@@ -1,0 +1,21 @@
+"""dlrover-tpu: a TPU-native elastic distributed deep-learning framework.
+
+A ground-up redesign of the capabilities of DLRover (elastic/fault-tolerant
+training orchestration + auto-acceleration + sparse embedding) for TPU
+hardware: JAX/XLA for compute, GSPMD meshes for parallelism, Pallas for
+custom kernels, and a gRPC control plane for elasticity.
+
+Layering (bottom-up):
+  common/      shared primitives: config, node model, typed RPC messages, IPC
+  master/      per-job master: rendezvous, data sharding, scaling, monitors
+  agent/       per-host agent: process supervision, checkpoint persistence
+  trainer/     in-process APIs: run CLI, ElasticTrainer, samplers
+  parallel/    mesh/axis fabric, sharding rules, ring attention
+  models/      flagship model families (GPT, Llama, MoE)
+  ops/         Pallas TPU kernels (flash attention, quantization)
+  optimizers/  AGD, WSAM, low-bit optimizer states (optax transforms)
+  auto/        auto_accelerate strategy engine
+  checkpoint/  flash checkpoint (shm staging + async persistence)
+"""
+
+__version__ = "0.1.0"

@@ -1,0 +1,188 @@
+"""Control-plane RPC transport.
+
+Same 2-RPC shape as the reference master service
+(proto/elastic_training.proto:28-31: ``report`` fire-and-forget-ish and
+``get`` request/response), but built with gRPC *generic handlers* and the
+typed msgpack schema from ``messages.py`` — no protoc codegen, no pickle.
+
+The server dispatches on the request dataclass type; handlers are
+registered per message class.
+"""
+
+from __future__ import annotations
+
+import socket
+import threading
+from concurrent import futures
+from typing import Any, Callable, Dict, Optional, Type
+
+import grpc
+
+from dlrover_tpu.common import messages
+from dlrover_tpu.common.constants import GrpcEnv
+from dlrover_tpu.common.log import get_logger
+
+logger = get_logger("comm")
+
+SERVICE_NAME = "dlrover_tpu.Master"
+_GET = f"/{SERVICE_NAME}/get"
+_REPORT = f"/{SERVICE_NAME}/report"
+
+_GRPC_OPTIONS = [
+    ("grpc.max_send_message_length", GrpcEnv.MAX_MESSAGE_LENGTH),
+    ("grpc.max_receive_message_length", GrpcEnv.MAX_MESSAGE_LENGTH),
+]
+
+
+def find_free_port(host: str = "127.0.0.1") -> int:
+    with socket.socket(socket.AF_INET, socket.SOCK_STREAM) as s:
+        s.bind((host, 0))
+        return s.getsockname()[1]
+
+
+class RpcDispatcher:
+    """Routes decoded request messages to per-type handler callables."""
+
+    def __init__(self):
+        self._get_handlers: Dict[type, Callable[[Any], Any]] = {}
+        self._report_handlers: Dict[type, Callable[[Any], Any]] = {}
+
+    def register_get(self, msg_cls: type, fn: Callable[[Any], Any]) -> None:
+        self._get_handlers[msg_cls] = fn
+
+    def register_report(self, msg_cls: type, fn: Callable[[Any], Any]) -> None:
+        self._report_handlers[msg_cls] = fn
+
+    def handle_get(self, request: Any) -> Any:
+        fn = self._get_handlers.get(type(request))
+        if fn is None:
+            raise KeyError(f"no get handler for {type(request).__name__}")
+        return fn(request)
+
+    def handle_report(self, request: Any) -> Any:
+        fn = self._report_handlers.get(type(request))
+        if fn is None:
+            raise KeyError(f"no report handler for {type(request).__name__}")
+        return fn(request)
+
+
+class _GenericHandler(grpc.GenericRpcHandler):
+    def __init__(self, dispatcher: RpcDispatcher):
+        self._dispatcher = dispatcher
+
+    def service(self, handler_call_details):
+        method = handler_call_details.method
+        if method == _GET:
+            return grpc.unary_unary_rpc_method_handler(
+                self._do_get,
+                request_deserializer=messages.deserialize,
+                response_serializer=messages.serialize,
+            )
+        if method == _REPORT:
+            return grpc.unary_unary_rpc_method_handler(
+                self._do_report,
+                request_deserializer=messages.deserialize,
+                response_serializer=messages.serialize,
+            )
+        return None
+
+    def _do_get(self, request, context):
+        try:
+            result = self._dispatcher.handle_get(request)
+            return messages.BaseResponse(success=True, data=result)
+        except Exception as e:  # noqa: BLE001 - must not kill the server
+            logger.exception("get(%s) failed", type(request).__name__)
+            return messages.BaseResponse(success=False, message=str(e))
+
+    def _do_report(self, request, context):
+        try:
+            result = self._dispatcher.handle_report(request)
+            return messages.BaseResponse(success=True, data=result)
+        except Exception as e:  # noqa: BLE001
+            logger.exception("report(%s) failed", type(request).__name__)
+            return messages.BaseResponse(success=False, message=str(e))
+
+
+class RpcServer:
+    """gRPC server hosting the master service."""
+
+    def __init__(
+        self,
+        dispatcher: RpcDispatcher,
+        port: int = 0,
+        max_workers: int = 16,
+    ):
+        self.dispatcher = dispatcher
+        self._server = grpc.server(
+            futures.ThreadPoolExecutor(max_workers=max_workers),
+            options=_GRPC_OPTIONS,
+        )
+        self._server.add_generic_rpc_handlers([_GenericHandler(dispatcher)])
+        self.port = self._server.add_insecure_port(f"0.0.0.0:{port}")
+
+    @property
+    def addr(self) -> str:
+        return f"127.0.0.1:{self.port}"
+
+    def start(self) -> None:
+        self._server.start()
+        logger.info("master RPC server listening on port %d", self.port)
+
+    def stop(self, grace: Optional[float] = None) -> None:
+        self._server.stop(grace)
+
+
+class RpcError(RuntimeError):
+    pass
+
+
+class RpcClient:
+    """Client to the master service; thread-safe, lazily connected."""
+
+    def __init__(self, addr: str, timeout: float = 30.0):
+        self.addr = addr
+        self.timeout = timeout
+        self._lock = threading.Lock()
+        self._channel: Optional[grpc.Channel] = None
+        self._get: Optional[grpc.UnaryUnaryMultiCallable] = None
+        self._report: Optional[grpc.UnaryUnaryMultiCallable] = None
+
+    def _connect(self):
+        with self._lock:
+            if self._channel is not None:
+                return
+            self._channel = grpc.insecure_channel(
+                self.addr, options=_GRPC_OPTIONS
+            )
+            self._get = self._channel.unary_unary(
+                _GET,
+                request_serializer=messages.serialize,
+                response_deserializer=messages.deserialize,
+            )
+            self._report = self._channel.unary_unary(
+                _REPORT,
+                request_serializer=messages.serialize,
+                response_deserializer=messages.deserialize,
+            )
+
+    def _call(self, stub_name: str, request: Any, timeout: Optional[float]):
+        self._connect()
+        stub = self._get if stub_name == "get" else self._report
+        response = stub(request, timeout=timeout or self.timeout)
+        if not isinstance(response, messages.BaseResponse):
+            raise RpcError(f"bad response type {type(response).__name__}")
+        if not response.success:
+            raise RpcError(response.message)
+        return response.data
+
+    def get(self, request: Any, timeout: Optional[float] = None) -> Any:
+        return self._call("get", request, timeout)
+
+    def report(self, request: Any, timeout: Optional[float] = None) -> Any:
+        return self._call("report", request, timeout)
+
+    def close(self) -> None:
+        with self._lock:
+            if self._channel is not None:
+                self._channel.close()
+                self._channel = None

@@ -1,0 +1,193 @@
+"""Framework-wide constants and enums.
+
+Capability parity with the reference's constant registry
+(dlrover/python/common/constants.py), redesigned for a TPU fleet: node
+types are host/master rather than PS/worker-GPU, and accelerator metadata
+speaks TPU topologies (chips per host, ICI slice shape) instead of GPUs.
+"""
+
+from __future__ import annotations
+
+import enum
+
+
+class NodeType:
+    """Roles a node can play in a job."""
+
+    MASTER = "master"
+    # A TPU host (one VM of a pod slice, owning N chips).
+    WORKER = "worker"
+    # CPU-only preprocessing host (coworker architecture).
+    DATA_WORKER = "data_worker"
+    # Parameter-server-style host for the sparse embedding path.
+    EMBEDDING = "embedding"
+    EVALUATOR = "evaluator"
+
+    ALL = (MASTER, WORKER, DATA_WORKER, EMBEDDING, EVALUATOR)
+
+
+class NodeStatus:
+    """Lifecycle states of a node; transitions in common/status_flow.py."""
+
+    INITIAL = "initial"
+    PENDING = "pending"
+    RUNNING = "running"
+    SUCCEEDED = "succeeded"
+    FAILED = "failed"
+    DELETED = "deleted"
+    BREAKDOWN = "breakdown"  # hardware failure detected by health check
+
+    ALIVE = (PENDING, RUNNING)
+    TERMINAL = (SUCCEEDED, FAILED, DELETED, BREAKDOWN)
+
+
+class NodeEventType:
+    CREATED = "created"
+    MODIFIED = "modified"
+    DELETED = "deleted"
+
+
+class NodeExitReason:
+    """Why a node's training process exited; drives relaunch policy."""
+
+    SUCCEEDED = "succeeded"
+    KILLED = "killed"
+    OOM = "oom"
+    FATAL_ERROR = "fatal_error"
+    HARDWARE_ERROR = "hardware_error"
+    PREEMPTED = "preempted"
+    UNKNOWN = "unknown"
+
+    # Exit reasons that should never be relaunched.
+    NO_RELAUNCH = (SUCCEEDED, FATAL_ERROR)
+
+
+class JobStage:
+    INIT = "init"
+    RENDEZVOUS = "rendezvous"
+    TRAINING = "training"
+    SUSPENDED = "suspended"
+    SUCCEEDED = "succeeded"
+    FAILED = "failed"
+
+
+class RendezvousName:
+    TRAINING = "elastic-training"
+    NETWORK_CHECK = "network-check"
+
+
+class TaskType:
+    """Dynamic-sharding task types handed to workers."""
+
+    TRAINING = "training"
+    EVALUATION = "evaluation"
+    PREDICTION = "prediction"
+    WAIT = "wait"
+    NONE = "none"
+
+
+class DatasetType:
+    TABLE = "table"
+    TEXT = "text"
+    STREAMING = "streaming"
+
+
+class TrainingExceptionLevel:
+    PROCESS_ERROR = "process_error"
+    NODE_ERROR = "node_error"
+    RDZV_ERROR = "rdzv_error"
+    WARNING = "warning"
+    INFO = "info"
+
+
+class PlatformType:
+    LOCAL = "local"
+    KUBERNETES = "k8s"
+    RAY = "ray"
+
+
+class Accelerators:
+    TPU = "tpu"
+    CPU = "cpu"  # for tests / virtual meshes
+
+
+class TpuGeneration:
+    V4 = "v4"
+    V5E = "v5e"
+    V5P = "v5p"
+    V6E = "v6e"
+
+    # Peak bf16 matmul TFLOP/s per chip, used by the analyser's cost model.
+    PEAK_BF16_TFLOPS = {V4: 275.0, V5E: 197.0, V5P: 459.0, V6E: 918.0}
+    # HBM bytes/s per chip.
+    HBM_GBPS = {V4: 1228.0, V5E: 819.0, V5P: 2765.0, V6E: 1640.0}
+
+
+class CheckpointConstant:
+    TRACKER_FILE = "latest_checkpointed_iteration.txt"
+    STEP_DIR_PREFIX = "iter_"
+    DONE_FILE_PREFIX = "done_"
+    MODEL_STATE_NAME = "model_state"
+    OPTIM_STATE_NAME = "optim_state"
+    EXTRA_STATE_NAME = "extra_state"
+
+
+class NodeEnv:
+    """Environment variables understood by agents and training processes."""
+
+    JOB_NAME = "DLROVER_TPU_JOB_NAME"
+    MASTER_ADDR = "DLROVER_TPU_MASTER_ADDR"
+    NODE_ID = "DLROVER_TPU_NODE_ID"
+    NODE_RANK = "DLROVER_TPU_NODE_RANK"
+    NODE_NUM = "DLROVER_TPU_NODE_NUM"
+    LOCAL_WORLD_SIZE = "DLROVER_TPU_LOCAL_WORLD_SIZE"
+    # JAX distributed bootstrap (coordinator = rank-0 host).
+    COORDINATOR_ADDR = "DLROVER_TPU_COORDINATOR_ADDR"
+    PROCESS_ID = "DLROVER_TPU_PROCESS_ID"
+    NUM_PROCESSES = "DLROVER_TPU_NUM_PROCESSES"
+    # Restart bookkeeping
+    RESTART_COUNT = "DLROVER_TPU_RESTART_COUNT"
+    # Platform type: local | k8s | ray
+    PLATFORM = "DLROVER_TPU_PLATFORM"
+    # Monitoring
+    MONITOR_ENABLED = "DLROVER_TPU_MONITOR_ENABLED"
+
+
+class GrpcEnv:
+    MAX_MESSAGE_LENGTH = 256 * 1024 * 1024
+
+
+class DefaultValues:
+    RDZV_TIMEOUT_SECS = 600
+    PENDING_TIMEOUT_SECS = 900
+    HANG_TIMEOUT_SECS = 1800
+    SHARD_TIMEOUT_SECS = 300
+    RELAUNCH_MAX = 3
+    MASTER_PORT = 0  # 0 = pick a free port
+    SAVE_MEM_INTERVAL_SECS = 30
+    REPORT_INTERVAL_SECS = 15
+
+
+class JobExitReason:
+    SUCCEEDED = "succeeded"
+    NODE_OOM = "node_oom_error"
+    NODE_FATAL = "node_fatal_error"
+    RDZV_TIMEOUT = "rendezvous_timeout"
+    PENDING_TIMEOUT = "pending_timeout"
+    UNKNOWN = "unknown"
+
+
+class ErrorMonitorConstants:
+    TYPE_INFO = "info"
+    TYPE_ERROR = "error"
+    ACTION_RELAUNCH = "relaunch"
+    ACTION_STOP = "stop"
+
+
+class EventAction(str, enum.Enum):
+    """Actions the master can push down to agents."""
+
+    NONE = "none"
+    RESTART_TRAINING = "restart_training"
+    STOP_TRAINING = "stop_training"
+    SAVE_CHECKPOINT = "save_checkpoint"

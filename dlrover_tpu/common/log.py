@@ -1,0 +1,38 @@
+"""Structured logging for all dlrover-tpu processes.
+
+One shared logger (parity: dlrover/python/common/log.py) with a
+rank/role-aware format so interleaved multi-process logs stay readable.
+"""
+
+from __future__ import annotations
+
+import logging
+import os
+import sys
+
+_FORMAT = (
+    "[%(asctime)s] [%(levelname)s] "
+    "[%(name)s:%(lineno)d] %(message)s"
+)
+
+
+def _build_logger(name: str = "dlrover_tpu") -> logging.Logger:
+    logger = logging.getLogger(name)
+    if logger.handlers:
+        return logger
+    level = os.getenv("DLROVER_TPU_LOG_LEVEL", "INFO").upper()
+    logger.setLevel(level)
+    handler = logging.StreamHandler(sys.stderr)
+    handler.setFormatter(logging.Formatter(_FORMAT))
+    logger.addHandler(handler)
+    logger.propagate = False
+    return logger
+
+
+default_logger = _build_logger()
+
+
+def get_logger(name: str) -> logging.Logger:
+    """Child logger that inherits the default handler/format."""
+    logger = default_logger.getChild(name)
+    return logger

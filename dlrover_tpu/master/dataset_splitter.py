@@ -1,0 +1,258 @@
+"""Dataset splitters for dynamic data sharding.
+
+Parity: dlrover/python/master/shard/dataset_splitter.py:144,257,359
+(TableDatasetSplitter / TextDatasetSplitter / StreamingDatasetSplitter).
+A splitter turns a dataset into epoch-aware shards of
+``batch_size * num_minibatches_per_shard`` records; the TaskManager
+queues them to workers. On TPU the worker side maps shard index ranges
+onto per-host `jax.Array` feed batches.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import json
+import random
+from abc import ABC, abstractmethod
+from typing import List, Optional
+
+from dlrover_tpu.common.log import get_logger
+
+logger = get_logger("splitter")
+
+
+@dataclasses.dataclass
+class Shard:
+    """A contiguous [start, end) range of records of one dataset.
+
+    ``record_indices`` optionally carries a shuffled index list for
+    text-style datasets where order must be randomized per epoch.
+    """
+
+    name: str
+    start: int
+    end: int
+    record_indices: Optional[List[int]] = None
+
+
+class DatasetSplitter(ABC):
+    def __init__(
+        self,
+        dataset_name: str,
+        dataset_size: int,
+        shard_size: int,
+        num_epochs: int = 1,
+    ):
+        self.dataset_name = dataset_name
+        self.dataset_size = dataset_size
+        self.shard_size = shard_size
+        self.num_epochs = num_epochs
+        self.epoch = 0
+
+    @abstractmethod
+    def create_shards(self) -> None:
+        """Populate shards for the next epoch."""
+
+    @abstractmethod
+    def get_shards(self) -> List[Shard]:
+        ...
+
+    def epoch_finished(self) -> bool:
+        return self.epoch >= self.num_epochs
+
+    def to_checkpoint(self) -> dict:
+        return {
+            "dataset_name": self.dataset_name,
+            "dataset_size": self.dataset_size,
+            "shard_size": self.shard_size,
+            "num_epochs": self.num_epochs,
+            "epoch": self.epoch,
+        }
+
+    def restore_checkpoint(self, state: dict) -> None:
+        self.epoch = state.get("epoch", 0)
+
+
+class TableDatasetSplitter(DatasetSplitter):
+    """Shards a record-addressable table dataset by index ranges."""
+
+    def __init__(
+        self,
+        dataset_name: str,
+        dataset_size: int,
+        shard_size: int,
+        num_epochs: int = 1,
+        shuffle: bool = False,
+        max_shard_count: int = 50000,
+    ):
+        super().__init__(dataset_name, dataset_size, shard_size, num_epochs)
+        self.shuffle = shuffle
+        self.max_shard_count = max_shard_count
+        self._shards: List[Shard] = []
+
+    def create_shards(self) -> None:
+        # Huge datasets are covered in sub-epoch windows of at most
+        # max_shard_count shards: keep a sliding offset and only advance
+        # the epoch once the window reaches the end of the data, so no
+        # record is ever silently dropped (parity with the reference's
+        # _split_epoch_for_huge_dataset).
+        offset = getattr(self, "_sub_offset", 0)
+        if offset == 0:
+            self.epoch += 1
+        shards = []
+        window_records = self.max_shard_count * self.shard_size
+        end_of_window = min(offset + window_records, self.dataset_size)
+        for start in range(offset, end_of_window, self.shard_size):
+            end = min(start + self.shard_size, self.dataset_size)
+            shards.append(Shard(self.dataset_name, start, end))
+        self._sub_offset = 0 if end_of_window >= self.dataset_size else end_of_window
+        if self.shuffle:
+            random.shuffle(shards)
+        self._shards = shards
+        logger.info(
+            "dataset %s epoch %d: %d shards of %d records "
+            "(window [%d, %d))",
+            self.dataset_name,
+            self.epoch,
+            len(shards),
+            self.shard_size,
+            offset,
+            end_of_window,
+        )
+
+    def epoch_finished(self) -> bool:
+        # Mid-window: the current epoch still has uncovered records.
+        if getattr(self, "_sub_offset", 0) > 0:
+            return False
+        return super().epoch_finished()
+
+    def get_shards(self) -> List[Shard]:
+        return self._shards
+
+    def to_checkpoint(self) -> dict:
+        state = super().to_checkpoint()
+        state["sub_offset"] = getattr(self, "_sub_offset", 0)
+        return state
+
+    def restore_checkpoint(self, state: dict) -> None:
+        super().restore_checkpoint(state)
+        self._sub_offset = state.get("sub_offset", 0)
+
+
+class TextDatasetSplitter(DatasetSplitter):
+    """Shards a line-indexed text dataset, shuffling record indices
+    within (and optionally across) shards per epoch."""
+
+    def __init__(
+        self,
+        dataset_name: str,
+        dataset_size: int,
+        shard_size: int,
+        num_epochs: int = 1,
+        shuffle: bool = False,
+    ):
+        super().__init__(dataset_name, dataset_size, shard_size, num_epochs)
+        self.shuffle = shuffle
+        self._shards: List[Shard] = []
+
+    def create_shards(self) -> None:
+        self.epoch += 1
+        indices = list(range(self.dataset_size))
+        if self.shuffle:
+            random.shuffle(indices)
+        shards = []
+        for start in range(0, self.dataset_size, self.shard_size):
+            end = min(start + self.shard_size, self.dataset_size)
+            shards.append(
+                Shard(
+                    self.dataset_name,
+                    start,
+                    end,
+                    record_indices=indices[start:end],
+                )
+            )
+        self._shards = shards
+
+    def get_shards(self) -> List[Shard]:
+        return self._shards
+
+
+class StreamingDatasetSplitter(DatasetSplitter):
+    """Shards an unbounded stream by advancing partition offsets.
+
+    ``dataset_size`` < 0 means infinite; shards are fabricated on demand
+    from the current offset.
+    """
+
+    def __init__(
+        self,
+        dataset_name: str,
+        shard_size: int,
+        dataset_size: int = -1,
+        num_epochs: int = 1,
+        fetch_batch: int = 100,
+    ):
+        super().__init__(dataset_name, dataset_size, shard_size, num_epochs)
+        self.offset = 0
+        self.fetch_batch = fetch_batch
+        self._shards: List[Shard] = []
+
+    def epoch_finished(self) -> bool:
+        if self.dataset_size < 0:
+            return False
+        return self.offset >= self.dataset_size
+
+    def create_shards(self) -> None:
+        if self.epoch == 0:
+            self.epoch = 1
+        shards = []
+        for _ in range(self.fetch_batch):
+            if 0 <= self.dataset_size <= self.offset:
+                break
+            end = self.offset + self.shard_size
+            if self.dataset_size >= 0:
+                end = min(end, self.dataset_size)
+            shards.append(Shard(self.dataset_name, self.offset, end))
+            self.offset = end
+        self._shards = shards
+
+    def get_shards(self) -> List[Shard]:
+        return self._shards
+
+    def to_checkpoint(self) -> dict:
+        state = super().to_checkpoint()
+        state["offset"] = self.offset
+        return state
+
+    def restore_checkpoint(self, state: dict) -> None:
+        super().restore_checkpoint(state)
+        self.offset = state.get("offset", 0)
+
+
+def new_dataset_splitter(
+    storage_type: str,
+    dataset_name: str,
+    dataset_size: int,
+    shard_size: int,
+    num_epochs: int = 1,
+    shuffle: bool = False,
+) -> DatasetSplitter:
+    if storage_type in ("", "table"):
+        return TableDatasetSplitter(
+            dataset_name, dataset_size, shard_size, num_epochs, shuffle
+        )
+    if storage_type == "text":
+        return TextDatasetSplitter(
+            dataset_name, dataset_size, shard_size, num_epochs, shuffle
+        )
+    if storage_type == "streaming":
+        return StreamingDatasetSplitter(
+            dataset_name, shard_size, dataset_size, num_epochs
+        )
+    raise ValueError(f"unknown dataset storage type {storage_type!r}")
+
+
+def splitter_state_to_json(splitter: DatasetSplitter, extra: dict) -> str:
+    state = splitter.to_checkpoint()
+    state.update(extra)
+    return json.dumps(state)

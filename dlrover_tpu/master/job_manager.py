@@ -1,0 +1,265 @@
+"""Job/node manager on the master.
+
+Capability parity with the reference's node management layer
+(dlrover/python/master/node/dist_job_manager.py:87): tracks every node's
+state machine, classifies failures, decides relaunches, and feeds the
+rendezvous managers / task manager / speed monitor. Platform-specific
+scaling (GKE TPU pod-slices, Ray) plugs in via a ``Scaler`` interface;
+the local platform simply records intents so tests can assert on them.
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+from typing import Callable, Dict, List, Optional
+
+from dlrover_tpu.common.constants import (
+    NodeEventType,
+    NodeExitReason,
+    NodeStatus,
+    NodeType,
+    TrainingExceptionLevel,
+)
+from dlrover_tpu.common.log import get_logger
+from dlrover_tpu.common.node import Node, NodeResource
+
+logger = get_logger("job_manager")
+
+
+class ScalePlan:
+    """Target state the scaler should realize."""
+
+    def __init__(self):
+        self.launch_nodes: List[Node] = []
+        self.remove_nodes: List[Node] = []
+
+    def empty(self) -> bool:
+        return not self.launch_nodes and not self.remove_nodes
+
+    def __repr__(self):
+        return (
+            f"ScalePlan(launch={[n.id for n in self.launch_nodes]}, "
+            f"remove={[n.id for n in self.remove_nodes]})"
+        )
+
+
+class Scaler:
+    """Executes ScalePlans. Subclasses talk to GKE/Ray; the base class
+    records plans for local mode and tests."""
+
+    def __init__(self):
+        self.executed_plans: List[ScalePlan] = []
+
+    def scale(self, plan: ScalePlan) -> None:
+        self.executed_plans.append(plan)
+
+
+class JobManager:
+    """Tracks nodes and drives relaunch decisions."""
+
+    def __init__(
+        self,
+        scaler: Optional[Scaler] = None,
+        max_relaunch: int = 3,
+        heartbeat_timeout: float = 180.0,
+    ):
+        self._lock = threading.Lock()
+        self._nodes: Dict[int, Node] = {}
+        self._scaler = scaler or Scaler()
+        self._max_relaunch = max_relaunch
+        self._heartbeat_timeout = heartbeat_timeout
+        self._next_node_id = 0
+        self._stop = threading.Event()
+        self._monitor_thread: Optional[threading.Thread] = None
+        # subscribers: fn(node, event_type)
+        self._listeners: List[Callable[[Node, str], None]] = []
+
+    # -- membership ---------------------------------------------------------
+
+    def add_listener(self, fn: Callable[[Node, str], None]) -> None:
+        self._listeners.append(fn)
+
+    def _notify(self, node: Node, event_type: str) -> None:
+        for fn in self._listeners:
+            try:
+                fn(node, event_type)
+            except Exception:  # noqa: BLE001
+                logger.exception("node event listener failed")
+
+    def register_node(
+        self,
+        node_type: str = NodeType.WORKER,
+        node_id: Optional[int] = None,
+        rank: int = -1,
+        addr: str = "",
+        resource: Optional[NodeResource] = None,
+    ) -> Node:
+        """Called when an agent announces itself (or a pod is created)."""
+        with self._lock:
+            if node_id is None:
+                node_id = self._next_node_id
+            self._next_node_id = max(self._next_node_id, node_id + 1)
+            node = self._nodes.get(node_id)
+            if node is not None and node.status in NodeStatus.TERMINAL:
+                # A relaunched agent re-registering under its old id: the
+                # old Node is a finished incarnation, start a fresh one
+                # carrying over rank and the relaunch budget.
+                fresh = Node(
+                    type=node.type,
+                    id=node.id,
+                    rank=node.rank,
+                    host_addr=addr or node.host_addr,
+                    config_resource=node.config_resource,
+                    relaunch_count=node.relaunch_count,
+                    max_relaunch_count=node.max_relaunch_count,
+                )
+                self._nodes[node_id] = fresh
+                node = fresh
+            elif node is None:
+                node = Node(
+                    type=node_type,
+                    id=node_id,
+                    rank=rank if rank >= 0 else node_id,
+                    host_addr=addr,
+                    config_resource=resource or NodeResource(),
+                    max_relaunch_count=self._max_relaunch,
+                )
+                self._nodes[node_id] = node
+            node.host_addr = addr or node.host_addr
+            node.update_status(NodeStatus.RUNNING)
+            node.update_heartbeat()
+        self._notify(node, NodeEventType.CREATED)
+        return node
+
+    def get_node(self, node_id: int) -> Optional[Node]:
+        with self._lock:
+            return self._nodes.get(node_id)
+
+    def list_nodes(self, node_type: str = "") -> List[Node]:
+        with self._lock:
+            return [
+                n
+                for n in self._nodes.values()
+                if not node_type or n.type == node_type
+            ]
+
+    def alive_nodes(self) -> List[Node]:
+        with self._lock:
+            return [n for n in self._nodes.values() if n.is_alive()]
+
+    def update_heartbeat(self, node_id: int) -> None:
+        with self._lock:
+            node = self._nodes.get(node_id)
+            if node is not None:
+                node.update_heartbeat()
+
+    # -- failure handling ---------------------------------------------------
+
+    def classify_exit(self, error_data: str, level: str) -> str:
+        if level == TrainingExceptionLevel.NODE_ERROR:
+            return NodeExitReason.HARDWARE_ERROR
+        text = (error_data or "").lower()
+        if "oom" in text or "out of memory" in text or "resource_exhausted" in text:
+            return NodeExitReason.OOM
+        if "preempt" in text:
+            return NodeExitReason.PREEMPTED
+        return NodeExitReason.KILLED
+
+    def handle_failure_report(
+        self, node_id: int, error_data: str, level: str, restart_count: int
+    ) -> bool:
+        """Returns True if the node will be relaunched."""
+        with self._lock:
+            node = self._nodes.get(node_id)
+            if node is None:
+                return False
+            node.exit_reason = self.classify_exit(error_data, level)
+            node.update_status(NodeStatus.FAILED)
+            relaunch = node.should_relaunch()
+            if relaunch:
+                node.inc_relaunch_count()
+        logger.warning(
+            "node %d failed (%s, level=%s) relaunch=%s",
+            node_id,
+            node.exit_reason,
+            level,
+            relaunch,
+        )
+        self._notify(node, NodeEventType.MODIFIED)
+        if relaunch:
+            self._relaunch(node)
+        return relaunch
+
+    def _relaunch(self, node: Node) -> None:
+        plan = ScalePlan()
+        new_node = Node(
+            type=node.type,
+            id=node.id,
+            rank=node.rank,
+            status=NodeStatus.PENDING,
+            config_resource=node.config_resource,
+            relaunch_count=node.relaunch_count,
+            max_relaunch_count=node.max_relaunch_count,
+        )
+        plan.launch_nodes.append(new_node)
+        plan.remove_nodes.append(node)
+        self._scaler.scale(plan)
+
+    def handle_node_succeeded(self, node_id: int) -> None:
+        with self._lock:
+            node = self._nodes.get(node_id)
+            if node is not None:
+                node.update_status(NodeStatus.SUCCEEDED)
+        if node is not None:
+            self._notify(node, NodeEventType.MODIFIED)
+
+    # -- hang watchdog ------------------------------------------------------
+
+    def start(self) -> None:
+        if self._monitor_thread is not None:
+            return
+        self._monitor_thread = threading.Thread(
+            target=self._monitor_loop, name="node-monitor", daemon=True
+        )
+        self._monitor_thread.start()
+
+    def _monitor_loop(self) -> None:
+        while not self._stop.wait(30.0):
+            now = time.time()
+            dead: List[Node] = []
+            with self._lock:
+                for node in self._nodes.values():
+                    if (
+                        node.is_alive()
+                        and node.heartbeat_time > 0
+                        and now - node.heartbeat_time
+                        > self._heartbeat_timeout
+                    ):
+                        node.exit_reason = NodeExitReason.KILLED
+                        node.update_status(NodeStatus.FAILED)
+                        dead.append(node)
+            for node in dead:
+                logger.warning(
+                    "node %d heartbeat timeout (>%ss); treating as dead",
+                    node.id,
+                    self._heartbeat_timeout,
+                )
+                self._notify(node, NodeEventType.DELETED)
+                if node.should_relaunch():
+                    node.inc_relaunch_count()
+                    self._relaunch(node)
+
+    def stop(self) -> None:
+        self._stop.set()
+
+    def all_workers_done(self) -> bool:
+        with self._lock:
+            workers = [
+                n
+                for n in self._nodes.values()
+                if n.type == NodeType.WORKER
+            ]
+            if not workers:
+                return False
+            return all(n.status in NodeStatus.TERMINAL for n in workers)

@@ -1,0 +1,56 @@
+"""Master-hosted key-value store.
+
+Parity: dlrover/python/master/elastic_training/kv_store_service.py. Used
+by agents/trainers as the bootstrap store (the role torch's TCPStore
+plays in torchelastic; here it hands out the JAX coordinator address and
+synchronizes process-id assignment) and for small cross-host blobs.
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+from typing import Dict
+
+
+class KVStoreService:
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._store: Dict[str, bytes] = {}
+        self._cond = threading.Condition(self._lock)
+
+    def set(self, key: str, value: bytes) -> None:
+        with self._cond:
+            self._store[key] = value
+            self._cond.notify_all()
+
+    def get(self, key: str) -> bytes:
+        with self._lock:
+            return self._store.get(key, b"")
+
+    def has(self, key: str) -> bool:
+        with self._lock:
+            return key in self._store
+
+    def add(self, key: str, amount: int) -> int:
+        """Atomic counter add (value stored as decimal string)."""
+        with self._cond:
+            current = int(self._store.get(key, b"0") or b"0")
+            current += amount
+            self._store[key] = str(current).encode()
+            self._cond.notify_all()
+            return current
+
+    def wait(self, key: str, timeout: float = 60.0) -> bytes:
+        deadline = time.time() + timeout
+        with self._cond:
+            while key not in self._store:
+                remaining = deadline - time.time()
+                if remaining <= 0:
+                    raise TimeoutError(f"key {key!r} not set in {timeout}s")
+                self._cond.wait(remaining)
+            return self._store[key]
+
+    def clear(self) -> None:
+        with self._lock:
+            self._store.clear()

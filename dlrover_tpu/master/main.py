@@ -1,0 +1,43 @@
+"""Master CLI entrypoint: ``python -m dlrover_tpu.master.main``.
+
+Parity: dlrover/python/master/main.py:37-58.
+"""
+
+from __future__ import annotations
+
+import argparse
+import sys
+
+from dlrover_tpu.common.log import get_logger
+from dlrover_tpu.master.master import JobMaster
+
+logger = get_logger("master.main")
+
+
+def parse_args(argv=None):
+    parser = argparse.ArgumentParser("dlrover-tpu-master")
+    parser.add_argument("--port", type=int, default=0)
+    parser.add_argument("--node_num", type=int, default=1)
+    parser.add_argument("--min_nodes", type=int, default=0)
+    parser.add_argument("--node_unit", type=int, default=1)
+    parser.add_argument("--rdzv_timeout", type=float, default=30.0)
+    return parser.parse_args(argv)
+
+
+def main(argv=None) -> int:
+    args = parse_args(argv)
+    master = JobMaster(
+        port=args.port,
+        node_num=args.node_num,
+        min_nodes=args.min_nodes,
+        node_unit=args.node_unit,
+        rdzv_timeout=args.rdzv_timeout,
+    )
+    master.prepare()
+    # Print the bound port on stdout so a parent process can discover it.
+    print(f"DLROVER_TPU_MASTER_PORT={master.port}", flush=True)
+    return master.run()
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,114 @@
+"""Job master assembly and lifecycle.
+
+Parity: dlrover/python/master/dist_master.py:53 (DistributedJobMaster)
+and local_master.py (LocalJobMaster). One ``JobMaster`` serves both
+roles: in local/standalone mode it is spawned as a subprocess of the run
+CLI on the rank-0 host; on a cluster it runs in its own pod.
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+from typing import Optional
+
+from dlrover_tpu.common.comm import RpcDispatcher, RpcServer
+from dlrover_tpu.common.log import get_logger
+from dlrover_tpu.master.job_manager import JobManager, Scaler
+from dlrover_tpu.master.kv_store import KVStoreService
+from dlrover_tpu.master.rendezvous import (
+    ElasticRendezvous,
+    NetworkCheckRendezvous,
+)
+from dlrover_tpu.master.servicer import MasterServicer
+from dlrover_tpu.master.speed_monitor import SpeedMonitor
+from dlrover_tpu.master.task_manager import TaskManager
+
+logger = get_logger("master")
+
+
+class JobMaster:
+    def __init__(
+        self,
+        port: int = 0,
+        node_num: int = 1,
+        min_nodes: int = 0,
+        node_unit: int = 1,
+        rdzv_timeout: float = 30.0,
+        scaler: Optional[Scaler] = None,
+    ):
+        """``node_num`` is the desired (max) world size; ``min_nodes``
+        (default = node_num) is the smallest world the job may proceed
+        with after losses — the elastic range of ``--nnodes min:max``."""
+        self.node_num = node_num
+        self.job_manager = JobManager(scaler=scaler)
+        self.task_manager = TaskManager()
+        self.speed_monitor = SpeedMonitor()
+        self.kv_store = KVStoreService()
+        self.elastic_rdzv = ElasticRendezvous()
+        self.check_rdzv = NetworkCheckRendezvous()
+        for rdzv in (self.elastic_rdzv, self.check_rdzv):
+            rdzv.update_params(
+                min_nodes=min_nodes if min_nodes > 0 else node_num,
+                max_nodes=node_num,
+                waiting_timeout=rdzv_timeout,
+                node_unit=node_unit,
+            )
+        self.servicer = MasterServicer(
+            job_manager=self.job_manager,
+            task_manager=self.task_manager,
+            elastic_rdzv=self.elastic_rdzv,
+            check_rdzv=self.check_rdzv,
+            kv_store=self.kv_store,
+            speed_monitor=self.speed_monitor,
+        )
+        dispatcher = RpcDispatcher()
+        self.servicer.register(dispatcher)
+        self._server = RpcServer(dispatcher, port=port)
+        self._stopped = threading.Event()
+
+    @property
+    def port(self) -> int:
+        return self._server.port
+
+    @property
+    def addr(self) -> str:
+        return self._server.addr
+
+    def prepare(self) -> None:
+        self._server.start()
+        self.job_manager.start()
+        self.task_manager.start()
+
+    def run(self, poll_interval: float = 2.0) -> int:
+        """Block until the job completes; returns an exit code."""
+        try:
+            while not self._stopped.wait(poll_interval):
+                if self.job_manager.all_workers_done():
+                    logger.info("all workers finished; master exiting")
+                    return 0
+        except KeyboardInterrupt:
+            return 1
+        return 0
+
+    def stop(self) -> None:
+        self._stopped.set()
+        self.task_manager.stop()
+        self.job_manager.stop()
+        self._server.stop(0)
+
+
+def run_master(
+    port: int = 0,
+    node_num: int = 1,
+    node_unit: int = 1,
+    rdzv_timeout: float = 30.0,
+) -> JobMaster:
+    master = JobMaster(
+        port=port,
+        node_num=node_num,
+        node_unit=node_unit,
+        rdzv_timeout=rdzv_timeout,
+    )
+    master.prepare()
+    return master

@@ -1,0 +1,339 @@
+"""Master-side rendezvous managers.
+
+Behavior parity with the reference's rendezvous layer
+(dlrover/python/master/elastic_training/rdzv_manager.py:113,272,351):
+
+* ``ElasticRendezvous`` — collects joining hosts, freezes a
+  communication world once ``max_nodes`` joined or ``min_nodes`` joined
+  and the waiting timeout elapsed, rounded down to a multiple of
+  ``node_unit`` (a TPU *pod-slice host group*: worlds must be a whole
+  number of slices for the ICI mesh to be rectangular).
+* ``NetworkCheckRendezvous`` — two-round pairwise health check: round 0
+  pairs neighbors, round 1 re-pairs sorted-by-time so a failing pair is
+  disambiguated; stragglers are nodes slower than 2x the median.
+
+On TPU the "world" handed back is used to (re)build the
+``jax.distributed`` bootstrap (coordinator + process ids), and the
+health-check payload is a small psum/all-gather over ICI rather than a
+NCCL allgather.
+"""
+
+from __future__ import annotations
+
+import math
+import threading
+import time
+from typing import Dict, List, Optional, Set, Tuple
+
+from dlrover_tpu.common.constants import RendezvousName
+from dlrover_tpu.common.log import get_logger
+
+logger = get_logger("rendezvous")
+
+
+class RendezvousParameters:
+    def __init__(
+        self,
+        min_nodes: int = 0,
+        max_nodes: int = 0,
+        waiting_timeout: float = 30.0,
+        node_unit: int = 1,
+    ):
+        self.min_nodes = min_nodes
+        self.max_nodes = max_nodes
+        self.waiting_timeout = waiting_timeout
+        self.node_unit = node_unit
+
+
+class RendezvousManagerBase:
+    """Shared join/freeze logic for both rendezvous flavors."""
+
+    name: str = ""
+
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._params = RendezvousParameters()
+        # node_rank -> local_world_size, nodes waiting for the next round
+        self._waiting_nodes: Dict[int, int] = {}
+        # frozen world for the current round
+        self._rdzv_nodes: Dict[int, int] = {}
+        self._latest_rdzv_nodes: List[int] = []
+        self._alive_nodes: Set[int] = set()
+        self._rdzv_round = 0
+        self._lastcall_time = 0.0
+        self._start_rdzv_time = 0.0
+
+    def update_params(
+        self,
+        min_nodes: int,
+        max_nodes: int,
+        waiting_timeout: float = 30.0,
+        node_unit: int = 1,
+    ) -> None:
+        with self._lock:
+            if self._params.max_nodes == 0:
+                self._params = RendezvousParameters(
+                    min_nodes, max_nodes, waiting_timeout, node_unit
+                )
+
+    @property
+    def round(self) -> int:
+        return self._rdzv_round
+
+    def add_alive_node(self, node_id: int) -> None:
+        with self._lock:
+            self._alive_nodes.add(node_id)
+
+    def remove_alive_node(self, node_id: int, node_rank: int = -1) -> None:
+        with self._lock:
+            self._alive_nodes.discard(node_id)
+            rank = node_rank if node_rank >= 0 else node_id
+            self._waiting_nodes.pop(rank, None)
+
+    def join(self, node_rank: int, local_world_size: int) -> int:
+        """Add a node to the waiting list; returns the round index."""
+        with self._lock:
+            if not self._waiting_nodes:
+                self._start_rdzv_time = time.time()
+                logger.info(
+                    "%s: start round %d rendezvous",
+                    self.name,
+                    self._rdzv_round,
+                )
+            if node_rank not in self._waiting_nodes:
+                self._waiting_nodes[node_rank] = local_world_size
+                # Only a returning member of the frozen world invalidates
+                # it (it restarted, so the old world is dead). A brand-new
+                # node must NOT wipe the world other members are still
+                # fetching — it waits for the next round, which agents
+                # enter once num_nodes_waiting() tells them to restart.
+                if node_rank in self._latest_rdzv_nodes:
+                    self._rdzv_nodes = {}
+                self._lastcall_time = time.time()
+            return self._rdzv_round
+
+    def _try_complete(self) -> bool:
+        """Freeze the world when enough nodes joined. Caller holds lock."""
+        waiting_num = len(self._waiting_nodes)
+        completed = False
+        if waiting_num >= self._params.max_nodes and waiting_num > 0:
+            # Never freeze a world larger than max_nodes.
+            waiting_num = self._params.max_nodes
+            completed = True
+        elif (
+            waiting_num > 0
+            and time.time() - self._lastcall_time
+            >= self._params.waiting_timeout
+        ):
+            # Round down to whole node_units (slices) FIRST, then check
+            # the minimum — a rounded-down world below min_nodes is not
+            # a viable job and must keep waiting.
+            waiting_num = (
+                waiting_num // self._params.node_unit
+            ) * self._params.node_unit
+            if waiting_num >= self._params.min_nodes and waiting_num > 0:
+                completed = True
+            else:
+                return False
+        if completed:
+            ranks = sorted(self._waiting_nodes.keys())[:waiting_num]
+            self._rdzv_nodes = {r: self._waiting_nodes[r] for r in ranks}
+            self._latest_rdzv_nodes = list(self._rdzv_nodes.keys())
+            for r in ranks:
+                self._waiting_nodes.pop(r, None)
+            self._lastcall_time = 0.0
+            elapsed = time.time() - self._start_rdzv_time
+            logger.info(
+                "%s: round %d completed with %d nodes in %.2fs; "
+                "left waiting: %s",
+                self.name,
+                self._rdzv_round,
+                len(self._rdzv_nodes),
+                elapsed,
+                self._waiting_nodes,
+            )
+        return completed
+
+    def num_nodes_waiting(self) -> int:
+        """Nonzero return tells agents to restart for re-rendezvous.
+
+        A returning member (restart) triggers immediately; brand-new
+        nodes only once a whole node_unit (slice) of them is ready.
+        """
+        with self._lock:
+            for rank in self._waiting_nodes:
+                if rank in self._latest_rdzv_nodes:
+                    return len(self._waiting_nodes)
+            if len(self._waiting_nodes) >= self._params.node_unit:
+                return len(self._waiting_nodes)
+            return 0
+
+
+class ElasticRendezvous(RendezvousManagerBase):
+    """Rendezvous for the training world."""
+
+    name = RendezvousName.TRAINING
+
+    def get_comm_world(
+        self, node_rank: int
+    ) -> Tuple[int, int, Dict[int, int]]:
+        with self._lock:
+            if not self._rdzv_nodes:
+                if self._try_complete():
+                    self._rdzv_round += 1
+            return self._rdzv_round, 0, dict(self._rdzv_nodes)
+
+
+class NetworkCheckRendezvous(RendezvousManagerBase):
+    """Two-round pairwise health-check rendezvous.
+
+    Round even: pair adjacent nodes — each pair runs the check payload
+    (psum + matmul benchmark) over ICI/DCN. Round odd: re-pair fastest
+    with slowest so a node that failed in a bad pair gets a known-good
+    partner; a node failing both rounds is faulty.
+    """
+
+    name = RendezvousName.NETWORK_CHECK
+    CHECK_ROUNDS = 2
+
+    def __init__(self):
+        super().__init__()
+        self._node_status: Dict[int, bool] = {}
+        self._node_times: Dict[int, float] = {}
+        self._reported_nodes: Set[int] = set()
+        self._node_groups: List[Dict[int, int]] = []
+        self._fault_nodes: Set[int] = set()
+        self._straggler_nodes: Set[int] = set()
+
+    def join(self, node_rank: int, local_world_size: int) -> int:
+        with self._lock:
+            self._node_groups.clear()
+        return super().join(node_rank, local_world_size)
+
+    def get_comm_world(
+        self, node_rank: int
+    ) -> Tuple[int, int, Dict[int, int]]:
+        with self._lock:
+            if not self._node_groups:
+                if self._try_complete():
+                    self._fault_nodes.clear()
+                    self._straggler_nodes.clear()
+                    self._node_groups = self._group_nodes(self._rdzv_round)
+                    logger.info(
+                        "network-check round %d groups: %s",
+                        self._rdzv_round,
+                        self._node_groups,
+                    )
+                    if self._rdzv_round % self.CHECK_ROUNDS == 0:
+                        self._node_status = {}
+                        self._node_times = {}
+                    self._reported_nodes = set()
+                    self._rdzv_round += 1
+            for i, group in enumerate(self._node_groups):
+                if node_rank in group:
+                    return self._rdzv_round, i, dict(group)
+            return self._rdzv_round, 0, dict(self._rdzv_nodes)
+
+    def _group_nodes(self, rdzv_round: int) -> List[Dict[int, int]]:
+        phase = rdzv_round % self.CHECK_ROUNDS
+        groups: List[Dict[int, int]] = []
+        if phase == 0:
+            # Adjacent pairs; odd node out merges into the last group.
+            group: Dict[int, int] = {}
+            for rank, lws in sorted(self._rdzv_nodes.items()):
+                group[rank] = lws
+                if len(group) == 2:
+                    groups.append(group)
+                    group = {}
+            if group:
+                if groups:
+                    groups[-1].update(group)
+                else:
+                    groups.append(group)
+        else:
+            # Pair fastest with slowest from the previous round.
+            ordered = [
+                rank
+                for rank, _ in sorted(
+                    self._node_times.items(), key=lambda kv: kv[1]
+                )
+                if rank in self._rdzv_nodes
+            ]
+            # Nodes that never reported go in at the end (suspect).
+            for rank in sorted(self._rdzv_nodes):
+                if rank not in ordered:
+                    ordered.append(rank)
+            left, right = 0, len(ordered) - 1
+            group = {}
+            while right >= left:
+                group = {}
+                group[ordered[left]] = self._rdzv_nodes[ordered[left]]
+                group[ordered[right]] = self._rdzv_nodes[ordered[right]]
+                if len(group) == 2:
+                    groups.append(group)
+                left += 1
+                right -= 1
+            if len(group) == 1:
+                if groups:
+                    groups[-1].update(group)
+                else:
+                    groups.append(group)
+        return groups
+
+    def report_result(
+        self, node_rank: int, normal: bool, elapsed_time: float
+    ) -> None:
+        with self._lock:
+            self._reported_nodes.add(node_rank)
+            # A node is healthy if it passed in ANY round (a failure may
+            # be its partner's fault); keep its best time.
+            prev_status = self._node_status.get(node_rank, normal)
+            self._node_status[node_rank] = prev_status or normal
+            prev_time = self._node_times.get(node_rank, elapsed_time)
+            self._node_times[node_rank] = round(
+                min(prev_time, elapsed_time), 3
+            )
+
+    def check_fault_nodes(self) -> Tuple[List[int], str]:
+        """Return ([fault ranks], reason). reason='waiting' while nodes
+        are still reporting."""
+        with self._lock:
+            if len(self._reported_nodes) < len(self._rdzv_nodes):
+                return [], "waiting"
+            if not self._fault_nodes:
+                for rank, ok in self._node_status.items():
+                    if not ok:
+                        self._fault_nodes.add(rank)
+                stragglers = self._detect_stragglers()
+                if not self._fault_nodes and not stragglers:
+                    # Align round counter so the next check starts fresh.
+                    self._rdzv_round = (
+                        math.ceil(self._rdzv_round / self.CHECK_ROUNDS)
+                        * self.CHECK_ROUNDS
+                    )
+            reason = "fault" if self._fault_nodes else ""
+            return sorted(self._fault_nodes), reason
+
+    def get_stragglers(self) -> Tuple[List[int], str]:
+        with self._lock:
+            if len(self._reported_nodes) < len(self._rdzv_nodes):
+                return [], "waiting"
+            if not self._straggler_nodes:
+                self._straggler_nodes.update(self._detect_stragglers())
+            return sorted(self._straggler_nodes), ""
+
+    def _detect_stragglers(self) -> Dict[int, float]:
+        stragglers: Dict[int, float] = {}
+        times = sorted(self._node_times.values())
+        if not times:
+            return stragglers
+        n = len(times)
+        med = (
+            times[n // 2]
+            if n % 2
+            else (times[n // 2] + times[n // 2 - 1]) / 2
+        )
+        for rank, t in self._node_times.items():
+            if t > 2 * med:
+                stragglers[rank] = t
+        return stragglers

@@ -1,0 +1,245 @@
+"""Master RPC servicer: binds typed messages to master components.
+
+Parity: dlrover/python/master/servicer.py:62 (MasterServicer.get/report
+dispatch), rebuilt on the typed dispatcher of common/comm.py.
+"""
+
+from __future__ import annotations
+
+import time
+from typing import Optional
+
+from dlrover_tpu.common import messages as msg
+from dlrover_tpu.common.comm import RpcDispatcher
+from dlrover_tpu.common.constants import EventAction, RendezvousName
+from dlrover_tpu.common.log import get_logger
+from dlrover_tpu.master.job_manager import JobManager
+from dlrover_tpu.master.kv_store import KVStoreService
+from dlrover_tpu.master.rendezvous import (
+    ElasticRendezvous,
+    NetworkCheckRendezvous,
+)
+from dlrover_tpu.master.speed_monitor import SpeedMonitor
+from dlrover_tpu.master.task_manager import TaskManager
+
+logger = get_logger("servicer")
+
+
+class MasterServicer:
+    def __init__(
+        self,
+        job_manager: JobManager,
+        task_manager: TaskManager,
+        elastic_rdzv: ElasticRendezvous,
+        check_rdzv: NetworkCheckRendezvous,
+        kv_store: Optional[KVStoreService] = None,
+        speed_monitor: Optional[SpeedMonitor] = None,
+    ):
+        self.job_manager = job_manager
+        self.task_manager = task_manager
+        self.rdzv_managers = {
+            RendezvousName.TRAINING: elastic_rdzv,
+            RendezvousName.NETWORK_CHECK: check_rdzv,
+        }
+        self.kv_store = kv_store or KVStoreService()
+        self.speed_monitor = speed_monitor or SpeedMonitor()
+        # actions queued for agents, popped on heartbeat
+        self._pending_actions: dict[int, str] = {}
+
+    def _rdzv(self, name: str):
+        mgr = self.rdzv_managers.get(name or RendezvousName.TRAINING)
+        if mgr is None:
+            raise KeyError(f"unknown rendezvous {name!r}")
+        return mgr
+
+    # -- registration -------------------------------------------------------
+
+    def register(self, dispatcher: RpcDispatcher) -> None:
+        g = dispatcher.register_get
+        r = dispatcher.register_report
+
+        g(msg.JoinRendezvousRequest, self._join_rendezvous)
+        g(msg.CommWorldRequest, self._get_comm_world)
+        g(msg.WaitingNodeNumRequest, self._num_nodes_waiting)
+        g(msg.NetworkCheckQueryRequest, self._query_network_check)
+        g(msg.KVStoreGetRequest, self._kv_get)
+        g(msg.KVStoreAddRequest, self._kv_add)
+        g(msg.TaskRequest, self._get_task)
+        g(msg.ShardCheckpointRequest, self._get_shard_checkpoint)
+        g(msg.JobNodesRequest, self._get_job_nodes)
+        g(msg.ParallelConfigRequest, self._get_parallel_config)
+
+        r(msg.KVStoreSetRequest, self._kv_set)
+        r(msg.DatasetShardParams, self._create_dataset)
+        r(msg.TaskResultRequest, self._report_task_result)
+        r(msg.NetworkCheckResultRequest, self._report_network_result)
+        r(msg.StepReport, self._report_step)
+        r(msg.ResourceStats, self._report_resource)
+        r(msg.NodeFailureReport, self._report_failure)
+        r(msg.HeartbeatRequest, self._heartbeat)
+        r(msg.NodeAddressRequest, self._register_node)
+        r(msg.RestoreShardRequest, self._restore_shards)
+
+    def _noop(self, req):
+        return None
+
+    # -- rendezvous ---------------------------------------------------------
+
+    def _join_rendezvous(self, req: msg.JoinRendezvousRequest):
+        mgr = self._rdzv(req.rdzv_name)
+        round_ = mgr.join(req.node_rank, req.local_world_size)
+        return msg.JoinRendezvousResponse(round=round_)
+
+    def _get_comm_world(self, req: msg.CommWorldRequest):
+        mgr = self._rdzv(req.rdzv_name)
+        rank = req.node_rank if req.node_rank >= 0 else req.node_id
+        round_, group, world = mgr.get_comm_world(rank)
+        return msg.CommWorldResponse(
+            rdzv_name=req.rdzv_name, round=round_, group=group, world=world
+        )
+
+    def _num_nodes_waiting(self, req: msg.WaitingNodeNumRequest):
+        mgr = self._rdzv(req.rdzv_name)
+        return msg.WaitingNodeNumResponse(waiting_num=mgr.num_nodes_waiting())
+
+    def _report_network_result(self, req: msg.NetworkCheckResultRequest):
+        mgr = self.rdzv_managers[RendezvousName.NETWORK_CHECK]
+        mgr.report_result(req.node_id, req.normal, req.elapsed_time)
+        return None
+
+    def _query_network_check(self, req: msg.NetworkCheckQueryRequest):
+        mgr = self.rdzv_managers[RendezvousName.NETWORK_CHECK]
+        if req.kind == "straggler":
+            nodes, reason = mgr.get_stragglers()
+        else:
+            nodes, reason = mgr.check_fault_nodes()
+        return msg.NetworkCheckQueryResponse(nodes=nodes, reason=reason)
+
+    # -- kv store -----------------------------------------------------------
+
+    def _kv_get(self, req: msg.KVStoreGetRequest):
+        found = self.kv_store.has(req.key)
+        return msg.KVStoreGetResponse(
+            found=found, value=self.kv_store.get(req.key)
+        )
+
+    def _kv_set(self, req: msg.KVStoreSetRequest):
+        self.kv_store.set(req.key, req.value)
+        return None
+
+    def _kv_add(self, req: msg.KVStoreAddRequest):
+        return msg.KVStoreAddResponse(
+            value=self.kv_store.add(req.key, req.amount)
+        )
+
+    # -- data sharding ------------------------------------------------------
+
+    def _create_dataset(self, req: msg.DatasetShardParams):
+        shard_size = req.batch_size * req.num_minibatches_per_shard
+        self.task_manager.create_dataset(
+            dataset_name=req.dataset_name,
+            dataset_size=req.dataset_size,
+            shard_size=max(shard_size, 1),
+            num_epochs=req.num_epochs,
+            shuffle=req.shuffle,
+            storage_type=req.storage_type or "table",
+            task_type=req.task_type or "training",
+        )
+        return None
+
+    def _get_task(self, req: msg.TaskRequest):
+        task = self.task_manager.get_task(req.node_id, req.dataset_name)
+        shard = None
+        if task.shard is not None:
+            shard = msg.Shard(
+                name=task.shard.name,
+                start=task.shard.start,
+                end=task.shard.end,
+                record_indices=task.shard.record_indices or [],
+            )
+        return msg.Task(
+            task_id=task.task_id, task_type=task.task_type, shard=shard
+        )
+
+    def _report_task_result(self, req: msg.TaskResultRequest):
+        self.task_manager.report_task_result(
+            req.dataset_name, req.task_id, req.success
+        )
+        return None
+
+    def _get_shard_checkpoint(self, req: msg.ShardCheckpointRequest):
+        content = self.task_manager.get_shard_checkpoint(req.dataset_name)
+        return msg.ShardCheckpointResponse(content=content)
+
+    def _restore_shards(self, req: msg.RestoreShardRequest):
+        self.task_manager.restore_shard_checkpoint(
+            req.dataset_name, req.content
+        )
+        return None
+
+    # -- monitoring ---------------------------------------------------------
+
+    def _report_step(self, req: msg.StepReport):
+        ts = req.timestamp or time.time()
+        self.speed_monitor.collect_global_step(req.step, ts, req.tokens)
+        if req.node_id >= 0:
+            self.speed_monitor.collect_node_step(req.node_id, req.step)
+        return None
+
+    def _report_resource(self, req: msg.ResourceStats):
+        node = self.job_manager.get_node(req.node_id)
+        if node is not None:
+            node.config_resource.used_cpu = req.cpu_percent
+            node.config_resource.used_memory_mb = req.memory_mb
+            node.config_resource.hbm_used_gb = req.hbm_used_gb
+            node.config_resource.duty_cycle = req.duty_cycle
+        return None
+
+    def _report_failure(self, req: msg.NodeFailureReport):
+        node = self.job_manager.get_node(req.node_id)
+        rank = node.rank if node is not None else req.node_id
+        self.job_manager.handle_failure_report(
+            req.node_id, req.error_data, req.level, req.restart_count
+        )
+        self.task_manager.recover_node_tasks(req.node_id)
+        self.speed_monitor.remove_running_node(req.node_id)
+        for mgr in self.rdzv_managers.values():
+            mgr.remove_alive_node(req.node_id, node_rank=rank)
+        return None
+
+    def _heartbeat(self, req: msg.HeartbeatRequest):
+        self.job_manager.update_heartbeat(req.node_id)
+        action = self._pending_actions.pop(req.node_id, EventAction.NONE.value)
+        return msg.HeartbeatResponse(action=action)
+
+    def push_action(self, node_id: int, action: str) -> None:
+        self._pending_actions[node_id] = action
+
+    def _register_node(self, req: msg.NodeAddressRequest):
+        node = self.job_manager.register_node(
+            node_type=req.node_type or "worker",
+            node_id=req.node_id if req.node_id >= 0 else None,
+            addr=req.node_ip,
+        )
+        self.speed_monitor.add_running_node(node.id)
+        for mgr in self.rdzv_managers.values():
+            mgr.add_alive_node(node.id)
+        return None
+
+    def _get_job_nodes(self, req: msg.JobNodesRequest):
+        nodes = [
+            msg.NodeMeta(
+                node_type=n.type,
+                node_id=n.id,
+                rank=n.rank,
+                status=n.status,
+                addr=n.host_addr,
+                chips=n.config_resource.chips,
+            )
+            for n in self.job_manager.list_nodes(req.node_type)
+        ]
+        return msg.JobNodesResponse(nodes=nodes)
+
+    def _get_parallel_config(self, req: msg.ParallelConfigRequest):
+        # Filled in by the auto-tuner (master/auto_scaler); default empty.
+        return msg.ParallelConfig()

@@ -1,0 +1,278 @@
+"""Dynamic data sharding: the master-side task manager.
+
+Parity: dlrover/python/master/shard/task_manager.py:37 (TaskManager) and
+batch_dataset_manager.py. Shards flow todo -> doing -> done; a shard
+assigned to a worker that dies or times out goes back to todo, which is
+what gives exactly-once(-ish) data consumption under elasticity without
+any coordination in the training processes.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import json
+import threading
+import time
+from typing import Callable, Dict, List, Optional
+
+from dlrover_tpu.common.constants import TaskType
+from dlrover_tpu.common.log import get_logger
+from dlrover_tpu.master.dataset_splitter import (
+    DatasetSplitter,
+    Shard,
+    new_dataset_splitter,
+)
+
+logger = get_logger("task_manager")
+
+
+@dataclasses.dataclass
+class Task:
+    task_id: int
+    task_type: str
+    shard: Optional[Shard] = None
+
+    @classmethod
+    def wait_task(cls) -> "Task":
+        return cls(task_id=-1, task_type=TaskType.WAIT)
+
+
+@dataclasses.dataclass
+class DoingTask:
+    task: Task
+    node_id: int
+    start_time: float
+
+
+class DatasetManager:
+    """Todo/doing bookkeeping for one named dataset."""
+
+    def __init__(self, splitter: DatasetSplitter, task_type: str):
+        self.splitter = splitter
+        self.task_type = task_type
+        self.todo: List[Task] = []
+        self.doing: Dict[int, DoingTask] = {}
+        self._task_id = 0
+        self._completed_step = 0
+
+    def create_tasks(self) -> None:
+        if self.splitter.epoch_finished():
+            return
+        self.splitter.create_shards()
+        for shard in self.splitter.get_shards():
+            self.todo.append(
+                Task(
+                    task_id=self._task_id,
+                    task_type=self.task_type,
+                    shard=shard,
+                )
+            )
+            self._task_id += 1
+
+    def get_task(self, node_id: int) -> Task:
+        if not self.todo and not self.splitter.epoch_finished():
+            self.create_tasks()
+        if not self.todo:
+            if self.doing:
+                return Task.wait_task()  # epoch may still be recovered
+            return Task(task_id=-1, task_type=TaskType.NONE)
+        task = self.todo.pop(0)
+        self.doing[task.task_id] = DoingTask(task, node_id, time.time())
+        return task
+
+    def report_done(self, task_id: int, success: bool) -> Optional[Task]:
+        doing = self.doing.pop(task_id, None)
+        if doing is None:
+            return None
+        if not success:
+            self.todo.insert(0, doing.task)
+            return doing.task
+        return None
+
+    def recover_node_tasks(self, node_id: int) -> int:
+        """Requeue all shards a dead node was working on."""
+        recovered = 0
+        for task_id in list(self.doing):
+            if self.doing[task_id].node_id == node_id:
+                doing = self.doing.pop(task_id)
+                self.todo.insert(0, doing.task)
+                recovered += 1
+        return recovered
+
+    def reassign_timeout_tasks(self, timeout: float) -> int:
+        now = time.time()
+        n = 0
+        for task_id in list(self.doing):
+            doing = self.doing[task_id]
+            if now - doing.start_time > timeout:
+                self.doing.pop(task_id)
+                self.todo.insert(0, doing.task)
+                n += 1
+        if n:
+            logger.warning("reassigned %d timed-out shards", n)
+        return n
+
+    def finished(self) -> bool:
+        return (
+            self.splitter.epoch_finished()
+            and not self.todo
+            and not self.doing
+        )
+
+    def to_checkpoint(self) -> dict:
+        """Snapshot undone shards so a restarted job resumes data exactly."""
+        undone = [t for t in self.todo] + [
+            d.task for d in self.doing.values()
+        ]
+        return {
+            "splitter": self.splitter.to_checkpoint(),
+            "todo": [
+                {
+                    "task_id": t.task_id,
+                    "start": t.shard.start if t.shard else 0,
+                    "end": t.shard.end if t.shard else 0,
+                    "indices": t.shard.record_indices if t.shard else None,
+                }
+                for t in undone
+            ],
+            "next_task_id": self._task_id,
+        }
+
+    def restore_checkpoint(self, state: dict) -> None:
+        self.splitter.restore_checkpoint(state.get("splitter", {}))
+        self.todo = []
+        self.doing = {}
+        for t in state.get("todo", []):
+            shard = Shard(
+                name=self.splitter.dataset_name,
+                start=t["start"],
+                end=t["end"],
+                record_indices=t.get("indices"),
+            )
+            self.todo.append(
+                Task(
+                    task_id=t["task_id"],
+                    task_type=self.task_type,
+                    shard=shard,
+                )
+            )
+        self._task_id = state.get("next_task_id", len(self.todo))
+
+
+class TaskManager:
+    """All datasets of one job + the shard-timeout watchdog."""
+
+    def __init__(self, shard_timeout: float = 300.0):
+        self._lock = threading.Lock()
+        self._datasets: Dict[str, DatasetManager] = {}
+        self._completed_notified: set = set()
+        self.shard_timeout = shard_timeout
+        self._stop = threading.Event()
+        self._thread: Optional[threading.Thread] = None
+        # callback(dataset_name) fired when a dataset completes
+        self.on_dataset_complete: Optional[Callable[[str], None]] = None
+
+    def create_dataset(
+        self,
+        dataset_name: str,
+        dataset_size: int,
+        shard_size: int,
+        num_epochs: int = 1,
+        shuffle: bool = False,
+        storage_type: str = "table",
+        task_type: str = TaskType.TRAINING,
+    ) -> None:
+        with self._lock:
+            if dataset_name in self._datasets:
+                return
+            splitter = new_dataset_splitter(
+                storage_type,
+                dataset_name,
+                dataset_size,
+                shard_size,
+                num_epochs,
+                shuffle,
+            )
+            self._datasets[dataset_name] = DatasetManager(
+                splitter, task_type
+            )
+
+    def has_dataset(self, dataset_name: str) -> bool:
+        with self._lock:
+            return dataset_name in self._datasets
+
+    def get_task(self, node_id: int, dataset_name: str) -> Task:
+        completed = False
+        with self._lock:
+            ds = self._datasets.get(dataset_name)
+            if ds is None:
+                return Task.wait_task()
+            task = ds.get_task(node_id)
+            if (
+                task.task_type == TaskType.NONE
+                and ds.finished()
+                and dataset_name not in self._completed_notified
+            ):
+                self._completed_notified.add(dataset_name)
+                completed = True
+        # Fire the callback OUTSIDE the lock: it may re-enter TaskManager.
+        if completed and self.on_dataset_complete:
+            self.on_dataset_complete(dataset_name)
+        return task
+
+    def report_task_result(
+        self, dataset_name: str, task_id: int, success: bool
+    ) -> None:
+        with self._lock:
+            ds = self._datasets.get(dataset_name)
+            if ds is not None:
+                ds.report_done(task_id, success)
+
+    def recover_node_tasks(self, node_id: int) -> None:
+        with self._lock:
+            for ds in self._datasets.values():
+                ds.recover_node_tasks(node_id)
+
+    def finished(self) -> bool:
+        with self._lock:
+            if not self._datasets:
+                return False
+            return all(ds.finished() for ds in self._datasets.values())
+
+    # -- checkpoint ---------------------------------------------------------
+
+    def get_shard_checkpoint(self, dataset_name: str) -> str:
+        with self._lock:
+            ds = self._datasets.get(dataset_name)
+            if ds is None:
+                return ""
+            return json.dumps(ds.to_checkpoint())
+
+    def restore_shard_checkpoint(
+        self, dataset_name: str, content: str
+    ) -> bool:
+        with self._lock:
+            ds = self._datasets.get(dataset_name)
+            if ds is None or not content:
+                return False
+            ds.restore_checkpoint(json.loads(content))
+            return True
+
+    # -- watchdog -----------------------------------------------------------
+
+    def start(self) -> None:
+        if self._thread is not None:
+            return
+        self._thread = threading.Thread(
+            target=self._watch_loop, name="shard-watchdog", daemon=True
+        )
+        self._thread.start()
+
+    def _watch_loop(self) -> None:
+        while not self._stop.wait(15.0):
+            with self._lock:
+                for ds in self._datasets.values():
+                    ds.reassign_timeout_tasks(self.shard_timeout)
+
+    def stop(self) -> None:
+        self._stop.set()

@@ -1,0 +1,27 @@
+"""Test configuration: run JAX on a virtual 8-device CPU mesh.
+
+Must set the env vars before jax initializes its backends, so this
+executes at conftest import time (pytest loads conftest before test
+modules import jax).
+"""
+
+import os
+
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+_flags = os.environ.get("XLA_FLAGS", "")
+if "xla_force_host_platform_device_count" not in _flags:
+    os.environ["XLA_FLAGS"] = (
+        _flags + " --xla_force_host_platform_device_count=8"
+    ).strip()
+
+import pytest  # noqa: E402
+
+
+@pytest.fixture()
+def fresh_context():
+    """Reset the global Context singleton around a test."""
+    from dlrover_tpu.common.config import Context
+
+    Context.reset()
+    yield Context.singleton()
+    Context.reset()

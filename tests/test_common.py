@@ -1,0 +1,139 @@
+"""Tests for common primitives: node model, messages, RPC transport."""
+
+import threading
+
+import pytest
+
+from dlrover_tpu.common import messages
+from dlrover_tpu.common.comm import (
+    RpcClient,
+    RpcDispatcher,
+    RpcError,
+    RpcServer,
+)
+from dlrover_tpu.common.constants import NodeStatus, NodeType
+from dlrover_tpu.common.node import Node, NodeResource
+
+
+class TestNode:
+    def test_status_transitions(self):
+        node = Node(type=NodeType.WORKER, id=0)
+        assert node.status == NodeStatus.INITIAL
+        assert node.update_status(NodeStatus.PENDING)
+        assert node.update_status(NodeStatus.RUNNING)
+        assert node.start_time > 0
+        # Illegal: RUNNING -> PENDING
+        assert not node.update_status(NodeStatus.PENDING)
+        assert node.update_status(NodeStatus.FAILED)
+        assert node.finish_time > 0
+
+    def test_relaunch_policy(self):
+        node = Node(type=NodeType.WORKER, id=1, max_relaunch_count=2)
+        node.exit_reason = "oom"
+        assert node.should_relaunch()
+        node.inc_relaunch_count()
+        node.inc_relaunch_count()
+        assert not node.should_relaunch()
+        node2 = Node(type=NodeType.WORKER, id=2)
+        node2.exit_reason = "fatal_error"
+        assert not node2.should_relaunch()
+
+    def test_roundtrip_dict(self):
+        node = Node(
+            type=NodeType.WORKER,
+            id=3,
+            rank=1,
+            config_resource=NodeResource(cpu=4, chips=4, tpu_type="v5p"),
+        )
+        node2 = Node.from_dict(node.to_dict())
+        assert node2.id == 3
+        assert node2.config_resource.chips == 4
+
+
+class TestMessages:
+    def test_roundtrip_nested(self):
+        req = messages.Task(
+            task_id=7,
+            task_type="training",
+            shard=messages.Shard(name="ds", start=10, end=20),
+        )
+        out = messages.deserialize(messages.serialize(req))
+        assert isinstance(out, messages.Task)
+        assert out.shard.end == 20
+
+    def test_unknown_fields_dropped(self):
+        d = messages.encode_to_dict(messages.TaskRequest(node_id=1))
+        d["future_field"] = 123
+        out = messages.decode_from_dict(d)
+        assert out.node_id == 1
+
+    def test_dict_payload(self):
+        resp = messages.CommWorldResponse(round=2, world={0: 4, 1: 4})
+        out = messages.deserialize(messages.serialize(resp))
+        assert out.world == {0: 4, 1: 4}
+
+    def test_unknown_type_raises(self):
+        with pytest.raises(ValueError):
+            messages.decode_from_dict({"_t": "Nonexistent"})
+
+
+class TestRpc:
+    def test_get_report_roundtrip(self):
+        dispatcher = RpcDispatcher()
+        seen = []
+
+        def handle_task(req: messages.TaskRequest):
+            return messages.Task(task_id=42, task_type="training")
+
+        def handle_step(req: messages.StepReport):
+            seen.append(req.step)
+            return None
+
+        dispatcher.register_get(messages.TaskRequest, handle_task)
+        dispatcher.register_report(messages.StepReport, handle_step)
+        server = RpcServer(dispatcher, port=0)
+        server.start()
+        try:
+            client = RpcClient(f"127.0.0.1:{server.port}")
+            task = client.get(messages.TaskRequest(node_id=0))
+            assert task.task_id == 42
+            client.report(messages.StepReport(node_id=0, step=5))
+            assert seen == [5]
+            # Unhandled type surfaces as RpcError, not a crash.
+            with pytest.raises(RpcError):
+                client.get(messages.KVStoreGetRequest(key="x"))
+            client.close()
+        finally:
+            server.stop(0)
+
+    def test_concurrent_clients(self):
+        dispatcher = RpcDispatcher()
+        lock = threading.Lock()
+        counter = {"n": 0}
+
+        def handle_add(req: messages.KVStoreAddRequest):
+            with lock:
+                counter["n"] += req.amount
+                return messages.KVStoreAddResponse(value=counter["n"])
+
+        dispatcher.register_get(messages.KVStoreAddRequest, handle_add)
+        server = RpcServer(dispatcher, port=0)
+        server.start()
+        try:
+            client = RpcClient(f"127.0.0.1:{server.port}")
+            threads = [
+                threading.Thread(
+                    target=lambda: client.get(
+                        messages.KVStoreAddRequest(key="c", amount=1)
+                    )
+                )
+                for _ in range(8)
+            ]
+            for t in threads:
+                t.start()
+            for t in threads:
+                t.join()
+            assert counter["n"] == 8
+            client.close()
+        finally:
+            server.stop(0)

@@ -1,0 +1,286 @@
+"""Master tests: rendezvous, sharding, speed monitor, servicer over RPC.
+
+Follows the reference's test technique (SURVEY.md §4): a real in-process
+master + N simulated nodes calling the client API.
+"""
+
+import time
+
+import pytest
+
+from dlrover_tpu.common import messages as msg
+from dlrover_tpu.common.comm import RpcClient
+from dlrover_tpu.common.constants import RendezvousName, TaskType
+from dlrover_tpu.master.job_manager import JobManager
+from dlrover_tpu.master.master import JobMaster
+from dlrover_tpu.master.rendezvous import (
+    ElasticRendezvous,
+    NetworkCheckRendezvous,
+)
+from dlrover_tpu.master.task_manager import TaskManager
+from dlrover_tpu.master.speed_monitor import SpeedMonitor
+
+
+class TestElasticRendezvous:
+    def test_completes_at_max_nodes(self):
+        rdzv = ElasticRendezvous()
+        rdzv.update_params(min_nodes=2, max_nodes=4, waiting_timeout=30)
+        for rank in range(4):
+            assert rdzv.join(rank, 8) == 0
+        round_, group, world = rdzv.get_comm_world(0)
+        assert round_ == 1
+        assert world == {0: 8, 1: 8, 2: 8, 3: 8}
+
+    def test_completes_after_timeout_with_min_nodes(self):
+        rdzv = ElasticRendezvous()
+        rdzv.update_params(min_nodes=2, max_nodes=4, waiting_timeout=0.2)
+        rdzv.join(0, 8)
+        rdzv.join(1, 8)
+        _, _, world = rdzv.get_comm_world(0)
+        assert world == {}  # not yet: timeout hasn't elapsed
+        time.sleep(0.3)
+        _, _, world = rdzv.get_comm_world(0)
+        assert world == {0: 8, 1: 8}
+
+    def test_node_unit_rounding(self):
+        rdzv = ElasticRendezvous()
+        rdzv.update_params(
+            min_nodes=2, max_nodes=8, waiting_timeout=0.1, node_unit=2
+        )
+        for rank in range(3):
+            rdzv.join(rank, 4)
+        time.sleep(0.2)
+        _, _, world = rdzv.get_comm_world(0)
+        # 3 nodes rounded down to 2 (one full node_unit)
+        assert world == {0: 4, 1: 4}
+
+    def test_restart_triggers_waiting_signal(self):
+        rdzv = ElasticRendezvous()
+        rdzv.update_params(min_nodes=2, max_nodes=2, waiting_timeout=10)
+        rdzv.join(0, 8)
+        rdzv.join(1, 8)
+        rdzv.get_comm_world(0)
+        assert rdzv.num_nodes_waiting() == 0
+        # A previous member re-joins (process restart) -> immediate signal.
+        rdzv.join(0, 8)
+        assert rdzv.num_nodes_waiting() == 1
+
+
+class TestNetworkCheckRendezvous:
+    def _run_round(self, rdzv, statuses, times):
+        """Simulate all nodes joining, getting groups, and reporting."""
+        for rank in statuses:
+            rdzv.join(rank, 8)
+        groups = {}
+        for rank in statuses:
+            _, group, world = rdzv.get_comm_world(rank)
+            groups[rank] = world
+        for rank in statuses:
+            rdzv.report_result(rank, statuses[rank], times[rank])
+        return groups
+
+    def test_pairwise_grouping(self):
+        rdzv = NetworkCheckRendezvous()
+        rdzv.update_params(min_nodes=4, max_nodes=4, waiting_timeout=1)
+        statuses = {0: True, 1: True, 2: True, 3: True}
+        times = {0: 1.0, 1: 1.1, 2: 0.9, 3: 1.0}
+        groups = self._run_round(rdzv, statuses, times)
+        assert groups[0] == {0: 8, 1: 8}
+        assert groups[2] == {2: 8, 3: 8}
+        faults, reason = rdzv.check_fault_nodes()
+        assert faults == []
+
+    def test_fault_localization_two_rounds(self):
+        rdzv = NetworkCheckRendezvous()
+        rdzv.update_params(min_nodes=4, max_nodes=4, waiting_timeout=1)
+        # Round 0: node 1 is broken, so pair (0,1) both fail.
+        statuses = {0: False, 1: False, 2: True, 3: True}
+        times = {0: 10.0, 1: 10.0, 2: 1.0, 3: 1.0}
+        self._run_round(rdzv, statuses, times)
+        faults, reason = rdzv.check_fault_nodes()
+        assert set(faults) == {0, 1}
+        # Round 1: re-paired with good partners, node 0 passes, 1 fails.
+        statuses = {0: True, 1: False, 2: True, 3: False}
+        times = {0: 1.0, 1: 10.0, 2: 1.0, 3: 10.0}
+        self._run_round(rdzv, statuses, times)
+        faults, reason = rdzv.check_fault_nodes()
+        assert faults == [1]
+
+    def test_straggler_detection(self):
+        rdzv = NetworkCheckRendezvous()
+        rdzv.update_params(min_nodes=4, max_nodes=4, waiting_timeout=1)
+        statuses = {0: True, 1: True, 2: True, 3: True}
+        times = {0: 1.0, 1: 1.0, 2: 1.0, 3: 5.0}
+        self._run_round(rdzv, statuses, times)
+        stragglers, _ = rdzv.get_stragglers()
+        assert stragglers == [3]
+
+
+class TestTaskManager:
+    def test_shard_lifecycle(self):
+        tm = TaskManager()
+        tm.create_dataset("ds", dataset_size=100, shard_size=10)
+        tasks = []
+        while True:
+            task = tm.get_task(0, "ds")
+            if task.task_type != TaskType.TRAINING:
+                break
+            tasks.append(task)
+            tm.report_task_result("ds", task.task_id, True)
+        assert len(tasks) == 10
+        assert tasks[0].shard.start == 0 and tasks[0].shard.end == 10
+        assert tm.finished()
+
+    def test_dead_node_tasks_requeued(self):
+        tm = TaskManager()
+        tm.create_dataset("ds", dataset_size=40, shard_size=10)
+        t1 = tm.get_task(0, "ds")
+        t2 = tm.get_task(1, "ds")
+        tm.recover_node_tasks(0)  # node 0 dies holding t1
+        remaining = []
+        while True:
+            t = tm.get_task(1, "ds")
+            if t.task_type != TaskType.TRAINING:
+                break
+            remaining.append(t)
+            tm.report_task_result("ds", t.task_id, True)
+        tm.report_task_result("ds", t2.task_id, True)
+        # t1's shard was reassigned: all 4 shards processed
+        starts = sorted([t1.shard.start] + [t.shard.start for t in remaining])
+        assert starts == [0, 0, 20, 30]  # shard 0 appears twice (requeued)
+
+    def test_checkpoint_restore(self):
+        tm = TaskManager()
+        tm.create_dataset("ds", dataset_size=40, shard_size=10)
+        t = tm.get_task(0, "ds")
+        ckpt = tm.get_shard_checkpoint("ds")
+        assert ckpt
+        tm2 = TaskManager()
+        tm2.create_dataset("ds", dataset_size=40, shard_size=10)
+        assert tm2.restore_shard_checkpoint("ds", ckpt)
+        # All 4 shards (incl. the in-flight one) are in todo again.
+        seen = 0
+        while True:
+            task = tm2.get_task(0, "ds")
+            if task.task_type != TaskType.TRAINING:
+                break
+            seen += 1
+            tm2.report_task_result("ds", task.task_id, True)
+        assert seen == 4
+
+    def test_multi_epoch(self):
+        tm = TaskManager()
+        tm.create_dataset("ds", dataset_size=20, shard_size=10, num_epochs=2)
+        count = 0
+        while True:
+            task = tm.get_task(0, "ds")
+            if task.task_type != TaskType.TRAINING:
+                break
+            count += 1
+            tm.report_task_result("ds", task.task_id, True)
+        assert count == 4  # 2 shards x 2 epochs
+
+
+class TestSpeedMonitor:
+    def test_throughput_and_recovery(self):
+        sm = SpeedMonitor(window=10)
+        sm.add_running_node(0)
+        sm.add_running_node(1)
+        t = 1000.0
+        for step in range(5):
+            sm.collect_global_step(step, t + step, tokens=100)
+        assert sm.running_speed() == pytest.approx(1.0)
+        assert sm.token_throughput() == pytest.approx(100.0)
+        sm.remove_running_node(1)  # failure event
+        assert sm.recovery_seconds() is not None  # already >= 90%
+
+
+class TestJobManager:
+    def test_relaunch_on_failure(self):
+        jm = JobManager(max_relaunch=2)
+        node = jm.register_node(node_id=0)
+        assert jm.handle_failure_report(0, "oom killed", "process_error", 0)
+        assert node.exit_reason == "oom"
+        assert len(jm._scaler.executed_plans) == 1
+
+    def test_fatal_error_no_relaunch(self):
+        jm = JobManager()
+        jm.register_node(node_id=0)
+        node = jm.get_node(0)
+        node.exit_reason = "fatal_error"
+        node.relaunchable = False
+        assert not jm.handle_failure_report(0, "x", "rdzv_error", 0)
+
+
+class TestMasterEndToEnd:
+    """Real master over gRPC, simulated agents (ref test technique)."""
+
+    @pytest.fixture()
+    def master(self):
+        m = JobMaster(port=0, node_num=2, rdzv_timeout=1.0)
+        m.prepare()
+        yield m
+        m.stop()
+
+    def test_full_flow(self, master):
+        client0 = RpcClient(master.addr)
+        client1 = RpcClient(master.addr)
+        # register both nodes
+        client0.report(msg.NodeAddressRequest(node_id=0, node_ip="h0"))
+        client1.report(msg.NodeAddressRequest(node_id=1, node_ip="h1"))
+        # rendezvous
+        for nid, c in ((0, client0), (1, client1)):
+            resp = c.get(
+                msg.JoinRendezvousRequest(
+                    node_id=nid,
+                    node_rank=nid,
+                    local_world_size=4,
+                    rdzv_name=RendezvousName.TRAINING,
+                )
+            )
+            assert resp.round == 0
+        world = client0.get(
+            msg.CommWorldRequest(
+                node_id=0, rdzv_name=RendezvousName.TRAINING
+            )
+        )
+        assert world.world == {0: 4, 1: 4}
+        # kv store bootstrap
+        client0.report(
+            msg.KVStoreSetRequest(key="coordinator", value=b"h0:9999")
+        )
+        got = client1.get(msg.KVStoreGetRequest(key="coordinator"))
+        assert got.value == b"h0:9999"
+        # dataset + tasks
+        client0.report(
+            msg.DatasetShardParams(
+                batch_size=4,
+                num_minibatches_per_shard=2,
+                dataset_size=32,
+                dataset_name="train",
+                task_type=TaskType.TRAINING,
+            )
+        )
+        task = client0.get(msg.TaskRequest(node_id=0, dataset_name="train"))
+        assert task.task_type == TaskType.TRAINING
+        assert task.shard.end - task.shard.start == 8
+        client0.report(
+            msg.TaskResultRequest(
+                node_id=0, dataset_name="train", task_id=task.task_id
+            )
+        )
+        # step + heartbeat
+        client0.report(msg.StepReport(node_id=0, step=1, tokens=512))
+        hb = client0.report(msg.HeartbeatRequest(node_id=0))
+        assert hb.action == "none"
+        # failure report relaunches + requeues
+        client1.report(
+            msg.NodeFailureReport(
+                node_id=1, error_data="oom", level="process_error"
+            )
+        )
+        nodes = client0.get(msg.JobNodesRequest())
+        statuses = {n.node_id: n.status for n in nodes.nodes}
+        assert statuses[1] == "failed"
+        client0.close()
+        client1.close()

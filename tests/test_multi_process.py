@@ -1,0 +1,128 @@
+"""Tests for cross-process IPC primitives (single-process + subprocess)."""
+
+import multiprocessing as mp
+import queue
+
+import pytest
+
+from dlrover_tpu.common.multi_process import (
+    SharedDict,
+    SharedLock,
+    SharedMemoryHandle,
+    SharedQueue,
+)
+
+
+class TestSharedLock:
+    def test_acquire_release(self):
+        lock = SharedLock("t1", server=True)
+        try:
+            assert lock.acquire()
+            assert lock.locked()
+            client = SharedLock("t1")
+            assert not client.acquire(blocking=False)
+            lock.release()
+            assert client.acquire(blocking=False)
+            client.release()
+            client.close()
+        finally:
+            lock.close()
+
+    def test_context_manager(self):
+        lock = SharedLock("t2", server=True)
+        try:
+            with lock:
+                assert lock.locked()
+            assert not lock.locked()
+        finally:
+            lock.close()
+
+
+class TestSharedQueue:
+    def test_fifo(self):
+        q = SharedQueue("q1", server=True)
+        try:
+            q.put({"step": 1})
+            q.put({"step": 2})
+            assert q.qsize() == 2
+            assert q.get()["step"] == 1
+            assert q.get()["step"] == 2
+            assert q.empty()
+            with pytest.raises(queue.Empty):
+                q.get(block=False)
+        finally:
+            q.close()
+
+    def test_cross_client(self):
+        q = SharedQueue("q2", server=True)
+        client = SharedQueue("q2")
+        try:
+            client.put([1, 2, 3])
+            assert q.get() == [1, 2, 3]
+        finally:
+            client.close()
+            q.close()
+
+
+class TestSharedDict:
+    def test_ops(self):
+        d = SharedDict("d1", server=True)
+        client = SharedDict("d1")
+        try:
+            client.set("a", 1)
+            d.update({"b": [2], "c": "x"})
+            assert d.get("a") == 1
+            assert client.get("b") == [2]
+            assert client.get("missing", 9) == 9
+            assert set(d.all()) == {"a", "b", "c"}
+            assert d.pop("a") == 1
+            assert d.get("a") is None
+        finally:
+            client.close()
+            d.close()
+
+
+def _subprocess_writer(qname):
+    q = SharedQueue(qname)
+    q.put({"from": "child"})
+    q.close()
+
+
+class TestCrossProcess:
+    def test_queue_across_processes(self):
+        q = SharedQueue("qx", server=True)
+        try:
+            ctx = mp.get_context("spawn")
+            p = ctx.Process(target=_subprocess_writer, args=("qx",))
+            p.start()
+            item = q.get(timeout=30)
+            p.join(timeout=30)
+            assert item == {"from": "child"}
+        finally:
+            q.close()
+
+
+class TestSharedMemory:
+    def test_create_attach_unlink(self):
+        shm = SharedMemoryHandle("seg1", create=True, size=1024)
+        try:
+            shm.buf[:4] = b"abcd"
+            reader = SharedMemoryHandle("seg1")
+            assert bytes(reader.buf[:4]) == b"abcd"
+            reader.close()
+            # Re-create with smaller size re-attaches the same segment.
+            again = SharedMemoryHandle("seg1", create=True, size=512)
+            assert bytes(again.buf[:4]) == b"abcd"
+            again.close()
+        finally:
+            shm.unlink()
+            shm.close()
+
+    def test_exists(self):
+        assert not SharedMemoryHandle.exists("nope")
+        shm = SharedMemoryHandle("seg2", create=True, size=64)
+        try:
+            assert SharedMemoryHandle.exists("seg2")
+        finally:
+            shm.unlink()
+            shm.close()

@@ -1,0 +1,182 @@
+"""Regression tests for review findings on the control-plane core."""
+
+import time
+
+from dlrover_tpu.common import messages as msg
+from dlrover_tpu.common.comm import RpcClient
+from dlrover_tpu.master.job_manager import JobManager
+from dlrover_tpu.master.master import JobMaster
+from dlrover_tpu.master.rendezvous import ElasticRendezvous
+
+
+def test_reregistration_after_failure_revives_node():
+    jm = JobManager(max_relaunch=3)
+    jm.register_node(node_id=0)
+    jm.handle_failure_report(0, "oom", "process_error", 0)
+    assert jm.get_node(0).status == "failed"
+    # Relaunched agent re-registers under the same id.
+    node = jm.register_node(node_id=0, addr="h0-new")
+    assert node.status == "running"
+    assert node.relaunch_count == 1  # budget carried over
+    assert not jm.all_workers_done()
+
+
+def test_new_joiner_does_not_wipe_frozen_world():
+    rdzv = ElasticRendezvous()
+    rdzv.update_params(min_nodes=2, max_nodes=2, waiting_timeout=10)
+    rdzv.join(0, 8)
+    rdzv.join(1, 8)
+    _, _, world0 = rdzv.get_comm_world(0)
+    assert world0 == {0: 8, 1: 8}
+    # A scale-up node joins before node 1 fetched the world.
+    rdzv.join(2, 8)
+    _, _, world1 = rdzv.get_comm_world(1)
+    assert world1 == {0: 8, 1: 8}  # unchanged
+    # But a returning member invalidates it.
+    rdzv.join(0, 8)
+    _, _, world2 = rdzv.get_comm_world(1)
+    assert 2 in rdzv._waiting_nodes or world2 != {0: 8, 1: 8}
+
+
+def test_node_unit_rounding_respects_min_nodes():
+    rdzv = ElasticRendezvous()
+    rdzv.update_params(
+        min_nodes=3, max_nodes=4, waiting_timeout=0.1, node_unit=2
+    )
+    for rank in range(3):
+        rdzv.join(rank, 4)
+    time.sleep(0.2)
+    _, _, world = rdzv.get_comm_world(0)
+    # 3 rounds down to 2 < min_nodes=3: must NOT complete.
+    assert world == {}
+
+
+def test_network_check_verdict_over_rpc():
+    m = JobMaster(port=0, node_num=2, rdzv_timeout=0.5)
+    m.prepare()
+    try:
+        c = RpcClient(m.addr)
+        for rank in range(2):
+            c.get(
+                msg.JoinRendezvousRequest(
+                    node_id=rank,
+                    node_rank=rank,
+                    local_world_size=4,
+                    rdzv_name="network-check",
+                )
+            )
+        for rank in range(2):
+            c.get(
+                msg.CommWorldRequest(node_id=rank, rdzv_name="network-check")
+            )
+        c.report(
+            msg.NetworkCheckResultRequest(
+                node_id=0, normal=True, elapsed_time=1.0
+            )
+        )
+        # Not all reported yet -> waiting
+        q = c.get(msg.NetworkCheckQueryRequest(kind="fault"))
+        assert q.reason == "waiting"
+        c.report(
+            msg.NetworkCheckResultRequest(
+                node_id=1, normal=False, elapsed_time=9.0
+            )
+        )
+        q = c.get(msg.NetworkCheckQueryRequest(kind="fault"))
+        assert q.nodes == [1] and q.reason == "fault"
+        # With only 2 nodes the 2x-median rule can never fire (t1 > t0+t1
+        # is impossible) — straggler detection needs >= 3 nodes.
+        q = c.get(msg.NetworkCheckQueryRequest(kind="straggler"))
+        assert q.nodes == []
+        c.close()
+    finally:
+        m.stop()
+
+
+def test_kv_empty_value_found():
+    m = JobMaster(port=0, node_num=1)
+    m.prepare()
+    try:
+        c = RpcClient(m.addr)
+        assert not c.get(msg.KVStoreGetRequest(key="flag")).found
+        c.report(msg.KVStoreSetRequest(key="flag", value=b""))
+        assert c.get(msg.KVStoreGetRequest(key="flag")).found
+        c.close()
+    finally:
+        m.stop()
+
+
+def test_huge_dataset_not_truncated():
+    from dlrover_tpu.master.dataset_splitter import TableDatasetSplitter
+
+    sp = TableDatasetSplitter("big", dataset_size=100, shard_size=10,
+                              num_epochs=1, max_shard_count=4)
+    covered = []
+    while not sp.epoch_finished():
+        sp.create_shards()
+        covered.extend((s.start, s.end) for s in sp.get_shards())
+    # All 10 shards produced across sub-epoch windows, none dropped.
+    assert len(covered) == 10
+    assert covered[0] == (0, 10) and covered[-1] == (90, 100)
+    assert sp.epoch == 1
+
+
+def test_lock_released_when_holder_process_dies():
+    import multiprocessing as mp
+    import time as _t
+    from dlrover_tpu.common.multi_process import SharedLock
+
+    lock = SharedLock("crash", server=True)
+    try:
+        ctx = mp.get_context("spawn")
+        p = ctx.Process(target=_hold_lock_forever, args=("crash",))
+        p.start()
+        deadline = _t.time() + 30
+        while not lock.locked() and _t.time() < deadline:
+            _t.sleep(0.05)
+        assert lock.locked()
+        p.kill()  # holder dies without releasing
+        p.join(timeout=30)
+        deadline = _t.time() + 10
+        acquired = False
+        while _t.time() < deadline:
+            if lock.acquire(blocking=False):
+                acquired = True
+                break
+            _t.sleep(0.1)
+        assert acquired, "lock not force-released after holder death"
+        lock.release()
+    finally:
+        lock.close()
+
+
+def _hold_lock_forever(name):
+    import time as _t
+    from dlrover_tpu.common.multi_process import SharedLock
+
+    lock = SharedLock(name)
+    lock.acquire()
+    _t.sleep(300)
+
+
+def test_queue_blocking_get_does_not_block_put_other_thread():
+    import threading as th
+    from dlrover_tpu.common.multi_process import SharedQueue
+
+    q = SharedQueue("tdq", server=True)
+    try:
+        result = {}
+
+        def getter():
+            result["item"] = q.get(timeout=10)
+
+        t = th.Thread(target=getter)
+        t.start()
+        import time as _t
+
+        _t.sleep(0.3)  # getter is now blocked in its poll loop
+        q.put("hello")  # same SharedQueue object, different thread
+        t.join(timeout=10)
+        assert result.get("item") == "hello"
+    finally:
+        q.close()
