@@ -1,0 +1,338 @@
+"""Per-host elastic agent: supervises the training process.
+
+Parity: dlrover/python/elastic_agent/torch/training.py (
+MasterRendezvousHandler :132, ElasticTrainingAgent :313, launch_agent
+:642), redesigned for the JAX process model: ONE training process per
+host owns all local TPU chips (instead of torchelastic's
+one-process-per-GPU), and world bootstrap hands the process
+``jax.distributed.initialize`` coordinates (coordinator addr, process
+id, process count) via env vars instead of a c10d TCPStore.
+
+Restart semantics are the reference's: on membership change or process
+failure the agent kills and respawns the *training process* while the
+agent itself stays up, which is exactly the teardown/re-init JAX needs
+since its distributed world is static per initialization.
+"""
+
+from __future__ import annotations
+
+import os
+import signal
+import subprocess
+import sys
+import threading
+import time
+from dataclasses import dataclass, field
+from typing import Dict, List, Optional
+
+from dlrover_tpu.agent.master_client import MasterClient
+from dlrover_tpu.common.comm import find_free_port
+from dlrover_tpu.common.constants import (
+    NodeEnv,
+    RendezvousName,
+    TrainingExceptionLevel,
+)
+from dlrover_tpu.common.log import get_logger
+
+logger = get_logger("agent")
+
+
+class RendezvousTimeoutError(RuntimeError):
+    pass
+
+
+class MasterRendezvousHandler:
+    """Agent-side rendezvous: join, poll for the frozen world, compute
+    this node's rank and the JAX bootstrap coordinates."""
+
+    def __init__(
+        self,
+        client: MasterClient,
+        local_world_size: int,
+        rdzv_name: str = RendezvousName.TRAINING,
+        timeout: float = 600.0,
+        poll_interval: float = 0.3,
+    ):
+        self.client = client
+        self.local_world_size = local_world_size
+        self.rdzv_name = rdzv_name
+        self.timeout = timeout
+        self.poll_interval = poll_interval
+
+    def next_rendezvous(self) -> "WorldSpec":
+        round_ = self.client.join_rendezvous(
+            self.local_world_size, rdzv_name=self.rdzv_name
+        )
+        deadline = time.time() + self.timeout
+        while time.time() < deadline:
+            rdzv_round, group, world = self.client.get_comm_world(
+                rdzv_name=self.rdzv_name
+            )
+            if world and self.client.node_rank in world:
+                return self._build_spec(rdzv_round, group, world)
+            if world and self.client.node_rank not in world:
+                # Frozen without us (e.g. node_unit rounding): rejoin.
+                round_ = self.client.join_rendezvous(
+                    self.local_world_size, rdzv_name=self.rdzv_name
+                )
+            time.sleep(self.poll_interval)
+        raise RendezvousTimeoutError(
+            f"{self.rdzv_name} rendezvous not completed in {self.timeout}s "
+            f"(joined round {round_})"
+        )
+
+    def _build_spec(
+        self, rdzv_round: int, group: int, world: Dict[int, int]
+    ) -> "WorldSpec":
+        ranks = sorted(world.keys())
+        my_rank = ranks.index(self.client.node_rank)
+        # Process ids: one training process per node; process_id equals
+        # the node's position; chips-per-host is the local world size.
+        spec = WorldSpec(
+            round=rdzv_round,
+            group=group,
+            world=world,
+            node_world_size=len(ranks),
+            node_rank=my_rank,
+            process_id=my_rank,
+            num_processes=len(ranks),
+        )
+        # Rank-0 of the world publishes the coordinator endpoint.
+        kv_key = f"coordinator/{self.rdzv_name}/{rdzv_round}/{group}"
+        if my_rank == 0:
+            host = os.getenv("DLROVER_TPU_HOST_IP", "127.0.0.1")
+            port = find_free_port()
+            spec.coordinator = f"{host}:{port}"
+            self.client.kv_set(kv_key, spec.coordinator.encode())
+        else:
+            spec.coordinator = self.client.kv_wait(
+                kv_key, timeout=self.timeout
+            ).decode()
+        return spec
+
+
+@dataclass
+class WorldSpec:
+    round: int
+    group: int
+    world: Dict[int, int]
+    node_world_size: int
+    node_rank: int
+    process_id: int
+    num_processes: int
+    coordinator: str = ""
+
+
+@dataclass
+class AgentConfig:
+    node_id: int = 0
+    node_rank: int = -1
+    local_world_size: int = 1
+    max_restarts: int = 3
+    monitor_interval: float = 2.0
+    rdzv_timeout: float = 600.0
+    network_check: bool = False
+    heartbeat_interval: float = 15.0
+    env: Dict[str, str] = field(default_factory=dict)
+
+
+class ElasticAgent:
+    """Supervises one training process through restarts and membership
+    changes."""
+
+    def __init__(
+        self,
+        config: AgentConfig,
+        entry_cmd: List[str],
+        client: Optional[MasterClient] = None,
+    ):
+        self.config = config
+        self.entry_cmd = entry_cmd
+        self.client = client or MasterClient.singleton()
+        self._rdzv = MasterRendezvousHandler(
+            self.client,
+            config.local_world_size,
+            timeout=config.rdzv_timeout,
+        )
+        self._proc: Optional[subprocess.Popen] = None
+        self._restart_count = 0
+        self._stop = threading.Event()
+        self._spec: Optional[WorldSpec] = None
+        # Set by the heartbeat thread; acted on ONLY by the monitor
+        # loop so process lifecycle has a single owner (no concurrent
+        # kill/spawn races).
+        self._restart_requested = threading.Event()
+
+    # -- process management -------------------------------------------------
+
+    def _spawn(self, spec: WorldSpec) -> None:
+        env = dict(os.environ)
+        env.update(self.config.env)
+        env.update(
+            {
+                NodeEnv.NODE_ID: str(self.config.node_id),
+                NodeEnv.NODE_RANK: str(spec.node_rank),
+                NodeEnv.NODE_NUM: str(spec.node_world_size),
+                NodeEnv.LOCAL_WORLD_SIZE: str(
+                    self.config.local_world_size
+                ),
+                NodeEnv.COORDINATOR_ADDR: spec.coordinator,
+                NodeEnv.PROCESS_ID: str(spec.process_id),
+                NodeEnv.NUM_PROCESSES: str(spec.num_processes),
+                NodeEnv.RESTART_COUNT: str(self._restart_count),
+                NodeEnv.MASTER_ADDR: self.client._client.addr,
+            }
+        )
+        logger.info(
+            "spawning training process (round=%d rank=%d/%d restart=%d): %s",
+            spec.round,
+            spec.node_rank,
+            spec.node_world_size,
+            self._restart_count,
+            " ".join(self.entry_cmd),
+        )
+        self._proc = subprocess.Popen(self.entry_cmd, env=env)
+
+    def _kill_proc(self, grace: float = 10.0) -> None:
+        if self._proc is None or self._proc.poll() is not None:
+            return
+        self._proc.send_signal(signal.SIGTERM)
+        deadline = time.time() + grace
+        while time.time() < deadline:
+            if self._proc.poll() is not None:
+                return
+            time.sleep(0.2)
+        self._proc.kill()
+        self._proc.wait()
+
+    # -- health check -------------------------------------------------------
+
+    def run_network_check(self) -> bool:
+        """Run the psum/matmul benchmark payload in a throwaway process
+        group and report the result (ref: NetworkCheckElasticAgent)."""
+        handler = MasterRendezvousHandler(
+            self.client,
+            self.config.local_world_size,
+            rdzv_name=RendezvousName.NETWORK_CHECK,
+            timeout=self.config.rdzv_timeout,
+        )
+        for _ in range(2):  # two grouping rounds localize the fault
+            spec = handler.next_rendezvous()
+            start = time.time()
+            result = subprocess.run(
+                [
+                    sys.executable,
+                    "-m",
+                    "dlrover_tpu.trainer.network_check",
+                ],
+                env={
+                    **os.environ,
+                    NodeEnv.COORDINATOR_ADDR: spec.coordinator,
+                    NodeEnv.PROCESS_ID: str(spec.process_id),
+                    NodeEnv.NUM_PROCESSES: str(spec.num_processes),
+                },
+                timeout=300,
+                check=False,
+            )
+            elapsed = time.time() - start
+            normal = result.returncode == 0
+            self.client.report_network_check(normal, elapsed)
+        deadline = time.time() + self.config.rdzv_timeout
+        faults, reason = self.client.query_fault_nodes()
+        while reason == "waiting":
+            if time.time() > deadline:
+                logger.error(
+                    "network-check verdict not available within %ss "
+                    "(peers never reported); treating as failure",
+                    self.config.rdzv_timeout,
+                )
+                return False
+            time.sleep(1.0)
+            faults, reason = self.client.query_fault_nodes()
+        if self.client.node_rank in faults:
+            logger.error("this node FAILED the network check")
+            return False
+        return True
+
+    # -- main loop ----------------------------------------------------------
+
+    def run(self) -> int:
+        self.client.register_node(node_type="worker")
+        if self.config.network_check and not self.run_network_check():
+            self.client.report_failure(
+                "network check failed",
+                TrainingExceptionLevel.NODE_ERROR,
+            )
+            return 1
+        heartbeat = threading.Thread(
+            target=self._heartbeat_loop, daemon=True
+        )
+        heartbeat.start()
+        result = self._invoke_run()
+        self._stop.set()
+        return result
+
+    def _invoke_run(self) -> int:
+        self._spec = self._rdzv.next_rendezvous()
+        self._spawn(self._spec)
+        while not self._stop.is_set():
+            time.sleep(self.config.monitor_interval)
+            code = self._proc.poll() if self._proc else None
+            if code is not None:
+                if code == 0:
+                    logger.info("training process finished successfully")
+                    return 0
+                if not self._handle_failure(code):
+                    return code
+                continue
+            if self._restart_requested.is_set():
+                self._restart_requested.clear()
+                logger.info("master requested restart")
+                self._restart_workers()
+            elif self._membership_changed():
+                logger.info(
+                    "membership changed; restarting training process "
+                    "for re-rendezvous"
+                )
+                self._restart_workers()
+        self._kill_proc()
+        return 0
+
+    def _handle_failure(self, exitcode: int) -> bool:
+        """Report and decide restart. True = keep running."""
+        self.client.report_failure(
+            f"training process exit code {exitcode}",
+            TrainingExceptionLevel.PROCESS_ERROR,
+            restart_count=self._restart_count,
+        )
+        if self._restart_count >= self.config.max_restarts:
+            logger.error(
+                "exhausted %d restarts; giving up", self.config.max_restarts
+            )
+            return False
+        self._restart_count += 1
+        self._restart_workers()
+        return True
+
+    def _restart_workers(self) -> None:
+        self._kill_proc()
+        self._spec = self._rdzv.next_rendezvous()
+        self._spawn(self._spec)
+
+    def _membership_changed(self) -> bool:
+        return self.client.num_nodes_waiting() > 0
+
+    def _heartbeat_loop(self) -> None:
+        while not self._stop.wait(self.config.heartbeat_interval):
+            try:
+                action = self.client.heartbeat()
+                if action == "restart_training":
+                    self._restart_requested.set()
+                elif action == "stop_training":
+                    self._stop.set()
+            except Exception:  # noqa: BLE001
+                logger.warning("heartbeat failed", exc_info=True)
+
+    def stop(self) -> None:
+        self._stop.set()
+        self._kill_proc()

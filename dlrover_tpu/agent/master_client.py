@@ -1,0 +1,285 @@
+"""Typed client to the job master, used by agents and trainers.
+
+Parity: dlrover/python/elastic_agent/master_client.py:49 (MasterClient
+with the retry decorator at :26), re-typed onto the msgpack schema.
+"""
+
+from __future__ import annotations
+
+import functools
+import os
+import time
+from typing import Dict, List, Optional, Tuple
+
+from dlrover_tpu.common import messages as msg
+from dlrover_tpu.common.comm import RpcClient
+from dlrover_tpu.common.constants import NodeEnv, RendezvousName
+from dlrover_tpu.common.log import get_logger
+
+logger = get_logger("master_client")
+
+
+def retry(times: int = 3, interval: float = 1.0):
+    def decorator(fn):
+        @functools.wraps(fn)
+        def wrapped(*args, **kwargs):
+            last_exc: Optional[Exception] = None
+            for attempt in range(times):
+                try:
+                    return fn(*args, **kwargs)
+                except Exception as e:  # noqa: BLE001
+                    last_exc = e
+                    logger.warning(
+                        "%s failed (attempt %d/%d): %s",
+                        fn.__name__,
+                        attempt + 1,
+                        times,
+                        e,
+                    )
+                    time.sleep(interval * (attempt + 1))
+            raise last_exc  # type: ignore[misc]
+
+        return wrapped
+
+    return decorator
+
+
+class MasterClient:
+    """One instance per process; safe to share across threads."""
+
+    _singleton: Optional["MasterClient"] = None
+
+    def __init__(self, addr: str, node_id: int = 0, node_rank: int = -1):
+        self._client = RpcClient(addr)
+        self.node_id = node_id
+        self.node_rank = node_rank if node_rank >= 0 else node_id
+
+    @classmethod
+    def singleton(cls) -> "MasterClient":
+        if cls._singleton is None:
+            addr = os.getenv(NodeEnv.MASTER_ADDR, "")
+            if not addr:
+                raise RuntimeError(
+                    f"{NodeEnv.MASTER_ADDR} not set; is this process "
+                    "running under dlrover-tpu-run?"
+                )
+            node_id = int(os.getenv(NodeEnv.NODE_ID, "0"))
+            node_rank = int(os.getenv(NodeEnv.NODE_RANK, "-1"))
+            cls._singleton = cls(addr, node_id, node_rank)
+        return cls._singleton
+
+    @classmethod
+    def reset(cls) -> None:
+        cls._singleton = None
+
+    # -- node lifecycle -----------------------------------------------------
+
+    @retry()
+    def register_node(self, node_type: str = "worker", node_ip: str = ""):
+        self._client.report(
+            msg.NodeAddressRequest(
+                node_id=self.node_id, node_type=node_type, node_ip=node_ip
+            )
+        )
+
+    @retry()
+    def report_failure(
+        self, error_data: str, level: str, restart_count: int = 0
+    ):
+        self._client.report(
+            msg.NodeFailureReport(
+                node_id=self.node_id,
+                error_data=error_data,
+                level=level,
+                restart_count=restart_count,
+            )
+        )
+
+    def heartbeat(self) -> str:
+        resp = self._client.report(
+            msg.HeartbeatRequest(node_id=self.node_id, timestamp=time.time())
+        )
+        return resp.action if resp else "none"
+
+    # -- rendezvous ---------------------------------------------------------
+
+    @retry()
+    def join_rendezvous(
+        self,
+        local_world_size: int,
+        rdzv_name: str = RendezvousName.TRAINING,
+    ) -> int:
+        resp = self._client.get(
+            msg.JoinRendezvousRequest(
+                node_id=self.node_id,
+                node_rank=self.node_rank,
+                local_world_size=local_world_size,
+                rdzv_name=rdzv_name,
+            )
+        )
+        return resp.round
+
+    def get_comm_world(
+        self, rdzv_name: str = RendezvousName.TRAINING
+    ) -> Tuple[int, int, Dict[int, int]]:
+        resp = self._client.get(
+            msg.CommWorldRequest(
+                node_id=self.node_id,
+                node_rank=self.node_rank,
+                rdzv_name=rdzv_name,
+            )
+        )
+        return resp.round, resp.group, resp.world
+
+    def num_nodes_waiting(
+        self, rdzv_name: str = RendezvousName.TRAINING
+    ) -> int:
+        try:
+            resp = self._client.get(
+                msg.WaitingNodeNumRequest(
+                    node_id=self.node_id, rdzv_name=rdzv_name
+                )
+            )
+            return resp.waiting_num
+        except Exception:  # noqa: BLE001 - polling must not kill the agent
+            return 0
+
+    @retry()
+    def report_network_check(self, normal: bool, elapsed_time: float):
+        self._client.report(
+            msg.NetworkCheckResultRequest(
+                node_id=self.node_rank,
+                normal=normal,
+                elapsed_time=elapsed_time,
+            )
+        )
+
+    def query_fault_nodes(self) -> Tuple[List[int], str]:
+        resp = self._client.get(msg.NetworkCheckQueryRequest(kind="fault"))
+        return resp.nodes, resp.reason
+
+    def query_stragglers(self) -> Tuple[List[int], str]:
+        resp = self._client.get(
+            msg.NetworkCheckQueryRequest(kind="straggler")
+        )
+        return resp.nodes, resp.reason
+
+    # -- kv store -----------------------------------------------------------
+
+    @retry()
+    def kv_set(self, key: str, value: bytes):
+        self._client.report(msg.KVStoreSetRequest(key=key, value=value))
+
+    def kv_get(self, key: str) -> Optional[bytes]:
+        resp = self._client.get(msg.KVStoreGetRequest(key=key))
+        return resp.value if resp.found else None
+
+    def kv_add(self, key: str, amount: int) -> int:
+        resp = self._client.get(
+            msg.KVStoreAddRequest(key=key, amount=amount)
+        )
+        return resp.value
+
+    def kv_wait(self, key: str, timeout: float = 120.0) -> bytes:
+        deadline = time.time() + timeout
+        while time.time() < deadline:
+            value = self.kv_get(key)
+            if value is not None:
+                return value
+            time.sleep(0.2)
+        raise TimeoutError(f"kv key {key!r} not set within {timeout}s")
+
+    # -- data sharding ------------------------------------------------------
+
+    @retry()
+    def create_dataset(
+        self,
+        dataset_name: str,
+        dataset_size: int,
+        batch_size: int,
+        num_epochs: int = 1,
+        shuffle: bool = False,
+        num_minibatches_per_shard: int = 2,
+        storage_type: str = "table",
+        task_type: str = "training",
+    ):
+        self._client.report(
+            msg.DatasetShardParams(
+                batch_size=batch_size,
+                num_epochs=num_epochs,
+                dataset_size=dataset_size,
+                shuffle=shuffle,
+                num_minibatches_per_shard=num_minibatches_per_shard,
+                dataset_name=dataset_name,
+                task_type=task_type,
+                storage_type=storage_type,
+            )
+        )
+
+    def get_task(self, dataset_name: str) -> msg.Task:
+        return self._client.get(
+            msg.TaskRequest(node_id=self.node_id, dataset_name=dataset_name)
+        )
+
+    @retry()
+    def report_task_result(
+        self, dataset_name: str, task_id: int, success: bool = True
+    ):
+        self._client.report(
+            msg.TaskResultRequest(
+                node_id=self.node_id,
+                dataset_name=dataset_name,
+                task_id=task_id,
+                success=success,
+            )
+        )
+
+    def get_shard_checkpoint(self, dataset_name: str) -> str:
+        resp = self._client.get(
+            msg.ShardCheckpointRequest(dataset_name=dataset_name)
+        )
+        return resp.content
+
+    @retry()
+    def restore_shard_checkpoint(self, dataset_name: str, content: str):
+        self._client.report(
+            msg.RestoreShardRequest(dataset_name=dataset_name, content=content)
+        )
+
+    # -- metrics ------------------------------------------------------------
+
+    def report_step(self, step: int, tokens: int = 0):
+        try:
+            self._client.report(
+                msg.StepReport(
+                    node_id=self.node_id,
+                    timestamp=time.time(),
+                    step=step,
+                    tokens=tokens,
+                )
+            )
+        except Exception:  # noqa: BLE001 - telemetry is best-effort
+            pass
+
+    def report_resource(
+        self,
+        cpu_percent: float,
+        memory_mb: int,
+        hbm_used_gb: float = 0.0,
+        duty_cycle: float = 0.0,
+    ):
+        try:
+            self._client.report(
+                msg.ResourceStats(
+                    node_id=self.node_id,
+                    cpu_percent=cpu_percent,
+                    memory_mb=memory_mb,
+                    hbm_used_gb=hbm_used_gb,
+                    duty_cycle=duty_cycle,
+                )
+            )
+        except Exception:  # noqa: BLE001
+            pass
+
+    def close(self):
+        self._client.close()

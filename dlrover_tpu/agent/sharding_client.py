@@ -1,0 +1,162 @@
+"""Worker-side dynamic sharding clients.
+
+Parity: dlrover/python/elastic_agent/sharding/client.py:31,233
+(ShardingClient / IndexShardingClient). The index client prefetches
+sample indices from master-assigned shards on a background thread so
+``fetch_sample_index()`` is cheap inside the input pipeline.
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+from collections import deque
+from typing import Deque, Dict, List, Optional
+
+from dlrover_tpu.agent.master_client import MasterClient
+from dlrover_tpu.common.constants import TaskType
+from dlrover_tpu.common.log import get_logger
+from dlrover_tpu.common import messages as msg
+
+logger = get_logger("sharding_client")
+
+
+class ShardingClient:
+    """Fetches whole shards; reports completion."""
+
+    def __init__(
+        self,
+        dataset_name: str,
+        client: Optional[MasterClient] = None,
+    ):
+        self.dataset_name = dataset_name
+        self._client = client or MasterClient.singleton()
+        self._pending: Dict[int, msg.Task] = {}
+        self._lock = threading.Lock()
+
+    def create_dataset(
+        self,
+        dataset_size: int,
+        batch_size: int,
+        num_epochs: int = 1,
+        shuffle: bool = False,
+        num_minibatches_per_shard: int = 2,
+        storage_type: str = "table",
+    ) -> None:
+        self._client.create_dataset(
+            dataset_name=self.dataset_name,
+            dataset_size=dataset_size,
+            batch_size=batch_size,
+            num_epochs=num_epochs,
+            shuffle=shuffle,
+            num_minibatches_per_shard=num_minibatches_per_shard,
+            storage_type=storage_type,
+        )
+
+    def get_task(self, wait: bool = True, timeout: float = 300.0):
+        """Returns the next Task or None when the dataset is exhausted."""
+        deadline = time.time() + timeout
+        while True:
+            task = self._client.get_task(self.dataset_name)
+            if task.task_type == TaskType.WAIT:
+                if not wait or time.time() > deadline:
+                    return None
+                time.sleep(1.0)
+                continue
+            if task.task_type == TaskType.NONE or task.task_id < 0:
+                return None
+            with self._lock:
+                self._pending[task.task_id] = task
+            return task
+
+    def report_task_done(self, task_id: int, success: bool = True) -> None:
+        with self._lock:
+            self._pending.pop(task_id, None)
+        self._client.report_task_result(
+            self.dataset_name, task_id, success
+        )
+
+    def checkpoint(self) -> str:
+        return self._client.get_shard_checkpoint(self.dataset_name)
+
+    def restore(self, content: str) -> None:
+        self._client.restore_shard_checkpoint(self.dataset_name, content)
+
+
+class IndexShardingClient(ShardingClient):
+    """Streams individual sample indices out of master-assigned shards.
+
+    The dataset's ``__getitem__`` asks for the next index; shard
+    boundaries and completion reporting stay invisible to user code.
+    """
+
+    def __init__(
+        self,
+        dataset_name: str,
+        batch_size: int,
+        client: Optional[MasterClient] = None,
+    ):
+        super().__init__(dataset_name, client)
+        self.batch_size = batch_size
+        self._indices: Deque[int] = deque()
+        self._index_lock = threading.Lock()
+        # task_id -> remaining sample count; completion reported at 0
+        self._task_remaining: Dict[int, int] = {}
+        self._current_task_queue: Deque[int] = deque()
+        self._exhausted = False
+
+    def fetch_sample_index(self) -> Optional[int]:
+        """Next sample index, or None when the dataset is exhausted."""
+        with self._index_lock:
+            if self._indices:
+                self._account_consumed()
+                return self._indices.popleft()
+        if self._exhausted:
+            return None
+        self._prefetch()
+        with self._index_lock:
+            if not self._indices:
+                return None
+            self._account_consumed()
+            return self._indices.popleft()
+
+    def _account_consumed(self) -> None:
+        # Called with _index_lock held, BEFORE popping one index.
+        while self._current_task_queue:
+            tid = self._current_task_queue[0]
+            if self._task_remaining.get(tid, 0) > 0:
+                self._task_remaining[tid] -= 1
+                if self._task_remaining[tid] == 0:
+                    self._current_task_queue.popleft()
+                    done_tid = tid
+                    # Report outside the lock via a thread to keep the
+                    # input pipeline non-blocking.
+                    threading.Thread(
+                        target=self.report_task_done,
+                        args=(done_tid,),
+                        daemon=True,
+                    ).start()
+                return
+            self._current_task_queue.popleft()
+
+    def _prefetch(self) -> None:
+        task = self.get_task(wait=True)
+        if task is None:
+            self._exhausted = True
+            return
+        shard = task.shard
+        if shard.record_indices:
+            indices: List[int] = list(shard.record_indices)
+        else:
+            indices = list(range(shard.start, shard.end))
+        with self._index_lock:
+            self._indices.extend(indices)
+            self._task_remaining[task.task_id] = len(indices)
+            self._current_task_queue.append(task.task_id)
+
+    def reset(self) -> None:
+        with self._index_lock:
+            self._indices.clear()
+            self._task_remaining.clear()
+            self._current_task_queue.clear()
+            self._exhausted = False

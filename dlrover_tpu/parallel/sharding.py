@@ -1,0 +1,95 @@
+"""Logical-axis sharding rules.
+
+The TPU-native replacement for the reference's per-module parallel
+wrappers (atorch RowParallelLinear/ColumnParallelLinear etc.,
+modules/distributed_modules/layers.py): models annotate parameters with
+*logical* axis names; a rule table maps logical names to mesh axes and
+GSPMD propagates everything else. Changing the parallelism strategy is
+a rule-table edit, not a model rewrite.
+"""
+
+from __future__ import annotations
+
+from typing import Dict, Optional, Sequence, Tuple, Union
+
+import jax
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+MeshAxis = Union[str, None, Tuple[str, ...]]
+Rules = Dict[str, MeshAxis]
+
+# Default rule table for transformer LMs. Logical names follow the
+# usual conventions (batch/seq/embed/mlp/heads/kv/vocab).
+DEFAULT_RULES: Rules = {
+    "batch": ("data", "fsdp"),
+    "seq": "seq",
+    # Weight embed dim shards over fsdp (ZeRO-3-style); activations
+    # annotate their embed dim as None.
+    "embed": "fsdp",
+    "mlp": "tensor",
+    "heads": "tensor",
+    "kv": None,
+    "vocab": "tensor",
+    "expert": "expert",
+    "stage": "pipe",
+    "layers": None,  # scanned layer stack dim stays replicated
+}
+
+
+def spec_for(
+    logical_axes: Sequence[Optional[str]],
+    rules: Optional[Rules] = None,
+) -> P:
+    """Translate logical axis names to a PartitionSpec."""
+    rules = rules or DEFAULT_RULES
+    return P(*(rules.get(a) if a else None for a in logical_axes))
+
+
+def tree_specs(logical_tree, rules: Optional[Rules] = None):
+    """Map a pytree of logical-axes tuples to PartitionSpecs."""
+    return jax.tree.map(
+        lambda axes: spec_for(axes, rules),
+        logical_tree,
+        is_leaf=lambda x: isinstance(x, tuple)
+        and all(isinstance(a, (str, type(None))) for a in x),
+    )
+
+
+def tree_shardings(
+    mesh: Mesh, logical_tree, rules: Optional[Rules] = None
+):
+    specs = tree_specs(logical_tree, rules)
+    return jax.tree.map(
+        lambda s: NamedSharding(mesh, s),
+        specs,
+        is_leaf=lambda x: isinstance(x, P),
+    )
+
+
+def prune_specs_to_mesh(mesh: Mesh, specs):
+    """Drop mesh axes of size 1 from specs (XLA treats them as
+    replicated anyway, but pruning keeps HLO shardings tidy)."""
+
+    def prune(spec: P) -> P:
+        out = []
+        for entry in spec:
+            if entry is None:
+                out.append(None)
+            elif isinstance(entry, tuple):
+                kept = tuple(
+                    a for a in entry if mesh.shape.get(a, 1) > 1
+                )
+                out.append(kept if kept else None)
+            else:
+                out.append(
+                    entry if mesh.shape.get(entry, 1) > 1 else None
+                )
+        return P(*out)
+
+    return jax.tree.map(
+        prune, specs, is_leaf=lambda x: isinstance(x, P)
+    )
+
+
+def shard_array(mesh: Mesh, spec: P, x):
+    return jax.device_put(x, NamedSharding(mesh, spec))

@@ -1,0 +1,208 @@
+"""``dlrover-tpu-run`` — the elastic launcher CLI.
+
+Parity: dlrover/trainer/torch/elastic_run.py (dlrover-run, a superset of
+torchrun): spawns a local job master when none is given (standalone or
+rank-0), then runs the per-host :class:`ElasticAgent` that supervises
+the training process.
+
+Usage:
+    dlrover-tpu-run --standalone train.py --epochs 3
+    dlrover-tpu-run --nnodes 2:4 --network-check --node_unit 2 \
+        --master <addr> train.py
+"""
+
+from __future__ import annotations
+
+import argparse
+import os
+import re
+import subprocess
+import sys
+import time
+from typing import List, Optional, Tuple
+
+from dlrover_tpu.agent.agent import AgentConfig, ElasticAgent
+from dlrover_tpu.agent.master_client import MasterClient
+from dlrover_tpu.common.constants import NodeEnv
+from dlrover_tpu.common.log import get_logger
+
+logger = get_logger("elastic_run")
+
+
+def parse_nnodes(value: str) -> Tuple[int, int]:
+    if ":" in value:
+        lo, hi = value.split(":", 1)
+        return int(lo), int(hi)
+    n = int(value)
+    return n, n
+
+
+def parse_args(argv=None):
+    parser = argparse.ArgumentParser(
+        "dlrover-tpu-run", allow_abbrev=False
+    )
+    parser.add_argument(
+        "--nnodes",
+        type=str,
+        default="1",
+        help="number of nodes, or elastic range 'min:max'",
+    )
+    parser.add_argument(
+        "--nproc_per_node",
+        type=int,
+        default=0,
+        help="local chips per node (0 = autodetect jax.local_devices)",
+    )
+    parser.add_argument("--node_rank", type=int, default=-1)
+    parser.add_argument("--node_unit", type=int, default=1)
+    parser.add_argument("--max_restarts", type=int, default=3)
+    parser.add_argument(
+        "--standalone",
+        action="store_true",
+        help="single-node mode with an auto-spawned local master",
+    )
+    parser.add_argument(
+        "--master",
+        type=str,
+        default="",
+        help="job master address (spawned locally when empty on rank 0)",
+    )
+    parser.add_argument(
+        "--network-check",
+        action="store_true",
+        dest="network_check",
+        help="run the ICI psum+matmul health check before training",
+    )
+    parser.add_argument("--rdzv_timeout", type=float, default=600.0)
+    parser.add_argument(
+        "-m",
+        "--module",
+        action="store_true",
+        help="treat training_script as a python module (python -m)",
+    )
+    parser.add_argument(
+        "training_script",
+        type=str,
+        help="training script path (or module name with -m)",
+    )
+    parser.add_argument(
+        "training_script_args", nargs=argparse.REMAINDER
+    )
+    return parser.parse_args(argv)
+
+
+def _launch_local_master(
+    node_num: int, min_nodes: int, node_unit: int
+) -> Tuple[subprocess.Popen, str]:
+    """Spawn the job master as a subprocess; returns (proc, addr)."""
+    proc = subprocess.Popen(
+        [
+            sys.executable,
+            "-m",
+            "dlrover_tpu.master.main",
+            "--node_num",
+            str(node_num),
+            "--min_nodes",
+            str(min_nodes),
+            "--node_unit",
+            str(node_unit),
+        ],
+        stdout=subprocess.PIPE,  # binary: non-blocking reads below
+    )
+    # The master prints DLROVER_TPU_MASTER_PORT=N once bound. Read it
+    # with a hard deadline: readline() on a silent-but-alive master
+    # would otherwise block forever.
+    deadline = time.time() + 30
+    port: Optional[int] = None
+    os.set_blocking(proc.stdout.fileno(), False)
+    buf = b""
+    while time.time() < deadline:
+        chunk = proc.stdout.read()  # None when no data (non-blocking)
+        if chunk:
+            buf += chunk
+            m = re.search(rb"DLROVER_TPU_MASTER_PORT=(\d+)", buf)
+            if m:
+                port = int(m.group(1))
+                break
+        if proc.poll() is not None:
+            break
+        time.sleep(0.1)
+    if port is None:
+        proc.kill()
+        raise RuntimeError("local master failed to start within 30s")
+    addr = f"127.0.0.1:{port}"
+    logger.info("local job master running at %s", addr)
+    return proc, addr
+
+
+def _local_chip_count() -> int:
+    try:
+        import jax
+
+        return len(jax.local_devices())
+    except Exception:  # noqa: BLE001
+        return 1
+
+
+def run(args) -> int:
+    min_nodes, max_nodes = parse_nnodes(args.nnodes)
+    if args.standalone:
+        min_nodes = max_nodes = 1
+    nproc = args.nproc_per_node or _local_chip_count()
+    node_rank = (
+        args.node_rank
+        if args.node_rank >= 0
+        else int(os.getenv(NodeEnv.NODE_RANK, "0"))
+    )
+
+    master_proc = None
+    master_addr = args.master or os.getenv(NodeEnv.MASTER_ADDR, "")
+    if not master_addr:
+        if node_rank == 0:
+            master_proc, master_addr = _launch_local_master(
+                max_nodes, min_nodes, args.node_unit
+            )
+        else:
+            raise SystemExit(
+                "--master is required on non-rank-0 nodes"
+            )
+
+    os.environ[NodeEnv.MASTER_ADDR] = master_addr
+    os.environ[NodeEnv.NODE_ID] = str(node_rank)
+    os.environ[NodeEnv.NODE_RANK] = str(node_rank)
+    MasterClient.reset()
+
+    if args.module:
+        entry_cmd = [sys.executable, "-m", args.training_script]
+    else:
+        entry_cmd = [sys.executable, args.training_script]
+    entry_cmd += list(args.training_script_args)
+
+    config = AgentConfig(
+        node_id=node_rank,
+        node_rank=node_rank,
+        local_world_size=nproc,
+        max_restarts=args.max_restarts,
+        network_check=args.network_check,
+        rdzv_timeout=args.rdzv_timeout,
+    )
+    agent = ElasticAgent(config, entry_cmd)
+    try:
+        return agent.run()
+    finally:
+        agent.stop()
+        if master_proc is not None:
+            master_proc.terminate()
+            try:
+                master_proc.wait(timeout=10)
+            except subprocess.TimeoutExpired:
+                master_proc.kill()
+
+
+def main(argv=None) -> int:
+    args = parse_args(argv)
+    return run(args)
+
+
+if __name__ == "__main__":
+    sys.exit(main())

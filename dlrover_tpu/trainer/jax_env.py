@@ -1,0 +1,80 @@
+"""JAX distributed bootstrap from agent-provided environment.
+
+The TPU equivalent of the reference's c10d bootstrap (MASTER_ADDR from
+the agent store, dlrover/python/elastic_agent/torch/master_kv_store.py):
+the agent hands every training process its coordinator address, process
+id and count; calling :func:`setup_distributed` wires
+``jax.distributed.initialize`` accordingly. Single-process runs skip
+initialization entirely.
+"""
+
+from __future__ import annotations
+
+import os
+from typing import Optional
+
+from dlrover_tpu.common.constants import NodeEnv
+from dlrover_tpu.common.log import get_logger
+
+logger = get_logger("jax_env")
+
+_initialized = False
+
+
+def num_processes() -> int:
+    return int(os.getenv(NodeEnv.NUM_PROCESSES, "1"))
+
+
+def process_id() -> int:
+    return int(os.getenv(NodeEnv.PROCESS_ID, "0"))
+
+
+def coordinator_address() -> Optional[str]:
+    return os.getenv(NodeEnv.COORDINATOR_ADDR) or None
+
+
+def restart_count() -> int:
+    return int(os.getenv(NodeEnv.RESTART_COUNT, "0"))
+
+
+def setup_distributed() -> None:
+    """Initialize jax.distributed if the agent provided a multi-process
+    world. Idempotent."""
+    global _initialized
+    if _initialized:
+        return
+    n = num_processes()
+    if n <= 1:
+        _initialized = True
+        return
+    import jax
+
+    addr = coordinator_address()
+    pid = process_id()
+    logger.info(
+        "jax.distributed.initialize(coordinator=%s, num_processes=%d, "
+        "process_id=%d)",
+        addr,
+        n,
+        pid,
+    )
+    jax.distributed.initialize(
+        coordinator_address=addr,
+        num_processes=n,
+        process_id=pid,
+    )
+    _initialized = True
+
+
+def teardown_distributed() -> None:
+    global _initialized
+    if not _initialized:
+        return
+    if num_processes() > 1:
+        import jax
+
+        try:
+            jax.distributed.shutdown()
+        except Exception:  # noqa: BLE001
+            logger.warning("jax.distributed.shutdown failed", exc_info=True)
+    _initialized = False

@@ -1,0 +1,77 @@
+"""Node health-check payload.
+
+Parity: dlrover/trainer/torch/run_network_check.py:36-60 (10x allgather
++ matmul benchmark). TPU version: 10 rounds of ``psum`` across all
+devices of the (sub)world over ICI/DCN plus an MXU matmul benchmark.
+Exit code 0 = healthy; nonzero = faulty. Elapsed time is what the
+master's straggler detector compares across nodes.
+
+Run as ``python -m dlrover_tpu.trainer.network_check`` by the agent in a
+throwaway process.
+"""
+
+from __future__ import annotations
+
+import sys
+import time
+
+from dlrover_tpu.common.log import get_logger
+from dlrover_tpu.trainer import jax_env
+
+logger = get_logger("network_check")
+
+ROUNDS = 10
+MATMUL_SIZE = 1024
+
+
+def run_check() -> float:
+    import jax
+    import jax.numpy as jnp
+
+    jax_env.setup_distributed()
+    n_devices = jax.device_count()
+    devices = jax.local_devices()
+
+    # Collective benchmark: psum over every device in the world.
+    local = len(devices)
+    x = jnp.ones((local, 128, 128), dtype=jnp.bfloat16)
+    _psum = jax.pmap(
+        lambda v: jax.lax.psum(v, axis_name="i"), axis_name="i"
+    )
+    start = time.time()
+    for _ in range(ROUNDS):
+        out = _psum(x)
+    jax.block_until_ready(out)
+    # MXU benchmark: a bf16 matmul big enough to engage the systolic
+    # array but small enough to finish instantly on a healthy chip.
+    a = jnp.ones((MATMUL_SIZE, MATMUL_SIZE), dtype=jnp.bfloat16)
+    mm = jax.jit(lambda m: m @ m)
+    for _ in range(ROUNDS):
+        r = mm(a)
+    jax.block_until_ready(r)
+    elapsed = time.time() - start
+    expected = float(n_devices)
+    got = float(out[0, 0, 0])
+    if abs(got - expected) > 1e-3:
+        raise RuntimeError(
+            f"psum returned {got}, expected {expected}: data corruption"
+        )
+    logger.info(
+        "network check passed: %d devices, %.3fs", n_devices, elapsed
+    )
+    return elapsed
+
+
+def main() -> int:
+    try:
+        run_check()
+        return 0
+    except Exception:  # noqa: BLE001
+        logger.exception("network check FAILED")
+        return 1
+    finally:
+        jax_env.teardown_distributed()
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -7,12 +7,21 @@ modules import jax).
 
 import os
 
-os.environ.setdefault("JAX_PLATFORMS", "cpu")
+# Force-override: the environment presets JAX_PLATFORMS=axon (the real
+# TPU tunnel, registered by a sitecustomize hook at interpreter start);
+# tests always run on the virtual 8-device CPU mesh. The env var alone
+# does not win against the preregistered backend, so also flip the jax
+# config after import.
+os.environ["JAX_PLATFORMS"] = "cpu"
 _flags = os.environ.get("XLA_FLAGS", "")
 if "xla_force_host_platform_device_count" not in _flags:
     os.environ["XLA_FLAGS"] = (
         _flags + " --xla_force_host_platform_device_count=8"
     ).strip()
+
+import jax  # noqa: E402
+
+jax.config.update("jax_platforms", "cpu")
 
 import pytest  # noqa: E402
 

@@ -1,0 +1,173 @@
+"""Agent-layer tests: client, rendezvous handler, sharding, supervision.
+
+Real in-process master + real subprocess supervision (no cluster),
+mirroring the reference's test technique.
+"""
+
+import os
+import sys
+import threading
+
+import pytest
+
+from dlrover_tpu.agent.agent import (
+    AgentConfig,
+    ElasticAgent,
+    MasterRendezvousHandler,
+)
+from dlrover_tpu.agent.master_client import MasterClient
+from dlrover_tpu.agent.sharding_client import IndexShardingClient
+from dlrover_tpu.master.master import JobMaster
+
+
+@pytest.fixture()
+def master2():
+    m = JobMaster(port=0, node_num=2, rdzv_timeout=1.0)
+    m.prepare()
+    yield m
+    m.stop()
+
+
+@pytest.fixture()
+def master1():
+    m = JobMaster(port=0, node_num=1, rdzv_timeout=1.0)
+    m.prepare()
+    yield m
+    m.stop()
+
+
+def _client(master, node_id):
+    return MasterClient(master.addr, node_id=node_id)
+
+
+class TestRendezvousHandler:
+    def test_two_nodes_bootstrap(self, master2):
+        specs = {}
+
+        def join(node_id):
+            client = _client(master2, node_id)
+            client.register_node()
+            handler = MasterRendezvousHandler(
+                client, local_world_size=4, timeout=30
+            )
+            specs[node_id] = handler.next_rendezvous()
+
+        threads = [
+            threading.Thread(target=join, args=(i,)) for i in range(2)
+        ]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join(timeout=60)
+        assert specs[0].node_world_size == 2
+        assert specs[0].num_processes == 2
+        assert {specs[0].node_rank, specs[1].node_rank} == {0, 1}
+        # Both got the same coordinator endpoint from the KV store.
+        assert specs[0].coordinator == specs[1].coordinator
+        assert specs[0].coordinator.count(":") == 1
+
+
+class TestIndexShardingClient:
+    def test_streams_all_indices(self, master1):
+        client = _client(master1, 0)
+        shard_client = IndexShardingClient(
+            "train", batch_size=4, client=client
+        )
+        shard_client.create_dataset(
+            dataset_size=20, batch_size=4, num_minibatches_per_shard=2
+        )
+        seen = []
+        while True:
+            idx = shard_client.fetch_sample_index()
+            if idx is None:
+                break
+            seen.append(idx)
+        assert sorted(seen) == list(range(20))
+
+
+class TestAgentSupervision:
+    def test_restart_until_success(self, master1, tmp_path):
+        """Entry fails twice (distinct exit codes), then succeeds."""
+        counter = tmp_path / "count"
+        script = tmp_path / "train.py"
+        script.write_text(
+            "import pathlib, sys\n"
+            f"p = pathlib.Path({str(counter)!r})\n"
+            "n = int(p.read_text()) if p.exists() else 0\n"
+            "p.write_text(str(n + 1))\n"
+            "sys.exit(0 if n >= 2 else 7)\n"
+        )
+        client = _client(master1, 0)
+        config = AgentConfig(
+            node_id=0,
+            local_world_size=1,
+            max_restarts=3,
+            monitor_interval=0.2,
+            rdzv_timeout=30,
+        )
+        agent = ElasticAgent(
+            config, [sys.executable, str(script)], client=client
+        )
+        assert agent.run() == 0
+        assert counter.read_text() == "3"
+        assert agent._restart_count == 2
+
+    def test_gives_up_after_max_restarts(self, master1, tmp_path):
+        script = tmp_path / "fail.py"
+        script.write_text("import sys; sys.exit(3)\n")
+        client = _client(master1, 0)
+        config = AgentConfig(
+            node_id=0,
+            local_world_size=1,
+            max_restarts=1,
+            monitor_interval=0.2,
+            rdzv_timeout=30,
+        )
+        agent = ElasticAgent(
+            config, [sys.executable, str(script)], client=client
+        )
+        assert agent.run() == 3
+        # Both failures were reported to the master.
+        node = master1.job_manager.get_node(0)
+        assert node.status == "failed"
+
+
+class TestStandaloneCli:
+    def test_end_to_end(self, tmp_path):
+        """dlrover-tpu-run --standalone runs a real training script that
+        talks to the auto-spawned master for data shards."""
+        script = tmp_path / "train.py"
+        script.write_text(
+            "from dlrover_tpu.agent.master_client import MasterClient\n"
+            "from dlrover_tpu.agent.sharding_client import "
+            "IndexShardingClient\n"
+            "client = MasterClient.singleton()\n"
+            "sc = IndexShardingClient('d', batch_size=2, client=client)\n"
+            "sc.create_dataset(dataset_size=8, batch_size=2)\n"
+            "seen = []\n"
+            "while True:\n"
+            "    i = sc.fetch_sample_index()\n"
+            "    if i is None: break\n"
+            "    seen.append(i)\n"
+            "assert sorted(seen) == list(range(8)), seen\n"
+            "client.report_step(step=4, tokens=64)\n"
+            "print('TRAIN_OK')\n"
+        )
+        from dlrover_tpu.trainer.elastic_run import main
+
+        env_backup = dict(os.environ)
+        try:
+            MasterClient.reset()
+            code = main(
+                [
+                    "--standalone",
+                    "--nproc_per_node",
+                    "1",
+                    str(script),
+                ]
+            )
+        finally:
+            os.environ.clear()
+            os.environ.update(env_backup)
+            MasterClient.reset()
+        assert code == 0
