@@ -16,6 +16,7 @@ since its distributed world is static per initialization.
 
 from __future__ import annotations
 
+import collections
 import os
 import signal
 import subprocess
@@ -23,11 +24,13 @@ import sys
 import threading
 import time
 from dataclasses import dataclass, field
-from typing import Dict, List, Optional
+from typing import Deque, Dict, List, Optional
 
 from dlrover_tpu.agent.master_client import MasterClient
 from dlrover_tpu.common.comm import find_free_port
+from dlrover_tpu.common.config import ensure_framework_on_pythonpath
 from dlrover_tpu.common.constants import (
+    NodeAction,
     NodeEnv,
     RendezvousName,
     TrainingExceptionLevel,
@@ -155,6 +158,11 @@ class ElasticAgent:
             timeout=config.rdzv_timeout,
         )
         self._proc: Optional[subprocess.Popen] = None
+        # Tail of the child's stderr, kept so failure reports carry the
+        # actual error text (OOM / RESOURCE_EXHAUSTED / preemption) the
+        # master's classifier keys on (ref: error log monitor).
+        self._stderr_tail: Deque[bytes] = collections.deque(maxlen=50)
+        self._stderr_thread: Optional[threading.Thread] = None
         self._restart_count = 0
         self._stop = threading.Event()
         self._spec: Optional[WorldSpec] = None
@@ -166,7 +174,7 @@ class ElasticAgent:
     # -- process management -------------------------------------------------
 
     def _spawn(self, spec: WorldSpec) -> None:
-        env = dict(os.environ)
+        env = ensure_framework_on_pythonpath(dict(os.environ))
         env.update(self.config.env)
         env.update(
             {
@@ -191,19 +199,60 @@ class ElasticAgent:
             self._restart_count,
             " ".join(self.entry_cmd),
         )
-        self._proc = subprocess.Popen(self.entry_cmd, env=env)
+        self._stderr_tail.clear()
+        self._proc = subprocess.Popen(
+            self.entry_cmd, env=env, stderr=subprocess.PIPE
+        )
+        self._stderr_thread = threading.Thread(
+            target=self._pump_stderr,
+            args=(self._proc.stderr,),
+            daemon=True,
+        )
+        self._stderr_thread.start()
+
+    def _pump_stderr(self, pipe) -> None:
+        """Forward the child's stderr while keeping the last lines."""
+        try:
+            for line in iter(pipe.readline, b""):
+                self._stderr_tail.append(line)
+                try:
+                    sys.stderr.buffer.write(line)
+                    sys.stderr.buffer.flush()
+                except (AttributeError, ValueError, OSError):
+                    # stderr replaced by a text-only capture (pytest) or
+                    # closed: keep the tail, drop the passthrough.
+                    pass
+        finally:
+            pipe.close()
+
+    def _stderr_text(self, limit: int = 2048) -> str:
+        text = b"".join(self._stderr_tail).decode("utf-8", "replace")
+        return text[-limit:]
 
     def _kill_proc(self, grace: float = 10.0) -> None:
         if self._proc is None or self._proc.poll() is not None:
+            self._join_stderr_pump()
             return
         self._proc.send_signal(signal.SIGTERM)
         deadline = time.time() + grace
         while time.time() < deadline:
             if self._proc.poll() is not None:
+                self._join_stderr_pump()
                 return
             time.sleep(0.2)
         self._proc.kill()
         self._proc.wait()
+        self._join_stderr_pump()
+
+    def _join_stderr_pump(self) -> None:
+        """Drain the old incarnation's pump thread so its buffered
+        stderr cannot leak into the next incarnation's tail."""
+        if (
+            self._stderr_thread is not None
+            and self._stderr_thread is not threading.current_thread()
+        ):
+            self._stderr_thread.join(timeout=3.0)
+        self._stderr_thread = None
 
     # -- health check -------------------------------------------------------
 
@@ -226,7 +275,7 @@ class ElasticAgent:
                     "dlrover_tpu.trainer.network_check",
                 ],
                 env={
-                    **os.environ,
+                    **ensure_framework_on_pythonpath(dict(os.environ)),
                     NodeEnv.COORDINATOR_ADDR: spec.coordinator,
                     NodeEnv.PROCESS_ID: str(spec.process_id),
                     NodeEnv.NUM_PROCESSES: str(spec.num_processes),
@@ -281,6 +330,13 @@ class ElasticAgent:
             if code is not None:
                 if code == 0:
                     logger.info("training process finished successfully")
+                    try:
+                        self.client.report_succeeded()
+                    except Exception:  # noqa: BLE001
+                        logger.warning(
+                            "could not report success to master",
+                            exc_info=True,
+                        )
                     return 0
                 if not self._handle_failure(code):
                     return code
@@ -300,14 +356,37 @@ class ElasticAgent:
 
     def _handle_failure(self, exitcode: int) -> bool:
         """Report and decide restart. True = keep running."""
-        self.client.report_failure(
-            f"training process exit code {exitcode}",
-            TrainingExceptionLevel.PROCESS_ERROR,
-            restart_count=self._restart_count,
+        if self._stderr_thread is not None:
+            self._stderr_thread.join(timeout=3.0)
+        exhausted = self._restart_count >= self.config.max_restarts
+        error_data = (
+            f"training process exit code {exitcode}\n"
+            + self._stderr_text()
         )
-        if self._restart_count >= self.config.max_restarts:
+        action = NodeAction.RESTART_IN_PLACE
+        try:
+            action = self.client.report_failure(
+                error_data,
+                TrainingExceptionLevel.PROCESS_ERROR,
+                restart_count=self._restart_count,
+                fatal=exhausted,
+            )
+        except Exception:  # noqa: BLE001
+            # An unreachable master must not take the agent down with
+            # it — restarts are still locally meaningful.
+            logger.warning(
+                "could not report failure to master", exc_info=True
+            )
+        if exhausted:
             logger.error(
                 "exhausted %d restarts; giving up", self.config.max_restarts
+            )
+            return False
+        if action != NodeAction.RESTART_IN_PLACE:
+            # The master took ownership (node relaunch or stop): this
+            # agent must not also restart the process in place.
+            logger.info(
+                "master verdict %r; agent stops supervising", action
             )
             return False
         self._restart_count += 1

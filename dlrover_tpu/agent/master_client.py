@@ -13,7 +13,11 @@ from typing import Dict, List, Optional, Tuple
 
 from dlrover_tpu.common import messages as msg
 from dlrover_tpu.common.comm import RpcClient
-from dlrover_tpu.common.constants import NodeEnv, RendezvousName
+from dlrover_tpu.common.constants import (
+    NodeAction,
+    NodeEnv,
+    RendezvousName,
+)
 from dlrover_tpu.common.log import get_logger
 
 logger = get_logger("master_client")
@@ -84,15 +88,27 @@ class MasterClient:
 
     @retry()
     def report_failure(
-        self, error_data: str, level: str, restart_count: int = 0
-    ):
-        self._client.report(
+        self,
+        error_data: str,
+        level: str,
+        restart_count: int = 0,
+        fatal: bool = False,
+    ) -> str:
+        resp = self._client.report(
             msg.NodeFailureReport(
                 node_id=self.node_id,
                 error_data=error_data,
                 level=level,
                 restart_count=restart_count,
+                fatal=fatal,
             )
+        )
+        return resp.action if resp else NodeAction.RESTART_IN_PLACE
+
+    @retry()
+    def report_succeeded(self):
+        self._client.report(
+            msg.NodeSucceededReport(node_id=self.node_id)
         )
 
     def heartbeat(self) -> str:

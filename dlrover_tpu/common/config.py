@@ -25,6 +25,28 @@ def env_str(name: str, default: str = "") -> str:
     return os.getenv(name, default)
 
 
+def ensure_framework_on_pythonpath(env: Dict[str, str]) -> Dict[str, str]:
+    """Make subprocesses able to ``import dlrover_tpu`` regardless of
+    their cwd or script location.
+
+    Python puts the *script's* directory — not the cwd — on
+    ``sys.path``, so a training script living elsewhere would not find
+    an uninstalled framework checkout. Prepend the package root to
+    PYTHONPATH (launcher parity: torchrun relies on pip-installation
+    instead; we support running straight from a checkout).
+    """
+    import dlrover_tpu
+
+    pkg_root = os.path.dirname(
+        os.path.dirname(os.path.abspath(dlrover_tpu.__file__))
+    )
+    existing = env.get("PYTHONPATH", "")
+    parts = [p for p in existing.split(os.pathsep) if p]
+    if pkg_root not in parts:
+        env["PYTHONPATH"] = os.pathsep.join([pkg_root] + parts)
+    return env
+
+
 def env_bool(name: str, default: bool = False) -> bool:
     v = os.getenv(name)
     if v is None:

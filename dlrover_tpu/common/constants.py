@@ -47,6 +47,14 @@ class NodeEventType:
     DELETED = "deleted"
 
 
+class NodeAction:
+    """Master's verdict on a failure report: who owns the restart."""
+
+    RESTART_IN_PLACE = "restart_in_place"  # agent respawns the process
+    RELAUNCH_NODE = "relaunch_node"  # master replaces the node (pod)
+    STOP = "stop"  # no restart at all
+
+
 class NodeExitReason:
     """Why a node's training process exited; drives relaunch policy."""
 

@@ -292,6 +292,20 @@ class NodeFailureReport:
     error_data: str = ""
     level: str = ""
     restart_count: int = 0
+    # True when the reporting agent has exhausted its local restart
+    # budget: the node is done, do not relaunch.
+    fatal: bool = False
+
+
+@message
+class NodeSucceededReport:
+    node_id: int = -1
+
+
+@message
+class NodeFailureResponse:
+    # A NodeAction constant: who owns the restart after this failure.
+    action: str = "restart_in_place"
 
 
 @message

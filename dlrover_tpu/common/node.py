@@ -96,6 +96,9 @@ class Node:
     finish_time: float = 0.0
     relaunch_count: int = 0
     max_relaunch_count: int = 3
+    # Training-process failures handled by the node's own agent (the
+    # node stayed up; only the process inside restarted).
+    process_failure_count: int = 0
     relaunchable: bool = True
     is_released: bool = False
     exit_reason: str = ""

@@ -10,11 +10,14 @@ the local platform simply records intents so tests can assert on them.
 
 from __future__ import annotations
 
+import re
 import threading
 import time
 from typing import Callable, Dict, List, Optional
 
 from dlrover_tpu.common.constants import (
+    JobExitReason,
+    NodeAction,
     NodeEventType,
     NodeExitReason,
     NodeStatus,
@@ -63,12 +66,14 @@ class JobManager:
         scaler: Optional[Scaler] = None,
         max_relaunch: int = 3,
         heartbeat_timeout: float = 180.0,
+        pending_timeout: float = 900.0,
     ):
         self._lock = threading.Lock()
         self._nodes: Dict[int, Node] = {}
         self._scaler = scaler or Scaler()
         self._max_relaunch = max_relaunch
         self._heartbeat_timeout = heartbeat_timeout
+        self._pending_timeout = pending_timeout
         self._next_node_id = 0
         self._stop = threading.Event()
         self._monitor_thread: Optional[threading.Thread] = None
@@ -160,36 +165,84 @@ class JobManager:
         if level == TrainingExceptionLevel.NODE_ERROR:
             return NodeExitReason.HARDWARE_ERROR
         text = (error_data or "").lower()
-        if "oom" in text or "out of memory" in text or "resource_exhausted" in text:
+        # error_data carries raw stderr: match whole words so e.g.
+        # "chatroom" in an app message cannot classify as OOM.
+        if (
+            re.search(r"\boom\b", text)
+            or "out of memory" in text
+            or "resource_exhausted" in text
+        ):
             return NodeExitReason.OOM
-        if "preempt" in text:
+        if re.search(r"\bpreempt", text):
             return NodeExitReason.PREEMPTED
         return NodeExitReason.KILLED
 
     def handle_failure_report(
-        self, node_id: int, error_data: str, level: str, restart_count: int
-    ) -> bool:
-        """Returns True if the node will be relaunched."""
+        self,
+        node_id: int,
+        error_data: str,
+        level: str,
+        restart_count: int,
+        fatal: bool = False,
+    ) -> str:
+        """Returns the :class:`NodeAction` verdict, which the servicer
+        sends back so agent and master never both own the restart.
+
+        A non-fatal PROCESS_ERROR means the agent on that node is
+        restarting the training process itself — the node (pod) is
+        alive, so it must stay RUNNING here (ref: process restarts are
+        agent-local, the master only replaces *nodes*,
+        dist_job_manager.py:489).
+        """
         with self._lock:
             node = self._nodes.get(node_id)
             if node is None:
-                return False
+                return NodeAction.STOP
+            # Idempotency: report RPCs are retried, so a duplicate of a
+            # report we already acted on must not relaunch twice or
+            # fail the replacement incarnation.
+            if node.status == NodeStatus.PENDING:
+                return NodeAction.RELAUNCH_NODE
+            if node.status in NodeStatus.TERMINAL:
+                return NodeAction.STOP
             node.exit_reason = self.classify_exit(error_data, level)
+            # OOM and preemption escalate to a node relaunch (OOM pods
+            # get grown resources in the reference,
+            # resource/local_optimizer.py:96); plain app crashes are
+            # retried in place by the agent.
+            if (
+                not fatal
+                and level == TrainingExceptionLevel.PROCESS_ERROR
+                and node.exit_reason
+                not in (NodeExitReason.OOM, NodeExitReason.PREEMPTED)
+            ):
+                node.process_failure_count = restart_count + 1
+                logger.warning(
+                    "node %d training process failed; agent is "
+                    "restarting it (count=%d)",
+                    node_id,
+                    node.process_failure_count,
+                )
+                return NodeAction.RESTART_IN_PLACE
+            if fatal:
+                node.exit_reason = NodeExitReason.FATAL_ERROR
             node.update_status(NodeStatus.FAILED)
             relaunch = node.should_relaunch()
             if relaunch:
                 node.inc_relaunch_count()
         logger.warning(
-            "node %d failed (%s, level=%s) relaunch=%s",
+            "node %d failed (%s, level=%s, fatal=%s) relaunch=%s",
             node_id,
             node.exit_reason,
             level,
+            fatal,
             relaunch,
         )
         self._notify(node, NodeEventType.MODIFIED)
         if relaunch:
             self._relaunch(node)
-        return relaunch
+            return NodeAction.RELAUNCH_NODE
+        return NodeAction.STOP
 
     def _relaunch(self, node: Node) -> None:
         plan = ScalePlan()
@@ -202,6 +255,10 @@ class JobManager:
             relaunch_count=node.relaunch_count,
             max_relaunch_count=node.max_relaunch_count,
         )
+        # Track the new incarnation: the failed node is being replaced,
+        # so the job is NOT done (all_workers_done must see PENDING).
+        with self._lock:
+            self._nodes[node.id] = new_node
         plan.launch_nodes.append(new_node)
         plan.remove_nodes.append(node)
         self._scaler.scale(plan)
@@ -226,29 +283,49 @@ class JobManager:
 
     def _monitor_loop(self) -> None:
         while not self._stop.wait(30.0):
-            now = time.time()
-            dead: List[Node] = []
-            with self._lock:
-                for node in self._nodes.values():
-                    if (
-                        node.is_alive()
-                        and node.heartbeat_time > 0
-                        and now - node.heartbeat_time
-                        > self._heartbeat_timeout
-                    ):
-                        node.exit_reason = NodeExitReason.KILLED
-                        node.update_status(NodeStatus.FAILED)
-                        dead.append(node)
-            for node in dead:
-                logger.warning(
-                    "node %d heartbeat timeout (>%ss); treating as dead",
-                    node.id,
-                    self._heartbeat_timeout,
-                )
-                self._notify(node, NodeEventType.DELETED)
-                if node.should_relaunch():
-                    node.inc_relaunch_count()
-                    self._relaunch(node)
+            self.check_nodes_once()
+
+    def check_nodes_once(self) -> None:
+        """One watchdog pass: heartbeat + pending timeouts."""
+        now = time.time()
+        dead: List[Node] = []
+        with self._lock:
+            for node in self._nodes.values():
+                if (
+                    node.is_alive()
+                    and node.heartbeat_time > 0
+                    and now - node.heartbeat_time
+                    > self._heartbeat_timeout
+                ):
+                    node.exit_reason = NodeExitReason.KILLED
+                    node.update_status(NodeStatus.FAILED)
+                    dead.append(node)
+                elif (
+                    node.status == NodeStatus.PENDING
+                    and now - node.create_time > self._pending_timeout
+                ):
+                    # A replacement that never came up (or a scaler
+                    # that cannot launch, e.g. local mode): abandon it
+                    # so all_workers_done() can complete the job
+                    # (ref: seconds_to_wait_pending_pod=900).
+                    node.exit_reason = JobExitReason.PENDING_TIMEOUT
+                    node.relaunchable = False
+                    node.update_status(NodeStatus.FAILED)
+                    logger.warning(
+                        "node %d pending for >%ss; abandoning",
+                        node.id,
+                        self._pending_timeout,
+                    )
+        for node in dead:
+            logger.warning(
+                "node %d heartbeat timeout (>%ss); treating as dead",
+                node.id,
+                self._heartbeat_timeout,
+            )
+            self._notify(node, NodeEventType.DELETED)
+            if node.should_relaunch():
+                node.inc_relaunch_count()
+                self._relaunch(node)
 
     def stop(self) -> None:
         self._stop.set()

@@ -76,6 +76,7 @@ class MasterServicer:
         r(msg.StepReport, self._report_step)
         r(msg.ResourceStats, self._report_resource)
         r(msg.NodeFailureReport, self._report_failure)
+        r(msg.NodeSucceededReport, self._report_succeeded)
         r(msg.HeartbeatRequest, self._heartbeat)
         r(msg.NodeAddressRequest, self._register_node)
         r(msg.RestoreShardRequest, self._restore_shards)
@@ -198,13 +199,22 @@ class MasterServicer:
     def _report_failure(self, req: msg.NodeFailureReport):
         node = self.job_manager.get_node(req.node_id)
         rank = node.rank if node is not None else req.node_id
-        self.job_manager.handle_failure_report(
-            req.node_id, req.error_data, req.level, req.restart_count
+        action = self.job_manager.handle_failure_report(
+            req.node_id,
+            req.error_data,
+            req.level,
+            req.restart_count,
+            fatal=req.fatal,
         )
         self.task_manager.recover_node_tasks(req.node_id)
         self.speed_monitor.remove_running_node(req.node_id)
         for mgr in self.rdzv_managers.values():
             mgr.remove_alive_node(req.node_id, node_rank=rank)
+        return msg.NodeFailureResponse(action=action)
+
+    def _report_succeeded(self, req: msg.NodeSucceededReport):
+        self.job_manager.handle_node_succeeded(req.node_id)
+        self.speed_monitor.remove_running_node(req.node_id)
         return None
 
     def _heartbeat(self, req: msg.HeartbeatRequest):

@@ -95,6 +95,8 @@ def _launch_local_master(
     node_num: int, min_nodes: int, node_unit: int
 ) -> Tuple[subprocess.Popen, str]:
     """Spawn the job master as a subprocess; returns (proc, addr)."""
+    from dlrover_tpu.common.config import ensure_framework_on_pythonpath
+
     proc = subprocess.Popen(
         [
             sys.executable,
@@ -108,6 +110,7 @@ def _launch_local_master(
             str(node_unit),
         ],
         stdout=subprocess.PIPE,  # binary: non-blocking reads below
+        env=ensure_framework_on_pythonpath(dict(os.environ)),
     )
     # The master prints DLROVER_TPU_MASTER_PORT=N once bound. Read it
     # with a hard deadline: readline() on a silent-but-alive master

@@ -199,7 +199,10 @@ class TestJobManager:
     def test_relaunch_on_failure(self):
         jm = JobManager(max_relaunch=2)
         node = jm.register_node(node_id=0)
-        assert jm.handle_failure_report(0, "oom killed", "process_error", 0)
+        action = jm.handle_failure_report(
+            0, "oom killed", "process_error", 0
+        )
+        assert action == "relaunch_node"
         assert node.exit_reason == "oom"
         assert len(jm._scaler.executed_plans) == 1
 
@@ -209,7 +212,7 @@ class TestJobManager:
         node = jm.get_node(0)
         node.exit_reason = "fatal_error"
         node.relaunchable = False
-        assert not jm.handle_failure_report(0, "x", "rdzv_error", 0)
+        assert jm.handle_failure_report(0, "x", "rdzv_error", 0) == "stop"
 
 
 class TestMasterEndToEnd:
@@ -281,6 +284,9 @@ class TestMasterEndToEnd:
         )
         nodes = client0.get(msg.JobNodesRequest())
         statuses = {n.node_id: n.status for n in nodes.nodes}
-        assert statuses[1] == "failed"
+        # OOM escalates to a node relaunch: the replacement incarnation
+        # is pending (the job is NOT done).
+        assert statuses[1] == "pending"
+        assert not master.job_manager.all_workers_done()
         client0.close()
         client1.close()

@@ -13,7 +13,8 @@ def test_reregistration_after_failure_revives_node():
     jm = JobManager(max_relaunch=3)
     jm.register_node(node_id=0)
     jm.handle_failure_report(0, "oom", "process_error", 0)
-    assert jm.get_node(0).status == "failed"
+    # OOM escalates to relaunch: a pending replacement is tracked.
+    assert jm.get_node(0).status == "pending"
     # Relaunched agent re-registers under the same id.
     node = jm.register_node(node_id=0, addr="h0-new")
     assert node.status == "running"
@@ -180,3 +181,49 @@ def test_queue_blocking_get_does_not_block_put_other_thread():
         assert result.get("item") == "hello"
     finally:
         q.close()
+
+
+def test_pending_replacement_times_out_so_job_can_finish():
+    """A relaunch plan nobody executes (local mode) must not hang the
+    master forever: the PENDING ghost is abandoned after the timeout."""
+    jm = JobManager(max_relaunch=3, pending_timeout=0.0)
+    jm.register_node(node_id=0)
+    action = jm.handle_failure_report(0, "oom", "process_error", 0)
+    assert action == "relaunch_node"
+    assert jm.get_node(0).status == "pending"
+    assert not jm.all_workers_done()
+    jm.check_nodes_once()
+    assert jm.get_node(0).status == "failed"
+    assert jm.all_workers_done()
+
+
+def test_process_error_keeps_node_running_at_master():
+    """Plain app crashes are retried in place by the agent; the node
+    (pod) is alive so the master keeps it RUNNING."""
+    jm = JobManager()
+    jm.register_node(node_id=0)
+    action = jm.handle_failure_report(
+        0, "training process exit code 1\nValueError: x", "process_error", 0
+    )
+    assert action == "restart_in_place"
+    node = jm.get_node(0)
+    assert node.status == "running"
+    assert node.process_failure_count == 1
+    assert not jm.all_workers_done()
+
+
+def test_oom_stderr_classifies_and_escalates():
+    """RESOURCE_EXHAUSTED in the child's stderr tail (what the agent now
+    reports) escalates to a master-owned node relaunch."""
+    jm = JobManager()
+    jm.register_node(node_id=0)
+    action = jm.handle_failure_report(
+        0,
+        "training process exit code 1\njaxlib.xla_extension."
+        "XlaRuntimeError: RESOURCE_EXHAUSTED: Out of memory",
+        "process_error",
+        0,
+    )
+    assert action == "relaunch_node"
+    # The tracked node is now the pending replacement incarnation.
+    assert jm.get_node(0).status == "pending"
