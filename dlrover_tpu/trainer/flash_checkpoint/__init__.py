@@ -1,0 +1,18 @@
+"""Flash Checkpoint: zero-stall checkpointing for TPU training.
+
+TPU-native re-design of the reference's Flash Checkpoint stack
+(dlrover/trainer/torch/flash_checkpoint/* + elastic_agent/torch/
+ckpt_saver.py): the training process stages sharded ``jax.Array``
+state into host shared memory in seconds; the host agent persists shm
+to storage asynchronously, on a failure signal, or right before an
+elastic restart — so a crashed trainer never loses the last in-memory
+checkpoint.
+"""
+
+from dlrover_tpu.trainer.flash_checkpoint.checkpointer import (
+    Checkpointer,
+    StorageType,
+)
+from dlrover_tpu.trainer.flash_checkpoint.engine import CheckpointEngine
+
+__all__ = ["CheckpointEngine", "Checkpointer", "StorageType"]

@@ -1,0 +1,317 @@
+"""Train-side flash-checkpoint engine.
+
+Parity with the reference's CheckpointEngine
+(dlrover/trainer/torch/flash_checkpoint/engine.py:75 —
+save_to_memory:169 with the shm-lock + all-rank-ready barrier
+:202-219), built for JAX:
+
+* state is one *global* sharded pytree, not per-rank torch state_dicts;
+  each process stages only the addressable shards it owns (replica 0 of
+  each shard, so replicated leaves are written exactly once per shard);
+* device→host is a ``jax.device_get`` of those shards (the analogue of
+  the reference's GPU→CPU ``tensor.copy_`` into shm, measured 2.3s for
+  3GB in docs/design/async-checkpoint.md);
+* persistence is delegated to the host agent via a SharedQueue event —
+  the trainer never blocks on storage.
+
+Restore reassembles global arrays from any shard layout and re-shards
+onto the current mesh (reshard-on-load), covering the reference's FSDP
+reshard-on-restart (atorch/utils/fsdp_save_util.py) by construction.
+"""
+
+from __future__ import annotations
+
+import time
+from typing import Any, Dict, List, Optional, Tuple
+
+import numpy as np
+
+from dlrover_tpu.common import ckpt_shm
+from dlrover_tpu.common.ckpt_shm import (
+    SharedMemoryHandler,
+    TensorEntry,
+    plan_entries,
+)
+from dlrover_tpu.common.log import get_logger
+from dlrover_tpu.common.multi_process import (
+    SharedDict,
+    SharedLock,
+    SharedQueue,
+)
+
+logger = get_logger("flash_ckpt")
+
+CKPT_EVENT_QUEUE = "ckpt_events"
+CKPT_STATUS_DICT = "ckpt_status"
+TRACKER_FILE = "latest_checkpointed_step"
+WRITING_PREFIX = "._writing_"
+
+
+def _path_name(path) -> str:
+    """'params/blocks/wqkv'-style stable leaf name from a key path."""
+    import jax
+
+    parts = []
+    for k in path:
+        if isinstance(k, jax.tree_util.DictKey):
+            parts.append(str(k.key))
+        elif isinstance(k, jax.tree_util.SequenceKey):
+            parts.append(str(k.idx))
+        elif isinstance(k, jax.tree_util.GetAttrKey):
+            parts.append(str(k.name))
+        else:
+            parts.append(str(k))
+    return "/".join(parts)
+
+
+def flatten_named(tree) -> List[Tuple[str, Any]]:
+    import jax
+
+    flat = jax.tree_util.tree_flatten_with_path(tree)[0]
+    return [(_path_name(path), leaf) for path, leaf in flat]
+
+
+def step_dir(checkpoint_dir: str, step: int) -> str:
+    return f"{checkpoint_dir.rstrip('/')}/{step}"
+
+
+def writing_dir(checkpoint_dir: str, step: int) -> str:
+    return f"{checkpoint_dir.rstrip('/')}/{WRITING_PREFIX}{step}"
+
+
+def done_dir(checkpoint_dir: str, step: int) -> str:
+    """Done-files live *outside* the writing dir so the commit rename
+    doesn't destroy the evidence a retrying committer needs."""
+    return f"{checkpoint_dir.rstrip('/')}/.done_{step}"
+
+
+def pack_shard_file(step: int, entries: List[TensorEntry], extra: dict,
+                    payload: bytes) -> bytes:
+    meta = ckpt_shm.pack_meta(step, entries, extra)
+    return (len(meta).to_bytes(8, "little") + meta + payload)
+
+
+def unpack_shard_file(data: bytes) -> Tuple[int, List[TensorEntry],
+                                            dict, bytes]:
+    meta_len = int.from_bytes(data[:8], "little")
+    step, entries, extra = ckpt_shm.unpack_meta(data[8:8 + meta_len])
+    return step, entries, extra, data[8 + meta_len:]
+
+
+class CheckpointEngine:
+    """Stages sharded jax state into shm; loads committed checkpoints.
+
+    One engine per training process. ``local_rank`` selects the shm
+    segment shared with the host agent; ``global_rank``/``world_size``
+    name this process's shard files in storage.
+    """
+
+    def __init__(
+        self,
+        checkpoint_dir: str,
+        local_rank: int = 0,
+        global_rank: Optional[int] = None,
+        world_size: Optional[int] = None,
+        use_agent: bool = True,
+        storage=None,
+    ):
+        import jax
+
+        from dlrover_tpu.common.storage import get_storage
+
+        self.checkpoint_dir = checkpoint_dir
+        self.storage = storage or get_storage()
+        self.local_rank = local_rank
+        self.global_rank = (jax.process_index()
+                            if global_rank is None else global_rank)
+        self.world_size = (jax.process_count()
+                           if world_size is None else world_size)
+        self._shm = SharedMemoryHandler(local_rank)
+        self._use_agent = use_agent
+        if use_agent:
+            self._lock = SharedLock(f"ckpt_{local_rank}")
+            self._events = SharedQueue(CKPT_EVENT_QUEUE)
+            self._status = SharedDict(CKPT_STATUS_DICT)
+        else:
+            self._lock = None
+            self._events = None
+            self._status = None
+        self._cached_step = -1
+
+    # -- save ------------------------------------------------------------
+
+    def _stage(self, state) -> Tuple[List[Tuple[TensorEntry, np.ndarray]],
+                                     int]:
+        """device→host copy of this process's primary shards."""
+        import jax
+
+        named = flatten_named(state)
+        plans = []
+        hosts: List[np.ndarray] = []
+        for name, leaf in named:
+            if not isinstance(leaf, jax.Array):
+                leaf = jax.numpy.asarray(leaf)
+            gshape = leaf.shape
+            seen_index = set()
+            for shard in leaf.addressable_shards:
+                if shard.replica_id != 0:
+                    continue
+                index = tuple(
+                    (sl.start or 0,
+                     sl.stop if sl.stop is not None else gshape[d])
+                    for d, sl in enumerate(shard.index)
+                )
+                # Several addressable devices can hold replica 0 of the
+                # same logical shard under nested replication; write
+                # each logical slice once.
+                if index in seen_index:
+                    continue
+                seen_index.add(index)
+                host = np.asarray(shard.data)
+                dtype_name = str(leaf.dtype)
+                raw = ckpt_shm._np_view(dtype_name)
+                if raw is not None:
+                    host = host.view(raw)
+                plans.append((name, dtype_name, gshape, index,
+                              host.nbytes))
+                hosts.append(host)
+        entries, total = plan_entries(plans)
+        return list(zip(entries, hosts)), total
+
+    def save_to_memory(self, step: int, state,
+                       extra: Optional[dict] = None) -> bool:
+        """Stage ``state`` into shm. Non-blocking wrt storage; skips
+        (returns False) if the agent is mid-persist on this segment."""
+        extra = dict(extra or {})
+        extra["_global_rank"] = self.global_rank
+        extra["_world_size"] = self.world_size
+        # Trylock *before* the device→host copy so a busy agent costs
+        # nothing — staging multi-GB state only to drop it would stall
+        # the train loop for seconds.
+        if self._lock is not None and not self._lock.acquire(
+                blocking=False):
+            logger.warning(
+                "step %s: shm busy (agent persisting); skip staging",
+                step)
+            return False
+        try:
+            arrays, _ = self._stage(state)
+            self._shm.save(step, arrays, extra)
+            self._cached_step = step
+        finally:
+            if self._lock is not None:
+                self._lock.release()
+        return True
+
+    def save_to_storage(self, step: int, state,
+                        extra: Optional[dict] = None) -> bool:
+        """Stage into shm then ask the agent to persist asynchronously."""
+        if not self.save_to_memory(step, state, extra):
+            return False
+        if self._events is not None:
+            self._events.put({"type": "save", "step": step})
+        return True
+
+    def wait_persisted(self, step: int, timeout: float = 60.0) -> bool:
+        """Block until the agent reports ``step`` committed (tests,
+        graceful shutdown)."""
+        if self._status is None:
+            return False
+        deadline = time.time() + timeout
+        while time.time() < deadline:
+            if int(self._status.get("latest_persisted_step", -1)) >= step:
+                return True
+            time.sleep(0.05)
+        return False
+
+    # -- load ------------------------------------------------------------
+
+    def latest_step(self) -> int:
+        """Latest committed step in storage, or -1."""
+        path = f"{self.checkpoint_dir.rstrip('/')}/{TRACKER_FILE}"
+        if not self.storage.exists(path):
+            return -1
+        txt = self.storage.read_bytes(path).decode().strip()
+        return int(txt) if txt else -1
+
+    def load_flat(self, step: Optional[int] = None
+                  ) -> Optional[Tuple[int, Dict[str, np.ndarray], dict]]:
+        """Load {leaf-name: global ndarray} for the latest (or given)
+        committed step, merging every rank's shard files."""
+        if step is None:
+            step = self.latest_step()
+        if step < 0:
+            return None
+        sdir = step_dir(self.checkpoint_dir, step)
+        entries: List[TensorEntry] = []
+        payloads: List[bytes] = []
+        extra: dict = {}
+        offset = 0
+        found = False
+        for fname in self.storage.listdir(sdir):
+            if not fname.endswith(".ckpt"):
+                continue
+            found = True
+            shard_step, shard_entries, shard_extra, payload = (
+                unpack_shard_file(
+                    self.storage.read_bytes(f"{sdir}/{fname}")))
+            if shard_step != step:
+                raise ValueError(
+                    f"shard {fname} holds step {shard_step}, dir says "
+                    f"{step}: corrupt checkpoint")
+            for e in shard_entries:
+                e.offset += offset
+                entries.append(e)
+            payloads.append(payload)
+            offset += len(payload)
+            for k, v in shard_extra.items():
+                if not k.startswith("_"):
+                    extra[k] = v
+        if not found:
+            return None
+        flat = ckpt_shm.assemble_global(entries, b"".join(payloads))
+        return step, flat, extra
+
+    def load(self, like, shardings=None,
+             step: Optional[int] = None):
+        """Restore a pytree shaped like ``like`` (arrays or
+        ShapeDtypeStructs). If ``shardings`` (matching pytree of
+        NamedSharding) is given, leaves are device_put with it —
+        reshard-on-load onto the current mesh.
+
+        Returns (step, state, extra) or None when no checkpoint exists.
+        """
+        import jax
+
+        res = self.load_flat(step)
+        if res is None:
+            return None
+        found_step, flat, extra = res
+        named = flatten_named(like)
+        leaves = []
+        missing = []
+        for name, leaf in named:
+            if name not in flat:
+                missing.append(name)
+                leaves.append(None)
+                continue
+            arr = flat[name]
+            leaves.append(arr)
+        if missing:
+            raise KeyError(
+                f"checkpoint step {found_step} missing leaves: "
+                f"{missing[:5]}{'...' if len(missing) > 5 else ''}")
+        treedef = jax.tree_util.tree_structure(like)
+        state = jax.tree_util.tree_unflatten(treedef, leaves)
+        if shardings is not None:
+            state = jax.tree.map(
+                lambda x, s: jax.device_put(x, s), state, shardings)
+        else:
+            state = jax.tree.map(jax.numpy.asarray, state)
+        return found_step, state, extra
+
+    def close(self) -> None:
+        self._shm.close()
+        for h in (self._lock, self._events, self._status):
+            if h is not None:
+                h.close()

@@ -1,0 +1,200 @@
+"""Flash Checkpoint tests.
+
+Modeled on the reference's test strategy (dlrover/python/tests/
+test_ckpt_saver.py + trainer checkpoint tests): real shm + real saver
+thread in one process, sharded arrays on the virtual 8-device CPU mesh,
+reshard-on-load across different mesh shapes.
+"""
+
+import os
+import uuid
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+from dlrover_tpu.agent.ckpt_saver import AsyncCheckpointSaver
+from dlrover_tpu.common import ckpt_shm
+from dlrover_tpu.trainer.flash_checkpoint.engine import CheckpointEngine
+
+
+@pytest.fixture(autouse=True)
+def _isolated_job(monkeypatch, tmp_path):
+    """Unique job name per test so shm segments/sockets don't collide."""
+    monkeypatch.setenv("DLROVER_TPU_JOB_NAME", f"t{uuid.uuid4().hex[:8]}")
+    yield
+
+
+@pytest.fixture()
+def saver(tmp_path):
+    s = AsyncCheckpointSaver(
+        checkpoint_dir=str(tmp_path / "ckpt"),
+        local_shard_num=1,
+        global_shard_num=1,
+        commit_timeout=20.0,
+    )
+    s.start()
+    yield s
+    s.close()
+    for shm in s._shms:
+        shm.unlink()
+
+
+def _mesh(shape, names):
+    devs = np.array(jax.devices()[: int(np.prod(shape))]).reshape(shape)
+    return Mesh(devs, names)
+
+
+def _state(mesh):
+    w = jnp.arange(64, dtype=jnp.float32).reshape(8, 8)
+    b = jnp.ones((8,), jnp.bfloat16)
+    sharded_w = jax.device_put(
+        w, NamedSharding(mesh, P("data", None)))
+    return {"w": sharded_w, "inner": {"b": b, "step_scale": jnp.float32(2.0)}}
+
+
+class TestShmFormat:
+    def test_roundtrip(self):
+        arrs = [
+            ("a/b", np.arange(12, dtype=np.float32).reshape(3, 4)),
+            ("c", np.ones((5,), np.int32)),
+        ]
+        plans = [
+            (name, str(a.dtype), a.shape,
+             [(0, s) for s in a.shape], a.nbytes)
+            for name, a in arrs
+        ]
+        entries, total = ckpt_shm.plan_entries(plans)
+        assert entries[1].offset % 128 == 0
+        handler = ckpt_shm.SharedMemoryHandler(0)
+        try:
+            handler.save(7, list(zip(entries, [a for _, a in arrs])),
+                         {"k": "v"})
+            step, got_entries, extra, payload = handler.load()
+            assert step == 7 and extra["k"] == "v"
+            flat = ckpt_shm.assemble_global(got_entries, payload)
+            np.testing.assert_array_equal(flat["a/b"], arrs[0][1])
+            np.testing.assert_array_equal(flat["c"], arrs[1][1])
+        finally:
+            handler.unlink()
+            handler.close()
+
+    def test_bf16_raw_staging(self):
+        import ml_dtypes
+
+        a = np.arange(8, dtype=ml_dtypes.bfloat16)
+        raw = a.view(np.uint16)
+        plans = [("x", "bfloat16", a.shape, [(0, 8)], raw.nbytes)]
+        entries, _ = ckpt_shm.plan_entries(plans)
+        handler = ckpt_shm.SharedMemoryHandler(0)
+        try:
+            handler.save(1, [(entries[0], raw)])
+            _, got, _, payload = handler.load()
+            flat = ckpt_shm.assemble_global(got, payload)
+            assert flat["x"].dtype == ml_dtypes.bfloat16
+            np.testing.assert_array_equal(flat["x"], a)
+        finally:
+            handler.unlink()
+            handler.close()
+
+
+class TestEngineSaverEndToEnd:
+    def test_save_and_commit(self, saver, tmp_path):
+        mesh = _mesh((4, 2), ("data", "tensor"))
+        state = _state(mesh)
+        engine = CheckpointEngine(str(tmp_path / "ckpt"), use_agent=True)
+        try:
+            assert engine.save_to_storage(10, state, {"lr": 0.1})
+            assert engine.wait_persisted(10, timeout=20)
+            assert engine.latest_step() == 10
+            step, flat, extra = engine.load_flat()
+            assert step == 10 and extra["lr"] == 0.1
+            np.testing.assert_array_equal(
+                flat["w"], np.arange(64, dtype=np.float32).reshape(8, 8))
+            np.testing.assert_array_equal(
+                np.asarray(flat["inner/b"], np.float32), np.ones(8))
+        finally:
+            engine.close()
+
+    def test_memory_only_then_flush(self, saver, tmp_path):
+        """save_to_memory leaves storage untouched; the agent's
+        failure-path flush (save_shm_to_storage) persists it."""
+        mesh = _mesh((8,), ("data",))
+        state = _state(mesh)
+        engine = CheckpointEngine(str(tmp_path / "ckpt"), use_agent=True)
+        try:
+            assert engine.save_to_memory(5, state)
+            assert engine.latest_step() == -1
+            assert saver.save_shm_to_storage()
+            assert engine.latest_step() == 5
+        finally:
+            engine.close()
+
+    def test_reshard_on_load(self, saver, tmp_path):
+        """Save on a (4,2) data×tensor mesh, restore onto (2,4)."""
+        mesh_a = _mesh((4, 2), ("data", "tensor"))
+        w = jnp.arange(256, dtype=jnp.float32).reshape(16, 16)
+        sharded = jax.device_put(
+            w, NamedSharding(mesh_a, P("data", "tensor")))
+        engine = CheckpointEngine(str(tmp_path / "ckpt"), use_agent=True)
+        try:
+            assert engine.save_to_storage(3, {"w": sharded})
+            assert engine.wait_persisted(3, timeout=20)
+
+            mesh_b = _mesh((2, 4), ("data", "tensor"))
+            target = NamedSharding(mesh_b, P("tensor", "data"))
+            like = {"w": jax.ShapeDtypeStruct((16, 16), jnp.float32)}
+            step, restored, _ = engine.load(
+                like, shardings={"w": target})
+            assert step == 3
+            np.testing.assert_array_equal(np.asarray(restored["w"]), w)
+            assert restored["w"].sharding == target
+        finally:
+            engine.close()
+
+    def test_newer_save_wins(self, saver, tmp_path):
+        mesh = _mesh((8,), ("data",))
+        engine = CheckpointEngine(str(tmp_path / "ckpt"), use_agent=True)
+        try:
+            for step in (1, 2):
+                state = {"x": jax.device_put(
+                    jnp.full((8,), step, jnp.float32),
+                    NamedSharding(mesh, P("data")))}
+                assert engine.save_to_storage(step, state)
+                assert engine.wait_persisted(step, timeout=20)
+            assert engine.latest_step() == 2
+            _, flat, _ = engine.load_flat()
+            np.testing.assert_array_equal(flat["x"], np.full(8, 2.0))
+        finally:
+            engine.close()
+
+
+class TestCheckpointerStandalone:
+    def test_self_hosted_saver(self, tmp_path):
+        from dlrover_tpu.trainer.flash_checkpoint import (
+            Checkpointer,
+            StorageType,
+        )
+
+        mesh = _mesh((8,), ("data",))
+        state = _state(mesh)
+        ckpt = Checkpointer(str(tmp_path / "ckpt2"))
+        saver = ckpt._self_hosted_saver
+        try:
+            assert ckpt.save_checkpoint(42, state,
+                                        storage_type=StorageType.DISK)
+            assert ckpt.wait_latest_checkpoint(timeout=20)
+            like = jax.tree.map(
+                lambda x: jax.ShapeDtypeStruct(x.shape, x.dtype), state)
+            step, restored, _ = ckpt.load_checkpoint(like)
+            assert step == 42
+            np.testing.assert_array_equal(
+                np.asarray(restored["w"]),
+                np.arange(64, dtype=np.float32).reshape(8, 8))
+        finally:
+            ckpt.close()
+            if saver is not None:
+                for shm in saver._shms:
+                    shm.unlink()
