@@ -163,6 +163,7 @@ class ElasticAgent:
         # master's classifier keys on (ref: error log monitor).
         self._stderr_tail: Deque[bytes] = collections.deque(maxlen=50)
         self._stderr_thread: Optional[threading.Thread] = None
+        self._tail_lock = threading.Lock()
         self._restart_count = 0
         self._stop = threading.Event()
         self._spec: Optional[WorldSpec] = None
@@ -199,22 +200,30 @@ class ElasticAgent:
             self._restart_count,
             " ".join(self.entry_cmd),
         )
-        self._stderr_tail.clear()
+        # Fresh deque per incarnation: if a previous pump thread out-
+        # lives its 3s join (a grandchild kept the pipe open), it keeps
+        # appending to the *old* deque and cannot pollute this
+        # incarnation's tail or race its readers.
+        with self._tail_lock:
+            self._stderr_tail = collections.deque(maxlen=50)
         self._proc = subprocess.Popen(
             self.entry_cmd, env=env, stderr=subprocess.PIPE
         )
         self._stderr_thread = threading.Thread(
             target=self._pump_stderr,
-            args=(self._proc.stderr,),
+            args=(self._proc.stderr, self._stderr_tail),
             daemon=True,
         )
         self._stderr_thread.start()
 
-    def _pump_stderr(self, pipe) -> None:
-        """Forward the child's stderr while keeping the last lines."""
+    def _pump_stderr(self, pipe, tail: Deque[bytes]) -> None:
+        """Forward the child's stderr while keeping the last lines.
+
+        ``tail`` is this incarnation's deque, bound at spawn time."""
         try:
             for line in iter(pipe.readline, b""):
-                self._stderr_tail.append(line)
+                with self._tail_lock:
+                    tail.append(line)
                 try:
                     sys.stderr.buffer.write(line)
                     sys.stderr.buffer.flush()
@@ -226,7 +235,9 @@ class ElasticAgent:
             pipe.close()
 
     def _stderr_text(self, limit: int = 2048) -> str:
-        text = b"".join(self._stderr_tail).decode("utf-8", "replace")
+        with self._tail_lock:
+            lines = list(self._stderr_tail)
+        text = b"".join(lines).decode("utf-8", "replace")
         return text[-limit:]
 
     def _kill_proc(self, grace: float = 10.0) -> None:

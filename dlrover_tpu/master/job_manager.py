@@ -66,14 +66,19 @@ class JobManager:
         scaler: Optional[Scaler] = None,
         max_relaunch: int = 3,
         heartbeat_timeout: float = 180.0,
-        pending_timeout: float = 900.0,
+        pending_timeout: Optional[float] = None,
     ):
+        from dlrover_tpu.common.config import Context
+
         self._lock = threading.Lock()
         self._nodes: Dict[int, Node] = {}
         self._scaler = scaler or Scaler()
         self._max_relaunch = max_relaunch
         self._heartbeat_timeout = heartbeat_timeout
-        self._pending_timeout = pending_timeout
+        self._pending_timeout = (
+            Context.singleton().pending_timeout_secs
+            if pending_timeout is None else pending_timeout
+        )
         self._next_node_id = 0
         self._stop = threading.Event()
         self._monitor_thread: Optional[threading.Thread] = None
@@ -165,10 +170,11 @@ class JobManager:
         if level == TrainingExceptionLevel.NODE_ERROR:
             return NodeExitReason.HARDWARE_ERROR
         text = (error_data or "").lower()
-        # error_data carries raw stderr: match whole words so e.g.
-        # "chatroom" in an app message cannot classify as OOM.
+        # error_data carries raw stderr: require a word *start* so
+        # "chatroom" cannot classify as OOM, while "OOMKilled" /
+        # "oom-killer" tokens still do.
         if (
-            re.search(r"\boom\b", text)
+            re.search(r"\boom", text)
             or "out of memory" in text
             or "resource_exhausted" in text
         ):
@@ -300,6 +306,22 @@ class JobManager:
                     node.exit_reason = NodeExitReason.KILLED
                     node.update_status(NodeStatus.FAILED)
                     dead.append(node)
+                elif (
+                    node.status == NodeStatus.PENDING
+                    and node.heartbeat_time > 0
+                    and now - node.heartbeat_time
+                    < self._heartbeat_timeout
+                ):
+                    # The node is alive and talking to us even though
+                    # no status report arrived (e.g. the failure-report
+                    # response was lost and the agent restarted in
+                    # place): a heartbeating node is RUNNING, not a
+                    # stuck replacement to abandon.
+                    node.update_status(NodeStatus.RUNNING)
+                    logger.info(
+                        "pending node %d is heartbeating; marking "
+                        "RUNNING", node.id,
+                    )
                 elif (
                     node.status == NodeStatus.PENDING
                     and now - node.create_time > self._pending_timeout
