@@ -367,8 +367,7 @@ class ElasticAgent:
 
     def _handle_failure(self, exitcode: int) -> bool:
         """Report and decide restart. True = keep running."""
-        if self._stderr_thread is not None:
-            self._stderr_thread.join(timeout=3.0)
+        self._join_stderr_pump()
         exhausted = self._restart_count >= self.config.max_restarts
         error_data = (
             f"training process exit code {exitcode}\n"

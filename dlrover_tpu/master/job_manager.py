@@ -158,11 +158,32 @@ class JobManager:
         with self._lock:
             return [n for n in self._nodes.values() if n.is_alive()]
 
+    # Beats landing on a PENDING replacement within this window after
+    # the relaunch are treated as last-gasp traffic from the agent
+    # being replaced and dropped; a genuinely-alive agent (e.g. the
+    # failure-report response was lost and it restarted in place)
+    # keeps beating past the window, so the PENDING->RUNNING recovery
+    # in check_nodes_once still fires for it. 2x the agent heartbeat
+    # cadence (agent.py AgentConfig.heartbeat_interval=15).
+    PENDING_HEARTBEAT_GRACE = 30.0
+
     def update_heartbeat(self, node_id: int) -> None:
         with self._lock:
             node = self._nodes.get(node_id)
-            if node is not None:
-                node.update_heartbeat()
+            if node is None:
+                return
+            # Bound by the pending timeout so a short operator-set
+            # timeout can never starve the PENDING->RUNNING recovery
+            # of every heartbeat before it abandons the node.
+            grace = min(
+                self.PENDING_HEARTBEAT_GRACE, self._pending_timeout / 2
+            )
+            if (
+                node.status == NodeStatus.PENDING
+                and time.time() - node.create_time < grace
+            ):
+                return
+            node.update_heartbeat()
 
     # -- failure handling ---------------------------------------------------
 
@@ -170,11 +191,12 @@ class JobManager:
         if level == TrainingExceptionLevel.NODE_ERROR:
             return NodeExitReason.HARDWARE_ERROR
         text = (error_data or "").lower()
-        # error_data carries raw stderr: require a word *start* so
-        # "chatroom" cannot classify as OOM, while "OOMKilled" /
-        # "oom-killer" tokens still do.
+        # error_data carries raw stderr: match the whole token "oom"
+        # plus the kernel/k8s killer spellings, but NOT every token
+        # merely starting with "oom" ("oom_score_adj" appears in
+        # ordinary procfs dumps of unrelated crashes).
         if (
-            re.search(r"\boom", text)
+            re.search(r"\boom\b|\boomkill", text)
             or "out of memory" in text
             or "resource_exhausted" in text
         ):

@@ -39,6 +39,8 @@ class GPTConfig:
     dropout: float = 0.0  # elastic training defaults to 0 (nanoGPT)
     dtype: Any = jnp.bfloat16
     remat: bool = True
+    # None = auto (flash on TPU at long context); True/False forces.
+    use_flash_attention: Optional[bool] = None
 
     @property
     def head_dim(self) -> int:
@@ -170,6 +172,28 @@ def _block(x, lp, cfg: GPTConfig, attn_fn):
     return x
 
 
+def default_attention_for(cfg: GPTConfig) -> Callable:
+    """Pick the attention implementation for this config.
+
+    On TPU with long context the Pallas flash kernel
+    (ops/flash_attention.py) is mandatory — materialized [B,H,T,T]
+    scores exceed HBM beyond ~4k seq — while at short seq XLA's fused
+    einsum attention is equally fast with none of the kernel-launch
+    overhead. ``cfg.use_flash_attention`` forces either path; None
+    auto-selects (flash on TPU from 2048 context up).
+    """
+    use_flash = cfg.use_flash_attention
+    if use_flash is None:
+        use_flash = (
+            jax.default_backend() == "tpu" and cfg.block_size >= 2048
+        )
+    if use_flash:
+        from dlrover_tpu.ops.flash_attention import flash_attention
+
+        return functools.partial(flash_attention, causal=True)
+    return functools.partial(_default_attention, causal=True)
+
+
 def forward(
     params: Params,
     tokens: jax.Array,
@@ -178,7 +202,7 @@ def forward(
 ) -> jax.Array:
     """tokens [B, T] int32 -> logits [B, T, vocab] float32."""
     if attn_fn is None:
-        attn_fn = functools.partial(_default_attention, causal=True)
+        attn_fn = default_attention_for(cfg)
     B, T = tokens.shape
     x = params["wte"][tokens] + params["wpe"][:T][None]
     x = x.astype(cfg.dtype)

@@ -1,0 +1,11 @@
+"""TPU kernel library (Pallas).
+
+The TPU-native replacement for the reference's native-op layer:
+flash-attention CUDA wheels + patched modules
+(atorch/modules/transformer/layers.py), the TF CPU FMHA op
+(tfplus/flash_attn/kernels/*), and the CUDA quantization suite
+(atorch/ops/csrc/quantization/*). Kernels are written once in Pallas
+and run compiled on TPU or interpreted on CPU for tests.
+"""
+
+from dlrover_tpu.ops.flash_attention import flash_attention  # noqa: F401

@@ -1,0 +1,165 @@
+"""Blockwise quantization kernels (Pallas).
+
+TPU-native replacement for the reference's CUDA quantization suite
+(atorch/ops/csrc/quantization/{quantize,dequantize,swizzled_quantize,
+quant_reduce}.cu and the fused quantized-state optimizer kernel,
+pt_binding.cpp:152-176). Symmetric per-block int8 quantization: each
+block of ``block_size`` contiguous values shares one float32 scale
+(absmax / 127). Backs the low-bit optimizer states of optim/low_bit.py.
+
+The kernels run compiled on TPU and interpreted on CPU (tests). Shapes
+are flattened to [num_blocks, block_size]; block_size should be a
+multiple of 128 (lane width). A jnp reference path is exported for
+odd sizes and as the ground truth in tests.
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Tuple
+
+import jax
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+DEFAULT_BLOCK = 1024
+# Rows of blocks processed per kernel grid step (sublane packing).
+_ROWS = 8
+
+
+def _use_interpret() -> bool:
+    return jax.default_backend() != "tpu"
+
+
+# ---------------------------------------------------------------------------
+# Kernels
+# ---------------------------------------------------------------------------
+
+
+def _quantize_kernel(x_ref, q_ref, scale_ref):
+    x = x_ref[:].astype(jnp.float32)  # (_ROWS, block)
+    absmax = jnp.max(jnp.abs(x), axis=1, keepdims=True)
+    scale = absmax / 127.0
+    safe = jnp.maximum(scale, 1e-30)
+    q = jnp.clip(jnp.round(x / safe), -127, 127)
+    q_ref[:] = q.astype(jnp.int8)
+    scale_ref[:] = scale
+
+
+def _dequantize_kernel(q_ref, scale_ref, out_ref):
+    out_ref[:] = (
+        q_ref[:].astype(jnp.float32) * scale_ref[:]
+    ).astype(out_ref.dtype)
+
+
+def quantize_blockwise(
+    x: jax.Array, block_size: int = DEFAULT_BLOCK
+) -> Tuple[jax.Array, jax.Array, Tuple[int, ...]]:
+    """x (any shape) -> (int8 values [n_blocks, block], f32 scales
+    [n_blocks, 1], original shape). Tail is zero-padded (zero maps to
+    zero exactly, so padding never perturbs scales of real data beyond
+    the shared block — callers with hard accuracy needs should size
+    params to block multiples)."""
+    shape = x.shape
+    flat = x.reshape(-1)
+    n = flat.size
+    pad = (-n) % block_size
+    if pad:
+        flat = jnp.pad(flat, (0, pad))
+    rows = flat.size // block_size
+    x2 = flat.reshape(rows, block_size)
+
+    row_pad = (-rows) % _ROWS
+    if row_pad:
+        x2 = jnp.pad(x2, ((0, row_pad), (0, 0)))
+    grid = x2.shape[0] // _ROWS
+
+    q, scales = pl.pallas_call(
+        _quantize_kernel,
+        grid=(grid,),
+        in_specs=[
+            pl.BlockSpec(
+                (_ROWS, block_size), lambda i: (i, 0),
+                memory_space=pltpu.VMEM,
+            )
+        ],
+        out_specs=[
+            pl.BlockSpec(
+                (_ROWS, block_size), lambda i: (i, 0),
+                memory_space=pltpu.VMEM,
+            ),
+            pl.BlockSpec(
+                (_ROWS, 1), lambda i: (i, 0), memory_space=pltpu.VMEM
+            ),
+        ],
+        out_shape=[
+            jax.ShapeDtypeStruct(x2.shape, jnp.int8),
+            jax.ShapeDtypeStruct((x2.shape[0], 1), jnp.float32),
+        ],
+        interpret=_use_interpret(),
+    )(x2)
+    return q[:rows], scales[:rows], shape
+
+
+def dequantize_blockwise(
+    q: jax.Array,
+    scales: jax.Array,
+    shape: Tuple[int, ...],
+    dtype=jnp.float32,
+) -> jax.Array:
+    rows, block_size = q.shape
+    row_pad = (-rows) % _ROWS
+    if row_pad:
+        q = jnp.pad(q, ((0, row_pad), (0, 0)))
+        scales = jnp.pad(scales, ((0, row_pad), (0, 0)))
+    grid = q.shape[0] // _ROWS
+    out = pl.pallas_call(
+        _dequantize_kernel,
+        grid=(grid,),
+        in_specs=[
+            pl.BlockSpec(
+                (_ROWS, block_size), lambda i: (i, 0),
+                memory_space=pltpu.VMEM,
+            ),
+            pl.BlockSpec(
+                (_ROWS, 1), lambda i: (i, 0), memory_space=pltpu.VMEM
+            ),
+        ],
+        out_specs=pl.BlockSpec(
+            (_ROWS, block_size), lambda i: (i, 0),
+            memory_space=pltpu.VMEM,
+        ),
+        out_shape=jax.ShapeDtypeStruct(q.shape, dtype),
+        interpret=_use_interpret(),
+    )(q, scales)
+    n = 1
+    for s in shape:
+        n *= s
+    return out[:rows].reshape(-1)[:n].reshape(shape)
+
+
+# ---------------------------------------------------------------------------
+# jnp reference (ground truth for tests; also handles tiny arrays)
+# ---------------------------------------------------------------------------
+
+
+def quantize_blockwise_ref(x, block_size: int = DEFAULT_BLOCK):
+    shape = x.shape
+    flat = x.reshape(-1).astype(jnp.float32)
+    pad = (-flat.size) % block_size
+    if pad:
+        flat = jnp.pad(flat, (0, pad))
+    x2 = flat.reshape(-1, block_size)
+    scale = jnp.max(jnp.abs(x2), axis=1, keepdims=True) / 127.0
+    safe = jnp.maximum(scale, 1e-30)
+    q = jnp.clip(jnp.round(x2 / safe), -127, 127).astype(jnp.int8)
+    return q, scale, shape
+
+
+def dequantize_blockwise_ref(q, scales, shape, dtype=jnp.float32):
+    out = q.astype(jnp.float32) * scales
+    n = 1
+    for s in shape:
+        n *= s
+    return out.reshape(-1)[:n].reshape(shape).astype(dtype)

@@ -1,0 +1,13 @@
+"""Optimizers (optax-native).
+
+TPU-native re-implementations of the reference's optimizer suite
+(atorch/optimizers/: AGD agd.py:19, WSAM wsam.py:11, low-bit
+optimizers low_bit/ backed by the CUDA quantization ops). Here they
+are pure optax transformations / jittable step wrappers — no parameter
+mutation, no process groups; gradient averaging is whatever psum the
+surrounding pjit inserts.
+"""
+
+from dlrover_tpu.optim.agd import agd, scale_by_agd  # noqa: F401
+from dlrover_tpu.optim.low_bit import adam_8bit  # noqa: F401
+from dlrover_tpu.optim.wsam import WeightedSAM  # noqa: F401

@@ -1,0 +1,141 @@
+"""AGD optimizer (NeurIPS'23) as an optax transformation.
+
+"AGD: an Auto-switchable Optimizer using Stepwise Gradient Difference
+as Preconditioning Matrix" — behavioral parity with the reference's
+torch implementation (atorch/optimizers/agd.py:19-157, update rule
+:120-156), re-stated functionally:
+
+    m_t   = b1 m_{t-1} + (1-b1) g_t
+    u_t   = m_t/(1-b1^t) - m_{t-1}/(1-b1^{t-1})      (u_1 = m_1/(1-b1))
+    v_t   = b2 v_{t-1} + (1-b2) u_t^2
+    denom = max(sqrt(v_t  or amsgrad-max), delta*sqrt(1-b2^t))
+    p_t   = p_{t-1}(1 - lr*wd) - lr*sqrt(1-b2^t)/(1-b1^t) * m_t/denom
+
+The reference claims up to 1.5x faster convergence than AdamW on
+nanoGPT (atorch/docs/README-AGD.md:29, BASELINE.md) — the test suite
+checks AGD beats AdamW on a quadratic benchmark.
+"""
+
+from __future__ import annotations
+
+from typing import NamedTuple, Optional, Tuple
+
+import chex
+import jax
+import jax.numpy as jnp
+import optax
+
+
+class ScaleByAGDState(NamedTuple):
+    count: chex.Array
+    exp_avg: chex.ArrayTree
+    exp_avg_sq: chex.ArrayTree
+    max_exp_avg_sq: Optional[chex.ArrayTree]
+
+
+def scale_by_agd(
+    b1: float = 0.9,
+    b2: float = 0.999,
+    delta: float = 1e-5,
+    amsgrad: bool = False,
+    clip: Optional[float] = None,
+) -> optax.GradientTransformation:
+    """The preconditioning core: g -> sqrt(bc2)/bc1 * m/denom.
+
+    (Learning rate and weight decay are composed on top in :func:`agd`.)
+    """
+
+    def init_fn(params):
+        zeros = jax.tree.map(
+            lambda p: jnp.zeros_like(p, dtype=jnp.float32), params
+        )
+        return ScaleByAGDState(
+            count=jnp.zeros([], jnp.int32),
+            exp_avg=zeros,
+            exp_avg_sq=jax.tree.map(jnp.zeros_like, zeros),
+            max_exp_avg_sq=(
+                jax.tree.map(jnp.zeros_like, zeros) if amsgrad else None
+            ),
+        )
+
+    def update_fn(updates, state, params=None):
+        del params
+        count = state.count + 1
+        t = count.astype(jnp.float32)
+        bc1_old = 1.0 - b1 ** (t - 1.0)
+        bc1 = 1.0 - b1**t
+        bc2 = 1.0 - b2**t
+
+        m_old = state.exp_avg
+        m_new = jax.tree.map(
+            lambda m, g: b1 * m + (1.0 - b1) * g.astype(jnp.float32),
+            m_old,
+            updates,
+        )
+        # Stepwise gradient difference preconditioner. At t=1 the
+        # previous bias correction divides by zero; the reference
+        # special-cases it to m_1/bc1 — jnp.where keeps it jittable.
+        safe_bc1_old = jnp.where(count == 1, 1.0, bc1_old)
+        u = jax.tree.map(
+            lambda mn, mo: jnp.where(
+                count == 1,
+                mn / bc1,
+                mn / bc1 - mo / safe_bc1_old,
+            ),
+            m_new,
+            m_old,
+        )
+        v_new = jax.tree.map(
+            lambda v, uu: b2 * v + (1.0 - b2) * uu * uu,
+            state.exp_avg_sq,
+            u,
+        )
+        if amsgrad:
+            max_v = jax.tree.map(
+                jnp.maximum, state.max_exp_avg_sq, v_new
+            )
+            denom_src = max_v
+        else:
+            max_v = None
+            denom_src = v_new
+
+        delta_adjust = delta * jnp.sqrt(bc2)
+
+        def precond(mn, v):
+            denom = jnp.maximum(jnp.sqrt(v), delta_adjust)
+            out = mn / denom
+            if clip is not None:
+                out = jnp.clip(out, -clip, clip)
+            return out * (jnp.sqrt(bc2) / bc1)
+
+        out = jax.tree.map(precond, m_new, denom_src)
+        return out, ScaleByAGDState(
+            count=count,
+            exp_avg=m_new,
+            exp_avg_sq=v_new,
+            max_exp_avg_sq=max_v,
+        )
+
+    return optax.GradientTransformation(init_fn, update_fn)
+
+
+def agd(
+    learning_rate: optax.ScalarOrSchedule = 1e-3,
+    betas: Tuple[float, float] = (0.9, 0.999),
+    delta: float = 1e-5,
+    weight_decay: float = 0.0,
+    amsgrad: bool = False,
+    clip: Optional[float] = None,
+) -> optax.GradientTransformation:
+    """Full AGD with decoupled weight decay (the reference default,
+    weight_decouple=True fixed_decay=False: p *= 1 - lr*wd)."""
+    tx = [
+        scale_by_agd(
+            b1=betas[0], b2=betas[1], delta=delta,
+            amsgrad=amsgrad, clip=clip,
+        )
+    ]
+    if weight_decay:
+        tx.append(optax.add_decayed_weights(weight_decay))
+    tx.append(optax.scale_by_learning_rate(learning_rate))
+    return optax.chain(*tx)

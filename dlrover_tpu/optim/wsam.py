@@ -1,0 +1,136 @@
+"""Weighted Sharpness-Aware Minimization (KDD'23), JAX-native.
+
+Parity with the reference's torch WeightedSAM
+(atorch/optimizers/wsam.py:11-140): two forward/backward passes per
+step — climb to w+e(w) along the normalized gradient (first_step :50),
+take the base-optimizer step using the sharpness-weighted gradient
+(second_step :74) — with ``decouple=True`` applying the sharpness term
+as a separate additive correction.
+
+The torch version needs DDP no_sync + allreduce choreography; under
+pjit both gradient evaluations are just calls of the same compiled
+grad function, and any data-parallel averaging is already inside it.
+The whole two-pass step is one jittable function.
+"""
+
+from __future__ import annotations
+
+from typing import Callable, NamedTuple, Optional
+
+import chex
+import jax
+import jax.numpy as jnp
+import optax
+
+
+def _global_norm(tree) -> jax.Array:
+    return jnp.sqrt(
+        sum(
+            jnp.sum(jnp.square(x.astype(jnp.float32)))
+            for x in jax.tree.leaves(tree)
+        )
+    )
+
+
+class WSAMState(NamedTuple):
+    base_state: chex.ArrayTree
+
+
+class WeightedSAM:
+    """Wraps a base optax optimizer with the WSAM two-pass step.
+
+    Parameters mirror the reference: rho (perturbation radius), gamma
+    (sharpness weight; alpha = gamma/(1-gamma)), adaptive (scale the
+    perturbation by |p|, ASAM-style), decouple (sharpness as decoupled
+    correction), max_norm (grad clipping before each use).
+
+    Use ``make_step(grad_fn)`` where grad_fn(params, *batch) ->
+    (loss, grads); the returned function is jit-compatible:
+
+        step = jax.jit(wsam.make_step(jax.value_and_grad(loss_fn)))
+        params, state, loss = step(params, state, batch...)
+    """
+
+    def __init__(
+        self,
+        base_optimizer: optax.GradientTransformation,
+        rho: float = 0.05,
+        gamma: float = 0.9,
+        sam_eps: float = 1e-12,
+        adaptive: bool = False,
+        decouple: bool = True,
+        max_norm: Optional[float] = None,
+        learning_rate: Optional[float] = None,
+    ):
+        self.base = base_optimizer
+        self.rho = rho
+        self.alpha = gamma / (1.0 - gamma)
+        self.sam_eps = sam_eps
+        self.adaptive = adaptive
+        self.decouple = decouple
+        self.max_norm = max_norm
+        # The decoupled correction needs the base lr (ref second_step
+        # uses group["lr"]); optax hides it inside the chain, so it is
+        # passed explicitly when decouple=True.
+        self.learning_rate = learning_rate
+        if decouple and learning_rate is None:
+            raise ValueError(
+                "decouple=True needs learning_rate= (the reference "
+                "reads it from the param group)"
+            )
+
+    def init(self, params) -> WSAMState:
+        return WSAMState(base_state=self.base.init(params))
+
+    def _clip(self, grads):
+        if self.max_norm is None:
+            return grads
+        norm = _global_norm(grads)
+        scale = jnp.minimum(1.0, self.max_norm / (norm + 1e-12))
+        return jax.tree.map(lambda g: g * scale, grads)
+
+    def make_step(
+        self, grad_fn: Callable
+    ) -> Callable:
+        def step(params, state: WSAMState, *batch):
+            loss, g1 = grad_fn(params, *batch)
+            g1 = self._clip(g1)
+            # -- first step: climb to the local maximum w + e(w)
+            gnorm = _global_norm(g1)
+            scale = self.rho / (gnorm + self.sam_eps)
+            if self.adaptive:
+                e_w = jax.tree.map(
+                    lambda p, g: jnp.square(p) * g * scale, params, g1
+                )
+            else:
+                e_w = jax.tree.map(lambda g: g * scale, g1)
+            perturbed = jax.tree.map(jnp.add, params, e_w)
+            # -- second gradient at the perturbed point
+            _, g2 = grad_fn(perturbed, *batch)
+            g2 = self._clip(g2)
+
+            if self.decouple:
+                sharpness = jax.tree.map(jnp.subtract, g2, g1)
+                updates, base_state = self.base.update(
+                    g1, state.base_state, params
+                )
+                new_params = optax.apply_updates(params, updates)
+                new_params = jax.tree.map(
+                    lambda p, s: p
+                    - self.learning_rate * self.alpha * s,
+                    new_params,
+                    sharpness,
+                )
+            else:
+                mixed = jax.tree.map(
+                    lambda a, b: self.alpha * b + (1.0 - self.alpha) * a,
+                    g1,
+                    g2,
+                )
+                updates, base_state = self.base.update(
+                    mixed, state.base_state, params
+                )
+                new_params = optax.apply_updates(params, updates)
+            return new_params, WSAMState(base_state=base_state), loss
+
+        return step

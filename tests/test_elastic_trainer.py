@@ -1,0 +1,174 @@
+"""ElasticTrainer fixed-global-batch semantics + checkpointable sampler."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import optax
+import pytest
+from jax.sharding import Mesh
+
+from dlrover_tpu.parallel.mesh import MeshConfig, build_mesh
+from dlrover_tpu.trainer.elastic_trainer import (
+    ElasticDataLoader,
+    ElasticDistributedSampler,
+    ElasticTrainer,
+    gradient_accumulation_steps,
+)
+
+
+def _mesh(data=4):
+    return build_mesh(MeshConfig(data=data), devices=jax.devices()[:data])
+
+
+def _linear_loss(params, x, y):
+    pred = x @ params["w"] + params["b"]
+    return jnp.mean((pred - y) ** 2)
+
+
+def _toy_data(n=64, d=8, seed=0):
+    rng = np.random.default_rng(seed)
+    x = rng.normal(size=(n, d)).astype(np.float32)
+    w_true = rng.normal(size=(d, 1)).astype(np.float32)
+    y = x @ w_true + 0.01 * rng.normal(size=(n, 1)).astype(np.float32)
+    return x, y
+
+
+def test_accum_steps_keeps_global_batch():
+    # 8 shards x micro 4 = 32/step -> accum 4 for global 128
+    assert gradient_accumulation_steps(128, 4, 8) == 4
+    # losing half the shards doubles accumulation, global stays 128
+    assert gradient_accumulation_steps(128, 4, 4) == 8
+    # non-divisible rounds UP (effective batch never shrinks)
+    assert gradient_accumulation_steps(100, 4, 8) == 4
+
+
+def test_accumulated_step_equals_big_batch_step():
+    """accum microbatches must produce the same update as one big
+    batch (the whole point of fixed-global-batch elasticity)."""
+    x, y = _toy_data(32)
+    params = {"w": jnp.zeros((8, 1)), "b": jnp.zeros((1,))}
+    opt = optax.sgd(0.1)
+
+    mesh = _mesh(2)
+    tr = ElasticTrainer(
+        mesh, _linear_loss, opt, global_batch_size=32, micro_batch_size=4
+    )
+    assert tr.accum_steps == 4
+    p1, _, loss1 = tr.train_step(
+        params, opt.init(params), jnp.asarray(x), jnp.asarray(y)
+    )
+
+    # one big-batch step on the same data
+    loss_big, grads = jax.value_and_grad(_linear_loss)(
+        params, jnp.asarray(x), jnp.asarray(y)
+    )
+    updates, _ = opt.update(grads, opt.init(params), params)
+    p2 = optax.apply_updates(params, updates)
+
+    np.testing.assert_allclose(loss1, loss_big, rtol=1e-5)
+    for a, b in zip(jax.tree.leaves(p1), jax.tree.leaves(p2)):
+        np.testing.assert_allclose(a, b, rtol=1e-5, atol=1e-6)
+
+
+def test_world_shrink_same_global_batch():
+    """4-shard and 2-shard trainers apply the same global batch and
+    produce the same parameters."""
+    x, y = _toy_data(32)
+    params = {"w": jnp.zeros((8, 1)), "b": jnp.zeros((1,))}
+    opt = optax.sgd(0.1)
+
+    results = []
+    for shards in (4, 2):
+        tr = ElasticTrainer(
+            _mesh(shards),
+            _linear_loss,
+            opt,
+            global_batch_size=32,
+            micro_batch_size=4,
+        )
+        assert tr.samples_per_step == 32
+        p, _, _ = tr.train_step(
+            params, opt.init(params), jnp.asarray(x), jnp.asarray(y)
+        )
+        results.append(p)
+    for a, b in zip(jax.tree.leaves(results[0]), jax.tree.leaves(results[1])):
+        np.testing.assert_allclose(a, b, rtol=1e-5, atol=1e-6)
+
+
+def test_training_converges():
+    x, y = _toy_data(64)
+    params = {"w": jnp.zeros((8, 1)), "b": jnp.zeros((1,))}
+    opt = optax.adam(0.05)
+    tr = ElasticTrainer(
+        _mesh(2), _linear_loss, opt, global_batch_size=64,
+        micro_batch_size=8,
+    )
+    opt_state = opt.init(params)
+    losses = []
+    for _ in range(30):
+        params, opt_state, loss = tr.train_step(
+            params, opt_state, jnp.asarray(x), jnp.asarray(y)
+        )
+        losses.append(float(loss))
+    assert losses[-1] < losses[0] * 0.1
+
+
+# -- sampler ---------------------------------------------------------------
+
+
+def test_sampler_shards_partition_epoch():
+    samplers = [
+        ElasticDistributedSampler(100, num_shards=4, shard_rank=r, seed=7)
+        for r in range(4)
+    ]
+    seen = []
+    for s in samplers:
+        seen.extend(list(s))
+    assert len(seen) == 100  # padded 100->100 (divisible)
+    assert sorted(seen) == sorted(set(seen))
+
+
+def test_sampler_resume_after_world_change_no_replay():
+    """Consume 40 samples on 4 shards, checkpoint, resume on 2 shards:
+    the union of samples seen must cover the epoch exactly once."""
+    first = [
+        ElasticDistributedSampler(96, num_shards=4, shard_rank=r, seed=3)
+        for r in range(4)
+    ]
+    seen = []
+    iters = [iter(s) for s in first]
+    for _ in range(10):  # 10 rounds x 4 shards = 40 samples
+        for it in iters:
+            seen.append(next(it))
+    state = first[0].state_dict()
+    assert state["consumed"] == 40
+
+    resumed = []
+    for r in range(2):
+        s = ElasticDistributedSampler(96, num_shards=2, shard_rank=r, seed=3)
+        s.load_state_dict(state)
+        resumed.extend(list(s))
+    total = seen + resumed
+    assert sorted(total) == list(range(96))
+
+
+def test_sampler_reshuffles_by_epoch():
+    s = ElasticDistributedSampler(50, num_shards=1, shard_rank=0, seed=1)
+    e0 = list(s)
+    s.set_epoch(1)
+    e1 = list(s)
+    assert e0 != e1
+    assert sorted(e0) == sorted(e1)
+
+
+def test_dataloader_batches():
+    data = np.arange(40, dtype=np.float32).reshape(20, 2)
+    sampler = ElasticDistributedSampler(
+        20, num_shards=2, shard_rank=0, shuffle=False
+    )
+    dl = ElasticDataLoader(data, batch_size=5, sampler=sampler)
+    batches = list(dl)
+    assert len(batches) == 2
+    assert batches[0].shape == (5, 2)
+    # shard 0 takes even positions when unshuffled
+    np.testing.assert_array_equal(batches[0][:, 0], [0, 4, 8, 12, 16])

@@ -1,0 +1,214 @@
+"""Optimizer suite: AGD, WSAM, 8-bit Adam + quantization kernels."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import optax
+import pytest
+
+from dlrover_tpu.ops.quantization import (
+    dequantize_blockwise,
+    dequantize_blockwise_ref,
+    quantize_blockwise,
+    quantize_blockwise_ref,
+)
+from dlrover_tpu.optim import WeightedSAM, adam_8bit, agd
+from dlrover_tpu.optim.low_bit import optimizer_state_bytes
+
+
+def _rosenbrock(p):
+    x, y = p["x"], p["y"]
+    return (1.0 - x) ** 2 + 100.0 * (y - x**2) ** 2
+
+
+def _quadratic_problem(d=32, seed=0):
+    rng = np.random.default_rng(seed)
+    diag = jnp.asarray(rng.uniform(0.1, 10.0, size=d), jnp.float32)
+    target = jnp.asarray(rng.normal(size=d), jnp.float32)
+
+    def loss(params):
+        return 0.5 * jnp.sum(diag * (params["w"] - target) ** 2)
+
+    return loss, {"w": jnp.zeros(d)}
+
+
+# -- quantization kernels ---------------------------------------------------
+
+
+@pytest.mark.parametrize("shape", [(4096,), (1000,), (64, 80), (3, 7, 11)])
+def test_quantize_roundtrip_matches_ref(shape):
+    x = jnp.asarray(
+        np.random.default_rng(0).normal(size=shape), jnp.float32
+    )
+    q, s, sh = quantize_blockwise(x, block_size=256)
+    qr, sr, _ = quantize_blockwise_ref(x, block_size=256)
+    np.testing.assert_array_equal(q, qr)
+    np.testing.assert_allclose(s, sr, rtol=1e-6)
+    out = dequantize_blockwise(q, s, sh)
+    ref = dequantize_blockwise_ref(qr, sr, sh)
+    np.testing.assert_allclose(out, ref, rtol=1e-6)
+    # quantization error bounded by scale/2 per element
+    err = np.abs(np.asarray(out - x))
+    bound = np.max(np.abs(np.asarray(x))) / 127.0
+    assert err.max() <= bound + 1e-6
+
+
+def test_quantize_under_jit():
+    x = jnp.ones((2048,), jnp.float32) * 3.0
+
+    @jax.jit
+    def roundtrip(x):
+        q, s, sh = quantize_blockwise(x, 512)
+        return dequantize_blockwise(q, s, sh)
+
+    np.testing.assert_allclose(roundtrip(x), x, rtol=1e-2)
+
+
+# -- AGD --------------------------------------------------------------------
+
+
+def test_agd_converges_rosenbrock():
+    params = {"x": jnp.asarray(-1.0), "y": jnp.asarray(1.5)}
+    opt = agd(learning_rate=0.05)
+    state = opt.init(params)
+    step = jax.jit(
+        lambda p, s: _agd_step(opt, p, s)
+    )
+    for _ in range(1500):
+        params, state = step(params, state)
+    assert float(_rosenbrock(params)) < 1e-2
+
+
+def _agd_step(opt, p, s):
+    g = jax.grad(_rosenbrock)(p)
+    u, s = opt.update(g, s, p)
+    return optax.apply_updates(p, u), s
+
+
+def test_agd_beats_adamw_on_quadratic():
+    """BASELINE.md: AGD converges up to 1.5x faster than AdamW on
+    nanoGPT; check direction on an ill-conditioned quadratic."""
+    loss, p0 = _quadratic_problem()
+
+    def run(opt, n=200):
+        p, s = dict(p0), opt.init(p0)
+        for _ in range(n):
+            g = jax.grad(loss)(p)
+            u, s = opt.update(g, s, p)
+            p = optax.apply_updates(p, u)
+        return float(loss(p))
+
+    final_agd = run(agd(learning_rate=0.1))
+    final_adamw = run(optax.adamw(0.1))
+    assert final_agd < final_adamw
+
+
+def test_agd_weight_decay_shrinks_params():
+    p = {"w": jnp.ones(4)}
+    opt = agd(learning_rate=0.1, weight_decay=0.5)
+    s = opt.init(p)
+    u, s = opt.update({"w": jnp.zeros(4)}, s, p)
+    p2 = optax.apply_updates(p, u)
+    assert float(jnp.max(p2["w"])) < 1.0
+
+
+# -- WSAM -------------------------------------------------------------------
+
+
+def test_wsam_decouple_converges():
+    loss, p0 = _quadratic_problem(d=16)
+    wsam = WeightedSAM(
+        optax.sgd(0.05), rho=0.05, gamma=0.9, learning_rate=0.05
+    )
+    state = wsam.init(p0)
+    step = jax.jit(wsam.make_step(jax.value_and_grad(loss)))
+    p = p0
+    losses = []
+    for _ in range(300):
+        p, state, l = step(p, state)
+        losses.append(float(l))
+    # SAM-family optimizers orbit the minimum at radius ~rho, so check
+    # strong relative decrease rather than an absolute floor.
+    assert losses[-1] < losses[0] * 0.01
+
+
+def test_wsam_coupled_mode():
+    loss, p0 = _quadratic_problem(d=16)
+    wsam = WeightedSAM(
+        optax.sgd(0.05), rho=0.05, gamma=0.9, decouple=False
+    )
+    state = wsam.init(p0)
+    step = jax.jit(wsam.make_step(jax.value_and_grad(loss)))
+    p, state, _ = step(p0, state)
+    # moved toward target
+    assert float(loss(p)) < float(loss(p0))
+
+
+def test_wsam_decouple_requires_lr():
+    with pytest.raises(ValueError):
+        WeightedSAM(optax.sgd(0.1), decouple=True)
+
+
+def test_wsam_rho_zero_equals_base():
+    """With rho=0 the perturbation vanishes; decoupled sharpness term
+    is zero, so WSAM == base optimizer exactly."""
+    loss, p0 = _quadratic_problem(d=8)
+    base = optax.sgd(0.1)
+    wsam = WeightedSAM(base, rho=0.0, gamma=0.9, learning_rate=0.1)
+    step = wsam.make_step(jax.value_and_grad(loss))
+    p_wsam, _, _ = step(p0, wsam.init(p0))
+
+    g = jax.grad(loss)(p0)
+    u, _ = base.update(g, base.init(p0), p0)
+    p_base = optax.apply_updates(p0, u)
+    np.testing.assert_allclose(
+        p_wsam["w"], p_base["w"], rtol=1e-5, atol=1e-7
+    )
+
+
+# -- 8-bit Adam -------------------------------------------------------------
+
+
+def test_adam8bit_tracks_adam():
+    loss, p0 = _quadratic_problem(d=4096)
+    opt8 = adam_8bit(learning_rate=0.1, min_quantize_size=1024)
+    opt32 = optax.adam(0.1)
+
+    def run(opt):
+        p, s = dict(p0), opt.init(p0)
+        step = jax.jit(
+            lambda p, s: _opt_step(opt, loss, p, s)
+        )
+        for _ in range(100):
+            p, s = step(p, s)
+        return float(loss(p))
+
+    f8, f32 = run(opt8), run(opt32)
+    # Both collapse the loss by >3 orders of magnitude; the 8-bit
+    # variant is allowed a quantization-noise lag behind exact Adam.
+    assert f8 < float(loss(p0)) * 1e-3
+    assert f32 < float(loss(p0)) * 1e-3
+    assert f8 < f32 * 50
+
+
+def _opt_step(opt, loss, p, s):
+    g = jax.grad(loss)(p)
+    u, s = opt.update(g, s, p)
+    return optax.apply_updates(p, u), s
+
+
+def test_adam8bit_memory_savings():
+    p = {"big": jnp.zeros(65536), "small": jnp.zeros(16)}
+    opt = adam_8bit(min_quantize_size=1024)
+    s = opt.init(p)
+    actual, f32_equiv = optimizer_state_bytes(s)
+    # int8 moments + scales ~ 1/4 the f32 footprint for the big leaf
+    assert actual < f32_equiv * 0.35
+
+
+def test_adam8bit_small_leaves_stay_f32():
+    p = {"small": jnp.zeros(16)}
+    opt = adam_8bit(min_quantize_size=1024)
+    s = opt.init(p)
+    inner = s[0]  # chain: (Adam8bitState, decay..., lr scale)
+    assert inner.mu["small"].dtype == jnp.float32

@@ -227,3 +227,37 @@ def test_oom_stderr_classifies_and_escalates():
     assert action == "relaunch_node"
     # The tracked node is now the pending replacement incarnation.
     assert jm.get_node(0).status == "pending"
+
+
+def test_oom_classifier_word_boundary():
+    """'oom_score_adj' in a procfs dump must not classify as OOM, while
+    the real killer spellings must."""
+    jm = JobManager()
+    assert jm.classify_exit("oom_score_adj: 1000", "process_error") != "oom"
+    assert jm.classify_exit("OOMKilled", "process_error") == "oom"
+    assert jm.classify_exit("oom-killer invoked", "process_error") == "oom"
+    assert jm.classify_exit("killed by oom", "process_error") == "oom"
+
+
+def test_stale_heartbeat_does_not_revive_pending_replacement():
+    """A last-gasp heartbeat from the agent being replaced lands right
+    after the relaunch; it must not flip the fresh PENDING node to
+    RUNNING (that would defeat the pending timeout and the duplicate-
+    report guard)."""
+    jm = JobManager()
+    jm.register_node(node_id=0)
+    action = jm.handle_failure_report(
+        0, "CUDA out of memory", "process_error", 0
+    )
+    assert action == "relaunch_node"
+    assert jm.get_node(0).status == "pending"
+    # In-flight beat from the dying agent arrives immediately.
+    jm.update_heartbeat(0)
+    jm.check_nodes_once()
+    assert jm.get_node(0).status == "pending"
+    # An agent still beating past the grace window is genuinely alive
+    # (lost-response restart-in-place case) and does recover the node.
+    jm.get_node(0).create_time -= jm.PENDING_HEARTBEAT_GRACE + 1
+    jm.update_heartbeat(0)
+    jm.check_nodes_once()
+    assert jm.get_node(0).status == "running"
