@@ -1,0 +1,23 @@
+"""Auto-acceleration: strategy search over mesh/sharding/remat/dtype.
+
+TPU-native re-conception of atorch's auto_accelerate stack
+(atorch/auto/: accelerate.py:401 API, engine/ gRPC strategy service,
+opt_lib/ 13 wrapper-based optimization methods, analyser, dry_runner).
+The torch version searches over *wrapper combinations* (fsdp, zero,
+amp, checkpoint, tensor/pipeline parallel...) coordinated by a rank-0
+gRPC engine; under JAX's single-controller SPMD the same search is a
+plain in-process loop, and every "method" collapses into one object:
+
+    Strategy = mesh shape x sharding rules x remat policy x dtype
+               x optimizer choice x microbatch size
+
+because GSPMD turns all of DP/FSDP/TP/SP/EP/PP into sharding
+annotations on one jitted function.
+"""
+
+from dlrover_tpu.accelerate.api import (  # noqa: F401
+    AccelerateResult,
+    auto_accelerate,
+)
+from dlrover_tpu.accelerate.strategy import Strategy  # noqa: F401
+from dlrover_tpu.accelerate.analyser import analyse_model  # noqa: F401

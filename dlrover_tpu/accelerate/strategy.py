@@ -1,0 +1,93 @@
+"""Strategy: the unit of acceleration search.
+
+The whole optimization space of the reference's opt_lib (13 methods,
+atorch/auto/opt_lib/optimization_library.py:38-56) maps to this one
+record: zero1/2/3+fsdp -> the ``fsdp`` mesh axis; tensor_parallel ->
+``tensor``; pipeline_parallel -> ``pipe``; sequence parallel ->
+``seq``; amp_native/half -> dtype policy; checkpoint -> remat policy;
+module_replace (flash-attn swap) -> the model's attention config.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import itertools
+import json
+from typing import Dict, List, Optional, Tuple
+
+
+@dataclasses.dataclass(frozen=True)
+class Strategy:
+    mesh_shape: Tuple[Tuple[str, int], ...]  # (("data",4),("fsdp",2),...)
+    remat: bool = True
+    dtype: str = "bfloat16"  # compute/weights dtype policy
+    optimizer: str = "adamw"  # adamw | agd | adam8bit
+    micro_batch_size: int = 8
+
+    @property
+    def mesh_dict(self) -> Dict[str, int]:
+        return dict(self.mesh_shape)
+
+    def name(self) -> str:
+        mesh = "x".join(f"{a}{s}" for a, s in self.mesh_shape if s > 1)
+        return (
+            f"{mesh or 'single'}-{self.dtype}"
+            f"-{'remat' if self.remat else 'noremat'}-{self.optimizer}"
+            f"-mb{self.micro_batch_size}"
+        )
+
+    def to_json(self) -> str:
+        return json.dumps(dataclasses.asdict(self))
+
+    @staticmethod
+    def from_json(s: str) -> "Strategy":
+        d = json.loads(s)
+        d["mesh_shape"] = tuple(
+            (a, int(n)) for a, n in d["mesh_shape"]
+        )
+        return Strategy(**d)
+
+
+def _factorizations(n: int, n_axes: int) -> List[Tuple[int, ...]]:
+    """All ways to write n as an ordered product of n_axes factors."""
+    if n_axes == 1:
+        return [(n,)]
+    out = []
+    for f in range(1, n + 1):
+        if n % f == 0:
+            for rest in _factorizations(n // f, n_axes - 1):
+                out.append((f,) + rest)
+    return out
+
+
+def candidate_strategies(
+    n_devices: int,
+    axes: Tuple[str, ...] = ("data", "fsdp", "tensor"),
+    micro_batch_sizes: Tuple[int, ...] = (8,),
+    dtypes: Tuple[str, ...] = ("bfloat16",),
+    optimizers: Tuple[str, ...] = ("adamw",),
+    remats: Tuple[bool, ...] = (True,),
+    max_tensor: int = 8,
+) -> List[Strategy]:
+    """Enumerate the raw candidate grid (the reference's
+    CombinationAlgorithm, auto/engine/sg_algo/combination_sg.py:16);
+    the analyser prunes it before any dry-run."""
+    out = []
+    for factors in _factorizations(n_devices, len(axes)):
+        shape = tuple(zip(axes, factors))
+        d = dict(shape)
+        if d.get("tensor", 1) > max_tensor:
+            continue
+        for mb, dt, opt, rm in itertools.product(
+            micro_batch_sizes, dtypes, optimizers, remats
+        ):
+            out.append(
+                Strategy(
+                    mesh_shape=shape,
+                    remat=rm,
+                    dtype=dt,
+                    optimizer=opt,
+                    micro_batch_size=mb,
+                )
+            )
+    return out

@@ -328,8 +328,23 @@ class ElasticAgent:
             target=self._heartbeat_loop, daemon=True
         )
         heartbeat.start()
-        result = self._invoke_run()
-        self._stop.set()
+        # Telemetry to the master: node resources + training progress
+        # (ref elastic_agent/monitor/{resource,training}.py).
+        from dlrover_tpu.agent.monitor import (
+            ResourceMonitor,
+            TrainingMonitor,
+        )
+
+        res_mon = ResourceMonitor(self.client)
+        train_mon = TrainingMonitor(self.client)
+        res_mon.start()
+        train_mon.start()
+        try:
+            result = self._invoke_run()
+        finally:
+            res_mon.stop()
+            train_mon.stop()
+            self._stop.set()
         return result
 
     def _invoke_run(self) -> int:

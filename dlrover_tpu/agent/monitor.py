@@ -1,0 +1,154 @@
+"""Agent-side monitors: node resources and training progress.
+
+Parity with the reference's agent monitors
+(dlrover/python/elastic_agent/monitor/resource.py:90 ResourceMonitor —
+psutil + pynvml telemetry pushed to the master; monitor/training.py:79
+TorchTrainingMonitor — global-step reports feeding the master's speed
+monitor). TPU adaptation: chip telemetry comes from JAX's
+``local_devices()[i].memory_stats()`` (HBM in use) instead of pynvml,
+and the training side reads the metrics file the trainer process
+writes (same file-drop mechanism as the reference's
+ConfigPath.RUNTIME_METRICS).
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import threading
+import time
+from typing import Optional
+
+from dlrover_tpu.common.log import get_logger
+
+logger = get_logger("agent_monitor")
+
+METRICS_FILE_ENV = "DLROVER_TPU_METRICS_FILE"
+DEFAULT_METRICS_FILE = "/tmp/dlrover_tpu_train_metrics.json"
+
+
+def current_resource_stats() -> dict:
+    """One sample of host + TPU utilization."""
+    stats = {
+        "cpu_percent": 0.0,
+        "memory_mb": 0,
+        "hbm_used_gb": 0.0,
+        "duty_cycle": 0.0,
+    }
+    try:
+        import psutil
+
+        stats["cpu_percent"] = psutil.cpu_percent(interval=None)
+        stats["memory_mb"] = int(
+            psutil.Process().memory_info().rss / (1 << 20)
+        )
+    except Exception:  # noqa: BLE001 — psutil optional
+        pass
+    try:
+        import jax
+
+        hbm = 0
+        for dev in jax.local_devices():
+            ms = dev.memory_stats() or {}
+            hbm += ms.get("bytes_in_use", 0)
+        stats["hbm_used_gb"] = hbm / (1 << 30)
+    except Exception:  # noqa: BLE001 — no device / not initialized
+        pass
+    return stats
+
+
+class ResourceMonitor:
+    """Samples resources and reports them to the master."""
+
+    def __init__(self, client, interval: float = 30.0):
+        self.client = client
+        self.interval = interval
+        self._stop = threading.Event()
+        self._thread: Optional[threading.Thread] = None
+
+    def start(self) -> None:
+        if self._thread is None:
+            self._thread = threading.Thread(
+                target=self._loop, name="resource-monitor", daemon=True
+            )
+            self._thread.start()
+
+    def stop(self) -> None:
+        self._stop.set()
+
+    def report_once(self) -> dict:
+        stats = current_resource_stats()
+        try:
+            self.client.report_resource(**stats)
+        except Exception:  # noqa: BLE001
+            logger.debug("resource report failed", exc_info=True)
+        return stats
+
+    def _loop(self) -> None:
+        while not self._stop.wait(self.interval):
+            self.report_once()
+
+
+class TrainingMonitor:
+    """Relays the trainer's step metrics file to the master speed
+    monitor (ref TorchTrainingMonitor.report_resource_with_step,
+    elastic_agent/monitor/training.py:79)."""
+
+    def __init__(
+        self,
+        client,
+        metrics_file: Optional[str] = None,
+        interval: float = 15.0,
+    ):
+        self.client = client
+        self.metrics_file = metrics_file or os.getenv(
+            METRICS_FILE_ENV, DEFAULT_METRICS_FILE
+        )
+        self.interval = interval
+        self._last_step = -1
+        self._stop = threading.Event()
+        self._thread: Optional[threading.Thread] = None
+
+    @staticmethod
+    def write_metrics(
+        step: int, tokens: int = 0, path: Optional[str] = None
+    ) -> None:
+        """Called from the TRAINING process each step (cheap: one
+        tmp-file rename)."""
+        path = path or os.getenv(METRICS_FILE_ENV, DEFAULT_METRICS_FILE)
+        tmp = f"{path}.tmp"
+        with open(tmp, "w") as f:
+            json.dump(
+                {"step": step, "tokens": tokens, "ts": time.time()}, f
+            )
+        os.replace(tmp, path)
+
+    def report_once(self) -> Optional[int]:
+        try:
+            with open(self.metrics_file) as f:
+                data = json.load(f)
+        except (OSError, ValueError):
+            return None
+        step = int(data.get("step", -1))
+        if step <= self._last_step:
+            return None
+        self._last_step = step
+        try:
+            self.client.report_step(step, int(data.get("tokens", 0)))
+        except Exception:  # noqa: BLE001
+            logger.debug("step report failed", exc_info=True)
+        return step
+
+    def start(self) -> None:
+        if self._thread is None:
+            self._thread = threading.Thread(
+                target=self._loop, name="training-monitor", daemon=True
+            )
+            self._thread.start()
+
+    def stop(self) -> None:
+        self._stop.set()
+
+    def _loop(self) -> None:
+        while not self._stop.wait(self.interval):
+            self.report_once()

@@ -102,6 +102,9 @@ class Node:
     relaunchable: bool = True
     is_released: bool = False
     exit_reason: str = ""
+    # Why the previous incarnation died (set on the replacement by
+    # _relaunch): lets the auto-scaler grow resources for OOM retries.
+    relaunch_reason: str = ""
     critical: bool = False
     heartbeat_time: float = 0.0
     # Straggler / health flags set by the network-check rendezvous.

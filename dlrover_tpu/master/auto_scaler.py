@@ -1,0 +1,180 @@
+"""Auto-scaler + local resource optimizer.
+
+Capability parity with the reference's resource re-planning layer
+(dlrover/python/master/node/job_auto_scaler.py:40
+``new_job_auto_scaler`` / AllreduceTrainingAutoScaler :254, and
+master/resource/local_optimizer.py:66): periodically compare the
+job's target worker count with what is actually alive, grow OOM'd
+nodes' memory before relaunch, and — for TPU — keep the worker count
+on *slice-compatible* sizes (a v5p slice wants multiples of its host
+count; arbitrary worker counts strand chips).
+
+The Brain remote optimizer of the reference (brain_optimizer.py) is a
+pluggable ResourceOptimizer here; LocalResourceOptimizer is the
+default heuristic (the reference ships the same split).
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+from typing import List, Optional
+
+from dlrover_tpu.common.constants import NodeStatus, NodeType
+from dlrover_tpu.common.log import get_logger
+from dlrover_tpu.common.node import Node, NodeResource
+from dlrover_tpu.master.job_manager import JobManager, ScalePlan
+from dlrover_tpu.master.speed_monitor import SpeedMonitor
+
+logger = get_logger("auto_scaler")
+
+OOM_MEMORY_GROW_FACTOR = 1.5  # ref local_optimizer.py:96 grows OOM pods
+
+
+class ResourceOptimizer:
+    """Strategy seam: local heuristic now, Brain-style remote later."""
+
+    def optimize_oom_node(self, resource: NodeResource) -> NodeResource:
+        raise NotImplementedError
+
+    def target_worker_count(
+        self, current: int, speed_monitor: SpeedMonitor
+    ) -> int:
+        raise NotImplementedError
+
+
+class LocalResourceOptimizer(ResourceOptimizer):
+    def __init__(
+        self,
+        min_workers: int = 1,
+        max_workers: int = 64,
+        hosts_per_slice: int = 1,
+    ):
+        self.min_workers = min_workers
+        self.max_workers = max_workers
+        # TPU slices come in fixed host multiples (v5p-32 = 4 hosts);
+        # scaling to a non-multiple strands chips.
+        self.hosts_per_slice = max(hosts_per_slice, 1)
+
+    def optimize_oom_node(self, resource: NodeResource) -> NodeResource:
+        grown = NodeResource.from_dict(resource.to_dict())
+        grown.memory_mb = int(
+            max(resource.memory_mb, 1024) * OOM_MEMORY_GROW_FACTOR
+        )
+        return grown
+
+    def target_worker_count(
+        self, current: int, speed_monitor: SpeedMonitor
+    ) -> int:
+        target = max(self.min_workers, min(current, self.max_workers))
+        # round DOWN to a slice multiple (never exceed what is alive)
+        target -= target % self.hosts_per_slice
+        return max(target, self.hosts_per_slice)
+
+
+class AllreduceAutoScaler:
+    """Keeps an allreduce (SPMD) job at its target size (ref
+    AllreduceTrainingAutoScaler._periodic_adjust_worker
+    job_auto_scaler.py:288): counts alive workers, asks the scaler for
+    replacements of anything missing, and applies OOM memory growth
+    to relaunch resources."""
+
+    def __init__(
+        self,
+        job_manager: JobManager,
+        speed_monitor: SpeedMonitor,
+        target_workers: int,
+        optimizer: Optional[ResourceOptimizer] = None,
+        interval: float = 30.0,
+    ):
+        self.job_manager = job_manager
+        self.speed_monitor = speed_monitor
+        self.target_workers = target_workers
+        self.optimizer = optimizer or LocalResourceOptimizer()
+        self.interval = interval
+        self._stop = threading.Event()
+        self._thread: Optional[threading.Thread] = None
+
+    def start(self) -> None:
+        if self._thread is None:
+            self._thread = threading.Thread(
+                target=self._loop, name="auto-scaler", daemon=True
+            )
+            self._thread.start()
+
+    def stop(self) -> None:
+        self._stop.set()
+
+    def _loop(self) -> None:
+        while not self._stop.wait(self.interval):
+            try:
+                self.adjust_once()
+            except Exception:  # noqa: BLE001
+                logger.warning("auto-scale pass failed", exc_info=True)
+
+    def grow_oom_resources(self) -> None:
+        """Apply memory growth to nodes that OOM'd before their
+        replacement launches."""
+        for node in self.job_manager.list_nodes(NodeType.WORKER):
+            if (
+                node.relaunch_reason == "oom"
+                and node.status == NodeStatus.PENDING
+                and node.config_resource is not None
+                and not getattr(node, "_oom_grown", False)
+            ):
+                node.config_resource = self.optimizer.optimize_oom_node(
+                    node.config_resource
+                )
+                node._oom_grown = True  # type: ignore[attr-defined]
+                logger.info(
+                    "node %d OOM relaunch memory grown to %dMB",
+                    node.id,
+                    node.config_resource.memory_mb,
+                )
+
+    def adjust_once(self) -> Optional[ScalePlan]:
+        """One pass: replace missing workers up to the slice-aligned
+        target. Returns the plan if one was issued."""
+        self.grow_oom_resources()
+        nodes = self.job_manager.list_nodes(NodeType.WORKER)
+        alive = [n for n in nodes if n.is_alive()]
+        pending = [n for n in nodes if n.status == NodeStatus.PENDING]
+        target = self.optimizer.target_worker_count(
+            self.target_workers, self.speed_monitor
+        )
+        missing = target - len(alive) - len(pending)
+        if missing <= 0:
+            return None
+        used_ids = {n.id for n in nodes}
+        plan = ScalePlan()
+        next_id = max(used_ids, default=-1) + 1
+        template = alive[0] if alive else (nodes[0] if nodes else None)
+        for i in range(missing):
+            resource = (
+                NodeResource.from_dict(
+                    template.config_resource.to_dict()
+                )
+                if template is not None and template.config_resource
+                else NodeResource()
+            )
+            plan.launch_nodes.append(
+                Node(
+                    type=NodeType.WORKER,
+                    id=next_id + i,
+                    rank=next_id + i,
+                    status=NodeStatus.PENDING,
+                    config_resource=resource,
+                )
+            )
+        for node in plan.launch_nodes:
+            self.job_manager.adopt_node(node)
+        self.job_manager.scaler.scale(plan)
+        logger.info(
+            "auto-scaler: %d alive / %d pending of target %d -> "
+            "launching %d",
+            len(alive),
+            len(pending),
+            target,
+            missing,
+        )
+        return plan

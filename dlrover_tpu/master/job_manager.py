@@ -85,7 +85,17 @@ class JobManager:
         # subscribers: fn(node, event_type)
         self._listeners: List[Callable[[Node, str], None]] = []
 
+    @property
+    def scaler(self) -> Scaler:
+        return self._scaler
+
     # -- membership ---------------------------------------------------------
+
+    def adopt_node(self, node: Node) -> None:
+        """Track a node created by the auto-scaler (it will register
+        itself over RPC once its agent starts)."""
+        with self._lock:
+            self._nodes[node.id] = node
 
     def add_listener(self, fn: Callable[[Node, str], None]) -> None:
         self._listeners.append(fn)
@@ -282,6 +292,7 @@ class JobManager:
             config_resource=node.config_resource,
             relaunch_count=node.relaunch_count,
             max_relaunch_count=node.max_relaunch_count,
+            relaunch_reason=node.exit_reason,
         )
         # Track the new incarnation: the failed node is being replaced,
         # so the job is NOT done (all_workers_done must see PENDING).
@@ -290,6 +301,36 @@ class JobManager:
         plan.launch_nodes.append(new_node)
         plan.remove_nodes.append(node)
         self._scaler.scale(plan)
+
+    def handle_node_gone(self, node_id: int, reason: str = "") -> None:
+        """A cluster event (pod failed/deleted/preempted) removed the
+        node out from under us — the agent may never get to report.
+        (ref: _process_event on DELETED, dist_job_manager.py:401)."""
+        with self._lock:
+            node = self._nodes.get(node_id)
+            if node is None or node.status in NodeStatus.TERMINAL:
+                return
+            # The pod-Deleted event for a node we already relaunched
+            # (the scaler removes the old pod as part of the plan)
+            # must not fail the fresh PENDING replacement — same
+            # duplicate guard as handle_failure_report.
+            if node.status == NodeStatus.PENDING:
+                return
+            node.exit_reason = self.classify_exit(
+                reason, TrainingExceptionLevel.PROCESS_ERROR
+            )
+            if "preempt" in (reason or "").lower():
+                node.exit_reason = NodeExitReason.PREEMPTED
+            node.update_status(NodeStatus.FAILED)
+            relaunch = node.should_relaunch()
+            if relaunch:
+                node.inc_relaunch_count()
+        logger.warning(
+            "node %d gone (%s); relaunch=%s", node_id, reason, relaunch
+        )
+        self._notify(node, NodeEventType.DELETED)
+        if relaunch:
+            self._relaunch(node)
 
     def handle_node_succeeded(self, node_id: int) -> None:
         with self._lock:

@@ -66,6 +66,22 @@ class JobMaster:
         self.servicer.register(dispatcher)
         self._server = RpcServer(dispatcher, port=port)
         self._stopped = threading.Event()
+        # Nodes can die without their agent ever reporting (pod
+        # deleted, preemption, heartbeat timeout). The servicer's
+        # failure-report path does this cleanup inline; DELETED events
+        # from handle_node_gone / the watchdog must trigger the same
+        # shard requeue + rendezvous removal (all idempotent).
+        self.job_manager.add_listener(self._on_node_event)
+
+    def _on_node_event(self, node, event_type: str) -> None:
+        from dlrover_tpu.common.constants import NodeEventType
+
+        if event_type != NodeEventType.DELETED:
+            return
+        self.task_manager.recover_node_tasks(node.id)
+        self.speed_monitor.remove_running_node(node.id)
+        for rdzv in (self.elastic_rdzv, self.check_rdzv):
+            rdzv.remove_alive_node(node.id, node_rank=node.rank)
 
     @property
     def port(self) -> int:

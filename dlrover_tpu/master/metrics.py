@@ -1,0 +1,134 @@
+"""Job metric collection and reporting.
+
+Parity with the reference's stats layer
+(dlrover/python/master/stats/job_collector.py JobMetricCollector +
+reporter.py pluggable reporter backends): the master aggregates job
+facts (runtime, node counts, speed, failures) and periodically hands a
+snapshot to a reporter. Backends: log (default) and JSON-lines file;
+the seam is where a metrics service / Brain datastore plugs in.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import json
+import threading
+import time
+from typing import Dict, List, Optional
+
+from dlrover_tpu.common.constants import NodeStatus, NodeType
+from dlrover_tpu.common.log import get_logger
+
+logger = get_logger("metrics")
+
+
+@dataclasses.dataclass
+class JobSnapshot:
+    timestamp: float
+    job_name: str
+    runtime_s: float
+    global_step: int
+    speed_steps_per_s: float
+    token_throughput: float
+    workers_alive: int
+    workers_pending: int
+    workers_failed: int
+    total_relaunches: int
+    failure_counts: Dict[str, int]
+
+    def to_dict(self) -> dict:
+        return dataclasses.asdict(self)
+
+
+class Reporter:
+    def report(self, snapshot: JobSnapshot) -> None:
+        raise NotImplementedError
+
+
+class LogReporter(Reporter):
+    def report(self, snapshot: JobSnapshot) -> None:
+        logger.info("job metrics: %s", json.dumps(snapshot.to_dict()))
+
+
+class JsonFileReporter(Reporter):
+    def __init__(self, path: str):
+        self.path = path
+        self._lock = threading.Lock()
+
+    def report(self, snapshot: JobSnapshot) -> None:
+        with self._lock, open(self.path, "a") as f:
+            f.write(json.dumps(snapshot.to_dict()) + "\n")
+
+
+class JobMetricCollector:
+    def __init__(
+        self,
+        job_name: str,
+        job_manager,
+        speed_monitor,
+        reporters: Optional[List[Reporter]] = None,
+        interval: float = 60.0,
+    ):
+        self.job_name = job_name
+        self.job_manager = job_manager
+        self.speed_monitor = speed_monitor
+        self.reporters = reporters or [LogReporter()]
+        self.interval = interval
+        self.start_time = time.time()
+        self._stop = threading.Event()
+        self._thread: Optional[threading.Thread] = None
+
+    def snapshot(self) -> JobSnapshot:
+        nodes = self.job_manager.list_nodes(NodeType.WORKER)
+        failure_counts: Dict[str, int] = {}
+        for n in nodes:
+            reason = n.exit_reason or n.relaunch_reason
+            if reason:
+                failure_counts[reason] = (
+                    failure_counts.get(reason, 0) + 1
+                )
+        return JobSnapshot(
+            timestamp=time.time(),
+            job_name=self.job_name,
+            runtime_s=time.time() - self.start_time,
+            global_step=self.speed_monitor.global_step,
+            speed_steps_per_s=self.speed_monitor.running_speed(),
+            token_throughput=self.speed_monitor.token_throughput(),
+            workers_alive=sum(
+                1 for n in nodes if n.status == NodeStatus.RUNNING
+            ),
+            workers_pending=sum(
+                1 for n in nodes if n.status == NodeStatus.PENDING
+            ),
+            workers_failed=sum(
+                1 for n in nodes if n.status == NodeStatus.FAILED
+            ),
+            total_relaunches=sum(n.relaunch_count for n in nodes),
+            failure_counts=failure_counts,
+        )
+
+    def collect_once(self) -> JobSnapshot:
+        snap = self.snapshot()
+        for r in self.reporters:
+            try:
+                r.report(snap)
+            except Exception:  # noqa: BLE001
+                logger.warning("reporter failed", exc_info=True)
+        return snap
+
+    def start(self) -> None:
+        if self._thread is None:
+            self._thread = threading.Thread(
+                target=self._loop, name="metric-collector", daemon=True
+            )
+            self._thread.start()
+
+    def stop(self) -> None:
+        self._stop.set()
+
+    def _loop(self) -> None:
+        while not self._stop.wait(self.interval):
+            try:
+                self.collect_once()
+            except Exception:  # noqa: BLE001
+                logger.warning("metric collection failed", exc_info=True)

@@ -1,0 +1,336 @@
+"""Platform scalers and node watchers.
+
+Capability parity with the reference's scaler/watcher layer
+(dlrover/python/master/scaler/pod_scaler.py:71 PodScaler — creates
+Pods+Services directly; elasticjob_scaler.py ElasticJobScaler —
+patches a ScalePlan CRD; watcher/k8s_watcher.py PodWatcher), adapted
+to TPU scheduling: the unit of scaling is a *host with attached TPU
+chips* (a GKE TPU pod-slice member), and pod specs carry the TPU
+topology selectors instead of GPU resource requests.
+
+The k8s API surface is behind the small ``ClusterClient`` interface so
+the master logic is testable against ``FakeClusterClient`` (the
+reference achieves the same with MagicMock monkey-patching,
+tests/test_utils.py:244-259 — a real seam beats mocks).
+"""
+
+from __future__ import annotations
+
+import copy
+import itertools
+import queue
+import threading
+import time
+from typing import Callable, Dict, Iterator, List, Optional
+
+from dlrover_tpu.common.constants import NodeEventType, NodeStatus, NodeType
+from dlrover_tpu.common.log import get_logger
+from dlrover_tpu.common.node import Node, NodeResource
+from dlrover_tpu.master.job_manager import ScalePlan, Scaler
+
+logger = get_logger("scaler")
+
+
+# ---------------------------------------------------------------------------
+# Cluster client seam
+# ---------------------------------------------------------------------------
+
+
+class ClusterClient:
+    """Minimal cluster-API surface the scaler needs."""
+
+    def create_pod(self, spec: Dict) -> None:
+        raise NotImplementedError
+
+    def delete_pod(self, name: str) -> None:
+        raise NotImplementedError
+
+    def list_pods(self, job_name: str) -> List[Dict]:
+        raise NotImplementedError
+
+    def create_service(self, spec: Dict) -> None:
+        raise NotImplementedError
+
+    def patch_custom_object(self, name: str, body: Dict) -> None:
+        raise NotImplementedError
+
+    def watch_pods(self, job_name: str) -> Iterator[Dict]:
+        raise NotImplementedError
+
+
+class FakeClusterClient(ClusterClient):
+    """In-memory cluster for tests and local drills: pods 'start'
+    instantly; ``fail_pod``/``preempt_pod`` inject faults."""
+
+    def __init__(self):
+        self.pods: Dict[str, Dict] = {}
+        self.services: Dict[str, Dict] = {}
+        self.custom_objects: Dict[str, Dict] = {}
+        self.events: "queue.Queue[Dict]" = queue.Queue()
+        self.create_errors = 0  # set >0 to make creates fail N times
+
+    def create_pod(self, spec: Dict) -> None:
+        if self.create_errors > 0:
+            self.create_errors -= 1
+            raise RuntimeError("simulated pod create failure")
+        name = spec["name"]
+        pod = dict(spec, phase="Running")
+        self.pods[name] = pod
+        self.events.put({"type": "ADDED", "pod": copy.deepcopy(pod)})
+
+    def delete_pod(self, name: str) -> None:
+        pod = self.pods.pop(name, None)
+        if pod is not None:
+            pod["phase"] = "Deleted"
+            self.events.put(
+                {"type": "DELETED", "pod": copy.deepcopy(pod)}
+            )
+
+    def list_pods(self, job_name: str) -> List[Dict]:
+        return [
+            copy.deepcopy(p)
+            for p in self.pods.values()
+            if p.get("job") == job_name
+        ]
+
+    def create_service(self, spec: Dict) -> None:
+        self.services[spec["name"]] = spec
+
+    def patch_custom_object(self, name: str, body: Dict) -> None:
+        self.custom_objects[name] = body
+
+    def watch_pods(self, job_name: str) -> Iterator[Dict]:
+        while True:
+            evt = self.events.get()
+            if evt is None:  # sentinel for shutdown
+                return
+            if evt["pod"].get("job") == job_name:
+                yield evt
+
+    # fault injection for drills
+    def fail_pod(self, name: str, reason: str = "Error") -> None:
+        pod = self.pods.pop(name, None)
+        if pod is not None:
+            pod["phase"] = "Failed"
+            pod["reason"] = reason
+            self.events.put(
+                {"type": "MODIFIED", "pod": copy.deepcopy(pod)}
+            )
+
+    def preempt_pod(self, name: str) -> None:
+        self.fail_pod(name, reason="Preempted")
+
+
+# ---------------------------------------------------------------------------
+# Pod scaler
+# ---------------------------------------------------------------------------
+
+
+class TPUPodScaler(Scaler):
+    """Realizes ScalePlans as pod create/delete calls (ref PodScaler
+    pod_scaler.py:143 ``scale``, :376 ``_create_pod``, :486 service
+    creation). Pods are retried through a background queue the same
+    way (:349 ``_periodic_create_pod``)."""
+
+    def __init__(
+        self,
+        job_name: str,
+        client: ClusterClient,
+        pod_template: Optional[Dict] = None,
+        retry_interval: float = 3.0,
+        max_create_retries: int = 5,
+    ):
+        super().__init__()
+        self.job_name = job_name
+        self.client = client
+        self.pod_template = pod_template or {}
+        self._create_q: "queue.Queue[Optional[Node]]" = queue.Queue()
+        self._retry_interval = retry_interval
+        self._max_create_retries = max_create_retries
+        self._thread: Optional[threading.Thread] = None
+        self._stop = threading.Event()
+
+    def start(self) -> None:
+        if self._thread is None:
+            self._thread = threading.Thread(
+                target=self._create_loop, name="pod-creator", daemon=True
+            )
+            self._thread.start()
+
+    def stop(self) -> None:
+        self._stop.set()
+        self._create_q.put(None)
+
+    def pod_name(self, node: Node) -> str:
+        return f"{self.job_name}-{node.type}-{node.id}"
+
+    def scale(self, plan: ScalePlan) -> None:
+        super().scale(plan)
+        for node in plan.remove_nodes:
+            try:
+                self.client.delete_pod(self.pod_name(node))
+            except Exception:  # noqa: BLE001
+                logger.warning(
+                    "delete pod %s failed", self.pod_name(node),
+                    exc_info=True,
+                )
+        for node in plan.launch_nodes:
+            self._create_q.put(node)
+        # synchronous drain when no background thread is running
+        if self._thread is None:
+            self._drain_once()
+
+    def _pod_spec(self, node: Node) -> Dict:
+        res = node.config_resource or NodeResource()
+        spec = dict(self.pod_template)
+        spec.update(
+            {
+                "name": self.pod_name(node),
+                "job": self.job_name,
+                "type": node.type,
+                "node_id": node.id,
+                "rank": node.rank,
+                "cpu": res.cpu,
+                "memory_mb": res.memory_mb,
+                # TPU scheduling: GKE selects node pools by these
+                # (cloud.google.com/gke-tpu-accelerator + topology).
+                "tpu_accelerator": res.tpu_type,
+                "tpu_chips": res.chips,
+            }
+        )
+        return spec
+
+    def _create_node(self, node: Node) -> bool:
+        spec = self._pod_spec(node)
+        try:
+            self.client.create_pod(spec)
+            self.client.create_service(
+                {
+                    "name": spec["name"],
+                    "job": self.job_name,
+                    "selector": spec["name"],
+                }
+            )
+            return True
+        except Exception:  # noqa: BLE001
+            logger.warning(
+                "create pod %s failed", spec["name"], exc_info=True
+            )
+            return False
+
+    def _drain_once(self) -> None:
+        while True:
+            try:
+                node = self._create_q.get_nowait()
+            except queue.Empty:
+                return
+            if node is None:
+                return
+            for attempt in range(self._max_create_retries):
+                if self._create_node(node):
+                    break
+                if self._thread is not None:
+                    time.sleep(self._retry_interval)
+            else:
+                logger.error(
+                    "giving up creating pod for node %d after %d "
+                    "retries",
+                    node.id,
+                    self._max_create_retries,
+                )
+
+    def _create_loop(self) -> None:
+        while not self._stop.is_set():
+            node = self._create_q.get()
+            if node is None:
+                return
+            for attempt in range(self._max_create_retries):
+                if self._create_node(node):
+                    break
+                time.sleep(self._retry_interval)
+
+
+class ElasticJobScaler(Scaler):
+    """Writes the plan into a ScalePlan custom object for an external
+    operator to realize (ref elasticjob_scaler.py)."""
+
+    def __init__(self, job_name: str, client: ClusterClient):
+        super().__init__()
+        self.job_name = job_name
+        self.client = client
+        self._plan_index = itertools.count()
+
+    def scale(self, plan: ScalePlan) -> None:
+        super().scale(plan)
+        body = {
+            "job": self.job_name,
+            "launch": [
+                {
+                    "id": n.id,
+                    "type": n.type,
+                    "rank": n.rank,
+                    "resource": (n.config_resource or NodeResource())
+                    .to_dict(),
+                }
+                for n in plan.launch_nodes
+            ],
+            "remove": [n.id for n in plan.remove_nodes],
+        }
+        name = f"{self.job_name}-scaleplan-{next(self._plan_index)}"
+        self.client.patch_custom_object(name, body)
+
+
+# ---------------------------------------------------------------------------
+# Watcher: cluster events -> job manager
+# ---------------------------------------------------------------------------
+
+
+_PHASE_TO_STATUS = {
+    "Pending": NodeStatus.PENDING,
+    "Running": NodeStatus.RUNNING,
+    "Succeeded": NodeStatus.SUCCEEDED,
+    "Failed": NodeStatus.FAILED,
+    "Deleted": NodeStatus.DELETED,
+}
+
+
+class PodEventWatcher:
+    """Relays pod events into JobManager node updates (ref PodWatcher
+    k8s_watcher.py: event -> _process_event dist_job_manager.py:401)."""
+
+    def __init__(self, job_name: str, client: ClusterClient, job_manager):
+        self.job_name = job_name
+        self.client = client
+        self.job_manager = job_manager
+        self._thread: Optional[threading.Thread] = None
+
+    def start(self) -> None:
+        self._thread = threading.Thread(
+            target=self._watch_loop, name="pod-watcher", daemon=True
+        )
+        self._thread.start()
+
+    def _watch_loop(self) -> None:
+        try:
+            for evt in self.client.watch_pods(self.job_name):
+                self.process_event(evt)
+        except Exception:  # noqa: BLE001
+            logger.warning("pod watch loop ended", exc_info=True)
+
+    def process_event(self, evt: Dict) -> None:
+        pod = evt["pod"]
+        node_id = pod.get("node_id")
+        if node_id is None:
+            return
+        status = _PHASE_TO_STATUS.get(pod.get("phase", ""), "")
+        if not status:
+            return
+        if status in (NodeStatus.FAILED, NodeStatus.DELETED):
+            reason = pod.get("reason", "")
+            self.job_manager.handle_node_gone(
+                node_id, reason=reason
+            )
+        elif status == NodeStatus.RUNNING:
+            node = self.job_manager.get_node(node_id)
+            if node is not None:
+                node.update_status(NodeStatus.RUNNING)

@@ -95,14 +95,23 @@ class WeightedSAM:
         def step(params, state: WSAMState, *batch):
             loss, g1 = grad_fn(params, *batch)
             g1 = self._clip(g1)
-            # -- first step: climb to the local maximum w + e(w)
-            gnorm = _global_norm(g1)
-            scale = self.rho / (gnorm + self.sam_eps)
+            # -- first step: climb to the local maximum w + e(w).
+            # Adaptive (ASAM) normalizes by ||abs(p)*g|| so the
+            # perturbation radius stays rho in the rescaled geometry
+            # (ref _grad_norm, wsam.py:123-140).
             if self.adaptive:
+                gnorm = _global_norm(
+                    jax.tree.map(
+                        lambda p, g: jnp.abs(p) * g, params, g1
+                    )
+                )
+                scale = self.rho / (gnorm + self.sam_eps)
                 e_w = jax.tree.map(
                     lambda p, g: jnp.square(p) * g * scale, params, g1
                 )
             else:
+                gnorm = _global_norm(g1)
+                scale = self.rho / (gnorm + self.sam_eps)
                 e_w = jax.tree.map(lambda g: g * scale, g1)
             perturbed = jax.tree.map(jnp.add, params, e_w)
             # -- second gradient at the perturbed point

@@ -1,0 +1,3 @@
+"""Platform scheduler abstraction (ref dlrover/python/scheduler/)."""
+
+from dlrover_tpu.scheduler.factory import get_platform  # noqa: F401

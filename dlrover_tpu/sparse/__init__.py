@@ -1,0 +1,12 @@
+"""Sparse / embedding path (tfplus parity, TF-free).
+
+KvVariable-style dynamically-growing embedding store (C++ host store,
+dlrover_tpu/native/kv_store.cc) with fused sparse optimizers and a JAX
+bridge for training CTR-style models on TPU.
+"""
+
+from dlrover_tpu.sparse.kv_variable import (  # noqa: F401
+    KvVariable,
+    SparseOptimizer,
+    embedding_lookup,
+)

@@ -1,0 +1,419 @@
+"""KvVariable: Python API over the C++ host embedding store.
+
+Parity with tfplus's Python surface (tfplus/python/ops/
+kv_variable_ops.py ``get_kv_variable``, embedding_ops.py lookups,
+python/training/*.py sparse optimizers) without TensorFlow: the store
+is plain C++ behind ctypes (built on demand with g++, the same
+just-in-time native build idea as atorch's op builder,
+atorch/ops/op_builder/builder.py), and ``embedding_lookup`` bridges it
+into jitted JAX programs with ``jax.pure_callback``.
+
+Training flow (PS-style, host-resident sparse state):
+
+    vals = embedding_lookup(kv, keys)        # inside jit, via callback
+    ... dense math on TPU ...
+    grads = jax.grad(...)                    # d loss / d vals
+    kv.apply_gradients("adam", keys, grads, step)   # fused C++ apply
+"""
+
+from __future__ import annotations
+
+import ctypes
+import hashlib
+import os
+import subprocess
+import tempfile
+import threading
+from typing import Dict, Optional, Tuple
+
+import numpy as np
+
+_SRC = os.path.join(
+    os.path.dirname(os.path.dirname(os.path.abspath(__file__))),
+    "native",
+    "kv_store.cc",
+)
+_LIB_LOCK = threading.Lock()
+_LIB: Optional[ctypes.CDLL] = None
+
+
+def _build_library() -> str:
+    """Compile kv_store.cc to a cached .so keyed by source hash."""
+    with open(_SRC, "rb") as f:
+        digest = hashlib.sha256(f.read()).hexdigest()[:16]
+    cache_dir = os.path.join(
+        os.getenv("DLROVER_TPU_CACHE", tempfile.gettempdir()),
+        "dlrover_tpu_native",
+    )
+    os.makedirs(cache_dir, exist_ok=True)
+    so_path = os.path.join(cache_dir, f"kv_store_{digest}.so")
+    if os.path.exists(so_path):
+        return so_path
+    tmp = so_path + f".build{os.getpid()}"
+    cmd = [
+        "g++", "-O3", "-shared", "-fPIC", "-std=c++17",
+        "-o", tmp, _SRC,
+    ]
+    subprocess.run(cmd, check=True, capture_output=True)
+    os.replace(tmp, so_path)  # atomic vs concurrent builders
+    return so_path
+
+
+def _lib() -> ctypes.CDLL:
+    global _LIB
+    with _LIB_LOCK:
+        if _LIB is None:
+            lib = ctypes.CDLL(_build_library())
+            lib.kv_create.restype = ctypes.c_void_p
+            lib.kv_create.argtypes = [
+                ctypes.c_int, ctypes.c_uint64, ctypes.c_int,
+                ctypes.c_float, ctypes.c_int,
+            ]
+            lib.kv_destroy.argtypes = [ctypes.c_void_p]
+            lib.kv_size.restype = ctypes.c_int64
+            lib.kv_size.argtypes = [ctypes.c_void_p]
+            lib.kv_dim.restype = ctypes.c_int
+            lib.kv_dim.argtypes = [ctypes.c_void_p]
+            i64p = np.ctypeslib.ndpointer(np.int64, flags="C")
+            f32p = np.ctypeslib.ndpointer(np.float32, flags="C")
+            u32p = np.ctypeslib.ndpointer(np.uint32, flags="C")
+            lib.kv_gather_or_insert.argtypes = [
+                ctypes.c_void_p, i64p, ctypes.c_int64, f32p,
+            ]
+            lib.kv_gather_or_zeros.argtypes = [
+                ctypes.c_void_p, i64p, ctypes.c_int64, f32p,
+            ]
+            lib.kv_update.argtypes = [
+                ctypes.c_void_p, i64p, ctypes.c_int64, f32p,
+                ctypes.c_int64,
+            ]
+            lib.kv_evict.restype = ctypes.c_int64
+            lib.kv_evict.argtypes = [
+                ctypes.c_void_p, ctypes.c_uint32, ctypes.c_int64,
+            ]
+            lib.kv_export.restype = ctypes.c_int64
+            lib.kv_export.argtypes = [
+                ctypes.c_void_p, ctypes.c_int64, i64p, f32p, u32p,
+                i64p, ctypes.c_int64,
+            ]
+            lib.kv_import.argtypes = [
+                ctypes.c_void_p, i64p, f32p, u32p, i64p,
+                ctypes.c_int64,
+            ]
+            lib.kv_sparse_apply_adagrad.argtypes = [
+                ctypes.c_void_p, ctypes.c_void_p, i64p, f32p,
+                ctypes.c_int64, ctypes.c_float, ctypes.c_float,
+                ctypes.c_int64,
+            ]
+            lib.kv_sparse_apply_adam.argtypes = [
+                ctypes.c_void_p, ctypes.c_void_p, ctypes.c_void_p,
+                i64p, f32p, ctypes.c_int64, ctypes.c_float,
+                ctypes.c_float, ctypes.c_float, ctypes.c_float,
+                ctypes.c_int64,
+            ]
+            lib.kv_sparse_apply_ftrl.argtypes = [
+                ctypes.c_void_p, ctypes.c_void_p, ctypes.c_void_p,
+                i64p, f32p, ctypes.c_int64, ctypes.c_float,
+                ctypes.c_float, ctypes.c_float, ctypes.c_float,
+                ctypes.c_int64,
+            ]
+            lib.kv_sparse_apply_momentum.argtypes = [
+                ctypes.c_void_p, ctypes.c_void_p, i64p, f32p,
+                ctypes.c_int64, ctypes.c_float, ctypes.c_float,
+                ctypes.c_int64,
+            ]
+            _LIB = lib
+    return _LIB
+
+
+_INIT_RANDOM, _INIT_ZEROS, _INIT_CONST = 0, 1, 2
+
+
+class _Store:
+    """RAII over one C++ KvStore."""
+
+    def __init__(self, dim, seed, shards, init_scale, init_mode):
+        self._lib = _lib()
+        self.dim = dim
+        self._h = ctypes.c_void_p(
+            self._lib.kv_create(dim, seed, shards, init_scale, init_mode)
+        )
+
+    def __del__(self):
+        h, self._h = self._h, None
+        if h:
+            self._lib.kv_destroy(h)
+
+    @property
+    def handle(self):
+        return self._h
+
+    def __len__(self):
+        return self._lib.kv_size(self._h)
+
+
+class KvVariable:
+    """Dynamically-growing embedding table keyed by int64 ids.
+
+    (ref: get_kv_variable, tfplus python/ops/kv_variable_ops.py; the
+    C++ store carries per-key frequency/version for eviction and
+    incremental export, kv_variable.h.)
+    """
+
+    def __init__(
+        self,
+        name: str,
+        embedding_dim: int,
+        seed: int = 0,
+        num_shards: int = 16,
+        init_scale: float = 0.05,
+    ):
+        self.name = name
+        self.embedding_dim = embedding_dim
+        self._store = _Store(
+            embedding_dim, seed, num_shards, init_scale, _INIT_RANDOM
+        )
+        # optimizer slot stores, created lazily per optimizer
+        self._slots: Dict[str, _Store] = {}
+        self._seed = seed
+        self._num_shards = num_shards
+
+    def __len__(self) -> int:
+        return len(self._store)
+
+    # -- lookup -------------------------------------------------------------
+
+    def gather(self, keys: np.ndarray, train: bool = True) -> np.ndarray:
+        """[n] int64 -> [n, dim] f32. train=True inserts missing keys
+        (GatherOrInsert); train=False returns zeros (GatherOrZeros)."""
+        keys = np.ascontiguousarray(keys, np.int64)
+        out = np.empty((keys.size, self.embedding_dim), np.float32)
+        fn = (
+            self._store._lib.kv_gather_or_insert
+            if train
+            else self._store._lib.kv_gather_or_zeros
+        )
+        fn(self._store.handle, keys.ravel(), keys.size, out)
+        return out.reshape(keys.shape + (self.embedding_dim,))
+
+    def assign(self, keys: np.ndarray, values: np.ndarray, step: int = 0):
+        keys = np.ascontiguousarray(keys, np.int64).ravel()
+        values = np.ascontiguousarray(values, np.float32).reshape(
+            keys.size, self.embedding_dim
+        )
+        self._store._lib.kv_update(
+            self._store.handle, keys, keys.size, values, step
+        )
+
+    # -- optimizer slots ----------------------------------------------------
+
+    def _slot(self, slot_name: str, init_mode=_INIT_ZEROS, init=0.0):
+        if slot_name not in self._slots:
+            self._slots[slot_name] = _Store(
+                self.embedding_dim,
+                self._seed + hash(slot_name) % 1000,
+                self._num_shards,
+                init,
+                init_mode,
+            )
+        return self._slots[slot_name]
+
+    def apply_gradients(
+        self,
+        optimizer: str,
+        keys: np.ndarray,
+        grads: np.ndarray,
+        step: int,
+        lr: float = 1e-3,
+        **kw,
+    ) -> None:
+        """Fused sparse apply. Duplicate keys are combined first (sum)
+        — the reference's kernels expect deduplicated ids too."""
+        keys = np.ascontiguousarray(keys, np.int64).ravel()
+        grads = np.ascontiguousarray(grads, np.float32).reshape(
+            keys.size, self.embedding_dim
+        )
+        ukeys, inv = np.unique(keys, return_inverse=True)
+        ugrads = np.zeros((ukeys.size, self.embedding_dim), np.float32)
+        np.add.at(ugrads, inv, grads)
+
+        lib = self._store._lib
+        h = self._store.handle
+        if optimizer == "adam":
+            lib.kv_sparse_apply_adam(
+                h,
+                self._slot("m").handle,
+                self._slot("v").handle,
+                ukeys, ugrads, ukeys.size,
+                lr, kw.get("beta1", 0.9), kw.get("beta2", 0.999),
+                kw.get("eps", 1e-8), max(step, 1),
+            )
+        elif optimizer == "adagrad":
+            lib.kv_sparse_apply_adagrad(
+                h,
+                self._slot("accum").handle,
+                ukeys, ugrads, ukeys.size,
+                lr, kw.get("eps", 1e-10), step,
+            )
+        elif optimizer == "ftrl":
+            lib.kv_sparse_apply_ftrl(
+                h,
+                self._slot(
+                    "accum_ftrl", _INIT_CONST,
+                    kw.get("initial_accumulator", 0.1),
+                ).handle,
+                self._slot("linear").handle,
+                ukeys, ugrads, ukeys.size,
+                lr, kw.get("l1", 0.0), kw.get("l2", 0.0),
+                kw.get("lr_power", 0.5), step,
+            )
+        elif optimizer == "momentum":
+            lib.kv_sparse_apply_momentum(
+                h,
+                self._slot("momentum").handle,
+                ukeys, ugrads, ukeys.size,
+                lr, kw.get("momentum", 0.9), step,
+            )
+        else:
+            raise ValueError(f"unknown sparse optimizer {optimizer!r}")
+
+    # -- eviction (under/over-flow policies) --------------------------------
+
+    def evict(
+        self, min_frequency: int = 0, min_version: int = 0
+    ) -> int:
+        return self._store._lib.kv_evict(
+            self._store.handle, min_frequency, min_version
+        )
+
+    # -- checkpoint ---------------------------------------------------------
+
+    def export(
+        self, since_version: int = 0
+    ) -> Tuple[np.ndarray, np.ndarray, np.ndarray, np.ndarray]:
+        """(keys, values, freqs, versions); since_version>0 = delta
+        export of rows touched at/after that step."""
+        lib = self._store._lib
+        h = self._store.handle
+        cap = len(self._store)
+        keys = np.empty(max(cap, 1), np.int64)
+        values = np.empty((max(cap, 1), self.embedding_dim), np.float32)
+        freqs = np.empty(max(cap, 1), np.uint32)
+        versions = np.empty(max(cap, 1), np.int64)
+        n = lib.kv_export(
+            h, since_version, keys, values, freqs, versions, cap
+        )
+        if n > cap:  # store grew between size() and export
+            cap = int(n)
+            keys = np.empty(cap, np.int64)
+            values = np.empty((cap, self.embedding_dim), np.float32)
+            freqs = np.empty(cap, np.uint32)
+            versions = np.empty(cap, np.int64)
+            n = lib.kv_export(
+                h, since_version, keys, values, freqs, versions, cap
+            )
+        n = int(n)
+        return keys[:n], values[:n], freqs[:n], versions[:n]
+
+    def import_(self, keys, values, freqs=None, versions=None) -> None:
+        keys = np.ascontiguousarray(keys, np.int64)
+        values = np.ascontiguousarray(values, np.float32)
+        n = keys.size
+        freqs = (
+            np.ascontiguousarray(freqs, np.uint32)
+            if freqs is not None
+            else np.zeros(n, np.uint32)
+        )
+        versions = (
+            np.ascontiguousarray(versions, np.int64)
+            if versions is not None
+            else np.zeros(n, np.int64)
+        )
+        self._store._lib.kv_import(
+            self._store.handle, keys, values, freqs, versions, n
+        )
+
+    def state_dict(self) -> dict:
+        keys, values, freqs, versions = self.export()
+        slots = {}
+        for name, store in self._slots.items():
+            cap = len(store)
+            sk = np.empty(max(cap, 1), np.int64)
+            sv = np.empty((max(cap, 1), self.embedding_dim), np.float32)
+            sf = np.empty(max(cap, 1), np.uint32)
+            sver = np.empty(max(cap, 1), np.int64)
+            n = int(
+                store._lib.kv_export(
+                    store.handle, 0, sk, sv, sf, sver, cap
+                )
+            )
+            slots[name] = (sk[:n], sv[:n])
+        return {
+            "keys": keys,
+            "values": values,
+            "freqs": freqs,
+            "versions": versions,
+            "slots": slots,
+        }
+
+    def load_state_dict(self, state: dict) -> None:
+        self.import_(
+            state["keys"], state["values"], state.get("freqs"),
+            state.get("versions"),
+        )
+        for name, (sk, sv) in state.get("slots", {}).items():
+            # recreate slot stores with matching init semantics
+            mode = (
+                _INIT_CONST if name == "accum_ftrl" else _INIT_ZEROS
+            )
+            slot = self._slot(name, mode, 0.1 if mode == _INIT_CONST else 0.0)
+            slot._lib.kv_update(
+                slot.handle,
+                np.ascontiguousarray(sk, np.int64),
+                sk.size,
+                np.ascontiguousarray(sv, np.float32),
+                0,
+            )
+
+
+class SparseOptimizer:
+    """Convenience: one object applying the same rule to many
+    KvVariables (ref python/training/group_adam.py GroupAdam et al —
+    'group' = shared hyperparameters across embedding tables)."""
+
+    def __init__(self, optimizer: str = "adam", lr: float = 1e-3, **kw):
+        self.optimizer = optimizer
+        self.lr = lr
+        self.kw = kw
+
+    def apply(
+        self,
+        grads_by_var: Dict[KvVariable, Tuple[np.ndarray, np.ndarray]],
+        step: int,
+    ) -> None:
+        for var, (keys, grads) in grads_by_var.items():
+            var.apply_gradients(
+                self.optimizer, keys, grads, step, lr=self.lr, **self.kw
+            )
+
+
+def embedding_lookup(kv: KvVariable, keys, train: bool = True):
+    """JAX-visible lookup: usable inside jit via pure_callback.
+
+    Returns f32 [batch..., dim]. Differentiable in the sense that the
+    cotangent w.r.t. the *gathered values* flows out of jax.grad; feed
+    it to ``kv.apply_gradients``. (The table itself is host state, not
+    a traced array — by design, see module docstring.)
+    """
+    import jax
+    import jax.numpy as jnp
+
+    keys = jnp.asarray(keys)
+    out_shape = jax.ShapeDtypeStruct(
+        keys.shape + (kv.embedding_dim,), jnp.float32
+    )
+
+    def host_gather(k):
+        return kv.gather(np.asarray(k), train=train)
+
+    return jax.pure_callback(host_gather, out_shape, keys)

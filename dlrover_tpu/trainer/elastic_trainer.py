@@ -51,11 +51,7 @@ def gradient_accumulation_steps(
     the effective batch never shrinks when nodes are lost
     (ref: trainer.py:420 rounds the same way)."""
     per_step = micro_batch_size * num_shards
-    if global_batch_size % per_step:
-        accum = (global_batch_size + per_step - 1) // per_step
-    else:
-        accum = global_batch_size // per_step
-    return max(accum, 1)
+    return (global_batch_size + per_step - 1) // per_step
 
 
 @dataclasses.dataclass
@@ -273,8 +269,17 @@ class ElasticDistributedSampler:
             yield int(order[global_pos])
 
     def __len__(self):
-        order_len = self._epoch_order().size
-        return max(0, (order_len - self.consumed)) // self.num_shards
+        # Derived arithmetically — materializing/shuffling the whole
+        # permutation per len() call would be O(dataset) each time.
+        if self.drop_last:
+            order_len = (
+                self.dataset_size // self.num_shards * self.num_shards
+            )
+        else:
+            order_len = self.dataset_size + (
+                (-self.dataset_size) % self.num_shards
+            )
+        return max(0, order_len - self.consumed) // self.num_shards
 
     def state_dict(self) -> dict:
         return {

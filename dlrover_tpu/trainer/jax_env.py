@@ -43,6 +43,17 @@ def setup_distributed() -> None:
     global _initialized
     if _initialized:
         return
+    # Honor an explicit JAX_PLATFORMS=cpu even when a TPU plugin
+    # preregistered itself (the env var alone loses to a registered
+    # backend): CPU-mesh test runs set this to get the virtual
+    # 8-device world.
+    if os.getenv("JAX_PLATFORMS", "") == "cpu":
+        import jax
+
+        try:
+            jax.config.update("jax_platforms", "cpu")
+        except Exception:  # noqa: BLE001 — backend already initialized
+            pass
     n = num_processes()
     if n <= 1:
         _initialized = True

@@ -1,0 +1,137 @@
+"""auto_accelerate: analyser, candidate pruning, dry-run search."""
+
+import functools
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from dlrover_tpu.accelerate import (
+    Strategy,
+    analyse_model,
+    auto_accelerate,
+)
+from dlrover_tpu.accelerate.analyser import estimate_step_memory
+from dlrover_tpu.accelerate.strategy import (
+    candidate_strategies,
+    _factorizations,
+)
+from dlrover_tpu.models import gpt
+
+
+CFG = gpt.GPTConfig(
+    vocab_size=256,
+    block_size=64,
+    n_layer=2,
+    n_head=2,
+    n_embd=32,
+    dtype=jnp.float32,
+    remat=False,
+)
+
+
+def _model():
+    init = functools.partial(gpt.init_params, cfg=CFG)
+    loss = functools.partial(gpt.loss_fn, cfg=CFG)
+    axes = gpt.param_logical_axes(CFG)
+    return init, loss, axes
+
+
+def _sample_batch(n=2):
+    key = jax.random.PRNGKey(0)
+    tokens = jax.random.randint(key, (n, CFG.block_size), 0, 256)
+    return tokens, jnp.roll(tokens, -1, axis=1)
+
+
+def test_factorizations():
+    fs = _factorizations(8, 3)
+    assert (8, 1, 1) in fs and (2, 2, 2) in fs and (1, 1, 8) in fs
+    for f in fs:
+        assert f[0] * f[1] * f[2] == 8
+
+
+def test_candidate_strategies_cover_mesh_space():
+    cands = candidate_strategies(8)
+    names = {c.name() for c in cands}
+    assert len(names) == len(cands)  # no duplicates
+    shapes = {c.mesh_dict["fsdp"] for c in cands}
+    assert {1, 2, 4, 8} <= shapes
+
+
+def test_analyse_model_counts_params():
+    init, _, _ = _model()
+    a = analyse_model(init)
+    real = gpt.num_params(gpt.init_params(jax.random.PRNGKey(0), CFG))
+    assert a.n_params == real
+
+
+def test_memory_estimate_prunes_impossible():
+    init, _, _ = _model()
+    a = analyse_model(init)
+    # a tiny "HBM" of 1KB: nothing fits
+    s = Strategy(mesh_shape=(("data", 8),))
+    _, fits = estimate_step_memory(a, s, 1 << 20, hbm_bytes=1 << 10)
+    assert not fits
+    # sharded model on generous HBM fits
+    s2 = Strategy(mesh_shape=(("data", 1), ("fsdp", 8)))
+    _, fits2 = estimate_step_memory(a, s2, 1 << 10, hbm_bytes=1 << 30)
+    assert fits2
+    # more sharding -> strictly less memory
+    e_dp, _ = estimate_step_memory(a, s, 1 << 10, 1 << 30)
+    e_fsdp, _ = estimate_step_memory(a, s2, 1 << 10, 1 << 30)
+    assert e_fsdp < e_dp
+
+
+def test_explicit_strategy_path_trains():
+    init, loss, axes = _model()
+    s = Strategy(
+        mesh_shape=(("data", 2), ("fsdp", 2), ("tensor", 2)),
+        dtype="float32",
+        micro_batch_size=4,
+    )
+    res = auto_accelerate(
+        init, loss, axes, _sample_batch(), strategy=s,
+        devices=jax.devices()[:8],
+    )
+    params, opt_state = res.init_fn(jax.random.PRNGKey(0))
+    tokens, targets = _sample_batch(8)
+    tokens, targets = res.shard_batch_fn(tokens, targets)
+    losses = []
+    for _ in range(5):
+        params, opt_state, metrics = res.step_fn(
+            params, opt_state, tokens, targets
+        )
+        losses.append(float(metrics["loss"]))
+    assert losses[-1] < losses[0]
+
+
+def test_search_picks_a_strategy_and_logs():
+    init, loss, axes = _model()
+    cands = [
+        Strategy(mesh_shape=(("data", 4),), micro_batch_size=4,
+                 dtype="float32"),
+        Strategy(mesh_shape=(("data", 2), ("fsdp", 2)),
+                 micro_batch_size=4, dtype="float32"),
+    ]
+    res = auto_accelerate(
+        init, loss, axes, _sample_batch(),
+        devices=jax.devices()[:4],
+        candidates=cands,
+        hbm_bytes=1 << 30,
+        activation_bytes_per_sample=1 << 10,
+    )
+    assert res.strategy in cands
+    assert res.throughput is not None and res.throughput > 0
+    ran = [e for e in res.search_log if "samples_per_sec" in e]
+    assert len(ran) == 2
+
+
+def test_search_raises_when_nothing_fits():
+    init, loss, axes = _model()
+    with pytest.raises(RuntimeError, match="no strategy fits"):
+        auto_accelerate(
+            init, loss, axes, _sample_batch(),
+            devices=jax.devices()[:4],
+            hbm_bytes=1 << 10,
+        )

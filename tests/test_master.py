@@ -290,3 +290,30 @@ class TestMasterEndToEnd:
         assert not master.job_manager.all_workers_done()
         client0.close()
         client1.close()
+
+
+def test_node_gone_requeues_shards_via_listener():
+    """A preempted node (agent never reports) must release its data
+    shards through the master's DELETED listener — the cleanup the
+    servicer only does on explicit failure reports."""
+    from dlrover_tpu.master.master import JobMaster
+
+    master = JobMaster(node_num=2, rdzv_timeout=1)
+    try:
+        jm = master.job_manager
+        jm.register_node(node_id=0)
+        jm.register_node(node_id=1)
+        # one shard total: node 0 takes it, then dies silently
+        master.task_manager.create_dataset(
+            "ds", dataset_size=4, shard_size=4
+        )
+        task = master.task_manager.get_task(0, "ds")
+        assert task.shard is not None
+        jm.handle_node_gone(0, reason="Preempted")
+        assert jm.get_node(0).status == "pending"
+        # the shard is back on the todo queue for the survivor
+        task2 = master.task_manager.get_task(1, "ds")
+        assert task2.shard is not None
+        assert task2.shard.start == task.shard.start
+    finally:
+        master.stop()

@@ -1,0 +1,108 @@
+"""Monitors, metric collector, profiler."""
+
+import json
+import os
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from dlrover_tpu.agent.monitor import (
+    ResourceMonitor,
+    TrainingMonitor,
+    current_resource_stats,
+)
+from dlrover_tpu.master.job_manager import JobManager
+from dlrover_tpu.master.metrics import (
+    JobMetricCollector,
+    JsonFileReporter,
+)
+from dlrover_tpu.master.speed_monitor import SpeedMonitor
+from dlrover_tpu.utils.profiler import (
+    profile_fn,
+    summarize,
+    transformer_component_flops,
+)
+
+
+class FakeClient:
+    def __init__(self):
+        self.resources = []
+        self.steps = []
+
+    def report_resource(self, **kw):
+        self.resources.append(kw)
+
+    def report_step(self, step, tokens=0):
+        self.steps.append((step, tokens))
+
+
+def test_resource_stats_sampled():
+    stats = current_resource_stats()
+    assert stats["memory_mb"] > 0  # psutil is available here
+
+
+def test_resource_monitor_reports():
+    client = FakeClient()
+    mon = ResourceMonitor(client, interval=999)
+    out = mon.report_once()
+    assert client.resources and client.resources[0] == out
+
+
+def test_training_monitor_relays_new_steps(tmp_path):
+    path = str(tmp_path / "metrics.json")
+    client = FakeClient()
+    mon = TrainingMonitor(client, metrics_file=path, interval=999)
+    assert mon.report_once() is None  # no file yet
+    TrainingMonitor.write_metrics(5, tokens=1000, path=path)
+    assert mon.report_once() == 5
+    assert client.steps == [(5, 1000)]
+    # same step again: not re-reported
+    assert mon.report_once() is None
+    TrainingMonitor.write_metrics(6, tokens=2000, path=path)
+    assert mon.report_once() == 6
+
+
+def test_metric_collector_snapshot(tmp_path):
+    jm = JobManager()
+    jm.register_node(node_id=0)
+    jm.register_node(node_id=1)
+    jm.handle_failure_report(1, "CUDA out of memory", "process_error", 0)
+    sm = SpeedMonitor()
+    path = str(tmp_path / "metrics.jsonl")
+    coll = JobMetricCollector(
+        "jobZ", jm, sm, reporters=[JsonFileReporter(path)], interval=999
+    )
+    snap = coll.collect_once()
+    assert snap.workers_alive == 1
+    assert snap.workers_pending == 1  # OOM replacement
+    assert snap.failure_counts.get("oom") == 1
+    with open(path) as f:
+        on_disk = json.loads(f.readline())
+    assert on_disk["job_name"] == "jobZ"
+
+
+def test_profile_fn_costs_and_timing():
+    def fn(x):
+        return x @ x
+
+    x = jnp.ones((256, 256), jnp.float32)
+    prof = profile_fn(fn, x, iters=3)
+    # 2*M*N*K flops for the matmul
+    assert prof.flops == pytest.approx(2 * 256**3, rel=0.1)
+    assert prof.wall_time_s > 0
+    assert prof.arithmetic_intensity > 0
+    assert "GFLOP" in summarize(prof, "matmul")
+
+
+def test_transformer_component_flops_sums_to_model():
+    from dlrover_tpu.models import gpt
+
+    cfg = gpt.GPTConfig.nano()
+    comp = transformer_component_flops(
+        cfg.n_layer, cfg.n_embd, cfg.block_size, cfg.vocab_size
+    )
+    total_per_token = sum(comp.values()) / cfg.block_size
+    model_estimate = gpt.flops_per_token(cfg)
+    assert total_per_token == pytest.approx(model_estimate, rel=0.05)

@@ -212,3 +212,27 @@ def test_adam8bit_small_leaves_stay_f32():
     s = opt.init(p)
     inner = s[0]  # chain: (Adam8bitState, decay..., lr scale)
     assert inner.mu["small"].dtype == jnp.float32
+
+
+def test_wsam_adaptive_perturbation_radius():
+    """ASAM mode: perturbation normalized by ||abs(p)*g|| keeps
+    ||e_w|| <= rho * max|p|; the unnormalized bug gave ~rho * max|p|^2."""
+    loss, p0 = _quadratic_problem(d=8)
+    big = {"w": p0["w"] + 100.0}  # large-magnitude params
+    rho = 0.05
+    wsam = WeightedSAM(
+        optax.sgd(0.01), rho=rho, gamma=0.9, adaptive=True,
+        learning_rate=0.01,
+    )
+    seen = []
+
+    def recording_grad_fn(p):
+        seen.append(p["w"])
+        return jax.value_and_grad(loss)(p)
+
+    wsam.make_step(recording_grad_fn)(big, wsam.init(big))
+    assert len(seen) == 2  # original + perturbed
+    e_w = seen[1] - seen[0]
+    norm = float(jnp.linalg.norm(e_w))
+    max_p = float(jnp.max(jnp.abs(big["w"])))
+    assert 0.0 < norm <= rho * max_p * 1.01

@@ -1,0 +1,185 @@
+"""Scaler / watcher / auto-scaler against the fake cluster."""
+
+import time
+
+import pytest
+
+from dlrover_tpu.common.constants import NodeStatus, NodeType
+from dlrover_tpu.common.node import Node, NodeResource
+from dlrover_tpu.master.auto_scaler import (
+    AllreduceAutoScaler,
+    LocalResourceOptimizer,
+)
+from dlrover_tpu.master.job_manager import JobManager, ScalePlan
+from dlrover_tpu.master.scaler import (
+    FakeClusterClient,
+    PodEventWatcher,
+    TPUPodScaler,
+)
+from dlrover_tpu.master.speed_monitor import SpeedMonitor
+from dlrover_tpu.scheduler import get_platform
+
+
+def _node(i, chips=4, tpu="v5p"):
+    return Node(
+        type=NodeType.WORKER,
+        id=i,
+        rank=i,
+        status=NodeStatus.PENDING,
+        config_resource=NodeResource(
+            cpu=8, memory_mb=16384, chips=chips, tpu_type=tpu
+        ),
+    )
+
+
+def test_pod_scaler_creates_pods_and_services():
+    client = FakeClusterClient()
+    scaler = TPUPodScaler("job1", client)
+    plan = ScalePlan()
+    plan.launch_nodes = [_node(0), _node(1)]
+    scaler.scale(plan)
+    pods = client.list_pods("job1")
+    assert len(pods) == 2
+    assert pods[0]["tpu_accelerator"] == "v5p"
+    assert pods[0]["tpu_chips"] == 4
+    assert "job1-worker-0" in client.services
+
+
+def test_pod_scaler_removes_pods():
+    client = FakeClusterClient()
+    scaler = TPUPodScaler("job1", client)
+    plan = ScalePlan()
+    plan.launch_nodes = [_node(0)]
+    scaler.scale(plan)
+    plan2 = ScalePlan()
+    plan2.remove_nodes = [_node(0)]
+    scaler.scale(plan2)
+    assert client.list_pods("job1") == []
+
+
+def test_pod_scaler_retries_transient_create_failure():
+    client = FakeClusterClient()
+    client.create_errors = 2
+    scaler = TPUPodScaler("job1", client, retry_interval=0.01)
+    scaler.start()
+    plan = ScalePlan()
+    plan.launch_nodes = [_node(0)]
+    scaler.scale(plan)
+    deadline = time.time() + 5
+    while time.time() < deadline and not client.list_pods("job1"):
+        time.sleep(0.02)
+    scaler.stop()
+    assert len(client.list_pods("job1")) == 1
+
+
+def test_watcher_relaunches_on_pod_failure():
+    client = FakeClusterClient()
+    scaler = TPUPodScaler("job1", client)
+    jm = JobManager(scaler=scaler)
+    watcher = PodEventWatcher("job1", client, jm)
+    node = jm.register_node(node_id=0)
+
+    plan = ScalePlan()
+    plan.launch_nodes = [_node(0)]
+    scaler.scale(plan)
+    client.fail_pod("job1-worker-0", reason="Error")
+    # drain events synchronously; the fake cluster starts the
+    # replacement pod instantly, so the full cycle lands on RUNNING
+    while not client.events.empty():
+        watcher.process_event(client.events.get())
+    assert jm.get_node(0).status == NodeStatus.RUNNING
+    # the scaler was asked to realize the replacement
+    assert any(
+        p.launch_nodes for p in scaler.executed_plans[1:]
+    )
+    assert "job1-worker-0" in {
+        p["name"] for p in client.list_pods("job1")
+    }
+
+
+def test_watcher_preemption_classified():
+    client = FakeClusterClient()
+    scaler = TPUPodScaler("job1", client)
+    jm = JobManager(scaler=scaler)
+    watcher = PodEventWatcher("job1", client, jm)
+    jm.register_node(node_id=0)
+    plan = ScalePlan()
+    plan.launch_nodes = [_node(0)]
+    scaler.scale(plan)
+    client.preempt_pod("job1-worker-0")
+    while not client.events.empty():
+        watcher.process_event(client.events.get())
+    # preempted nodes relaunch; fake cluster restarts them instantly
+    assert jm.get_node(0).status == NodeStatus.RUNNING
+    assert any(p.launch_nodes for p in scaler.executed_plans[1:])
+
+
+def test_auto_scaler_replaces_missing_workers():
+    client = FakeClusterClient()
+    scaler = TPUPodScaler("job1", client)
+    jm = JobManager(scaler=scaler)
+    for i in range(2):
+        jm.register_node(node_id=i)
+    auto = AllreduceAutoScaler(
+        jm, SpeedMonitor(), target_workers=4, interval=999
+    )
+    plan = auto.adjust_once()
+    assert plan is not None
+    assert len(plan.launch_nodes) == 2
+    # adopted into the job manager as pending
+    assert jm.get_node(2).status == NodeStatus.PENDING
+    # idempotent: pending nodes count toward the target
+    assert auto.adjust_once() is None
+
+
+def test_auto_scaler_slice_alignment():
+    opt = LocalResourceOptimizer(hosts_per_slice=4)
+    assert opt.target_worker_count(7, SpeedMonitor()) == 4
+    assert opt.target_worker_count(8, SpeedMonitor()) == 8
+    assert opt.target_worker_count(2, SpeedMonitor()) == 4
+
+
+def test_auto_scaler_grows_oom_memory():
+    client = FakeClusterClient()
+    jm = JobManager(scaler=TPUPodScaler("job1", client))
+    jm.register_node(node_id=0)
+    action = jm.handle_failure_report(
+        0, "CUDA out of memory", "process_error", 0
+    )
+    assert action == "relaunch_node"
+    node = jm.get_node(0)
+    node.config_resource = NodeResource(memory_mb=8192)
+    auto = AllreduceAutoScaler(
+        jm, SpeedMonitor(), target_workers=1, interval=999
+    )
+    auto.grow_oom_resources()
+    assert jm.get_node(0).config_resource.memory_mb == 12288
+
+
+def test_platform_factory_local_and_gated():
+    platform = get_platform("local", "jobX")
+    assert platform.client is not None
+    plan = ScalePlan()
+    plan.launch_nodes = [_node(0)]
+    platform.scaler.scale(plan)
+    assert platform.client.list_pods("jobX")
+    with pytest.raises(RuntimeError, match="kubernetes"):
+        get_platform("gke", "jobX")
+    with pytest.raises(RuntimeError, match="ray"):
+        get_platform("ray", "jobX")
+
+
+def test_node_gone_does_not_refail_pending_replacement():
+    """The pod-Deleted event that follows every relaunch (the scaler
+    removes the old pod) must not burn a second relaunch count."""
+    client = FakeClusterClient()
+    scaler = TPUPodScaler("job1", client)
+    jm = JobManager(scaler=scaler)
+    jm.register_node(node_id=0)
+    jm.handle_failure_report(0, "CUDA out of memory", "process_error", 0)
+    node = jm.get_node(0)
+    assert node.status == NodeStatus.PENDING
+    count_before = node.relaunch_count
+    jm.handle_node_gone(0, reason="Deleted")
+    assert jm.get_node(0).relaunch_count == count_before
+    assert jm.get_node(0).status == NodeStatus.PENDING
