@@ -81,6 +81,11 @@ def _build_for_strategy(
     devices,
 ):
     mesh_cfg = MeshConfig(**strategy.mesh_dict)
+    n_needed = 1
+    for _, s in strategy.mesh_shape:
+        n_needed *= s
+    if n_needed < len(devices):
+        devices = devices[:n_needed]
     mesh = build_mesh(mesh_cfg, devices=devices)
     optimizer = _make_optimizer(strategy.optimizer, learning_rate)
     init, _ = make_sharded_init(

@@ -136,6 +136,10 @@ class AgentConfig:
     rdzv_timeout: float = 600.0
     network_check: bool = False
     heartbeat_interval: float = 15.0
+    # >0 enables hang detection: restart the training process when no
+    # step progress for this many seconds (ref: atorch
+    # --relaunch_on_hanging, fault_tolerance/custom_agent.py:19).
+    hang_timeout: float = 0.0
     env: Dict[str, str] = field(default_factory=dict)
 
 
@@ -167,6 +171,7 @@ class ElasticAgent:
         self._restart_count = 0
         self._stop = threading.Event()
         self._spec: Optional[WorldSpec] = None
+        self._ckpt_saver = None
         # Set by the heartbeat thread; acted on ONLY by the monitor
         # loop so process lifecycle has a single owner (no concurrent
         # kill/spawn races).
@@ -175,10 +180,25 @@ class ElasticAgent:
     # -- process management -------------------------------------------------
 
     def _spawn(self, spec: WorldSpec) -> None:
+        # Remove the previous incarnation's step-metrics file: the
+        # hang detector and training monitor must not baseline on a
+        # stale step (a resume can legitimately restart at a LOWER
+        # step, which a stale high-water mark would misread as a hang
+        # / silence).
+        from dlrover_tpu.agent.monitor import (
+            DEFAULT_METRICS_FILE,
+            METRICS_FILE_ENV,
+        )
+
+        try:
+            os.remove(os.getenv(METRICS_FILE_ENV, DEFAULT_METRICS_FILE))
+        except OSError:
+            pass
         env = ensure_framework_on_pythonpath(dict(os.environ))
         env.update(self.config.env)
         env.update(
             {
+                "DLROVER_TPU_AGENT_PRESENT": "1",
                 NodeEnv.NODE_ID: str(self.config.node_id),
                 NodeEnv.NODE_RANK: str(spec.node_rank),
                 NodeEnv.NODE_NUM: str(spec.node_world_size),
@@ -334,24 +354,108 @@ class ElasticAgent:
             ResourceMonitor,
             TrainingMonitor,
         )
+        from dlrover_tpu.agent.paral_config_tuner import ParalConfigTuner
 
         res_mon = ResourceMonitor(self.client)
         train_mon = TrainingMonitor(self.client)
+        tuner = ParalConfigTuner(self.client)
         res_mon.start()
         train_mon.start()
+        tuner.start()
         try:
             result = self._invoke_run()
         finally:
             res_mon.stop()
             train_mon.stop()
+            tuner.stop()
             self._stop.set()
         return result
 
+    def _ensure_ckpt_saver(self, spec: WorldSpec) -> None:
+        """Start/refresh the agent-hosted flash-checkpoint saver (ref:
+        saver started at _invoke_run, elastic_agent/torch/
+        training.py:509; agent ownership means a crashed trainer's shm
+        still gets flushed). World facts refresh on every rendezvous."""
+        import os as _os
+
+        from dlrover_tpu.agent.ckpt_saver import AsyncCheckpointSaver
+
+        default_dir = _os.path.join(
+            "/tmp",
+            f"dlrover_tpu_ckpt_{_os.getenv('DLROVER_TPU_JOB_NAME', 'job')}",
+        )
+        saver = AsyncCheckpointSaver.start_async_saving_ckpt(
+            checkpoint_dir=default_dir,
+            local_shard_num=1,
+            global_shard_num=max(spec.num_processes, 1),
+            is_commit_owner=spec.node_rank == 0,
+        )
+        saver.global_shard_num = max(spec.num_processes, 1)
+        saver.is_commit_owner = spec.node_rank == 0
+        if self._ckpt_saver is None:
+            saver.register_signal_handler()
+        self._ckpt_saver = saver
+
+    def _flush_ckpt_shm(self) -> None:
+        """Persist any staged-but-unpersisted checkpoint before a
+        restart (ref: _save_ckpt_to_storage, training.py:572)."""
+        if self._ckpt_saver is not None:
+            try:
+                self._ckpt_saver.save_shm_to_storage()
+            except Exception:  # noqa: BLE001
+                logger.warning(
+                    "pre-restart checkpoint flush failed", exc_info=True
+                )
+
     def _invoke_run(self) -> int:
+        from dlrover_tpu.agent.hang_detector import HangDetector
+
+        hang = (
+            HangDetector(hang_timeout=self.config.hang_timeout)
+            if self.config.hang_timeout > 0
+            else None
+        )
         self._spec = self._rdzv.next_rendezvous()
+        self._ensure_ckpt_saver(self._spec)
         self._spawn(self._spec)
         while not self._stop.is_set():
             time.sleep(self.config.monitor_interval)
+            if hang is not None and hang.check():
+                exhausted = (
+                    self._restart_count >= self.config.max_restarts
+                )
+                logger.error(
+                    "training process hung (%.0fs without step "
+                    "progress); %s",
+                    hang.seconds_since_progress(),
+                    "giving up" if exhausted else "restarting it",
+                )
+                action = NodeAction.RESTART_IN_PLACE
+                try:
+                    action = self.client.report_failure(
+                        "training process hanging",
+                        TrainingExceptionLevel.PROCESS_ERROR,
+                        restart_count=self._restart_count,
+                        fatal=exhausted,
+                    )
+                except Exception:  # noqa: BLE001
+                    logger.warning("could not report hang", exc_info=True)
+                if exhausted:
+                    self._kill_proc()  # a hung proc still holds chips
+                    return 1
+                if action != NodeAction.RESTART_IN_PLACE:
+                    # Master took ownership (node relaunch/stop): same
+                    # handover as _handle_failure.
+                    logger.info(
+                        "master verdict %r on hang; agent stops "
+                        "supervising", action,
+                    )
+                    self._kill_proc()
+                    return 1
+                self._restart_count += 1
+                self._restart_workers()
+                hang.reset()
+                continue
             code = self._proc.poll() if self._proc else None
             if code is not None:
                 if code == 0:
@@ -419,8 +523,10 @@ class ElasticAgent:
         return True
 
     def _restart_workers(self) -> None:
+        self._flush_ckpt_shm()
         self._kill_proc()
         self._spec = self._rdzv.next_rendezvous()
+        self._ensure_ckpt_saver(self._spec)
         self._spawn(self._spec)
 
     def _membership_changed(self) -> bool:

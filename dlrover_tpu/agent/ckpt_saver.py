@@ -94,17 +94,24 @@ class AsyncCheckpointSaver:
 
     @classmethod
     def start_async_saving_ckpt(cls, **kwargs) -> "AsyncCheckpointSaver":
-        """Singleton start, mirroring the reference classmethod."""
+        """Singleton start, mirroring the reference classmethod.
+
+        The constructor's checkpoint_dir is a default: save events
+        carry the trainer's authoritative dir and the running saver
+        adopts it, so a second start with a different dir (agent
+        re-rendezvous after the trainer already saved) reuses the
+        instance instead of failing."""
         if cls._instance is None:
             cls._instance = cls(**kwargs)
             cls._instance.start()
         elif kwargs.get("checkpoint_dir", "").rstrip("/") != (
                 cls._instance.checkpoint_dir):
-            raise ValueError(
-                "AsyncCheckpointSaver already running for "
-                f"{cls._instance.checkpoint_dir!r}; a second saver for "
-                f"{kwargs.get('checkpoint_dir')!r} is not supported in "
-                "one process")
+            logger.info(
+                "reusing running checkpoint saver (dir %s; requested "
+                "%s will apply if save events name it)",
+                cls._instance.checkpoint_dir,
+                kwargs.get("checkpoint_dir"),
+            )
         return cls._instance
 
     def start(self) -> None:
@@ -161,6 +168,12 @@ class AsyncCheckpointSaver:
                 return  # server shut down
             if event.get("type") == "save":
                 step = int(event["step"])
+                evt_dir = (event.get("dir") or "").rstrip("/")
+                if evt_dir and evt_dir != self.checkpoint_dir:
+                    logger.info(
+                        "adopting trainer checkpoint dir %s", evt_dir
+                    )
+                    self.checkpoint_dir = evt_dir
                 try:
                     self.save_step_checkpoint(step)
                 except Exception:  # noqa: BLE001

@@ -1,0 +1,84 @@
+"""Training-hang detection (agent side).
+
+Parity with atorch's fault-tolerance hang detector
+(atorch/fault_tolerance/hanging_detector.py:86 + custom_agent.py:19
+LocalDetectHangingAgent): the torch version has every rank write a
+heartbeat tensor through the c10d store and relaunches workers when it
+stalls. Here the signal is the step-metrics file the training process
+already writes (agent/monitor.py TrainingMonitor.write_metrics) — a
+training process that is alive but making no step progress for
+``hang_timeout`` seconds is hung (deadlocked collective, stuck host
+callback, wedged TPU runtime) and gets restarted by the agent.
+
+Distinct from the master's heartbeat timeout (job_manager.py): that
+catches dead *agents*; this catches live agents whose *training
+process* stopped stepping.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import time
+from typing import Optional
+
+from dlrover_tpu.agent.monitor import (
+    DEFAULT_METRICS_FILE,
+    METRICS_FILE_ENV,
+)
+from dlrover_tpu.common.log import get_logger
+
+logger = get_logger("hang_detector")
+
+
+class HangDetector:
+    """Tracks step progress; ``check()`` returns True when hung.
+
+    ``startup_grace`` covers compilation: the first step legitimately
+    takes minutes on TPU (cold jit), so the clock only starts after
+    the first step lands or the grace expires.
+    """
+
+    def __init__(
+        self,
+        hang_timeout: float = 600.0,
+        startup_grace: float = 1800.0,
+        metrics_file: Optional[str] = None,
+    ):
+        self.hang_timeout = hang_timeout
+        self.startup_grace = startup_grace
+        self.metrics_file = metrics_file or os.getenv(
+            METRICS_FILE_ENV, DEFAULT_METRICS_FILE
+        )
+        self.reset()
+
+    def reset(self) -> None:
+        self._started_at = time.time()
+        self._last_step = -1
+        self._last_progress = time.time()
+
+    def _read_step(self) -> Optional[int]:
+        try:
+            with open(self.metrics_file) as f:
+                return int(json.load(f).get("step", -1))
+        except (OSError, ValueError):
+            return None
+
+    def check(self) -> bool:
+        """True when the training process should be considered hung."""
+        now = time.time()
+        step = self._read_step()
+        # ANY step change counts as progress: a resume may restart at
+        # a LOWER step than the previous incarnation's high-water mark
+        # (the agent also clears the file on spawn, belt and braces).
+        if step is not None and step != self._last_step:
+            self._last_step = step
+            self._last_progress = now
+            return False
+        if self._last_step < 0:
+            # still compiling / warming up
+            return now - self._started_at > self.startup_grace
+        return now - self._last_progress > self.hang_timeout
+
+    def seconds_since_progress(self) -> float:
+        return time.time() - self._last_progress

@@ -262,6 +262,14 @@ class MasterClient:
             msg.RestoreShardRequest(dataset_name=dataset_name, content=content)
         )
 
+    # -- auto-tuning --------------------------------------------------------
+
+    def get_parallel_config(self):
+        """Master-pushed tuning config (ref ParalConfigTuner)."""
+        return self._client.get(
+            msg.ParallelConfigRequest(node_id=self.node_id)
+        )
+
     # -- metrics ------------------------------------------------------------
 
     def report_step(self, step: int, tokens: int = 0):

@@ -106,6 +106,7 @@ class TrainingMonitor:
         )
         self.interval = interval
         self._last_step = -1
+        self._last_tokens = 0
         self._stop = threading.Event()
         self._thread: Optional[threading.Thread] = None
 
@@ -130,11 +131,21 @@ class TrainingMonitor:
         except (OSError, ValueError):
             return None
         step = int(data.get("step", -1))
-        if step <= self._last_step:
+        if step == self._last_step:
             return None
+        if step < self._last_step:
+            # Training process restarted at an earlier step (resume
+            # from checkpoint / from scratch): re-baseline instead of
+            # going silent until the old high-water mark is passed.
+            self._last_tokens = 0
         self._last_step = step
+        # The metrics file carries a CUMULATIVE token count; the
+        # master's speed monitor accumulates per-report deltas.
+        tokens = int(data.get("tokens", 0))
+        delta = max(tokens - self._last_tokens, 0)
+        self._last_tokens = tokens
         try:
-            self.client.report_step(step, int(data.get("tokens", 0)))
+            self.client.report_step(step, delta)
         except Exception:  # noqa: BLE001
             logger.debug("step report failed", exc_info=True)
         return step

@@ -1,0 +1,176 @@
+"""Brain service: historical job metrics -> resource plans.
+
+Algorithms re-derived from the reference's optalgorithm set
+(go/brain/pkg/optimizer/implementation/optalgorithm/):
+
+* ``optimize_job_resource`` — initial plan from similar completed jobs
+  (optimize_job_worker_create_resource.go): median of what worked.
+* ``optimize_worker_oom`` — grow memory after OOM
+  (optimize_job_worker_resource.go): max(seen peak * 1.5, request * 2).
+* ``optimize_worker_count`` — throughput-knee detection
+  (optimize_job_worker_count.go): stop adding workers when marginal
+  speedup per worker drops below a threshold.
+
+The datastore is sqlite (stdlib) instead of MySQL — same schema shape
+(job facts + runtime samples), zero deployment burden.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import json
+import sqlite3
+import threading
+import time
+from typing import Dict, List, Optional
+
+from dlrover_tpu.common.log import get_logger
+from dlrover_tpu.common.node import NodeResource
+from dlrover_tpu.master.auto_scaler import ResourceOptimizer
+from dlrover_tpu.master.speed_monitor import SpeedMonitor
+
+logger = get_logger("brain")
+
+
+@dataclasses.dataclass
+class JobMetricsRecord:
+    job_name: str
+    model_signature: str  # e.g. "gpt2-124m" — similarity key
+    workers: int
+    memory_mb: int
+    chips_per_worker: int
+    throughput: float  # samples or tokens / s
+    peak_memory_mb: int = 0
+    oom: bool = False
+    completed: bool = True
+    timestamp: float = 0.0
+
+
+class BrainService:
+    def __init__(self, db_path: str = ":memory:"):
+        self._db = sqlite3.connect(db_path, check_same_thread=False)
+        self._lock = threading.Lock()
+        self._db.execute(
+            """CREATE TABLE IF NOT EXISTS job_metrics (
+                job_name TEXT, model_signature TEXT, workers INT,
+                memory_mb INT, chips_per_worker INT, throughput REAL,
+                peak_memory_mb INT, oom INT, completed INT,
+                timestamp REAL
+            )"""
+        )
+
+    def persist_metrics(self, rec: JobMetricsRecord) -> None:
+        with self._lock:
+            self._db.execute(
+                "INSERT INTO job_metrics VALUES (?,?,?,?,?,?,?,?,?,?)",
+                (
+                    rec.job_name,
+                    rec.model_signature,
+                    rec.workers,
+                    rec.memory_mb,
+                    rec.chips_per_worker,
+                    rec.throughput,
+                    rec.peak_memory_mb,
+                    int(rec.oom),
+                    int(rec.completed),
+                    rec.timestamp or time.time(),
+                ),
+            )
+            self._db.commit()
+
+    def _rows(self, signature: str) -> List[tuple]:
+        with self._lock:
+            cur = self._db.execute(
+                "SELECT workers, memory_mb, chips_per_worker, "
+                "throughput, peak_memory_mb, oom, completed "
+                "FROM job_metrics WHERE model_signature = ?",
+                (signature,),
+            )
+            return cur.fetchall()
+
+    # -- algorithms ---------------------------------------------------------
+
+    def optimize_job_resource(
+        self, signature: str
+    ) -> Optional[Dict]:
+        """Initial plan from successful history: median worker count
+        and the max memory that never OOM'd."""
+        rows = [r for r in self._rows(signature) if r[6]]  # completed
+        if not rows:
+            return None
+        workers = sorted(r[0] for r in rows)
+        memory = [r[1] for r in rows if not r[5]]
+        plan = {
+            "workers": workers[len(workers) // 2],
+            "memory_mb": max(memory) if memory else max(
+                r[1] for r in rows
+            ),
+            "chips_per_worker": rows[-1][2],
+        }
+        return plan
+
+    def optimize_worker_oom(
+        self, signature: str, requested_mb: int
+    ) -> int:
+        """Memory for an OOM retry: above every observed peak."""
+        rows = self._rows(signature)
+        peaks = [r[4] for r in rows if r[4] > 0]
+        candidate = int(max(peaks) * 1.5) if peaks else requested_mb * 2
+        return max(candidate, int(requested_mb * 1.5))
+
+    def optimize_worker_count(
+        self, signature: str, min_marginal_gain: float = 0.6
+    ) -> Optional[int]:
+        """Largest worker count whose marginal throughput per added
+        worker stays above ``min_marginal_gain`` x linear scaling."""
+        rows = [r for r in self._rows(signature) if r[3] > 0]
+        if len(rows) < 2:
+            return None
+        by_workers: Dict[int, float] = {}
+        for r in rows:
+            by_workers[r[0]] = max(by_workers.get(r[0], 0.0), r[3])
+        counts = sorted(by_workers)
+        best = counts[0]
+        for prev, cur in zip(counts, counts[1:]):
+            gain = by_workers[cur] - by_workers[prev]
+            linear = by_workers[prev] / prev * (cur - prev)
+            if linear > 0 and gain / linear >= min_marginal_gain:
+                best = cur
+            else:
+                break
+        return best
+
+
+class BrainResourceOptimizer(ResourceOptimizer):
+    """Plugs the Brain into the master's auto-scaler (ref
+    brain_optimizer.py BrainResoureOptimizer)."""
+
+    def __init__(
+        self,
+        brain: BrainService,
+        signature: str,
+        min_workers: int = 1,
+        max_workers: int = 64,
+        hosts_per_slice: int = 1,
+    ):
+        self.brain = brain
+        self.signature = signature
+        self.min_workers = min_workers
+        self.max_workers = max_workers
+        self.hosts_per_slice = max(hosts_per_slice, 1)
+
+    def optimize_oom_node(self, resource: NodeResource) -> NodeResource:
+        grown = NodeResource.from_dict(resource.to_dict())
+        grown.memory_mb = self.brain.optimize_worker_oom(
+            self.signature, max(resource.memory_mb, 1024)
+        )
+        return grown
+
+    def target_worker_count(
+        self, current: int, speed_monitor: SpeedMonitor
+    ) -> int:
+        suggested = self.brain.optimize_worker_count(self.signature)
+        target = suggested if suggested is not None else current
+        target = max(self.min_workers, min(target, self.max_workers))
+        target -= target % self.hosts_per_slice
+        return max(target, self.hosts_per_slice)

@@ -45,6 +45,9 @@ class MasterServicer:
         self.speed_monitor = speed_monitor or SpeedMonitor()
         # actions queued for agents, popped on heartbeat
         self._pending_actions: dict[int, str] = {}
+        # auto-tuner output pulled by agents (ref: master-pushed
+        # ParallelConfig, elastic_agent/config/paral_config_tuner.py)
+        self.parallel_config = msg.ParallelConfig()
 
     def _rdzv(self, name: str):
         mgr = self.rdzv_managers.get(name or RendezvousName.TRAINING)
@@ -251,5 +254,10 @@ class MasterServicer:
         return msg.JobNodesResponse(nodes=nodes)
 
     def _get_parallel_config(self, req: msg.ParallelConfigRequest):
-        # Filled in by the auto-tuner (master/auto_scaler); default empty.
-        return msg.ParallelConfig()
+        return self.parallel_config
+
+    def set_parallel_config(self, config: msg.ParallelConfig) -> None:
+        """Called by the auto-tuner; version bump tells agents to
+        apply it at the next restart."""
+        config.version = self.parallel_config.version + 1
+        self.parallel_config = config

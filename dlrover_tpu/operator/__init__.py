@@ -1,0 +1,18 @@
+"""ElasticJob operator (controller/reconciler).
+
+Functional parity with the reference's Go operator
+(dlrover/go/operator/: ElasticJob + ScalePlan CRDs, reconciler that
+creates the job-master pod and delegates pod lifecycle to it). The
+reference requires a Go controller because it lives inside
+kubernetes' controller-runtime; this build has no Go toolchain, so the
+same reconcile semantics are implemented as a Python controller over
+the ClusterClient seam — swap FakeClusterClient for the GKE client to
+run it against a real cluster.
+"""
+
+from dlrover_tpu.operator.controller import (  # noqa: F401
+    ElasticJob,
+    ElasticJobController,
+    JobPhase,
+    ReplicaSpec,
+)

@@ -1,0 +1,233 @@
+"""ElasticJob controller: reconcile jobs into master + worker pods.
+
+Semantics ported from the reference's reconciler
+(go/operator/pkg/controllers/elasticjob_controller.go:85 Reconcile,
+master pod factory controllers/master/master.go, ScalePlan executor):
+
+* An ElasticJob first gets a job-master pod; workers are NOT created
+  by the operator — the master creates/scales them (the reference
+  delegates pod lifecycle to the master the same way).
+* ScalePlan custom objects written by an ElasticJobScaler are executed
+  here (create/remove worker pods) for masters that don't own a pod
+  scaler themselves.
+* Job phase tracking: Pending -> Running -> Succeeded/Failed, with
+  master-pod restart up to ``master_restart_limit``.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import threading
+import time
+from typing import Dict, List, Optional
+
+from dlrover_tpu.common.log import get_logger
+from dlrover_tpu.common.node import NodeResource
+from dlrover_tpu.master.scaler import ClusterClient
+
+logger = get_logger("operator")
+
+
+class JobPhase:
+    PENDING = "Pending"
+    RUNNING = "Running"
+    SUCCEEDED = "Succeeded"
+    FAILED = "Failed"
+
+
+@dataclasses.dataclass
+class ReplicaSpec:
+    """(ref ReplicaSpec in elasticjob_types.go:29-67)"""
+
+    replicas: int = 1
+    min_replicas: int = 0  # 0 -> replicas (no elasticity)
+    resource: NodeResource = dataclasses.field(
+        default_factory=NodeResource
+    )
+    restart_limit: int = 3
+
+
+@dataclasses.dataclass
+class ElasticJob:
+    name: str
+    workers: ReplicaSpec = dataclasses.field(default_factory=ReplicaSpec)
+    master_resource: NodeResource = dataclasses.field(
+        default_factory=lambda: NodeResource(cpu=2, memory_mb=4096)
+    )
+    master_restart_limit: int = 2
+    # command/image fields would go in the pod template in production
+    pod_template: Dict = dataclasses.field(default_factory=dict)
+    # status
+    phase: str = JobPhase.PENDING
+    master_restarts: int = 0
+
+
+class ElasticJobController:
+    """One reconcile loop over a set of ElasticJobs."""
+
+    def __init__(self, client: ClusterClient, interval: float = 5.0):
+        self.client = client
+        self.interval = interval
+        self.jobs: Dict[str, ElasticJob] = {}
+        self._executed_plans: set = set()
+        self._stop = threading.Event()
+        self._thread: Optional[threading.Thread] = None
+
+    # -- API ----------------------------------------------------------------
+
+    def create_job(self, job: ElasticJob) -> None:
+        self.jobs[job.name] = job
+        self.reconcile(job.name)
+
+    def delete_job(self, name: str) -> None:
+        job = self.jobs.pop(name, None)
+        if job is None:
+            return
+        for pod in self.client.list_pods(name):
+            try:
+                self.client.delete_pod(pod["name"])
+            except Exception:  # noqa: BLE001
+                logger.warning(
+                    "delete pod %s failed", pod["name"], exc_info=True
+                )
+
+    # -- reconcile ----------------------------------------------------------
+
+    def master_pod_name(self, job_name: str) -> str:
+        return f"{job_name}-master"
+
+    def reconcile(self, name: str) -> None:
+        """One reconcile pass for one job (ref Reconcile,
+        elasticjob_controller.go:85)."""
+        job = self.jobs.get(name)
+        if job is None or job.phase in (
+            JobPhase.SUCCEEDED,
+            JobPhase.FAILED,
+        ):
+            return
+        pods = {p["name"]: p for p in self.client.list_pods(name)}
+        master_name = self.master_pod_name(name)
+        master = pods.get(master_name)
+
+        if master is None:
+            if job.phase == JobPhase.RUNNING:
+                # master pod vanished mid-job
+                job.master_restarts += 1
+                if job.master_restarts > job.master_restart_limit:
+                    job.phase = JobPhase.FAILED
+                    logger.error(
+                        "job %s: master restart limit exceeded", name
+                    )
+                    return
+                logger.warning(
+                    "job %s: master pod gone; recreating (%d/%d)",
+                    name,
+                    job.master_restarts,
+                    job.master_restart_limit,
+                )
+            self._create_master_pod(job)
+            job.phase = JobPhase.RUNNING
+            return
+
+        phase = master.get("phase", "")
+        if phase == "Succeeded":
+            job.phase = JobPhase.SUCCEEDED
+        elif phase == "Failed":
+            job.master_restarts += 1
+            if job.master_restarts > job.master_restart_limit:
+                job.phase = JobPhase.FAILED
+            else:
+                self.client.delete_pod(master_name)
+                self._create_master_pod(job)
+        else:
+            job.phase = JobPhase.RUNNING
+        self._execute_scale_plans(job)
+
+    def _create_master_pod(self, job: ElasticJob) -> None:
+        spec = dict(job.pod_template)
+        spec.update(
+            {
+                "name": self.master_pod_name(job.name),
+                "job": job.name,
+                "type": "master",
+                "node_id": -1,
+                "cpu": job.master_resource.cpu,
+                "memory_mb": job.master_resource.memory_mb,
+                # the master learns its world from the job spec
+                "env": {
+                    "DLROVER_TPU_NODE_NUM": str(job.workers.replicas),
+                    "DLROVER_TPU_MIN_NODES": str(
+                        job.workers.min_replicas
+                        or job.workers.replicas
+                    ),
+                },
+            }
+        )
+        self.client.create_pod(spec)
+
+    def _execute_scale_plans(self, job: ElasticJob) -> None:
+        """Execute ScalePlan custom objects written for this job (ref
+        the operator's ScalePlan controller)."""
+        plans = getattr(self.client, "custom_objects", {})
+        for plan_name, body in list(plans.items()):
+            if (
+                body.get("job") != job.name
+                or plan_name in self._executed_plans
+            ):
+                continue
+            self._executed_plans.add(plan_name)
+            for item in body.get("launch", []):
+                spec = dict(job.pod_template)
+                res = item.get("resource", {})
+                spec.update(
+                    {
+                        "name": f"{job.name}-worker-{item['id']}",
+                        "job": job.name,
+                        "type": item.get("type", "worker"),
+                        "node_id": item["id"],
+                        "rank": item.get("rank", item["id"]),
+                        "cpu": res.get("cpu", 0),
+                        "memory_mb": res.get("memory_mb", 0),
+                        "tpu_accelerator": res.get("tpu_type", ""),
+                        "tpu_chips": res.get("chips", 0),
+                    }
+                )
+                try:
+                    self.client.create_pod(spec)
+                except Exception:  # noqa: BLE001
+                    logger.warning(
+                        "scaleplan %s: create worker %s failed",
+                        plan_name,
+                        spec["name"],
+                        exc_info=True,
+                    )
+            for node_id in body.get("remove", []):
+                try:
+                    self.client.delete_pod(
+                        f"{job.name}-worker-{node_id}"
+                    )
+                except Exception:  # noqa: BLE001
+                    pass
+
+    # -- loop ---------------------------------------------------------------
+
+    def start(self) -> None:
+        if self._thread is None:
+            self._thread = threading.Thread(
+                target=self._loop, name="elasticjob-controller",
+                daemon=True,
+            )
+            self._thread.start()
+
+    def stop(self) -> None:
+        self._stop.set()
+
+    def _loop(self) -> None:
+        while not self._stop.wait(self.interval):
+            for name in list(self.jobs):
+                try:
+                    self.reconcile(name)
+                except Exception:  # noqa: BLE001
+                    logger.warning(
+                        "reconcile %s failed", name, exc_info=True
+                    )

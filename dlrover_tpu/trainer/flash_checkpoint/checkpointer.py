@@ -68,6 +68,9 @@ class Checkpointer:
         self.engine = CheckpointEngine(
             checkpoint_dir, local_rank=local_rank
         )
+        # Step of the checkpoint most recently restored by
+        # load_checkpoint (-1 = none restored yet).
+        self.last_restored_step = -1
 
     def save_checkpoint(
         self,
@@ -85,9 +88,18 @@ class Checkpointer:
 
     def load_checkpoint(self, like, shardings=None,
                         step: Optional[int] = None):
-        """Restore the latest committed checkpoint, resharded onto the
-        current mesh via ``shardings``. None if no checkpoint."""
-        return self.engine.load(like, shardings=shardings, step=step)
+        """Restore a committed checkpoint (the latest, or ``step=``),
+        resharded onto the current mesh via ``shardings``. Returns the
+        restored state pytree (shaped like ``like``), or None if no
+        checkpoint; the step actually restored is in
+        ``last_restored_step`` (NOT latest_step(), which may be newer
+        when rolling back with step=)."""
+        res = self.engine.load(like, shardings=shardings, step=step)
+        if res is None:
+            return None
+        found_step, state, _ = res
+        self.last_restored_step = found_step
+        return state
 
     def latest_step(self) -> int:
         return self.engine.latest_step()

@@ -209,7 +209,15 @@ class CheckpointEngine:
         if not self.save_to_memory(step, state, extra):
             return False
         if self._events is not None:
-            self._events.put({"type": "save", "step": step})
+            # The agent-hosted saver learns the checkpoint dir from the
+            # event: the agent starts before any trainer chose a dir.
+            self._events.put(
+                {
+                    "type": "save",
+                    "step": step,
+                    "dir": self.checkpoint_dir,
+                }
+            )
         return True
 
     def wait_persisted(self, step: int, timeout: float = 60.0) -> bool:

@@ -1,0 +1,205 @@
+"""High-level Trainer: the one-object training loop.
+
+Parity with AtorchTrainer (atorch/trainer/atorch_trainer.py:121, an
+HF-Trainer-style loop integrating auto_accelerate + flash checkpoint
+saves): give it a functional model and a dataset, call ``train()``.
+Integrates every layer of this framework: strategy (explicit or
+searched), mesh + sharded step, fixed-global-batch accumulation,
+checkpointable sampler, flash checkpoint save/restore, step-metrics
+file for the agent's monitors, and master-pushed parallel-config
+overrides when running under the elastic agent.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import os
+import tempfile
+import time
+from typing import Any, Callable, Optional, Tuple
+
+import numpy as np
+
+from dlrover_tpu.common.log import get_logger
+
+logger = get_logger("trainer")
+
+
+@dataclasses.dataclass
+class TrainingArguments:
+    """(ref transformers.TrainingArguments subset the AtorchTrainer
+    consumes, atorch_trainer.py:121)"""
+
+    max_steps: int = 1000
+    global_batch_size: int = 32
+    micro_batch_size: int = 4
+    learning_rate: float = 3e-4
+    optimizer: str = "adamw"
+    checkpoint_dir: str = ""
+    save_steps: int = 100
+    log_steps: int = 10
+    seed: int = 0
+    strategy: Optional[Any] = None  # accelerate.Strategy or None=search
+    apply_paral_config: bool = True
+
+
+class Trainer:
+    def __init__(
+        self,
+        model_init: Callable,
+        model_loss: Callable,
+        logical_axes: Any,
+        dataset,  # map-style: dataset[i] -> (tokens, targets)
+        args: TrainingArguments,
+        collate_fn: Optional[Callable] = None,
+    ):
+        self.args = args
+        self.model_init = model_init
+        self.model_loss = model_loss
+        self.logical_axes = logical_axes
+        self.dataset = dataset
+        self.collate_fn = collate_fn
+
+        if args.apply_paral_config:
+            self._apply_paral_config()
+
+    def _apply_paral_config(self) -> None:
+        """Master-pushed overrides staged by the agent's tuner. Only
+        applied when actually running under the elastic agent — a
+        standalone run must not pick up another job's leftover file."""
+        if os.getenv("DLROVER_TPU_AGENT_PRESENT", "") != "1":
+            return
+        from dlrover_tpu.agent.paral_config_tuner import (
+            read_parallel_config,
+        )
+
+        cfg = read_parallel_config()
+        if not cfg:
+            return
+        if cfg.get("micro_batch_size"):
+            self.args.micro_batch_size = int(cfg["micro_batch_size"])
+            logger.info(
+                "paral config v%s: micro_batch_size=%d",
+                cfg.get("version"),
+                self.args.micro_batch_size,
+            )
+
+    def train(self) -> dict:
+        import jax
+        import jax.numpy as jnp
+
+        from dlrover_tpu.accelerate import auto_accelerate
+        from dlrover_tpu.agent.monitor import TrainingMonitor
+        from dlrover_tpu.trainer import jax_env
+        from dlrover_tpu.trainer.elastic_trainer import (
+            ElasticDataLoader,
+            ElasticDistributedSampler,
+            ElasticTrainer,
+        )
+        from dlrover_tpu.trainer.flash_checkpoint.checkpointer import (
+            Checkpointer,
+            StorageType,
+        )
+
+        args = self.args
+        jax_env.setup_distributed()
+
+        first = self.dataset[0]
+        sample = (
+            jnp.asarray(first[0])[None],
+            jnp.asarray(first[1])[None],
+        )
+        res = auto_accelerate(
+            self.model_init,
+            self.model_loss,
+            self.logical_axes,
+            sample,
+            learning_rate=args.learning_rate,
+            strategy=args.strategy,
+        )
+        trainer = ElasticTrainer(
+            res.mesh,
+            self.model_loss,
+            res.optimizer,
+            global_batch_size=args.global_batch_size,
+            micro_batch_size=args.micro_batch_size,
+        )
+        params, opt_state = res.init_fn(
+            jax.random.PRNGKey(args.seed)
+        )
+
+        ckpt_dir = args.checkpoint_dir or os.path.join(
+            tempfile.gettempdir(), "dlrover_tpu_trainer_ckpt"
+        )
+        ckpt = Checkpointer(ckpt_dir)
+        sampler = ElasticDistributedSampler(
+            dataset_size=len(self.dataset),
+            num_shards=jax_env.num_processes(),
+            shard_rank=max(jax_env.process_id(), 0),
+            seed=args.seed,
+        )
+        start_step = 0
+        restored = ckpt.load_checkpoint((params, opt_state))
+        if restored is not None:
+            params, opt_state = restored
+            start_step = ckpt.last_restored_step
+            sampler.consumed = (
+                start_step * args.global_batch_size
+            ) % max(len(self.dataset), 1)
+            logger.info("resumed from checkpoint step %d", start_step)
+        trainer.step_num = start_step
+
+        loader = ElasticDataLoader(
+            self.dataset,
+            batch_size=trainer.samples_per_step,
+            sampler=sampler,
+            collate_fn=self.collate_fn,
+        )
+        it = iter(loader)
+
+        losses = []
+        t0 = time.time()
+        step = start_step
+        for step in range(start_step + 1, args.max_steps + 1):
+            try:
+                tokens, targets = next(it)
+            except StopIteration:
+                sampler.set_epoch(sampler.epoch + 1)
+                it = iter(loader)
+                tokens, targets = next(it)
+            params, opt_state, loss = trainer.train_step(
+                params, opt_state, jnp.asarray(tokens),
+                jnp.asarray(targets),
+            )
+            losses.append(float(loss))
+            TrainingMonitor.write_metrics(
+                step,
+                tokens=step
+                * args.global_batch_size
+                * np.asarray(tokens).shape[-1],
+            )
+            if step % args.log_steps == 0:
+                logger.info(
+                    "step %d: loss %.4f (%.1f steps/s)",
+                    step,
+                    losses[-1],
+                    args.log_steps / max(time.time() - t0, 1e-9),
+                )
+                t0 = time.time()
+            if args.save_steps and step % args.save_steps == 0:
+                ckpt.save_checkpoint(
+                    step, (params, opt_state),
+                    storage_type=StorageType.DISK,
+                )
+        ckpt.save_checkpoint(
+            step, (params, opt_state), storage_type=StorageType.DISK
+        )
+        ckpt.wait_latest_checkpoint()
+        ckpt.close()
+        return {
+            "final_step": step,
+            "final_loss": losses[-1] if losses else None,
+            "params": params,
+            "opt_state": opt_state,
+            "strategy": res.strategy,
+        }

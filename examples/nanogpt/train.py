@@ -30,7 +30,8 @@ import numpy as np
 
 def parse_args(argv=None):
     p = argparse.ArgumentParser()
-    p.add_argument("--steps", type=int, default=50)
+    p.add_argument("--steps", type=int, default=0,
+                   help="0 = default (50, or 8 with --smoke)")
     p.add_argument("--global-batch-size", type=int, default=32)
     p.add_argument("--micro-batch-size", type=int, default=4)
     p.add_argument("--block-size", type=int, default=128)
@@ -84,9 +85,12 @@ def main(argv=None) -> int:
             n_head=2, n_embd=64,
             dtype=jnp.float32, remat=False,
         )
-        args.steps = min(args.steps, 8)
+        if args.steps <= 0:
+            args.steps = 8
     else:
         cfg = gpt.GPTConfig.nano()
+        if args.steps <= 0:
+            args.steps = 50
 
     model_init = functools.partial(gpt.init_params, cfg=cfg)
     model_loss = functools.partial(gpt.loss_fn, cfg=cfg)
@@ -127,7 +131,7 @@ def main(argv=None) -> int:
     restored = ckpt.load_checkpoint((params, opt_state))
     if restored is not None:
         params, opt_state = restored
-        start_step = ckpt.latest_step()
+        start_step = ckpt.last_restored_step
         print(f"restored checkpoint at step {start_step}")
 
     sampler = ElasticDistributedSampler(
@@ -151,6 +155,7 @@ def main(argv=None) -> int:
 
     t0 = time.time()
     tokens_seen = 0
+    loss = float("nan")  # stays NaN when fully resumed (no steps left)
     for step in range(start_step + 1, args.steps + 1):
         tok, tgt = next_batch(trainer.samples_per_step)
         params, opt_state, loss = trainer.train_step(

@@ -34,3 +34,19 @@ def fresh_context():
     Context.reset()
     yield Context.singleton()
     Context.reset()
+
+
+@pytest.fixture(autouse=True)
+def _teardown_ckpt_saver_singleton():
+    """The agent-hosted AsyncCheckpointSaver is a process singleton;
+    a test that started one (agent run paths) must not pin its
+    checkpoint dir for later tests' standalone Checkpointers."""
+    yield
+    from dlrover_tpu.agent.ckpt_saver import AsyncCheckpointSaver
+
+    inst = AsyncCheckpointSaver._instance
+    if inst is not None:
+        try:
+            inst.close()
+        except Exception:  # noqa: BLE001
+            pass

@@ -299,6 +299,7 @@ def test_node_gone_requeues_shards_via_listener():
     from dlrover_tpu.master.master import JobMaster
 
     master = JobMaster(node_num=2, rdzv_timeout=1)
+    master.prepare()
     try:
         jm = master.job_manager
         jm.register_node(node_id=0)

@@ -106,3 +106,20 @@ def test_transformer_component_flops_sums_to_model():
     total_per_token = sum(comp.values()) / cfg.block_size
     model_estimate = gpt.flops_per_token(cfg)
     assert total_per_token == pytest.approx(model_estimate, rel=0.05)
+
+
+def test_training_monitor_reports_token_deltas_and_restarts(tmp_path):
+    """Cumulative token counts become per-report deltas; a restart at
+    a lower step re-baselines instead of going silent."""
+    path = str(tmp_path / "metrics.json")
+    client = FakeClient()
+    mon = TrainingMonitor(client, metrics_file=path, interval=999)
+    TrainingMonitor.write_metrics(1, tokens=1000, path=path)
+    mon.report_once()
+    TrainingMonitor.write_metrics(2, tokens=2500, path=path)
+    mon.report_once()
+    assert client.steps == [(1, 1000), (2, 1500)]  # deltas
+    # restart: resume at step 1 with fresh cumulative counter
+    TrainingMonitor.write_metrics(1, tokens=800, path=path)
+    assert mon.report_once() == 1
+    assert client.steps[-1] == (1, 800)

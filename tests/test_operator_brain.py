@@ -1,0 +1,157 @@
+"""ElasticJob controller + Brain service."""
+
+import pytest
+
+from dlrover_tpu.brain import (
+    BrainResourceOptimizer,
+    BrainService,
+    JobMetricsRecord,
+)
+from dlrover_tpu.common.node import Node, NodeResource
+from dlrover_tpu.master.job_manager import JobManager, ScalePlan
+from dlrover_tpu.master.scaler import ElasticJobScaler, FakeClusterClient
+from dlrover_tpu.master.speed_monitor import SpeedMonitor
+from dlrover_tpu.operator import (
+    ElasticJob,
+    ElasticJobController,
+    JobPhase,
+    ReplicaSpec,
+)
+
+
+# -- operator ---------------------------------------------------------------
+
+
+def test_controller_creates_master_pod():
+    client = FakeClusterClient()
+    ctl = ElasticJobController(client)
+    job = ElasticJob(name="j1", workers=ReplicaSpec(replicas=4))
+    ctl.create_job(job)
+    pods = client.list_pods("j1")
+    assert [p["name"] for p in pods] == ["j1-master"]
+    assert pods[0]["env"]["DLROVER_TPU_NODE_NUM"] == "4"
+    assert job.phase == JobPhase.RUNNING
+
+
+def test_controller_restarts_failed_master_up_to_limit():
+    client = FakeClusterClient()
+    ctl = ElasticJobController(client)
+    job = ElasticJob(name="j1", master_restart_limit=1)
+    ctl.create_job(job)
+    # master dies once: recreated
+    client.fail_pod("j1-master")
+    ctl.reconcile("j1")
+    assert client.list_pods("j1")  # recreated
+    assert job.master_restarts == 1
+    # dies again: limit exceeded -> job failed
+    client.fail_pod("j1-master")
+    ctl.reconcile("j1")
+    assert job.phase == JobPhase.FAILED
+
+
+def test_controller_job_succeeds_with_master():
+    client = FakeClusterClient()
+    ctl = ElasticJobController(client)
+    job = ElasticJob(name="j1")
+    ctl.create_job(job)
+    client.pods["j1-master"]["phase"] = "Succeeded"
+    ctl.reconcile("j1")
+    assert job.phase == JobPhase.SUCCEEDED
+
+
+def test_controller_executes_scaleplan_objects():
+    """ElasticJobScaler writes ScalePlan custom objects; the operator
+    realizes them (the reference's split of responsibilities)."""
+    client = FakeClusterClient()
+    ctl = ElasticJobController(client)
+    job = ElasticJob(name="j1")
+    ctl.create_job(job)
+
+    scaler = ElasticJobScaler("j1", client)
+    plan = ScalePlan()
+    plan.launch_nodes = [
+        Node(
+            type="worker", id=0, rank=0,
+            config_resource=NodeResource(
+                cpu=4, memory_mb=8192, chips=4, tpu_type="v5p"
+            ),
+        )
+    ]
+    scaler.scale(plan)
+    ctl.reconcile("j1")
+    names = {p["name"] for p in client.list_pods("j1")}
+    assert names == {"j1-master", "j1-worker-0"}
+    worker = client.pods["j1-worker-0"]
+    assert worker["tpu_accelerator"] == "v5p"
+    # plans execute once, not repeatedly
+    client.delete_pod("j1-worker-0")
+    ctl.reconcile("j1")
+    assert "j1-worker-0" not in client.pods
+
+
+def test_controller_delete_job_cleans_pods():
+    client = FakeClusterClient()
+    ctl = ElasticJobController(client)
+    ctl.create_job(ElasticJob(name="j1"))
+    ctl.delete_job("j1")
+    assert client.list_pods("j1") == []
+
+
+# -- brain ------------------------------------------------------------------
+
+
+def _seed_brain():
+    brain = BrainService()
+    runs = [
+        (2, 8192, 100.0, 6000, False),
+        (4, 8192, 190.0, 6500, False),
+        (8, 8192, 360.0, 7000, False),
+        (16, 8192, 400.0, 7000, False),  # scaling knee past 8
+        (4, 4096, 0.0, 4096, True),  # an OOM run
+    ]
+    for i, (w, mem, tput, peak, oom) in enumerate(runs):
+        brain.persist_metrics(
+            JobMetricsRecord(
+                job_name=f"job{i}",
+                model_signature="gpt-test",
+                workers=w,
+                memory_mb=mem,
+                chips_per_worker=4,
+                throughput=tput,
+                peak_memory_mb=peak,
+                oom=oom,
+                completed=not oom,
+            )
+        )
+    return brain
+
+
+def test_brain_initial_plan_from_history():
+    brain = _seed_brain()
+    plan = brain.optimize_job_resource("gpt-test")
+    assert plan["workers"] in (4, 8)
+    assert plan["memory_mb"] == 8192
+    assert brain.optimize_job_resource("unknown-model") is None
+
+
+def test_brain_oom_memory_above_observed_peaks():
+    brain = _seed_brain()
+    grown = brain.optimize_worker_oom("gpt-test", requested_mb=8192)
+    assert grown >= 7000 * 1.5
+
+
+def test_brain_worker_count_finds_scaling_knee():
+    brain = _seed_brain()
+    # 2->4: 1.9x for 2x (0.9 marginal), 4->8: ~1.9x (0.89),
+    # 8->16: 1.11x for 2x (0.11 marginal) -> knee at 8
+    assert brain.optimize_worker_count("gpt-test") == 8
+
+
+def test_brain_resource_optimizer_plugs_into_scaler_seam():
+    brain = _seed_brain()
+    opt = BrainResourceOptimizer(
+        brain, "gpt-test", hosts_per_slice=4
+    )
+    assert opt.target_worker_count(2, SpeedMonitor()) == 8
+    grown = opt.optimize_oom_node(NodeResource(memory_mb=8192))
+    assert grown.memory_mb > 8192
