@@ -186,12 +186,12 @@ class ElasticAgent:
         # step, which a stale high-water mark would misread as a hang
         # / silence).
         from dlrover_tpu.agent.monitor import (
-            DEFAULT_METRICS_FILE,
+            default_metrics_file,
             METRICS_FILE_ENV,
         )
 
         try:
-            os.remove(os.getenv(METRICS_FILE_ENV, DEFAULT_METRICS_FILE))
+            os.remove(os.getenv(METRICS_FILE_ENV, default_metrics_file()))
         except OSError:
             pass
         env = ensure_framework_on_pythonpath(dict(os.environ))

@@ -214,6 +214,16 @@ class AsyncCheckpointSaver:
             if snapshots is None:
                 return False
             step = snapshots[0][0]
+            # The staged metadata names the trainer's checkpoint dir —
+            # authoritative even when the only save events so far were
+            # memory-only (flash fast path flushed before a restart).
+            staged_dir = (snapshots[0][2].get("_checkpoint_dir")
+                          or "").rstrip("/")
+            if staged_dir and staged_dir != self.checkpoint_dir:
+                logger.info(
+                    "adopting staged checkpoint dir %s", staged_dir)
+                self.checkpoint_dir = staged_dir
+                self._persisted_step = self._read_tracker()
             if step <= self._persisted_step:
                 return True
             wdir = writing_dir(self.checkpoint_dir, step)

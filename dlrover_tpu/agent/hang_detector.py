@@ -23,7 +23,7 @@ import time
 from typing import Optional
 
 from dlrover_tpu.agent.monitor import (
-    DEFAULT_METRICS_FILE,
+    default_metrics_file,
     METRICS_FILE_ENV,
 )
 from dlrover_tpu.common.log import get_logger
@@ -48,7 +48,7 @@ class HangDetector:
         self.hang_timeout = hang_timeout
         self.startup_grace = startup_grace
         self.metrics_file = metrics_file or os.getenv(
-            METRICS_FILE_ENV, DEFAULT_METRICS_FILE
+            METRICS_FILE_ENV, default_metrics_file()
         )
         self.reset()
 

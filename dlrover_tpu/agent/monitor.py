@@ -24,7 +24,14 @@ from dlrover_tpu.common.log import get_logger
 logger = get_logger("agent_monitor")
 
 METRICS_FILE_ENV = "DLROVER_TPU_METRICS_FILE"
-DEFAULT_METRICS_FILE = "/tmp/dlrover_tpu_train_metrics.json"
+
+
+def default_metrics_file() -> str:
+    """Job-scoped path (same rule as paral_config_tuner.
+    default_config_file): two jobs on one host must not cross-talk the
+    hang detector and step/speed reports."""
+    job = os.getenv("DLROVER_TPU_JOB_NAME", "default")
+    return f"/tmp/dlrover_tpu_train_metrics_{job}.json"
 
 
 def current_resource_stats() -> dict:
@@ -102,7 +109,7 @@ class TrainingMonitor:
     ):
         self.client = client
         self.metrics_file = metrics_file or os.getenv(
-            METRICS_FILE_ENV, DEFAULT_METRICS_FILE
+            METRICS_FILE_ENV, default_metrics_file()
         )
         self.interval = interval
         self._last_step = -1
@@ -116,7 +123,7 @@ class TrainingMonitor:
     ) -> None:
         """Called from the TRAINING process each step (cheap: one
         tmp-file rename)."""
-        path = path or os.getenv(METRICS_FILE_ENV, DEFAULT_METRICS_FILE)
+        path = path or os.getenv(METRICS_FILE_ENV, default_metrics_file())
         tmp = f"{path}.tmp"
         with open(tmp, "w") as f:
             json.dump(

@@ -256,6 +256,16 @@ class KvVariable:
                 lr, kw.get("eps", 1e-10), step,
             )
         elif optimizer == "ftrl":
+            # TF/tfplus convention: lr_power <= 0 (typically -0.5); the
+            # C++ kernel computes pow(accum, -lr_power), so a positive
+            # value would grow the step as the accumulator grows
+            # (ref: tfplus kv_variable/kernels/training_ops.cc Ftrl
+            # validation).
+            lr_power = kw.get("lr_power", -0.5)
+            if lr_power > 0:
+                raise ValueError(
+                    f"ftrl lr_power must be <= 0, got {lr_power}"
+                )
             lib.kv_sparse_apply_ftrl(
                 h,
                 self._slot(
@@ -265,7 +275,7 @@ class KvVariable:
                 self._slot("linear").handle,
                 ukeys, ugrads, ukeys.size,
                 lr, kw.get("l1", 0.0), kw.get("l2", 0.0),
-                kw.get("lr_power", 0.5), step,
+                lr_power, step,
             )
         elif optimizer == "momentum":
             lib.kv_sparse_apply_momentum(

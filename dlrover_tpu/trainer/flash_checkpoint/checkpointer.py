@@ -69,8 +69,10 @@ class Checkpointer:
             checkpoint_dir, local_rank=local_rank
         )
         # Step of the checkpoint most recently restored by
-        # load_checkpoint (-1 = none restored yet).
+        # load_checkpoint (-1 = none restored yet), and the extras
+        # saved alongside it (sampler state, user metadata).
         self.last_restored_step = -1
+        self.last_restored_extra: dict = {}
 
     def save_checkpoint(
         self,
@@ -97,8 +99,9 @@ class Checkpointer:
         res = self.engine.load(like, shardings=shardings, step=step)
         if res is None:
             return None
-        found_step, state, _ = res
+        found_step, state, extra = res
         self.last_restored_step = found_step
+        self.last_restored_extra = extra
         return state
 
     def latest_step(self) -> int:

@@ -185,6 +185,11 @@ class CheckpointEngine:
         extra = dict(extra or {})
         extra["_global_rank"] = self.global_rank
         extra["_world_size"] = self.world_size
+        # Stamp the trainer's authoritative dir into the staged
+        # metadata: the agent flushing a memory-only checkpoint before
+        # a restart must persist where the resumed trainer will look,
+        # even if it never saw a save_to_storage event.
+        extra["_checkpoint_dir"] = self.checkpoint_dir
         # Trylock *before* the device→host copy so a busy agent costs
         # nothing — staging multi-GB state only to drop it would stall
         # the train loop for seconds.

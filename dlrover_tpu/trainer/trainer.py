@@ -143,9 +143,20 @@ class Trainer:
         if restored is not None:
             params, opt_state = restored
             start_step = ckpt.last_restored_step
-            sampler.consumed = (
-                start_step * args.global_batch_size
-            ) % max(len(self.dataset), 1)
+            sampler_state = ckpt.last_restored_extra.get("sampler")
+            if sampler_state is not None:
+                # Exact data-resume guarantee: the checkpointed sampler
+                # state carries epoch + global consumed count and is
+                # world-size-change aware (load_state_dict re-rounds to
+                # the new shard count).
+                sampler.load_state_dict(dict(sampler_state))
+            else:
+                # Old checkpoint without sampler state: estimate with
+                # the trainer's rounded-up samples_per_step, not the
+                # raw global batch size.
+                sampler.consumed = (
+                    start_step * trainer.samples_per_step
+                ) % max(len(self.dataset), 1)
             logger.info("resumed from checkpoint step %d", start_step)
         trainer.step_num = start_step
 
@@ -190,9 +201,11 @@ class Trainer:
                 ckpt.save_checkpoint(
                     step, (params, opt_state),
                     storage_type=StorageType.DISK,
+                    extra={"sampler": sampler.state_dict()},
                 )
         ckpt.save_checkpoint(
-            step, (params, opt_state), storage_type=StorageType.DISK
+            step, (params, opt_state), storage_type=StorageType.DISK,
+            extra={"sampler": sampler.state_dict()},
         )
         ckpt.wait_latest_checkpoint()
         ckpt.close()

@@ -198,3 +198,54 @@ class TestCheckpointerStandalone:
             if saver is not None:
                 for shm in saver._shms:
                     shm.unlink()
+
+
+class TestAdviceFixes:
+    def test_flush_adopts_staged_dir(self, tmp_path):
+        """A memory-only staged checkpoint flushed by the agent before a
+        restart must land in the TRAINER's checkpoint dir (carried in
+        the staged metadata), not the agent's constructor default."""
+        mesh = _mesh((8,), ("data",))
+        state = _state(mesh)
+        agent_default = str(tmp_path / "agent_default")
+        trainer_dir = str(tmp_path / "trainer_dir")
+        saver = AsyncCheckpointSaver(
+            checkpoint_dir=agent_default,
+            local_shard_num=1,
+            global_shard_num=1,
+            commit_timeout=20.0,
+        )
+        saver.start()
+        engine = CheckpointEngine(trainer_dir, use_agent=True)
+        try:
+            # Fast path only: never a save_to_storage event.
+            assert engine.save_to_memory(7, state)
+            assert saver.save_shm_to_storage()
+            assert engine.latest_step() == 7  # in trainer_dir
+            assert not os.path.exists(
+                os.path.join(agent_default, "7"))
+        finally:
+            engine.close()
+            saver.close()
+
+    def test_checkpointer_restores_extra(self, tmp_path):
+        from dlrover_tpu.trainer.flash_checkpoint import (
+            Checkpointer,
+            StorageType,
+        )
+
+        mesh = _mesh((8,), ("data",))
+        state = _state(mesh)
+        ckpt = Checkpointer(str(tmp_path / "ckpt3"))
+        try:
+            assert ckpt.save_checkpoint(
+                9, state, storage_type=StorageType.DISK,
+                extra={"sampler": {"epoch": 2, "consumed": 640}})
+            assert ckpt.wait_latest_checkpoint(timeout=20)
+            like = jax.tree.map(
+                lambda x: jax.ShapeDtypeStruct(x.shape, x.dtype), state)
+            assert ckpt.load_checkpoint(like) is not None
+            assert ckpt.last_restored_extra["sampler"] == {
+                "epoch": 2, "consumed": 640}
+        finally:
+            ckpt.close()

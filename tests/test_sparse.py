@@ -199,3 +199,37 @@ def test_jax_embedding_lookup_and_training():
         losses.append(float(loss))
     assert losses[-1] < losses[0] * 0.5
     assert len(kv) == 3  # unique keys inserted
+
+
+def test_sparse_ftrl_lr_power_convention():
+    """TF/tfplus convention: lr_power <= 0 (default -0.5, so the
+    effective per-coordinate lr SHRINKS as the accumulator grows);
+    positive values are rejected like the reference's kernel
+    validation (tfplus training_ops.cc)."""
+    import numpy as np
+
+    from dlrover_tpu.sparse.kv_variable import KvVariable
+
+    dim = 4
+    kv = KvVariable("ftrl_power", embedding_dim=dim, seed=11)
+    keys = np.array([1], np.int64)
+    kv.gather(keys)  # materialize the row
+    with pytest.raises(ValueError):
+        kv.apply_gradients(
+            "ftrl", keys, np.ones((1, dim), np.float32), step=1,
+            lr=0.1, lr_power=0.5,
+        )
+    # With the default (-0.5) the update magnitude must shrink across
+    # repeated identical gradients (1/sqrt(accum) schedule).
+    before = kv.gather(keys).copy()
+    kv.apply_gradients("ftrl", keys, np.ones((1, dim), np.float32),
+                       step=1, lr=0.1)
+    first_delta = np.abs(kv.gather(keys) - before).mean()
+    for s in range(2, 31):
+        kv.apply_gradients("ftrl", keys, np.ones((1, dim), np.float32),
+                           step=s, lr=0.1)
+    prev = kv.gather(keys).copy()
+    kv.apply_gradients("ftrl", keys, np.ones((1, dim), np.float32),
+                       step=31, lr=0.1)
+    late_delta = np.abs(kv.gather(keys) - prev).mean()
+    assert late_delta < first_delta
