@@ -305,5 +305,35 @@ class MasterClient:
         except Exception:  # noqa: BLE001
             pass
 
+    # -- PS-elastic sparse path ------------------------------------------
+
+    @retry()
+    def get_partition_map(self):
+        """Fetch the current embedding PartitionMap (sparse path)."""
+        from dlrover_tpu.sparse.partition import PartitionMap
+
+        resp = self._client.get(msg.PartitionMapRequest())
+        return PartitionMap(
+            version=resp.version,
+            assignment=list(resp.assignment),
+            ps_addrs={int(k): v for k, v in resp.ps_addrs.items()},
+        )
+
+    @retry()
+    def register_ps(self, ps_id: int, addr: str):
+        self._client.report(
+            msg.PsRegisterRequest(node_id=ps_id, addr=addr)
+        )
+
+    def report_ps_stats(self, ps_id: int, qps: float,
+                        cpu_percent: float, total_rows: int):
+        try:
+            self._client.report(msg.PsStatsReport(
+                node_id=ps_id, qps=qps, cpu_percent=cpu_percent,
+                total_rows=total_rows,
+            ))
+        except Exception:  # noqa: BLE001 - telemetry is best-effort
+            pass
+
     def close(self):
         self._client.close()

@@ -377,3 +377,181 @@ class ScalePlanMsg:
 
     node_group: Dict[str, int] = dataclasses.field(default_factory=dict)
     remove_nodes: List[int] = dataclasses.field(default_factory=list)
+
+
+# ---------------------------------------------------------------------------
+# Sparse / PS-elastic path (ref: tfplus kv_variable ops + dlrover
+# master/node/ps.py orchestration; arrays ride msgpack as raw bytes)
+# ---------------------------------------------------------------------------
+
+
+@message
+class Tensor:
+    """Dense ndarray on the wire: raw bytes + dtype + shape."""
+
+    dtype: str = "float32"
+    shape: List[int] = dataclasses.field(default_factory=list)
+    data: bytes = b""
+
+    @staticmethod
+    def from_numpy(arr) -> "Tensor":
+        import numpy as np
+
+        arr = np.ascontiguousarray(arr)
+        return Tensor(
+            dtype=str(arr.dtype),
+            shape=list(arr.shape),
+            data=arr.tobytes(),
+        )
+
+    def to_numpy(self):
+        import numpy as np
+
+        return np.frombuffer(self.data, dtype=self.dtype).reshape(
+            self.shape
+        ).copy()
+
+
+@message
+class PsLookupRequest:
+    table: str = ""
+    keys: Optional[Tensor] = None
+    train: bool = True  # True: gather-or-insert; False: gather-or-zeros
+    map_version: int = -1
+
+
+@message
+class PsLookupResponse:
+    values: Optional[Tensor] = None
+
+
+@message
+class PsApplyRequest:
+    """Fused sparse optimizer apply on a PS shard."""
+
+    table: str = ""
+    optimizer: str = "adam"
+    keys: Optional[Tensor] = None
+    grads: Optional[Tensor] = None
+    step: int = 0
+    lr: float = 1e-3
+    hyperparams: Dict[str, float] = dataclasses.field(default_factory=dict)
+    map_version: int = -1
+
+
+@message
+class PsExportRequest:
+    """Export rows of the given partitions (for PS->PS moves and for
+    checkpoint flush). since_version>0 = delta export."""
+
+    table: str = ""
+    partitions: List[int] = dataclasses.field(default_factory=list)
+    since_version: int = 0
+    include_slots: bool = True
+
+
+@message
+class PsTableDump:
+    table: str = ""
+    keys: Optional[Tensor] = None
+    values: Optional[Tensor] = None
+    freqs: Optional[Tensor] = None
+    versions: Optional[Tensor] = None
+    # slot name -> (keys, values) for optimizer state
+    slot_keys: Dict[str, Tensor] = dataclasses.field(default_factory=dict)
+    slot_values: Dict[str, Tensor] = dataclasses.field(default_factory=dict)
+
+
+@message
+class PsImportRequest:
+    dump: Optional[PsTableDump] = None
+
+
+@message
+class PsPullPartitionsRequest:
+    """Master -> target PS: pull these partitions from source_addr,
+    import them, ack. The data moves PS-to-PS, not through the master."""
+
+    source_addr: str = ""
+    partitions: List[int] = dataclasses.field(default_factory=list)
+
+
+@message
+class PsFreezeRequest:
+    """Master -> source PS: stop serving these partitions (clients get
+    a stale-map rejection and refetch the PartitionMap)."""
+
+    partitions: List[int] = dataclasses.field(default_factory=list)
+    frozen: bool = True
+
+
+@message
+class PsStatsRequest:
+    pass
+
+
+@message
+class PsStatsResponse:
+    ps_id: int = -1
+    tables: Dict[str, int] = dataclasses.field(default_factory=dict)
+    qps: float = 0.0
+    cpu_percent: float = 0.0
+    frozen_partitions: List[int] = dataclasses.field(default_factory=list)
+
+
+@message
+class PsFlushRequest:
+    """Checkpoint: delta-flush owned partitions to storage."""
+
+    step: int = 0
+
+
+@message
+class PsFlushResponse:
+    flushed_rows: int = 0
+
+
+@message
+class PsRestoreRequest:
+    """Restore the given partitions from the checkpoint dir (after a
+    relaunch or a partition takeover from a dead PS)."""
+
+    partitions: List[int] = dataclasses.field(default_factory=list)
+
+
+@message
+class PartitionMapMsg:
+    version: int = 0
+    assignment: List[int] = dataclasses.field(default_factory=list)
+    ps_addrs: Dict[int, str] = dataclasses.field(default_factory=dict)
+
+
+@message
+class PartitionMapRequest:
+    known_version: int = -1
+
+
+@message
+class PsRegisterRequest:
+    """PS node -> master: announce service address."""
+
+    node_id: int = -1
+    addr: str = ""
+
+
+@message
+class PsStatsReport:
+    """PS node -> master: periodic telemetry for the hot-PS optimizer."""
+
+    node_id: int = -1
+    qps: float = 0.0
+    cpu_percent: float = 0.0
+    total_rows: int = 0
+
+
+@message
+class PsSetPartitionsRequest:
+    """Master -> PS: own these partitions at this map version."""
+
+    partitions: List[int] = dataclasses.field(default_factory=list)
+    map_version: int = 0

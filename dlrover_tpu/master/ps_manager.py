@@ -1,0 +1,233 @@
+"""Master-side manager for the PS-elastic sparse path.
+
+Capability parity with the reference's PS node management
+(dlrover/python/master/node/ps.py:1-369 ParameterServerManager — alive
+PS set, pending migration, sync barrier before dropping a PS) and the
+worker SyncService (master/elastic_training/sync_service.py), built on
+the versioned PartitionMap instead of node-granular migration:
+
+* PS nodes register their RPC address; the manager assigns hash
+  partitions (sparse/partition.py:balanced_assignment — minimal-move).
+* scale-up/down is an orchestrated move: freeze on source -> target
+  pulls (PS-to-PS delta export/import incl. optimizer slots) -> map
+  version bump -> unfreeze. Workers carrying the old version get
+  rejected and refetch — no barrier RPC needed.
+* a dead PS (failure report / heartbeat timeout) gets its partitions
+  reassigned to survivors, who restore them from the per-partition
+  delta checkpoint files (ps_server.flush) — the sparse analogue of
+  flash-checkpoint recovery.
+* periodic PS telemetry (qps/cpu/rows) feeds the hot-PS auto-scaler
+  (master/auto_scaler.py:PsAutoScaler; ref local_optimizer.py:66).
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+from typing import Dict, List, Optional
+
+from dlrover_tpu.common import messages as msg
+from dlrover_tpu.common.comm import RpcClient
+from dlrover_tpu.common.log import get_logger
+from dlrover_tpu.sparse.partition import (
+    NUM_PARTITIONS,
+    PartitionMap,
+    balanced_assignment,
+)
+
+logger = get_logger("ps_manager")
+
+
+class PsManager:
+    def __init__(self, num_partitions: int = NUM_PARTITIONS):
+        self.num_partitions = num_partitions
+        self._lock = threading.RLock()
+        self._map = PartitionMap(version=0, assignment=[], ps_addrs={})
+        self._clients: Dict[int, RpcClient] = {}
+        self._stats: Dict[int, msg.PsStatsReport] = {}
+        self._stats_time: Dict[int, float] = {}
+
+    # -- accessors -------------------------------------------------------
+
+    @property
+    def partition_map(self) -> PartitionMap:
+        with self._lock:
+            return PartitionMap(
+                version=self._map.version,
+                assignment=list(self._map.assignment),
+                ps_addrs=dict(self._map.ps_addrs),
+            )
+
+    def to_msg(self) -> msg.PartitionMapMsg:
+        m = self.partition_map
+        return msg.PartitionMapMsg(
+            version=m.version,
+            assignment=m.assignment,
+            ps_addrs=m.ps_addrs,
+        )
+
+    def _client(self, ps_id: int) -> RpcClient:
+        addr = self._map.ps_addrs[ps_id]
+        c = self._clients.get(ps_id)
+        if c is None or c.addr != addr:
+            if c is not None:
+                c.close()
+            c = RpcClient(addr)
+            self._clients[ps_id] = c
+        return c
+
+    # -- membership ------------------------------------------------------
+
+    def register_ps(self, ps_id: int, addr: str) -> None:
+        """A PS node came up (fresh or relaunched). Rebalance minimal-
+        move, migrate data for partitions that change owner, publish."""
+        with self._lock:
+            is_new = ps_id not in self._map.ps_addrs
+            self._map.ps_addrs[ps_id] = addr
+            self._clients.pop(ps_id, None)
+            if is_new or not self._map.assignment:
+                self._rebalance(reason=f"register ps {ps_id}")
+            else:
+                # Same node re-registered (restart in place): it lost
+                # its memory — restore its partitions from checkpoint
+                # and bump the version so workers re-resolve the addr.
+                self._map = PartitionMap(
+                    version=self._map.version + 1,
+                    assignment=list(self._map.assignment),
+                    ps_addrs=dict(self._map.ps_addrs),
+                )
+                for other in sorted(self._map.ps_addrs):
+                    parts = self._map.partitions_of(other)
+                    self._publish(
+                        other, parts,
+                        restore=parts if other == ps_id else None,
+                    )
+
+    def remove_ps(self, ps_id: int) -> None:
+        """A PS died or is being scaled in. Survivors take over its
+        partitions and restore them from the flush dir."""
+        with self._lock:
+            if ps_id not in self._map.ps_addrs:
+                return
+            dead_parts = self._map.partitions_of(ps_id)
+            del self._map.ps_addrs[ps_id]
+            c = self._clients.pop(ps_id, None)
+            if c is not None:
+                c.close()
+            self._stats.pop(ps_id, None)
+            if not self._map.ps_addrs:
+                logger.error("last PS node %d removed", ps_id)
+                self._map.assignment = []
+                self._map.version += 1
+                return
+            self._rebalance(
+                reason=f"remove ps {ps_id}", restore_parts=dead_parts
+            )
+
+    # -- rebalancing -----------------------------------------------------
+
+    def _rebalance(self, reason: str,
+                   restore_parts: Optional[List[int]] = None) -> None:
+        """Compute the minimal-move assignment and execute the
+        migration plan. Must hold the lock."""
+        ps_ids = sorted(self._map.ps_addrs)
+        old = self._map
+        new_assignment = balanced_assignment(
+            ps_ids, self.num_partitions, previous=old
+        )
+        moves: Dict[int, Dict[int, List[int]]] = {}  # dst -> src -> [p]
+        fresh: Dict[int, List[int]] = {}  # dst -> partitions w/o source
+        restore_set = set(restore_parts or [])
+        for p, dst in enumerate(new_assignment):
+            src = (old.assignment[p]
+                   if p < len(old.assignment) else None)
+            if src == dst:
+                continue
+            if (src is None or src not in self._map.ps_addrs
+                    or p in restore_set):
+                fresh.setdefault(dst, []).append(p)
+            else:
+                moves.setdefault(dst, {}).setdefault(src, []).append(p)
+
+        # 1. freeze moving partitions on their sources
+        for dst, by_src in moves.items():
+            for src, parts in by_src.items():
+                self._safe_call(src, msg.PsFreezeRequest(
+                    partitions=parts, frozen=True))
+        # 2. targets pull from sources (PS-to-PS)
+        for dst, by_src in moves.items():
+            for src, parts in by_src.items():
+                self._safe_call(dst, msg.PsPullPartitionsRequest(
+                    source_addr=self._map.ps_addrs[src],
+                    partitions=parts,
+                ))
+        # 3. publish the new map (version bump) to every PS
+        self._map = PartitionMap(
+            version=old.version + 1,
+            assignment=new_assignment,
+            ps_addrs=dict(self._map.ps_addrs),
+        )
+        for ps_id in ps_ids:
+            parts = self._map.partitions_of(ps_id)
+            restore = sorted(set(fresh.get(ps_id, [])) & set(parts))
+            self._publish(ps_id, parts, restore=restore)
+        logger.info(
+            "partition map v%d (%s): %s",
+            self._map.version, reason,
+            {ps: len(self._map.partitions_of(ps)) for ps in ps_ids},
+        )
+
+    def _publish(self, ps_id: int, parts: List[int],
+                 restore: Optional[List[int]] = None) -> None:
+        if restore:
+            self._safe_call(ps_id, msg.PsRestoreRequest(
+                partitions=restore))
+        self._safe_call(ps_id, msg.PsSetPartitionsRequest(
+            partitions=parts, map_version=self._map.version))
+
+    def _safe_call(self, ps_id: int, request) -> None:
+        try:
+            self._client(ps_id).get(request)
+        except Exception:  # noqa: BLE001 — a dying PS must not wedge
+            logger.warning(
+                "PS %d rpc %s failed", ps_id,
+                type(request).__name__, exc_info=True,
+            )
+
+    # -- checkpoint ------------------------------------------------------
+
+    def flush_all(self, step: int) -> int:
+        """Direct every PS to delta-flush (called on the trainer's
+        checkpoint cadence). Returns total rows flushed."""
+        total = 0
+        with self._lock:
+            ps_ids = sorted(self._map.ps_addrs)
+        for ps_id in ps_ids:
+            try:
+                resp = self._client(ps_id).get(
+                    msg.PsFlushRequest(step=step))
+                total += resp.flushed_rows
+            except Exception:  # noqa: BLE001
+                logger.warning("PS %d flush failed", ps_id,
+                               exc_info=True)
+        return total
+
+    # -- telemetry -------------------------------------------------------
+
+    def report_stats(self, report: msg.PsStatsReport) -> None:
+        with self._lock:
+            self._stats[report.node_id] = report
+            self._stats_time[report.node_id] = time.time()
+
+    def hot_ps(self, cpu_threshold: float = 80.0) -> List[int]:
+        """PS nodes whose reported CPU exceeds the threshold (input to
+        the hot-PS auto-scaler; ref local_optimizer.py:66)."""
+        with self._lock:
+            return sorted(
+                node_id for node_id, s in self._stats.items()
+                if s.cpu_percent >= cpu_threshold
+            )
+
+    def stats(self) -> Dict[int, msg.PsStatsReport]:
+        with self._lock:
+            return dict(self._stats)

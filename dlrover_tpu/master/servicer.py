@@ -34,6 +34,7 @@ class MasterServicer:
         check_rdzv: NetworkCheckRendezvous,
         kv_store: Optional[KVStoreService] = None,
         speed_monitor: Optional[SpeedMonitor] = None,
+        ps_manager=None,
     ):
         self.job_manager = job_manager
         self.task_manager = task_manager
@@ -43,6 +44,13 @@ class MasterServicer:
         }
         self.kv_store = kv_store or KVStoreService()
         self.speed_monitor = speed_monitor or SpeedMonitor()
+        # PS-elastic sparse path (ref master/node/ps.py); created
+        # lazily so dense-only jobs pay nothing.
+        if ps_manager is None:
+            from dlrover_tpu.master.ps_manager import PsManager
+
+            ps_manager = PsManager()
+        self.ps_manager = ps_manager
         # actions queued for agents, popped on heartbeat
         self._pending_actions: dict[int, str] = {}
         # auto-tuner output pulled by agents (ref: master-pushed
@@ -83,6 +91,10 @@ class MasterServicer:
         r(msg.HeartbeatRequest, self._heartbeat)
         r(msg.NodeAddressRequest, self._register_node)
         r(msg.RestoreShardRequest, self._restore_shards)
+
+        g(msg.PartitionMapRequest, self._get_partition_map)
+        r(msg.PsRegisterRequest, self._register_ps)
+        r(msg.PsStatsReport, self._report_ps_stats)
 
     def _noop(self, req):
         return None
@@ -261,3 +273,14 @@ class MasterServicer:
         apply it at the next restart."""
         config.version = self.parallel_config.version + 1
         self.parallel_config = config
+
+    # -- PS-elastic sparse path --------------------------------------------
+
+    def _get_partition_map(self, req: msg.PartitionMapRequest):
+        return self.ps_manager.to_msg()
+
+    def _register_ps(self, req: msg.PsRegisterRequest):
+        self.ps_manager.register_ps(req.node_id, req.addr)
+
+    def _report_ps_stats(self, req: msg.PsStatsReport):
+        self.ps_manager.report_stats(req)

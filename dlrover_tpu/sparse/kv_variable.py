@@ -366,24 +366,25 @@ class KvVariable:
             "slots": slots,
         }
 
+    def import_slot(self, name: str, keys, values) -> None:
+        """Import optimizer-slot rows (checkpoint restore / PS move).
+        Recreates the slot store with matching init semantics."""
+        keys = np.ascontiguousarray(keys, np.int64)
+        values = np.ascontiguousarray(values, np.float32)
+        mode = _INIT_CONST if name == "accum_ftrl" else _INIT_ZEROS
+        slot = self._slot(name, mode, 0.1 if mode == _INIT_CONST else 0.0)
+        slot._lib.kv_update(
+            slot.handle, keys, keys.size,
+            values.reshape(keys.size, self.embedding_dim), 0,
+        )
+
     def load_state_dict(self, state: dict) -> None:
         self.import_(
             state["keys"], state["values"], state.get("freqs"),
             state.get("versions"),
         )
         for name, (sk, sv) in state.get("slots", {}).items():
-            # recreate slot stores with matching init semantics
-            mode = (
-                _INIT_CONST if name == "accum_ftrl" else _INIT_ZEROS
-            )
-            slot = self._slot(name, mode, 0.1 if mode == _INIT_CONST else 0.0)
-            slot._lib.kv_update(
-                slot.handle,
-                np.ascontiguousarray(sk, np.int64),
-                sk.size,
-                np.ascontiguousarray(sv, np.float32),
-                0,
-            )
+            self.import_slot(name, sk, sv)
 
 
 class SparseOptimizer:

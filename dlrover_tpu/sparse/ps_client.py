@@ -1,0 +1,189 @@
+"""Worker-side client to the distributed embedding store.
+
+The sparse analogue of the dense path's GSPMD sharding: keys are routed
+to PS shards by the master-owned PartitionMap, requests fan out in
+parallel, and a stale map (reshard in flight) is handled by refetch +
+retry — no worker barrier needed (ref: dlrover sync_service.py solves
+this with an explicit barrier; the version check subsumes it).
+
+``embedding_lookup`` bridges lookups into jitted JAX programs with
+``jax.pure_callback`` exactly like the single-host path
+(sparse/kv_variable.py:embedding_lookup).
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+from concurrent.futures import ThreadPoolExecutor
+from typing import Dict, Optional
+
+import numpy as np
+
+from dlrover_tpu.common import messages as msg
+from dlrover_tpu.common.comm import RpcClient, RpcError
+from dlrover_tpu.common.log import get_logger
+from dlrover_tpu.sparse.partition import PartitionMap
+
+logger = get_logger("ps_client")
+
+
+class DistributedKvClient:
+    """Routes lookups/updates for named embedding tables to PS shards.
+
+    ``map_source``: callable returning the current PartitionMap (the
+    master client's ``get_partition_map``, or a static map in tests).
+    """
+
+    def __init__(
+        self,
+        map_source,
+        embedding_dims: Dict[str, int],
+        max_retries: int = 8,
+        retry_interval: float = 0.5,
+    ):
+        self._map_source = map_source
+        self.embedding_dims = dict(embedding_dims)
+        self.max_retries = max_retries
+        self.retry_interval = retry_interval
+        self._map: Optional[PartitionMap] = None
+        self._clients: Dict[str, RpcClient] = {}
+        self._lock = threading.Lock()
+        self._pool = ThreadPoolExecutor(max_workers=16)
+
+    # -- map / connections ----------------------------------------------
+
+    def _refresh_map(self, force: bool = False) -> PartitionMap:
+        with self._lock:
+            if self._map is None or force:
+                self._map = self._map_source()
+            return self._map
+
+    def _client_for(self, addr: str) -> RpcClient:
+        with self._lock:
+            c = self._clients.get(addr)
+            if c is None:
+                c = RpcClient(addr)
+                self._clients[addr] = c
+            return c
+
+    def close(self) -> None:
+        self._pool.shutdown(wait=False)
+        with self._lock:
+            for c in self._clients.values():
+                c.close()
+            self._clients.clear()
+
+    # -- fan-out core ----------------------------------------------------
+
+    def _fan_out(self, keys: np.ndarray, call):
+        """Group flat ``keys`` by owning PS and run ``call(addr,
+        version, sub_keys, idx)`` per shard in parallel; retries the
+        whole round with a fresh map on StaleMapError-style failures."""
+        last_err: Optional[Exception] = None
+        for attempt in range(self.max_retries):
+            pmap = self._refresh_map(force=attempt > 0)
+            groups = pmap.group_keys(keys)
+            futs = []
+            for ps_id, idx in groups.items():
+                addr = pmap.ps_addrs.get(ps_id)
+                if addr is None:
+                    last_err = RpcError(f"no address for PS {ps_id}")
+                    break
+                futs.append(self._pool.submit(
+                    call, addr, pmap.version, keys[idx], idx
+                ))
+            else:
+                try:
+                    for f in futs:
+                        f.result()
+                    return
+                except Exception as e:  # noqa: BLE001 — retried
+                    last_err = e
+            # A reshard is in flight or a PS died: wait for the master
+            # to publish a new map, then retry from scratch.
+            time.sleep(self.retry_interval * (1 + attempt))
+        raise RpcError(
+            f"sparse op failed after {self.max_retries} retries: "
+            f"{last_err}"
+        )
+
+    # -- API -------------------------------------------------------------
+
+    def lookup(self, table: str, keys, train: bool = True) -> np.ndarray:
+        keys = np.ascontiguousarray(keys, np.int64)
+        flat = keys.ravel()
+        dim = self.embedding_dims[table]
+        out = np.zeros((flat.size, dim), np.float32)
+
+        def call(addr, version, sub_keys, idx):
+            resp = self._client_for(addr).get(msg.PsLookupRequest(
+                table=table,
+                keys=msg.Tensor.from_numpy(sub_keys),
+                train=train,
+                map_version=version,
+            ))
+            out[idx] = resp.values.to_numpy()
+
+        self._fan_out(flat, call)
+        return out.reshape(keys.shape + (dim,))
+
+    def apply_gradients(
+        self,
+        table: str,
+        keys,
+        grads,
+        step: int,
+        optimizer: str = "adam",
+        lr: float = 1e-3,
+        **hyperparams,
+    ) -> None:
+        keys = np.ascontiguousarray(keys, np.int64).ravel()
+        dim = self.embedding_dims[table]
+        grads = np.ascontiguousarray(grads, np.float32).reshape(
+            keys.size, dim
+        )
+
+        def call(addr, version, sub_keys, idx):
+            self._client_for(addr).get(msg.PsApplyRequest(
+                table=table,
+                optimizer=optimizer,
+                keys=msg.Tensor.from_numpy(sub_keys),
+                grads=msg.Tensor.from_numpy(grads[idx]),
+                step=step,
+                lr=lr,
+                hyperparams=dict(hyperparams),
+                map_version=version,
+            ))
+
+        self._fan_out(keys, call)
+
+    def table_size(self, table: str) -> int:
+        """Total rows across shards (stats fan-out; test/ops helper)."""
+        pmap = self._refresh_map(force=True)
+        total = 0
+        for ps_id in pmap.ps_ids():
+            addr = pmap.ps_addrs.get(ps_id)
+            if addr is None:
+                continue
+            stats = self._client_for(addr).get(msg.PsStatsRequest())
+            total += stats.tables.get(table, 0)
+        return total
+
+
+def embedding_lookup(client: DistributedKvClient, table: str, keys,
+                     train: bool = True):
+    """JAX-visible distributed lookup, usable inside jit via
+    pure_callback (same contract as the single-host
+    kv_variable.embedding_lookup)."""
+    import jax
+    import jax.numpy as jnp
+
+    keys = jnp.asarray(keys)
+    dim = client.embedding_dims[table]
+    out_shape = jax.ShapeDtypeStruct(keys.shape + (dim,), jnp.float32)
+
+    def host_gather(k):
+        return client.lookup(table, np.asarray(k), train=train)
+
+    return jax.pure_callback(host_gather, out_shape, keys)

@@ -1,0 +1,358 @@
+"""Parameter-server node for the sparse embedding path.
+
+Capability parity with the reference's PS pillar as a system: each PS
+node hosts a shard (a set of hash partitions, sparse/partition.py) of
+every KvVariable table behind the typed-msgpack RPC transport
+(common/comm.py) — the TPU-native replacement for tfplus's in-graph
+partitioned KvVariables served by TF PS servers
+(tfplus/tfplus/kv_variable/kernels/kv_variable_ops.cc) and managed by
+dlrover's PS node managers (dlrover/python/master/node/ps.py).
+
+Elasticity protocol (master-directed, data moves PS-to-PS):
+
+* every data-plane request carries the PartitionMap version; a stale
+  or frozen-partition request is rejected with ``StaleMapError`` so the
+  worker refetches the map and retries — the version check is the
+  whole worker-sync story (ref sync_service.py's barrier).
+* scale-up: master freezes moving partitions on the source, tells the
+  target to PULL them (delta export / import of values + optimizer
+  slots), bumps the map, unfreezes.
+* failure: master reassigns the dead node's partitions to survivors,
+  who restore them from the flush dir (delta checkpoint files written
+  by ``flush`` — the sparse analogue of flash checkpoint).
+"""
+
+from __future__ import annotations
+
+import os
+import threading
+import time
+from typing import Dict, List, Optional
+
+import numpy as np
+
+from dlrover_tpu.common import messages as msg
+from dlrover_tpu.common.comm import RpcClient, RpcDispatcher, RpcServer
+from dlrover_tpu.common.log import get_logger
+from dlrover_tpu.common.storage import get_storage
+from dlrover_tpu.sparse.kv_variable import KvVariable
+from dlrover_tpu.sparse.partition import NUM_PARTITIONS, key_partition
+
+logger = get_logger("ps_server")
+
+
+class StaleMapError(RuntimeError):
+    """Client used an outdated PartitionMap (or hit a frozen/foreign
+    partition); it must refetch the map and retry."""
+
+
+class PsServer:
+    """One PS node: tables + partitions + RPC service.
+
+    ``checkpoint_dir``: where delta flushes land; restore reads it.
+    Table rows owned = rows whose ``key_partition`` is in
+    ``self.partitions`` — enforcement is cooperative (clients route by
+    the same map), with explicit checks on export/move paths.
+    """
+
+    def __init__(
+        self,
+        node_id: int,
+        checkpoint_dir: str,
+        embedding_dims: Dict[str, int],
+        num_partitions: int = NUM_PARTITIONS,
+        port: int = 0,
+        seed: int = 0,
+        storage=None,
+    ):
+        self.node_id = node_id
+        self.checkpoint_dir = checkpoint_dir.rstrip("/")
+        self.num_partitions = num_partitions
+        self.storage = storage or get_storage()
+        self._tables: Dict[str, KvVariable] = {
+            name: KvVariable(name, dim, seed=seed + i)
+            for i, (name, dim) in enumerate(sorted(embedding_dims.items()))
+        }
+        self._lock = threading.RLock()
+        self.partitions: List[int] = []
+        self.frozen: set = set()
+        self.map_version = -1
+        # flush bookkeeping: per-table last flushed store version (the
+        # KvVariable's version counter is the training step passed to
+        # apply_gradients/assign)
+        self._flushed_version: Dict[str, int] = {}
+        self._qps_count = 0
+        self._qps_t0 = time.time()
+
+        dispatcher = RpcDispatcher()
+        dispatcher.register_get(msg.PsLookupRequest, self._lookup)
+        dispatcher.register_get(msg.PsApplyRequest, self._apply)
+        dispatcher.register_get(msg.PsExportRequest, self._export)
+        dispatcher.register_get(msg.PsImportRequest, self._import)
+        dispatcher.register_get(msg.PsPullPartitionsRequest, self._pull)
+        dispatcher.register_get(msg.PsFreezeRequest, self._freeze)
+        dispatcher.register_get(msg.PsStatsRequest, self._stats)
+        dispatcher.register_get(msg.PsFlushRequest, self._flush)
+        dispatcher.register_get(msg.PsRestoreRequest, self._restore)
+        dispatcher.register_get(
+            msg.PsSetPartitionsRequest, self._set_partitions
+        )
+        self._server = RpcServer(dispatcher, port=port)
+
+    # -- lifecycle -------------------------------------------------------
+
+    @property
+    def addr(self) -> str:
+        return self._server.addr
+
+    def start(self) -> None:
+        self._server.start()
+        logger.info("PS %d serving on %s", self.node_id, self.addr)
+
+    def stop(self) -> None:
+        self._server.stop(grace=0.5)
+
+    def set_partitions(self, partitions: List[int], map_version: int
+                       ) -> None:
+        with self._lock:
+            self.partitions = sorted(partitions)
+            self.map_version = map_version
+            self.frozen -= set(self.partitions)
+
+    def _set_partitions(self, req: msg.PsSetPartitionsRequest) -> None:
+        self.set_partitions(req.partitions, req.map_version)
+
+    def table(self, name: str) -> KvVariable:
+        return self._tables[name]
+
+    # -- helpers ---------------------------------------------------------
+
+    def _check_version(self, version: int, keys: np.ndarray) -> None:
+        if version >= 0 and version != self.map_version:
+            raise StaleMapError(
+                f"stale partition map: client v{version}, "
+                f"ps v{self.map_version}"
+            )
+        if self.frozen:
+            parts = set(np.unique(
+                key_partition(keys, self.num_partitions)).tolist())
+            hit = parts & self.frozen
+            if hit:
+                raise StaleMapError(
+                    f"partitions {sorted(hit)} frozen for reshard"
+                )
+
+    def _count(self):
+        self._qps_count += 1
+
+    # -- data plane ------------------------------------------------------
+
+    def _lookup(self, req: msg.PsLookupRequest) -> msg.PsLookupResponse:
+        self._count()
+        keys = req.keys.to_numpy()
+        with self._lock:
+            self._check_version(req.map_version, keys)
+            vals = self._tables[req.table].gather(keys, train=req.train)
+        return msg.PsLookupResponse(values=msg.Tensor.from_numpy(vals))
+
+    def _apply(self, req: msg.PsApplyRequest) -> None:
+        self._count()
+        keys = req.keys.to_numpy()
+        grads = req.grads.to_numpy()
+        with self._lock:
+            self._check_version(req.map_version, keys)
+            self._tables[req.table].apply_gradients(
+                req.optimizer, keys, grads, req.step, lr=req.lr,
+                **req.hyperparams,
+            )
+
+    # -- reshard / checkpoint -------------------------------------------
+
+    def _dump_table(
+        self, name: str, partitions: Optional[List[int]],
+        since_version: int, include_slots: bool,
+    ) -> msg.PsTableDump:
+        table = self._tables[name]
+        keys, values, freqs, versions = table.export(since_version)
+        if partitions is not None:
+            part_set = np.isin(
+                key_partition(keys, self.num_partitions),
+                np.asarray(partitions, np.int32),
+            )
+            keys, values = keys[part_set], values[part_set]
+            freqs, versions = freqs[part_set], versions[part_set]
+        dump = msg.PsTableDump(
+            table=name,
+            keys=msg.Tensor.from_numpy(keys),
+            values=msg.Tensor.from_numpy(values),
+            freqs=msg.Tensor.from_numpy(freqs),
+            versions=msg.Tensor.from_numpy(versions),
+        )
+        if include_slots:
+            state = table.state_dict()
+            for slot, (sk, sv) in state["slots"].items():
+                if partitions is not None:
+                    mask = np.isin(
+                        key_partition(sk, self.num_partitions),
+                        np.asarray(partitions, np.int32),
+                    )
+                    sk, sv = sk[mask], sv[mask]
+                dump.slot_keys[slot] = msg.Tensor.from_numpy(sk)
+                dump.slot_values[slot] = msg.Tensor.from_numpy(sv)
+        return dump
+
+    def _export(self, req: msg.PsExportRequest) -> msg.PsTableDump:
+        with self._lock:
+            return self._dump_table(
+                req.table, req.partitions or None, req.since_version,
+                req.include_slots,
+            )
+
+    def _import_dump(self, dump: msg.PsTableDump) -> int:
+        table = self._tables[dump.table]
+        keys = dump.keys.to_numpy()
+        table.import_(
+            keys,
+            dump.values.to_numpy(),
+            dump.freqs.to_numpy() if dump.freqs is not None else None,
+            dump.versions.to_numpy() if dump.versions is not None else None,
+        )
+        for slot, sk in dump.slot_keys.items():
+            sv = dump.slot_values[slot].to_numpy()
+            sk = sk.to_numpy()
+            table.import_slot(slot, sk, sv)
+        return keys.size
+
+    def _import(self, req: msg.PsImportRequest) -> None:
+        with self._lock:
+            self._import_dump(req.dump)
+
+    def _pull(self, req: msg.PsPullPartitionsRequest) -> None:
+        """Pull partitions from another PS and import (master-directed
+        move; the source froze them first)."""
+        client = RpcClient(req.source_addr)
+        try:
+            for name in self._tables:
+                dump = client.get(msg.PsExportRequest(
+                    table=name, partitions=req.partitions,
+                    since_version=0, include_slots=True,
+                ))
+                with self._lock:
+                    n = self._import_dump(dump)
+                logger.info(
+                    "PS %d pulled %d rows of %s for partitions %s",
+                    self.node_id, n, name, req.partitions,
+                )
+        finally:
+            client.close()
+
+    def _freeze(self, req: msg.PsFreezeRequest) -> None:
+        with self._lock:
+            if req.frozen:
+                self.frozen |= set(req.partitions)
+            else:
+                self.frozen -= set(req.partitions)
+
+    # -- stats / telemetry ----------------------------------------------
+
+    def _stats(self, req: msg.PsStatsRequest) -> msg.PsStatsResponse:
+        now = time.time()
+        dt = max(now - self._qps_t0, 1e-6)
+        qps = self._qps_count / dt
+        self._qps_count = 0
+        self._qps_t0 = now
+        cpu = 0.0
+        try:
+            import psutil
+
+            cpu = psutil.Process().cpu_percent(interval=None)
+        except Exception:  # noqa: BLE001 — psutil optional
+            pass
+        with self._lock:
+            tables = {n: len(t) for n, t in self._tables.items()}
+            frozen = sorted(self.frozen)
+        return msg.PsStatsResponse(
+            ps_id=self.node_id, tables=tables, qps=qps,
+            cpu_percent=cpu, frozen_partitions=frozen,
+        )
+
+    # -- checkpoint flush / restore -------------------------------------
+
+    def _part_dir(self, table: str, partition: int) -> str:
+        return f"{self.checkpoint_dir}/{table}/p{partition:04d}"
+
+    def _flush(self, req: msg.PsFlushRequest) -> msg.PsFlushResponse:
+        """Delta-flush each owned partition to its own directory so any
+        future owner can restore it (files are per-partition — that is
+        what makes takeover after a PS death possible)."""
+        import io
+
+        flushed = 0
+        with self._lock:
+            for name, table in self._tables.items():
+                since = self._flushed_version.get(name, 0)
+                dump = self._dump_table(
+                    name, self.partitions, since, include_slots=True)
+                keys = dump.keys.to_numpy()
+                if keys.size == 0:
+                    continue
+                parts = key_partition(keys, self.num_partitions)
+                for p in np.unique(parts):
+                    mask = parts == p
+                    buf = io.BytesIO()
+                    arrays = {
+                        "keys": keys[mask],
+                        "values": dump.values.to_numpy()[mask],
+                        "freqs": dump.freqs.to_numpy()[mask],
+                        "versions": dump.versions.to_numpy()[mask],
+                    }
+                    for slot, sk in dump.slot_keys.items():
+                        sk_np = sk.to_numpy()
+                        sv_np = dump.slot_values[slot].to_numpy()
+                        smask = np.isin(sk_np, keys[mask])
+                        arrays[f"slotk_{slot}"] = sk_np[smask]
+                        arrays[f"slotv_{slot}"] = sv_np[smask]
+                    np.savez(buf, **arrays)
+                    self.storage.write_bytes(
+                        buf.getvalue(),
+                        f"{self._part_dir(name, int(p))}/"
+                        f"{req.step:012d}.npz",
+                    )
+                    flushed += int(mask.sum())
+                self._flushed_version[name] = req.step + 1
+        return msg.PsFlushResponse(flushed_rows=flushed)
+
+    def _restore(self, req: msg.PsRestoreRequest) -> None:
+        """Import all delta files of the given partitions, oldest first
+        (later flushes overwrite earlier rows on import)."""
+        import io
+
+        with self._lock:
+            for name, table in self._tables.items():
+                for p in req.partitions:
+                    pdir = self._part_dir(name, p)
+                    try:
+                        files = sorted(
+                            f for f in self.storage.listdir(pdir)
+                            if f.endswith(".npz")
+                        )
+                    except (FileNotFoundError, OSError):
+                        continue
+                    for fname in files:
+                        data = self.storage.read_bytes(f"{pdir}/{fname}")
+                        arrays = np.load(io.BytesIO(data))
+                        table.import_(
+                            arrays["keys"], arrays["values"],
+                            arrays["freqs"], arrays["versions"],
+                        )
+                        for arr_name in arrays.files:
+                            if arr_name.startswith("slotk_"):
+                                slot = arr_name[len("slotk_"):]
+                                table.import_slot(
+                                    slot, arrays[arr_name],
+                                    arrays[f"slotv_{slot}"],
+                                )
+                    logger.info(
+                        "PS %d restored partition %d of %s",
+                        self.node_id, p, name,
+                    )

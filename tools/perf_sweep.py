@@ -1,0 +1,104 @@
+"""Perf sweep harness: times the GPT-2 train step across configs.
+
+Usage: python tools/perf_sweep.py 'remat,flash,batch[,block_q,block_k]' ...
+  remat: full | attn | none
+  flash: flash | xla
+
+Prints one line per config: config, step ms, MFU, vs_baseline.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import functools
+import sys
+import time
+
+import jax
+import jax.numpy as jnp
+import optax
+
+from dlrover_tpu.models import gpt
+from dlrover_tpu.parallel.mesh import MeshConfig, build_mesh
+from dlrover_tpu.trainer.step import (
+    make_sharded_init,
+    make_train_step,
+    shard_batch,
+)
+
+PEAK = 197e12
+REF_HFU = 0.496
+
+
+def run_config(mesh, spec: str) -> None:
+    parts = spec.split(",")
+    remat_s, flash_s, batch_s = parts[0], parts[1], parts[2]
+    block_q = int(parts[3]) if len(parts) > 3 else 128
+    block_k = int(parts[4]) if len(parts) > 4 else 128
+    remat = {"full": True, "attn": "attention", "none": False}[remat_s]
+    use_flash = flash_s == "flash"
+
+    cfg = dataclasses.replace(
+        gpt.GPTConfig.gpt2(), remat=remat, use_flash_attention=use_flash
+    )
+    batch = int(batch_s)
+
+    attn_fn = None
+    if use_flash:
+        from dlrover_tpu.ops.flash_attention import flash_attention
+
+        attn_fn = functools.partial(
+            flash_attention, causal=True, block_q=block_q, block_k=block_k
+        )
+
+    optimizer = optax.adamw(3e-4, weight_decay=0.1)
+    loss = functools.partial(gpt.loss_fn_fused, cfg=cfg, attn_fn=attn_fn)
+    init, _ = make_sharded_init(
+        mesh,
+        functools.partial(gpt.init_params, cfg=cfg),
+        gpt.param_logical_axes(cfg),
+        optimizer,
+    )
+    params, opt_state = init(jax.random.PRNGKey(0))
+    step = make_train_step(mesh, loss, optimizer)
+
+    tokens = jax.random.randint(
+        jax.random.PRNGKey(1), (batch, cfg.block_size), 0, cfg.vocab_size
+    )
+    targets = jnp.roll(tokens, -1, axis=1)
+    tokens, targets = shard_batch(mesh, tokens, targets)
+
+    try:
+        for _ in range(3):
+            params, opt_state, metrics = step(
+                params, opt_state, tokens, targets
+            )
+        float(metrics["loss"])
+        n_steps = 10
+        t0 = time.time()
+        for _ in range(n_steps):
+            params, opt_state, metrics = step(
+                params, opt_state, tokens, targets
+            )
+        float(metrics["loss"])
+        dt = (time.time() - t0) / n_steps
+    except Exception as e:  # noqa: BLE001
+        print(f"{spec:32s} FAILED: {type(e).__name__}: {str(e)[:120]}")
+        return
+    tok_s = batch * cfg.block_size / dt
+    mfu = tok_s * gpt.flops_per_token(cfg) / PEAK
+    print(
+        f"{spec:32s} step={dt*1000:7.1f}ms tok/s={tok_s:9.0f} "
+        f"mfu={mfu:.3f} vs={mfu/REF_HFU:.3f}",
+        flush=True,
+    )
+
+
+def main():
+    mesh = build_mesh(MeshConfig(data=len(jax.devices())))
+    for spec in sys.argv[1:]:
+        run_config(mesh, spec)
+
+
+if __name__ == "__main__":
+    main()
