@@ -26,6 +26,21 @@ class NodeType:
     ALL = (MASTER, WORKER, DATA_WORKER, EMBEDDING, EVALUATOR)
 
 
+# PS (EMBEDDING) hosts pick their own ps_id starting at 0, same as
+# workers pick ranks — the job-manager node table is shared, so PS
+# node ids live in their own namespace to avoid colliding with (and
+# silently merging onto) worker nodes of the same id.
+PS_NODE_ID_BASE = 1_000_000
+
+
+def ps_node_id(ps_id: int) -> int:
+    return PS_NODE_ID_BASE + ps_id
+
+
+def node_ps_id(node_id: int) -> int:
+    return node_id - PS_NODE_ID_BASE
+
+
 class NodeStatus:
     """Lifecycle states of a node; transitions in common/status_flow.py."""
 

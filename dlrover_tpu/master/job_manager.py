@@ -332,6 +332,21 @@ class JobManager:
         if relaunch:
             self._relaunch(node)
 
+    def retire_node(self, node_id: int) -> None:
+        """Gracefully retire a node (drained PS, scale-in): DELETED
+        through the normal transition path so listeners fire and
+        finish_time is set, then the pod is removed."""
+        with self._lock:
+            node = self._nodes.get(node_id)
+        if node is None:
+            return
+        node.relaunchable = False
+        node.update_status(NodeStatus.DELETED)
+        self._notify(node, NodeEventType.DELETED)
+        plan = ScalePlan()
+        plan.remove_nodes.append(node)
+        self.scaler.scale(plan)
+
     def handle_node_succeeded(self, node_id: int) -> None:
         with self._lock:
             node = self._nodes.get(node_id)

@@ -21,6 +21,12 @@ def parse_args(argv=None):
     parser.add_argument("--min_nodes", type=int, default=0)
     parser.add_argument("--node_unit", type=int, default=1)
     parser.add_argument("--rdzv_timeout", type=float, default=30.0)
+    # Sparse/CTR jobs: enable hot-PS migration + worker adjustment
+    # (master/auto_scaler.py:PsTrainingAutoScaler).
+    parser.add_argument("--ps_autoscale", action="store_true")
+    parser.add_argument(
+        "--ps_autoscale_interval", type=float, default=30.0
+    )
     return parser.parse_args(argv)
 
 
@@ -34,6 +40,8 @@ def main(argv=None) -> int:
         rdzv_timeout=args.rdzv_timeout,
     )
     master.prepare()
+    if args.ps_autoscale:
+        master.start_ps_autoscaler(interval=args.ps_autoscale_interval)
     # Print the bound port on stdout so a parent process can discover it.
     print(f"DLROVER_TPU_MASTER_PORT={master.port}", flush=True)
     return master.run()

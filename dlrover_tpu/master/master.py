@@ -45,6 +45,9 @@ class JobMaster:
         self.task_manager = TaskManager()
         self.speed_monitor = SpeedMonitor()
         self.kv_store = KVStoreService()
+        from dlrover_tpu.master.ps_manager import PsManager
+
+        self.ps_manager = PsManager()
         self.elastic_rdzv = ElasticRendezvous()
         self.check_rdzv = NetworkCheckRendezvous()
         for rdzv in (self.elastic_rdzv, self.check_rdzv):
@@ -61,7 +64,12 @@ class JobMaster:
             check_rdzv=self.check_rdzv,
             kv_store=self.kv_store,
             speed_monitor=self.speed_monitor,
+            ps_manager=self.ps_manager,
         )
+        # PS-strategy auto-scaling starts on demand (sparse/CTR jobs):
+        # master.start_ps_autoscaler() wires the hot-PS optimizer to
+        # the registered PS fleet.
+        self.ps_auto_scaler = None
         dispatcher = RpcDispatcher()
         self.servicer.register(dispatcher)
         self._server = RpcServer(dispatcher, port=port)
@@ -96,6 +104,24 @@ class JobMaster:
         self.job_manager.start()
         self.task_manager.start()
 
+    def start_ps_autoscaler(self, interval: float = 30.0) -> None:
+        """Enable PS-strategy auto-scaling (hot-PS migration + worker
+        adjustment) for sparse/CTR jobs. Parity:
+        dlrover/python/master/node/job_auto_scaler.py:136
+        start_auto_scaling."""
+        if self.ps_auto_scaler is None:
+            from dlrover_tpu.master.auto_scaler import (
+                PsTrainingAutoScaler,
+            )
+
+            self.ps_auto_scaler = PsTrainingAutoScaler(
+                self.job_manager,
+                self.speed_monitor,
+                self.ps_manager,
+                interval=interval,
+            )
+            self.ps_auto_scaler.start()
+
     def run(self, poll_interval: float = 2.0) -> int:
         """Block until the job completes; returns an exit code."""
         try:
@@ -109,6 +135,8 @@ class JobMaster:
 
     def stop(self) -> None:
         self._stopped.set()
+        if self.ps_auto_scaler is not None:
+            self.ps_auto_scaler.stop()
         self.task_manager.stop()
         self.job_manager.stop()
         self._server.stop(0)

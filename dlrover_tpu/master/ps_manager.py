@@ -124,13 +124,45 @@ class PsManager:
                 reason=f"remove ps {ps_id}", restore_parts=dead_parts
             )
 
+    def drain_ps(self, ps_id: int) -> None:
+        """Gracefully retire a still-alive PS (hot-PS migration, scale
+        -in): its partitions move PS-to-PS to the survivors (freeze ->
+        pull -> publish) instead of being restored from checkpoint —
+        the live analogue of the reference's migrate-then-drop
+        (master/node/ps.py:327 _migrate_parameter_server)."""
+        with self._lock:
+            if ps_id not in self._map.ps_addrs:
+                return
+            if len(self._map.ps_addrs) > 1:
+                # The rebalance publishes the new map (version bump)
+                # to the survivors; the drained node just drops out of
+                # the address book afterwards — no second bump, or the
+                # published version would go stale immediately.
+                self._rebalance(
+                    reason=f"drain ps {ps_id}", exclude=ps_id
+                )
+                del self._map.ps_addrs[ps_id]
+                c = self._clients.pop(ps_id, None)
+                if c is not None:
+                    c.close()
+                self._stats.pop(ps_id, None)
+                return
+        # Last PS: nothing to move to — plain removal (checkpoint
+        # restore is the only recovery once a new PS appears).
+        self.remove_ps(ps_id)
+
     # -- rebalancing -----------------------------------------------------
 
     def _rebalance(self, reason: str,
-                   restore_parts: Optional[List[int]] = None) -> None:
+                   restore_parts: Optional[List[int]] = None,
+                   exclude: Optional[int] = None) -> None:
         """Compute the minimal-move assignment and execute the
-        migration plan. Must hold the lock."""
-        ps_ids = sorted(self._map.ps_addrs)
+        migration plan. Must hold the lock. ``exclude``: a still-alive
+        node to plan around — it gets no partitions in the new map but
+        remains a valid pull source for the moves."""
+        ps_ids = sorted(
+            i for i in self._map.ps_addrs if i != exclude
+        )
         old = self._map
         new_assignment = balanced_assignment(
             ps_ids, self.num_partitions, previous=old
@@ -228,6 +260,17 @@ class PsManager:
                 if s.cpu_percent >= cpu_threshold
             )
 
-    def stats(self) -> Dict[int, msg.PsStatsReport]:
+    def stats(
+        self, max_age: Optional[float] = None
+    ) -> Dict[int, msg.PsStatsReport]:
+        """Latest report per PS; ``max_age`` (seconds) drops stale
+        entries so a PS that stopped reporting can't keep steering
+        the auto-scaler with its last value."""
+        now = time.time()
         with self._lock:
-            return dict(self._stats)
+            return {
+                node_id: s
+                for node_id, s in self._stats.items()
+                if max_age is None
+                or now - self._stats_time.get(node_id, 0.0) <= max_age
+            }

@@ -281,6 +281,23 @@ class MasterServicer:
 
     def _register_ps(self, req: msg.PsRegisterRequest):
         self.ps_manager.register_ps(req.node_id, req.addr)
+        # PS hosts are job nodes too: the node table is what the
+        # PS auto-scaler plans over (ref master/node/ps.py keeps PS
+        # in the same node dict as workers). PS ids are namespaced
+        # (constants.ps_node_id) so ps 0 never merges onto worker 0.
+        from dlrover_tpu.common.constants import NodeType, ps_node_id
+
+        self.job_manager.register_node(
+            node_type=NodeType.EMBEDDING,
+            node_id=ps_node_id(req.node_id),
+            addr=req.addr,
+        )
 
     def _report_ps_stats(self, req: msg.PsStatsReport):
+        from dlrover_tpu.common.constants import ps_node_id
+
         self.ps_manager.report_stats(req)
+        # stats reports double as the PS host's heartbeat — without
+        # this the 180s watchdog would kill every healthy PS.
+        self.job_manager.update_heartbeat(ps_node_id(req.node_id))
+

@@ -176,6 +176,38 @@ class TestElasticity:
         assert len(servers[0].table("emb")) == 512
         client.close()
 
+    def test_drain_moves_rows_live_without_flush(self, cluster):
+        """Hot-PS migration path: drain a still-alive PS — its rows
+        must move PS-to-PS (no checkpoint flush ever happened), unlike
+        remove_ps which restores from the flush dir."""
+        mgr, servers, _ = cluster
+        client = _make_client(mgr)
+        keys = np.arange(256, dtype=np.int64)
+        client.lookup("emb", keys)
+        client.apply_gradients(
+            "emb", keys, np.full((256, 8), 0.1, np.float32),
+            step=1, optimizer="adagrad", lr=0.1,
+        )
+        vals_before = client.lookup("emb", keys, train=False)
+        drained = servers[1]
+        assert len(drained.table("emb")) > 0
+        mgr.drain_ps(1)  # NOTE: no flush_all before this
+        # survivor owns everything; drained node can stop now
+        assert set(mgr.partition_map.assignment) == {0}
+        drained.stop()
+        servers.pop(1)
+        vals_after = client.lookup("emb", keys, train=False)
+        np.testing.assert_allclose(vals_before, vals_after, rtol=1e-6)
+        assert len(servers[0].table("emb")) == 256
+        # optimizer slots moved too: another step on the survivor
+        # continues adagrad from the accumulated state (values keep
+        # moving, no reset-sized jump)
+        client.apply_gradients(
+            "emb", keys, np.full((256, 8), 0.1, np.float32),
+            step=2, optimizer="adagrad", lr=0.1,
+        )
+        client.close()
+
     def test_concurrent_traffic_through_reshard(self, cluster):
         """Workers keep training while the master reshards: stale-map
         rejections retry transparently, nothing is lost or wedged."""
