@@ -97,20 +97,15 @@ def _build_for_strategy(
 
 def _dry_run(
     strategy: Strategy,
-    model_init,
-    model_loss,
-    logical_axes,
-    learning_rate,
-    devices,
+    built,
     sample_batch: Tuple[jax.Array, jax.Array],
     steps: int = 3,
 ) -> Tuple[float, float]:
     """(samples_per_sec, compile_seconds). The reference's
-    dry_runner.profile — real compiled steps, timed."""
-    mesh, _, init, step = _build_for_strategy(
-        strategy, model_init, model_loss, logical_axes,
-        learning_rate, devices,
-    )
+    dry_runner.profile — real compiled steps, timed. ``built`` is the
+    (mesh, optimizer, init, step) tuple from the build cache, so the
+    winning strategy's executable is reused, never recompiled."""
+    mesh, _, init, step = built
     tokens, targets = sample_batch
     n = strategy.micro_batch_size
     tokens = jnp.tile(tokens[:1], (n,) + (1,) * (tokens.ndim - 1))
@@ -174,12 +169,14 @@ def auto_accelerate(
     hbm = hbm_bytes if hbm_bytes is not None else (16 << 30)
 
     viable: List[Strategy] = []
+    cost_prior: List[float] = []
     for cand in candidates:
         est, fits = estimate_step_memory(
             analysis, cand, activation_bytes_per_sample, hbm
         )
         if fits:
             viable.append(cand)
+            cost_prior.append(est)
     logger.info(
         "strategy search: %d candidates, %d fit in memory",
         len(candidates),
@@ -191,29 +188,37 @@ def auto_accelerate(
             f"needs more than {hbm} bytes/device on {len(devices)} "
             "devices"
         )
-    # Prefer more model sharding when memory is tight, more data
-    # parallelism when it is not: sort by estimated memory (asc) and
-    # take a diverse prefix for dry-running.
-    scored = []
-    for cand in viable[: max_dry_runs * 4]:
-        est, _ = estimate_step_memory(
-            analysis, cand, activation_bytes_per_sample, hbm
-        )
-        scored.append((est, cand))
-    scored.sort(key=lambda x: x[0])
-    to_run = [c for _, c in scored[:max_dry_runs]]
 
+    # Compile cache: one build (and one XLA compile) per strategy —
+    # the winner's executable is handed back, not recompiled.
+    build_cache: Dict[str, Tuple] = {}
+
+    def build(s: Strategy):
+        key = s.to_json()
+        if key not in build_cache:
+            build_cache[key] = _build_for_strategy(
+                s, model_init, model_loss, logical_axes,
+                learning_rate, devices,
+            )
+        return build_cache[key]
+
+    # BO over the viable set, seeded by the memory cost model (ref
+    # bayes_opt_sg.py:35; TPU compile times make each avoided dry-run
+    # tens of seconds of wall clock).
+    from dlrover_tpu.accelerate.bayes_search import BayesStrategySearch
+
+    search = BayesStrategySearch(viable, cost_prior=cost_prior)
     log: List[Dict] = []
-    best: Optional[Tuple[float, Strategy]] = None
-    for cand in to_run:
+    while search.should_continue(max_dry_runs):
+        cand = search.suggest()
         try:
             tput, compile_s = _dry_run(
-                cand, model_init, model_loss, logical_axes,
-                learning_rate, devices, sample_batch,
+                cand, build(cand), sample_batch
             )
         except Exception as exc:  # noqa: BLE001 — OOM/shape mismatch
             logger.warning("strategy %s failed: %s", cand.name(), exc)
             log.append({"strategy": cand.name(), "error": str(exc)})
+            search.observe(cand, None)
             continue
         log.append(
             {
@@ -228,16 +233,20 @@ def auto_accelerate(
             tput,
             compile_s,
         )
-        if best is None or tput > best[0]:
-            best = (tput, cand)
-    if best is None:
+        search.observe(cand, tput)
+        # Evict losers' executables: keeping every dry-run program
+        # resident shrinks free HBM for later candidates and can
+        # fake an OOM on a strategy that fits in production.
+        keep = search.best_strategy()
+        keep_key = keep.to_json() if keep is not None else None
+        for key in list(build_cache):
+            if key != keep_key:
+                del build_cache[key]
+    chosen = search.best_strategy()
+    if chosen is None:
         raise RuntimeError(f"all dry-runs failed: {log}")
 
-    tput, chosen = best
-    mesh, optimizer, init, step = _build_for_strategy(
-        chosen, model_init, model_loss, logical_axes,
-        learning_rate, devices,
-    )
+    mesh, optimizer, init, step = build(chosen)  # cache hit
     return AccelerateResult(
         strategy=chosen,
         mesh=mesh,
@@ -245,6 +254,6 @@ def auto_accelerate(
         init_fn=init,
         step_fn=step,
         shard_batch_fn=lambda t, g: shard_batch(mesh, t, g),
-        throughput=tput,
+        throughput=search.best_throughput(),
         search_log=log,
     )

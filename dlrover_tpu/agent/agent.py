@@ -135,6 +135,11 @@ class AgentConfig:
     monitor_interval: float = 2.0
     rdzv_timeout: float = 600.0
     network_check: bool = False
+    # With network_check: a node the master judges a straggler (>2x
+    # median check time) exits instead of joining training, so the
+    # scaler replaces it (ref dlrover-run --exclude-straggler,
+    # trainer/torch/elastic_run.py:99-137).
+    exclude_straggler: bool = False
     heartbeat_interval: float = 15.0
     # >0 enables hang detection: restart the training process when no
     # step progress for this many seconds (ref: atorch
@@ -332,6 +337,20 @@ class ElasticAgent:
         if self.client.node_rank in faults:
             logger.error("this node FAILED the network check")
             return False
+        stragglers, _ = self.client.query_stragglers()
+        if self.client.node_rank in stragglers:
+            if self.config.exclude_straggler:
+                logger.error(
+                    "this node is a STRAGGLER (>2x median check "
+                    "time) and --exclude-straggler is set; exiting "
+                    "so it gets replaced"
+                )
+                return False
+            logger.warning(
+                "this node is a STRAGGLER (>2x median check time); "
+                "continuing (pass --exclude-straggler to exit "
+                "instead)"
+            )
         return True
 
     # -- main loop ----------------------------------------------------------

@@ -25,6 +25,12 @@ class CheckpointStorage(ABC):
     @abstractmethod
     def read_bytes(self, path: str) -> bytes: ...
 
+    def read_range(self, path: str, offset: int, length: int) -> bytes:
+        """Read ``length`` bytes at ``offset``. Base implementation
+        reads the whole object; backends with ranged reads (POSIX
+        seek, GCS/S3 Range headers) override for streaming restore."""
+        return self.read_bytes(path)[offset:offset + length]
+
     @abstractmethod
     def exists(self, path: str) -> bool: ...
 
@@ -66,6 +72,11 @@ class PosixStorage(CheckpointStorage):
     def read_bytes(self, path: str) -> bytes:
         with open(path, "rb") as f:
             return f.read()
+
+    def read_range(self, path: str, offset: int, length: int) -> bytes:
+        with open(path, "rb") as f:
+            f.seek(offset)
+            return f.read(length)
 
     def exists(self, path: str) -> bool:
         return os.path.exists(path)

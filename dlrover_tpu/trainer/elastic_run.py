@@ -73,6 +73,13 @@ def parse_args(argv=None):
         dest="network_check",
         help="run the ICI psum+matmul health check before training",
     )
+    parser.add_argument(
+        "--exclude-straggler",
+        action="store_true",
+        dest="exclude_straggler",
+        help="with --network-check: exit (and get replaced) when the "
+        "master judges this node a straggler (>2x median check time)",
+    )
     parser.add_argument("--rdzv_timeout", type=float, default=600.0)
     parser.add_argument(
         "-m",
@@ -142,6 +149,16 @@ def _local_chip_count() -> int:
     try:
         import jax
 
+        # Honor an explicit JAX_PLATFORMS=cpu even when a TPU plugin
+        # preregistered itself (the env var alone loses to a
+        # registered backend; same dance as jax_env.setup_distributed)
+        # — otherwise this device query would try to reach a TPU the
+        # caller explicitly opted out of.
+        if os.getenv("JAX_PLATFORMS", "") == "cpu":
+            try:
+                jax.config.update("jax_platforms", "cpu")
+            except Exception:  # noqa: BLE001 — already initialized
+                pass
         return len(jax.local_devices())
     except Exception:  # noqa: BLE001
         return 1
@@ -187,6 +204,7 @@ def run(args) -> int:
         local_world_size=nproc,
         max_restarts=args.max_restarts,
         network_check=args.network_check,
+        exclude_straggler=args.exclude_straggler,
         rdzv_timeout=args.rdzv_timeout,
     )
     agent = ElasticAgent(config, entry_cmd)

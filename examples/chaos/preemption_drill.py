@@ -59,9 +59,13 @@ def find_training_pid(agent_pid: int):
 
 def main() -> int:
     p = argparse.ArgumentParser()
-    p.add_argument("--steps", type=int, default=60)
+    p.add_argument("--steps", type=int, default=2000)
     p.add_argument("--kill-signal", default="KILL")
     p.add_argument("--recovery-budget", type=float, default=120.0)
+    p.add_argument(
+        "--output", default="",
+        help="also write the result JSON to this path",
+    )
     args = p.parse_args()
 
     job = f"drill{os.getpid()}"
@@ -73,6 +77,12 @@ def main() -> int:
         XLA_FLAGS="--xla_force_host_platform_device_count=8",
         DLROVER_TPU_JOB_NAME=job,
         DLROVER_TPU_METRICS_FILE=metrics,
+        # Persistent compilation cache: the restarted process must not
+        # pay the cold compile again — same mechanism production TPU
+        # jobs rely on for fast recovery.
+        JAX_COMPILATION_CACHE_DIR=os.path.join(tmp, "jaxcache"),
+        JAX_PERSISTENT_CACHE_MIN_ENTRY_SIZE_BYTES="0",
+        JAX_PERSISTENT_CACHE_MIN_COMPILE_TIME_SECS="0",
     )
     cmd = [
         sys.executable, "-m", "dlrover_tpu.trainer.elastic_run",
@@ -83,8 +93,8 @@ def main() -> int:
     ]
     launcher = subprocess.Popen(cmd, env=env)
     try:
-        # wait for steady stepping
-        deadline = time.time() + 300
+        # wait for steady stepping (cold compile on 1 CPU core is slow)
+        deadline = time.time() + 600
         last = (-1, 0.0)
         rates = []
         while time.time() < deadline:
@@ -144,6 +154,9 @@ def main() -> int:
             "within_budget": recovered_at is not None,
         }
         print(json.dumps(result))
+        if args.output:
+            with open(args.output, "w") as f:
+                json.dump(result, f, indent=1)
         return 0 if recovered_at is not None else 1
     finally:
         launcher.terminate()

@@ -128,7 +128,15 @@ def main(argv=None) -> int:
     )
     ckpt = Checkpointer(ckpt_dir)
     start_step = 0
-    restored = ckpt.load_checkpoint((params, opt_state))
+    # Pass shardings: the restore then STREAMS — each host fetches
+    # only the shard byte-ranges its devices need (engine.py
+    # load_streaming), instead of assembling the full state host-side.
+    state_shardings = jax.tree.map(
+        lambda x: x.sharding, (params, opt_state)
+    )
+    restored = ckpt.load_checkpoint(
+        (params, opt_state), shardings=state_shardings
+    )
     if restored is not None:
         params, opt_state = restored
         start_step = ckpt.last_restored_step

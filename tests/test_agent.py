@@ -132,6 +132,141 @@ class TestAgentSupervision:
         assert node.status == "failed"
 
 
+class TestExcludeStraggler:
+    def test_straggler_excluded_only_with_flag(
+        self, monkeypatch, tmp_path
+    ):
+        """3 nodes run the network check; node 2 is 9x slower than the
+        median. Without --exclude-straggler it continues (warn only);
+        with it, run_network_check returns False so the node exits and
+        gets replaced (ref dlrover-run --exclude-straggler)."""
+        from dlrover_tpu.common.constants import NodeEnv
+
+        master = JobMaster(port=0, node_num=3, rdzv_timeout=10.0)
+        master.prepare()
+        try:
+            class FakeDone:
+                returncode = 0
+
+            def fake_run(cmd, env=None, **kw):
+                import time as _t
+
+                pid = int(env.get(NodeEnv.PROCESS_ID, "0"))
+                _t.sleep(0.45 if pid == 2 else 0.05)
+                return FakeDone()
+
+            from dlrover_tpu.agent import agent as agent_mod
+
+            monkeypatch.setattr(
+                agent_mod.subprocess, "run", fake_run
+            )
+
+            results = {}
+
+            def run_one(node_id, exclude):
+                client = _client(master, node_id)
+                config = AgentConfig(
+                    node_id=node_id,
+                    node_rank=node_id,
+                    local_world_size=1,
+                    network_check=True,
+                    exclude_straggler=exclude,
+                    rdzv_timeout=10.0,
+                )
+                agent = ElasticAgent(
+                    config, [sys.executable, "-c", ""], client=client
+                )
+                results[node_id] = agent.run_network_check()
+
+            threads = [
+                threading.Thread(
+                    target=run_one, args=(i, i == 2), daemon=True
+                )
+                for i in range(3)
+            ]
+            for t in threads:
+                t.start()
+            for t in threads:
+                t.join(timeout=60)
+            # fast nodes pass; the straggler with the flag exits
+            assert results[0] is True
+            assert results[1] is True
+            assert results[2] is False
+            stragglers, _ = (
+                master.servicer.rdzv_managers["network-check"]
+                .get_stragglers()
+            )
+            assert stragglers == [2]
+        finally:
+            master.stop()
+
+    def test_straggler_continues_without_flag(
+        self, monkeypatch
+    ):
+        """Same drill but the slow node does NOT pass the flag: it
+        must keep running (True)."""
+        from dlrover_tpu.common.constants import NodeEnv
+
+        master = JobMaster(port=0, node_num=3, rdzv_timeout=10.0)
+        master.prepare()
+        try:
+            class FakeDone:
+                returncode = 0
+
+            def fake_run(cmd, env=None, **kw):
+                import time as _t
+
+                pid = int(env.get(NodeEnv.PROCESS_ID, "0"))
+                _t.sleep(0.45 if pid == 2 else 0.05)
+                return FakeDone()
+
+            from dlrover_tpu.agent import agent as agent_mod
+
+            monkeypatch.setattr(
+                agent_mod.subprocess, "run", fake_run
+            )
+            results = {}
+
+            def run_one(node_id):
+                client = _client(master, node_id)
+                config = AgentConfig(
+                    node_id=node_id,
+                    node_rank=node_id,
+                    local_world_size=1,
+                    network_check=True,
+                    exclude_straggler=False,
+                    rdzv_timeout=10.0,
+                )
+                agent = ElasticAgent(
+                    config, [sys.executable, "-c", ""], client=client
+                )
+                results[node_id] = agent.run_network_check()
+
+            threads = [
+                threading.Thread(
+                    target=run_one, args=(i,), daemon=True
+                )
+                for i in range(3)
+            ]
+            for t in threads:
+                t.start()
+            for t in threads:
+                t.join(timeout=60)
+            assert results == {0: True, 1: True, 2: True}
+        finally:
+            master.stop()
+
+    def test_cli_flag_reaches_agent_config(self):
+        from dlrover_tpu.trainer.elastic_run import parse_args
+
+        args = parse_args(
+            ["--network-check", "--exclude-straggler", "t.py"]
+        )
+        assert args.exclude_straggler is True
+        args = parse_args(["t.py"])
+        assert args.exclude_straggler is False
+
+
 class TestStandaloneCli:
     def test_end_to_end(self, tmp_path):
         """dlrover-tpu-run --standalone runs a real training script that

@@ -171,6 +171,169 @@ class TestEngineSaverEndToEnd:
             engine.close()
 
 
+class CountingStorage:
+    """PosixStorage wrapper that accounts every byte read."""
+
+    def __init__(self):
+        from dlrover_tpu.common.storage import PosixStorage
+
+        self._s = PosixStorage()
+        self.full_read_paths = []
+        self.range_bytes = 0
+
+    def read_bytes(self, path):
+        self.full_read_paths.append(path)
+        return self._s.read_bytes(path)
+
+    def read_range(self, path, offset, length):
+        self.range_bytes += length
+        return self._s.read_range(path, offset, length)
+
+    def __getattr__(self, name):
+        return getattr(self._s, name)
+
+
+def _craft_checkpoint(tmp_path, step=5):
+    """Hand-craft a 2-host checkpoint: rank0 holds rows 0:8 of ``w``
+    plus a big ``junk`` leaf, rank1 holds rows 8:16 of ``w``. Returns
+    (ckpt_dir, w, junk_nbytes, total_payload_bytes)."""
+    from dlrover_tpu.trainer.flash_checkpoint.engine import (
+        TRACKER_FILE,
+        pack_shard_file,
+    )
+
+    ckpt_dir = str(tmp_path / "crafted")
+    sdir = f"{ckpt_dir}/{step}"
+    os.makedirs(sdir, exist_ok=True)
+    w = np.arange(256, dtype=np.float32).reshape(16, 16)
+    junk = np.ones((64, 64), np.float32)  # 16KB nobody asks for
+
+    total = 0
+    for rank, (rows, extras) in enumerate(
+        [((0, 8), [("junk", junk)]), ((8, 16), [])]
+    ):
+        arrays = [("w", w[rows[0]:rows[1]],
+                   ((rows[0], rows[1]), (0, 16)), (16, 16))]
+        for name, arr in extras:
+            arrays.append(
+                (name, arr,
+                 tuple((0, s) for s in arr.shape), arr.shape)
+            )
+        plans = [
+            (name, str(arr.dtype), gshape, index, arr.nbytes)
+            for name, arr, index, gshape in arrays
+        ]
+        entries, size = ckpt_shm.plan_entries(plans)
+        payload = bytearray(size)
+        for e, (_, arr, _, _) in zip(entries, arrays):
+            payload[e.offset:e.offset + e.nbytes] = arr.tobytes()
+        data = pack_shard_file(step, entries, {}, bytes(payload))
+        with open(f"{sdir}/rank{rank}.ckpt", "wb") as f:
+            f.write(data)
+        total += size
+    with open(f"{ckpt_dir}/{TRACKER_FILE}", "w") as f:
+        f.write(str(step))
+    return ckpt_dir, w, junk.nbytes, total
+
+
+class TestStreamingRestore:
+    def test_slice_read_touches_only_owning_shard(self, tmp_path):
+        """Fetching rows 0:8 must read rank0's w bytes only — not
+        rank1's shard and not the junk leaf."""
+        ckpt_dir, w, _, _ = _craft_checkpoint(tmp_path)
+        storage = CountingStorage()
+        engine = CheckpointEngine(
+            ckpt_dir, use_agent=False, storage=storage,
+            global_rank=0, world_size=1,
+        )
+        try:
+            step, index, _ = engine.read_shard_metas()
+            assert step == 5
+            meta_bytes = storage.range_bytes
+            sub = engine._read_slice(
+                index["w"], (16, 16), "float32",
+                (slice(0, 8), slice(0, 16)),
+            )
+            np.testing.assert_array_equal(sub, w[0:8])
+            payload_read = storage.range_bytes - meta_bytes
+            assert payload_read == w[0:8].nbytes  # exactly one shard
+            # sub-band: rows 2:4 cost 2 rows of bytes, not the entry
+            before = storage.range_bytes
+            sub2 = engine._read_slice(
+                index["w"], (16, 16), "float32",
+                (slice(2, 4), slice(0, 16)),
+            )
+            np.testing.assert_array_equal(sub2, w[2:4])
+            assert storage.range_bytes - before == w[2:4].nbytes
+            assert not [p for p in storage.full_read_paths
+                        if p.endswith('.ckpt')]
+        finally:
+            engine.close()
+
+    def test_streaming_load_reads_less_than_checkpoint(self, tmp_path):
+        """End-to-end load with shardings: bytes read < total
+        checkpoint payload (the junk leaf is never fetched), and the
+        restored array equals the original across both rank files."""
+        ckpt_dir, w, junk_nbytes, total = _craft_checkpoint(tmp_path)
+        storage = CountingStorage()
+        engine = CheckpointEngine(
+            ckpt_dir, use_agent=False, storage=storage,
+            global_rank=0, world_size=1,
+        )
+        mesh = _mesh((8,), ("data",))
+        target = NamedSharding(mesh, P("data"))
+        try:
+            step, state, _ = engine.load(
+                {"w": jax.ShapeDtypeStruct((16, 16), jnp.float32)},
+                shardings={"w": target},
+            )
+            assert step == 5
+            np.testing.assert_array_equal(np.asarray(state["w"]), w)
+            assert state["w"].sharding == target
+            assert storage.range_bytes < total  # junk never read
+            assert total - storage.range_bytes >= junk_nbytes // 2
+            assert not [p for p in storage.full_read_paths
+                        if p.endswith('.ckpt')]
+        finally:
+            engine.close()
+
+    def test_streaming_load_missing_coverage_raises(self, tmp_path):
+        """A checkpoint whose shards don't cover the requested slice
+        must fail loudly, not return zeros."""
+        from dlrover_tpu.trainer.flash_checkpoint.engine import (
+            TRACKER_FILE,
+            pack_shard_file,
+        )
+
+        ckpt_dir = str(tmp_path / "holey")
+        os.makedirs(f"{ckpt_dir}/1", exist_ok=True)
+        w = np.ones((8, 8), np.float32)
+        plans = [("w", "float32", (16, 8), ((0, 8), (0, 8)),
+                  w.nbytes)]
+        entries, size = ckpt_shm.plan_entries(plans)
+        payload = bytearray(size)
+        payload[entries[0].offset:entries[0].offset + w.nbytes] = (
+            w.tobytes())
+        with open(f"{ckpt_dir}/1/rank0.ckpt", "wb") as f:
+            f.write(pack_shard_file(1, entries, {}, bytes(payload)))
+        with open(f"{ckpt_dir}/{TRACKER_FILE}", "w") as f:
+            f.write("1")
+        engine = CheckpointEngine(
+            ckpt_dir, use_agent=False,
+            global_rank=0, world_size=1,
+        )
+        mesh = _mesh((8,), ("data",))
+        try:
+            with pytest.raises(Exception, match="cover|missing"):
+                engine.load(
+                    {"w": jax.ShapeDtypeStruct((16, 8), jnp.float32)},
+                    shardings={
+                        "w": NamedSharding(mesh, P("data"))},
+                )
+        finally:
+            engine.close()
+
+
 class TestCheckpointerStandalone:
     def test_self_hosted_saver(self, tmp_path):
         from dlrover_tpu.trainer.flash_checkpoint import (
