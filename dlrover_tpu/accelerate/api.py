@@ -219,6 +219,10 @@ def auto_accelerate(
             logger.warning("strategy %s failed: %s", cand.name(), exc)
             log.append({"strategy": cand.name(), "error": str(exc)})
             search.observe(cand, None)
+            # the failed candidate's executables must not stay
+            # resident either — they'd cascade the OOM into the next
+            # dry-run
+            build_cache.pop(cand.to_json(), None)
             continue
         log.append(
             {

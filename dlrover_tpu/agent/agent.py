@@ -337,7 +337,15 @@ class ElasticAgent:
         if self.client.node_rank in faults:
             logger.error("this node FAILED the network check")
             return False
-        stragglers, _ = self.client.query_stragglers()
+        try:
+            stragglers, _ = self.client.query_stragglers()
+        except Exception:  # noqa: BLE001 — a transient RPC failure
+            # must not kill a healthy node over an advisory check
+            logger.warning(
+                "straggler query failed; assuming not a straggler",
+                exc_info=True,
+            )
+            stragglers = []
         if self.client.node_rank in stragglers:
             if self.config.exclude_straggler:
                 logger.error(

@@ -28,8 +28,15 @@ class CheckpointStorage(ABC):
     def read_range(self, path: str, offset: int, length: int) -> bytes:
         """Read ``length`` bytes at ``offset``. Base implementation
         reads the whole object; backends with ranged reads (POSIX
-        seek, GCS/S3 Range headers) override for streaming restore."""
+        seek, GCS/S3 Range headers) override BOTH this and
+        ``supports_range`` for streaming restore."""
         return self.read_bytes(path)[offset:offset + length]
+
+    def supports_range(self) -> bool:
+        """Whether read_range is a true ranged read. Streaming restore
+        only engages when True — the base fallback would otherwise
+        download the whole object once per requested range."""
+        return False
 
     @abstractmethod
     def exists(self, path: str) -> bool: ...
@@ -77,6 +84,9 @@ class PosixStorage(CheckpointStorage):
         with open(path, "rb") as f:
             f.seek(offset)
             return f.read(length)
+
+    def supports_range(self) -> bool:
+        return True
 
     def exists(self, path: str) -> bool:
         return os.path.exists(path)

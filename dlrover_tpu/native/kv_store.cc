@@ -363,6 +363,184 @@ void kv_sparse_apply_ftrl(void* param_h, void* accum_h, void* linear_h,
   });
 }
 
+// Group Adam with group lasso (ref training_ops.cc:1065
+// KvVariableGroupSparseApplyAdamV2 / python group_adam.py): Adam
+// moments feed an FTRL-style linear accumulator; the whole embedding
+// row is soft-thresholded by the L21 group norm — rows whose
+// shrunk-linear norm falls under l21*sqrt(dim) collapse to exactly
+// zero (the reference blacklists the key; zeroing is the storewise
+// equivalent — the row re-learns from zero if it comes back).
+void kv_sparse_apply_group_adam(void* param_h, void* accum_h, void* linear_h,
+                                void* m_h, void* v_h, const int64_t* keys,
+                                const float* grads, int64_t n, float lr,
+                                float beta1, float beta2, float eps, float l1,
+                                float l2, float l21, int64_t step) {
+  auto* param = static_cast<KvStore*>(param_h);
+  auto* accum = static_cast<KvStore*>(accum_h);
+  auto* linear = static_cast<KvStore*>(linear_h);
+  auto* mstore = static_cast<KvStore*>(m_h);
+  auto* vstore = static_cast<KvStore*>(v_h);
+  int dim = param->dim();
+  float b1p = std::pow(beta1, static_cast<float>(step));
+  float b2p = std::pow(beta2, static_cast<float>(step));
+  float eps_adj = eps / std::sqrt(1.0f - b2p);
+  float l21_norm = l21 * std::sqrt(static_cast<float>(dim));
+  param->for_each_key(keys, n, step, [&](int64_t i, float* p) {
+    const float* g = grads + i * dim;
+    int64_t key = keys[i];
+    accum->for_each_key(&key, 1, step, [&](int64_t, float* a) {
+      linear->for_each_key(&key, 1, step, [&](int64_t, float* l) {
+        mstore->for_each_key(&key, 1, step, [&](int64_t, float* m) {
+          vstore->for_each_key(&key, 1, step, [&](int64_t, float* v) {
+            float norm_sq = 0.0f;
+            for (int d = 0; d < dim; ++d) {
+              m[d] = beta1 * m[d] + (1.0f - beta1) * g[d];
+              v[d] = beta2 * v[d] + (1.0f - beta2) * g[d] * g[d];
+              float new_a = v[d] / (1.0f - b2p);
+              float delta = std::sqrt(new_a) - std::sqrt(a[d]);
+              if (beta1 <= b1p) delta += eps_adj;  // first step
+              l[d] += m[d] / (1.0f - b1p) - delta / lr * p[d];
+              a[d] = new_a;
+              float adj = std::fmin(std::fmax(l[d], -l1), l1);
+              float l1l = adj - l[d];
+              norm_sq += l1l * l1l;
+            }
+            float norm = std::sqrt(norm_sq);
+            if (norm > l21_norm) {
+              float scale = 1.0f - l21_norm / norm;
+              for (int d = 0; d < dim; ++d) {
+                float adj = std::fmin(std::fmax(l[d], -l1), l1);
+                float l1l = adj - l[d];
+                float y =
+                    (std::sqrt(a[d]) + eps_adj) / lr + 2.0f * l2;
+                p[d] = l1l * scale / y;
+              }
+            } else {
+              std::memset(p, 0, sizeof(float) * dim);
+            }
+          });
+        });
+      });
+    });
+  });
+}
+
+// Group FTRL with group lasso + optional l2 shrinkage (ref
+// training_ops.cc:597 KvVariableSparseGroupSparseApplyFtrlV2 /
+// python sparse_group_ftrl.py). Same L21 whole-row threshold.
+void kv_sparse_apply_group_ftrl(void* param_h, void* accum_h, void* linear_h,
+                                const int64_t* keys, const float* grads,
+                                int64_t n, float lr, float l1, float l2,
+                                float l21, float lr_power, float l2_shrinkage,
+                                int64_t step) {
+  auto* param = static_cast<KvStore*>(param_h);
+  auto* accum = static_cast<KvStore*>(accum_h);
+  auto* linear = static_cast<KvStore*>(linear_h);
+  int dim = param->dim();
+  float l21_norm = l21 * std::sqrt(static_cast<float>(dim));
+  param->for_each_key(keys, n, step, [&](int64_t i, float* p) {
+    const float* g = grads + i * dim;
+    int64_t key = keys[i];
+    accum->for_each_key(&key, 1, step, [&](int64_t, float* a) {
+      linear->for_each_key(&key, 1, step, [&](int64_t, float* l) {
+        float norm_sq = 0.0f;
+        std::vector<float> new_accum(dim);
+        for (int d = 0; d < dim; ++d) {
+          float gu = g[d] + 2.0f * l2_shrinkage * p[d];
+          new_accum[d] = a[d] + gu * gu;
+          float sigma =
+              (std::pow(new_accum[d], -lr_power) -
+               std::pow(a[d], -lr_power)) /
+              lr;
+          l[d] += gu - sigma * p[d];
+          a[d] = new_accum[d];
+          float adj = std::fmin(std::fmax(l[d], -l1), l1);
+          float l1l = adj - l[d];
+          norm_sq += l1l * l1l;
+        }
+        float norm = std::sqrt(norm_sq);
+        if (norm > l21_norm) {
+          float scale = 1.0f - l21_norm / norm;
+          for (int d = 0; d < dim; ++d) {
+            float adj = std::fmin(std::fmax(l[d], -l1), l1);
+            float l1l = adj - l[d];
+            float y = std::pow(a[d], -lr_power) / lr + 2.0f * l2;
+            p[d] = l1l * scale / y;
+          }
+        } else {
+          std::memset(p, 0, sizeof(float) * dim);
+        }
+      });
+    });
+  });
+}
+
+// LAMB (You et al. 2020) on sparse rows: per-ROW trust ratio — the
+// layerwise norm of the dense formulation becomes the embedding-row
+// norm, which is the natural unit for a KvVariable.
+void kv_sparse_apply_lamb(void* param_h, void* m_h, void* v_h,
+                          const int64_t* keys, const float* grads, int64_t n,
+                          float lr, float beta1, float beta2, float eps,
+                          float weight_decay, int64_t step) {
+  auto* param = static_cast<KvStore*>(param_h);
+  auto* mstore = static_cast<KvStore*>(m_h);
+  auto* vstore = static_cast<KvStore*>(v_h);
+  int dim = param->dim();
+  float bc1 = 1.0f - std::pow(beta1, static_cast<float>(step));
+  float bc2 = 1.0f - std::pow(beta2, static_cast<float>(step));
+  std::vector<float> u(dim);
+  param->for_each_key(keys, n, step, [&](int64_t i, float* p) {
+    const float* g = grads + i * dim;
+    int64_t key = keys[i];
+    mstore->for_each_key(&key, 1, step, [&](int64_t, float* m) {
+      vstore->for_each_key(&key, 1, step, [&](int64_t, float* v) {
+        float p_norm_sq = 0.0f, u_norm_sq = 0.0f;
+        for (int d = 0; d < dim; ++d) {
+          m[d] = beta1 * m[d] + (1.0f - beta1) * g[d];
+          v[d] = beta2 * v[d] + (1.0f - beta2) * g[d] * g[d];
+          u[d] = (m[d] / bc1) / (std::sqrt(v[d] / bc2) + eps) +
+                 weight_decay * p[d];
+          p_norm_sq += p[d] * p[d];
+          u_norm_sq += u[d] * u[d];
+        }
+        float p_norm = std::sqrt(p_norm_sq);
+        float u_norm = std::sqrt(u_norm_sq);
+        float ratio =
+            (p_norm > 0.0f && u_norm > 0.0f) ? p_norm / u_norm : 1.0f;
+        for (int d = 0; d < dim; ++d) p[d] -= lr * ratio * u[d];
+      });
+    });
+  });
+}
+
+// AdaBelief (Zhuang et al. 2020): second moment tracks the variance
+// of the gradient around its EMA instead of the raw second moment.
+void kv_sparse_apply_adabelief(void* param_h, void* m_h, void* s_h,
+                               const int64_t* keys, const float* grads,
+                               int64_t n, float lr, float beta1, float beta2,
+                               float eps, int64_t step) {
+  auto* param = static_cast<KvStore*>(param_h);
+  auto* mstore = static_cast<KvStore*>(m_h);
+  auto* sstore = static_cast<KvStore*>(s_h);
+  int dim = param->dim();
+  float bc1 = 1.0f - std::pow(beta1, static_cast<float>(step));
+  float bc2 = 1.0f - std::pow(beta2, static_cast<float>(step));
+  param->for_each_key(keys, n, step, [&](int64_t i, float* p) {
+    const float* g = grads + i * dim;
+    int64_t key = keys[i];
+    mstore->for_each_key(&key, 1, step, [&](int64_t, float* m) {
+      sstore->for_each_key(&key, 1, step, [&](int64_t, float* s) {
+        for (int d = 0; d < dim; ++d) {
+          m[d] = beta1 * m[d] + (1.0f - beta1) * g[d];
+          float diff = g[d] - m[d];
+          s[d] = beta2 * s[d] + (1.0f - beta2) * diff * diff + eps;
+          p[d] -= lr * (m[d] / bc1) / (std::sqrt(s[d] / bc2) + eps);
+        }
+      });
+    });
+  });
+}
+
 void kv_sparse_apply_momentum(void* param_h, void* mom_h, const int64_t* keys,
                               const float* grads, int64_t n, float lr,
                               float momentum, int64_t step) {

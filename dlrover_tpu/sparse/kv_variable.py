@@ -122,6 +122,31 @@ def _lib() -> ctypes.CDLL:
                 ctypes.c_int64, ctypes.c_float, ctypes.c_float,
                 ctypes.c_int64,
             ]
+            lib.kv_sparse_apply_group_adam.argtypes = [
+                ctypes.c_void_p, ctypes.c_void_p, ctypes.c_void_p,
+                ctypes.c_void_p, ctypes.c_void_p, i64p, f32p,
+                ctypes.c_int64, ctypes.c_float, ctypes.c_float,
+                ctypes.c_float, ctypes.c_float, ctypes.c_float,
+                ctypes.c_float, ctypes.c_float, ctypes.c_int64,
+            ]
+            lib.kv_sparse_apply_group_ftrl.argtypes = [
+                ctypes.c_void_p, ctypes.c_void_p, ctypes.c_void_p,
+                i64p, f32p, ctypes.c_int64, ctypes.c_float,
+                ctypes.c_float, ctypes.c_float, ctypes.c_float,
+                ctypes.c_float, ctypes.c_float, ctypes.c_int64,
+            ]
+            lib.kv_sparse_apply_lamb.argtypes = [
+                ctypes.c_void_p, ctypes.c_void_p, ctypes.c_void_p,
+                i64p, f32p, ctypes.c_int64, ctypes.c_float,
+                ctypes.c_float, ctypes.c_float, ctypes.c_float,
+                ctypes.c_float, ctypes.c_int64,
+            ]
+            lib.kv_sparse_apply_adabelief.argtypes = [
+                ctypes.c_void_p, ctypes.c_void_p, ctypes.c_void_p,
+                i64p, f32p, ctypes.c_int64, ctypes.c_float,
+                ctypes.c_float, ctypes.c_float, ctypes.c_float,
+                ctypes.c_int64,
+            ]
             _LIB = lib
     return _LIB
 
@@ -284,6 +309,58 @@ class KvVariable:
                 ukeys, ugrads, ukeys.size,
                 lr, kw.get("momentum", 0.9), step,
             )
+        elif optimizer == "group_adam":
+            # Adam + group lasso (ref tfplus group_adam.py /
+            # training_ops.cc:1065): rows whose L21-shrunk linear norm
+            # drops below l21*sqrt(dim) collapse to exact zeros.
+            lib.kv_sparse_apply_group_adam(
+                h,
+                self._slot("accum_ga").handle,
+                self._slot("linear_ga").handle,
+                self._slot("m").handle,
+                self._slot("v").handle,
+                ukeys, ugrads, ukeys.size,
+                lr, kw.get("beta1", 0.9), kw.get("beta2", 0.999),
+                kw.get("eps", 1e-8), kw.get("l1", 0.0),
+                kw.get("l2", 0.0), kw.get("l21", 0.0), max(step, 1),
+            )
+        elif optimizer == "group_ftrl":
+            lr_power = kw.get("lr_power", -0.5)
+            if lr_power > 0:
+                raise ValueError(
+                    f"ftrl lr_power must be <= 0, got {lr_power}"
+                )
+            lib.kv_sparse_apply_group_ftrl(
+                h,
+                self._slot(
+                    "accum_ftrl", _INIT_CONST,
+                    kw.get("initial_accumulator", 0.1),
+                ).handle,
+                self._slot("linear").handle,
+                ukeys, ugrads, ukeys.size,
+                lr, kw.get("l1", 0.0), kw.get("l2", 0.0),
+                kw.get("l21", 0.0), lr_power,
+                kw.get("l2_shrinkage", 0.0), step,
+            )
+        elif optimizer == "lamb":
+            lib.kv_sparse_apply_lamb(
+                h,
+                self._slot("m").handle,
+                self._slot("v").handle,
+                ukeys, ugrads, ukeys.size,
+                lr, kw.get("beta1", 0.9), kw.get("beta2", 0.999),
+                kw.get("eps", 1e-6),
+                kw.get("weight_decay", 0.0), max(step, 1),
+            )
+        elif optimizer == "adabelief":
+            lib.kv_sparse_apply_adabelief(
+                h,
+                self._slot("m").handle,
+                self._slot("s").handle,
+                ukeys, ugrads, ukeys.size,
+                lr, kw.get("beta1", 0.9), kw.get("beta2", 0.999),
+                kw.get("eps", 1e-16), max(step, 1),
+            )
         else:
             raise ValueError(f"unknown sparse optimizer {optimizer!r}")
 
@@ -389,8 +466,11 @@ class KvVariable:
 
 class SparseOptimizer:
     """Convenience: one object applying the same rule to many
-    KvVariables (ref python/training/group_adam.py GroupAdam et al —
-    'group' = shared hyperparameters across embedding tables)."""
+    KvVariables. Rules: adam | adagrad | ftrl | momentum | lamb |
+    adabelief | group_adam | group_ftrl — the group_* variants carry
+    the reference's group-lasso L21 row sparsification
+    (tfplus python/training/group_adam.py, sparse_group_ftrl.py;
+    kernels in native/kv_store.cc)."""
 
     def __init__(self, optimizer: str = "adam", lr: float = 1e-3, **kw):
         self.optimizer = optimizer

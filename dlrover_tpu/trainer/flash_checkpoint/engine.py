@@ -405,13 +405,15 @@ class CheckpointEngine:
                 f"shardings tree structure {shard_def} does not "
                 f"match `like` tree structure {like_def}")
         sharding_leaves = jax.tree_util.tree_leaves(shardings)
+        # Fail on missing leaves BEFORE streaming gigabytes of the
+        # present ones.
+        missing = [n for n, _ in named if n not in index]
+        if missing:
+            raise KeyError(
+                f"checkpoint step {found_step} missing leaves: "
+                f"{missing[:5]}{'...' if len(missing) > 5 else ''}")
         leaves = []
-        missing = []
         for (name, leaf), sharding in zip(named, sharding_leaves):
-            if name not in index:
-                missing.append(name)
-                leaves.append(None)
-                continue
             sources = index[name]
             gshape = sources[0][2].global_shape
             dtype_name = sources[0][2].dtype
@@ -434,10 +436,6 @@ class CheckpointEngine:
             if jdtype is not None and arr.dtype != jdtype:
                 arr = arr.astype(jdtype)
             leaves.append(arr)
-        if missing:
-            raise KeyError(
-                f"checkpoint step {found_step} missing leaves: "
-                f"{missing[:5]}{'...' if len(missing) > 5 else ''}")
         treedef = jax.tree_util.tree_structure(like)
         state = jax.tree_util.tree_unflatten(treedef, leaves)
         return found_step, state, extra
@@ -455,7 +453,10 @@ class CheckpointEngine:
         """
         import jax
 
-        if shardings is not None:
+        # Streaming needs real ranged reads; on a backend whose
+        # read_range is the whole-object fallback, each range request
+        # would re-download the file — assemble-then-reshard instead.
+        if shardings is not None and self.storage.supports_range():
             return self.load_streaming(like, shardings, step)
         res = self.load_flat(step)
         if res is None:
@@ -477,7 +478,11 @@ class CheckpointEngine:
                 f"{missing[:5]}{'...' if len(missing) > 5 else ''}")
         treedef = jax.tree_util.tree_structure(like)
         state = jax.tree_util.tree_unflatten(treedef, leaves)
-        state = jax.tree.map(jax.numpy.asarray, state)
+        if shardings is not None:
+            state = jax.tree.map(
+                lambda x, s: jax.device_put(x, s), state, shardings)
+        else:
+            state = jax.tree.map(jax.numpy.asarray, state)
         return found_step, state, extra
 
     def close(self) -> None:

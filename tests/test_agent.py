@@ -58,7 +58,7 @@ class TestRendezvousHandler:
         for t in threads:
             t.start()
         for t in threads:
-            t.join(timeout=60)
+            t.join(timeout=120)
         assert specs[0].node_world_size == 2
         assert specs[0].num_processes == 2
         assert {specs[0].node_rank, specs[1].node_rank} == {0, 1}
@@ -142,7 +142,7 @@ class TestExcludeStraggler:
         gets replaced (ref dlrover-run --exclude-straggler)."""
         from dlrover_tpu.common.constants import NodeEnv
 
-        master = JobMaster(port=0, node_num=3, rdzv_timeout=10.0)
+        master = JobMaster(port=0, node_num=3, rdzv_timeout=60.0)
         master.prepare()
         try:
             class FakeDone:
@@ -171,7 +171,7 @@ class TestExcludeStraggler:
                     local_world_size=1,
                     network_check=True,
                     exclude_straggler=exclude,
-                    rdzv_timeout=10.0,
+                    rdzv_timeout=60.0,
                 )
                 agent = ElasticAgent(
                     config, [sys.executable, "-c", ""], client=client
@@ -187,7 +187,7 @@ class TestExcludeStraggler:
             for t in threads:
                 t.start()
             for t in threads:
-                t.join(timeout=60)
+                t.join(timeout=120)
             # fast nodes pass; the straggler with the flag exits
             assert results[0] is True
             assert results[1] is True
@@ -207,7 +207,7 @@ class TestExcludeStraggler:
         must keep running (True)."""
         from dlrover_tpu.common.constants import NodeEnv
 
-        master = JobMaster(port=0, node_num=3, rdzv_timeout=10.0)
+        master = JobMaster(port=0, node_num=3, rdzv_timeout=60.0)
         master.prepare()
         try:
             class FakeDone:
@@ -235,7 +235,7 @@ class TestExcludeStraggler:
                     local_world_size=1,
                     network_check=True,
                     exclude_straggler=False,
-                    rdzv_timeout=10.0,
+                    rdzv_timeout=60.0,
                 )
                 agent = ElasticAgent(
                     config, [sys.executable, "-c", ""], client=client
@@ -251,7 +251,7 @@ class TestExcludeStraggler:
             for t in threads:
                 t.start()
             for t in threads:
-                t.join(timeout=60)
+                t.join(timeout=120)
             assert results == {0: True, 1: True, 2: True}
         finally:
             master.stop()

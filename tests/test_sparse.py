@@ -233,3 +233,174 @@ def test_sparse_ftrl_lr_power_convention():
                        step=31, lr=0.1)
     late_delta = np.abs(kv.gather(keys) - prev).mean()
     assert late_delta < first_delta
+
+
+def test_sparse_lamb_matches_optax_per_row():
+    """Fused C++ sparse LAMB == optax.lamb with each embedding row as
+    its own leaf (the trust ratio is per-row here, per-leaf there)."""
+    import jax.numpy as jnp
+    import optax
+
+    dim = 8
+    kv = KvVariable("emb", embedding_dim=dim, seed=11)
+    keys = np.array([3, 17], np.int64)
+    init_vals = kv.gather(keys).copy()
+    grads = np.random.default_rng(1).normal(size=(2, dim)).astype(
+        np.float32
+    )
+
+    opt = optax.lamb(1e-2, eps=1e-6, weight_decay=0.01)
+    dense = {str(i): jnp.asarray(init_vals[i]) for i in range(2)}
+    state = opt.init(dense)
+    for step in range(1, 4):
+        kv.apply_gradients(
+            "lamb", keys, grads, step=step, lr=1e-2,
+            weight_decay=0.01,
+        )
+        gtree = {str(i): jnp.asarray(grads[i]) for i in range(2)}
+        updates, state = opt.update(gtree, state, dense)
+        dense = optax.apply_updates(dense, updates)
+    got = kv.gather(keys, train=False)
+    want = np.stack([np.asarray(dense[str(i)]) for i in range(2)])
+    np.testing.assert_allclose(got, want, atol=1e-5, rtol=1e-4)
+
+
+def test_sparse_adabelief_matches_optax():
+    import jax.numpy as jnp
+    import optax
+
+    dim = 8
+    kv = KvVariable("emb", embedding_dim=dim, seed=12)
+    keys = np.array([4, 8], np.int64)
+    init_vals = kv.gather(keys).copy()
+    grads = np.random.default_rng(2).normal(size=(2, dim)).astype(
+        np.float32
+    )
+    opt = optax.adabelief(1e-2, eps=1e-8, eps_root=1e-8)
+    dense = jnp.asarray(init_vals)
+    state = opt.init(dense)
+    for step in range(1, 5):
+        kv.apply_gradients(
+            "adabelief", keys, grads, step=step, lr=1e-2, eps=1e-8,
+        )
+        updates, state = opt.update(jnp.asarray(grads), state, dense)
+        dense = optax.apply_updates(dense, updates)
+    np.testing.assert_allclose(
+        kv.gather(keys, train=False), np.asarray(dense),
+        atol=1e-5, rtol=1e-4,
+    )
+
+
+def _group_adam_numpy(p, g, steps, lr, b1, b2, eps, l1, l2, l21):
+    """Direct transcription of the reference's GroupAdam kernel math
+    (tfplus training_ops.cc:1065 COMPUTE_ADAM)."""
+    dim = p.shape[-1]
+    m = np.zeros_like(p)
+    v = np.zeros_like(p)
+    a = np.zeros_like(p)
+    lin = np.zeros_like(p)
+    l21n = l21 * np.sqrt(dim)
+    for t in range(1, steps + 1):
+        b1p, b2p = b1**t, b2**t
+        eps_adj = eps / np.sqrt(1.0 - b2p)
+        m = b1 * m + (1 - b1) * g
+        v = b2 * v + (1 - b2) * g * g
+        new_a = v / (1.0 - b2p)
+        delta = np.sqrt(new_a) - np.sqrt(a)
+        if b1 <= b1p:  # only at t == 1
+            delta = delta + eps_adj
+        lin = lin + m / (1.0 - b1p) - delta / lr * p
+        a = new_a
+        adj = np.clip(lin, -l1, l1)
+        l1l = adj - lin
+        norm = np.sqrt((l1l**2).sum(-1, keepdims=True))
+        y = (np.sqrt(a) + eps_adj) / lr + 2.0 * l2
+        scale = np.where(norm > l21n, 1.0 - l21n / norm, 0.0)
+        p = np.where(norm > l21n, l1l * scale / y, 0.0)
+    return p.astype(np.float32)
+
+
+def test_group_adam_matches_reference_formula():
+    dim = 8
+    kv = KvVariable("emb", embedding_dim=dim, seed=13)
+    keys = np.array([1, 2], np.int64)
+    init_vals = kv.gather(keys).copy()
+    grads = np.random.default_rng(3).normal(size=(2, dim)).astype(
+        np.float32
+    )
+    kwargs = dict(lr=0.05, b1=0.9, b2=0.999, eps=1e-8, l1=0.001,
+                  l2=0.01, l21=0.001)
+    for step in range(1, 4):
+        kv.apply_gradients(
+            "group_adam", keys, grads, step=step, lr=kwargs["lr"],
+            beta1=kwargs["b1"], beta2=kwargs["b2"],
+            eps=kwargs["eps"], l1=kwargs["l1"], l2=kwargs["l2"],
+            l21=kwargs["l21"],
+        )
+    want = _group_adam_numpy(
+        init_vals, grads, 3, kwargs["lr"], kwargs["b1"],
+        kwargs["b2"], kwargs["eps"], kwargs["l1"], kwargs["l2"],
+        kwargs["l21"],
+    )
+    np.testing.assert_allclose(
+        kv.gather(keys, train=False), want, atol=1e-5, rtol=1e-4,
+    )
+
+
+def test_group_lasso_zeroes_weak_rows():
+    """The L21 group penalty must collapse rows with weak gradients to
+    EXACT zeros while strong rows keep learning (the reference
+    blacklists such keys; the sparsification is the point)."""
+    dim = 8
+    kv = KvVariable("emb", embedding_dim=dim, seed=14)
+    keys = np.array([100, 200], np.int64)
+    strong = np.ones(dim, np.float32)
+    weak = np.full(dim, 1e-4, np.float32)
+    grads = np.stack([strong, weak])
+    for step in range(1, 20):
+        kv.apply_gradients(
+            "group_adam", keys, grads, step=step, lr=0.1, l21=0.01,
+        )
+    vals = kv.gather(keys, train=False)
+    assert np.abs(vals[0]).max() > 0  # strong row survives
+    np.testing.assert_array_equal(vals[1], np.zeros(dim))  # exact 0
+
+
+def test_group_ftrl_reduces_to_ftrl_without_group_terms():
+    """l21=0 (and l1=l2=shrinkage=0): group FTRL must equal the plain
+    fused FTRL kernel step for step."""
+    dim = 6
+    kv_a = KvVariable("emb_a", embedding_dim=dim, seed=15)
+    kv_b = KvVariable("emb_b", embedding_dim=dim, seed=15)
+    keys = np.array([7], np.int64)
+    np.testing.assert_array_equal(kv_a.gather(keys), kv_b.gather(keys))
+    grads = np.random.default_rng(4).normal(size=(1, dim)).astype(
+        np.float32
+    )
+    for step in range(1, 6):
+        kv_a.apply_gradients("ftrl", keys, grads, step=step, lr=0.1)
+        kv_b.apply_gradients(
+            "group_ftrl", keys, grads, step=step, lr=0.1, l21=0.0,
+        )
+    np.testing.assert_allclose(
+        kv_a.gather(keys, train=False),
+        kv_b.gather(keys, train=False),
+        atol=1e-6, rtol=1e-6,
+    )
+
+
+def test_group_ftrl_l21_sparsifies():
+    dim = 8
+    kv = KvVariable("emb", embedding_dim=dim, seed=16)
+    keys = np.array([1, 2], np.int64)
+    grads = np.stack([
+        np.ones(dim, np.float32),
+        np.full(dim, 1e-4, np.float32),
+    ])
+    for step in range(1, 20):
+        kv.apply_gradients(
+            "group_ftrl", keys, grads, step=step, lr=0.1, l21=0.02,
+        )
+    vals = kv.gather(keys, train=False)
+    assert np.abs(vals[0]).max() > 0
+    np.testing.assert_array_equal(vals[1], np.zeros(dim))
