@@ -322,6 +322,13 @@ class ElasticAgent:
             elapsed = time.time() - start
             normal = result.returncode == 0
             self.client.report_network_check(normal, elapsed)
+        return self.network_check_verdict()
+
+    def network_check_verdict(self) -> bool:
+        """Consume the master's fault + straggler verdicts for this
+        node after check results were reported. Split from
+        run_network_check so the decision (incl. --exclude-straggler)
+        is testable without live rendezvous timing."""
         deadline = time.time() + self.config.rdzv_timeout
         faults, reason = self.client.query_fault_nodes()
         while reason == "waiting":

@@ -1,15 +1,29 @@
 """Brain service: historical job metrics -> resource plans.
 
 Algorithms re-derived from the reference's optalgorithm set
-(go/brain/pkg/optimizer/implementation/optalgorithm/):
+(go/brain/pkg/optimizer/implementation/optalgorithm/ — one function per
+file, registered by name; same registry shape here in ALGORITHMS):
 
-* ``optimize_job_resource`` — initial plan from similar completed jobs
-  (optimize_job_worker_create_resource.go): median of what worked.
-* ``optimize_worker_oom`` — grow memory after OOM
-  (optimize_job_worker_resource.go): max(seen peak * 1.5, request * 2).
+* ``optimize_job_resource`` — initial worker plan from similar
+  completed jobs (optimize_job_worker_create_resource.go).
+* ``optimize_worker_oom`` — grow memory after a worker OOM
+  (optimize_job_worker_resource.go runtime path).
+* ``optimize_worker_create_oom`` — initial memory for a job family
+  with OOM history (optimize_job_worker_create_oom_resource.go).
 * ``optimize_worker_count`` — throughput-knee detection
-  (optimize_job_worker_count.go): stop adding workers when marginal
-  speedup per worker drops below a threshold.
+  (optimize_job_worker_resource.go count path).
+* ``optimize_ps_create`` — PS count/resource from similar historic
+  jobs (optimize_job_ps_create_resource.go).
+* ``optimize_ps_cold_create`` — cold-start defaults with no history
+  (optimize_job_ps_cold_create_resource.go).
+* ``optimize_ps_init_adjust`` — PS cpu from the model's recv-op
+  count + margin once the first steps ran
+  (optimize_job_ps_init_adjust_resource.go).
+* ``optimize_ps_oom`` — PS OOM memory growth
+  (optimize_job_ps_oom_resource.go).
+* ``optimize_hot_ps`` — per-node cpu/memory hotness over the last N
+  runtime samples -> grow hot PS nodes
+  (optimize_job_hot_ps_resource.go).
 
 The datastore is sqlite (stdlib) instead of MySQL — same schema shape
 (job facts + runtime samples), zero deployment burden.
@@ -46,7 +60,28 @@ class JobMetricsRecord:
     timestamp: float = 0.0
 
 
+@dataclasses.dataclass
+class RuntimeSample:
+    """One telemetry snapshot of one node — the analogue of the
+    reference's JobRuntimeInfo rows (PSCPU/PSMemory/WorkerCPU maps)."""
+
+    job_name: str
+    node_type: str  # "worker" | "ps"
+    node_id: int
+    used_cpu: float
+    used_memory_mb: int
+    config_cpu: float
+    config_memory_mb: int
+    speed: float = 0.0  # global steps/s at sample time
+    timestamp: float = 0.0
+
+
 class BrainService:
+    # samples averaged for hotness decisions (ref
+    # optimplcomm.NRecordToAvgResource)
+    HOT_WINDOW = 3
+    MAX_PS_CPU = 32.0  # ref maxCPUThreshold
+
     def __init__(self, db_path: str = ":memory:"):
         self._db = sqlite3.connect(db_path, check_same_thread=False)
         self._lock = threading.Lock()
@@ -56,6 +91,24 @@ class BrainService:
                 memory_mb INT, chips_per_worker INT, throughput REAL,
                 peak_memory_mb INT, oom INT, completed INT,
                 timestamp REAL
+            )"""
+        )
+        self._db.execute(
+            """CREATE TABLE IF NOT EXISTS runtime_samples (
+                job_name TEXT, node_type TEXT, node_id INT,
+                used_cpu REAL, used_memory_mb INT, config_cpu REAL,
+                config_memory_mb INT, speed REAL, timestamp REAL
+            )"""
+        )
+        self._db.execute(
+            """CREATE INDEX IF NOT EXISTS idx_runtime_samples
+               ON runtime_samples (job_name, node_type, timestamp)"""
+        )
+        self._db.execute(
+            """CREATE TABLE IF NOT EXISTS ps_job_facts (
+                job_name TEXT, model_signature TEXT, ps_count INT,
+                ps_cpu REAL, ps_memory_mb INT, recv_op_count INT,
+                oom INT, completed INT, timestamp REAL
             )"""
         )
 
@@ -118,6 +171,75 @@ class BrainService:
         candidate = int(max(peaks) * 1.5) if peaks else requested_mb * 2
         return max(candidate, int(requested_mb * 1.5))
 
+    # keep this many newest samples per (job, node_type) — hotness
+    # windows are tiny, unbounded telemetry would grow forever
+    SAMPLE_RETENTION = 1000
+
+    def persist_runtime_sample(self, s: RuntimeSample) -> None:
+        with self._lock:
+            self._db.execute(
+                "INSERT INTO runtime_samples VALUES "
+                "(?,?,?,?,?,?,?,?,?)",
+                (
+                    s.job_name, s.node_type, s.node_id, s.used_cpu,
+                    s.used_memory_mb, s.config_cpu,
+                    s.config_memory_mb, s.speed,
+                    s.timestamp or time.time(),
+                ),
+            )
+            self._db.execute(
+                "DELETE FROM runtime_samples WHERE rowid IN ("
+                "  SELECT rowid FROM runtime_samples"
+                "  WHERE job_name = ? AND node_type = ?"
+                "  ORDER BY timestamp DESC"
+                "  LIMIT -1 OFFSET ?)",
+                (s.job_name, s.node_type, self.SAMPLE_RETENTION),
+            )
+            self._db.commit()
+
+    def persist_ps_job(
+        self,
+        job_name: str,
+        signature: str,
+        ps_count: int,
+        ps_cpu: float,
+        ps_memory_mb: int,
+        recv_op_count: int = 0,
+        oom: bool = False,
+        completed: bool = True,
+    ) -> None:
+        with self._lock:
+            self._db.execute(
+                "INSERT INTO ps_job_facts VALUES (?,?,?,?,?,?,?,?,?)",
+                (
+                    job_name, signature, ps_count, ps_cpu,
+                    ps_memory_mb, recv_op_count, int(oom),
+                    int(completed), time.time(),
+                ),
+            )
+            self._db.commit()
+
+    def _recent_samples(
+        self, job_name: str, node_type: str, window: int
+    ) -> Dict[int, List[tuple]]:
+        """node_id -> newest-first [(used_cpu, used_mem, cfg_cpu,
+        cfg_mem)] limited to ``window`` per node."""
+        with self._lock:
+            cur = self._db.execute(
+                "SELECT node_id, used_cpu, used_memory_mb, "
+                "config_cpu, config_memory_mb FROM runtime_samples "
+                "WHERE job_name = ? AND node_type = ? "
+                "ORDER BY timestamp DESC",
+                (job_name, node_type),
+            )
+            rows = cur.fetchall()
+        out: Dict[int, List[tuple]] = {}
+        for node_id, ucpu, umem, ccpu, cmem in rows:
+            bucket = out.setdefault(node_id, [])
+            if len(bucket) < window:
+                bucket.append((ucpu, umem, ccpu, cmem))
+        return out
+
     def optimize_worker_count(
         self, signature: str, min_marginal_gain: float = 0.6
     ) -> Optional[int]:
@@ -139,6 +261,192 @@ class BrainService:
             else:
                 break
         return best
+
+
+    def optimize_worker_create_oom(
+        self, signature: str, default_mb: int = 8192
+    ) -> int:
+        """Initial worker memory for a job family whose history shows
+        OOMs (ref optimize_job_worker_create_oom_resource.go): above
+        every OOM'd request and every observed peak."""
+        rows = self._rows(signature)
+        oom_requests = [r[1] for r in rows if r[5]]
+        peaks = [r[4] for r in rows if r[4] > 0]
+        if not oom_requests and not peaks:
+            return default_mb
+        floor = max(oom_requests + peaks)
+        return int(floor * 1.5)
+
+    # -- PS-strategy algorithms -----------------------------------------
+
+    def _ps_rows(self, signature: str) -> List[tuple]:
+        with self._lock:
+            cur = self._db.execute(
+                "SELECT ps_count, ps_cpu, ps_memory_mb, "
+                "recv_op_count, oom, completed FROM ps_job_facts "
+                "WHERE model_signature = ?",
+                (signature,),
+            )
+            return cur.fetchall()
+
+    def optimize_ps_create(self, signature: str) -> Optional[Dict]:
+        """PS plan from similar completed jobs (ref
+        optimize_job_ps_create_resource.go ->
+        EstimateJobResourceByHistoricJobs): median count, max cpu, max
+        memory that never OOM'd."""
+        rows = [r for r in self._ps_rows(signature) if r[5]]
+        if not rows:
+            return None
+        counts = sorted(r[0] for r in rows)
+        no_oom = [r for r in rows if not r[4]]
+        pool = no_oom or rows
+        return {
+            "ps_count": counts[len(counts) // 2],
+            "ps_cpu": max(r[1] for r in pool),
+            "ps_memory_mb": max(r[2] for r in pool),
+        }
+
+    def optimize_ps_cold_create(
+        self,
+        default_count: int = 2,
+        default_cpu: float = 8.0,
+        default_memory_mb: int = 8192,
+    ) -> Dict:
+        """Cold start — no history for the family (ref
+        optimize_job_ps_cold_create_resource.go config defaults)."""
+        return {
+            "ps_count": default_count,
+            "ps_cpu": default_cpu,
+            "ps_memory_mb": default_memory_mb,
+        }
+
+    def optimize_ps_init_adjust(
+        self,
+        job_name: str,
+        recv_op_count: int,
+        ps_count: int,
+        margin_cpu: float = 4.0,
+        memory_margin_percent: float = 0.5,
+    ) -> Optional[Dict]:
+        """Right after the first steps: size PS cpu from the model's
+        recv-op fan-in per PS (ref
+        optimize_job_ps_init_adjust_resource.go: cpu =
+        ceil(0.08 * recv_ops_per_ps) + margin, capped; memory = peak *
+        (1 + margin))."""
+        if ps_count <= 0:
+            return None
+        recv_per_ps = recv_op_count / ps_count
+        if recv_per_ps <= 150:
+            cpu = float(int(0.08 * recv_per_ps + 0.999)) + margin_cpu
+        else:
+            cpu = 16.0
+        samples = self._recent_samples(
+            job_name, "ps", self.HOT_WINDOW
+        )
+        peak_mem = 0
+        observed_cpu = 0.0
+        for rows in samples.values():
+            for ucpu, umem, _, _ in rows:
+                peak_mem = max(peak_mem, umem)
+                observed_cpu = max(observed_cpu, ucpu)
+        cpu = min(max(cpu, observed_cpu + margin_cpu),
+                  self.MAX_PS_CPU)
+        plan: Dict = {"ps_cpu": cpu}
+        if peak_mem > 0:
+            plan["ps_memory_mb"] = int(
+                peak_mem * (1.0 + memory_margin_percent)
+            )
+        return plan
+
+    def optimize_ps_oom(
+        self, signature: str, requested_mb: int
+    ) -> int:
+        """Memory for an OOM'd PS relaunch (ref
+        optimize_job_ps_oom_resource.go): above every observed PS
+        request that OOM'd."""
+        rows = self._ps_rows(signature)
+        oomed = [r[2] for r in rows if r[4]]
+        floor = max(oomed, default=requested_mb)
+        return int(max(floor, requested_mb) * 1.5)
+
+    def optimize_hot_ps(
+        self,
+        job_name: str,
+        current_workers: int,
+        target_workers: int,
+        hot_cpu_util: float = 0.8,
+        hot_memory_util: float = 0.8,
+        memory_adjust_mb: int = 4096,
+    ) -> Dict[int, Dict]:
+        """Per-node hotness over the last HOT_WINDOW samples (ref
+        optimize_job_hot_ps_resource.go): a PS averaging above the cpu
+        threshold gets cpu scaled by target/current workers (capped at
+        MAX_PS_CPU, every PS scaled by the same coefficient); one
+        above the memory threshold gets a fixed memory bump. Returns
+        {ps_id: {"cpu": new, "memory_mb": new}}."""
+        samples = self._recent_samples(
+            job_name, "ps", self.HOT_WINDOW
+        )
+        avg_cpu: Dict[int, float] = {}
+        cfg_cpu: Dict[int, float] = {}
+        hot_cpu: List[int] = []
+        hot_mem: Dict[int, int] = {}
+        for node_id, rows in samples.items():
+            if len(rows) < self.HOT_WINDOW:
+                continue
+            a_cpu = sum(r[0] for r in rows) / len(rows)
+            avg_cpu[node_id] = a_cpu
+            cfg_cpu[node_id] = rows[0][2]
+            if rows[0][2] > 0 and a_cpu / rows[0][2] >= hot_cpu_util:
+                hot_cpu.append(node_id)
+            a_mem = sum(r[1] for r in rows) / len(rows)
+            if (rows[0][3] > 0
+                    and a_mem / rows[0][3] >= hot_memory_util):
+                hot_mem[node_id] = rows[0][3]
+        plan: Dict[int, Dict] = {}
+        if hot_cpu and current_workers > 0:
+            coeff = target_workers / current_workers
+            for n in hot_cpu:
+                if avg_cpu[n] * coeff > self.MAX_PS_CPU:
+                    coeff = self.MAX_PS_CPU / avg_cpu[n]
+            # enlarge every PS by the same ratio (the ref scales the
+            # whole group so the load stays balanced)
+            for n, cpu in avg_cpu.items():
+                opt = float(int(cpu * coeff + 0.999))
+                if opt > cfg_cpu.get(n, 0.0):
+                    plan[n] = {"cpu": min(opt, self.MAX_PS_CPU)}
+        for n, cfg_mem in hot_mem.items():
+            entry = plan.setdefault(n, {})
+            entry["memory_mb"] = cfg_mem + memory_adjust_mb
+        return plan
+
+
+# Name -> bound-method registry, mirroring the reference's
+# registerOptimizeAlgorithm table (optimize_algorithm.go).
+ALGORITHMS = {
+    "optimize_job_worker_create_resource": "optimize_job_resource",
+    "optimize_job_worker_resource": "optimize_worker_count",
+    "optimize_job_worker_create_oom_resource":
+        "optimize_worker_create_oom",
+    "optimize_job_worker_oom_resource": "optimize_worker_oom",
+    "optimize_job_ps_create_resource": "optimize_ps_create",
+    "optimize_job_ps_cold_create_resource": "optimize_ps_cold_create",
+    "optimize_job_ps_init_adjust_resource": "optimize_ps_init_adjust",
+    "optimize_job_ps_oom_resource": "optimize_ps_oom",
+    "optimize_job_hot_ps_resource": "optimize_hot_ps",
+}
+
+
+def run_algorithm(brain: BrainService, name: str, /, *args, **kw):
+    """Invoke a registered algorithm by its reference name."""
+    try:
+        method = ALGORITHMS[name]
+    except KeyError:
+        raise KeyError(
+            f"unknown brain algorithm {name!r}; known: "
+            f"{sorted(ALGORITHMS)}"
+        ) from None
+    return getattr(brain, method)(*args, **kw)
 
 
 class BrainResourceOptimizer(ResourceOptimizer):

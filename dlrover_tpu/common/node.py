@@ -27,6 +27,10 @@ class NodeResource:
     # TPU chips attached to this host (4 for a v5p host, 8 for v5e-8, ...)
     chips: int = 0
     tpu_type: str = ""  # e.g. "v5p", "v5e"
+    # Which TPU slice of a multi-slice job this host belongs to; the
+    # scaler keeps replacements in the dead host's slice so the DCN
+    # mesh axis stays balanced.
+    slice_id: int = 0
     # Utilisation telemetry filled in by the agent's resource monitor.
     used_cpu: float = 0.0
     used_memory_mb: int = 0

@@ -440,12 +440,16 @@ class AllreduceAutoScaler:
         target_workers: int,
         optimizer: Optional[ResourceOptimizer] = None,
         interval: float = 30.0,
+        num_slices: int = 1,
     ):
         self.job_manager = job_manager
         self.speed_monitor = speed_monitor
         self.target_workers = target_workers
         self.optimizer = optimizer or LocalResourceOptimizer()
         self.interval = interval
+        # Multi-slice jobs: replacements must land in the deficient
+        # slice so the DCN (outer) mesh axis stays balanced.
+        self.num_slices = max(num_slices, 1)
         self._stop = threading.Event()
         self._thread: Optional[threading.Thread] = None
 
@@ -491,19 +495,39 @@ class AllreduceAutoScaler:
         target. Returns the plan if one was issued."""
         self.grow_oom_resources()
         nodes = self.job_manager.list_nodes(NodeType.WORKER)
+        # ALIVE includes PENDING: replacements in flight count toward
+        # the target (counting them twice would strand the job one
+        # worker short of the target forever).
         alive = [n for n in nodes if n.is_alive()]
-        pending = [n for n in nodes if n.status == NodeStatus.PENDING]
         target = self.optimizer.target_worker_count(
             self.target_workers, self.speed_monitor
         )
-        missing = target - len(alive) - len(pending)
+        missing = target - len(alive)
         if missing <= 0:
             return None
+
+        # Fill the most-deficient slice first so the DCN axis stays
+        # balanced (each slice is one block of the outer mesh axis).
+        def slice_of(n: Node) -> int:
+            if n.config_resource is None:
+                return 0
+            return n.config_resource.slice_id % self.num_slices
+
+        counts = {s: 0 for s in range(self.num_slices)}
+        templates: dict = {}
+        for n in alive:
+            s = slice_of(n)
+            counts[s] += 1
+            templates.setdefault(s, n)
+        fallback = alive[0] if alive else (nodes[0] if nodes else None)
+
         used_ids = {n.id for n in nodes}
         plan = ScalePlan()
         next_id = max(used_ids, default=-1) + 1
-        template = alive[0] if alive else (nodes[0] if nodes else None)
         for i in range(missing):
+            s = min(counts, key=counts.get)
+            counts[s] += 1
+            template = templates.get(s, fallback)
             resource = (
                 NodeResource.from_dict(
                     template.config_resource.to_dict()
@@ -511,6 +535,7 @@ class AllreduceAutoScaler:
                 if template is not None and template.config_resource
                 else NodeResource()
             )
+            resource.slice_id = s
             plan.launch_nodes.append(
                 Node(
                     type=NodeType.WORKER,
@@ -524,11 +549,12 @@ class AllreduceAutoScaler:
             self.job_manager.adopt_node(node)
         self.job_manager.scaler.scale(plan)
         logger.info(
-            "auto-scaler: %d alive / %d pending of target %d -> "
-            "launching %d",
+            "auto-scaler: %d alive of target %d -> launching %d "
+            "(slices %s)",
             len(alive),
-            len(pending),
             target,
             missing,
+            {s: c for s, c in counts.items()} if self.num_slices > 1
+            else "n/a",
         )
         return plan

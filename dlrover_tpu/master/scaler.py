@@ -196,6 +196,9 @@ class TPUPodScaler(Scaler):
                 # (cloud.google.com/gke-tpu-accelerator + topology).
                 "tpu_accelerator": res.tpu_type,
                 "tpu_chips": res.chips,
+                # multi-slice: pin the pod to its slice's node pool so
+                # the replacement lands where the dead host was
+                "tpu_slice": res.slice_id,
             }
         )
         return spec

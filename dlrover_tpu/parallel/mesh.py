@@ -81,9 +81,62 @@ class MeshConfig:
         return math.prod(self.axis_sizes().values())
 
 
+def group_devices_by_slice(
+    devices: Sequence[jax.Device],
+    num_slices: int,
+    slice_ids: Optional[Sequence[int]] = None,
+) -> Tuple[List[jax.Device], List[int]]:
+    """Order devices so slice members are contiguous blocks.
+
+    ``slice_ids`` overrides per-device slice assignment (virtual
+    slices on CPU tests); otherwise the TPU runtime's
+    ``device.slice_index`` is used. When neither distinguishes slices
+    (single-slice hardware faked into num_slices), the list is split
+    into equal contiguous blocks. Returns (ordered_devices,
+    slice_id_per_ordered_device).
+    """
+    n = len(devices)
+    if n % num_slices:
+        raise ValueError(
+            f"{n} devices not divisible into {num_slices} slices"
+        )
+    per_slice = n // num_slices
+    if slice_ids is None:
+        slice_ids = [
+            getattr(d, "slice_index", 0) or 0 for d in devices
+        ]
+    distinct = sorted(set(slice_ids))
+    if len(distinct) == num_slices:
+        groups: Dict[int, List[jax.Device]] = {s: [] for s in distinct}
+        for d, s in zip(devices, slice_ids):
+            groups[s].append(d)
+        bad = {
+            s: len(g) for s, g in groups.items() if len(g) != per_slice
+        }
+        if bad:
+            raise ValueError(
+                f"uneven slices (want {per_slice}/slice): {bad}"
+            )
+        ordered: List[jax.Device] = []
+        ordered_ids: List[int] = []
+        for s in distinct:
+            ordered.extend(groups[s])
+            ordered_ids.extend([s] * per_slice)
+        return ordered, ordered_ids
+    if len(distinct) == 1:
+        # no slice info: contiguous equal split (virtual slices)
+        ids = [i // per_slice for i in range(n)]
+        return list(devices), ids
+    raise ValueError(
+        f"devices span {len(distinct)} slices but num_slices="
+        f"{num_slices}"
+    )
+
+
 def build_mesh(
     config: MeshConfig,
     devices: Optional[Sequence[jax.Device]] = None,
+    slice_ids: Optional[Sequence[int]] = None,
 ) -> Mesh:
     """Build the job mesh.
 
@@ -91,9 +144,14 @@ def build_mesh(
     device list from ``jax.devices()`` enumerates ICI-adjacent chips
     contiguously, so innermost mesh axes land on ICI neighbors.
 
-    Multi-slice (num_slices > 1): the outermost non-trivial axis must be
-    divisible by num_slices so each slice holds a contiguous block and
-    only that axis's collectives cross DCN.
+    Multi-slice (num_slices > 1): devices are grouped so each slice is
+    one contiguous block of the outermost non-trivial axis (which must
+    be divisible by num_slices) — only that axis's collectives cross
+    DCN, everything inner stays on ICI. Slice membership comes from
+    the TPU runtime (``device.slice_index``) or an explicit
+    ``slice_ids`` list (virtual slices in CPU tests). This is the
+    capability the reference reaches via per-group NCCL bootstrap
+    across nodes (atorch/distributed/distributed.py:587).
     """
     devices = list(devices if devices is not None else jax.devices())
     config = config.resolve(len(devices))
@@ -107,6 +165,17 @@ def build_mesh(
                 f"outermost axis {outer}={sizes[outer]} not divisible "
                 f"by num_slices={config.num_slices}"
             )
+        devices, _ = group_devices_by_slice(
+            devices, config.num_slices, slice_ids
+        )
+        per_slice = len(devices) // config.num_slices
+        logger.info(
+            "multi-slice mesh: %d slices x %d devices; axis %r "
+            "crosses DCN",
+            config.num_slices,
+            per_slice,
+            outer,
+        )
     shape = tuple(sizes[a] for a in AXIS_ORDER)
     dev_array = np.asarray(devices).reshape(shape)
     mesh = Mesh(dev_array, AXIS_ORDER)
@@ -116,6 +185,17 @@ def build_mesh(
         len(devices),
     )
     return mesh
+
+
+def mesh_slice_blocks(mesh: Mesh, num_slices: int) -> List[List]:
+    """The per-slice device blocks of a multi-slice mesh (flat device
+    order), for asserting slice purity and for slice-aware ops."""
+    flat = list(mesh.devices.flat)
+    per_slice = len(flat) // num_slices
+    return [
+        flat[i * per_slice:(i + 1) * per_slice]
+        for i in range(num_slices)
+    ]
 
 
 def single_device_mesh() -> Mesh:

@@ -479,8 +479,15 @@ class CheckpointEngine:
         treedef = jax.tree_util.tree_structure(like)
         state = jax.tree_util.tree_unflatten(treedef, leaves)
         if shardings is not None:
-            state = jax.tree.map(
-                lambda x, s: jax.device_put(x, s), state, shardings)
+            # Match load_streaming: cast to `like`'s dtype so the two
+            # backends produce identical state trees.
+            def put(x, l, s):
+                want = getattr(l, "dtype", None)
+                if want is not None and x.dtype != want:
+                    x = x.astype(want)
+                return jax.device_put(x, s)
+
+            state = jax.tree.map(put, state, like, shardings)
         else:
             state = jax.tree.map(jax.numpy.asarray, state)
         return found_step, state, extra

@@ -133,65 +133,43 @@ class TestAgentSupervision:
 
 
 class TestExcludeStraggler:
-    def test_straggler_excluded_only_with_flag(
-        self, monkeypatch, tmp_path
-    ):
-        """3 nodes run the network check; node 2 is 9x slower than the
-        median. Without --exclude-straggler it continues (warn only);
-        with it, run_network_check returns False so the node exits and
-        gets replaced (ref dlrover-run --exclude-straggler)."""
-        from dlrover_tpu.common.constants import NodeEnv
+    """3 nodes report network-check results; node 2 is 40x slower
+    than the median (>2x threshold). The verdict path is driven
+    directly over RPC (no live rendezvous threads — that variant is
+    scheduling-sensitive on a 1-core CI box); the full check loop
+    incl. rendezvous is covered by TestStandaloneCli end-to-end."""
 
-        master = JobMaster(port=0, node_num=3, rdzv_timeout=60.0)
+    def _report_times(self, master, times):
+        for node_id, elapsed in times.items():
+            client = _client(master, node_id)
+            client.report_network_check(True, elapsed)
+
+    def _verdict(self, master, node_id, exclude):
+        config = AgentConfig(
+            node_id=node_id,
+            node_rank=node_id,
+            local_world_size=1,
+            network_check=True,
+            exclude_straggler=exclude,
+            rdzv_timeout=5.0,
+        )
+        agent = ElasticAgent(
+            config, [sys.executable, "-c", ""],
+            client=_client(master, node_id),
+        )
+        return agent.network_check_verdict()
+
+    def test_straggler_excluded_only_with_flag(self):
+        master = JobMaster(port=0, node_num=3, rdzv_timeout=5.0)
         master.prepare()
         try:
-            class FakeDone:
-                returncode = 0
-
-            def fake_run(cmd, env=None, **kw):
-                import time as _t
-
-                pid = int(env.get(NodeEnv.PROCESS_ID, "0"))
-                _t.sleep(0.45 if pid == 2 else 0.05)
-                return FakeDone()
-
-            from dlrover_tpu.agent import agent as agent_mod
-
-            monkeypatch.setattr(
-                agent_mod.subprocess, "run", fake_run
+            self._report_times(
+                master, {0: 0.05, 1: 0.05, 2: 2.0}
             )
-
-            results = {}
-
-            def run_one(node_id, exclude):
-                client = _client(master, node_id)
-                config = AgentConfig(
-                    node_id=node_id,
-                    node_rank=node_id,
-                    local_world_size=1,
-                    network_check=True,
-                    exclude_straggler=exclude,
-                    rdzv_timeout=60.0,
-                )
-                agent = ElasticAgent(
-                    config, [sys.executable, "-c", ""], client=client
-                )
-                results[node_id] = agent.run_network_check()
-
-            threads = [
-                threading.Thread(
-                    target=run_one, args=(i, i == 2), daemon=True
-                )
-                for i in range(3)
-            ]
-            for t in threads:
-                t.start()
-            for t in threads:
-                t.join(timeout=120)
-            # fast nodes pass; the straggler with the flag exits
-            assert results[0] is True
-            assert results[1] is True
-            assert results[2] is False
+            assert self._verdict(master, 0, exclude=False) is True
+            assert self._verdict(master, 1, exclude=False) is True
+            # straggler + flag -> excluded
+            assert self._verdict(master, 2, exclude=True) is False
             stragglers, _ = (
                 master.servicer.rdzv_managers["network-check"]
                 .get_stragglers()
@@ -200,59 +178,28 @@ class TestExcludeStraggler:
         finally:
             master.stop()
 
-    def test_straggler_continues_without_flag(
-        self, monkeypatch
-    ):
-        """Same drill but the slow node does NOT pass the flag: it
-        must keep running (True)."""
-        from dlrover_tpu.common.constants import NodeEnv
-
-        master = JobMaster(port=0, node_num=3, rdzv_timeout=60.0)
+    def test_straggler_continues_without_flag(self):
+        master = JobMaster(port=0, node_num=3, rdzv_timeout=5.0)
         master.prepare()
         try:
-            class FakeDone:
-                returncode = 0
-
-            def fake_run(cmd, env=None, **kw):
-                import time as _t
-
-                pid = int(env.get(NodeEnv.PROCESS_ID, "0"))
-                _t.sleep(0.45 if pid == 2 else 0.05)
-                return FakeDone()
-
-            from dlrover_tpu.agent import agent as agent_mod
-
-            monkeypatch.setattr(
-                agent_mod.subprocess, "run", fake_run
+            self._report_times(
+                master, {0: 0.05, 1: 0.05, 2: 2.0}
             )
-            results = {}
+            # straggler WITHOUT the flag -> keeps running
+            assert self._verdict(master, 2, exclude=False) is True
+        finally:
+            master.stop()
 
-            def run_one(node_id):
-                client = _client(master, node_id)
-                config = AgentConfig(
-                    node_id=node_id,
-                    node_rank=node_id,
-                    local_world_size=1,
-                    network_check=True,
-                    exclude_straggler=False,
-                    rdzv_timeout=60.0,
-                )
-                agent = ElasticAgent(
-                    config, [sys.executable, "-c", ""], client=client
-                )
-                results[node_id] = agent.run_network_check()
-
-            threads = [
-                threading.Thread(
-                    target=run_one, args=(i,), daemon=True
-                )
-                for i in range(3)
-            ]
-            for t in threads:
-                t.start()
-            for t in threads:
-                t.join(timeout=120)
-            assert results == {0: True, 1: True, 2: True}
+    def test_failed_node_still_fails_regardless_of_flag(self):
+        master = JobMaster(port=0, node_num=3, rdzv_timeout=5.0)
+        master.prepare()
+        try:
+            for node_id, (ok, t) in {
+                0: (True, 0.05), 1: (True, 0.05), 2: (False, 0.05),
+            }.items():
+                _client(master, node_id).report_network_check(ok, t)
+            assert self._verdict(master, 2, exclude=False) is False
+            assert self._verdict(master, 0, exclude=False) is True
         finally:
             master.stop()
 

@@ -155,3 +155,110 @@ def test_brain_resource_optimizer_plugs_into_scaler_seam():
     assert opt.target_worker_count(2, SpeedMonitor()) == 8
     grown = opt.optimize_oom_node(NodeResource(memory_mb=8192))
     assert grown.memory_mb > 8192
+
+
+def _seed_ps_brain():
+    from dlrover_tpu.brain.service import RuntimeSample
+
+    brain = BrainService()
+    for i, (count, cpu, mem, oom, done) in enumerate([
+        (2, 8.0, 8192, False, True),
+        (4, 12.0, 10240, False, True),
+        (4, 16.0, 12288, False, True),
+        (2, 8.0, 6144, True, True),  # an OOM'd PS config
+    ]):
+        brain.persist_ps_job(
+            f"psjob{i}", "ctr-test", count, cpu, mem,
+            recv_op_count=400, oom=oom, completed=done,
+        )
+    # runtime: ps 0 runs hot on cpu, ps 1 hot on memory, ps 2 cool
+    for t in range(3):
+        for node_id, (ucpu, umem) in enumerate(
+            [(7.5, 4000), (2.0, 7900), (2.0, 4000)]
+        ):
+            brain.persist_runtime_sample(RuntimeSample(
+                job_name="livejob", node_type="ps", node_id=node_id,
+                used_cpu=ucpu, used_memory_mb=umem, config_cpu=8.0,
+                config_memory_mb=8192, timestamp=100.0 + t,
+            ))
+    return brain
+
+
+def test_brain_ps_create_from_history():
+    brain = _seed_ps_brain()
+    plan = brain.optimize_ps_create("ctr-test")
+    assert plan["ps_count"] == 4  # median of (2, 4, 4, 2)... sorted
+    assert plan["ps_cpu"] == 16.0
+    assert plan["ps_memory_mb"] == 12288  # max that never OOM'd
+    assert brain.optimize_ps_create("unknown") is None
+
+
+def test_brain_ps_cold_create_defaults():
+    brain = BrainService()
+    plan = brain.optimize_ps_cold_create()
+    assert plan == {
+        "ps_count": 2, "ps_cpu": 8.0, "ps_memory_mb": 8192,
+    }
+
+
+def test_brain_ps_init_adjust_scales_cpu_with_recv_ops():
+    brain = _seed_ps_brain()
+    # 400 recv ops over 4 PS = 100/ps -> ceil(8) + margin 4 = 12
+    plan = brain.optimize_ps_init_adjust(
+        "livejob", recv_op_count=400, ps_count=4
+    )
+    assert plan["ps_cpu"] == 12.0
+    # heavy fan-in gets the 16-core default
+    plan = brain.optimize_ps_init_adjust(
+        "otherjob", recv_op_count=4000, ps_count=4
+    )
+    assert plan["ps_cpu"] == 16.0
+    # observed memory peak (7900) grows by the 50% margin
+    plan = brain.optimize_ps_init_adjust(
+        "livejob", recv_op_count=400, ps_count=4
+    )
+    assert plan["ps_memory_mb"] == int(7900 * 1.5)
+
+
+def test_brain_ps_oom_memory_above_oomed_requests():
+    brain = _seed_ps_brain()
+    grown = brain.optimize_ps_oom("ctr-test", requested_mb=4096)
+    assert grown >= int(6144 * 1.5)
+
+
+def test_brain_hot_ps_grows_hot_nodes():
+    brain = _seed_ps_brain()
+    plan = brain.optimize_hot_ps(
+        "livejob", current_workers=4, target_workers=8,
+    )
+    # ps 0 (cpu 7.5/8 avg) is cpu-hot: whole group scales by 2x ->
+    # ps 0 wants 15 cores; cool nodes (avg 2.0 -> 4) stay under their
+    # configured 8 so only the hot node appears with a cpu plan
+    assert plan[0]["cpu"] == 15.0
+    assert 2 not in plan or "cpu" not in plan[2]
+    # ps 1 (mem 7900/8192) is memory-hot: fixed bump
+    assert plan[1]["memory_mb"] == 8192 + 4096
+
+
+def test_brain_worker_create_oom_floor():
+    brain = _seed_brain()
+    # history has an OOM at 4096 requested and peaks up to 7000
+    mb = brain.optimize_worker_create_oom("gpt-test")
+    assert mb == int(7000 * 1.5)
+    assert BrainService().optimize_worker_create_oom(
+        "none", default_mb=2048) == 2048
+
+
+def test_brain_algorithm_registry_dispatch():
+    from dlrover_tpu.brain.service import ALGORITHMS, run_algorithm
+
+    brain = _seed_ps_brain()
+    assert len(ALGORITHMS) == 9
+    plan = run_algorithm(
+        brain, "optimize_job_ps_create_resource", "ctr-test"
+    )
+    assert plan["ps_count"] == 4
+    import pytest as _pytest
+
+    with _pytest.raises(KeyError, match="unknown brain algorithm"):
+        run_algorithm(brain, "nope")

@@ -183,3 +183,44 @@ def test_node_gone_does_not_refail_pending_replacement():
     jm.handle_node_gone(0, reason="Deleted")
     assert jm.get_node(0).relaunch_count == count_before
     assert jm.get_node(0).status == NodeStatus.PENDING
+
+
+def test_auto_scaler_fills_deficient_slice():
+    """Multi-slice: replacements land in the slice that lost hosts so
+    the DCN (outer) mesh axis stays balanced."""
+    client = FakeClusterClient()
+    scaler = TPUPodScaler("job1", client)
+    jm = JobManager(scaler=scaler)
+    # slice 0 has 2 alive hosts, slice 1 only 1 (one died)
+    for i, s in enumerate([0, 0, 1]):
+        node = jm.register_node(node_id=i)
+        node.config_resource = NodeResource(
+            cpu=8, chips=4, tpu_type="v5p", slice_id=s
+        )
+    auto = AllreduceAutoScaler(
+        jm, SpeedMonitor(), target_workers=4, interval=999,
+        num_slices=2,
+    )
+    plan = auto.adjust_once()
+    assert plan is not None and len(plan.launch_nodes) == 1
+    assert plan.launch_nodes[0].config_resource.slice_id == 1
+    # the pod spec carries the slice pin
+    pods = {p["name"]: p for p in client.list_pods("job1")}
+    new_pod = pods[f"job1-worker-{plan.launch_nodes[0].id}"]
+    assert new_pod["tpu_slice"] == 1
+
+
+def test_auto_scaler_pending_counts_once_toward_target():
+    """A PENDING replacement must not be double-counted (ALIVE already
+    includes PENDING) — the job would otherwise converge one short."""
+    client = FakeClusterClient()
+    jm = JobManager(scaler=TPUPodScaler("job1", client))
+    n0 = jm.register_node(node_id=0)
+    assert n0.is_alive()
+    auto = AllreduceAutoScaler(
+        jm, SpeedMonitor(), target_workers=3, interval=999
+    )
+    plan = auto.adjust_once()
+    assert len(plan.launch_nodes) == 2  # 1 alive -> need 2 more
+    # all three now count; no further launches
+    assert auto.adjust_once() is None
