@@ -29,8 +29,10 @@ class NodeResource:
     tpu_type: str = ""  # e.g. "v5p", "v5e"
     # Which TPU slice of a multi-slice job this host belongs to; the
     # scaler keeps replacements in the dead host's slice so the DCN
-    # mesh axis stays balanced.
-    slice_id: int = 0
+    # mesh axis stays balanced. -1 = single-slice job (no slice pin in
+    # the pod manifest — pinning slice "0" on an unlabeled cluster
+    # would leave every pod unschedulable).
+    slice_id: int = -1
     # Utilisation telemetry filled in by the agent's resource monitor.
     used_cpu: float = 0.0
     used_memory_mb: int = 0

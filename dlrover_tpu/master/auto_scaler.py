@@ -511,7 +511,7 @@ class AllreduceAutoScaler:
         def slice_of(n: Node) -> int:
             if n.config_resource is None:
                 return 0
-            return n.config_resource.slice_id % self.num_slices
+            return max(n.config_resource.slice_id, 0) % self.num_slices
 
         counts = {s: 0 for s in range(self.num_slices)}
         templates: dict = {}
@@ -535,7 +535,8 @@ class AllreduceAutoScaler:
                 if template is not None and template.config_resource
                 else NodeResource()
             )
-            resource.slice_id = s
+            # pin only when the job actually spans slices
+            resource.slice_id = s if self.num_slices > 1 else -1
             plan.launch_nodes.append(
                 Node(
                     type=NodeType.WORKER,

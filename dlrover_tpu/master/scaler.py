@@ -198,7 +198,10 @@ class TPUPodScaler(Scaler):
                 "tpu_chips": res.chips,
                 # multi-slice: pin the pod to its slice's node pool so
                 # the replacement lands where the dead host was
-                "tpu_slice": res.slice_id,
+                # (None = single-slice, no pin)
+                "tpu_slice": (
+                    res.slice_id if res.slice_id >= 0 else None
+                ),
             }
         )
         return spec
@@ -265,21 +268,12 @@ class ElasticJobScaler(Scaler):
 
     def scale(self, plan: ScalePlan) -> None:
         super().scale(plan)
-        body = {
-            "job": self.job_name,
-            "launch": [
-                {
-                    "id": n.id,
-                    "type": n.type,
-                    "rank": n.rank,
-                    "resource": (n.config_resource or NodeResource())
-                    .to_dict(),
-                }
-                for n in plan.launch_nodes
-            ],
-            "remove": [n.id for n in plan.remove_nodes],
-        }
+        from dlrover_tpu.scheduler.factory import scaleplan_manifest
+
         name = f"{self.job_name}-scaleplan-{next(self._plan_index)}"
+        # One manifest shape everywhere: the operator-compatible
+        # ScaleSpec (scheduler/factory.py, golden-file tested).
+        body = scaleplan_manifest(name, self.job_name, plan)
         self.client.patch_custom_object(name, body)
 
 

@@ -170,26 +170,42 @@ class ElasticJobController:
         the operator's ScalePlan controller)."""
         plans = getattr(self.client, "custom_objects", {})
         for plan_name, body in list(plans.items()):
+            # CRD ScaleSpec shape (scheduler/factory.py
+            # scaleplan_manifest; ref scaleplan_types.go:39-84).
+            spec_body = body.get("spec", {})
             if (
-                body.get("job") != job.name
+                spec_body.get("ownerJob") != job.name
                 or plan_name in self._executed_plans
             ):
                 continue
             self._executed_plans.add(plan_name)
-            for item in body.get("launch", []):
+            for item in spec_body.get("createPods", []):
                 spec = dict(job.pod_template)
                 res = item.get("resource", {})
+                mem = str(res.get("memory", "0")).rstrip("Mi") or "0"
                 spec.update(
                     {
-                        "name": f"{job.name}-worker-{item['id']}",
+                        "name": item.get(
+                            "name",
+                            f"{job.name}-worker-{item.get('id', 0)}",
+                        ),
                         "job": job.name,
                         "type": item.get("type", "worker"),
-                        "node_id": item["id"],
-                        "rank": item.get("rank", item["id"]),
-                        "cpu": res.get("cpu", 0),
-                        "memory_mb": res.get("memory_mb", 0),
-                        "tpu_accelerator": res.get("tpu_type", ""),
-                        "tpu_chips": res.get("chips", 0),
+                        "node_id": item.get("id", 0),
+                        "rank": item.get(
+                            "rankIndex", item.get("id", 0)
+                        ),
+                        "cpu": float(res.get("cpu", 0) or 0),
+                        "memory_mb": int(mem),
+                        # TPU shape is job-level (every host of a
+                        # slice is identical) — PodMeta.resource only
+                        # carries cpu/memory, like the reference's.
+                        "tpu_accelerator": job.pod_template.get(
+                            "tpu_accelerator", ""
+                        ),
+                        "tpu_chips": job.pod_template.get(
+                            "tpu_chips", 0
+                        ),
                     }
                 )
                 try:
@@ -201,11 +217,9 @@ class ElasticJobController:
                         spec["name"],
                         exc_info=True,
                     )
-            for node_id in body.get("remove", []):
+            for item in spec_body.get("removePods", []):
                 try:
-                    self.client.delete_pod(
-                        f"{job.name}-worker-{node_id}"
-                    )
+                    self.client.delete_pod(item["name"])
                 except Exception:  # noqa: BLE001
                     pass
 

@@ -96,9 +96,11 @@ class GKEClusterClient(ClusterClient):
         self.core.create_namespaced_service(self.namespace, svc)
 
     def patch_custom_object(self, name, body):
+        # group/version must agree with the manifest's apiVersion
+        # (ELASTIC_API_VERSION — the reference operator's group).
         self.custom.patch_namespaced_custom_object(
-            "dlrover.tpu.io", "v1", self.namespace, "scaleplans",
-            name, body,
+            "elastic.iml.github.io", "v1alpha1", self.namespace,
+            "scaleplans", name, body,
         )
 
     def watch_pods(self, job_name):
@@ -125,6 +127,18 @@ class GKEClusterClient(ClusterClient):
             }
 
 
+# Same API group/version as the reference operator
+# (go/operator/api/v1alpha1/groupversion_info.go:29) so manifests stay
+# interchangeable for users migrating from it.
+ELASTIC_API_VERSION = "elastic.iml.github.io/v1alpha1"
+
+
+def _quantity(v) -> str:
+    """k8s resource quantity: integral floats print as integers."""
+    f = float(v)
+    return str(int(f)) if f.is_integer() else str(v)
+
+
 def _pod_manifest(spec: dict, namespace: str) -> dict:
     """TPU pod manifest: GKE schedules TPU slices via nodeSelector on
     gke-tpu-accelerator/topology (not resource requests like GPU)."""
@@ -133,6 +147,14 @@ def _pod_manifest(spec: dict, namespace: str) -> dict:
         node_selector["cloud.google.com/gke-tpu-accelerator"] = spec[
             "tpu_accelerator"
         ]
+    if spec.get("tpu_slice") is not None:
+        # pin multi-slice replacements into their slice's node pool
+        node_selector["dlrover-tpu/slice"] = str(spec["tpu_slice"])
+    requests = {}
+    if spec.get("cpu"):
+        requests["cpu"] = _quantity(spec["cpu"])
+    if spec.get("memory_mb"):
+        requests["memory"] = f"{int(spec['memory_mb'])}Mi"
     return {
         "apiVersion": "v1",
         "kind": "Pod",
@@ -151,6 +173,7 @@ def _pod_manifest(spec: dict, namespace: str) -> dict:
                 {
                     "name": "worker",
                     "resources": {
+                        "requests": requests,
                         "limits": {
                             "google.com/tpu": spec.get("tpu_chips", 0)
                         }
@@ -160,6 +183,102 @@ def _pod_manifest(spec: dict, namespace: str) -> dict:
                 }
             ],
         },
+    }
+
+
+def elasticjob_manifest(
+    job_name: str,
+    namespace: str = "default",
+    distribution_strategy: str = "AllreduceStrategy",
+    resource_limits: Optional[dict] = None,
+    replica_specs: Optional[dict] = None,
+    optimize_mode: str = "single-job",
+    brain_service: str = "",
+    enable_elastic_scheduling: bool = True,
+    enable_dynamic_sharding: bool = True,
+    envs: Optional[dict] = None,
+) -> dict:
+    """ElasticJob CRD manifest — field-for-field the reference's
+    ElasticJobSpec (go/operator/api/v1alpha1/elasticjob_types.go:29-67:
+    distributionStrategy, resourceLimits, optimizeMode, brainService,
+    enableElasticScheduling, enableDynamicSharding, replicaSpecs,
+    envs)."""
+    spec: dict = {
+        "distributionStrategy": distribution_strategy,
+        "replicaSpecs": replica_specs or {},
+    }
+    if resource_limits:
+        spec["resourceLimits"] = {
+            k: str(v) for k, v in resource_limits.items()
+        }
+    if optimize_mode:
+        spec["optimizeMode"] = optimize_mode
+    if brain_service:
+        spec["brainService"] = brain_service
+    if enable_elastic_scheduling:
+        spec["enableElasticScheduling"] = True
+    if enable_dynamic_sharding:
+        spec["enableDynamicSharding"] = True
+    if envs:
+        spec["envs"] = dict(envs)
+    return {
+        "apiVersion": ELASTIC_API_VERSION,
+        "kind": "ElasticJob",
+        "metadata": {"name": job_name, "namespace": namespace},
+        "spec": spec,
+    }
+
+
+def _pod_meta(job_name: str, node) -> dict:
+    """PodMeta of the ScalePlan CRD (scaleplan_types.go:67)."""
+    res = node.config_resource
+    resource = {}
+    if res is not None:
+        if res.cpu:
+            resource["cpu"] = _quantity(res.cpu)
+        if res.memory_mb:
+            resource["memory"] = f"{int(res.memory_mb)}Mi"
+    name = f"{job_name}-{node.type}-{node.id}"
+    return {
+        "name": name,
+        "id": node.id,
+        "type": node.type,
+        "rankIndex": node.rank,
+        "service": name,
+        "resource": resource,
+    }
+
+
+def scaleplan_manifest(
+    name: str,
+    owner_job: str,
+    plan,
+    namespace: str = "default",
+    replica_resource_specs: Optional[dict] = None,
+    ps_hosts: Optional[list] = None,
+) -> dict:
+    """ScalePlan CRD manifest — the reference's ScaleSpec
+    (go/operator/api/v1alpha1/scaleplan_types.go:39-54:
+    replicaResourceSpecs, createPods, removePods, migratePods,
+    psHosts, ownerJob) built from a master ScalePlan."""
+    spec: dict = {"ownerJob": owner_job}
+    if replica_resource_specs:
+        spec["replicaResourceSpecs"] = replica_resource_specs
+    if plan.launch_nodes:
+        spec["createPods"] = [
+            _pod_meta(owner_job, n) for n in plan.launch_nodes
+        ]
+    if plan.remove_nodes:
+        spec["removePods"] = [
+            _pod_meta(owner_job, n) for n in plan.remove_nodes
+        ]
+    if ps_hosts:
+        spec["psHosts"] = list(ps_hosts)
+    return {
+        "apiVersion": ELASTIC_API_VERSION,
+        "kind": "ScalePlan",
+        "metadata": {"name": name, "namespace": namespace},
+        "spec": spec,
     }
 
 

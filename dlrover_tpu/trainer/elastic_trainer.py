@@ -87,6 +87,7 @@ class ElasticTrainer:
         global_batch_size: int,
         micro_batch_size: int,
         report_fn: Optional[Callable[[TrainerReport], None]] = None,
+        accum_dtype=None,
     ):
         self.mesh = mesh
         self.loss_fn = loss_fn
@@ -94,6 +95,13 @@ class ElasticTrainer:
         self.global_batch_size = global_batch_size
         self.micro_batch_size = micro_batch_size
         self.report_fn = report_fn
+        # Gradient-accumulator dtype. None = float32 (safe default:
+        # bf16 accumulation silently drops late microbatches once
+        # |acc| >> |g/accum|). Memory-constrained FSDP jobs can pass
+        # the params' dtype to halve the accumulator footprint —
+        # microbatches are pre-scaled by 1/accum so the range is fine;
+        # the tradeoff is bf16's ~8-bit mantissa on the running sum.
+        self.accum_dtype = accum_dtype
         self.num_shards = data_shards(mesh)
         self.accum_steps = gradient_accumulation_steps(
             global_batch_size, micro_batch_size, self.num_shards
@@ -120,24 +128,37 @@ class ElasticTrainer:
         # Microbatch dim leads: [accum, per_shard_batch, ...]
         mb_spec = P(None, *bspec)
 
+        accum_dtype = self.accum_dtype
+
         @jax.jit
         def train_step(params, opt_state, tokens, targets):
+            def acc_dtype(p):
+                if accum_dtype is not None:
+                    return accum_dtype
+                return jnp.float32
+
             def micro(carry, batch):
                 grad_acc, loss_acc = carry
                 mb_tokens, mb_targets = batch
                 loss, grads = jax.value_and_grad(loss_fn)(
                     params, mb_tokens, mb_targets
                 )
-                grad_acc = jax.tree.map(jnp.add, grad_acc, grads)
+                # Pre-scale each microbatch by 1/accum so low-precision
+                # accumulators stay in the gradients' own range (no
+                # overflow headroom needed, no final divide).
+                grad_acc = jax.tree.map(
+                    lambda a, g: a + (g / accum).astype(a.dtype),
+                    grad_acc,
+                    grads,
+                )
                 return (grad_acc, loss_acc + loss), None
 
             zeros = jax.tree.map(
-                lambda p: jnp.zeros(p.shape, jnp.float32), params
+                lambda p: jnp.zeros(p.shape, acc_dtype(p)), params
             )
             (grads, loss_sum), _ = jax.lax.scan(
                 micro, (zeros, 0.0), (tokens, targets)
             )
-            grads = jax.tree.map(lambda g: g / accum, grads)
             updates, opt_state = optimizer.update(
                 grads, opt_state, params
             )

@@ -64,7 +64,13 @@ def test_controller_executes_scaleplan_objects():
     realizes them (the reference's split of responsibilities)."""
     client = FakeClusterClient()
     ctl = ElasticJobController(client)
-    job = ElasticJob(name="j1")
+    # TPU shape is job-level: the CRD PodMeta only carries cpu/memory
+    # (ref scaleplan_types.go:84), the accelerator comes from the
+    # job's pod template.
+    job = ElasticJob(
+        name="j1",
+        pod_template={"tpu_accelerator": "v5p", "tpu_chips": 4},
+    )
     ctl.create_job(job)
 
     scaler = ElasticJobScaler("j1", client)
