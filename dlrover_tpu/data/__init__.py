@@ -1,0 +1,4 @@
+from dlrover_tpu.data.coworker import CoworkerDataLoader
+from dlrover_tpu.data.shm_ring import ShmBatchRing
+
+__all__ = ["CoworkerDataLoader", "ShmBatchRing"]

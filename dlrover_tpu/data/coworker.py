@@ -1,0 +1,255 @@
+"""Coworker dataloader: CPU preprocessing in sibling processes.
+
+Capability parity with the reference's coworker architecture
+(atorch/atorch/data/coworker_dataset.py + shm_context.py): input
+pipelines that would starve the accelerator run in separate *coworker*
+processes, stream finished batches through the shm ring
+(data/shm_ring.py), and the training process only ever copies
+ready-made numpy batches onto the chip. TPU-first differences:
+
+* one consumer per HOST (JAX is one process per host), K producer
+  processes — no per-GPU shm contexts;
+* elastic by construction: producers pull sample indices from the
+  master's dynamic sharding service when a ``shard_fn`` is given
+  (agent/sharding_client.py), so a killed coworker's in-flight shard
+  is re-dispatched by the master's timeout watchdog (at-least-once);
+* crashed producers are respawned up to ``max_restarts`` — the
+  training loop never sees the failure, matching the reference's
+  fault-tolerant input story.
+"""
+
+from __future__ import annotations
+
+import multiprocessing as mp
+import threading
+import time
+from typing import Callable, Dict, Iterator, Optional
+
+import numpy as np
+
+from dlrover_tpu.common.log import get_logger
+from dlrover_tpu.data.shm_ring import ShmBatchRing
+
+logger = get_logger("coworker")
+
+
+def _producer_main(
+    ring_name: str,
+    num_slots: int,
+    slot_bytes: int,
+    worker_id: int,
+    make_batches,  # Callable[[int], Iterator[dict]]
+    job_name: Optional[str] = None,
+):
+    # The ring's sockets/shm are job-scoped via DLROVER_TPU_JOB_NAME;
+    # pin the parent's value explicitly — a user __main__ that
+    # (re)sets the env on spawn re-import would otherwise strand the
+    # coworker waiting on sockets that will never exist.
+    import os
+
+    if job_name is not None:
+        os.environ["DLROVER_TPU_JOB_NAME"] = job_name
+    ring = ShmBatchRing(
+        ring_name, num_slots, slot_bytes, server=False
+    )
+    produced = 0
+    try:
+        for batch in make_batches(worker_id):
+            ring.put(batch, extra={"worker": worker_id})
+            produced += 1
+        ring.put_control({"end": worker_id, "produced": produced})
+    except KeyboardInterrupt:
+        pass
+    except Exception as exc:  # noqa: BLE001 — report, don't vanish
+        ring.put_control(
+            {"error": worker_id, "message": str(exc)[:500]}
+        )
+        raise
+    finally:
+        ring.close()
+
+
+def make_sharded_batches(
+    master_addr: str,
+    dataset_name: str,
+    batch_size: int,
+    fetch_fn: Callable[[np.ndarray], Dict[str, np.ndarray]],
+    node_id: int = 0,
+):
+    """Producer factory for elastic coworkers: each coworker pulls
+    sample-index batches from the master's dynamic sharding service
+    (master/task_manager.py todo/doing queues) and materializes them
+    with ``fetch_fn(indices) -> batch``. A coworker that dies
+    mid-shard leaves its task in the doing queue; the master's timeout
+    watchdog re-dispatches it — at-least-once delivery, exactly the
+    reference's elastic-data story (coworker_dataset.py over
+    dynamic sharding).
+
+    Returns a picklable ``make_batches(worker_id)`` for
+    :class:`CoworkerDataLoader`.
+    """
+    import functools
+
+    return functools.partial(
+        _sharded_batches_main,
+        master_addr=master_addr,
+        dataset_name=dataset_name,
+        batch_size=batch_size,
+        fetch_fn=fetch_fn,
+        node_id=node_id,
+    )
+
+
+def _sharded_batches_main(
+    worker_id: int,
+    master_addr: str,
+    dataset_name: str,
+    batch_size: int,
+    fetch_fn,
+    node_id: int,
+):
+    from dlrover_tpu.agent.master_client import MasterClient
+    from dlrover_tpu.agent.sharding_client import IndexShardingClient
+
+    client = MasterClient(master_addr, node_id=node_id)
+    shard_client = IndexShardingClient(
+        dataset_name, batch_size=batch_size, client=client
+    )
+    pending: list = []
+    while True:
+        idx = shard_client.fetch_sample_index()
+        if idx is None:
+            if pending:
+                yield fetch_fn(np.asarray(pending, np.int64))
+            return
+        pending.append(idx)
+        if len(pending) >= batch_size:
+            yield fetch_fn(np.asarray(pending, np.int64))
+            pending = []
+
+
+class CoworkerDataLoader:
+    """Iterate preprocessed batches produced by coworker processes.
+
+    ``make_batches(worker_id)`` runs IN the coworker process and
+    yields ``{name: np.ndarray}`` batches; it must be picklable (a
+    module-level function or functools.partial of one). Iteration
+    ends when every producer reported end-of-data.
+    """
+
+    def __init__(
+        self,
+        make_batches: Callable[[int], Iterator[Dict[str, np.ndarray]]],
+        num_workers: int = 1,
+        num_slots: int = 8,
+        slot_bytes: int = 64 << 20,
+        name: str = "coworker",
+        max_restarts: int = 2,
+        mp_context: str = "spawn",
+    ):
+        self.make_batches = make_batches
+        self.num_workers = num_workers
+        self.max_restarts = max_restarts
+        self._ring = ShmBatchRing(
+            name, num_slots, slot_bytes, server=True
+        )
+        self._ring_args = (name, num_slots, slot_bytes)
+        # spawn: coworkers must not inherit the parent's JAX/TPU
+        # runtime state (fork after backend init can deadlock)
+        self._ctx = mp.get_context(mp_context)
+        self._procs: Dict[int, mp.Process] = {}
+        self._restarts: Dict[int, int] = {}
+        self._ended: set = set()
+        self._stop = threading.Event()
+        self._supervisor: Optional[threading.Thread] = None
+
+    # -- lifecycle -------------------------------------------------------
+
+    def _spawn(self, worker_id: int) -> None:
+        import os
+
+        p = self._ctx.Process(
+            target=_producer_main,
+            args=(
+                *self._ring_args,
+                worker_id,
+                self.make_batches,
+                os.environ.get("DLROVER_TPU_JOB_NAME"),
+            ),
+            daemon=True,
+        )
+        p.start()
+        self._procs[worker_id] = p
+
+    def start(self) -> "CoworkerDataLoader":
+        for w in range(self.num_workers):
+            self._spawn(w)
+        self._supervisor = threading.Thread(
+            target=self._supervise, name="coworker-supervisor",
+            daemon=True,
+        )
+        self._supervisor.start()
+        return self
+
+    def _supervise(self) -> None:
+        """Respawn dead producers (ref: coworker fault tolerance).
+        A producer that exits nonzero without reporting end-of-data
+        restarts up to max_restarts; past that its stream is declared
+        over so iteration can still finish."""
+        while not self._stop.wait(0.5):
+            for w, p in list(self._procs.items()):
+                if p.is_alive() or w in self._ended:
+                    continue
+                if p.exitcode == 0:
+                    continue  # clean exit: end control already sent
+                restarts = self._restarts.get(w, 0)
+                if restarts < self.max_restarts:
+                    self._restarts[w] = restarts + 1
+                    logger.warning(
+                        "coworker %d died (exit %s); respawn %d/%d",
+                        w, p.exitcode, restarts + 1,
+                        self.max_restarts,
+                    )
+                    self._spawn(w)
+                else:
+                    logger.error(
+                        "coworker %d exhausted %d restarts; "
+                        "ending its stream", w, self.max_restarts,
+                    )
+                    self._ended.add(w)
+                    self._ring.put_control({"end": w, "gave_up": True})
+
+    # -- consumption -----------------------------------------------------
+
+    def __iter__(self) -> Iterator[Dict[str, np.ndarray]]:
+        while len(self._ended) < self.num_workers:
+            item = self._ring.get(timeout=1.0)
+            if item is None:
+                continue
+            batch, info = item
+            if batch is None:  # control
+                if "end" in info:
+                    self._ended.add(info["end"])
+                elif "error" in info:
+                    logger.warning(
+                        "coworker %s failed: %s",
+                        info.get("error"), info.get("message"),
+                    )
+                continue
+            yield batch
+
+    def batches(self, max_batches: Optional[int] = None):
+        for i, b in enumerate(self):
+            if max_batches is not None and i >= max_batches:
+                return
+            yield b
+
+    def close(self) -> None:
+        self._stop.set()
+        for p in self._procs.values():
+            if p.is_alive():
+                p.terminate()
+        deadline = time.time() + 5
+        for p in self._procs.values():
+            p.join(timeout=max(deadline - time.time(), 0.1))
+        self._ring.close(unlink=True)

@@ -1,0 +1,180 @@
+"""Shared-memory batch ring: zero-copy host-side batch transport.
+
+The TPU-native counterpart of the reference's shm data context
+(atorch/atorch/data/shm_context.py:1-682 ShmData — preallocated shm
+slots, per-slot state machine, producer/consumer processes): batches
+of numpy arrays move between a CPU-preprocessing *coworker* process
+and the training process through preallocated POSIX shm slots, so the
+only per-batch costs are one memcpy in and one memcpy out — no
+pickling, no socket payloads on the data path. Control traffic (slot
+hand-off) rides the existing msgpack unix-socket queues
+(common/multi_process.py), which carry only slot indices.
+
+Layout of one slot::
+
+    [u64 meta_len][msgpack meta][packed array payloads]
+
+where meta = {"arrays": [(name, dtype, shape, offset, nbytes)],
+"extra": {...}}.
+"""
+
+from __future__ import annotations
+
+from typing import Dict, List, Optional, Tuple
+
+import numpy as np
+
+import msgpack
+from dlrover_tpu.common.log import get_logger
+from dlrover_tpu.common.multi_process import (
+    SharedMemoryHandle,
+    SharedQueue,
+)
+
+logger = get_logger("shm_ring")
+
+_HEADER = 8
+
+
+def pack_batch(
+    buf: memoryview, batch: Dict[str, np.ndarray], extra: Optional[dict]
+) -> int:
+    """Pack ``batch`` into ``buf``; returns bytes used."""
+    metas: List[Tuple[str, str, tuple, int, int]] = []
+    offset = 0
+    arrays = []
+    for name in sorted(batch):
+        arr = np.ascontiguousarray(batch[name])
+        metas.append(
+            (name, str(arr.dtype), tuple(arr.shape), offset,
+             arr.nbytes)
+        )
+        arrays.append(arr)
+        offset += arr.nbytes
+    meta = msgpack.packb(
+        {"arrays": [list(m) for m in metas], "extra": extra or {}},
+        use_bin_type=True,
+    )
+    total = _HEADER + len(meta) + offset
+    if total > len(buf):
+        raise ValueError(
+            f"batch needs {total} bytes, slot holds {len(buf)} — "
+            "raise slot_bytes"
+        )
+    buf[:_HEADER] = len(meta).to_bytes(_HEADER, "little")
+    buf[_HEADER:_HEADER + len(meta)] = meta
+    payload_base = _HEADER + len(meta)
+    for (name, dtype, shape, off, nbytes), arr in zip(metas, arrays):
+        dst = np.frombuffer(
+            buf, np.uint8, count=nbytes, offset=payload_base + off
+        )
+        dst[:] = arr.view(np.uint8).ravel()
+    return total
+
+
+def unpack_batch(buf: memoryview) -> Tuple[Dict[str, np.ndarray], dict]:
+    """Copy a batch OUT of a slot (the slot is reused immediately)."""
+    meta_len = int.from_bytes(bytes(buf[:_HEADER]), "little")
+    meta = msgpack.unpackb(
+        bytes(buf[_HEADER:_HEADER + meta_len]), raw=False
+    )
+    payload_base = _HEADER + meta_len
+    out: Dict[str, np.ndarray] = {}
+    for name, dtype, shape, off, nbytes in meta["arrays"]:
+        src = np.frombuffer(
+            buf, np.uint8, count=nbytes, offset=payload_base + off
+        )
+        out[name] = (
+            src.copy().view(np.dtype(dtype)).reshape(tuple(shape))
+        )
+    return out, meta.get("extra", {})
+
+
+class ShmBatchRing:
+    """N-slot shm ring. The CONSUMER (training process) constructs
+    with ``server=True`` (it outlives producers across elastic
+    restarts); producers attach with ``server=False``.
+
+    put/get never copy through sockets — only slot ids do.
+    """
+
+    def __init__(
+        self,
+        name: str,
+        num_slots: int = 8,
+        slot_bytes: int = 64 << 20,
+        server: bool = False,
+    ):
+        self.name = name
+        self.num_slots = num_slots
+        self.slot_bytes = slot_bytes
+        self._shm = SharedMemoryHandle(
+            f"ring_{name}",
+            create=server,
+            size=num_slots * slot_bytes,
+        )
+        self._free = SharedQueue(f"ring_{name}_free", server=server)
+        self._ready = SharedQueue(f"ring_{name}_ready", server=server)
+        if server:
+            for i in range(num_slots):
+                self._free.put(i)
+
+    def _slot(self, i: int) -> memoryview:
+        base = i * self.slot_bytes
+        return self._shm.buf[base:base + self.slot_bytes]
+
+    # -- producer side ---------------------------------------------------
+
+    def put(
+        self,
+        batch: Dict[str, np.ndarray],
+        extra: Optional[dict] = None,
+        timeout: Optional[float] = None,
+    ) -> bool:
+        """Block for a free slot, write the batch, mark ready.
+        False on timeout."""
+        import queue as _queue
+
+        try:
+            slot = self._free.get(timeout=timeout)
+        except _queue.Empty:
+            return False
+        if slot is None:
+            return False
+        pack_batch(self._slot(slot), batch, extra)
+        self._ready.put({"slot": slot})
+        return True
+
+    def put_control(self, message: dict) -> None:
+        """Out-of-band control (end-of-data, producer failure) —
+        consumes no slot."""
+        self._ready.put({"control": message})
+
+    # -- consumer side ---------------------------------------------------
+
+    def get(
+        self, timeout: Optional[float] = None
+    ) -> Optional[Tuple[Optional[Dict[str, np.ndarray]], dict]]:
+        """Next (batch, extra); (None, control) for control messages;
+        None on timeout."""
+        import queue as _queue
+
+        try:
+            item = self._ready.get(timeout=timeout)
+        except _queue.Empty:
+            return None
+        if item is None:
+            return None
+        if "control" in item:
+            return None, item["control"]
+        slot = item["slot"]
+        batch, extra = unpack_batch(self._slot(slot))
+        self._free.put(slot)
+        return batch, extra
+
+    def close(self, unlink: bool = False) -> None:
+        if unlink:
+            self._shm.unlink()
+        self._shm.close()
+        self._free.close()
+        self._ready.close()

@@ -27,6 +27,39 @@ from dlrover_tpu.master.scaler import ClusterClient
 
 logger = get_logger("operator")
 
+_MEM_UNITS = {
+    "Ki": 1 / 1024, "Mi": 1.0, "Gi": 1024.0, "Ti": 1024.0 * 1024,
+    "K": 1e3 / (1 << 20), "M": 1e6 / (1 << 20),
+    "G": 1e9 / (1 << 20), "T": 1e12 / (1 << 20),
+}
+
+
+def _parse_cpu(v) -> float:
+    """k8s cpu quantity: cores or millicores ('500m' -> 0.5)."""
+    if isinstance(v, (int, float)):
+        return float(v)
+    s = str(v).strip()
+    if not s:
+        return 0.0
+    if s.endswith("m"):
+        return float(s[:-1]) / 1000.0
+    return float(s)
+
+
+def _parse_memory_mb(v) -> int:
+    """k8s memory quantity string -> MiB ('16Gi' -> 16384, '2048M' ->
+    1953, bare numeric STRINGS are bytes per the k8s convention;
+    python numbers are taken as MiB — our own NodeResource unit)."""
+    if isinstance(v, (int, float)):
+        return int(v)
+    s = str(v).strip()
+    if not s:
+        return 0
+    for suffix in sorted(_MEM_UNITS, key=len, reverse=True):
+        if s.endswith(suffix):
+            return int(float(s[:-len(suffix)]) * _MEM_UNITS[suffix])
+    return int(float(s) / (1 << 20))  # bytes
+
 
 class JobPhase:
     PENDING = "Pending"
@@ -165,6 +198,10 @@ class ElasticJobController:
         )
         self.client.create_pod(spec)
 
+    # quantity parsing lives on the class-free module level so the
+    # scaleplan path handles any k8s quantity the reference operator
+    # (or a human) writes, not just "<int>Mi"
+
     def _execute_scale_plans(self, job: ElasticJob) -> None:
         """Execute ScalePlan custom objects written for this job (ref
         the operator's ScalePlan controller)."""
@@ -180,41 +217,58 @@ class ElasticJobController:
                 continue
             self._executed_plans.add(plan_name)
             for item in spec_body.get("createPods", []):
-                spec = dict(job.pod_template)
-                res = item.get("resource", {})
-                mem = str(res.get("memory", "0")).rstrip("Mi") or "0"
-                spec.update(
-                    {
-                        "name": item.get(
-                            "name",
-                            f"{job.name}-worker-{item.get('id', 0)}",
-                        ),
-                        "job": job.name,
-                        "type": item.get("type", "worker"),
-                        "node_id": item.get("id", 0),
-                        "rank": item.get(
-                            "rankIndex", item.get("id", 0)
-                        ),
-                        "cpu": float(res.get("cpu", 0) or 0),
-                        "memory_mb": int(mem),
-                        # TPU shape is job-level (every host of a
-                        # slice is identical) — PodMeta.resource only
-                        # carries cpu/memory, like the reference's.
-                        "tpu_accelerator": job.pod_template.get(
-                            "tpu_accelerator", ""
-                        ),
-                        "tpu_chips": job.pod_template.get(
-                            "tpu_chips", 0
-                        ),
-                    }
-                )
                 try:
+                    spec = dict(job.pod_template)
+                    res = item.get("resource", {})
+                    labels = item.get("labels", {})
+                    spec.update(
+                        {
+                            "name": item.get(
+                                "name",
+                                f"{job.name}-worker-"
+                                f"{item.get('id', 0)}",
+                            ),
+                            "job": job.name,
+                            "type": item.get("type", "worker"),
+                            "node_id": item.get("id", 0),
+                            "rank": item.get(
+                                "rankIndex", item.get("id", 0)
+                            ),
+                            "cpu": _parse_cpu(res.get("cpu", 0)),
+                            "memory_mb": _parse_memory_mb(
+                                res.get("memory", 0)
+                            ),
+                            # per-pod TPU shape from the plan; job
+                            # template is the fallback for plans from
+                            # the reference operator (whose PodMeta
+                            # has no TPU fields)
+                            "tpu_chips": int(
+                                res.get(
+                                    "google.com/tpu",
+                                    job.pod_template.get(
+                                        "tpu_chips", 0
+                                    ),
+                                )
+                            ),
+                            "tpu_accelerator": labels.get(
+                                "dlrover-tpu/accelerator",
+                                job.pod_template.get(
+                                    "tpu_accelerator", ""
+                                ),
+                            ),
+                        }
+                    )
+                    if "dlrover-tpu/slice" in labels:
+                        spec["tpu_slice"] = int(
+                            labels["dlrover-tpu/slice"]
+                        )
                     self.client.create_pod(spec)
-                except Exception:  # noqa: BLE001
+                except Exception:  # noqa: BLE001 — one bad pod must
+                    # not abandon the rest of the plan
                     logger.warning(
-                        "scaleplan %s: create worker %s failed",
+                        "scaleplan %s: create pod %s failed",
                         plan_name,
-                        spec["name"],
+                        item.get("name", "?"),
                         exc_info=True,
                     )
             for item in spec_body.get("removePods", []):

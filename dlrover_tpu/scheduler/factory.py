@@ -215,10 +215,10 @@ def elasticjob_manifest(
         spec["optimizeMode"] = optimize_mode
     if brain_service:
         spec["brainService"] = brain_service
-    if enable_elastic_scheduling:
-        spec["enableElasticScheduling"] = True
-    if enable_dynamic_sharding:
-        spec["enableDynamicSharding"] = True
+    # always emitted: an omitted key would let a CRD/webhook default
+    # silently flip an explicit False back to enabled
+    spec["enableElasticScheduling"] = bool(enable_elastic_scheduling)
+    spec["enableDynamicSharding"] = bool(enable_dynamic_sharding)
     if envs:
         spec["envs"] = dict(envs)
     return {
@@ -230,16 +230,27 @@ def elasticjob_manifest(
 
 
 def _pod_meta(job_name: str, node) -> dict:
-    """PodMeta of the ScalePlan CRD (scaleplan_types.go:67)."""
+    """PodMeta of the ScalePlan CRD (scaleplan_types.go:67).
+    ``resource`` is a corev1.ResourceList, so TPU chips ride it as the
+    extended resource ``google.com/tpu``; accelerator type and slice
+    pin travel as labels (an additive field — reference-shaped
+    manifests without it stay valid)."""
     res = node.config_resource
     resource = {}
+    labels = {}
     if res is not None:
         if res.cpu:
             resource["cpu"] = _quantity(res.cpu)
         if res.memory_mb:
             resource["memory"] = f"{int(res.memory_mb)}Mi"
+        if res.chips:
+            resource["google.com/tpu"] = str(res.chips)
+        if res.tpu_type:
+            labels["dlrover-tpu/accelerator"] = res.tpu_type
+        if res.slice_id >= 0:
+            labels["dlrover-tpu/slice"] = str(res.slice_id)
     name = f"{job_name}-{node.type}-{node.id}"
-    return {
+    meta = {
         "name": name,
         "id": node.id,
         "type": node.type,
@@ -247,6 +258,9 @@ def _pod_meta(job_name: str, node) -> dict:
         "service": name,
         "resource": resource,
     }
+    if labels:
+        meta["labels"] = labels
+    return meta
 
 
 def scaleplan_manifest(

@@ -128,14 +128,14 @@ class ElasticTrainer:
         # Microbatch dim leads: [accum, per_shard_batch, ...]
         mb_spec = P(None, *bspec)
 
-        accum_dtype = self.accum_dtype
+        acc_dtype = (
+            self.accum_dtype
+            if self.accum_dtype is not None
+            else jnp.float32
+        )
 
         @jax.jit
         def train_step(params, opt_state, tokens, targets):
-            def acc_dtype(p):
-                if accum_dtype is not None:
-                    return accum_dtype
-                return jnp.float32
 
             def micro(carry, batch):
                 grad_acc, loss_acc = carry
@@ -154,7 +154,7 @@ class ElasticTrainer:
                 return (grad_acc, loss_acc + loss), None
 
             zeros = jax.tree.map(
-                lambda p: jnp.zeros(p.shape, acc_dtype(p)), params
+                lambda p: jnp.zeros(p.shape, acc_dtype), params
             )
             (grads, loss_sum), _ = jax.lax.scan(
                 micro, (zeros, 0.0), (tokens, targets)
