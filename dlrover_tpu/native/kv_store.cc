@@ -23,12 +23,17 @@
 // CTR workloads; each touches param + slot stores under one shard
 // pass.
 
+#include <fcntl.h>
+#include <unistd.h>
+
 #include <atomic>
 #include <cmath>
 #include <cstdint>
+#include <cstdio>
 #include <cstring>
 #include <mutex>
 #include <random>
+#include <string>
 #include <unordered_map>
 #include <vector>
 
@@ -38,6 +43,86 @@ struct Slot {
   uint32_t offset;     // row index into the arena
   uint32_t frequency;  // gather count
   int64_t version;     // last update step
+};
+
+// Disk tier of the hybrid store (ref tfplus hybrid_embedding/
+// storage_table.h MemStorageTable + remote tier, table_manager.h
+// under/over-flow handling): cold rows spill to an append-only file
+// of fixed records [dim floats | freq u32 | version i64]; hot-path
+// access promotes them back. pread/pwrite keep IO thread-safe under
+// per-shard locks.
+class DiskTier {
+ public:
+  DiskTier(const std::string& path, int dim)
+      : dim_(dim), record_bytes_(dim * sizeof(float) + sizeof(uint32_t) +
+                                 sizeof(int64_t)) {
+    fd_ = ::open(path.c_str(), O_RDWR | O_CREAT, 0644);
+    ok_ = fd_ >= 0;
+  }
+
+  ~DiskTier() {
+    if (fd_ >= 0) ::close(fd_);
+  }
+
+  bool ok() const { return ok_; }
+
+  static constexpr uint64_t kBadOffset = ~0ULL;
+
+  uint64_t write_row(const float* row, uint32_t freq, int64_t version) {
+    uint64_t off;
+    {
+      std::lock_guard<std::mutex> g(alloc_mu_);
+      if (!free_.empty()) {
+        off = free_.back();
+        free_.pop_back();
+      } else {
+        off = next_;
+        next_ += record_bytes_;
+      }
+    }
+    std::vector<char> rec(record_bytes_);
+    std::memcpy(rec.data(), row, dim_ * sizeof(float));
+    std::memcpy(rec.data() + dim_ * sizeof(float), &freq, sizeof(freq));
+    std::memcpy(rec.data() + dim_ * sizeof(float) + sizeof(freq), &version,
+                sizeof(version));
+    ssize_t n = ::pwrite(fd_, rec.data(), record_bytes_,
+                         static_cast<off_t>(off));
+    if (n != static_cast<ssize_t>(record_bytes_)) {
+      // short write (ENOSPC, EINTR...): the record is unusable —
+      // give the offset back and tell the caller to keep the row in
+      // RAM rather than silently losing trained state
+      release(off);
+      return kBadOffset;
+    }
+    return off;
+  }
+
+  bool read_row(uint64_t off, float* row, uint32_t* freq,
+                int64_t* version) const {
+    std::vector<char> rec(record_bytes_);
+    ssize_t n = ::pread(fd_, rec.data(), record_bytes_,
+                        static_cast<off_t>(off));
+    if (n != static_cast<ssize_t>(record_bytes_)) return false;
+    std::memcpy(row, rec.data(), dim_ * sizeof(float));
+    std::memcpy(freq, rec.data() + dim_ * sizeof(float), sizeof(*freq));
+    std::memcpy(version, rec.data() + dim_ * sizeof(float) + sizeof(*freq),
+                sizeof(*version));
+    return true;
+  }
+
+  void release(uint64_t off) {
+    std::lock_guard<std::mutex> g(alloc_mu_);
+    free_.push_back(off);
+  }
+
+ private:
+  int dim_;
+  size_t record_bytes_;
+  int fd_ = -1;
+  bool ok_ = false;
+  std::mutex alloc_mu_;
+  uint64_t next_ = 0;
+  std::vector<uint64_t> free_;
 };
 
 class KvStore {
@@ -57,9 +142,33 @@ class KvStore {
     }
   }
 
+  ~KvStore() { delete disk_; }
+
   int dim() const { return dim_; }
 
-  int64_t size() const {
+  // Enable the hybrid RAM/disk tier: at most ``max_ram_rows`` rows
+  // stay resident; the coldest (lowest frequency, then oldest
+  // version) spill to ``path``. Returns false if the file can't open.
+  // One-shot: re-targeting an active tier would orphan every spilled
+  // offset (they index the OLD file). Budget granularity is
+  // per-shard with a floor of 1, so the effective resident cap is
+  // max(max_ram_rows, num_shards).
+  bool set_disk_tier(const char* path, int64_t max_ram_rows) {
+    if (disk_ != nullptr) return false;
+    auto tier = new DiskTier(path, dim_);
+    if (!tier->ok()) {
+      delete tier;
+      return false;
+    }
+    per_shard_budget_ =
+        std::max<int64_t>(max_ram_rows / static_cast<int64_t>(
+                                             shards_.size()),
+                          1);
+    disk_ = tier;
+    return true;
+  }
+
+  int64_t ram_size() const {
     int64_t n = 0;
     for (auto& s : shards_) {
       std::lock_guard<std::mutex> g(s.mu);
@@ -67,6 +176,18 @@ class KvStore {
     }
     return n;
   }
+
+  int64_t disk_size() const {
+    if (!disk_) return 0;
+    int64_t n = 0;
+    for (auto& s : shards_) {
+      std::lock_guard<std::mutex> g(s.mu);
+      n += static_cast<int64_t>(s.disk_index.size());
+    }
+    return n;
+  }
+
+  int64_t size() const { return ram_size() + disk_size(); }
 
   // Deterministic per-key init: splitmix64 stream keyed by (seed, key)
   // so re-inserting an evicted key reproduces its initial row.
@@ -102,10 +223,23 @@ class KvStore {
       auto it = s.map.find(key);
       if (it == s.map.end()) {
         if (!insert_missing) {
+          // inference path never mutates tiers: serve cold rows
+          // straight from disk without promotion
+          if (disk_) {
+            auto dit = s.disk_index.find(key);
+            if (dit != s.disk_index.end()) {
+              uint32_t freq;
+              int64_t version;
+              if (disk_->read_row(dit->second, out + i * dim_, &freq,
+                                  &version)) {
+                continue;
+              }
+            }
+          }
           std::memset(out + i * dim_, 0, sizeof(float) * dim_);
           continue;
         }
-        it = insert_locked(s, key);
+        it = insert_locked(s, key);  // promotes from disk if spilled
       }
       if (count_frequency) it->second.frequency++;
       std::memcpy(out + i * dim_, s.arena.data() + it->second.offset,
@@ -144,6 +278,7 @@ class KvStore {
 
   int64_t evict(uint32_t min_frequency, int64_t min_version) {
     int64_t removed = 0;
+    std::vector<float> row(dim_);
     for (auto& s : shards_) {
       std::lock_guard<std::mutex> g(s.mu);
       for (auto it = s.map.begin(); it != s.map.end();) {
@@ -158,6 +293,27 @@ class KvStore {
           ++it;
         }
       }
+      if (disk_) {
+        for (auto it = s.disk_index.begin();
+             it != s.disk_index.end();) {
+          uint32_t freq = 0;
+          int64_t version = 0;
+          if (!disk_->read_row(it->second, row.data(), &freq,
+                               &version)) {
+            ++it;
+            continue;
+          }
+          bool low_freq = min_frequency > 0 && freq < min_frequency;
+          bool stale = min_version > 0 && version < min_version;
+          if (low_freq || stale) {
+            disk_->release(it->second);
+            it = s.disk_index.erase(it);
+            ++removed;
+          } else {
+            ++it;
+          }
+        }
+      }
     }
     return removed;
   }
@@ -167,6 +323,7 @@ class KvStore {
                          float* values_out, uint32_t* freq_out,
                          int64_t* version_out, int64_t capacity) const {
     int64_t count = 0;
+    std::vector<float> row(dim_);
     for (auto& s : shards_) {
       std::lock_guard<std::mutex> g(s.mu);
       for (const auto& [key, slot] : s.map) {
@@ -179,6 +336,27 @@ class KvStore {
           if (version_out) version_out[count] = slot.version;
         }
         ++count;  // keep counting so caller can size the buffer
+      }
+      // Checkpoints and reshard moves must carry the COLD tier too —
+      // losing spilled rows on a PS move would silently forget
+      // long-tail embeddings.
+      if (disk_) {
+        for (const auto& [key, off] : s.disk_index) {
+          uint32_t freq = 0;
+          int64_t version = 0;
+          if (!disk_->read_row(off, row.data(), &freq, &version)) {
+            continue;
+          }
+          if (version < since_version) continue;
+          if (count < capacity) {
+            keys_out[count] = key;
+            std::memcpy(values_out + count * dim_, row.data(),
+                        sizeof(float) * dim_);
+            if (freq_out) freq_out[count] = freq;
+            if (version_out) version_out[count] = version;
+          }
+          ++count;
+        }
       }
     }
     return count;
@@ -206,6 +384,10 @@ class KvStore {
     std::unordered_map<int64_t, Slot> map;
     std::vector<float> arena;
     std::vector<uint32_t> free_rows;
+    // key -> record offset in the disk tier (cold rows)
+    std::unordered_map<int64_t, uint64_t> disk_index;
+    // clock hand for sampled spill-candidate selection
+    size_t clock_bucket = 0;
   };
 
   Shard& shard_for(int64_t key) {
@@ -213,8 +395,50 @@ class KvStore {
     return shards_[(h >> 32) % shards_.size()];
   }
 
+  // Spill the coldest of a SAMPLE of rows to disk (caller holds the
+  // shard lock). Overflow policy = lowest frequency, then oldest
+  // version — the reference's under/over-flow ordering. Sampling
+  // (like the reference) keeps inserts O(1): a clock hand walks the
+  // hash buckets, so a full-shard scan per insert never happens.
+  void spill_coldest_locked(Shard& s) {
+    if (!disk_ || s.map.empty()) return;
+    constexpr int kSample = 8;
+    auto coldest = s.map.end();
+    int seen = 0;
+    size_t nbuckets = s.map.bucket_count();
+    size_t b = s.clock_bucket % nbuckets;
+    for (size_t walked = 0; walked < nbuckets && seen < kSample;
+         ++walked, b = (b + 1) % nbuckets) {
+      for (auto it = s.map.begin(b); it != s.map.end(b); ++it) {
+        auto mit = s.map.find(it->first);
+        if (coldest == s.map.end() ||
+            mit->second.frequency < coldest->second.frequency ||
+            (mit->second.frequency == coldest->second.frequency &&
+             mit->second.version < coldest->second.version)) {
+          coldest = mit;
+        }
+        if (++seen >= kSample) break;
+      }
+    }
+    s.clock_bucket = (b + 1) % nbuckets;
+    if (coldest == s.map.end()) return;
+    uint64_t off = disk_->write_row(
+        s.arena.data() + coldest->second.offset,
+        coldest->second.frequency, coldest->second.version);
+    if (off == DiskTier::kBadOffset) {
+      return;  // disk unwritable: keep the row in RAM (soft overflow)
+    }
+    s.disk_index[coldest->first] = off;
+    s.free_rows.push_back(coldest->second.offset);
+    s.map.erase(coldest);
+  }
+
   std::unordered_map<int64_t, Slot>::iterator insert_locked(Shard& s,
                                                             int64_t key) {
+    if (disk_ &&
+        static_cast<int64_t>(s.map.size()) >= per_shard_budget_) {
+      spill_coldest_locked(s);
+    }
     uint32_t offset;
     if (!s.free_rows.empty()) {
       offset = s.free_rows.back();
@@ -222,6 +446,26 @@ class KvStore {
     } else {
       offset = static_cast<uint32_t>(s.arena.size());
       s.arena.resize(s.arena.size() + dim_);
+    }
+    // Promotion: a spilled key re-enters RAM with its persisted row,
+    // frequency and version — not a fresh init.
+    if (disk_) {
+      auto dit = s.disk_index.find(key);
+      if (dit != s.disk_index.end()) {
+        uint32_t freq = 0;
+        int64_t version = 0;
+        bool read_ok = disk_->read_row(
+            dit->second, s.arena.data() + offset, &freq, &version);
+        // successful or not, the disk entry is consumed — a stale
+        // index entry would double-count the key and resurrect old
+        // state after an evict
+        disk_->release(dit->second);
+        s.disk_index.erase(dit);
+        if (read_ok) {
+          auto [it, ok] = s.map.emplace(key, Slot{offset, freq, version});
+          return it;
+        }
+      }
     }
     init_row(key, s.arena.data() + offset);
     auto [it, ok] = s.map.emplace(key, Slot{offset, 0, 0});
@@ -233,6 +477,8 @@ class KvStore {
   float init_scale_;
   int init_mode_;
   mutable std::vector<Shard> shards_;
+  DiskTier* disk_ = nullptr;
+  int64_t per_shard_budget_ = 0;
 };
 
 }  // namespace
@@ -248,6 +494,20 @@ void* kv_create(int dim, uint64_t seed, int num_shards, float init_scale,
 void kv_destroy(void* h) { delete static_cast<KvStore*>(h); }
 
 int64_t kv_size(void* h) { return static_cast<KvStore*>(h)->size(); }
+
+int kv_set_disk_tier(void* h, const char* path, int64_t max_ram_rows) {
+  return static_cast<KvStore*>(h)->set_disk_tier(path, max_ram_rows)
+             ? 0
+             : -1;
+}
+
+int64_t kv_ram_size(void* h) {
+  return static_cast<KvStore*>(h)->ram_size();
+}
+
+int64_t kv_disk_size(void* h) {
+  return static_cast<KvStore*>(h)->disk_size();
+}
 
 int kv_dim(void* h) { return static_cast<KvStore*>(h)->dim(); }
 

@@ -26,6 +26,10 @@ import tempfile
 import threading
 from typing import Dict, Optional, Tuple
 
+from dlrover_tpu.common.log import get_logger
+
+logger = get_logger("kv_variable")
+
 import numpy as np
 
 _SRC = os.path.join(
@@ -72,6 +76,14 @@ def _lib() -> ctypes.CDLL:
             lib.kv_destroy.argtypes = [ctypes.c_void_p]
             lib.kv_size.restype = ctypes.c_int64
             lib.kv_size.argtypes = [ctypes.c_void_p]
+            lib.kv_set_disk_tier.argtypes = [
+                ctypes.c_void_p, ctypes.c_char_p, ctypes.c_int64,
+            ]
+            lib.kv_set_disk_tier.restype = ctypes.c_int
+            lib.kv_ram_size.argtypes = [ctypes.c_void_p]
+            lib.kv_ram_size.restype = ctypes.c_int64
+            lib.kv_disk_size.argtypes = [ctypes.c_void_p]
+            lib.kv_disk_size.restype = ctypes.c_int64
             lib.kv_dim.restype = ctypes.c_int
             lib.kv_dim.argtypes = [ctypes.c_void_p]
             i64p = np.ctypeslib.ndpointer(np.int64, flags="C")
@@ -192,6 +204,8 @@ class KvVariable:
         seed: int = 0,
         num_shards: int = 16,
         init_scale: float = 0.05,
+        disk_tier_path: Optional[str] = None,
+        max_ram_rows: int = 0,
     ):
         self.name = name
         self.embedding_dim = embedding_dim
@@ -202,6 +216,41 @@ class KvVariable:
         self._slots: Dict[str, _Store] = {}
         self._seed = seed
         self._num_shards = num_shards
+        self._disk_tier_path = disk_tier_path
+        self._max_ram_rows = max_ram_rows
+        if disk_tier_path and max_ram_rows > 0:
+            self.enable_disk_tier(disk_tier_path, max_ram_rows)
+
+    def enable_disk_tier(self, path: str, max_ram_rows: int) -> None:
+        """Hybrid storage (ref tfplus hybrid_embedding/): keep at most
+        ``max_ram_rows`` rows resident; the coldest (lowest
+        frequency, oldest version) spill to ``path`` and promote back
+        on access. Checkpoints/export cover both tiers. Optimizer
+        slot stores stay RAM-only (their rows are touched exactly
+        when the param row is — spilling them separately would double
+        the IO for no memory win on the hot path)."""
+        if max_ram_rows < self._num_shards:
+            # budget granularity is per shard with a floor of one
+            # resident row, so the effective cap is num_shards
+            logger.warning(
+                "max_ram_rows=%d < num_shards=%d: effective resident "
+                "cap is %d",
+                max_ram_rows, self._num_shards, self._num_shards,
+            )
+        rc = self._store._lib.kv_set_disk_tier(
+            self._store.handle, path.encode(), max_ram_rows
+        )
+        if rc != 0:
+            raise OSError(
+                f"cannot enable disk tier at {path!r} (already "
+                "enabled, or file not writable)"
+            )
+
+    def ram_rows(self) -> int:
+        return self._store._lib.kv_ram_size(self._store.handle)
+
+    def disk_rows(self) -> int:
+        return self._store._lib.kv_disk_size(self._store.handle)
 
     def __len__(self) -> int:
         return len(self._store)

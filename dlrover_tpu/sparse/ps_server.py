@@ -64,13 +64,29 @@ class PsServer:
         port: int = 0,
         seed: int = 0,
         storage=None,
+        kv_options: Optional[dict] = None,
     ):
+        """``kv_options`` forwards to every KvVariable — e.g.
+        {"disk_tier_path": dir, "max_ram_rows": n} enables the hybrid
+        RAM/disk tier on this PS node's tables."""
         self.node_id = node_id
         self.checkpoint_dir = checkpoint_dir.rstrip("/")
         self.num_partitions = num_partitions
         self.storage = storage or get_storage()
+        kv_options = dict(kv_options or {})
+        tier_path = kv_options.pop("disk_tier_path", None)
         self._tables: Dict[str, KvVariable] = {
-            name: KvVariable(name, dim, seed=seed + i)
+            name: KvVariable(
+                name,
+                dim,
+                seed=seed + i,
+                disk_tier_path=(
+                    f"{tier_path}/ps{node_id}_{name}.tier"
+                    if tier_path
+                    else None
+                ),
+                **kv_options,
+            )
             for i, (name, dim) in enumerate(sorted(embedding_dims.items()))
         }
         self._lock = threading.RLock()

@@ -89,6 +89,9 @@ def main(argv=None) -> int:
     p.add_argument("--l21", type=float, default=1e-4)
     p.add_argument("--drill", action="store_true",
                    help="kill one PS mid-run; training must survive")
+    p.add_argument("--max-ram-rows", type=int, default=0,
+                   help=">0 enables the hybrid RAM/disk tier: at most "
+                   "this many embedding rows stay resident per PS")
     args = p.parse_args(argv)
 
     tmp = tempfile.mkdtemp(prefix="ctr_")
@@ -101,6 +104,14 @@ def main(argv=None) -> int:
             embedding_dims={"emb": EMB_DIM},
             num_partitions=32,
             seed=100 + i,
+            kv_options=(
+                {
+                    "disk_tier_path": tmp,
+                    "max_ram_rows": args.max_ram_rows,
+                }
+                if args.max_ram_rows > 0
+                else None
+            ),
         )
         ps.start()
         servers[i] = ps

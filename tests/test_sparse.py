@@ -404,3 +404,96 @@ def test_group_ftrl_l21_sparsifies():
     vals = kv.gather(keys, train=False)
     assert np.abs(vals[0]).max() > 0
     np.testing.assert_array_equal(vals[1], np.zeros(dim))
+
+
+class TestHybridStorageTier:
+    """RAM/disk tiering (ref tfplus hybrid_embedding/storage_table.h):
+    cold rows spill to disk, promote on access, survive checkpoints."""
+
+    def test_ram_bounded_and_rows_preserved(self, tmp_path):
+        dim = 8
+        kv = KvVariable(
+            "emb", embedding_dim=dim, seed=21,
+            disk_tier_path=str(tmp_path / "tier.bin"),
+            max_ram_rows=64,
+        )
+        keys = np.arange(512, dtype=np.int64)
+        vals = kv.gather(keys).copy()
+        assert len(kv) == 512  # nothing lost
+        assert kv.ram_rows() <= 64 + 16  # per-shard rounding slack
+        assert kv.disk_rows() >= 512 - 64 - 16
+        # every row — resident or spilled — reads back identically
+        np.testing.assert_array_equal(kv.gather(keys), vals)
+
+    def test_promotion_preserves_freq_version_and_training(
+        self, tmp_path
+    ):
+        dim = 4
+        kv = KvVariable(
+            "emb", embedding_dim=dim, seed=22,
+            disk_tier_path=str(tmp_path / "tier.bin"),
+            max_ram_rows=16,
+        )
+        hot = np.arange(8, dtype=np.int64)
+        # train the hot rows, then flood with cold keys to force the
+        # hot rows' neighbors to spill
+        for step in (1, 2, 3):
+            kv.apply_gradients(
+                "adagrad", hot, np.full((8, dim), 0.1, np.float32),
+                step=step, lr=0.1,
+            )
+        trained = kv.gather(hot, train=False).copy()
+        kv.gather(np.arange(100, 400, dtype=np.int64))  # flood
+        assert kv.disk_rows() > 0
+        # spilled trained rows come back exactly
+        np.testing.assert_array_equal(
+            kv.gather(hot, train=False), trained
+        )
+        # and training continues from the promoted state
+        kv.apply_gradients(
+            "adagrad", hot, np.full((8, dim), 0.1, np.float32),
+            step=4, lr=0.1,
+        )
+
+    def test_inference_reads_cold_rows_without_promotion(
+        self, tmp_path
+    ):
+        kv = KvVariable(
+            "emb", embedding_dim=4, seed=23,
+            disk_tier_path=str(tmp_path / "tier.bin"),
+            max_ram_rows=16,
+        )
+        keys = np.arange(200, dtype=np.int64)
+        vals = kv.gather(keys).copy()
+        disk_before = kv.disk_rows()
+        assert disk_before > 0
+        np.testing.assert_array_equal(
+            kv.gather(keys, train=False), vals
+        )
+        assert kv.disk_rows() == disk_before  # no promotion churn
+
+    def test_export_covers_both_tiers(self, tmp_path):
+        kv = KvVariable(
+            "emb", embedding_dim=4, seed=24,
+            disk_tier_path=str(tmp_path / "tier.bin"),
+            max_ram_rows=8,
+        )
+        keys = np.arange(100, dtype=np.int64)
+        vals = kv.gather(keys).copy()
+        ek, ev, ef, enew = kv.export()
+        assert ek.size == 100
+        order = np.argsort(ek)
+        np.testing.assert_array_equal(ek[order], keys)
+        np.testing.assert_array_equal(ev[order], vals)
+
+    def test_evict_drops_cold_disk_rows_too(self, tmp_path):
+        kv = KvVariable(
+            "emb", embedding_dim=4, seed=25,
+            disk_tier_path=str(tmp_path / "tier.bin"),
+            max_ram_rows=8,
+        )
+        kv.gather(np.arange(64, dtype=np.int64))
+        total = len(kv)
+        removed = kv.evict(min_frequency=2)  # all rows have freq 1
+        assert removed == total
+        assert len(kv) == 0
