@@ -127,6 +127,159 @@ class GKEClusterClient(ClusterClient):
             }
 
 
+class _RayWorker:
+    """Default actor body: holds the pod spec and reports health —
+    the execution payload (agent process) is launched by the job
+    master exactly as on k8s (ref scheduler/ray.py:40 RayWorker)."""
+
+    def __init__(self, spec):
+        self.spec = spec
+
+    def get_spec(self):
+        return self.spec
+
+    def ping(self):
+        return "ok"
+
+
+class RayClusterClient(ClusterClient):
+    """Ray platform (ref dlrover/python/scheduler/ray.py:51
+    RayClient): pods map to named, detached Ray actors; deletes are
+    ray.kill; listing walks named actors of the job's namespace.
+    Import-gated like GKE — this image ships no ray."""
+
+    def __init__(self, namespace: str = "dlrover", worker_cls=None):
+        try:
+            import ray
+        except ImportError as exc:
+            raise RuntimeError(
+                "platform 'ray' needs the ray package; this "
+                "environment does not ship it — use platform='local' "
+                "or install ray in your cluster image"
+            ) from exc
+        self._ray = ray
+        self.namespace = namespace
+        self.worker_cls = worker_cls or _RayWorker
+        if not ray.is_initialized():
+            ray.init(namespace=namespace, ignore_reinit_error=True)
+        import threading as _threading
+
+        # spec cache only — the cluster's named actors are the truth
+        # (they survive a master restart; _specs does not)
+        self._specs: dict = {}
+        self._specs_mu = _threading.Lock()
+
+    def create_pod(self, spec):
+        ray = self._ray
+        options = {
+            "name": spec["name"],
+            "namespace": self.namespace,
+            "lifetime": "detached",
+            "num_cpus": float(spec.get("cpu", 1) or 1),
+        }
+        if spec.get("tpu_chips"):
+            # Ray schedules TPU hosts via the custom "TPU" resource
+            options["resources"] = {"TPU": float(spec["tpu_chips"])}
+        ray.remote(self.worker_cls).options(**options).remote(spec)
+        with self._specs_mu:
+            self._specs[spec["name"]] = dict(spec)
+
+    def delete_pod(self, name):
+        ray = self._ray
+        # drop the cache entry FIRST: an intentionally removed pod
+        # must never resurface as "Failed" (the watcher would
+        # relaunch it)
+        with self._specs_mu:
+            self._specs.pop(name, None)
+        try:
+            handle = ray.get_actor(name, namespace=self.namespace)
+        except ValueError:
+            return  # already gone
+        ray.kill(handle, no_restart=True)
+
+    def list_pods(self, job_name):
+        from ray.util import list_named_actors
+
+        alive = {
+            a["name"] if isinstance(a, dict) else a
+            for a in list_named_actors(all_namespaces=False)
+        }
+        with self._specs_mu:
+            specs = {
+                n: dict(s) for n, s in self._specs.items()
+            }
+        prefix = f"{job_name}-"
+        out = []
+        seen = set()
+        for name, spec in specs.items():
+            if spec.get("job") != job_name:
+                continue
+            seen.add(name)
+            out.append(
+                {
+                    "name": name,
+                    "job": job_name,
+                    "phase": (
+                        "Running" if name in alive else "Failed"
+                    ),
+                    "node_id": spec.get("node_id", -1),
+                }
+            )
+        # Detached actors survive a master restart; a fresh client has
+        # an empty cache, so cluster-side actors of this job must
+        # still be listed (names are "{job}-{type}-{id}").
+        for name in alive - seen:
+            if not name.startswith(prefix):
+                continue
+            tail = name[len(prefix):]
+            try:
+                node_id = int(tail.rsplit("-", 1)[-1])
+            except ValueError:
+                node_id = -1
+            out.append(
+                {
+                    "name": name,
+                    "job": job_name,
+                    "phase": "Running",
+                    "node_id": node_id,
+                }
+            )
+        return out
+
+    def create_service(self, spec):
+        # Ray named actors are directly addressable; no Service object
+        return None
+
+    def patch_custom_object(self, name, body):
+        # no CRDs on Ray: scale plans execute in-process
+        return None
+
+    def watch_pods(self, job_name):
+        """Poll-diff watcher: yields Deleted/Modified events the way
+        the k8s watch stream does (the scaler's PodEventWatcher is
+        platform-agnostic over this)."""
+        import time as _time
+
+        last: dict = {}
+        while True:
+            now = {
+                p["name"]: p for p in self.list_pods(job_name)
+            }
+            for name, pod in now.items():
+                prev = last.get(name)
+                if prev is None:
+                    yield {"type": "ADDED", "pod": pod}
+                elif prev["phase"] != pod["phase"]:
+                    yield {"type": "MODIFIED", "pod": pod}
+            for name, pod in last.items():
+                if name not in now:
+                    gone = dict(pod)
+                    gone["phase"] = "Deleted"
+                    yield {"type": "DELETED", "pod": gone}
+            last = now
+            _time.sleep(2.0)
+
+
 # Same API group/version as the reference operator
 # (go/operator/api/v1alpha1/groupversion_info.go:29) so manifests stay
 # interchangeable for users migrating from it.
@@ -307,11 +460,7 @@ def get_platform(
     elif name == "gke":
         client = client or GKEClusterClient(**kwargs)
     elif name == "ray":
-        raise RuntimeError(
-            "platform 'ray' is not available in this build; the "
-            "scaler seam (master/scaler.py ClusterClient) is where a "
-            "Ray actor client plugs in"
-        )
+        client = client or RayClusterClient(**kwargs)
     else:
         raise ValueError(f"unknown platform {name!r}")
     scaler = TPUPodScaler(job_name, client)

@@ -224,3 +224,136 @@ def test_auto_scaler_pending_counts_once_toward_target():
     assert len(plan.launch_nodes) == 2  # 1 alive -> need 2 more
     # all three now count; no further launches
     assert auto.adjust_once() is None
+
+
+class _FakeRayActorHandle:
+    def __init__(self, name, spec):
+        self.name = name
+        self.spec = spec
+
+
+class _FakeRay:
+    """Just enough of the ray API for RayClusterClient."""
+
+    def __init__(self):
+        self.actors = {}
+        self.killed = []
+
+    def is_initialized(self):
+        return True
+
+    def init(self, **kw):
+        pass
+
+    def remote(self, cls):
+        fake = self
+
+        class _Remote:
+            def options(self, **options):
+                class _Launcher:
+                    def remote(self, spec):
+                        h = _FakeRayActorHandle(
+                            options["name"], spec
+                        )
+                        fake.actors[options["name"]] = h
+                        return h
+
+                return _Launcher()
+
+        return _Remote()
+
+    def get_actor(self, name, namespace=None):
+        if name not in self.actors:
+            raise ValueError(name)
+        return self.actors[name]
+
+    def kill(self, handle, no_restart=False):
+        self.killed.append(handle.name)
+        self.actors.pop(handle.name, None)
+
+
+def _ray_client(monkeypatch):
+    import sys
+    import types
+
+    fake = _FakeRay()
+    ray_mod = types.ModuleType("ray")
+    for attr in ("is_initialized", "init", "remote", "get_actor",
+                 "kill"):
+        setattr(ray_mod, attr, getattr(fake, attr))
+    util = types.ModuleType("ray.util")
+    util.list_named_actors = lambda all_namespaces=False: [
+        {"name": n} for n in fake.actors
+    ]
+    ray_mod.util = util
+    monkeypatch.setitem(sys.modules, "ray", ray_mod)
+    monkeypatch.setitem(sys.modules, "ray.util", util)
+    from dlrover_tpu.scheduler.factory import RayClusterClient
+
+    return RayClusterClient(), fake
+
+
+def test_ray_client_pods_as_named_actors(monkeypatch):
+    """Ray platform (ref scheduler/ray.py RayClient): pods become
+    named detached actors; delete kills; list reports phases."""
+    client, fake = _ray_client(monkeypatch)
+    scaler = TPUPodScaler("rj", client)
+    plan = ScalePlan()
+    plan.launch_nodes = [_node(0), _node(1)]
+    scaler.scale(plan)
+    assert set(fake.actors) == {"rj-worker-0", "rj-worker-1"}
+    pods = client.list_pods("rj")
+    assert {p["phase"] for p in pods} == {"Running"}
+
+    plan2 = ScalePlan()
+    plan2.remove_nodes = [_node(0)]
+    scaler.scale(plan2)
+    assert fake.killed == ["rj-worker-0"]
+    phases = {p["name"]: p["phase"] for p in client.list_pods("rj")}
+    assert "rj-worker-0" not in phases  # deleted on purpose
+    assert phases["rj-worker-1"] == "Running"
+    # a CRASHED actor (spec known, actor gone) reports Failed so the
+    # watcher can relaunch it
+    fake.actors.pop("rj-worker-1")
+    phases = {p["name"]: p["phase"] for p in client.list_pods("rj")}
+    assert phases["rj-worker-1"] == "Failed"
+
+
+def test_ray_platform_factory(monkeypatch):
+    _, fake = _ray_client(monkeypatch)
+    from dlrover_tpu.scheduler import get_platform
+
+    platform = get_platform("ray", "rj2")
+    plan = ScalePlan()
+    plan.launch_nodes = [_node(0)]
+    platform.scaler.scale(plan)
+    assert platform.client.list_pods("rj2")
+
+
+def test_ray_listing_survives_client_restart(monkeypatch):
+    """Detached actors outlive the master; a FRESH client must still
+    list them (and not recreate the world)."""
+    client, fake = _ray_client(monkeypatch)
+    scaler = TPUPodScaler("rj3", client)
+    plan = ScalePlan()
+    plan.launch_nodes = [_node(0), _node(1)]
+    scaler.scale(plan)
+    from dlrover_tpu.scheduler.factory import RayClusterClient
+
+    fresh = RayClusterClient()  # empty spec cache, same "cluster"
+    pods = {p["name"]: p for p in fresh.list_pods("rj3")}
+    assert set(pods) == {"rj3-worker-0", "rj3-worker-1"}
+    assert pods["rj3-worker-0"]["node_id"] == 0
+
+
+def test_ray_delete_of_dead_actor_clears_cache(monkeypatch):
+    """Removing a node whose actor already crashed must not leave a
+    phantom 'Failed' pod for the watcher to relaunch."""
+    client, fake = _ray_client(monkeypatch)
+    scaler = TPUPodScaler("rj4", client)
+    plan = ScalePlan()
+    plan.launch_nodes = [_node(0)]
+    scaler.scale(plan)
+    fake.actors.pop("rj4-worker-0")  # crash
+    client.delete_pod("rj4-worker-0")  # deliberate removal
+    assert client.list_pods("rj4") == []
