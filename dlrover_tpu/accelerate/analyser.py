@@ -85,8 +85,15 @@ def estimate_step_memory(
         / model_shards
     )
     act = activation_bytes_per_sample * strategy.micro_batch_size
-    if strategy.remat:
+    from dlrover_tpu.accelerate.remat import canonical
+
+    remat = canonical(strategy.remat)
+    if remat in ("full", "dots"):
         act = act * 0.2  # block-boundary activations only
+    elif remat == "offload":
+        act = act * 0.1  # boundaries live in host RAM, not HBM
+    elif remat == "attention":
+        act = act * 0.6  # attention internals recomputed
     total = int(p_bytes + g_bytes + o_bytes + act)
     # 20% headroom for XLA temp buffers / fragmentation
     return total, total < hbm_bytes * 0.8

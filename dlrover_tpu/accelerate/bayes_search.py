@@ -38,9 +38,14 @@ def encode_strategy(s: Strategy) -> np.ndarray:
     optimizer/dtype one-hots. Smooth-ish coordinates so nearby configs
     (e.g. fsdp=2 vs fsdp=4) have correlated throughput under the RBF
     kernel."""
+    from dlrover_tpu.accelerate.remat import POLICY_NAMES, canonical
+
     d = s.mesh_dict
     feats = [math.log2(max(d.get(a, 1), 1)) for a in _AXES]
-    feats.append(1.0 if s.remat else 0.0)
+    # one-hot over named remat policies ("none" must not look like
+    # "full" to the GP)
+    remat = canonical(s.remat)
+    feats.extend(1.0 if remat == n else 0.0 for n in POLICY_NAMES)
     feats.append(math.log2(max(s.micro_batch_size, 1)))
     feats.extend(
         1.0 if s.optimizer == o else 0.0 for o in _OPTIMIZERS

@@ -1,0 +1,110 @@
+"""Named rematerialization / offload policies.
+
+Capability parity with the reference's selective offloading checkpoint
+(atorch/auto/opt_lib/selective_offloading_checkpoint.py — choose per
+layer which activations to keep, recompute, or push to host memory)
+expressed the TPU way: ``jax.checkpoint`` policies. XLA already fuses
+and schedules the recompute; the policy just declares which residuals
+are worth HBM, and ``save_and_offload_only_these_names`` streams named
+residuals to pinned host memory instead of either keeping or
+recomputing them — the third point of the reference's tradeoff.
+
+Policies (cfg.remat / Strategy.remat accept these names):
+
+  "none"       keep every residual (fastest, most HBM)
+  "full"       recompute blocks; save only non-batch matmul outputs
+  "attention"  recompute only attention internals
+  "dots"       recompute everything except matmul outputs
+  "offload"    offload block-boundary residuals (checkpoint_name
+               "block_out") to pinned host memory, save nothing else
+
+Booleans keep working: True == "full", False == "none".
+"""
+
+from __future__ import annotations
+
+from typing import Any, Callable, Optional
+
+import jax
+
+# residual name tagged at each transformer block boundary (models
+# call jax.ad_checkpoint.checkpoint_name on the block output)
+BLOCK_OUT = "block_out"
+
+POLICY_NAMES = ("none", "full", "attention", "dots", "offload")
+
+
+def canonical(policy: Any) -> str:
+    if policy is True:
+        return "full"
+    if policy in (False, None):
+        return "none"
+    if policy in POLICY_NAMES:
+        return str(policy)
+    raise ValueError(
+        f"unknown remat policy {policy!r}; choose from "
+        f"{POLICY_NAMES} (or True/False)"
+    )
+
+
+def offload_policy():
+    """Block-boundary residuals stream to pinned host RAM; everything
+    else is recomputed. HBM cost of the backward pass drops to one
+    block's activations + transfer buffers."""
+    return jax.checkpoint_policies.save_and_offload_only_these_names(
+        names_which_can_be_saved=[],
+        names_which_can_be_offloaded=[BLOCK_OUT],
+        offload_src="device",
+        offload_dst="pinned_host",
+    )
+
+
+def apply_block_remat(
+    block_fn: Callable,
+    policy: Any,
+    attn_fn: Optional[Callable] = None,
+):
+    """Wrap a transformer block (and optionally its attention inner
+    fn) according to the named policy. Returns (block_fn, attn_fn)."""
+    name = canonical(policy)
+    if name == "none":
+        return block_fn, attn_fn
+    if name == "attention":
+        if attn_fn is None:
+            raise ValueError(
+                "remat='attention' needs the attention callable"
+            )
+        return block_fn, jax.checkpoint(attn_fn)
+    if name == "full":
+        return (
+            jax.checkpoint(
+                block_fn,
+                policy=(
+                    jax.checkpoint_policies
+                    .dots_with_no_batch_dims_saveable
+                ),
+            ),
+            attn_fn,
+        )
+    if name == "dots":
+        return (
+            jax.checkpoint(
+                block_fn,
+                policy=jax.checkpoint_policies.dots_saveable,
+            ),
+            attn_fn,
+        )
+    if name == "offload":
+        return (
+            jax.checkpoint(block_fn, policy=offload_policy()),
+            attn_fn,
+        )
+    raise AssertionError(name)
+
+
+def tag_block_output(x: jax.Array) -> jax.Array:
+    """Tag a block's output residual so the offload policy can name
+    it. A no-op under every other policy."""
+    from jax.ad_checkpoint import checkpoint_name
+
+    return checkpoint_name(x, BLOCK_OUT)

@@ -19,7 +19,9 @@ from typing import Dict, List, Optional, Tuple
 @dataclasses.dataclass(frozen=True)
 class Strategy:
     mesh_shape: Tuple[Tuple[str, int], ...]  # (("data",4),("fsdp",2),...)
-    remat: bool = True
+    # bool or a named policy from accelerate/remat.py
+    # ("none"|"full"|"attention"|"dots"|"offload")
+    remat: object = True
     dtype: str = "bfloat16"  # compute/weights dtype policy
     optimizer: str = "adamw"  # adamw | agd | adam8bit
     micro_batch_size: int = 8
@@ -28,11 +30,16 @@ class Strategy:
     def mesh_dict(self) -> Dict[str, int]:
         return dict(self.mesh_shape)
 
+    def _remat_name(self) -> str:
+        from dlrover_tpu.accelerate.remat import canonical
+
+        return canonical(self.remat)  # validates; fails fast on typos
+
     def name(self) -> str:
         mesh = "x".join(f"{a}{s}" for a, s in self.mesh_shape if s > 1)
         return (
             f"{mesh or 'single'}-{self.dtype}"
-            f"-{'remat' if self.remat else 'noremat'}-{self.optimizer}"
+            f"-remat:{self._remat_name()}-{self.optimizer}"
             f"-mb{self.micro_batch_size}"
         )
 
