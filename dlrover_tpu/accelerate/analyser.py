@@ -88,8 +88,13 @@ def estimate_step_memory(
     from dlrover_tpu.accelerate.remat import canonical
 
     remat = canonical(strategy.remat)
-    if remat in ("full", "dots"):
+    if remat == "full":
         act = act * 0.2  # block-boundary activations only
+    elif remat == "dots":
+        # dots_saveable keeps EVERY dot output, including batch-dim
+        # attention scores on the non-flash path — residency is close
+        # to no-remat, only elementwise intermediates are recomputed
+        act = act * 0.9
     elif remat == "offload":
         act = act * 0.1  # boundaries live in host RAM, not HBM
     elif remat == "attention":

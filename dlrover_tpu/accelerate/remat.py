@@ -102,6 +102,28 @@ def apply_block_remat(
     raise AssertionError(name)
 
 
+def wire_block(inner_block: Callable, policy: Any,
+               attn_fn: Callable) -> Callable:
+    """One-stop wiring for model backbones: returns the block callable
+    ``(x, layer_params) -> x`` with the named policy applied.
+
+    Encapsulates the two policy-dependent quirks every model family
+    would otherwise copy-paste: "attention" wraps the attention
+    callable (not the block), and all other checkpointing policies
+    need the block's output residual name-tagged INSIDE the
+    checkpointed region so the "offload" policy can stream it to host
+    RAM."""
+    if canonical(policy) == "attention":
+        _, wrapped_attn = apply_block_remat(None, "attention", attn_fn)
+        return lambda x, lp: inner_block(x, lp, wrapped_attn)
+
+    def named_block(x, lp):
+        return tag_block_output(inner_block(x, lp, attn_fn))
+
+    block, _ = apply_block_remat(named_block, policy, attn_fn)
+    return block
+
+
 def tag_block_output(x: jax.Array) -> jax.Array:
     """Tag a block's output residual so the offload policy can name
     it. A no-op under every other policy."""

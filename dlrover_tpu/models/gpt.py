@@ -215,28 +215,13 @@ def backbone(
     B, T = tokens.shape
     x = params["wte"][tokens] + params["wpe"][:T][None]
     x = x.astype(cfg.dtype)
-    from dlrover_tpu.accelerate.remat import (
-        apply_block_remat,
-        tag_block_output,
+    from dlrover_tpu.accelerate.remat import wire_block
+
+    block = wire_block(
+        lambda x, lp, af: _block(x, lp, cfg=cfg, attn_fn=af),
+        cfg.remat,
+        attn_fn,
     )
-
-    if cfg.remat == "attention":
-        # attention remat wraps the inner attention callable, not the
-        # whole block
-        _, attn_fn = apply_block_remat(
-            None, "attention", attn_fn
-        )
-        block = functools.partial(_block, cfg=cfg, attn_fn=attn_fn)
-    else:
-        inner = functools.partial(_block, cfg=cfg, attn_fn=attn_fn)
-
-        def named_block(x, lp):
-            # the boundary residual is named INSIDE the checkpointed
-            # region so the "offload" policy can stream it to host
-            # RAM (no-op under other policies)
-            return tag_block_output(inner(x, lp))
-
-        block, _ = apply_block_remat(named_block, cfg.remat, attn_fn)
 
     def scan_body(x, lp):
         return block(x, lp), None
@@ -283,16 +268,20 @@ def loss_fn_fused(
     cfg: GPTConfig,
     attn_fn: Optional[Callable] = None,
     num_chunks: int = 8,
+    save_logits: bool = False,
 ) -> jax.Array:
     """Same loss via the fused chunked cross-entropy
     (ops/cross_entropy.py): never materializes [B*T, V] log-softmax,
-    backward matmuls get bf16 cotangents. Use for big batch*seq."""
+    backward matmuls get bf16 cotangents. Use for big batch*seq.
+    ``save_logits`` trades [N,V] bf16 HBM for skipping the backward
+    logits recompute — right for GPT-2-size vocab heads with headroom."""
     from dlrover_tpu.ops.cross_entropy import fused_cross_entropy
 
     x = backbone(params, tokens, cfg, attn_fn)
     n = x.shape[0] * x.shape[1]
     return fused_cross_entropy(
-        x.reshape(n, -1), params["wte"], targets.reshape(n), num_chunks
+        x.reshape(n, -1), params["wte"], targets.reshape(n), num_chunks,
+        save_logits,
     )
 
 

@@ -24,44 +24,57 @@ import jax
 import jax.numpy as jnp
 
 
-def _chunk_lse_and_gold(x_c, wte, targets_c):
-    """One chunk: (logsumexp [c], gold-logit [c]) in f32."""
-    logits = jnp.einsum(
-        "ce,ve->cv", x_c, wte, preferred_element_type=jnp.float32
-    )
-    lse = jax.nn.logsumexp(logits, axis=-1)
-    gold = jnp.take_along_axis(
-        logits, targets_c[:, None], axis=-1
-    )[:, 0]
-    return lse, gold
-
-
-@functools.partial(jax.custom_vjp, nondiff_argnums=(3,))
-def fused_cross_entropy(x, wte, targets, num_chunks: int = 8):
+@functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4))
+def fused_cross_entropy(
+    x, wte, targets, num_chunks: int = 8, save_logits: bool = False
+):
     """Mean token cross-entropy of ``x @ wte^T`` against targets.
 
     x: [N, E] (activations, bf16 ok); wte: [V, E] tied embedding;
     targets: [N] int. N must be divisible by num_chunks (pad or pick a
     divisor; model code uses B*T which is a power of two).
+
+    ``save_logits=True`` stashes the forward logits in x.dtype (bf16:
+    2 bytes/entry, 1.6 GB at batch 16 x 1024 x 50k vocab) so the
+    backward skips the [N,V] recompute matmul — ~V*E MACs/token of
+    work MFU accounting never credits. Numerics caveat: with bf16
+    activations the saved logits are rounded to bf16 before the
+    backward ``exp``, so per-element softmax probabilities (and hence
+    dlogits) carry a few-percent relative error versus the f32
+    recompute path — zero-mean rounding noise on top of the bf16
+    cotangent cast both paths share. Use it when HBM has room and
+    bf16-grade gradients are acceptable (the GPT-2 bench regime);
+    leave it off at Llama-7B scale where the recompute is the right
+    trade, or when gradient bit-accuracy matters.
     """
-    loss, _ = _fwd(x, wte, targets, num_chunks)
+    loss, _ = _fwd(x, wte, targets, num_chunks, save_logits)
     return loss
 
 
-def _fwd(x, wte, targets, num_chunks):
+def _fwd(x, wte, targets, num_chunks, save_logits):
     n = x.shape[0]
     xc = x.reshape(num_chunks, n // num_chunks, -1)
     tc = targets.reshape(num_chunks, -1)
-    lse, gold = jax.lax.map(
-        lambda args: _chunk_lse_and_gold(args[0], wte, args[1]),
-        (xc, tc),
-    )
+
+    def chunk(args):
+        x_c, t_c = args
+        logits = jnp.einsum(
+            "ce,ve->cv", x_c, wte, preferred_element_type=jnp.float32
+        )
+        lse = jax.nn.logsumexp(logits, axis=-1)
+        gold = jnp.take_along_axis(logits, t_c[:, None], axis=-1)[:, 0]
+        saved = logits.astype(x.dtype) if save_logits else jnp.zeros(
+            (0,), x.dtype
+        )
+        return lse, gold, saved
+
+    lse, gold, saved = jax.lax.map(chunk, (xc, tc))
     loss = jnp.mean(lse - gold)
-    return loss, (x, wte, targets, lse.reshape(-1))
+    return loss, (x, wte, targets, lse.reshape(-1), saved)
 
 
-def _bwd(num_chunks, res, g):
-    x, wte, targets, lse = res
+def _bwd(num_chunks, save_logits, res, g):
+    x, wte, targets, lse, saved = res
     n = x.shape[0]
     c = n // num_chunks
     xc = x.reshape(num_chunks, c, -1)
@@ -69,10 +82,14 @@ def _bwd(num_chunks, res, g):
     lc = lse.reshape(num_chunks, -1)
 
     def chunk_grads(carry, args):
-        x_c, t_c, lse_c = args
-        logits = jnp.einsum(
-            "ce,ve->cv", x_c, wte, preferred_element_type=jnp.float32
-        )
+        x_c, t_c, lse_c, saved_c = args
+        if save_logits:
+            logits = saved_c.astype(jnp.float32)
+        else:
+            logits = jnp.einsum(
+                "ce,ve->cv", x_c, wte,
+                preferred_element_type=jnp.float32,
+            )
         p = jnp.exp(logits - lse_c[:, None])
         dlogits = p - jax.nn.one_hot(t_c, wte.shape[0], dtype=p.dtype)
         dlogits = (dlogits * (g / n)).astype(x.dtype)  # bf16 cotangent
@@ -83,11 +100,11 @@ def _bwd(num_chunks, res, g):
         return dwte, dx_c
 
     dwte0 = jnp.zeros(wte.shape, jnp.float32)
-    dwte, dxc = jax.lax.scan(chunk_grads, dwte0, (xc, tc, lc))
+    dwte, dxc = jax.lax.scan(chunk_grads, dwte0, (xc, tc, lc, saved))
     dx = dxc.reshape(x.shape)
     return dx, dwte.astype(wte.dtype), None
 
 
 fused_cross_entropy.defvjp(
-    lambda x, wte, t, nc: _fwd(x, wte, t, nc), _bwd
+    lambda x, wte, t, nc, sl: _fwd(x, wte, t, nc, sl), _bwd
 )

@@ -34,6 +34,7 @@ path is unit-testable on CPU.
 from __future__ import annotations
 
 import functools
+import math
 from typing import Optional
 
 import jax
@@ -144,7 +145,8 @@ def _fwd_kernel(
             (((1,), (1,)), ((), ())),
             preferred_element_type=jnp.float32,
         )
-        s = s * scale
+        if scale != 1.0:  # power-of-2 scales are folded into q outside
+            s = s * scale
         if masked:
             mask = _block_mask(
                 iq, jk, block_q, block_k, causal, seq_len, pad
@@ -156,7 +158,11 @@ def _fwd_kernel(
         m_new = jnp.maximum(m_prev, jnp.max(s, axis=1, keepdims=True))
         alpha = jnp.exp(m_prev - m_new)  # (block_q, 1): 1-lane exps
         p = jnp.exp(s - m_new)
-        if masked:
+        if masked and pad:
+            # Only padding can leave a row with no unmasked key (m_new
+            # = NEG_INF -> exp(0) = 1); under pure causal masking every
+            # executed row has a finite m_new, so exp(NEG_INF - m_new)
+            # already underflows to exactly 0 and the select is waste.
             p = jnp.where(mask, p, 0.0)
         l_scr[:] = l_prev * alpha + jnp.sum(p, axis=1, keepdims=True)
         m_scr[:] = m_new
@@ -280,7 +286,9 @@ def _bwd_kernel(
         s = jax.lax.dot_general(
             q, k, (((1,), (1,)), ((), ())),
             preferred_element_type=jnp.float32,
-        ) * scale
+        )
+        if scale != 1.0:
+            s = s * scale
         lse = lse_ref[0, 0]  # (block_q, 1)
         p = jnp.exp(s - lse)
         if masked:
@@ -300,7 +308,10 @@ def _bwd_kernel(
             preferred_element_type=jnp.float32,
         )
         delta = delta_ref[0, 0]
-        ds = (p * (dp - delta) * scale).astype(q.dtype)
+        ds = p * (dp - delta)
+        if scale != 1.0:
+            ds = ds * scale
+        ds = ds.astype(q.dtype)
         # dK += dS^T Q
         dk_scr[:] += jax.lax.dot_general(
             ds, q, (((0,), (0,)), ((), ())),
@@ -456,14 +467,22 @@ def flash_attention(
     b, t, h, d = q.shape
     if scale is None:
         scale = 1.0 / (d**0.5)
+    # Power-of-2 scales (every power-of-4 head_dim, e.g. 64 -> 1/8)
+    # multiply exactly in any float dtype, so fold them into q outside
+    # the kernel: XLA fuses the multiply into the surrounding
+    # transpose/pad, the kernel's `s * scale` pass over each
+    # [block_q, block_k] tile disappears (scale==1.0 folds at trace
+    # time), and autodiff routes the q-gradient scale through this
+    # multiply.
+    if scale != 1.0 and math.frexp(scale)[0] == 0.5:
+        q = q * jnp.asarray(scale, q.dtype)
+        scale = 1.0
     dq_, dk_ = default_block_sizes(t)
     block_q = dq_ if block_q is None else min(block_q, max(t, 8))
     block_k = dk_ if block_k is None else min(block_k, max(t, 8))
 
     # Pad so the padded length is divisible by BOTH block sizes (lcm),
     # otherwise the floor-divided grid would silently drop tail blocks.
-    import math
-
     pad = (-t) % math.lcm(block_q, block_k)
 
     def to_kernel_layout(x):

@@ -33,6 +33,51 @@ def test_fused_xent_matches_naive(num_chunks):
     np.testing.assert_allclose(got, want, rtol=1e-5)
 
 
+@pytest.mark.parametrize("num_chunks", [1, 4])
+def test_fused_xent_save_logits_matches_naive(num_chunks):
+    key = jax.random.PRNGKey(0)
+    n, e, v = 64, 16, 96
+    x = jax.random.normal(key, (n, e), jnp.float32)
+    wte = jax.random.normal(jax.random.PRNGKey(1), (v, e), jnp.float32)
+    targets = jax.random.randint(jax.random.PRNGKey(2), (n,), 0, v)
+    got = fused_cross_entropy(x, wte, targets, num_chunks, True)
+    want = _naive(x, wte, targets)
+    np.testing.assert_allclose(got, want, rtol=1e-5)
+    g1 = jax.grad(
+        lambda x, w: fused_cross_entropy(x, w, targets, num_chunks, True),
+        argnums=(0, 1),
+    )(x, wte)
+    g2 = jax.grad(_naive, argnums=(0, 1))(x, wte, targets)
+    for a, b in zip(g1, g2):
+        np.testing.assert_allclose(a, b, atol=2e-6, rtol=1e-4)
+
+
+def test_fused_xent_save_logits_bf16_grads_close():
+    """bf16 activations + save_logits: grads agree with the f32
+    recompute path to bf16-rounding tolerance (documented caveat)."""
+    n, e, v = 64, 32, 128
+    x = jax.random.normal(
+        jax.random.PRNGKey(0), (n, e), jnp.bfloat16
+    )
+    wte = jax.random.normal(
+        jax.random.PRNGKey(1), (v, e), jnp.bfloat16
+    )
+    targets = jax.random.randint(jax.random.PRNGKey(2), (n,), 0, v)
+    g_save = jax.grad(
+        lambda x, w: fused_cross_entropy(x, w, targets, 4, True),
+        argnums=(0, 1),
+    )(x, wte)
+    g_rec = jax.grad(
+        lambda x, w: fused_cross_entropy(x, w, targets, 4, False),
+        argnums=(0, 1),
+    )(x, wte)
+    for a, b in zip(g_save, g_rec):
+        a32 = np.asarray(a, np.float32)
+        b32 = np.asarray(b, np.float32)
+        denom = np.maximum(np.abs(b32), 1e-4)
+        assert np.median(np.abs(a32 - b32) / denom) < 0.05
+
+
 def test_fused_xent_grads_match_naive():
     n, e, v = 32, 8, 64
     x = jax.random.normal(jax.random.PRNGKey(0), (n, e), jnp.float32)

@@ -1,0 +1,183 @@
+"""Llama model family: RoPE, GQA, SwiGLU, sharded training.
+
+Parity targets: the reference trains Llama-2 through HF modules +
+atorch auto_accelerate (/root/reference/atorch/examples/llama2/
+fsdp_llama2.py); here the model is native (models/llama.py) and the
+same logical-axis rule table shards it.
+"""
+
+import functools
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import optax
+import pytest
+
+from dlrover_tpu.models import llama
+from dlrover_tpu.parallel.mesh import MeshConfig, build_mesh
+from dlrover_tpu.trainer.step import (
+    make_sharded_init,
+    make_train_step,
+    shard_batch,
+)
+
+
+@pytest.fixture(scope="module")
+def tiny():
+    return llama.LlamaConfig.tiny()
+
+
+@pytest.fixture(scope="module")
+def tiny_params(tiny):
+    return llama.init_params(jax.random.PRNGKey(0), tiny)
+
+
+def test_forward_shape_and_finite(tiny, tiny_params):
+    tokens = jax.random.randint(
+        jax.random.PRNGKey(1), (2, tiny.block_size), 0, tiny.vocab_size
+    )
+    logits = llama.forward(tiny_params, tokens, tiny)
+    assert logits.shape == (2, tiny.block_size, tiny.vocab_size)
+    assert bool(jnp.all(jnp.isfinite(logits)))
+
+
+def test_rope_preserves_norm(tiny):
+    cos, sin = llama.rope_table(tiny, 16)
+    x = jax.random.normal(
+        jax.random.PRNGKey(0), (1, 16, 2, tiny.head_dim)
+    )
+    rot = llama.apply_rope(x, cos, sin)
+    np.testing.assert_allclose(
+        jnp.linalg.norm(rot, axis=-1),
+        jnp.linalg.norm(x, axis=-1),
+        rtol=1e-5,
+    )
+    # position 0 is the identity rotation
+    np.testing.assert_allclose(rot[:, 0], x[:, 0], atol=1e-6)
+
+
+def test_rope_relative_shift_invariance(tiny):
+    """Attention scores under RoPE depend only on relative offsets:
+    rotating (q at p+s, k at p'+s) gives the same dot product."""
+    d = tiny.head_dim
+    cos, sin = llama.rope_table(tiny, 32)
+    q = jax.random.normal(jax.random.PRNGKey(0), (1, 32, 1, d))
+    k = jax.random.normal(jax.random.PRNGKey(1), (1, 32, 1, d))
+    qr = llama.apply_rope(q, cos, sin)[0, :, 0]
+    kr = llama.apply_rope(k, cos, sin)[0, :, 0]
+    # score(5, 3) computed at positions (5,3) vs the same vectors
+    # rotated as if at (15, 13): equal because offset is equal.
+    q2 = jnp.broadcast_to(q[0, 5, 0], (1, 32, 1, d))
+    k2 = jnp.broadcast_to(k[0, 3, 0], (1, 32, 1, d))
+    q2r = llama.apply_rope(q2, cos, sin)[0, :, 0]
+    k2r = llama.apply_rope(k2, cos, sin)[0, :, 0]
+    s_a = jnp.dot(q2r[15], k2r[13])
+    s_b = jnp.dot(q2r[5], k2r[3])
+    np.testing.assert_allclose(s_a, s_b, rtol=1e-4)
+    # and sanity: the in-context score at (5,3) uses those vectors
+    np.testing.assert_allclose(
+        jnp.dot(qr[5], kr[3]), s_b, rtol=1e-4, atol=1e-5
+    )
+
+
+def test_gqa_matches_explicit_head_broadcast(tiny, tiny_params):
+    """GQA forward == an MHA forward whose k/v weights are the kv
+    weights tiled over each query group."""
+    tokens = jax.random.randint(
+        jax.random.PRNGKey(2), (2, tiny.block_size), 0, tiny.vocab_size
+    )
+    out_gqa = llama.forward(tiny_params, tokens, tiny)
+
+    import dataclasses
+
+    mha = dataclasses.replace(tiny, n_kv_head=tiny.n_head)
+    D, Hkv, g = tiny.head_dim, tiny.n_kv_head, tiny.q_per_kv
+    p2 = jax.tree.map(lambda x: x, tiny_params)
+
+    def tile(w):  # [L, E, Hkv*D] -> [L, E, H*D] repeating per group
+        L, E = w.shape[0], w.shape[1]
+        w = w.reshape(L, E, Hkv, D)
+        w = jnp.repeat(w, g, axis=2)
+        return w.reshape(L, E, Hkv * g * D)
+
+    p2["blocks"] = dict(p2["blocks"])
+    p2["blocks"]["wk"] = tile(tiny_params["blocks"]["wk"])
+    p2["blocks"]["wv"] = tile(tiny_params["blocks"]["wv"])
+    out_mha = llama.forward(p2, tokens, mha)
+    np.testing.assert_allclose(out_gqa, out_mha, atol=1e-4, rtol=1e-4)
+
+
+def test_fused_loss_matches_plain(tiny, tiny_params):
+    tokens = jax.random.randint(
+        jax.random.PRNGKey(3), (2, tiny.block_size), 0, tiny.vocab_size
+    )
+    targets = jnp.roll(tokens, -1, axis=1)
+    plain = llama.loss_fn(tiny_params, tokens, targets, tiny)
+    fused = llama.loss_fn_fused(
+        tiny_params, tokens, targets, tiny, num_chunks=4
+    )
+    np.testing.assert_allclose(fused, plain, rtol=1e-5)
+    fused_sl = llama.loss_fn_fused(
+        tiny_params, tokens, targets, tiny, num_chunks=4, save_logits=True
+    )
+    np.testing.assert_allclose(fused_sl, plain, rtol=1e-5)
+
+
+def test_remat_policies_grad_parity(tiny, tiny_params):
+    import dataclasses
+
+    tokens = jax.random.randint(
+        jax.random.PRNGKey(4), (2, tiny.block_size), 0, tiny.vocab_size
+    )
+    targets = jnp.roll(tokens, -1, axis=1)
+    base = jax.grad(
+        lambda p: llama.loss_fn(p, tokens, targets, tiny)
+    )(tiny_params)
+    for policy in (True, "attention", "dots"):
+        cfg = dataclasses.replace(tiny, remat=policy)
+        g = jax.grad(
+            lambda p: llama.loss_fn(p, tokens, targets, cfg)
+        )(tiny_params)
+        for a, b in zip(jax.tree.leaves(g), jax.tree.leaves(base)):
+            np.testing.assert_allclose(a, b, atol=1e-5, rtol=1e-3)
+
+
+def test_sharded_train_step_tp_fsdp(tiny):
+    """Full sharded train step on the 8-device CPU mesh: fsdp=2 x
+    tensor=2 x data=2, loss finite and decreasing over steps."""
+    mesh = build_mesh(MeshConfig(data=2, fsdp=2, tensor=2))
+    optimizer = optax.adamw(1e-3)
+    loss = functools.partial(llama.loss_fn, cfg=tiny)
+    init, _ = make_sharded_init(
+        mesh,
+        functools.partial(llama.init_params, cfg=tiny),
+        llama.param_logical_axes(tiny),
+        optimizer,
+    )
+    params, opt_state = init(jax.random.PRNGKey(0))
+    step = make_train_step(mesh, loss, optimizer)
+    tokens = jax.random.randint(
+        jax.random.PRNGKey(5), (8, tiny.block_size), 0, tiny.vocab_size
+    )
+    targets = jnp.roll(tokens, -1, axis=1)
+    tokens, targets = shard_batch(mesh, tokens, targets)
+    losses = []
+    for _ in range(4):
+        params, opt_state, metrics = step(
+            params, opt_state, tokens, targets
+        )
+        losses.append(float(metrics["loss"]))
+    assert all(np.isfinite(losses))
+    assert losses[-1] < losses[0]
+
+
+def test_flops_per_token_matches_analytic(tiny):
+    got = llama.flops_per_token(tiny)
+    E, L, I = tiny.n_embd, tiny.n_layer, tiny.intermediate
+    kv = tiny.n_kv_head * tiny.head_dim
+    want = 6.0 * (
+        L * (2 * E * E + 2 * E * kv + 3 * E * I)
+        + tiny.vocab_size * E
+    ) + 12 * L * tiny.block_size * E
+    assert got == want

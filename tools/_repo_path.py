@@ -1,0 +1,14 @@
+"""Make the repo root importable from tools/ scripts.
+
+PYTHONPATH=. breaks the axon TPU plugin's jax_plugins namespace
+discovery, so tools extend sys.path here instead of via env var:
+
+    import _repo_path  # noqa: F401  (must precede `import jax`)
+"""
+
+import os
+import sys
+
+sys.path.insert(
+    0, os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+)

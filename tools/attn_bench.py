@@ -9,6 +9,8 @@ import functools
 import sys
 import time
 
+import _repo_path  # noqa: F401
+
 import jax
 import jax.numpy as jnp
 

@@ -1,8 +1,10 @@
 """Perf sweep harness: times the GPT-2 train step across configs.
 
-Usage: python tools/perf_sweep.py 'remat,flash,batch[,block_q,block_k]' ...
-  remat: full | attn | none
-  flash: flash | xla
+Usage: python tools/perf_sweep.py 'remat,flash,batch[,block_q,block_k[,sl]]' ...
+  remat: full | attn | none | dots | offload
+  flash: flash | xla | noop (noop stubs attention to measure the
+         step's non-attention cost by subtraction)
+  sl: save-logits cross-entropy variant (pass "sl")
 
 Prints one line per config: config, step ms, MFU, vs_baseline.
 """
@@ -13,6 +15,8 @@ import dataclasses
 import functools
 import sys
 import time
+
+import _repo_path  # noqa: F401
 
 import jax
 import jax.numpy as jnp
@@ -35,7 +39,11 @@ def run_config(mesh, spec: str) -> None:
     remat_s, flash_s, batch_s = parts[0], parts[1], parts[2]
     block_q = int(parts[3]) if len(parts) > 3 else 128
     block_k = int(parts[4]) if len(parts) > 4 else 128
-    remat = {"full": True, "attn": "attention", "none": False}[remat_s]
+    save_logits = len(parts) > 5 and parts[5] == "sl"
+    remat = {
+        "full": True, "attn": "attention", "none": False,
+        "dots": "dots", "offload": "offload",
+    }[remat_s]
     use_flash = flash_s == "flash"
 
     cfg = dataclasses.replace(
@@ -44,7 +52,11 @@ def run_config(mesh, spec: str) -> None:
     batch = int(batch_s)
 
     attn_fn = None
-    if use_flash:
+    if flash_s == "noop":
+        # Attention stubbed to identity-on-v: measures the step's
+        # non-attention cost by subtraction.
+        attn_fn = lambda q, k, v: v  # noqa: E731
+    elif use_flash:
         from dlrover_tpu.ops.flash_attention import flash_attention
 
         attn_fn = functools.partial(
@@ -52,7 +64,10 @@ def run_config(mesh, spec: str) -> None:
         )
 
     optimizer = optax.adamw(3e-4, weight_decay=0.1)
-    loss = functools.partial(gpt.loss_fn_fused, cfg=cfg, attn_fn=attn_fn)
+    loss = functools.partial(
+        gpt.loss_fn_fused, cfg=cfg, attn_fn=attn_fn,
+        save_logits=save_logits,
+    )
     init, _ = make_sharded_init(
         mesh,
         functools.partial(gpt.init_params, cfg=cfg),
