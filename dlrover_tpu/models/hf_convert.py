@@ -1,0 +1,114 @@
+"""HuggingFace checkpoint -> native pytree converters.
+
+The reference consumes HF models directly (its Llama example builds
+``AutoModelForCausalLM`` and wraps layers,
+/root/reference/atorch/examples/llama2/fsdp_llama2.py:8-14); this
+framework uses native JAX modules instead, so migration needs a weight
+bridge. ``llama_params_from_hf`` maps an HF Llama ``state_dict`` (or
+model) onto models/llama.py's stacked-layer pytree:
+
+* torch ``Linear.weight`` is [out, in] — transposed to [in, out];
+* per-layer tensors are stacked on a leading ``layers`` dim for the
+  ``lax.scan`` backbone;
+* rotary convention matches (HF ``rotate_half`` == our split-halves
+  apply_rope), so no permutation of q/k rows is needed.
+
+Torch stays host-side only: tensors convert through numpy and the
+result is a plain numpy pytree the caller shards via
+``jax.device_put`` / ``make_sharded_init``-style shardings.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Dict, Mapping
+
+import numpy as np
+
+from dlrover_tpu.models.llama import LlamaConfig
+
+
+def _np(t) -> np.ndarray:
+    """torch tensor | np array -> float32 numpy on host."""
+    if hasattr(t, "detach"):
+        t = t.detach().to("cpu").float().numpy()
+    return np.asarray(t, np.float32)
+
+
+def llama_config_from_hf(hf_config) -> LlamaConfig:
+    """Map an HF LlamaConfig to ours."""
+    return LlamaConfig(
+        vocab_size=hf_config.vocab_size,
+        block_size=hf_config.max_position_embeddings,
+        n_layer=hf_config.num_hidden_layers,
+        n_head=hf_config.num_attention_heads,
+        n_kv_head=getattr(
+            hf_config, "num_key_value_heads",
+            hf_config.num_attention_heads,
+        ),
+        n_embd=hf_config.hidden_size,
+        intermediate=hf_config.intermediate_size,
+        rope_theta=getattr(hf_config, "rope_theta", 10000.0),
+        rms_eps=hf_config.rms_norm_eps,
+    )
+
+
+def llama_params_from_hf(
+    state_dict: Mapping[str, Any],
+    cfg: LlamaConfig,
+    dtype: Any = np.float32,
+) -> Dict[str, Any]:
+    """HF Llama(ForCausalLM) state_dict -> our param pytree.
+
+    Accepts either the ``model.``-prefixed CausalLM dict or a bare
+    LlamaModel dict. Tied-embedding checkpoints (no lm_head.weight)
+    fall back to wte for the head, matching HF's tie_word_embeddings.
+    """
+    if hasattr(state_dict, "state_dict"):
+        raise TypeError("pass model.state_dict(), not the model")
+    sd = dict(state_dict)
+
+    def get(name):
+        for key in (name, f"model.{name}"):
+            if key in sd:
+                return _np(sd[key])
+        raise KeyError(
+            f"HF state_dict is missing {name!r} "
+            f"(have e.g. {list(sd)[:4]})"
+        )
+
+    L = cfg.n_layer
+
+    def stack(fmt, transpose=True):
+        mats = []
+        for i in range(L):
+            w = get(fmt.format(i=i))
+            mats.append(w.T if transpose else w)
+        return np.stack(mats).astype(dtype)
+
+    wte = get("embed_tokens.weight").astype(dtype)
+    try:
+        head = _np(sd["lm_head.weight"]).astype(dtype)
+    except KeyError:
+        head = wte  # tie_word_embeddings
+    params = {
+        "wte": wte,
+        "blocks": {
+            "rms1": stack(
+                "layers.{i}.input_layernorm.weight", transpose=False
+            ).astype(np.float32),
+            "wq": stack("layers.{i}.self_attn.q_proj.weight"),
+            "wk": stack("layers.{i}.self_attn.k_proj.weight"),
+            "wv": stack("layers.{i}.self_attn.v_proj.weight"),
+            "wo": stack("layers.{i}.self_attn.o_proj.weight"),
+            "rms2": stack(
+                "layers.{i}.post_attention_layernorm.weight",
+                transpose=False,
+            ).astype(np.float32),
+            "w_gate": stack("layers.{i}.mlp.gate_proj.weight"),
+            "w_up": stack("layers.{i}.mlp.up_proj.weight"),
+            "w_down": stack("layers.{i}.mlp.down_proj.weight"),
+        },
+        "rmsf": get("norm.weight").astype(np.float32),
+        "lm_head": head,
+    }
+    return params

@@ -9,5 +9,5 @@ surrounding pjit inserts.
 """
 
 from dlrover_tpu.optim.agd import agd, scale_by_agd  # noqa: F401
-from dlrover_tpu.optim.low_bit import adam_8bit  # noqa: F401
+from dlrover_tpu.optim.low_bit import adam_4bit, adam_8bit  # noqa: F401
 from dlrover_tpu.optim.wsam import WeightedSAM  # noqa: F401

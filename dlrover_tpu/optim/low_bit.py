@@ -1,15 +1,19 @@
-"""Low-bit (8-bit state) Adam backed by the Pallas quantization kernels.
+"""Low-bit (4/8-bit state) Adam backed by the Pallas quantization
+kernels.
 
 Parity target: the reference's low-bit optimizers
 (atorch/optimizers/low_bit/ + CUDA kernels
-atorch/ops/csrc/quantization/quantization_optimizer.{cc,cu}): optimizer
-moments live in int8 with per-block float32 scales, cutting optimizer
-HBM from 8 bytes/param (f32 m+v) to ~2 bytes/param, which is what makes
-large-model training fit on fewer chips.
+atorch/ops/csrc/quantization/quantization_optimizer.{cc,cu}, which
+support 4- and 8-bit states): optimizer moments live in int8 (or
+packed int4) with per-block float32 scales, cutting optimizer HBM
+from 8 bytes/param (f32 m+v) to ~2 (8-bit) or ~1 (4-bit) bytes/param,
+which is what makes large-model training fit on fewer chips.
 
 Each update dequantizes the moments, applies the Adam rule in float32,
 and requantizes — the quantize/dequantize run as Pallas kernels
-(ops/quantization.py) on TPU.
+(ops/quantization.py) on TPU. At 4 bits the first moment uses signed
+levels (-7..7) and the second moment — stored as sqrt(v), which is
+non-negative — uses unsigned levels (0..15) for double resolution.
 """
 
 from __future__ import annotations
@@ -24,12 +28,14 @@ import optax
 from dlrover_tpu.ops.quantization import (
     DEFAULT_BLOCK,
     dequantize_blockwise,
+    dequantize_blockwise_4bit,
     quantize_blockwise,
+    quantize_blockwise_4bit,
 )
 
 
 class _QTensor(NamedTuple):
-    q: chex.Array  # int8 [rows, block]
+    q: chex.Array  # int8 [rows, block] | packed uint8 [rows, block/2]
     scales: chex.Array  # f32 [rows, 1]
 
 
@@ -39,12 +45,17 @@ class Adam8bitState(NamedTuple):
     nu: chex.ArrayTree  # tree of _QTensor
 
 
-def _quant(x, block):
-    q, scales, _ = quantize_blockwise(x, block)
+def _quant(x, block, bits=8, signed=True):
+    if bits == 4:
+        q, scales, _ = quantize_blockwise_4bit(x, block, signed)
+    else:
+        q, scales, _ = quantize_blockwise(x, block)
     return _QTensor(q=q, scales=scales)
 
 
-def _dequant(qt: _QTensor, shape):
+def _dequant(qt: _QTensor, shape, bits=8, signed=True):
+    if bits == 4:
+        return dequantize_blockwise_4bit(qt.q, qt.scales, shape, signed)
     return dequantize_blockwise(qt.q, qt.scales, shape)
 
 
@@ -57,8 +68,10 @@ def adam_8bit(
     block_size: int = DEFAULT_BLOCK,
     min_quantize_size: int = 4096,
     update_clip: float = 2.0,
+    bits: int = 8,
 ) -> optax.GradientTransformation:
-    """AdamW with int8 blockwise-quantized moments.
+    """AdamW with blockwise-quantized moments (int8, or packed int4
+    with ``bits=4`` — see ``adam_4bit``).
 
     Leaves smaller than ``min_quantize_size`` keep float32 moments
     (quantization overhead/loss isn't worth it for biases/norms —
@@ -72,20 +85,27 @@ def adam_8bit(
     clip at 2 never binds on healthy coordinates (the reference's
     low-bit suite relies on the same trust-region idea).
     """
+    if bits not in (4, 8):
+        raise ValueError(f"bits must be 4 or 8, got {bits}")
 
     def _big(p) -> bool:
         return p.size >= min_quantize_size
 
     def init_fn(params):
-        def init_moment(p):
+        def init_moment(p, signed=True):
             if _big(p):
-                return _quant(jnp.zeros(p.shape, jnp.float32), block_size)
+                return _quant(
+                    jnp.zeros(p.shape, jnp.float32), block_size,
+                    bits, signed,
+                )
             return jnp.zeros(p.shape, jnp.float32)
 
         return Adam8bitState(
             count=jnp.zeros([], jnp.int32),
             mu=jax.tree.map(init_moment, params),
-            nu=jax.tree.map(init_moment, params),
+            nu=jax.tree.map(
+                lambda p: init_moment(p, signed=False), params
+            ),
         )
 
     def update_fn(updates, state, params=None):
@@ -101,13 +121,17 @@ def adam_8bit(
         def leaf_update(g, mu, nu, quantized):
             g = g.astype(jnp.float32)
             if quantized:
-                m = _dequant(mu, g.shape)
-                # v is stored as sqrt(v): linear int8 on sqrt(v) keeps
-                # the quantization threshold proportional to |g| for
-                # BOTH moments, so a coordinate whose m survives
-                # quantization never sees its v collapse to zero
-                # (which would explode m/(sqrt(v)+eps)).
-                v = jnp.square(_dequant(nu, g.shape))
+                m = _dequant(mu, g.shape, bits)
+                # v is stored as sqrt(v): linear quantization on
+                # sqrt(v) keeps the quantization threshold
+                # proportional to |g| for BOTH moments, so a
+                # coordinate whose m survives quantization never sees
+                # its v collapse to zero (which would explode
+                # m/(sqrt(v)+eps)). sqrt(v) is non-negative, so at 4
+                # bits it uses the unsigned 0..15 levels.
+                v = jnp.square(
+                    _dequant(nu, g.shape, bits, signed=False)
+                )
             else:
                 m, v = mu, nu
             m = b1 * m + (1.0 - b1) * g
@@ -116,8 +140,10 @@ def adam_8bit(
             if update_clip is not None:
                 out = jnp.clip(out, -update_clip, update_clip)
             if quantized:
-                m_s = _quant(m, block_size)
-                v_s = _quant(jnp.sqrt(v), block_size)
+                m_s = _quant(m, block_size, bits)
+                v_s = _quant(
+                    jnp.sqrt(v), block_size, bits, signed=False
+                )
             else:
                 m_s, v_s = m, v
             return out, m_s, v_s
@@ -151,12 +177,28 @@ def adam_8bit(
     return optax.chain(*tx)
 
 
+def adam_4bit(
+    learning_rate: optax.ScalarOrSchedule = 1e-3,
+    **kw,
+) -> optax.GradientTransformation:
+    """AdamW with packed-int4 moments (~1 byte/param of optimizer
+    state): signed 4-bit first moment, unsigned 4-bit sqrt(v). Same
+    trust-region clip as the 8-bit variant. Ref: the 4-bit mode of
+    atorch's quantization_optimizer kernels."""
+    return adam_8bit(learning_rate, bits=4, **kw)
+
+
 def optimizer_state_bytes(opt_state) -> Tuple[int, int]:
     """(actual_bytes, f32_equivalent_bytes) of all moment arrays —
-    used by tests and the memory accounting in the strategy engine."""
+    used by tests and the memory accounting in the strategy engine.
+    uint8 leaves are the packed-int4 states (two logical values per
+    byte), so their f32 equivalent is 2 * size * 4."""
     actual = 0
     f32_equiv = 0
     for leaf in jax.tree.leaves(opt_state):
         actual += leaf.size * leaf.dtype.itemsize
-        f32_equiv += leaf.size * 4
+        logical = (
+            leaf.size * 2 if leaf.dtype == jnp.uint8 else leaf.size
+        )
+        f32_equiv += logical * 4
     return actual, f32_equiv

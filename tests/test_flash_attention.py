@@ -36,6 +36,20 @@ def test_forward_unpadded_vs_padded_seq():
     np.testing.assert_allclose(out, ref, atol=2e-5, rtol=2e-5)
 
 
+@pytest.mark.parametrize("t", [520, 1000, 1024, 1536, 2048])
+def test_default_block_sizes_pad_stays_bounded(t):
+    """Regression: unequal default blocks once padded to
+    lcm(block_q, block_k), which explodes for t=520 (lcm 33280).
+    Defaults must never pad a sequence by more than one block."""
+    import math
+
+    from dlrover_tpu.ops.flash_attention import default_block_sizes
+
+    bq, bk = default_block_sizes(t)
+    pad = (-t) % math.lcm(bq, bk)
+    assert pad < max(bq, bk)
+
+
 @pytest.mark.parametrize("causal", [True, False])
 def test_gradients_match_reference(causal):
     q, k, v = _rand_qkv(jax.random.PRNGKey(2), 1, 128, 2, 64)

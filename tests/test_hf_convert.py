@@ -1,0 +1,73 @@
+"""HF Llama -> native pytree conversion: logits parity vs transformers."""
+
+import numpy as np
+import pytest
+
+torch = pytest.importorskip("torch")
+transformers = pytest.importorskip("transformers")
+
+import jax  # noqa: E402
+
+from dlrover_tpu.models import llama  # noqa: E402
+from dlrover_tpu.models.hf_convert import (  # noqa: E402
+    llama_config_from_hf,
+    llama_params_from_hf,
+)
+
+
+@pytest.fixture(scope="module")
+def hf_model():
+    hf_cfg = transformers.LlamaConfig(
+        vocab_size=128,
+        hidden_size=64,
+        intermediate_size=128,
+        num_hidden_layers=2,
+        num_attention_heads=4,
+        num_key_value_heads=2,
+        max_position_embeddings=64,
+        rms_norm_eps=1e-5,
+        rope_theta=10000.0,
+        tie_word_embeddings=False,
+        attn_implementation="eager",
+    )
+    torch.manual_seed(0)
+    model = transformers.LlamaForCausalLM(hf_cfg)
+    model.eval()
+    return model
+
+
+def test_logits_match_transformers(hf_model):
+    cfg = llama_config_from_hf(hf_model.config)
+    assert cfg.n_kv_head == 2 and cfg.head_dim == 16
+    params = llama_params_from_hf(hf_model.state_dict(), cfg)
+
+    import dataclasses
+
+    cfg = dataclasses.replace(
+        cfg, dtype=np.float32, remat=False, use_flash_attention=False
+    )
+    tokens_np = np.random.default_rng(0).integers(
+        0, cfg.vocab_size, size=(2, 16)
+    )
+    with torch.no_grad():
+        want = hf_model(
+            torch.from_numpy(tokens_np)
+        ).logits.float().numpy()
+    got = np.asarray(
+        llama.forward(
+            jax.tree.map(np.asarray, params),
+            tokens_np.astype(np.int32),
+            cfg,
+        )
+    )
+    np.testing.assert_allclose(got, want, atol=2e-4, rtol=2e-3)
+
+
+def test_tied_embeddings_fallback(hf_model):
+    cfg = llama_config_from_hf(hf_model.config)
+    sd = {
+        k: v for k, v in hf_model.state_dict().items()
+        if k != "lm_head.weight"
+    }
+    params = llama_params_from_hf(sd, cfg)
+    np.testing.assert_array_equal(params["lm_head"], params["wte"])
