@@ -59,6 +59,7 @@ _OPT_STATE_MULT = {
     "adamw": 2.0,
     "agd": 2.0,
     "adam8bit": 0.55,  # int8 m + int8 sqrt(v) + scales
+    "adam4bit": 0.3,  # packed nibbles + scales
     "sgd": 0.0,
 }
 

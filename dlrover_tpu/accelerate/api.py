@@ -52,6 +52,10 @@ def _make_optimizer(name: str, learning_rate: float):
         from dlrover_tpu.optim import adam_8bit
 
         return adam_8bit(learning_rate)
+    if name == "adam4bit":
+        from dlrover_tpu.optim import adam_4bit
+
+        return adam_4bit(learning_rate)
     if name == "sgd":
         return optax.sgd(learning_rate)
     raise ValueError(f"unknown optimizer {name!r}")

@@ -29,7 +29,7 @@ from dlrover_tpu.common.log import get_logger
 logger = get_logger("bayes_search")
 
 _AXES = ("data", "fsdp", "tensor", "seq", "pipe", "expert")
-_OPTIMIZERS = ("adamw", "agd", "adam8bit", "sgd")
+_OPTIMIZERS = ("adamw", "agd", "adam8bit", "adam4bit", "sgd")
 _DTYPES = ("bfloat16", "float32")
 
 

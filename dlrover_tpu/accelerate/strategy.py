@@ -23,7 +23,7 @@ class Strategy:
     # ("none"|"full"|"attention"|"dots"|"offload")
     remat: object = True
     dtype: str = "bfloat16"  # compute/weights dtype policy
-    optimizer: str = "adamw"  # adamw | agd | adam8bit
+    optimizer: str = "adamw"  # adamw | agd | adam8bit | adam4bit | sgd
     micro_batch_size: int = 8
 
     @property

@@ -66,10 +66,12 @@ def llama_params_from_hf(
     if hasattr(state_dict, "state_dict"):
         raise TypeError("pass model.state_dict(), not the model")
     sd = dict(state_dict)
+    used = set()
 
     def get(name):
         for key in (name, f"model.{name}"):
             if key in sd:
+                used.add(key)
                 return _np(sd[key])
         raise KeyError(
             f"HF state_dict is missing {name!r} "
@@ -111,4 +113,19 @@ def llama_params_from_hf(
         "rmsf": get("norm.weight").astype(np.float32),
         "lm_head": head,
     }
+    used.add("lm_head.weight")
+    # Models with weights we don't map (e.g. attention_bias=True
+    # checkpoints carry q_proj.bias) would silently convert into a
+    # different function — refuse instead of degrading.
+    leftover = {
+        k for k in sd
+        if k not in used
+        and not k.endswith("rotary_emb.inv_freq")  # recomputed
+    }
+    if leftover:
+        raise ValueError(
+            "HF state_dict contains tensors this converter does not "
+            f"map (unsupported architecture variant?): "
+            f"{sorted(leftover)[:6]}"
+        )
     return params

@@ -308,6 +308,8 @@ def _bwd_kernel(
             preferred_element_type=jnp.float32,
         )
         delta = delta_ref[0, 0]
+        # delta folds BOTH cotangents: rowsum(dO*O) from the output
+        # and -g_lse from the logsumexp (dlse/ds_j = p_j), see _bwd.
         ds = p * (dp - delta)
         if scale != 1.0:
             ds = ds * scale
@@ -341,7 +343,8 @@ def _bwd_kernel(
 
 
 def _bwd(
-    q, k, v, o, lse, do, causal, scale, block_q, block_k, seq_len, interpret
+    q, k, v, o, lse, do, causal, scale, block_q, block_k, seq_len,
+    interpret, g_lse=None,
 ):
     b, h, t, d = q.shape
     num_q = t // block_q
@@ -352,6 +355,10 @@ def _bwd(
         axis=-1,
         keepdims=True,
     )  # [B, H, T, 1]; XLA fuses this rowsum
+    if g_lse is not None:
+        # lse cotangent: dlse/ds_j = p_j, so dS gains p * g_lse — the
+        # same rank-1 shape as the delta term, folded in host-side.
+        delta = delta - g_lse
 
     kernel = functools.partial(
         _bwd_kernel,
@@ -435,6 +442,38 @@ def _flash_bwd(causal, scale, block_q, block_k, seq_len, interpret, res, g):
 _flash.defvjp(_flash_fwd, _flash_bwd)
 
 
+@functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5, 6, 7, 8))
+def _flash_lse(q, k, v, causal, scale, block_q, block_k, seq_len,
+               interpret):
+    """Like _flash but also returns the per-row logsumexp — the
+    ingredient ring attention needs to merge normalized block outputs
+    across devices (parallel/ring_attention.py)."""
+    return _fwd(
+        q, k, v, causal, scale, block_q, block_k, seq_len, interpret
+    )
+
+
+def _flash_lse_fwd(q, k, v, causal, scale, block_q, block_k, seq_len,
+                   interpret):
+    o, lse = _fwd(
+        q, k, v, causal, scale, block_q, block_k, seq_len, interpret
+    )
+    return (o, lse), (q, k, v, o, lse)
+
+
+def _flash_lse_bwd(causal, scale, block_q, block_k, seq_len, interpret,
+                   res, g):
+    g_o, g_lse = g
+    q, k, v, o, lse = res
+    return _bwd(
+        q, k, v, o, lse, g_o, causal, scale, block_q, block_k,
+        seq_len, interpret, g_lse=g_lse,
+    )
+
+
+_flash_lse.defvjp(_flash_lse_fwd, _flash_lse_bwd)
+
+
 def default_block_sizes(t: int) -> tuple:
     """Autotuned (block_q, block_k) by sequence length (measured on
     v5e, GPT-2 train step): 512 blocks beat 128 by ~2.5x at T=1024
@@ -460,7 +499,8 @@ def flash_attention(
     block_q: Optional[int] = None,
     block_k: Optional[int] = None,
     interpret: Optional[bool] = None,
-) -> jax.Array:
+    return_lse: bool = False,
+) -> "jax.Array | tuple[jax.Array, jax.Array]":
     """Flash attention on [batch, seq, heads, head_dim] inputs.
 
     Drop-in for models.gpt._default_attention. The [B,H,T,D] kernel
@@ -469,6 +509,10 @@ def flash_attention(
     multiple internally (padded keys are masked, padded query rows are
     sliced off). Runs interpreted off-TPU so tests exercise the same
     kernel on CPU.
+
+    ``return_lse=True`` also returns the per-row logsumexp [B, H, T]
+    (f32, differentiable) — used by ring attention to merge block
+    outputs across devices.
     """
     if interpret is None:
         interpret = _use_interpret()
@@ -500,6 +544,12 @@ def flash_attention(
         return x
 
     qk, kk, vk = map(to_kernel_layout, (q, k, v))
+    if return_lse:
+        o, lse = _flash_lse(
+            qk, kk, vk, causal, scale, block_q, block_k, t, interpret
+        )
+        o = o[:, :, :t].transpose(0, 2, 1, 3)
+        return o.astype(q.dtype), lse[:, :, :t, 0]
     o = _flash(qk, kk, vk, causal, scale, block_q, block_k, t, interpret)
     o = o[:, :, :t].transpose(0, 2, 1, 3)
     return o.astype(q.dtype)

@@ -111,21 +111,118 @@ def ring_attention(
     return out.astype(q.dtype)
 
 
+_NEG = -1e30  # "-inf" that keeps exp/logaddexp NaN-free
+
+
+def ring_attention_flash(
+    q: jax.Array,
+    k: jax.Array,
+    v: jax.Array,
+    axis_name: str = "seq",
+    causal: bool = False,
+    scale: Optional[float] = None,
+    interpret: Optional[bool] = None,
+) -> jax.Array:
+    """Ring attention with the Pallas flash kernel as the per-block
+    engine: each ring step runs flash attention against the resident
+    K/V block (O(lq) memory — the [lq, lk] score tile never reaches
+    HBM, unlike :func:`ring_attention`'s XLA path) and merges the
+    normalized block output via its logsumexp. This is the Ring
+    Attention construction (blockwise-parallel ring, PAPERS.md) with
+    the inner block computed by ops/flash_attention.py, including its
+    lse-cotangent backward.
+
+    Causal runs dispatch one of three per-block programs: K/V from an
+    earlier ring slot attends densely, the resident slot runs the
+    causal kernel, later slots are skipped (zero compute beyond the
+    branch). Per-device work is therefore imbalanced by ring position
+    — inherent to causal ring attention.
+    """
+    from dlrover_tpu.ops.flash_attention import flash_attention
+
+    b, lq, h, d = q.shape
+    n = jax.lax.psum(1, axis_name)
+    my_idx = jax.lax.axis_index(axis_name)
+    if scale is None:
+        scale = 1.0 / (d**0.5)
+
+    def flash_blk(q_, k_, v_, causal_):
+        o, lse = flash_attention(
+            q_, k_, v_, causal=causal_, scale=scale,
+            interpret=interpret, return_lse=True,
+        )
+        return o.astype(jnp.float32), lse
+
+    def step(carry, t):
+        k_blk, v_blk, lse_acc, o_acc = carry
+        src = (my_idx - t) % n
+        if causal:
+            idx = jnp.where(src < my_idx, 0, jnp.where(src == my_idx, 1, 2))
+            o_blk, lse_blk = jax.lax.switch(
+                idx,
+                [
+                    lambda q_, k_, v_: flash_blk(q_, k_, v_, False),
+                    lambda q_, k_, v_: flash_blk(q_, k_, v_, True),
+                    lambda q_, k_, v_: (
+                        jnp.zeros((b, lq, h, d), jnp.float32),
+                        jnp.full((b, h, lq), _NEG, jnp.float32),
+                    ),
+                ],
+                q, k_blk, v_blk,
+            )
+        else:
+            o_blk, lse_blk = flash_blk(q, k_blk, v_blk, False)
+        lse_new = jnp.logaddexp(lse_acc, lse_blk)
+        w_acc = jnp.exp(lse_acc - lse_new)
+        w_blk = jnp.exp(lse_blk - lse_new)
+        o_new = (
+            o_acc * w_acc.transpose(0, 2, 1)[..., None]
+            + o_blk * w_blk.transpose(0, 2, 1)[..., None]
+        )
+        perm = [(i, (i + 1) % n) for i in range(n)]
+        k_next = jax.lax.ppermute(k_blk, axis_name, perm)
+        v_next = jax.lax.ppermute(v_blk, axis_name, perm)
+        return (k_next, v_next, lse_new, o_new), None
+
+    lse0 = jnp.full((b, h, lq), _NEG, jnp.float32)
+    o0 = jnp.zeros((b, lq, h, d), jnp.float32)
+    (_, _, _, o_f), _ = jax.lax.scan(
+        step, (k, v, lse0, o0), jnp.arange(n)
+    )
+    return o_f.astype(q.dtype)
+
+
 def make_sharded_attention(
     mesh: Mesh,
     causal: bool = True,
     axis_name: str = "seq",
     batch_axes=("data", "fsdp"),
     head_axis: Optional[str] = "tensor",
+    impl: str = "auto",
 ):
-    """Wrap ring_attention in shard_map for the given mesh.
+    """Wrap ring attention in shard_map for the given mesh.
 
     Sequence parallelism composes with tensor parallelism: heads are
     sharded over ``tensor`` while sequence blocks ride the ``seq`` ring.
+
+    ``impl``: "flash" uses the Pallas per-block kernel
+    (ring_attention_flash), "xla" the einsum path (ring_attention),
+    "auto" picks flash on TPU.
     """
+    if impl not in ("auto", "flash", "xla"):
+        raise ValueError(f"unknown ring attention impl {impl!r}")
+    use_flash = (
+        impl == "flash"
+        or (impl == "auto" and jax.default_backend() == "tpu")
+    )
     spec = P(batch_axes, axis_name, head_axis, None)
 
     if mesh.shape.get(axis_name, 1) == 1:
+        if use_flash:
+            from dlrover_tpu.ops.flash_attention import flash_attention
+
+            return functools.partial(flash_attention, causal=causal)
+
         # No sequence sharding: plain (still jit-fused) attention.
         def plain(q, k, v):
             b, lq, h, d = q.shape
@@ -141,7 +238,9 @@ def make_sharded_attention(
         return plain
 
     fn = functools.partial(
-        ring_attention, axis_name=axis_name, causal=causal
+        ring_attention_flash if use_flash else ring_attention,
+        axis_name=axis_name,
+        causal=causal,
     )
     return shard_map(
         fn,

@@ -363,6 +363,46 @@ class TestCheckpointerStandalone:
                     shm.unlink()
 
 
+class TestOrbaxCompat:
+    def test_export_import_roundtrip(self, tmp_path):
+        pytest.importorskip("orbax.checkpoint")
+        from dlrover_tpu.trainer.flash_checkpoint import (
+            Checkpointer,
+            StorageType,
+        )
+        from dlrover_tpu.trainer.flash_checkpoint.orbax_compat import (
+            export_to_orbax,
+            import_from_orbax,
+        )
+
+        mesh = _mesh((8,), ("data",))
+        state = _state(mesh)
+        ckpt = Checkpointer(str(tmp_path / "flash"))
+        saver = ckpt._self_hosted_saver
+        orbax_dir = str(tmp_path / "orbax")
+        try:
+            assert ckpt.save_checkpoint(
+                7, state, storage_type=StorageType.DISK
+            )
+            assert ckpt.wait_latest_checkpoint(timeout=20)
+            like = jax.tree.map(
+                lambda x: jax.ShapeDtypeStruct(x.shape, x.dtype),
+                state,
+            )
+            step = export_to_orbax(ckpt, orbax_dir, like)
+            assert step == 7
+            got_step, restored = import_from_orbax(orbax_dir)
+            assert got_step == 7
+            np.testing.assert_array_equal(
+                np.asarray(restored["w"]), np.asarray(state["w"])
+            )
+        finally:
+            ckpt.close()
+            if saver is not None:
+                for shm in saver._shms:
+                    shm.unlink()
+
+
 class TestAdviceFixes:
     def test_flush_adopts_staged_dir(self, tmp_path):
         """A memory-only staged checkpoint flushed by the agent before a
