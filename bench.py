@@ -66,14 +66,17 @@ def main() -> int:
     # (0.403 vs 0.362 MFU measured on v5e).
     import dataclasses
 
-    # Measured on v5e: full remat + fused xent + batch 16 is the best
-    # of {remat x batch x fused-xent x flash-attn} (0.289 MFU; pure
-    # bf16 matmul ceiling on this chip measures 153 TF/s = 0.78 of
-    # nominal peak, so the step runs at ~43% of achievable).
+    # Measured on v5e (docs/ROOFLINE.md): full remat + flash
+    # (block_q 512, block_k 1024 — the kernel defaults) + fused xent
+    # with saved logits + batch 16 is the best of
+    # {remat x batch x block sizes x save-logits}; the pure bf16
+    # matmul ceiling on this chip measures 153 TF/s = 0.78 of nominal
+    # peak, which bounds any MFU quoted against nominal.
     cfg = dataclasses.replace(
         gpt.GPTConfig.gpt2(),
         remat=os.getenv("BENCH_REMAT", "1") == "1",
     )
+    save_logits = os.getenv("BENCH_SAVE_LOGITS", "1") == "1"
 
     batch_per_chip = int(os.getenv("BENCH_BATCH_PER_CHIP", "16"))
     batch = batch_per_chip * n_chips
@@ -81,7 +84,9 @@ def main() -> int:
     warmup = 3
 
     optimizer = optax.adamw(3e-4, weight_decay=0.1)
-    loss = functools.partial(gpt.loss_fn_fused, cfg=cfg)
+    loss = functools.partial(
+        gpt.loss_fn_fused, cfg=cfg, save_logits=save_logits
+    )
     init, _ = make_sharded_init(
         mesh,
         functools.partial(gpt.init_params, cfg=cfg),

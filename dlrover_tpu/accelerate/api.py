@@ -41,6 +41,13 @@ from dlrover_tpu.trainer.step import (
 logger = get_logger("accelerate")
 
 
+def make_optimizer(name: str, learning_rate: float):
+    """Public optimizer factory: Strategy.optimizer name -> optax
+    transformation (also used by example/tooling scripts that must
+    rebuild a checkpoint's optimizer-state structure)."""
+    return _make_optimizer(name, learning_rate)
+
+
 def _make_optimizer(name: str, learning_rate: float):
     if name == "adamw":
         return optax.adamw(learning_rate)

@@ -1,0 +1,324 @@
+"""Autoregressive decoding with a KV cache (GPT + Llama).
+
+Counterpart of the reference's sampling paths — nanoGPT's
+``model.generate`` loop in the example the framework demos train
+(/root/reference/examples/pytorch/nanogpt/train.py builds the same
+GPT this repo's models/gpt.py implements) and the HF ``generate`` its
+Llama examples inherit — built the XLA way:
+
+* static shapes end to end: the cache is a preallocated
+  [layers, batch, max_len, heads, head_dim] pytree, positions write
+  via ``lax.dynamic_update_slice``; one compile regardless of prompt
+  or output length;
+* the whole decode loop is a single ``lax.scan`` (no per-token Python
+  dispatch), layers run under the same stacked-params scan as
+  training;
+* sampling: greedy, temperature, and top-k via ``jax.random``.
+
+The per-token block math intentionally reuses each model's weights
+layout but re-derives the single-position forward (rope at one
+position, attention against the cache) — training forwards stay
+scan-over-sequence and never pay cache plumbing.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import functools
+from typing import Any, Callable, Dict, NamedTuple, Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from dlrover_tpu.models import gpt as gpt_mod
+from dlrover_tpu.models import llama as llama_mod
+
+
+class KVCache(NamedTuple):
+    k: jax.Array  # [L, B, T_max, H_kv, D]
+    v: jax.Array
+
+
+def _cache_for(cfg, batch: int, max_len: int, n_kv: int) -> KVCache:
+    shape = (cfg.n_layer, batch, max_len, n_kv, cfg.head_dim)
+    return KVCache(
+        k=jnp.zeros(shape, cfg.dtype), v=jnp.zeros(shape, cfg.dtype)
+    )
+
+
+def _cached_attention(q, k_cache, v_cache, pos):
+    """q [B,1,H,D] against cache [B,T,H,D]; positions > pos masked."""
+    b, t, h, d = k_cache.shape
+    s = jnp.einsum(
+        "bqhd,bkhd->bhqk", q, k_cache,
+        preferred_element_type=jnp.float32,
+    ) / np.sqrt(d)
+    mask = jnp.arange(t)[None, None, None, :] <= pos
+    s = jnp.where(mask, s, -1e30)
+    p = jax.nn.softmax(s, axis=-1).astype(v_cache.dtype)
+    return jnp.einsum("bhqk,bkhd->bqhd", p, v_cache)
+
+
+# ---------------------------------------------------------------------------
+# Per-model single-token steps
+# ---------------------------------------------------------------------------
+
+
+def gpt_decode_step(params, cache: KVCache, token, pos, cfg):
+    """One token through GPT with cache. token [B] int32, pos scalar.
+    Returns (logits [B, vocab] f32, new cache)."""
+    B = token.shape[0]
+    H, D, E = cfg.n_head, cfg.head_dim, cfg.n_embd
+    wpe = jax.lax.dynamic_slice_in_dim(params["wpe"], pos, 1, 0)
+    x = params["wte"][token][:, None, :] + wpe[None]
+    x = x.astype(cfg.dtype)  # [B,1,E]
+
+    def body(x, layer):
+        lp, k_c, v_c = layer
+        h = gpt_mod._layer_norm(x, lp["ln1_g"], lp["ln1_b"])
+        qkv = h @ lp["wqkv"]
+        q, k, v = jnp.split(qkv, 3, axis=-1)
+        q = q.reshape(B, 1, H, D)
+        k_c = jax.lax.dynamic_update_slice(
+            k_c, k.reshape(B, 1, H, D), (0, pos, 0, 0)
+        )
+        v_c = jax.lax.dynamic_update_slice(
+            v_c, v.reshape(B, 1, H, D), (0, pos, 0, 0)
+        )
+        att = _cached_attention(q, k_c, v_c, pos).reshape(B, 1, E)
+        x = x + att @ lp["wo"]
+        h = gpt_mod._layer_norm(x, lp["ln2_g"], lp["ln2_b"])
+        h = jax.nn.gelu(h @ lp["wi"] + lp["bi"])
+        x = x + h @ lp["wo2"] + lp["bo2"]
+        return x, (k_c, v_c)
+
+    x, (k_new, v_new) = jax.lax.scan(
+        body, x, (params["blocks"], cache.k, cache.v)
+    )
+    x = gpt_mod._layer_norm(x, params["lnf_g"], params["lnf_b"])
+    logits = jnp.einsum(
+        "boe,ve->bov", x, params["wte"],
+        preferred_element_type=jnp.float32,
+    )[:, 0]
+    return logits, KVCache(k=k_new, v=v_new)
+
+
+def gpt_prefill(params, cache: KVCache, tokens, cfg):
+    """Batched prompt pass: one forward over [B, T0] fills cache
+    positions 0..T0 and returns the last position's logits — the
+    time-to-first-token path (vs T0 sequential decode steps)."""
+    B, T0 = tokens.shape
+    H, D, E = cfg.n_head, cfg.head_dim, cfg.n_embd
+    x = params["wte"][tokens] + params["wpe"][:T0][None]
+    x = x.astype(cfg.dtype)
+
+    def body(x, layer):
+        lp, k_c, v_c = layer
+        h = gpt_mod._layer_norm(x, lp["ln1_g"], lp["ln1_b"])
+        qkv = h @ lp["wqkv"]
+        q, k, v = jnp.split(qkv, 3, axis=-1)
+        q = q.reshape(B, T0, H, D)
+        k = k.reshape(B, T0, H, D)
+        v = v.reshape(B, T0, H, D)
+        k_c = jax.lax.dynamic_update_slice(k_c, k, (0, 0, 0, 0))
+        v_c = jax.lax.dynamic_update_slice(v_c, v, (0, 0, 0, 0))
+        att = gpt_mod._default_attention(
+            q, k, v, causal=True
+        ).reshape(B, T0, E)
+        x = x + att @ lp["wo"]
+        h = gpt_mod._layer_norm(x, lp["ln2_g"], lp["ln2_b"])
+        h = jax.nn.gelu(h @ lp["wi"] + lp["bi"])
+        x = x + h @ lp["wo2"] + lp["bo2"]
+        return x, (k_c, v_c)
+
+    x, (k_new, v_new) = jax.lax.scan(
+        body, x, (params["blocks"], cache.k, cache.v)
+    )
+    x = gpt_mod._layer_norm(
+        x[:, -1:], params["lnf_g"], params["lnf_b"]
+    )
+    logits = jnp.einsum(
+        "boe,ve->bov", x, params["wte"],
+        preferred_element_type=jnp.float32,
+    )[:, 0]
+    return logits, KVCache(k=k_new, v=v_new)
+
+
+def llama_prefill(params, cache: KVCache, tokens, cfg, rope=None):
+    B, T0 = tokens.shape
+    H, Hkv, D, E = cfg.n_head, cfg.n_kv_head, cfg.head_dim, cfg.n_embd
+    cos_t, sin_t = rope if rope is not None else llama_mod.rope_table(
+        cfg, cfg.block_size
+    )
+    cos, sin = cos_t[:T0], sin_t[:T0]
+    x = params["wte"][tokens].astype(cfg.dtype)
+
+    def body(x, layer):
+        lp, k_c, v_c = layer
+        h = llama_mod._rms_norm(x, lp["rms1"], cfg.rms_eps)
+        q = llama_mod.apply_rope(
+            (h @ lp["wq"]).reshape(B, T0, H, D), cos, sin
+        )
+        k = llama_mod.apply_rope(
+            (h @ lp["wk"]).reshape(B, T0, Hkv, D), cos, sin
+        )
+        v = (h @ lp["wv"]).reshape(B, T0, Hkv, D)
+        k_c = jax.lax.dynamic_update_slice(k_c, k, (0, 0, 0, 0))
+        v_c = jax.lax.dynamic_update_slice(v_c, v, (0, 0, 0, 0))
+        if Hkv != H:
+            k = jnp.repeat(k, cfg.q_per_kv, axis=2)
+            v = jnp.repeat(v, cfg.q_per_kv, axis=2)
+        att = gpt_mod._default_attention(
+            q, k, v, causal=True
+        ).reshape(B, T0, E)
+        x = x + att @ lp["wo"]
+        h = llama_mod._rms_norm(x, lp["rms2"], cfg.rms_eps)
+        gated = jax.nn.silu(h @ lp["w_gate"]) * (h @ lp["w_up"])
+        return x + gated @ lp["w_down"], (k_c, v_c)
+
+    x, (k_new, v_new) = jax.lax.scan(
+        body, x, (params["blocks"], cache.k, cache.v)
+    )
+    x = llama_mod._rms_norm(x[:, -1:], params["rmsf"], cfg.rms_eps)
+    logits = jnp.einsum(
+        "boe,ve->bov", x, params["lm_head"],
+        preferred_element_type=jnp.float32,
+    )[:, 0]
+    return logits, KVCache(k=k_new, v=v_new)
+
+
+def llama_decode_step(params, cache: KVCache, token, pos, cfg,
+                      rope=None):
+    B = token.shape[0]
+    H, Hkv, D, E = cfg.n_head, cfg.n_kv_head, cfg.head_dim, cfg.n_embd
+    x = params["wte"][token][:, None, :].astype(cfg.dtype)  # [B,1,E]
+    cos_t, sin_t = rope if rope is not None else llama_mod.rope_table(
+        cfg, cfg.block_size
+    )
+    cos = jax.lax.dynamic_slice_in_dim(cos_t, pos, 1, 0)
+    sin = jax.lax.dynamic_slice_in_dim(sin_t, pos, 1, 0)
+
+    def body(x, layer):
+        lp, k_c, v_c = layer
+        h = llama_mod._rms_norm(x, lp["rms1"], cfg.rms_eps)
+        q = llama_mod.apply_rope(
+            (h @ lp["wq"]).reshape(B, 1, H, D), cos, sin
+        )
+        k = llama_mod.apply_rope(
+            (h @ lp["wk"]).reshape(B, 1, Hkv, D), cos, sin
+        )
+        v = (h @ lp["wv"]).reshape(B, 1, Hkv, D)
+        k_c = jax.lax.dynamic_update_slice(k_c, k, (0, pos, 0, 0))
+        v_c = jax.lax.dynamic_update_slice(v_c, v, (0, pos, 0, 0))
+        if Hkv != H:
+            k_full = jnp.repeat(k_c, cfg.q_per_kv, axis=2)
+            v_full = jnp.repeat(v_c, cfg.q_per_kv, axis=2)
+        else:
+            k_full, v_full = k_c, v_c
+        att = _cached_attention(q, k_full, v_full, pos).reshape(B, 1, E)
+        x = x + att @ lp["wo"]
+        h = llama_mod._rms_norm(x, lp["rms2"], cfg.rms_eps)
+        gated = jax.nn.silu(h @ lp["w_gate"]) * (h @ lp["w_up"])
+        return x + gated @ lp["w_down"], (k_c, v_c)
+
+    x, (k_new, v_new) = jax.lax.scan(
+        body, x, (params["blocks"], cache.k, cache.v)
+    )
+    x = llama_mod._rms_norm(x, params["rmsf"], cfg.rms_eps)
+    logits = jnp.einsum(
+        "boe,ve->bov", x, params["lm_head"],
+        preferred_element_type=jnp.float32,
+    )[:, 0]
+    return logits, KVCache(k=k_new, v=v_new)
+
+
+def _fns_for(cfg) -> tuple:
+    """(prefill_fn, step_fn) with model-specific constants (rope
+    tables) precomputed once, outside any scan."""
+    if isinstance(cfg, llama_mod.LlamaConfig):
+        rope = llama_mod.rope_table(cfg, cfg.block_size)
+        return (
+            functools.partial(llama_prefill, rope=rope),
+            functools.partial(llama_decode_step, rope=rope),
+        )
+    if isinstance(cfg, gpt_mod.GPTConfig):
+        return gpt_prefill, gpt_decode_step
+    raise TypeError(f"unsupported config type {type(cfg).__name__}")
+
+
+def _kv_heads(cfg) -> int:
+    return getattr(cfg, "n_kv_head", cfg.n_head)
+
+
+# ---------------------------------------------------------------------------
+# Generation loop
+# ---------------------------------------------------------------------------
+
+
+def generate(
+    params: Dict[str, Any],
+    cfg,
+    prompt: jax.Array,  # [B, T_prompt] int32
+    max_new_tokens: int,
+    temperature: float = 1.0,
+    top_k: Optional[int] = None,
+    key: Optional[jax.Array] = None,
+) -> jax.Array:
+    """Sample ``max_new_tokens`` continuations. Greedy when
+    ``temperature == 0``. Returns [B, T_prompt + max_new_tokens].
+
+    The prompt fills the cache in ONE batched forward (prefill); the
+    decode loop is one ``lax.scan`` over positions; jit-compatible
+    (wrap in jax.jit with static max_new_tokens for repeated use).
+    """
+    prefill_fn, step_fn = _fns_for(cfg)
+    b, t_prompt = prompt.shape
+    total = t_prompt + max_new_tokens
+    if total > cfg.block_size:
+        raise ValueError(
+            f"prompt+new = {total} exceeds block_size {cfg.block_size}"
+        )
+    if key is None:
+        key = jax.random.PRNGKey(0)
+    cache = _cache_for(cfg, b, total, _kv_heads(cfg))
+    logits, cache = prefill_fn(params, cache, prompt, cfg)
+
+    def sample(logits, k):
+        if temperature == 0.0:
+            return jnp.argmax(logits, axis=-1).astype(jnp.int32)
+        logits = logits / temperature
+        if top_k is not None:
+            kth = jnp.sort(logits, axis=-1)[:, -top_k][:, None]
+            logits = jnp.where(logits < kth, -1e30, logits)
+        return jax.random.categorical(k, logits).astype(jnp.int32)
+
+    def decode_body(carry, i):
+        cache, logits, key = carry
+        key, sub = jax.random.split(key)
+        tok = sample(logits, sub)
+        new_logits, cache = step_fn(
+            params, cache, tok, t_prompt + i, cfg
+        )
+        return (cache, new_logits, key), tok
+
+    (_, _, _), toks = jax.lax.scan(
+        decode_body, (cache, logits, key), jnp.arange(max_new_tokens)
+    )
+    return jnp.concatenate([prompt, toks.T], axis=1)
+
+
+def decode_logits_sequential(params, cfg, tokens: jax.Array):
+    """Teacher-forcing consistency helper (used by tests): run the
+    cached decode step over ``tokens`` [B, T] and return the logits at
+    every position [B, T, vocab] — must match the training forward."""
+    _, step_fn = _fns_for(cfg)
+    b, t = tokens.shape
+    cache = _cache_for(cfg, b, t, _kv_heads(cfg))
+
+    def body(cache, i):
+        logits, cache = step_fn(params, cache, tokens[:, i], i, cfg)
+        return cache, logits
+
+    _, logits = jax.lax.scan(body, cache, jnp.arange(t))
+    return jnp.swapaxes(logits, 0, 1)
