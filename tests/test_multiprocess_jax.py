@@ -1,0 +1,81 @@
+"""Real multi-process jax.distributed world over the agent env seam.
+
+SURVEY §4: "use CPU jax.distributed multi-process tests for
+collectives". Two actual OS processes bootstrap through
+trainer/jax_env.py exactly as agent-launched trainers do (coordinator
+address + process id/count from NodeEnv), then run a cross-process
+collective over the global device set — the same path a multi-host
+TPU pod takes over DCN.
+"""
+
+import os
+import socket
+import subprocess
+import sys
+
+_WORKER = r"""
+import os, sys
+os.environ["JAX_PLATFORMS"] = "cpu"
+os.environ["XLA_FLAGS"] = "--xla_force_host_platform_device_count=2"
+from dlrover_tpu.trainer import jax_env
+jax_env.setup_distributed()
+import jax
+import jax.numpy as jnp
+import numpy as np
+assert jax.process_count() == 2, jax.process_count()
+assert len(jax.devices()) == 4, len(jax.devices())  # 2 local x 2 procs
+from jax.experimental import multihost_utils
+mine = np.array([jax.process_index() + 1.0], np.float32)
+world = multihost_utils.process_allgather(mine)
+np.testing.assert_array_equal(world.ravel(), [1.0, 2.0])
+
+# A sharded computation over the GLOBAL mesh: psum of per-device ones.
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+mesh = Mesh(np.array(jax.devices()).reshape(4), ("data",))
+sharding = NamedSharding(mesh, P("data"))
+local = jnp.ones((2,), jnp.float32) * (jax.process_index() + 1)
+garr = jax.make_array_from_process_local_data(sharding, np.asarray(local), (4,))
+total = jax.jit(
+    lambda x: jnp.sum(x), in_shardings=sharding, out_shardings=NamedSharding(mesh, P())
+)(garr)
+# procs contribute [1,1] and [2,2] -> global sum 6
+assert float(total) == 6.0, float(total)
+jax_env.teardown_distributed()
+print("WORKER_OK", jax.process_index())
+"""
+
+
+def test_two_process_world_collective(tmp_path):
+    port = _free_port()
+    env_base = {
+        **os.environ,
+        "DLROVER_TPU_COORDINATOR_ADDR": f"127.0.0.1:{port}",
+        "DLROVER_TPU_NUM_PROCESSES": "2",
+    }
+    env_base.pop("JAX_PLATFORMS", None)
+    procs = []
+    for pid in range(2):
+        env = {**env_base, "DLROVER_TPU_PROCESS_ID": str(pid)}
+        procs.append(
+            subprocess.Popen(
+                [sys.executable, "-c", _WORKER],
+                env=env,
+                stdout=subprocess.PIPE,
+                stderr=subprocess.STDOUT,
+                text=True,
+                cwd=os.path.dirname(os.path.dirname(__file__)),
+            )
+        )
+    outs = []
+    for p in procs:
+        out, _ = p.communicate(timeout=150)
+        outs.append(out)
+    for pid, (p, out) in enumerate(zip(procs, outs)):
+        assert p.returncode == 0, f"proc {pid} failed:\n{out}"
+        assert f"WORKER_OK {pid}" in out
+
+
+def _free_port() -> int:
+    with socket.socket() as s:
+        s.bind(("127.0.0.1", 0))
+        return s.getsockname()[1]
