@@ -1,0 +1,155 @@
+"""Compressed gradient synchronization over a mesh axis.
+
+Reference counterparts: the quantized-reduction CUDA kernels
+(atorch/ops/csrc/quantization/quant_reduce.cu,
+swizzled_quantize.cu) and ADP's gradient-compression DDP hooks
+(atorch/data_parallel/adp.py). On TPU the equivalent lever is the
+*collective schedule*, not a custom allreduce: an allreduce is a
+reduce-scatter (which must stay high-precision — it sums) followed by
+an all-gather (which is pure broadcast and compresses safely). This
+module implements
+
+    psum_mean = psum_scatter(bf16/f32)  ->  quantize shard
+                -> all_gather(int8 + per-block scales) -> dequantize
+
+cutting the all-gather phase to ~1/2 (int8 vs bf16) or ~1/4 (packed
+int4) of the bytes — worth it exactly where the data axis crosses DCN
+(multi-slice outer axis, parallel/mesh.py), which is also where the
+reference deployed gradient compression.
+
+Opt-in via ``make_compressed_train_step`` for the replicated-params
+data-parallel regime; per-leaf quantization error is bounded by the
+per-block absmax / 127 (or /7 at 4 bits), and tests bound the
+end-to-end gradient deviation.
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Callable
+
+import jax
+import jax.numpy as jnp
+import optax
+from jax import shard_map
+from jax.sharding import Mesh, PartitionSpec as P
+
+from dlrover_tpu.ops.quantization import (
+    dequantize_blockwise_4bit_ref,
+    dequantize_blockwise_ref,
+    quantize_blockwise_4bit_ref,
+    quantize_blockwise_ref,
+)
+
+# Below this many elements the collective is latency-bound and
+# padding to n*block would inflate tiny leaves (biases, norms) by
+# orders of magnitude — plain pmean wins.
+DEFAULT_MIN_SIZE = 16384
+
+
+def compressed_psum_mean(
+    x: jax.Array,
+    axis_name: str,
+    bits: int = 8,
+    block: int = 1024,
+    min_size: int = DEFAULT_MIN_SIZE,
+) -> jax.Array:
+    """Mean of ``x`` over ``axis_name`` with an int-quantized
+    all-gather phase (packed two-per-byte at 4 bits — the
+    ops/quantization.py wire format). Must run inside shard_map;
+    returns the mean replicated across the axis (like ``lax.pmean``).
+    Leaves smaller than ``min_size`` fall back to plain pmean.
+    """
+    if bits not in (4, 8):
+        raise ValueError("bits must be 4 or 8")
+    if x.size < min_size:
+        return jax.lax.pmean(x, axis_name)
+    n = jax.lax.psum(1, axis_name)
+    shape, dtype = x.shape, x.dtype
+    flat = x.reshape(-1)  # keep input dtype: RS bytes match baseline
+    size = flat.size
+    pad = (-size) % (n * block)
+    if pad:
+        flat = jnp.pad(flat, (0, pad))
+    chunk = flat.size // n
+    # Phase 1: reduce-scatter in the gradient dtype (sums must not
+    # quantize; same precision/bytes as the baseline psum's RS phase).
+    shard = jax.lax.psum_scatter(
+        flat.reshape(n, chunk), axis_name, scatter_dimension=0,
+        tiled=False,
+    )  # [chunk], this device's reduced shard
+    # Phase 2: quantize the reduced shard, broadcast cheaply.
+    shard32 = shard.astype(jnp.float32)
+    if bits == 4:
+        q, scale, _ = quantize_blockwise_4bit_ref(shard32, block)
+    else:
+        q, scale, _ = quantize_blockwise_ref(shard32, block)
+    q_all = jax.lax.all_gather(q, axis_name)  # [n, rows, wire-width]
+    s_all = jax.lax.all_gather(scale, axis_name)
+    rows = q_all.shape[0] * q_all.shape[1]
+    q2 = q_all.reshape(rows, q_all.shape[2])
+    s2 = s_all.reshape(rows, 1)
+    if bits == 4:
+        full = dequantize_blockwise_4bit_ref(q2, s2, (rows * block,))
+    else:
+        full = dequantize_blockwise_ref(q2, s2, (rows * block,))
+    out = full.reshape(-1)[:size].reshape(shape) / n
+    return out.astype(dtype)
+
+
+def make_compressed_train_step(
+    mesh: Mesh,
+    loss_fn: Callable,
+    optimizer,
+    axis_name: str = "data",
+    bits: int = 8,
+    block: int = 1024,
+    min_size: int = DEFAULT_MIN_SIZE,
+    donate: bool = True,
+):
+    """Data-parallel train step whose gradient sync all-gathers
+    quantized shards (replicated-params regime: every leaf is
+    replicated over ``axis_name``, the batch is sharded over it).
+
+    Drop-in for trainer.step.make_train_step on a pure-data mesh;
+    compose the optimizer OUTSIDE the sync so its state stays exact.
+    """
+    batch_spec = P(axis_name)
+    rep = P()
+
+    def sharded_grads(params, tokens, targets):
+        loss, grads = jax.value_and_grad(loss_fn)(
+            params, tokens, targets
+        )
+        sync = functools.partial(
+            compressed_psum_mean, axis_name=axis_name, bits=bits,
+            block=block, min_size=min_size,
+        )
+        grads = jax.tree.map(sync, grads)
+        loss = jax.lax.pmean(loss, axis_name)
+        return loss, grads
+
+    grads_fn = shard_map(
+        sharded_grads,
+        mesh=mesh,
+        in_specs=(rep, batch_spec, batch_spec),
+        out_specs=(rep, rep),
+        check_vma=False,
+    )
+
+    def step(params, opt_state, tokens, targets):
+        loss, grads = grads_fn(params, tokens, targets)
+        updates, opt_state = optimizer.update(grads, opt_state, params)
+        params = optax.apply_updates(params, updates)
+        return params, opt_state, {"loss": loss}
+
+    donate_argnums = (0, 1) if donate else ()
+    return jax.jit(step, donate_argnums=donate_argnums)
+
+
+def sync_bytes_per_element(bits: int) -> float:
+    """Bytes moved per gradient element for a bf16 gradient sync —
+    used by tests and capacity planning. Baseline allreduce = RS + AG
+    at 2 B/el each = 4 B/el. Compressed: RS stays bf16 (2 B/el), AG
+    drops to bits/8 B/el (+ per-block scales, amortized to ~0)."""
+    return 2.0 + bits / 8.0

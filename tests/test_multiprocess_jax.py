@@ -67,9 +67,15 @@ def test_two_process_world_collective(tmp_path):
             )
         )
     outs = []
-    for p in procs:
-        out, _ = p.communicate(timeout=150)
-        outs.append(out)
+    try:
+        for p in procs:
+            out, _ = p.communicate(timeout=150)
+            outs.append(out)
+    finally:
+        for p in procs:  # never orphan workers on timeout/failure
+            if p.poll() is None:
+                p.kill()
+                p.communicate()
     for pid, (p, out) in enumerate(zip(procs, outs)):
         assert p.returncode == 0, f"proc {pid} failed:\n{out}"
         assert f"WORKER_OK {pid}" in out
