@@ -34,6 +34,13 @@ import optax
 from jax import shard_map
 from jax.sharding import Mesh, PartitionSpec as P
 
+# Deliberately the jnp (_ref) quantizers, NOT the Pallas kernels:
+# inside shard_map XLA fuses these elementwise ops straight into the
+# collective schedule (quantize overlaps the reduce-scatter epilogue),
+# whereas a pallas_call is an opaque boundary XLA cannot fuse or
+# overlap through. Wire format (int8 / packed-nibble uint8 + f32
+# per-block scales) is identical to the kernel path by construction —
+# test_int4_wire_format_is_packed pins that.
 from dlrover_tpu.ops.quantization import (
     dequantize_blockwise_4bit_ref,
     dequantize_blockwise_ref,

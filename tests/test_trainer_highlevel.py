@@ -86,6 +86,53 @@ def test_trainer_end_to_end(tmp_path, monkeypatch):
     assert out2["final_step"] == 8
 
 
+def test_trainer_with_llama_family(tmp_path, monkeypatch):
+    """The high-level Trainer is model-agnostic: drive it with the
+    Llama family (RoPE/GQA/SwiGLU) end to end, including a save."""
+    from dlrover_tpu.models import llama
+
+    lcfg = llama.LlamaConfig.tiny()
+
+    class LlamaData:
+        def __init__(self, n=128, seed=1):
+            rng = np.random.default_rng(seed)
+            self.data = rng.integers(
+                0, lcfg.vocab_size, size=(n, lcfg.block_size + 1)
+            ).astype(np.int32)
+
+        def __len__(self):
+            return len(self.data)
+
+        def __getitem__(self, i):
+            return self.data[i, :-1], self.data[i, 1:]
+
+    monkeypatch.setenv(
+        "DLROVER_TPU_METRICS_FILE", str(tmp_path / "m.json")
+    )
+    args = TrainingArguments(
+        max_steps=4,
+        global_batch_size=8,
+        micro_batch_size=4,
+        checkpoint_dir=str(tmp_path / "ckpt_llama"),
+        save_steps=4,
+        strategy=Strategy(
+            mesh_shape=(("data", 2), ("fsdp", 2), ("tensor", 2)),
+            dtype="float32",
+            micro_batch_size=4,
+        ),
+    )
+    t = Trainer(
+        functools.partial(llama.init_params, cfg=lcfg),
+        functools.partial(llama.loss_fn, cfg=lcfg),
+        llama.param_logical_axes(lcfg),
+        LlamaData(),
+        args,
+    )
+    out = t.train()
+    assert out["final_step"] == 4
+    assert np.isfinite(out["final_loss"])
+
+
 def test_hang_detector_startup_grace_and_progress(tmp_path):
     path = str(tmp_path / "m.json")
     det = HangDetector(

@@ -1,0 +1,150 @@
+"""Trace the bench train step on the real chip and print a per-op
+time attribution.
+
+Usage: python tools/profile_step.py [spec]   (spec as in perf_sweep)
+
+Captures a jax.profiler trace of a few steps, then parses the
+trace.json.gz xplane export and aggregates device-lane event
+durations by op name — the flat profile the reference gets from
+AProfiler's module hooks, here straight from XLA's own timeline.
+"""
+
+from __future__ import annotations
+
+import collections
+import glob
+import gzip
+import json
+import sys
+import tempfile
+
+import _repo_path  # noqa: F401
+
+
+def capture(spec: str, trace_dir: str) -> None:
+    import dataclasses
+    import functools
+
+    import jax
+    import jax.numpy as jnp
+    import optax
+
+    from dlrover_tpu.models import gpt
+    from dlrover_tpu.parallel.mesh import MeshConfig, build_mesh
+    from dlrover_tpu.trainer.step import (
+        make_sharded_init,
+        make_train_step,
+        shard_batch,
+    )
+
+    # Same spec grammar as tools/perf_sweep.py:
+    #   remat,flash,batch[,block_q,block_k[,sl]]
+    parts = spec.split(",")
+    remat = {
+        "full": True, "attn": "attention", "none": False,
+        "dots": "dots", "offload": "offload",
+    }[parts[0]]
+    flash_s = parts[1] if len(parts) > 1 else "flash"
+    batch = int(parts[2]) if len(parts) > 2 else 16
+    block_q = int(parts[3]) if len(parts) > 3 else None
+    block_k = int(parts[4]) if len(parts) > 4 else None
+    save_logits = len(parts) > 5 and parts[5] == "sl"
+    cfg = dataclasses.replace(
+        gpt.GPTConfig.gpt2(), remat=remat,
+        use_flash_attention=(flash_s == "flash"),
+    )
+    attn_fn = None
+    if flash_s == "noop":
+        attn_fn = lambda q, k, v: v  # noqa: E731
+    elif flash_s == "flash" and (block_q or block_k):
+        from dlrover_tpu.ops.flash_attention import flash_attention
+
+        attn_fn = functools.partial(
+            flash_attention, causal=True, block_q=block_q,
+            block_k=block_k,
+        )
+    mesh = build_mesh(MeshConfig(data=len(jax.devices())))
+    optimizer = optax.adamw(3e-4, weight_decay=0.1)
+    loss = functools.partial(
+        gpt.loss_fn_fused, cfg=cfg, attn_fn=attn_fn,
+        save_logits=save_logits,
+    )
+    init, _ = make_sharded_init(
+        mesh,
+        functools.partial(gpt.init_params, cfg=cfg),
+        gpt.param_logical_axes(cfg),
+        optimizer,
+    )
+    params, opt_state = init(jax.random.PRNGKey(0))
+    step = make_train_step(mesh, loss, optimizer)
+    tokens = jax.random.randint(
+        jax.random.PRNGKey(1), (batch, cfg.block_size), 0,
+        cfg.vocab_size,
+    )
+    targets = jnp.roll(tokens, -1, axis=1)
+    tokens, targets = shard_batch(mesh, tokens, targets)
+    for _ in range(3):  # compile + warm
+        params, opt_state, m = step(params, opt_state, tokens, targets)
+    float(m["loss"])
+    with jax.profiler.trace(trace_dir):
+        for _ in range(3):
+            params, opt_state, m = step(
+                params, opt_state, tokens, targets
+            )
+        float(m["loss"])
+
+
+def report(trace_dir: str, top: int = 25) -> None:
+    paths = glob.glob(
+        f"{trace_dir}/**/*.trace.json.gz", recursive=True
+    )
+    if not paths:
+        print("no trace.json.gz produced", file=sys.stderr)
+        return
+    with gzip.open(sorted(paths)[-1], "rt") as f:
+        trace = json.load(f)
+    events = trace.get("traceEvents", [])
+    # device lanes: pid whose process_name mentions TPU/device
+    name_by_pid = {}
+    for e in events:
+        if e.get("ph") == "M" and e.get("name") == "process_name":
+            name_by_pid[e["pid"]] = e["args"].get("name", "")
+    device_pids = {
+        pid for pid, n in name_by_pid.items()
+        if "TPU" in n or "/device" in n.lower() or "XLA" in n
+    }
+    per_op = collections.Counter()
+    total = 0.0
+    for e in events:
+        if e.get("ph") != "X" or e.get("pid") not in device_pids:
+            continue
+        dur = float(e.get("dur", 0.0))
+        name = e.get("name", "?")
+        per_op[name] += dur
+        total += dur
+    if not per_op:
+        print(
+            f"lanes seen: {sorted(set(name_by_pid.values()))[:10]}",
+            file=sys.stderr,
+        )
+        print("no device events matched", file=sys.stderr)
+        return
+    print(f"# total device time {total / 1e3:.2f} ms (3 steps)")
+    for name, dur in per_op.most_common(top):
+        print(
+            f"{dur / total * 100:6.2f}%  {dur / 1e3 / 3:8.3f} ms/step"
+            f"  {name[:90]}"
+        )
+
+
+def main() -> int:
+    spec = sys.argv[1] if len(sys.argv) > 1 else "full,flash,16"
+    trace_dir = tempfile.mkdtemp(prefix="dlrover_tpu_trace_")
+    capture(spec, trace_dir)
+    report(trace_dir)
+    print(f"# trace dir: {trace_dir}", file=sys.stderr)
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())
