@@ -112,13 +112,19 @@ def wire_block(inner_block: Callable, policy: Any,
     callable (not the block), and all other checkpointing policies
     need the block's output residual name-tagged INSIDE the
     checkpointed region so the "offload" policy can stream it to host
-    RAM."""
+    RAM. The block may return either the carried activation alone or
+    an ``(x, aux)`` tuple (MoE blocks carry a router loss); only the
+    activation is name-tagged."""
     if canonical(policy) == "attention":
         _, wrapped_attn = apply_block_remat(None, "attention", attn_fn)
         return lambda x, lp: inner_block(x, lp, wrapped_attn)
 
     def named_block(x, lp):
-        return tag_block_output(inner_block(x, lp, attn_fn))
+        out = inner_block(x, lp, attn_fn)
+        if isinstance(out, tuple):
+            y, aux = out
+            return tag_block_output(y), aux
+        return tag_block_output(out)
 
     block, _ = apply_block_remat(named_block, policy, attn_fn)
     return block

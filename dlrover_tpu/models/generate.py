@@ -104,6 +104,14 @@ def gpt_decode_step(params, cache: KVCache, token, pos, cfg):
     return logits, KVCache(k=k_new, v=v_new)
 
 
+def _llama_mlp(x, h, lp, cfg):
+    """Decode-path wrapper over the training block's MLP tail
+    (llama.mlp_tail — single definition); the aux loss is irrelevant
+    at inference and dropped."""
+    y, _ = llama_mod.mlp_tail(x, h, lp, cfg)
+    return y
+
+
 def gpt_prefill(params, cache: KVCache, tokens, cfg):
     """Batched prompt pass: one forward over [B, T0] fills cache
     positions 0..T0 and returns the last position's logits — the
@@ -174,17 +182,13 @@ def llama_prefill(params, cache: KVCache, tokens, cfg, rope=None):
         ).reshape(B, T0, E)
         x = x + att @ lp["wo"]
         h = llama_mod._rms_norm(x, lp["rms2"], cfg.rms_eps)
-        gated = jax.nn.silu(h @ lp["w_gate"]) * (h @ lp["w_up"])
-        return x + gated @ lp["w_down"], (k_c, v_c)
+        return _llama_mlp(x, h, lp, cfg), (k_c, v_c)
 
     x, (k_new, v_new) = jax.lax.scan(
         body, x, (params["blocks"], cache.k, cache.v)
     )
     x = llama_mod._rms_norm(x[:, -1:], params["rmsf"], cfg.rms_eps)
-    logits = jnp.einsum(
-        "boe,ve->bov", x, params["lm_head"],
-        preferred_element_type=jnp.float32,
-    )[:, 0]
+    logits = llama_mod.head_logits(params, x)[:, 0]
     return logits, KVCache(k=k_new, v=v_new)
 
 
@@ -219,17 +223,13 @@ def llama_decode_step(params, cache: KVCache, token, pos, cfg,
         att = _cached_attention(q, k_full, v_full, pos).reshape(B, 1, E)
         x = x + att @ lp["wo"]
         h = llama_mod._rms_norm(x, lp["rms2"], cfg.rms_eps)
-        gated = jax.nn.silu(h @ lp["w_gate"]) * (h @ lp["w_up"])
-        return x + gated @ lp["w_down"], (k_c, v_c)
+        return _llama_mlp(x, h, lp, cfg), (k_c, v_c)
 
     x, (k_new, v_new) = jax.lax.scan(
         body, x, (params["blocks"], cache.k, cache.v)
     )
     x = llama_mod._rms_norm(x, params["rmsf"], cfg.rms_eps)
-    logits = jnp.einsum(
-        "boe,ve->bov", x, params["lm_head"],
-        preferred_element_type=jnp.float32,
-    )[:, 0]
+    logits = llama_mod.head_logits(params, x)[:, 0]
     return logits, KVCache(k=k_new, v=v_new)
 
 

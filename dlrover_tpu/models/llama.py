@@ -52,6 +52,13 @@ class LlamaConfig:
     dtype: Any = jnp.bfloat16
     remat: Any = True  # same named policies as GPTConfig.remat
     use_flash_attention: Optional[bool] = None
+    # > 0 switches every block's MLP to a mixture-of-experts routed
+    # over the ``expert`` mesh axis (models/moe.py — Mixtral-shaped
+    # family; experts use the GShard FFN formulation). ``intermediate``
+    # then sets the per-expert hidden width.
+    n_experts: int = 0
+    moe_top_k: int = 2
+    moe_capacity_factor: float = 1.25
 
     @property
     def head_dim(self) -> int:
@@ -100,6 +107,40 @@ class LlamaConfig:
             remat=False,
         )
 
+    @staticmethod
+    def moe_8x7b() -> "LlamaConfig":
+        """Mixtral-8x7B-shaped: Llama-2 backbone, 8 experts, top-2."""
+        return LlamaConfig(
+            vocab_size=32000,
+            block_size=4096,
+            n_layer=32,
+            n_head=32,
+            n_kv_head=8,
+            n_embd=4096,
+            intermediate=14336,
+            rope_theta=1e6,
+            n_experts=8,
+            moe_top_k=2,
+        )
+
+    @staticmethod
+    def moe_tiny() -> "LlamaConfig":
+        return dataclasses.replace(
+            LlamaConfig.tiny(), n_experts=4, moe_top_k=2
+        )
+
+    def _moe_cfg(self):
+        from dlrover_tpu.models.moe import MoEConfig
+
+        return MoEConfig(
+            n_embd=self.n_embd,
+            n_experts=self.n_experts,
+            expert_hidden=self.intermediate,
+            top_k=self.moe_top_k,
+            capacity_factor=self.moe_capacity_factor,
+            dtype=self.dtype,
+        )
+
 
 # ---------------------------------------------------------------------------
 # Init
@@ -124,19 +165,33 @@ def init_params(key: jax.Array, cfg: LlamaConfig) -> Params:
     def stack(k, shape, s=std):
         return norm(k, (L,) + shape, s)
 
+    blocks = {
+        "rms1": jnp.ones((L, E), jnp.float32),
+        "wq": stack(keys[1], (E, E)),
+        "wk": stack(keys[2], (E, Hkv * D)),
+        "wv": stack(keys[3], (E, Hkv * D)),
+        "wo": stack(keys[4], (E, E), resid_std),
+        "rms2": jnp.ones((L, E), jnp.float32),
+    }
+    if cfg.n_experts > 0:
+        from dlrover_tpu.models.moe import init_moe_params
+
+        per_layer = [
+            init_moe_params(k, cfg._moe_cfg())
+            for k in jax.random.split(keys[5], L)
+        ]
+        blocks["moe"] = jax.tree.map(
+            lambda *xs: jnp.stack(xs), *per_layer
+        )
+    else:
+        blocks.update(
+            w_gate=stack(keys[5], (E, I)),
+            w_up=stack(keys[6], (E, I)),
+            w_down=stack(keys[7], (I, E), resid_std),
+        )
     return {
         "wte": norm(keys[0], (cfg.vocab_size, E)),
-        "blocks": {
-            "rms1": jnp.ones((L, E), jnp.float32),
-            "wq": stack(keys[1], (E, E)),
-            "wk": stack(keys[2], (E, Hkv * D)),
-            "wv": stack(keys[3], (E, Hkv * D)),
-            "wo": stack(keys[4], (E, E), resid_std),
-            "rms2": jnp.ones((L, E), jnp.float32),
-            "w_gate": stack(keys[5], (E, I)),
-            "w_up": stack(keys[6], (E, I)),
-            "w_down": stack(keys[7], (I, E), resid_std),
-        },
+        "blocks": blocks,
         "rmsf": jnp.ones((E,), jnp.float32),
         "lm_head": norm(keys[8], (cfg.vocab_size, E)),
     }
@@ -145,19 +200,30 @@ def init_params(key: jax.Array, cfg: LlamaConfig) -> Params:
 def param_logical_axes(cfg: LlamaConfig) -> Params:
     """Logical sharding axes per leaf (tensor axis on heads/mlp, fsdp
     on embed — the same rule table as GPT, parallel/sharding.py)."""
+    blocks = {
+        "rms1": ("layers", None),
+        "wq": ("layers", "embed", "heads"),
+        "wk": ("layers", "embed", "heads"),
+        "wv": ("layers", "embed", "heads"),
+        "wo": ("layers", "heads", "embed"),
+        "rms2": ("layers", None),
+    }
+    if cfg.n_experts > 0:
+        from dlrover_tpu.models.moe import moe_logical_axes
+
+        blocks["moe"] = {
+            name: ("layers",) + axes
+            for name, axes in moe_logical_axes().items()
+        }
+    else:
+        blocks.update(
+            w_gate=("layers", "embed", "mlp"),
+            w_up=("layers", "embed", "mlp"),
+            w_down=("layers", "mlp", "embed"),
+        )
     return {
         "wte": ("vocab", "embed"),
-        "blocks": {
-            "rms1": ("layers", None),
-            "wq": ("layers", "embed", "heads"),
-            "wk": ("layers", "embed", "heads"),
-            "wv": ("layers", "embed", "heads"),
-            "wo": ("layers", "heads", "embed"),
-            "rms2": ("layers", None),
-            "w_gate": ("layers", "embed", "mlp"),
-            "w_up": ("layers", "embed", "mlp"),
-            "w_down": ("layers", "mlp", "embed"),
-        },
+        "blocks": blocks,
         "rmsf": (None,),
         "lm_head": ("vocab", "embed"),
     }
@@ -201,6 +267,8 @@ def apply_rope(x: jax.Array, cos: jax.Array, sin: jax.Array) -> jax.Array:
 
 
 def _block(x, lp, cfg: LlamaConfig, attn_fn, cos, sin):
+    """One block. Returns (x, aux_loss) — aux is 0 for dense MLPs,
+    the router load-balancing loss for MoE blocks."""
     B, T, E = x.shape
     H, Hkv, D = cfg.n_head, cfg.n_kv_head, cfg.head_dim
     h = _rms_norm(x, lp["rms1"], cfg.rms_eps)
@@ -216,8 +284,29 @@ def _block(x, lp, cfg: LlamaConfig, attn_fn, cos, sin):
     att = attn_fn(q, k, v).reshape(B, T, E)
     x = x + att @ lp["wo"]
     h = _rms_norm(x, lp["rms2"], cfg.rms_eps)
+    return mlp_tail(x, h, lp, cfg)
+
+
+def mlp_tail(x, h, lp, cfg: LlamaConfig):
+    """Dense-SwiGLU or expert-routed MLP tail of a block. Shared by
+    the training block and the decode paths (models/generate.py).
+    Returns (x + mlp(h), aux_loss)."""
+    if cfg.n_experts > 0:
+        from dlrover_tpu.models.moe import moe_mlp
+
+        y, aux = moe_mlp(lp["moe"], h, cfg._moe_cfg())
+        return x + y.astype(x.dtype), aux
     gated = jax.nn.silu(h @ lp["w_gate"]) * (h @ lp["w_up"])
-    return x + gated @ lp["w_down"]
+    return x + gated @ lp["w_down"], jnp.zeros((), jnp.float32)
+
+
+def head_logits(params: Params, x: jax.Array) -> jax.Array:
+    """lm_head projection in f32 — the single definition shared by
+    forward() and the loss paths."""
+    return jnp.einsum(
+        "...te,ve->...tv", x, params["lm_head"],
+        preferred_element_type=jnp.float32,
+    )
 
 
 def default_attention_for(cfg: LlamaConfig) -> Callable:
@@ -228,12 +317,14 @@ def default_attention_for(cfg: LlamaConfig) -> Callable:
     return gpt.default_attention_for(cfg)
 
 
-def backbone(
+def backbone_with_aux(
     params: Params,
     tokens: jax.Array,
     cfg: LlamaConfig,
     attn_fn: Optional[Callable] = None,
-) -> jax.Array:
+) -> tuple:
+    """Forward without the head: ([B,T,E] hidden, summed MoE aux
+    loss — 0 for dense configs)."""
     if attn_fn is None:
         attn_fn = default_attention_for(cfg)
     B, T = tokens.shape
@@ -250,11 +341,24 @@ def backbone(
         attn_fn,
     )
 
-    def scan_body(x, lp):
-        return block(x, lp), None
+    def scan_body(carry, lp):
+        x, aux_sum = carry
+        x, aux = block(x, lp)
+        return (x, aux_sum + aux), None
 
-    x, _ = jax.lax.scan(scan_body, x, params["blocks"])
-    return _rms_norm(x, params["rmsf"], cfg.rms_eps)
+    (x, aux), _ = jax.lax.scan(
+        scan_body, (x, jnp.zeros((), jnp.float32)), params["blocks"]
+    )
+    return _rms_norm(x, params["rmsf"], cfg.rms_eps), aux
+
+
+def backbone(
+    params: Params,
+    tokens: jax.Array,
+    cfg: LlamaConfig,
+    attn_fn: Optional[Callable] = None,
+) -> jax.Array:
+    return backbone_with_aux(params, tokens, cfg, attn_fn)[0]
 
 
 def forward(
@@ -263,13 +367,7 @@ def forward(
     cfg: LlamaConfig,
     attn_fn: Optional[Callable] = None,
 ) -> jax.Array:
-    x = backbone(params, tokens, cfg, attn_fn)
-    return jnp.einsum(
-        "bte,ve->btv",
-        x,
-        params["lm_head"],
-        preferred_element_type=jnp.float32,
-    )
+    return head_logits(params, backbone(params, tokens, cfg, attn_fn))
 
 
 def loss_fn(
@@ -279,10 +377,11 @@ def loss_fn(
     cfg: LlamaConfig,
     attn_fn: Optional[Callable] = None,
 ) -> jax.Array:
-    logits = forward(params, tokens, cfg, attn_fn)
+    x, aux = backbone_with_aux(params, tokens, cfg, attn_fn)
+    logits = head_logits(params, x)
     logp = jax.nn.log_softmax(logits, axis=-1)
     ll = jnp.take_along_axis(logp, targets[..., None], axis=-1)
-    return -jnp.mean(ll)
+    return -jnp.mean(ll) + aux
 
 
 def loss_fn_fused(
@@ -296,7 +395,7 @@ def loss_fn_fused(
 ) -> jax.Array:
     from dlrover_tpu.ops.cross_entropy import fused_cross_entropy
 
-    x = backbone(params, tokens, cfg, attn_fn)
+    x, aux = backbone_with_aux(params, tokens, cfg, attn_fn)
     n = x.shape[0] * x.shape[1]
     return fused_cross_entropy(
         x.reshape(n, -1),
@@ -304,16 +403,21 @@ def loss_fn_fused(
         targets.reshape(n),
         num_chunks,
         save_logits,
-    )
+    ) + aux
 
 
 def flops_per_token(cfg: LlamaConfig) -> float:
     """PaLM-convention training FLOPs/token (matches the reference's
     compute_llama2_training_flops in examples/llama2/example_utils.py:
-    6 * matmul params + attention score/value matmuls)."""
+    6 * matmul params + attention score/value matmuls). MoE counts
+    only the *active* experts' matmuls (top_k) plus the router."""
     E, L, I = cfg.n_embd, cfg.n_layer, cfg.intermediate
     kv = cfg.n_kv_head * cfg.head_dim
-    per_layer = E * E + 2 * E * kv + E * E + 3 * E * I  # wq wk wv wo mlp
+    if cfg.n_experts > 0:
+        mlp = 2 * cfg.moe_top_k * E * I + E * cfg.n_experts
+    else:
+        mlp = 3 * E * I  # gate + up + down
+    per_layer = E * E + 2 * E * kv + E * E + mlp
     n_matmul = L * per_layer + cfg.vocab_size * E
     attn = 12 * L * cfg.block_size * E
     return 6.0 * n_matmul + attn

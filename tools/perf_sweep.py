@@ -34,7 +34,10 @@ PEAK = 197e12
 REF_HFU = 0.496
 
 
-def run_config(mesh, spec: str) -> None:
+def build_spec(spec: str):
+    """Parse a sweep spec -> (cfg, attn_fn, batch, save_logits).
+    Shared with tools/profile_step.py so the profiled config is
+    byte-identical to the benchmarked one."""
     parts = spec.split(",")
     remat_s, flash_s, batch_s = parts[0], parts[1], parts[2]
     block_q = int(parts[3]) if len(parts) > 3 else 128
@@ -49,8 +52,6 @@ def run_config(mesh, spec: str) -> None:
     cfg = dataclasses.replace(
         gpt.GPTConfig.gpt2(), remat=remat, use_flash_attention=use_flash
     )
-    batch = int(batch_s)
-
     attn_fn = None
     if flash_s == "noop":
         # Attention stubbed to identity-on-v: measures the step's
@@ -62,6 +63,11 @@ def run_config(mesh, spec: str) -> None:
         attn_fn = functools.partial(
             flash_attention, causal=True, block_q=block_q, block_k=block_k
         )
+    return cfg, attn_fn, int(batch_s), save_logits
+
+
+def run_config(mesh, spec: str) -> None:
+    cfg, attn_fn, batch, save_logits = build_spec(spec)
 
     optimizer = optax.adamw(3e-4, weight_decay=0.1)
     loss = functools.partial(

@@ -22,7 +22,6 @@ import _repo_path  # noqa: F401
 
 
 def capture(spec: str, trace_dir: str) -> None:
-    import dataclasses
     import functools
 
     import jax
@@ -37,32 +36,11 @@ def capture(spec: str, trace_dir: str) -> None:
         shard_batch,
     )
 
-    # Same spec grammar as tools/perf_sweep.py:
-    #   remat,flash,batch[,block_q,block_k[,sl]]
-    parts = spec.split(",")
-    remat = {
-        "full": True, "attn": "attention", "none": False,
-        "dots": "dots", "offload": "offload",
-    }[parts[0]]
-    flash_s = parts[1] if len(parts) > 1 else "flash"
-    batch = int(parts[2]) if len(parts) > 2 else 16
-    block_q = int(parts[3]) if len(parts) > 3 else None
-    block_k = int(parts[4]) if len(parts) > 4 else None
-    save_logits = len(parts) > 5 and parts[5] == "sl"
-    cfg = dataclasses.replace(
-        gpt.GPTConfig.gpt2(), remat=remat,
-        use_flash_attention=(flash_s == "flash"),
-    )
-    attn_fn = None
-    if flash_s == "noop":
-        attn_fn = lambda q, k, v: v  # noqa: E731
-    elif flash_s == "flash" and (block_q or block_k):
-        from dlrover_tpu.ops.flash_attention import flash_attention
+    # Exactly perf_sweep's spec grammar AND config construction —
+    # build_spec is shared so the profiled program is the benched one.
+    from perf_sweep import build_spec
 
-        attn_fn = functools.partial(
-            flash_attention, causal=True, block_q=block_q,
-            block_k=block_k,
-        )
+    cfg, attn_fn, batch, save_logits = build_spec(spec)
     mesh = build_mesh(MeshConfig(data=len(jax.devices())))
     optimizer = optax.adamw(3e-4, weight_decay=0.1)
     loss = functools.partial(
