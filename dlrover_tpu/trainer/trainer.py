@@ -38,6 +38,8 @@ class TrainingArguments:
     checkpoint_dir: str = ""
     save_steps: int = 100
     log_steps: int = 10
+    eval_steps: int = 0  # 0 = no periodic eval during train()
+    eval_max_batches: int = 0  # 0 = the whole eval dataset
     seed: int = 0
     strategy: Optional[Any] = None  # accelerate.Strategy or None=search
     apply_paral_config: bool = True
@@ -52,12 +54,14 @@ class Trainer:
         dataset,  # map-style: dataset[i] -> (tokens, targets)
         args: TrainingArguments,
         collate_fn: Optional[Callable] = None,
+        eval_dataset=None,
     ):
         self.args = args
         self.model_init = model_init
         self.model_loss = model_loss
         self.logical_axes = logical_axes
         self.dataset = dataset
+        self.eval_dataset = eval_dataset
         self.collate_fn = collate_fn
 
         if args.apply_paral_config:
@@ -197,6 +201,17 @@ class Trainer:
                     args.log_steps / max(time.time() - t0, 1e-9),
                 )
                 t0 = time.time()
+            if (
+                self.eval_dataset is not None
+                and args.eval_steps
+                and step % args.eval_steps == 0
+            ):
+                metrics = self._run_eval(res.mesh, params)
+                logger.info(
+                    "step %d: eval_loss %.4f ppl %.2f (%d batches)",
+                    step, metrics["eval_loss"], metrics["perplexity"],
+                    metrics["batches"],
+                )
             if args.save_steps and step % args.save_steps == 0:
                 ckpt.save_checkpoint(
                     step, (params, opt_state),
@@ -207,12 +222,128 @@ class Trainer:
             step, (params, opt_state), storage_type=StorageType.DISK,
             extra={"sampler": sampler.state_dict()},
         )
+        final_eval = None
+        if self.eval_dataset is not None:
+            final_eval = self._run_eval(res.mesh, params)
         ckpt.wait_latest_checkpoint()
         ckpt.close()
         return {
             "final_step": step,
             "final_loss": losses[-1] if losses else None,
+            "eval": final_eval,
             "params": params,
             "opt_state": opt_state,
             "strategy": res.strategy,
         }
+
+    def _run_eval(self, mesh, params) -> dict:
+        """Mean loss + perplexity over eval_dataset (the evaluator
+        role of the reference's estimator stack — here any process
+        holding params can evaluate; see also ``evaluate()`` for the
+        standalone checkpoint-watching evaluator node).
+
+        Eval batches are sized like a training micro-step
+        (micro_batch_size per data shard), so eval never spikes
+        activation memory above what training already uses; the tail
+        that doesn't fill a batch is dropped (standard drop_last).
+        """
+        import jax.numpy as jnp
+
+        from dlrover_tpu.trainer.step import make_eval_step, shard_batch
+
+        if self._eval_step is None:
+            self._eval_step = make_eval_step(self.model_loss)
+        args = self.args
+        shape = dict(mesh.shape)
+        data_shards = shape.get("data", 1) * shape.get("fsdp", 1)
+        bs = args.micro_batch_size * data_shards
+        n = len(self.eval_dataset)
+        if n < bs:
+            raise ValueError(
+                f"eval_dataset has {n} samples < one eval batch "
+                f"({bs} = micro_batch_size x data shards)"
+            )
+        total_batches = n // bs
+        max_batches = min(
+            args.eval_max_batches or total_batches, total_batches
+        )
+        total = 0.0
+        for b in range(max_batches):
+            pairs = [
+                self.eval_dataset[b * bs + i] for i in range(bs)
+            ]
+            tokens = np.stack([p[0] for p in pairs])
+            targets = np.stack([p[1] for p in pairs])
+            tokens, targets = shard_batch(
+                mesh, jnp.asarray(tokens), jnp.asarray(targets)
+            )
+            total += float(self._eval_step(params, tokens, targets))
+        mean = total / max(max_batches, 1)
+        return {
+            "eval_loss": mean,
+            "perplexity": float(np.exp(min(mean, 30.0))),
+            "batches": max_batches,
+        }
+
+    _eval_step = None
+
+    def evaluate(self, params=None, mesh=None) -> dict:
+        """Standalone evaluation (the reference's evaluator node,
+        master/node per-role managers): restore the latest committed
+        checkpoint when ``params`` is None and score eval_dataset.
+        """
+        import jax
+
+        from dlrover_tpu.accelerate import make_optimizer
+        from dlrover_tpu.trainer.flash_checkpoint.checkpointer import (
+            Checkpointer,
+        )
+
+        if self.eval_dataset is None:
+            raise ValueError("Trainer was built without eval_dataset")
+        args = self.args
+        if mesh is None:
+            # Eval is read-only: build the mesh straight from the
+            # strategy's shape (or plain DP) — no strategy search, no
+            # throwaway optimizer/init plumbing.
+            from dlrover_tpu.parallel.mesh import (
+                MeshConfig,
+                build_mesh,
+            )
+
+            if args.strategy is not None:
+                shape = dict(args.strategy.mesh_shape)
+                n_dev = 1
+                for v in shape.values():
+                    n_dev *= v
+                mesh = build_mesh(
+                    MeshConfig(**shape),
+                    devices=jax.devices()[:n_dev],
+                )
+            else:
+                mesh = build_mesh(
+                    MeshConfig(data=len(jax.devices()))
+                )
+        if params is None:
+            opt = make_optimizer(args.optimizer, args.learning_rate)
+            like = jax.eval_shape(
+                lambda k: (
+                    self.model_init(k),
+                    opt.init(self.model_init(k)),
+                ),
+                jax.random.PRNGKey(0),
+            )
+            ckpt_dir = args.checkpoint_dir or os.path.join(
+                tempfile.gettempdir(), "dlrover_tpu_trainer_ckpt"
+            )
+            ckpt = Checkpointer(ckpt_dir)
+            try:
+                state = ckpt.load_checkpoint(like)
+                if state is None:
+                    raise FileNotFoundError(
+                        f"no committed checkpoint under {ckpt_dir!r}"
+                    )
+                params = state[0]
+            finally:
+                ckpt.close()
+        return self._run_eval(mesh, params)

@@ -86,6 +86,53 @@ def test_trainer_end_to_end(tmp_path, monkeypatch):
     assert out2["final_step"] == 8
 
 
+def test_trainer_periodic_and_standalone_eval(tmp_path, monkeypatch):
+    """Evaluator role: periodic eval during train() and a standalone
+    evaluate() that restores the latest committed checkpoint."""
+    monkeypatch.setenv(
+        "DLROVER_TPU_METRICS_FILE", str(tmp_path / "m.json")
+    )
+    args = TrainingArguments(
+        max_steps=4,
+        global_batch_size=8,
+        micro_batch_size=4,
+        checkpoint_dir=str(tmp_path / "ckpt_eval"),
+        save_steps=4,
+        eval_steps=2,
+        eval_max_batches=2,
+        strategy=Strategy(
+            mesh_shape=(("data", 4),), dtype="float32",
+            micro_batch_size=4,
+        ),
+    )
+    t = Trainer(
+        functools.partial(gpt.init_params, cfg=CFG),
+        functools.partial(gpt.loss_fn, cfg=CFG),
+        gpt.param_logical_axes(CFG),
+        TokenDataset(),
+        args,
+        eval_dataset=TokenDataset(n=64, seed=9),
+    )
+    out = t.train()
+    assert out["eval"] is not None
+    assert np.isfinite(out["eval"]["eval_loss"])
+    assert out["eval"]["perplexity"] > 1.0
+
+    # standalone evaluator node: fresh Trainer, params from checkpoint
+    t2 = Trainer(
+        functools.partial(gpt.init_params, cfg=CFG),
+        functools.partial(gpt.loss_fn, cfg=CFG),
+        gpt.param_logical_axes(CFG),
+        TokenDataset(),
+        args,
+        eval_dataset=TokenDataset(n=64, seed=9),
+    )
+    metrics = t2.evaluate()
+    np.testing.assert_allclose(
+        metrics["eval_loss"], out["eval"]["eval_loss"], rtol=1e-5
+    )
+
+
 def test_trainer_with_llama_family(tmp_path, monkeypatch):
     """The high-level Trainer is model-agnostic: drive it with the
     Llama family (RoPE/GQA/SwiGLU) end to end, including a save."""

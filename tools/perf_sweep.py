@@ -37,11 +37,15 @@ REF_HFU = 0.496
 def build_spec(spec: str):
     """Parse a sweep spec -> (cfg, attn_fn, batch, save_logits).
     Shared with tools/profile_step.py so the profiled config is
-    byte-identical to the benchmarked one."""
+    byte-identical to the benchmarked one. Omitted fields default to
+    flash attention with the kernel's own autotuned block sizes and
+    batch 16."""
     parts = spec.split(",")
-    remat_s, flash_s, batch_s = parts[0], parts[1], parts[2]
-    block_q = int(parts[3]) if len(parts) > 3 else 128
-    block_k = int(parts[4]) if len(parts) > 4 else 128
+    remat_s = parts[0]
+    flash_s = parts[1] if len(parts) > 1 else "flash"
+    batch = int(parts[2]) if len(parts) > 2 else 16
+    block_q = int(parts[3]) if len(parts) > 3 else None
+    block_k = int(parts[4]) if len(parts) > 4 else None
     save_logits = len(parts) > 5 and parts[5] == "sl"
     remat = {
         "full": True, "attn": "attention", "none": False,
@@ -60,10 +64,11 @@ def build_spec(spec: str):
     elif use_flash:
         from dlrover_tpu.ops.flash_attention import flash_attention
 
+        # block_q/block_k None -> default_block_sizes autotuning
         attn_fn = functools.partial(
             flash_attention, causal=True, block_q=block_q, block_k=block_k
         )
-    return cfg, attn_fn, int(batch_s), save_logits
+    return cfg, attn_fn, batch, save_logits
 
 
 def run_config(mesh, spec: str) -> None:
