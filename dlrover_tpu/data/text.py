@@ -1,0 +1,173 @@
+"""Text data preparation: tokenize -> token bin -> packed blocks.
+
+Counterpart of the reference example's data path (nanoGPT
+``prepare.py`` writes uint16 token bins that
+/root/reference/examples/pytorch/nanogpt/train.py memmaps per batch)
+plus the elastic dataset wrappers the trainer consumes. Hermetic by
+design: the built-in ``ByteTokenizer`` needs no downloads (every byte
+is a token, vocab 256); ``HFTokenizerAdapter`` wraps any local
+``transformers`` tokenizer when one is available.
+
+The on-disk format is a raw little-endian uint16 array — byte-for-
+byte what nanoGPT writes — so corpora prepared by either stack are
+interchangeable.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+from typing import Iterable, Optional, Tuple
+
+import numpy as np
+
+
+class ByteTokenizer:
+    """Bytes are tokens (vocab 256). Lossless on any text/binary."""
+
+    vocab_size = 256
+
+    def encode(self, text: str) -> np.ndarray:
+        return np.frombuffer(text.encode("utf-8"), np.uint8).astype(
+            np.uint16
+        )
+
+    def decode(self, tokens) -> str:
+        return bytes(
+            int(t) & 0xFF for t in np.asarray(tokens).ravel()
+        ).decode("utf-8", errors="replace")
+
+
+class HFTokenizerAdapter:
+    """Wrap a transformers tokenizer (loaded from a LOCAL path — this
+    image has no egress) behind the same encode/decode surface."""
+
+    def __init__(self, tokenizer):
+        self._tok = tokenizer
+        self.vocab_size = int(tokenizer.vocab_size)
+
+    def encode(self, text: str) -> np.ndarray:
+        ids = self._tok.encode(text)
+        dtype = np.uint16 if self.vocab_size <= 1 << 16 else np.uint32
+        return np.asarray(ids, dtype)
+
+    def decode(self, tokens) -> str:
+        return self._tok.decode(list(np.asarray(tokens).ravel()))
+
+
+def write_token_bin(
+    out_path: str,
+    texts: Iterable[str],
+    tokenizer=None,
+    append: bool = False,
+) -> int:
+    """Tokenize ``texts`` and write/append a raw uint16 bin (uint32
+    when the tokenizer's vocab needs it). Returns total tokens
+    written. Streaming: one text chunk in memory at a time.
+
+    A ``<out_path>.meta.json`` sidecar records the dtype and vocab
+    size so PackedDataset can't silently misread a uint32 bin as
+    uint16 (foreign nanoGPT bins have no sidecar and default to
+    uint16, which is the format nanoGPT writes).
+    """
+    tokenizer = tokenizer or ByteTokenizer()
+    mode = "ab" if append else "wb"
+    total = 0
+    dtype = None
+    with open(out_path, mode) as f:
+        for text in texts:
+            toks = tokenizer.encode(text)
+            if dtype is None:
+                dtype = toks.dtype
+            elif toks.dtype != dtype:  # pragma: no cover — one tok
+                raise ValueError("tokenizer changed dtype mid-stream")
+            f.write(toks.tobytes())
+            total += toks.size
+    if dtype is not None:
+        with open(out_path + ".meta.json", "w") as f:
+            json.dump(
+                {
+                    "dtype": np.dtype(dtype).name,
+                    "vocab_size": getattr(
+                        tokenizer, "vocab_size", None
+                    ),
+                },
+                f,
+            )
+    return total
+
+
+class PackedDataset:
+    """Memory-mapped token bin sliced into (tokens, targets) blocks.
+
+    ``dataset[i]`` returns ``(bin[o:o+B], bin[o+1:o+B+1])`` with
+    ``o = i * stride``; default stride = block_size (disjoint blocks,
+    epoch == one pass over the corpus). Map-style, so it plugs
+    directly into ElasticDistributedSampler / ElasticDataLoader and
+    the master's dynamic sharding (each sample index is a shard-able
+    work item).
+    """
+
+    def __init__(
+        self,
+        bin_path: str,
+        block_size: int,
+        stride: Optional[int] = None,
+        dtype=None,
+    ):
+        self.block_size = block_size
+        self.stride = stride or block_size
+        if dtype is None:
+            # sidecar written by write_token_bin; foreign (nanoGPT)
+            # bins have none and are uint16 by that format's contract
+            meta_path = bin_path + ".meta.json"
+            if os.path.exists(meta_path):
+                with open(meta_path) as f:
+                    dtype = np.dtype(json.load(f)["dtype"])
+            else:
+                dtype = np.uint16
+        self.data = np.memmap(bin_path, dtype=dtype, mode="r")
+        n_tokens = len(self.data)
+        if n_tokens < block_size + 1:
+            raise ValueError(
+                f"{bin_path!r} holds {n_tokens} tokens < "
+                f"block_size+1 ({block_size + 1})"
+            )
+        self._len = (n_tokens - block_size - 1) // self.stride + 1
+
+    def __len__(self) -> int:
+        return self._len
+
+    def __getitem__(self, i: int) -> Tuple[np.ndarray, np.ndarray]:
+        if not 0 <= i < self._len:
+            raise IndexError(i)
+        o = i * self.stride
+        chunk = np.asarray(
+            self.data[o : o + self.block_size + 1], np.int32
+        )
+        return chunk[:-1], chunk[1:]
+
+
+def prepare_text_file(
+    text_path: str,
+    out_path: str,
+    tokenizer=None,
+    chunk_bytes: int = 1 << 20,
+) -> int:
+    """Stream a text file into a token bin (nanoGPT prepare.py
+    equivalent; constant memory)."""
+
+    def chunks():
+        with open(text_path, "r", encoding="utf-8", errors="replace") as f:
+            while True:
+                c = f.read(chunk_bytes)
+                if not c:
+                    return
+                yield c
+
+    tokens = write_token_bin(out_path, chunks(), tokenizer)
+    if tokens == 0:
+        # an empty bin would fail PackedDataset with a confusing error
+        os.remove(out_path)
+        raise ValueError(f"{text_path!r} produced no tokens")
+    return tokens

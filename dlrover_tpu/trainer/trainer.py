@@ -63,9 +63,23 @@ class Trainer:
         self.dataset = dataset
         self.eval_dataset = eval_dataset
         self.collate_fn = collate_fn
+        self._eval_step = None  # jitted lazily by _run_eval
 
         if args.apply_paral_config:
             self._apply_paral_config()
+
+    def _ckpt_dir(self) -> str:
+        return self.args.checkpoint_dir or os.path.join(
+            tempfile.gettempdir(), "dlrover_tpu_trainer_ckpt"
+        )
+
+    def _optimizer_name(self) -> str:
+        """The optimizer actually used by train(): the strategy's
+        (auto_accelerate reads strategy.optimizer), falling back to
+        args.optimizer only when no explicit strategy is set."""
+        if self.args.strategy is not None:
+            return self.args.strategy.optimizer
+        return self.args.optimizer
 
     def _apply_paral_config(self) -> None:
         """Master-pushed overrides staged by the agent's tuner. Only
@@ -132,9 +146,7 @@ class Trainer:
             jax.random.PRNGKey(args.seed)
         )
 
-        ckpt_dir = args.checkpoint_dir or os.path.join(
-            tempfile.gettempdir(), "dlrover_tpu_trainer_ckpt"
-        )
+        ckpt_dir = self._ckpt_dir()
         ckpt = Checkpointer(ckpt_dir)
         sampler = ElasticDistributedSampler(
             dataset_size=len(self.dataset),
@@ -173,6 +185,7 @@ class Trainer:
         it = iter(loader)
 
         losses = []
+        last_eval, last_eval_step = None, -1
         t0 = time.time()
         step = start_step
         for step in range(start_step + 1, args.max_steps + 1):
@@ -206,11 +219,12 @@ class Trainer:
                 and args.eval_steps
                 and step % args.eval_steps == 0
             ):
-                metrics = self._run_eval(res.mesh, params)
+                last_eval = self._run_eval(res.mesh, params)
+                last_eval_step = step
                 logger.info(
                     "step %d: eval_loss %.4f ppl %.2f (%d batches)",
-                    step, metrics["eval_loss"], metrics["perplexity"],
-                    metrics["batches"],
+                    step, last_eval["eval_loss"],
+                    last_eval["perplexity"], last_eval["batches"],
                 )
             if args.save_steps and step % args.save_steps == 0:
                 ckpt.save_checkpoint(
@@ -224,7 +238,12 @@ class Trainer:
         )
         final_eval = None
         if self.eval_dataset is not None:
-            final_eval = self._run_eval(res.mesh, params)
+            # reuse the in-loop result when the last step already ran it
+            final_eval = (
+                last_eval
+                if last_eval_step == step
+                else self._run_eval(res.mesh, params)
+            )
         ckpt.wait_latest_checkpoint()
         ckpt.close()
         return {
@@ -285,8 +304,6 @@ class Trainer:
             "batches": max_batches,
         }
 
-    _eval_step = None
-
     def evaluate(self, params=None, mesh=None) -> dict:
         """Standalone evaluation (the reference's evaluator node,
         master/node per-role managers): restore the latest committed
@@ -325,7 +342,14 @@ class Trainer:
                     MeshConfig(data=len(jax.devices()))
                 )
         if params is None:
-            opt = make_optimizer(args.optimizer, args.learning_rate)
+            from dlrover_tpu.parallel.sharding import tree_shardings
+            from dlrover_tpu.trainer.step import _match_opt_sharding
+
+            # Skeleton matches what train() SAVED: the strategy's
+            # optimizer (auto_accelerate never reads args.optimizer).
+            opt = make_optimizer(
+                self._optimizer_name(), args.learning_rate
+            )
             like = jax.eval_shape(
                 lambda k: (
                     self.model_init(k),
@@ -333,12 +357,20 @@ class Trainer:
                 ),
                 jax.random.PRNGKey(0),
             )
-            ckpt_dir = args.checkpoint_dir or os.path.join(
-                tempfile.gettempdir(), "dlrover_tpu_trainer_ckpt"
+            # Shardings make the restore STREAM (each host reads only
+            # its shards) and land params already placed per the rule
+            # table — no host-side full assembly, no per-batch
+            # re-upload of replicated numpy leaves.
+            param_shard = tree_shardings(mesh, self.logical_axes)
+            opt_shard = _match_opt_sharding(
+                like[1], like[0], param_shard, mesh
             )
+            ckpt_dir = self._ckpt_dir()
             ckpt = Checkpointer(ckpt_dir)
             try:
-                state = ckpt.load_checkpoint(like)
+                state = ckpt.load_checkpoint(
+                    like, shardings=(param_shard, opt_shard)
+                )
                 if state is None:
                     raise FileNotFoundError(
                         f"no committed checkpoint under {ckpt_dir!r}"

@@ -1,0 +1,120 @@
+"""Text preparation: tokenizer roundtrip, bin format, packed blocks."""
+
+import numpy as np
+import pytest
+
+from dlrover_tpu.data.text import (
+    ByteTokenizer,
+    PackedDataset,
+    prepare_text_file,
+    write_token_bin,
+)
+
+
+def test_byte_tokenizer_roundtrip():
+    tok = ByteTokenizer()
+    s = "hello, TPU é世界"
+    ids = tok.encode(s)
+    assert ids.dtype == np.uint16
+    assert tok.decode(ids) == s
+
+
+def test_bin_format_matches_nanogpt(tmp_path):
+    """Raw little-endian uint16 — the exact layout nanoGPT memmaps."""
+    p = str(tmp_path / "t.bin")
+    n = write_token_bin(p, ["abc"])
+    assert n == 3
+    raw = np.fromfile(p, np.uint16)
+    np.testing.assert_array_equal(raw, [97, 98, 99])
+    # append mode extends
+    write_token_bin(p, ["d"], append=True)
+    assert len(np.fromfile(p, np.uint16)) == 4
+
+
+def test_packed_dataset_blocks(tmp_path):
+    p = str(tmp_path / "t.bin")
+    text = "".join(chr(65 + (i % 26)) for i in range(1000))
+    prepare_text_file(str(_write(tmp_path, text)), p)
+    ds = PackedDataset(p, block_size=64)
+    assert len(ds) == (1000 - 65) // 64 + 1
+    tokens, targets = ds[0]
+    assert tokens.shape == targets.shape == (64,)
+    np.testing.assert_array_equal(tokens[1:], targets[:-1])
+    # disjoint blocks: block 1 starts where block 0 ended
+    t1, _ = ds[1]
+    assert t1[0] == targets[63]
+    with pytest.raises(IndexError):
+        ds[len(ds)]
+
+
+def test_packed_dataset_stride_overlap(tmp_path):
+    p = str(tmp_path / "t.bin")
+    write_token_bin(p, ["x" * 300])
+    ds = PackedDataset(p, block_size=128, stride=32)
+    assert len(ds) == (300 - 129) // 32 + 1
+    a, _ = ds[0]
+    b, _ = ds[1]
+    np.testing.assert_array_equal(a[32:], b[:-32])
+
+
+def test_uint32_vocab_roundtrips_via_sidecar(tmp_path):
+    """A >65536-vocab tokenizer writes uint32; PackedDataset reads
+    the sidecar and never misinterprets the bin as uint16."""
+
+    class BigVocabTok:
+        vocab_size = 150_000
+
+        def encode(self, text):
+            return np.array(
+                [100_000 + ord(c) for c in text], np.uint32
+            )
+
+    p = str(tmp_path / "big.bin")
+    write_token_bin(p, ["abcd" * 50], tokenizer=BigVocabTok())
+    ds = PackedDataset(p, block_size=16)
+    tokens, _ = ds[0]
+    assert int(tokens[0]) == 100_000 + ord("a")
+    assert tokens.max() < 150_000
+
+
+def test_trains_through_trainer(tmp_path):
+    """PackedDataset plugs into the high-level Trainer unchanged."""
+    import functools
+
+    from dlrover_tpu.accelerate import Strategy
+    from dlrover_tpu.models import gpt
+    from dlrover_tpu.trainer.trainer import Trainer, TrainingArguments
+
+    cfg = gpt.GPTConfig(
+        vocab_size=256, block_size=32, n_layer=1, n_head=2, n_embd=32,
+        dtype=np.float32, remat=False,
+    )
+    p = str(tmp_path / "corpus.bin")
+    write_token_bin(p, ["the quick brown fox " * 200])
+    ds = PackedDataset(p, block_size=cfg.block_size)
+    args = TrainingArguments(
+        max_steps=2,
+        global_batch_size=8,
+        micro_batch_size=4,
+        checkpoint_dir=str(tmp_path / "ck"),
+        save_steps=0,
+        strategy=Strategy(
+            mesh_shape=(("data", 4),), dtype="float32",
+            micro_batch_size=4,
+        ),
+    )
+    out = Trainer(
+        functools.partial(gpt.init_params, cfg=cfg),
+        functools.partial(gpt.loss_fn, cfg=cfg),
+        gpt.param_logical_axes(cfg),
+        ds,
+        args,
+    ).train()
+    assert out["final_step"] == 2
+    assert np.isfinite(out["final_loss"])
+
+
+def _write(tmp_path, text):
+    f = tmp_path / "in.txt"
+    f.write_text(text)
+    return f
