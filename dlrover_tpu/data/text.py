@@ -44,7 +44,12 @@ class HFTokenizerAdapter:
 
     def __init__(self, tokenizer):
         self._tok = tokenizer
-        self.vocab_size = int(tokenizer.vocab_size)
+        # len(tokenizer) includes added/special tokens;
+        # tokenizer.vocab_size does NOT and would undersize the dtype
+        try:
+            self.vocab_size = int(len(tokenizer))
+        except TypeError:
+            self.vocab_size = int(tokenizer.vocab_size)
 
     def encode(self, text: str) -> np.ndarray:
         ids = self._tok.encode(text)
@@ -74,13 +79,20 @@ def write_token_bin(
     mode = "ab" if append else "wb"
     total = 0
     dtype = None
+    if append and os.path.exists(out_path + ".meta.json"):
+        with open(out_path + ".meta.json") as f:
+            dtype = np.dtype(json.load(f)["dtype"])
     with open(out_path, mode) as f:
         for text in texts:
             toks = tokenizer.encode(text)
             if dtype is None:
                 dtype = toks.dtype
-            elif toks.dtype != dtype:  # pragma: no cover — one tok
-                raise ValueError("tokenizer changed dtype mid-stream")
+            elif toks.dtype != dtype:
+                raise ValueError(
+                    f"token dtype {toks.dtype} does not match the "
+                    f"bin's existing dtype {dtype} — appending mixed "
+                    "dtypes would silently corrupt the corpus"
+                )
             f.write(toks.tobytes())
             total += toks.size
     if dtype is not None:

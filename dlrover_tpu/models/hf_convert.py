@@ -35,7 +35,9 @@ def _np(t) -> np.ndarray:
 
 
 def llama_config_from_hf(hf_config) -> LlamaConfig:
-    """Map an HF LlamaConfig to ours."""
+    """Map an HF Llama (or Mixtral) config to ours — Mixtral configs
+    carry num_local_experts/num_experts_per_tok, which switch the
+    native family into MoE mode."""
     return LlamaConfig(
         vocab_size=hf_config.vocab_size,
         block_size=hf_config.max_position_embeddings,
@@ -49,6 +51,18 @@ def llama_config_from_hf(hf_config) -> LlamaConfig:
         intermediate=hf_config.intermediate_size,
         rope_theta=getattr(hf_config, "rope_theta", 10000.0),
         rms_eps=hf_config.rms_norm_eps,
+        n_experts=getattr(hf_config, "num_local_experts", 0),
+        moe_top_k=getattr(hf_config, "num_experts_per_tok", 2),
+        # No-drop capacity (capacity == all tokens): HF Mixtral has no
+        # capacity concept, so a converted model must never drop or it
+        # diverges from the source. Lower it explicitly to fine-tune
+        # with GShard-style dropping.
+        moe_capacity_factor=(
+            float(getattr(hf_config, "num_local_experts", 0))
+            / max(getattr(hf_config, "num_experts_per_tok", 2), 1)
+            if getattr(hf_config, "num_local_experts", 0)
+            else 1.25
+        ),
     )
 
 
@@ -92,24 +106,58 @@ def llama_params_from_hf(
         head = _np(sd["lm_head.weight"]).astype(dtype)
     except KeyError:
         head = wte  # tie_word_embeddings
+    blocks = {
+        "rms1": stack(
+            "layers.{i}.input_layernorm.weight", transpose=False
+        ).astype(np.float32),
+        "wq": stack("layers.{i}.self_attn.q_proj.weight"),
+        "wk": stack("layers.{i}.self_attn.k_proj.weight"),
+        "wv": stack("layers.{i}.self_attn.v_proj.weight"),
+        "wo": stack("layers.{i}.self_attn.o_proj.weight"),
+        "rms2": stack(
+            "layers.{i}.post_attention_layernorm.weight",
+            transpose=False,
+        ).astype(np.float32),
+    }
+    if cfg.n_experts > 0:
+        # Mixtral block_sparse_moe: gate -> router, experts j:
+        # w1 = SwiGLU gate, w3 = up, w2 = down.
+        def stack_experts(fmt):
+            mats = []
+            for i in range(L):
+                mats.append(
+                    np.stack(
+                        [
+                            get(fmt.format(i=i, j=j)).T
+                            for j in range(cfg.n_experts)
+                        ]
+                    )
+                )
+            return np.stack(mats).astype(dtype)  # [L, E, in, out]
+
+        blocks["moe"] = {
+            "router": stack(
+                "layers.{i}.block_sparse_moe.gate.weight"
+            ).astype(np.float32),
+            "wg": stack_experts(
+                "layers.{i}.block_sparse_moe.experts.{j}.w1.weight"
+            ),
+            "wi": stack_experts(
+                "layers.{i}.block_sparse_moe.experts.{j}.w3.weight"
+            ),
+            "wo": stack_experts(
+                "layers.{i}.block_sparse_moe.experts.{j}.w2.weight"
+            ),
+        }
+    else:
+        blocks.update(
+            w_gate=stack("layers.{i}.mlp.gate_proj.weight"),
+            w_up=stack("layers.{i}.mlp.up_proj.weight"),
+            w_down=stack("layers.{i}.mlp.down_proj.weight"),
+        )
     params = {
         "wte": wte,
-        "blocks": {
-            "rms1": stack(
-                "layers.{i}.input_layernorm.weight", transpose=False
-            ).astype(np.float32),
-            "wq": stack("layers.{i}.self_attn.q_proj.weight"),
-            "wk": stack("layers.{i}.self_attn.k_proj.weight"),
-            "wv": stack("layers.{i}.self_attn.v_proj.weight"),
-            "wo": stack("layers.{i}.self_attn.o_proj.weight"),
-            "rms2": stack(
-                "layers.{i}.post_attention_layernorm.weight",
-                transpose=False,
-            ).astype(np.float32),
-            "w_gate": stack("layers.{i}.mlp.gate_proj.weight"),
-            "w_up": stack("layers.{i}.mlp.up_proj.weight"),
-            "w_down": stack("layers.{i}.mlp.down_proj.weight"),
-        },
+        "blocks": blocks,
         "rmsf": get("norm.weight").astype(np.float32),
         "lm_head": head,
     }

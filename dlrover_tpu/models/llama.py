@@ -139,6 +139,8 @@ class LlamaConfig:
             top_k=self.moe_top_k,
             capacity_factor=self.moe_capacity_factor,
             dtype=self.dtype,
+            gated=True,  # SwiGLU experts + renormalized top-k:
+            renorm_top_k=True,  # the Mixtral block shape
         )
 
 
@@ -213,7 +215,9 @@ def param_logical_axes(cfg: LlamaConfig) -> Params:
 
         blocks["moe"] = {
             name: ("layers",) + axes
-            for name, axes in moe_logical_axes().items()
+            for name, axes in moe_logical_axes(
+                gated=cfg._moe_cfg().gated
+            ).items()
         }
     else:
         blocks.update(
@@ -414,7 +418,8 @@ def flops_per_token(cfg: LlamaConfig) -> float:
     E, L, I = cfg.n_embd, cfg.n_layer, cfg.intermediate
     kv = cfg.n_kv_head * cfg.head_dim
     if cfg.n_experts > 0:
-        mlp = 2 * cfg.moe_top_k * E * I + E * cfg.n_experts
+        # SwiGLU experts: gate+in+out matmuls per active expert
+        mlp = 3 * cfg.moe_top_k * E * I + E * cfg.n_experts
     else:
         mlp = 3 * E * I  # gate + up + down
     per_layer = E * E + 2 * E * kv + E * E + mlp

@@ -39,6 +39,13 @@ class MoEConfig:
     aux_loss_weight: float = 1e-2
     z_loss_weight: float = 1e-3
     dtype: Any = jnp.bfloat16
+    # gated=True: experts are SwiGLU (w_gate/w_in/w_out) — the
+    # Mixtral expert shape — instead of the 2-matmul GELU FFN.
+    gated: bool = False
+    # renorm_top_k=True: combine weights are renormalized over the
+    # token's kept choices (Mixtral's softmax-over-top-k) instead of
+    # the raw full-softmax probabilities (GShard).
+    renorm_top_k: bool = False
 
     @property
     def hidden(self) -> int:
@@ -46,7 +53,7 @@ class MoEConfig:
 
 
 def init_moe_params(key: jax.Array, cfg: MoEConfig) -> Dict[str, Any]:
-    k_r, k_i, k_o = jax.random.split(key, 3)
+    k_r, k_i, k_o, k_g = jax.random.split(key, 4)
     E, D, H = cfg.n_experts, cfg.n_embd, cfg.hidden
     std = 0.02
 
@@ -55,21 +62,29 @@ def init_moe_params(key: jax.Array, cfg: MoEConfig) -> Dict[str, Any]:
             cfg.dtype
         )
 
-    return {
+    params = {
         # Router stays float32: tiny, and routing decisions are
         # precision-sensitive.
         "router": jax.random.normal(k_r, (D, E), jnp.float32) * std,
         "wi": norm(k_i, (E, D, H)),
         "wo": norm(k_o, (E, H, D)),
     }
+    if cfg.gated:
+        params["wg"] = norm(k_g, (E, D, H))
+    return params
 
 
-def moe_logical_axes() -> Dict[str, Tuple[Optional[str], ...]]:
-    return {
+def moe_logical_axes(
+    gated: bool = False,
+) -> Dict[str, Tuple[Optional[str], ...]]:
+    axes = {
         "router": (None, None),
         "wi": ("expert", "embed", "mlp"),
         "wo": ("expert", "mlp", "embed"),
     }
+    if gated:
+        axes["wg"] = ("expert", "embed", "mlp")
+    return axes
 
 
 def _gating(
@@ -161,6 +176,12 @@ def moe_mlp(
     flat = x.reshape(n, D)
     logits = flat.astype(jnp.float32) @ params["router"]  # [n, E]
     dispatch, combine, metrics = _gating(logits, cfg.top_k, capacity)
+    if cfg.renorm_top_k:
+        # Mixtral semantics: weights renormalized over the token's
+        # kept choices (== softmax over the top-k logits when no
+        # capacity drop occurs).
+        denom = jnp.sum(combine, axis=(1, 2), keepdims=True)
+        combine = combine / jnp.maximum(denom, 1e-9)
 
     # dispatch tokens to expert buffers: [E, C, D]
     buf = jnp.einsum(
@@ -173,7 +194,14 @@ def moe_mlp(
         "ecd,edh->ech", buf, params["wi"],
         preferred_element_type=jnp.float32,
     )
-    h = jax.nn.gelu(h).astype(cfg.dtype)
+    if cfg.gated:
+        g = jnp.einsum(
+            "ecd,edh->ech", buf, params["wg"],
+            preferred_element_type=jnp.float32,
+        )
+        h = (jax.nn.silu(g) * h).astype(cfg.dtype)
+    else:
+        h = jax.nn.gelu(h).astype(cfg.dtype)
     out = jnp.einsum(
         "ech,ehd->ecd", h, params["wo"],
         preferred_element_type=jnp.float32,

@@ -230,11 +230,17 @@ class Trainer:
                 ckpt.save_checkpoint(
                     step, (params, opt_state),
                     storage_type=StorageType.DISK,
-                    extra={"sampler": sampler.state_dict()},
+                    extra={
+                        "sampler": sampler.state_dict(),
+                        "strategy": res.strategy.to_json(),
+                    },
                 )
         ckpt.save_checkpoint(
             step, (params, opt_state), storage_type=StorageType.DISK,
-            extra={"sampler": sampler.state_dict()},
+            extra={
+                "sampler": sampler.state_dict(),
+                "strategy": res.strategy.to_json(),
+            },
         )
         final_eval = None
         if self.eval_dataset is not None:
@@ -319,6 +325,14 @@ class Trainer:
         if self.eval_dataset is None:
             raise ValueError("Trainer was built without eval_dataset")
         args = self.args
+        if params is None and args.strategy is None:
+            raise ValueError(
+                "evaluate(params=None) needs args.strategy to rebuild "
+                "the checkpoint's optimizer-state skeleton — a "
+                "strategy=None training run searched one (train() "
+                "records it in the checkpoint extras under "
+                "'strategy'); pass that Strategy here."
+            )
         if mesh is None:
             # Eval is read-only: build the mesh straight from the
             # strategy's shape (or plain DP) — no strategy search, no

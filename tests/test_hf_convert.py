@@ -63,6 +63,61 @@ def test_logits_match_transformers(hf_model):
     np.testing.assert_allclose(got, want, atol=2e-4, rtol=2e-3)
 
 
+def test_mixtral_logits_match_transformers():
+    """MoE family parity: tiny HF Mixtral vs native gated-expert
+    Llama-MoE (capacity pinned high so neither path drops tokens —
+    HF Mixtral has no capacity concept)."""
+    hf_cfg = transformers.MixtralConfig(
+        vocab_size=128,
+        hidden_size=64,
+        intermediate_size=96,
+        num_hidden_layers=2,
+        num_attention_heads=4,
+        num_key_value_heads=2,
+        num_local_experts=4,
+        num_experts_per_tok=2,
+        max_position_embeddings=64,
+        rms_norm_eps=1e-5,
+        rope_theta=10000.0,
+        tie_word_embeddings=False,
+        attn_implementation="eager",
+        router_aux_loss_coef=0.0,
+    )
+    torch.manual_seed(1)
+    model = transformers.MixtralForCausalLM(hf_cfg)
+    model.eval()
+
+    cfg = llama_config_from_hf(hf_cfg)
+    assert cfg.n_experts == 4 and cfg.moe_top_k == 2
+    params = llama_params_from_hf(model.state_dict(), cfg)
+
+    import dataclasses
+
+    cfg = dataclasses.replace(
+        cfg,
+        dtype=np.float32,
+        remat=False,
+        use_flash_attention=False,
+        # capacity >= all tokens so routing never drops (HF parity)
+        moe_capacity_factor=float(cfg.n_experts) / cfg.moe_top_k,
+    )
+    tokens_np = np.random.default_rng(1).integers(
+        0, cfg.vocab_size, size=(2, 16)
+    )
+    with torch.no_grad():
+        want = model(
+            torch.from_numpy(tokens_np)
+        ).logits.float().numpy()
+    got = np.asarray(
+        llama.forward(
+            jax.tree.map(np.asarray, params),
+            tokens_np.astype(np.int32),
+            cfg,
+        )
+    )
+    np.testing.assert_allclose(got, want, atol=5e-4, rtol=5e-3)
+
+
 def test_tied_embeddings_fallback(hf_model):
     cfg = llama_config_from_hf(hf_model.config)
     sd = {

@@ -265,15 +265,16 @@ class TestLlamaMoE:
         got = llama.flops_per_token(moe_cfg)
         E, L, I = moe_cfg.n_embd, moe_cfg.n_layer, moe_cfg.intermediate
         kv = moe_cfg.n_kv_head * moe_cfg.head_dim
-        # active experts (top_k of n_experts) + router, NOT all experts
-        mlp = 2 * moe_cfg.moe_top_k * E * I + E * moe_cfg.n_experts
+        # active SwiGLU experts (top_k of n_experts, 3 matmuls each)
+        # + router, NOT all experts
+        mlp = 3 * moe_cfg.moe_top_k * E * I + E * moe_cfg.n_experts
         want = 6.0 * (
             L * (2 * E * E + 2 * E * kv + mlp)
             + moe_cfg.vocab_size * E
         ) + 12 * L * moe_cfg.block_size * E
         assert got == want
         # sanity: all-experts accounting would be strictly larger
-        all_experts = got + 6.0 * L * 2 * (
+        all_experts = got + 6.0 * L * 3 * (
             moe_cfg.n_experts - moe_cfg.moe_top_k
         ) * E * I
         assert got < all_experts
