@@ -41,11 +41,53 @@ from dlrover_tpu.trainer.step import (
 logger = get_logger("accelerate")
 
 
-def make_optimizer(name: str, learning_rate: float):
+def make_optimizer(
+    name: str,
+    learning_rate,
+    warmup_steps: int = 0,
+    decay_steps: int = 0,
+    schedule: str = "constant",
+    grad_clip_norm: float = 0.0,
+):
     """Public optimizer factory: Strategy.optimizer name -> optax
     transformation (also used by example/tooling scripts that must
-    rebuild a checkpoint's optimizer-state structure)."""
-    return _make_optimizer(name, learning_rate)
+    rebuild a checkpoint's optimizer-state structure).
+
+    ``schedule``: "constant" (optionally with linear ``warmup_steps``)
+    or "cosine" (warmup + cosine decay over ``decay_steps``, the HF
+    Trainer default the reference's AtorchTrainer inherits).
+    ``grad_clip_norm`` > 0 prepends global-norm clipping.
+
+    Checkpoint-skeleton note: a schedule changes the optimizer-state
+    structure (schedule step count), so rebuild skeletons with the
+    SAME schedule settings used in training — the Trainer passes its
+    TrainingArguments-derived kwargs identically in train() and
+    evaluate().
+    """
+    lr = learning_rate
+    if schedule == "cosine":
+        if not decay_steps:
+            raise ValueError("cosine schedule needs decay_steps")
+        lr = optax.warmup_cosine_decay_schedule(
+            init_value=0.0,
+            peak_value=learning_rate,
+            warmup_steps=warmup_steps,
+            decay_steps=decay_steps,
+            end_value=0.1 * learning_rate,
+        )
+    elif schedule == "constant":
+        if warmup_steps:
+            lr = optax.linear_schedule(
+                0.0, learning_rate, warmup_steps
+            )
+    else:
+        raise ValueError(f"unknown schedule {schedule!r}")
+    base = _make_optimizer(name, lr)
+    if grad_clip_norm:
+        return optax.chain(
+            optax.clip_by_global_norm(grad_clip_norm), base
+        )
+    return base
 
 
 def _make_optimizer(name: str, learning_rate: float):
@@ -90,6 +132,7 @@ def _build_for_strategy(
     logical_axes,
     learning_rate: float,
     devices,
+    optimizer_kwargs: Optional[Dict] = None,
 ):
     mesh_cfg = MeshConfig(**strategy.mesh_dict)
     n_needed = 1
@@ -98,7 +141,9 @@ def _build_for_strategy(
     if n_needed < len(devices):
         devices = devices[:n_needed]
     mesh = build_mesh(mesh_cfg, devices=devices)
-    optimizer = _make_optimizer(strategy.optimizer, learning_rate)
+    optimizer = make_optimizer(
+        strategy.optimizer, learning_rate, **(optimizer_kwargs or {})
+    )
     init, _ = make_sharded_init(
         mesh, model_init, logical_axes, optimizer
     )
@@ -152,18 +197,20 @@ def auto_accelerate(
     activation_bytes_per_sample: int = 1 << 20,
     hbm_bytes: Optional[int] = None,
     max_dry_runs: int = 6,
+    optimizer_kwargs: Optional[Dict] = None,
 ) -> AccelerateResult:
     """Pick (or apply) a strategy and return the compiled pieces.
 
     With ``strategy=`` this is the reference's load_strategy path; with
     None it analyses, prunes by memory estimate, dry-runs the top
-    candidates and keeps the fastest.
+    candidates and keeps the fastest. ``optimizer_kwargs`` forwards
+    schedule/clipping knobs to make_optimizer.
     """
     devices = list(devices if devices is not None else jax.devices())
     if strategy is not None:
         mesh, optimizer, init, step = _build_for_strategy(
             strategy, model_init, model_loss, logical_axes,
-            learning_rate, devices,
+            learning_rate, devices, optimizer_kwargs,
         )
         return AccelerateResult(
             strategy=strategy,
@@ -209,7 +256,7 @@ def auto_accelerate(
         if key not in build_cache:
             build_cache[key] = _build_for_strategy(
                 s, model_init, model_loss, logical_axes,
-                learning_rate, devices,
+                learning_rate, devices, optimizer_kwargs,
             )
         return build_cache[key]
 

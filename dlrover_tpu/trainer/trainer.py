@@ -40,6 +40,9 @@ class TrainingArguments:
     log_steps: int = 10
     eval_steps: int = 0  # 0 = no periodic eval during train()
     eval_max_batches: int = 0  # 0 = the whole eval dataset
+    warmup_steps: int = 0
+    lr_schedule: str = "constant"  # constant | cosine (over max_steps)
+    grad_clip_norm: float = 0.0  # 0 = no clipping
     seed: int = 0
     strategy: Optional[Any] = None  # accelerate.Strategy or None=search
     apply_paral_config: bool = True
@@ -80,6 +83,16 @@ class Trainer:
         if self.args.strategy is not None:
             return self.args.strategy.optimizer
         return self.args.optimizer
+
+    def _optimizer_kwargs(self) -> dict:
+        """Schedule/clipping knobs — passed IDENTICALLY by train()
+        and evaluate() so checkpoint skeletons always match."""
+        return {
+            "warmup_steps": self.args.warmup_steps,
+            "decay_steps": self.args.max_steps,
+            "schedule": self.args.lr_schedule,
+            "grad_clip_norm": self.args.grad_clip_norm,
+        }
 
     def _apply_paral_config(self) -> None:
         """Master-pushed overrides staged by the agent's tuner. Only
@@ -134,6 +147,7 @@ class Trainer:
             sample,
             learning_rate=args.learning_rate,
             strategy=args.strategy,
+            optimizer_kwargs=self._optimizer_kwargs(),
         )
         trainer = ElasticTrainer(
             res.mesh,
@@ -360,9 +374,11 @@ class Trainer:
             from dlrover_tpu.trainer.step import _match_opt_sharding
 
             # Skeleton matches what train() SAVED: the strategy's
-            # optimizer (auto_accelerate never reads args.optimizer).
+            # optimizer (auto_accelerate never reads args.optimizer)
+            # with the SAME schedule/clipping knobs.
             opt = make_optimizer(
-                self._optimizer_name(), args.learning_rate
+                self._optimizer_name(), args.learning_rate,
+                **self._optimizer_kwargs(),
             )
             like = jax.eval_shape(
                 lambda k: (

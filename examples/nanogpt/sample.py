@@ -56,8 +56,9 @@ def main() -> int:
 
     from dlrover_tpu.accelerate import make_optimizer
 
-    # lr only shapes nothing: opt_state structure is lr-independent,
-    # so any value reconstructs the checkpoint layout.
+    # train.py uses a flat lr (no schedule/clipping), so the bare
+    # factory reconstructs its checkpoint layout; a schedule would
+    # add opt-state leaves and need the same kwargs here.
     opt = make_optimizer(args.optimizer, 3e-4)
     like = jax.eval_shape(
         lambda k: (
