@@ -64,6 +64,11 @@ def make_optimizer(
     TrainingArguments-derived kwargs identically in train() and
     evaluate().
     """
+    if grad_clip_norm < 0:
+        raise ValueError(
+            f"grad_clip_norm must be >= 0, got {grad_clip_norm} "
+            "(a negative max_norm would flip every update's sign)"
+        )
     lr = learning_rate
     if schedule == "cosine":
         if not decay_steps:

@@ -239,6 +239,19 @@ def test_make_optimizer_schedule_variants():
         make_optimizer("adamw", 1e-2, schedule="cosine")
     with pytest.raises(ValueError):
         make_optimizer("adamw", 1e-2, schedule="nope")
+    with pytest.raises(ValueError):
+        make_optimizer("adamw", 1e-2, grad_clip_norm=-1.0)
+    # clipping actually binds: with sgd, ||update|| == lr * clip_norm
+    # for a gradient far above the threshold
+    lr, clip = 0.1, 0.5
+    opt = make_optimizer("sgd", lr, grad_clip_norm=clip)
+    p = {"w": jnp.zeros((4,))}
+    s = opt.init(p)
+    g = {"w": jnp.full((4,), 100.0)}
+    u, _ = opt.update(g, s, p)
+    np.testing.assert_allclose(
+        float(jnp.linalg.norm(u["w"])), lr * clip, rtol=1e-5
+    )
 
 
 def test_hang_detector_startup_grace_and_progress(tmp_path):
