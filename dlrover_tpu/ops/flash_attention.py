@@ -418,33 +418,40 @@ def _bwd(
 # ---------------------------------------------------------------------------
 
 
-@functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5, 6, 7, 8))
-def _flash(q, k, v, causal, scale, block_q, block_k, seq_len, interpret):
+@functools.partial(
+    jax.custom_vjp, nondiff_argnums=(3, 4, 5, 6, 7, 8, 9, 10)
+)
+def _flash(q, k, v, causal, scale, block_q, block_k, block_q_bwd,
+           block_k_bwd, seq_len, interpret):
     o, _ = _fwd(q, k, v, causal, scale, block_q, block_k, seq_len, interpret)
     return o
 
 
-def _flash_fwd(q, k, v, causal, scale, block_q, block_k, seq_len, interpret):
+def _flash_fwd(q, k, v, causal, scale, block_q, block_k, block_q_bwd,
+               block_k_bwd, seq_len, interpret):
     o, lse = _fwd(
         q, k, v, causal, scale, block_q, block_k, seq_len, interpret
     )
     return o, (q, k, v, o, lse)
 
 
-def _flash_bwd(causal, scale, block_q, block_k, seq_len, interpret, res, g):
+def _flash_bwd(causal, scale, block_q, block_k, block_q_bwd,
+               block_k_bwd, seq_len, interpret, res, g):
     q, k, v, o, lse = res
     return _bwd(
-        q, k, v, o, lse, g, causal, scale, block_q, block_k, seq_len,
-        interpret,
+        q, k, v, o, lse, g, causal, scale, block_q_bwd, block_k_bwd,
+        seq_len, interpret,
     )
 
 
 _flash.defvjp(_flash_fwd, _flash_bwd)
 
 
-@functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5, 6, 7, 8))
-def _flash_lse(q, k, v, causal, scale, block_q, block_k, seq_len,
-               interpret):
+@functools.partial(
+    jax.custom_vjp, nondiff_argnums=(3, 4, 5, 6, 7, 8, 9, 10)
+)
+def _flash_lse(q, k, v, causal, scale, block_q, block_k, block_q_bwd,
+               block_k_bwd, seq_len, interpret):
     """Like _flash but also returns the per-row logsumexp — the
     ingredient ring attention needs to merge normalized block outputs
     across devices (parallel/ring_attention.py)."""
@@ -453,20 +460,20 @@ def _flash_lse(q, k, v, causal, scale, block_q, block_k, seq_len,
     )
 
 
-def _flash_lse_fwd(q, k, v, causal, scale, block_q, block_k, seq_len,
-                   interpret):
+def _flash_lse_fwd(q, k, v, causal, scale, block_q, block_k,
+                   block_q_bwd, block_k_bwd, seq_len, interpret):
     o, lse = _fwd(
         q, k, v, causal, scale, block_q, block_k, seq_len, interpret
     )
     return (o, lse), (q, k, v, o, lse)
 
 
-def _flash_lse_bwd(causal, scale, block_q, block_k, seq_len, interpret,
-                   res, g):
+def _flash_lse_bwd(causal, scale, block_q, block_k, block_q_bwd,
+                   block_k_bwd, seq_len, interpret, res, g):
     g_o, g_lse = g
     q, k, v, o, lse = res
     return _bwd(
-        q, k, v, o, lse, g_o, causal, scale, block_q, block_k,
+        q, k, v, o, lse, g_o, causal, scale, block_q_bwd, block_k_bwd,
         seq_len, interpret, g_lse=g_lse,
     )
 
@@ -498,6 +505,8 @@ def flash_attention(
     scale: Optional[float] = None,
     block_q: Optional[int] = None,
     block_k: Optional[int] = None,
+    block_q_bwd: Optional[int] = None,
+    block_k_bwd: Optional[int] = None,
     interpret: Optional[bool] = None,
     return_lse: bool = False,
 ) -> "jax.Array | tuple[jax.Array, jax.Array]":
@@ -513,6 +522,11 @@ def flash_attention(
     ``return_lse=True`` also returns the per-row logsumexp [B, H, T]
     (f32, differentiable) — used by ring attention to merge block
     outputs across devices.
+
+    ``block_q_bwd``/``block_k_bwd`` tune the backward kernel's blocks
+    independently of the forward's (they default to the forward
+    blocks); the backward's access pattern (kv-outer grid, dq
+    full-sequence scratch) can favor different tiles.
     """
     if interpret is None:
         interpret = _use_interpret()
@@ -532,10 +546,28 @@ def flash_attention(
     dq_, dk_ = default_block_sizes(t)
     block_q = dq_ if block_q is None else min(block_q, max(t, 8))
     block_k = dk_ if block_k is None else min(block_k, max(t, 8))
+    block_q_bwd = (
+        block_q if block_q_bwd is None else min(block_q_bwd, max(t, 8))
+    )
+    block_k_bwd = (
+        block_k if block_k_bwd is None else min(block_k_bwd, max(t, 8))
+    )
 
-    # Pad so the padded length is divisible by BOTH block sizes (lcm),
-    # otherwise the floor-divided grid would silently drop tail blocks.
-    pad = (-t) % math.lcm(block_q, block_k)
+    # Pad so the padded length is divisible by EVERY block size (lcm),
+    # otherwise the floor-divided grids would silently drop tail
+    # blocks. Guard against lcm explosion: all four block sizes must
+    # form a divisibility chain (lcm == max), or a backward-side knob
+    # would silently inflate the FORWARD pass (e.g. bk=128 with
+    # bkb=96 pads T=1024 to 1152; bkb=520 vs bq=512 would pad 32x).
+    blocks = (block_q, block_k, block_q_bwd, block_k_bwd)
+    if math.lcm(*blocks) > 2 * max(blocks):
+        raise ValueError(
+            f"block sizes {blocks} are too coprime: padding to their "
+            f"lcm ({math.lcm(*blocks)}) would inflate the sequence "
+            "for every kernel, not just the one being tuned — pick "
+            "sizes that divide one another"
+        )
+    pad = (-t) % math.lcm(*blocks)
 
     def to_kernel_layout(x):
         x = jnp.transpose(x, (0, 2, 1, 3))  # [B,H,T,D]
@@ -546,10 +578,14 @@ def flash_attention(
     qk, kk, vk = map(to_kernel_layout, (q, k, v))
     if return_lse:
         o, lse = _flash_lse(
-            qk, kk, vk, causal, scale, block_q, block_k, t, interpret
+            qk, kk, vk, causal, scale, block_q, block_k,
+            block_q_bwd, block_k_bwd, t, interpret,
         )
         o = o[:, :, :t].transpose(0, 2, 1, 3)
         return o.astype(q.dtype), lse[:, :, :t, 0]
-    o = _flash(qk, kk, vk, causal, scale, block_q, block_k, t, interpret)
+    o = _flash(
+        qk, kk, vk, causal, scale, block_q, block_k,
+        block_q_bwd, block_k_bwd, t, interpret,
+    )
     o = o[:, :, :t].transpose(0, 2, 1, 3)
     return o.astype(q.dtype)

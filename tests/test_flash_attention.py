@@ -50,6 +50,34 @@ def test_default_block_sizes_pad_stays_bounded(t):
     assert pad < max(bq, bk)
 
 
+def test_distinct_bwd_blocks_grads_match():
+    """block_q_bwd/block_k_bwd different from the forward blocks must
+    produce identical gradients (only tiling changes)."""
+    q, k, v = _rand_qkv(jax.random.PRNGKey(7), 1, 256, 2, 32)
+
+    def loss(fn):
+        return lambda q, k, v: jnp.sum(jnp.square(fn(q, k, v)))
+
+    base = loss(
+        lambda q, k, v: flash_attention(
+            q, k, v, causal=True, block_q=128, block_k=128,
+            interpret=True,
+        )
+    )
+    tuned = loss(
+        lambda q, k, v: flash_attention(
+            q, k, v, causal=True, block_q=128, block_k=128,
+            block_q_bwd=64, block_k_bwd=256, interpret=True,
+        )
+    )
+    g1 = jax.grad(base, argnums=(0, 1, 2))(q, k, v)
+    g2 = jax.grad(tuned, argnums=(0, 1, 2))(q, k, v)
+    for a, b in zip(g1, g2):
+        np.testing.assert_allclose(
+            np.asarray(a), np.asarray(b), atol=2e-5, rtol=2e-5
+        )
+
+
 @pytest.mark.parametrize("causal", [True, False])
 def test_gradients_match_reference(causal):
     q, k, v = _rand_qkv(jax.random.PRNGKey(2), 1, 128, 2, 64)

@@ -1,10 +1,12 @@
 """Perf sweep harness: times the GPT-2 train step across configs.
 
-Usage: python tools/perf_sweep.py 'remat,flash,batch[,block_q,block_k[,sl]]' ...
+Usage:
+  python tools/perf_sweep.py 'remat,flash,batch[,bq,bk[,sl[,bqb,bkb]]]'
   remat: full | attn | none | dots | offload
   flash: flash | xla | noop (noop stubs attention to measure the
          step's non-attention cost by subtraction)
-  sl: save-logits cross-entropy variant (pass "sl")
+  sl: save-logits cross-entropy variant (pass "sl"; "-" to skip)
+  bqb,bkb: backward-kernel block sizes (default = forward blocks)
 
 Prints one line per config: config, step ms, MFU, vs_baseline.
 """
@@ -44,9 +46,17 @@ def build_spec(spec: str):
     remat_s = parts[0]
     flash_s = parts[1] if len(parts) > 1 else "flash"
     batch = int(parts[2]) if len(parts) > 2 else 16
-    block_q = int(parts[3]) if len(parts) > 3 else None
-    block_k = int(parts[4]) if len(parts) > 4 else None
+    def _blk(i):
+        # "-" (or absence) = kernel default for any block field
+        if len(parts) <= i or parts[i] == "-":
+            return None
+        return int(parts[i])
+
+    block_q = _blk(3)
+    block_k = _blk(4)
     save_logits = len(parts) > 5 and parts[5] == "sl"
+    block_q_bwd = _blk(6)
+    block_k_bwd = _blk(7)
     remat = {
         "full": True, "attn": "attention", "none": False,
         "dots": "dots", "offload": "offload",
@@ -66,7 +76,9 @@ def build_spec(spec: str):
 
         # block_q/block_k None -> default_block_sizes autotuning
         attn_fn = functools.partial(
-            flash_attention, causal=True, block_q=block_q, block_k=block_k
+            flash_attention, causal=True, block_q=block_q,
+            block_k=block_k, block_q_bwd=block_q_bwd,
+            block_k_bwd=block_k_bwd,
         )
     return cfg, attn_fn, batch, save_logits
 
