@@ -231,6 +231,8 @@ def _run_child(argv: list[str], timeout_s: float) -> tuple[str, str, str]:
 
 
 def _classify(status: str, detail: str) -> str:
+    if status == "never_ran":
+        return "budget_exhausted"
     if status == "timeout":
         return "tpu_hang"
     if "UNAVAILABLE" in detail or "initialize backend" in detail:
@@ -264,12 +266,12 @@ def main() -> int:
     attempts = 0
     last_status, last_detail = "never_ran", "no attempt completed"
     while True:
-        attempts += 1
         # Clamp every child to the remaining budget so total wall time
         # stays within BENCH_MAX_WAIT_S even when a child hangs.
         remaining = deadline - time.time()
         if remaining < 30:
             break
+        attempts += 1
         probe_out, status, detail = _run_child(
             [sys.executable, "-c", _PROBE_SRC],
             min(probe_timeout, remaining),

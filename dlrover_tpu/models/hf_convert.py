@@ -75,7 +75,16 @@ def llama_params_from_hf(
 
     Accepts either the ``model.``-prefixed CausalLM dict or a bare
     LlamaModel dict. Tied-embedding checkpoints (no lm_head.weight)
-    fall back to wte for the head, matching HF's tie_word_embeddings.
+    fall back to wte for the head, matching HF's tie_word_embeddings
+    at conversion time — but the returned pytree carries ``wte`` and
+    ``lm_head`` as two *independent* leaves, so the tie does not
+    survive training: gradients flow to each copy separately and they
+    diverge from the first optimizer step. That is fine for inference
+    and full-finetune-with-untied-head, but differs from HF's tied
+    fine-tune semantics; callers who need the tie preserved should
+    check ``"lm_head.weight" not in state_dict`` and alias the leaves
+    in their own step function (e.g. overwrite lm_head from wte after
+    each update, or compute logits against wte directly).
     """
     if hasattr(state_dict, "state_dict"):
         raise TypeError("pass model.state_dict(), not the model")

@@ -66,9 +66,12 @@ def init_lora(
     key: jax.Array,
 ) -> Params:
     """Build the LoRA tree: for each targeted leaf [..., in, out]
-    (leading dims = stacked layers), A ~ N(0, 1/r) [..., in, r] and
-    B = 0 [..., r, out] — B=0 makes step 0 a no-op, the standard LoRA
-    init."""
+    (leading dims = stacked layers), A ~ normal with std
+    1/sqrt(fan_in) shaped [..., in, r], and B = 0 [..., r, out].
+    B=0 makes step 0 a no-op, the standard LoRA init; the fan-in
+    scaling keeps A@x at unit variance regardless of rank — same
+    spirit as peft's Kaiming-uniform init (which uses a uniform
+    distribution and a slightly different constant)."""
     flat = _flatten_named(params)
     out: Dict[Tuple[str, ...], Any] = {}
     keys = jax.random.split(key, max(len(flat), 1))
@@ -78,7 +81,7 @@ def init_lora(
         *lead, n_in, n_out = leaf.shape
         a = (
             jax.random.normal(k, (*lead, n_in, cfg.rank), jnp.float32)
-            / cfg.rank
+            / (n_in**0.5)
         ).astype(leaf.dtype)
         b = jnp.zeros((*lead, cfg.rank, n_out), leaf.dtype)
         out[path] = {"a": a, "b": b}

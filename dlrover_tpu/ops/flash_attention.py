@@ -543,14 +543,24 @@ def flash_attention(
     if scale != 1.0 and math.frexp(scale)[0] == 0.5:
         q = q * jnp.asarray(scale, q.dtype)
         scale = 1.0
+    # A requested block larger than the sequence means "one tile
+    # spanning the whole (padded) sequence". Clamp those to the padded
+    # length implied by the in-range blocks — that adds no padding and
+    # always satisfies the divisibility-chain guard below, unlike
+    # clamping to t itself (block_k=1024 at t=520 -> 520 used to trip
+    # the guard for a call that tuned fine at longer sequences).
+    cap = max(t, 8)
     dq_, dk_ = default_block_sizes(t)
-    block_q = dq_ if block_q is None else min(block_q, max(t, 8))
-    block_k = dk_ if block_k is None else min(block_k, max(t, 8))
-    block_q_bwd = (
-        block_q if block_q_bwd is None else min(block_q_bwd, max(t, 8))
-    )
-    block_k_bwd = (
-        block_k if block_k_bwd is None else min(block_k_bwd, max(t, 8))
+    req_q = dq_ if block_q is None else block_q
+    req_k = dk_ if block_k is None else block_k
+    req_qb = req_q if block_q_bwd is None else block_q_bwd
+    req_kb = req_k if block_k_bwd is None else block_k_bwd
+    reqs = (req_q, req_k, req_qb, req_kb)
+    in_range = [r for r in reqs if r <= cap]
+    unit = math.lcm(*in_range) if in_range else 1
+    padded_base = max(8, math.ceil(t / unit) * unit)
+    block_q, block_k, block_q_bwd, block_k_bwd = (
+        r if r <= cap else padded_base for r in reqs
     )
 
     # Pad so the padded length is divisible by EVERY block size (lcm),

@@ -135,3 +135,28 @@ def test_unequal_blocks_no_dropped_keys():
     )
     ref = _default_attention(q, k, v, causal=False)
     np.testing.assert_allclose(out, ref, atol=2e-5, rtol=2e-5)
+
+
+def test_oversized_explicit_blocks_clamp_to_power_of_two():
+    """Regression: explicit block_k=1024 at t=520 used to clamp to 520,
+    tripping the divisibility-chain guard for a call that worked before
+    the guard existed. Oversized blocks now clamp to the largest power
+    of two <= t and the call must succeed and match the reference."""
+    q, k, v = _rand_qkv(jax.random.PRNGKey(5), 1, 520, 2, 64)
+    out = flash_attention(
+        q, k, v, causal=True, block_q=512, block_k=1024, interpret=True
+    )
+    ref = _default_attention(q, k, v, causal=True)
+    np.testing.assert_allclose(out, ref, atol=2e-5, rtol=2e-5)
+
+
+def test_oversized_block_with_tiny_sequence():
+    """block_k=1024 at t=20 (default block_q=t): the oversized block
+    must clamp to the padded length, not to a power of two that is
+    coprime with the non-power-of-two default block_q."""
+    q, k, v = _rand_qkv(jax.random.PRNGKey(6), 1, 20, 2, 64)
+    out = flash_attention(
+        q, k, v, causal=True, block_k=1024, interpret=True
+    )
+    ref = _default_attention(q, k, v, causal=True)
+    np.testing.assert_allclose(out, ref, atol=2e-5, rtol=2e-5)
