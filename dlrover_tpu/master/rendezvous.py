@@ -21,6 +21,7 @@ NCCL allgather.
 from __future__ import annotations
 
 import math
+import statistics
 import threading
 import time
 from typing import Dict, List, Optional, Set, Tuple
@@ -204,6 +205,7 @@ class NetworkCheckRendezvous(RendezvousManagerBase):
         self._node_groups: List[Dict[int, int]] = []
         self._fault_nodes: Set[int] = set()
         self._straggler_nodes: Set[int] = set()
+        self._verdict_done = False
 
     def join(self, node_rank: int, local_world_size: int) -> int:
         with self._lock:
@@ -218,6 +220,7 @@ class NetworkCheckRendezvous(RendezvousManagerBase):
                 if self._try_complete():
                     self._fault_nodes.clear()
                     self._straggler_nodes.clear()
+                    self._verdict_done = False
                     self._node_groups = self._group_nodes(self._rdzv_round)
                     logger.info(
                         "network-check round %d groups: %s",
@@ -285,55 +288,67 @@ class NetworkCheckRendezvous(RendezvousManagerBase):
     ) -> None:
         with self._lock:
             self._reported_nodes.add(node_rank)
-            # A node is healthy if it passed in ANY round (a failure may
-            # be its partner's fault); keep its best time.
-            prev_status = self._node_status.get(node_rank, normal)
-            self._node_status[node_rank] = prev_status or normal
-            prev_time = self._node_times.get(node_rank, elapsed_time)
-            self._node_times[node_rank] = round(
-                min(prev_time, elapsed_time), 3
+            # Health is sticky-pass across the paired rounds — one bad
+            # round may be the partner's fault, so passing anywhere
+            # wins — and a node's representative cost is its best time.
+            self._node_status[node_rank] = normal or self._node_status.get(
+                node_rank, False
             )
+            self._node_times[node_rank] = round(
+                min(
+                    self._node_times.get(node_rank, math.inf),
+                    elapsed_time,
+                ),
+                3,
+            )
+
+    def _round_verdict(self) -> bool:
+        """Classify the check round once all reports are in. Returns
+        False while reports are outstanding.
+
+        Verdict rules: a node whose sticky status never turned healthy
+        is faulty; a node slower than twice the median best-time is a
+        straggler; and a fully clean fleet fast-forwards the round
+        counter to the next CHECK_ROUNDS boundary, so the next check
+        request opens a fresh pair of rounds instead of replaying the
+        tail of this one. Evaluated at most once per check round (the
+        next ``get_comm_world`` completion re-arms it)."""
+        if len(self._reported_nodes) < len(self._rdzv_nodes):
+            return False
+        if not self._verdict_done:
+            self._verdict_done = True
+            self._fault_nodes.update(
+                rank
+                for rank, healthy in self._node_status.items()
+                if not healthy
+            )
+            self._straggler_nodes.update(self._slow_outliers())
+            if not (self._fault_nodes or self._straggler_nodes):
+                self._rdzv_round += -self._rdzv_round % self.CHECK_ROUNDS
+        return True
 
     def check_fault_nodes(self) -> Tuple[List[int], str]:
         """Return ([fault ranks], reason). reason='waiting' while nodes
         are still reporting."""
         with self._lock:
-            if len(self._reported_nodes) < len(self._rdzv_nodes):
+            if not self._round_verdict():
                 return [], "waiting"
-            if not self._fault_nodes:
-                for rank, ok in self._node_status.items():
-                    if not ok:
-                        self._fault_nodes.add(rank)
-                stragglers = self._detect_stragglers()
-                if not self._fault_nodes and not stragglers:
-                    # Align round counter so the next check starts fresh.
-                    self._rdzv_round = (
-                        math.ceil(self._rdzv_round / self.CHECK_ROUNDS)
-                        * self.CHECK_ROUNDS
-                    )
             reason = "fault" if self._fault_nodes else ""
             return sorted(self._fault_nodes), reason
 
     def get_stragglers(self) -> Tuple[List[int], str]:
         with self._lock:
-            if len(self._reported_nodes) < len(self._rdzv_nodes):
+            if not self._round_verdict():
                 return [], "waiting"
-            if not self._straggler_nodes:
-                self._straggler_nodes.update(self._detect_stragglers())
             return sorted(self._straggler_nodes), ""
 
-    def _detect_stragglers(self) -> Dict[int, float]:
-        stragglers: Dict[int, float] = {}
-        times = sorted(self._node_times.values())
-        if not times:
-            return stragglers
-        n = len(times)
-        med = (
-            times[n // 2]
-            if n % 2
-            else (times[n // 2] + times[n // 2 - 1]) / 2
-        )
-        for rank, t in self._node_times.items():
-            if t > 2 * med:
-                stragglers[rank] = t
-        return stragglers
+    def _slow_outliers(self) -> Dict[int, float]:
+        """Nodes whose best check time exceeds twice the fleet median."""
+        if not self._node_times:
+            return {}
+        cutoff = 2 * statistics.median(self._node_times.values())
+        return {
+            rank: t
+            for rank, t in self._node_times.items()
+            if t > cutoff
+        }
