@@ -32,6 +32,7 @@ from dlrover_tpu.common.config import ensure_framework_on_pythonpath
 from dlrover_tpu.common.constants import (
     NodeAction,
     NodeEnv,
+    NodeType,
     RendezvousName,
     TrainingExceptionLevel,
 )
@@ -130,6 +131,11 @@ class WorldSpec:
 class AgentConfig:
     node_id: int = 0
     node_rank: int = -1
+    # Role this agent's node plays (NodeType): "worker" nodes join the
+    # elastic rendezvous; an "evaluator" runs its command standalone
+    # (it follows checkpoints, not the training world) while the
+    # master still owns its lifecycle (critical role, relaunch).
+    node_type: str = "worker"
     local_world_size: int = 1
     max_restarts: int = 3
     monitor_interval: float = 2.0
@@ -371,8 +377,17 @@ class ElasticAgent:
     # -- main loop ----------------------------------------------------------
 
     def run(self) -> int:
-        self.client.register_node(node_type="worker")
-        if self.config.network_check and not self.run_network_check():
+        self.client.register_node(node_type=self.config.node_type)
+        # The network check is a training-world rendezvous sized to the
+        # worker fleet — an evaluator joining it would freeze a wrong-
+        # sized world and skew the straggler median, so only workers
+        # run it.
+        is_evaluator = self.config.node_type == NodeType.EVALUATOR
+        if (
+            not is_evaluator
+            and self.config.network_check
+            and not self.run_network_check()
+        ):
             self.client.report_failure(
                 "network check failed",
                 TrainingExceptionLevel.NODE_ERROR,
@@ -441,6 +456,19 @@ class ElasticAgent:
                     "pre-restart checkpoint flush failed", exc_info=True
                 )
 
+    def _standalone_spec(self) -> WorldSpec:
+        """World of one for roles outside the training rendezvous
+        (evaluator): the process runs alone, keyed by this node."""
+        return WorldSpec(
+            round=0,
+            group=0,
+            world={self.config.node_id: self.config.local_world_size},
+            node_world_size=1,
+            node_rank=0,
+            process_id=0,
+            num_processes=self.config.local_world_size,
+        )
+
     def _invoke_run(self) -> int:
         from dlrover_tpu.agent.hang_detector import HangDetector
 
@@ -449,7 +477,13 @@ class ElasticAgent:
             if self.config.hang_timeout > 0
             else None
         )
-        self._spec = self._rdzv.next_rendezvous()
+        if self.config.node_type == NodeType.EVALUATOR:
+            # Evaluators run outside the training world: no rendezvous
+            # join (which would block or distort the worker world), a
+            # world of one; master-side lifecycle still applies.
+            self._spec = self._standalone_spec()
+        else:
+            self._spec = self._rdzv.next_rendezvous()
         self._ensure_ckpt_saver(self._spec)
         self._spawn(self._spec)
         while not self._stop.is_set():
@@ -559,11 +593,19 @@ class ElasticAgent:
     def _restart_workers(self) -> None:
         self._flush_ckpt_shm()
         self._kill_proc()
-        self._spec = self._rdzv.next_rendezvous()
+        self._spec = (
+            self._standalone_spec()
+            if self.config.node_type == NodeType.EVALUATOR
+            else self._rdzv.next_rendezvous()
+        )
         self._ensure_ckpt_saver(self._spec)
         self._spawn(self._spec)
 
     def _membership_changed(self) -> bool:
+        # Evaluators are not part of the training world: worker churn
+        # must not restart the evaluation loop.
+        if self.config.node_type == NodeType.EVALUATOR:
+            return False
         return self.client.num_nodes_waiting() > 0
 
     def _heartbeat_loop(self) -> None:

@@ -17,6 +17,9 @@ class NodeType:
     MASTER = "master"
     # A TPU host (one VM of a pod slice, owning N chips).
     WORKER = "worker"
+    # The coordinating worker (rank-0 duties: variable init in PS
+    # strategy, checkpoint commits). Critical by default.
+    CHIEF = "chief"
     # CPU-only preprocessing host (coworker architecture).
     DATA_WORKER = "data_worker"
     # Parameter-server-style host for the sparse embedding path.
@@ -39,6 +42,17 @@ def ps_node_id(ps_id: int) -> int:
 
 def node_ps_id(node_id: int) -> int:
     return node_id - PS_NODE_ID_BASE
+
+
+# Evaluator ids are namespaced the same way PS ids are: an evaluator
+# launched with the default rank 0 must never merge onto worker 0's
+# node-table entry (the agent uses its node_id for register/heartbeat/
+# failure RPCs, so the namespacing happens at the agent).
+EVALUATOR_NODE_ID_BASE = 2_000_000
+
+
+def evaluator_node_id(index: int) -> int:
+    return EVALUATOR_NODE_ID_BASE + index
 
 
 class NodeStatus:
@@ -197,6 +211,9 @@ class JobExitReason:
     NODE_FATAL = "node_fatal_error"
     RDZV_TIMEOUT = "rendezvous_timeout"
     PENDING_TIMEOUT = "pending_timeout"
+    # A critical node (chief/evaluator/critical worker/PS) exhausted
+    # its relaunch budget: the job cannot make progress without it.
+    CRITICAL_NODE_FAILED = "critical_node_failed"
     UNKNOWN = "unknown"
 
 

@@ -10,6 +10,7 @@ the local platform simply records intents so tests can assert on them.
 
 from __future__ import annotations
 
+import dataclasses
 import re
 import threading
 import time
@@ -58,6 +59,66 @@ class Scaler:
         self.executed_plans.append(plan)
 
 
+@dataclasses.dataclass(frozen=True)
+class RolePolicy:
+    """Per-role lifecycle policy the master applies at registration.
+
+    ``critical``: losing such a node past its relaunch budget fails
+    the whole job instead of elastically shrinking it (ref:
+    chief/evaluator/PS are always critical, workers per the
+    critical-nodes spec, master/node/training_node.py:40-72).
+    ``max_relaunch``: role-specific relaunch-budget override; None
+    keeps the job-wide default.
+    """
+
+    critical: bool = False
+    max_relaunch: Optional[int] = None
+
+
+def default_role_policies() -> Dict[str, RolePolicy]:
+    return {
+        NodeType.CHIEF: RolePolicy(critical=True),
+        NodeType.EVALUATOR: RolePolicy(critical=True),
+        NodeType.EMBEDDING: RolePolicy(critical=True),
+    }
+
+
+def parse_critical_workers(spec: str) -> Dict[int, Optional[int]]:
+    """Parse the critical-workers spec into {rank: relaunch budget}.
+
+    ``""`` / ``"none"`` -> no critical workers; ``"all"`` -> every
+    worker critical (budget None = keep default); ``"0:3,5:1"`` ->
+    those ranks critical with the given per-rank relaunch budgets.
+    (ref: training_node.py:81 get_critical_worker_index)
+    """
+    spec = (spec or "").strip().lower()
+    if spec in ("", "none"):
+        return {}
+    if spec == "all":
+        return {-1: None}  # sentinel: every rank
+    out: Dict[int, Optional[int]] = {}
+    for part in spec.split(","):
+        part = part.strip()
+        if not part:
+            continue
+        rank_s, _, budget_s = part.partition(":")
+        try:
+            rank = int(rank_s)
+            budget = int(budget_s) if budget_s else None
+        except ValueError:
+            raise ValueError(
+                f"bad critical-workers entry {part!r}: expected "
+                "'rank' or 'rank:relaunch_budget' (or 'all'/'none')"
+            ) from None
+        if rank < 0 or (budget is not None and budget < 0):
+            raise ValueError(
+                f"bad critical-workers entry {part!r}: rank and "
+                "budget must be non-negative"
+            )
+        out[rank] = budget
+    return out
+
+
 class JobManager:
     """Tracks nodes and drives relaunch decisions."""
 
@@ -67,6 +128,8 @@ class JobManager:
         max_relaunch: int = 3,
         heartbeat_timeout: float = 180.0,
         pending_timeout: Optional[float] = None,
+        role_policies: Optional[Dict[str, RolePolicy]] = None,
+        critical_workers: str = "",
     ):
         from dlrover_tpu.common.config import Context
 
@@ -84,6 +147,14 @@ class JobManager:
         self._monitor_thread: Optional[threading.Thread] = None
         # subscribers: fn(node, event_type)
         self._listeners: List[Callable[[Node, str], None]] = []
+        self._role_policies = (
+            default_role_policies()
+            if role_policies is None
+            else dict(role_policies)
+        )
+        self._critical_workers = parse_critical_workers(critical_workers)
+        # Set when a critical node is lost for good: (reason, detail).
+        self._job_failure: Optional[tuple] = None
 
     @property
     def scaler(self) -> Scaler:
@@ -133,6 +204,7 @@ class JobManager:
                     config_resource=node.config_resource,
                     relaunch_count=node.relaunch_count,
                     max_relaunch_count=node.max_relaunch_count,
+                    critical=node.critical,
                 )
                 self._nodes[node_id] = fresh
                 node = fresh
@@ -147,10 +219,30 @@ class JobManager:
                 )
                 self._nodes[node_id] = node
             node.host_addr = addr or node.host_addr
+            self._apply_role_policy(node)
             node.update_status(NodeStatus.RUNNING)
             node.update_heartbeat()
         self._notify(node, NodeEventType.CREATED)
         return node
+
+    def _apply_role_policy(self, node: Node) -> None:
+        """Stamp role-derived lifecycle attributes on a node. Called
+        under the lock at registration; idempotent for re-registers."""
+        policy = self._role_policies.get(node.type)
+        if policy is not None:
+            node.critical = policy.critical
+            if policy.max_relaunch is not None:
+                node.max_relaunch_count = policy.max_relaunch
+        if node.type == NodeType.WORKER and self._critical_workers:
+            if -1 in self._critical_workers:  # "all"
+                budget = self._critical_workers[-1]
+            elif node.rank in self._critical_workers:
+                budget = self._critical_workers[node.rank]
+            else:
+                return
+            node.critical = True
+            if budget is not None:
+                node.max_relaunch_count = budget
 
     def get_node(self, node_id: int) -> Optional[Node]:
         with self._lock:
@@ -268,6 +360,8 @@ class JobManager:
             relaunch = node.should_relaunch()
             if relaunch:
                 node.inc_relaunch_count()
+            else:
+                self._note_critical_loss(node)
         logger.warning(
             "node %d failed (%s, level=%s, fatal=%s) relaunch=%s",
             node_id,
@@ -282,6 +376,29 @@ class JobManager:
             return NodeAction.RELAUNCH_NODE
         return NodeAction.STOP
 
+    def _note_critical_loss(self, node: Node) -> None:
+        """A node failed for good (budget exhausted / unrelaunchable).
+        For critical roles that means the job cannot make progress:
+        record the job-level failure for master.run to act on. Called
+        under the lock."""
+        if not node.critical or self._job_failure is not None:
+            return
+        self._job_failure = (
+            JobExitReason.CRITICAL_NODE_FAILED,
+            f"critical {node.type} node {node.id} (rank {node.rank}) "
+            f"lost: {node.exit_reason or 'unknown'} after "
+            f"{node.relaunch_count}/{node.max_relaunch_count} relaunches",
+        )
+        logger.error("job failed: %s", self._job_failure[1])
+
+    def job_failed(self) -> bool:
+        with self._lock:
+            return self._job_failure is not None
+
+    @property
+    def job_failure(self) -> Optional[tuple]:
+        return self._job_failure
+
     def _relaunch(self, node: Node) -> None:
         plan = ScalePlan()
         new_node = Node(
@@ -293,6 +410,7 @@ class JobManager:
             relaunch_count=node.relaunch_count,
             max_relaunch_count=node.max_relaunch_count,
             relaunch_reason=node.exit_reason,
+            critical=node.critical,
         )
         # Track the new incarnation: the failed node is being replaced,
         # so the job is NOT done (all_workers_done must see PENDING).
@@ -325,6 +443,8 @@ class JobManager:
             relaunch = node.should_relaunch()
             if relaunch:
                 node.inc_relaunch_count()
+            else:
+                self._note_critical_loss(node)
         logger.warning(
             "node %d gone (%s); relaunch=%s", node_id, reason, relaunch
         )
@@ -411,6 +531,13 @@ class JobManager:
                     node.exit_reason = JobExitReason.PENDING_TIMEOUT
                     node.relaunchable = False
                     node.update_status(NodeStatus.FAILED)
+                    # Only a replacement for a previously-running node
+                    # counts as a critical LOSS: an initial schedule
+                    # that never materialized (e.g. a platform that
+                    # cannot launch evaluators) leaves the job exactly
+                    # as it was, so it must not fail a healthy run.
+                    if node.relaunch_count > 0:
+                        self._note_critical_loss(node)
                     logger.warning(
                         "node %d pending for >%ss; abandoning",
                         node.id,
@@ -426,17 +553,117 @@ class JobManager:
             if node.should_relaunch():
                 node.inc_relaunch_count()
                 self._relaunch(node)
+            else:
+                with self._lock:
+                    self._note_critical_loss(node)
 
     def stop(self) -> None:
         self._stop.set()
 
     def all_workers_done(self) -> bool:
+        """All training nodes (workers AND chiefs) reached a terminal
+        state. Evaluators do not gate completion — they follow the
+        training fleet and are retired by the master when it ends
+        (ref: the estimator evaluator is stopped when the chief
+        finishes)."""
         with self._lock:
-            workers = [
+            training = [
                 n
                 for n in self._nodes.values()
-                if n.type == NodeType.WORKER
+                if n.type in (NodeType.WORKER, NodeType.CHIEF)
             ]
-            if not workers:
+            if not training:
                 return False
-            return all(n.status in NodeStatus.TERMINAL for n in workers)
+            return all(n.status in NodeStatus.TERMINAL for n in training)
+
+    # -- role-aware queries and scheduling ----------------------------------
+
+    def is_chief_running(self) -> bool:
+        """Whether any chief node is RUNNING (PS-strategy trainers wait
+        for the chief to initialize shared state before stepping)."""
+        with self._lock:
+            return any(
+                n.type == NodeType.CHIEF
+                and n.status == NodeStatus.RUNNING
+                for n in self._nodes.values()
+            )
+
+    def ensure_role(
+        self,
+        node_type: str,
+        count: int,
+        resource: Optional[NodeResource] = None,
+    ) -> List[Node]:
+        """Schedule nodes so ``count`` of ``node_type`` are alive.
+
+        The master's way to ask the platform for role nodes the job
+        spec wants but no agent has registered yet — e.g. a standalone
+        evaluator the trainer's evaluate loop will attach to. Returns
+        the newly launched (PENDING) nodes; no-op if enough are alive.
+        """
+        from dlrover_tpu.common.constants import (
+            evaluator_node_id,
+            ps_node_id,
+        )
+
+        # Role-namespaced ids (same scheme the agents use on their
+        # RPCs) so the arriving agent claims the PENDING node instead
+        # of colliding with a worker rank.
+        role_id = {
+            NodeType.EVALUATOR: evaluator_node_id,
+            NodeType.EMBEDDING: ps_node_id,
+        }.get(node_type)
+
+        plan = ScalePlan()
+        launched: List[Node] = []
+        with self._lock:
+            alive = sum(
+                1
+                for n in self._nodes.values()
+                if n.type == node_type and n.is_alive()
+            )
+            for index in range(count):
+                if alive + len(launched) >= count:
+                    break
+                if role_id is not None:
+                    node_id = role_id(index)
+                    existing = self._nodes.get(node_id)
+                    if existing is not None and existing.is_alive():
+                        continue
+                    rank = index
+                else:
+                    node_id = self._next_node_id
+                    self._next_node_id += 1
+                    rank = node_id
+                node = Node(
+                    type=node_type,
+                    id=node_id,
+                    rank=rank,
+                    status=NodeStatus.PENDING,
+                    config_resource=resource or NodeResource(),
+                    max_relaunch_count=self._max_relaunch,
+                )
+                self._apply_role_policy(node)
+                self._nodes[node.id] = node
+                plan.launch_nodes.append(node)
+                launched.append(node)
+        if not plan.empty():
+            self._scaler.scale(plan)
+        for node in launched:
+            self._notify(node, NodeEventType.CREATED)
+        return launched
+
+    def retire_role(self, node_type: str) -> None:
+        """Scale a whole role out (e.g. evaluators once training is
+        done) through the normal retirement path."""
+        for node in self.list_nodes(node_type):
+            if node.is_alive():
+                self.retire_node(node.id)
+
+    def terminate_job(self) -> None:
+        """Tear the whole fleet down (job-level failure): every alive
+        node is retired so the platform reclaims its pods instead of
+        leaving them training against a dead master."""
+        for node in self.list_nodes():
+            if node.is_alive():
+                self.retire_node(node.id)

@@ -27,18 +27,31 @@ def parse_args(argv=None):
     parser.add_argument(
         "--ps_autoscale_interval", type=float, default=30.0
     )
+    # Workers whose permanent loss fails the job: "", "none", "all",
+    # or "rank:budget,..." (ref: critical-nodes spec,
+    # master/node/training_node.py:81).
+    parser.add_argument("--critical_workers", type=str, default="")
+    # Standalone evaluator nodes the master schedules; the trainer's
+    # evaluate loop attaches to them (role: NodeType.EVALUATOR).
+    parser.add_argument("--evaluator_count", type=int, default=0)
     return parser.parse_args(argv)
 
 
 def main(argv=None) -> int:
     args = parse_args(argv)
-    master = JobMaster(
-        port=args.port,
-        node_num=args.node_num,
-        min_nodes=args.min_nodes,
-        node_unit=args.node_unit,
-        rdzv_timeout=args.rdzv_timeout,
-    )
+    try:
+        master = JobMaster(
+            port=args.port,
+            node_num=args.node_num,
+            min_nodes=args.min_nodes,
+            node_unit=args.node_unit,
+            rdzv_timeout=args.rdzv_timeout,
+            critical_workers=args.critical_workers,
+            evaluator_count=args.evaluator_count,
+        )
+    except ValueError as exc:
+        logger.error("invalid arguments: %s", exc)
+        return 2
     master.prepare()
     if args.ps_autoscale:
         master.start_ps_autoscaler(interval=args.ps_autoscale_interval)

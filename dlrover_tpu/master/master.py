@@ -13,6 +13,7 @@ import time
 from typing import Optional
 
 from dlrover_tpu.common.comm import RpcDispatcher, RpcServer
+from dlrover_tpu.common.constants import NodeType
 from dlrover_tpu.common.log import get_logger
 from dlrover_tpu.master.job_manager import JobManager, Scaler
 from dlrover_tpu.master.kv_store import KVStoreService
@@ -36,12 +37,20 @@ class JobMaster:
         node_unit: int = 1,
         rdzv_timeout: float = 30.0,
         scaler: Optional[Scaler] = None,
+        critical_workers: str = "",
+        evaluator_count: int = 0,
     ):
         """``node_num`` is the desired (max) world size; ``min_nodes``
         (default = node_num) is the smallest world the job may proceed
-        with after losses — the elastic range of ``--nnodes min:max``."""
+        with after losses — the elastic range of ``--nnodes min:max``.
+        ``critical_workers`` ("", "all", "none", "0:3,5:1") marks
+        workers whose permanent loss fails the job; ``evaluator_count``
+        standalone evaluator nodes are scheduled at prepare()."""
         self.node_num = node_num
-        self.job_manager = JobManager(scaler=scaler)
+        self.evaluator_count = evaluator_count
+        self.job_manager = JobManager(
+            scaler=scaler, critical_workers=critical_workers
+        )
         self.task_manager = TaskManager()
         self.speed_monitor = SpeedMonitor()
         self.kv_store = KVStoreService()
@@ -103,6 +112,10 @@ class JobMaster:
         self._server.start()
         self.job_manager.start()
         self.task_manager.start()
+        if self.evaluator_count > 0:
+            self.job_manager.ensure_role(
+                NodeType.EVALUATOR, self.evaluator_count
+            )
 
     def start_ps_autoscaler(self, interval: float = 30.0) -> None:
         """Enable PS-strategy auto-scaling (hot-PS migration + worker
@@ -126,8 +139,23 @@ class JobMaster:
         """Block until the job completes; returns an exit code."""
         try:
             while not self._stopped.wait(poll_interval):
+                if self.job_manager.job_failed():
+                    reason, detail = self.job_manager.job_failure
+                    logger.error(
+                        "job failed (%s): %s; master exiting",
+                        reason,
+                        detail,
+                    )
+                    # Reclaim the rest of the fleet — without this the
+                    # surviving pods keep training against a dead
+                    # master until they individually time out.
+                    self.job_manager.terminate_job()
+                    return 1
                 if self.job_manager.all_workers_done():
                     logger.info("all workers finished; master exiting")
+                    # Evaluators follow the training fleet: retire any
+                    # still-alive ones instead of leaving them orphaned.
+                    self.job_manager.retire_role(NodeType.EVALUATOR)
                     return 0
         except KeyboardInterrupt:
             return 1

@@ -82,6 +82,15 @@ def parse_args(argv=None):
     )
     parser.add_argument("--rdzv_timeout", type=float, default=600.0)
     parser.add_argument(
+        "--role",
+        type=str,
+        default="worker",
+        choices=["worker", "evaluator"],
+        help="node role: workers join the elastic rendezvous; an "
+        "evaluator runs its script standalone (world of one) while "
+        "the master owns its lifecycle",
+    )
+    parser.add_argument(
         "-m",
         "--module",
         action="store_true",
@@ -187,8 +196,19 @@ def run(args) -> int:
                 "--master is required on non-rank-0 nodes"
             )
 
+    # Evaluator ids live in their own namespace (like PS ids): the
+    # agent keys every RPC (register/heartbeat/failure) by node_id, so
+    # evaluator rank 0 must not collide with worker 0 in the master's
+    # node table — and it claims the PENDING node a master started
+    # with --evaluator_count pre-scheduled under the same id.
+    node_id = node_rank
+    if args.role == "evaluator":
+        from dlrover_tpu.common.constants import evaluator_node_id
+
+        node_id = evaluator_node_id(max(node_rank, 0))
+
     os.environ[NodeEnv.MASTER_ADDR] = master_addr
-    os.environ[NodeEnv.NODE_ID] = str(node_rank)
+    os.environ[NodeEnv.NODE_ID] = str(node_id)
     os.environ[NodeEnv.NODE_RANK] = str(node_rank)
     MasterClient.reset()
 
@@ -199,8 +219,9 @@ def run(args) -> int:
     entry_cmd += list(args.training_script_args)
 
     config = AgentConfig(
-        node_id=node_rank,
+        node_id=node_id,
         node_rank=node_rank,
+        node_type=args.role,
         local_world_size=nproc,
         max_restarts=args.max_restarts,
         network_check=args.network_check,

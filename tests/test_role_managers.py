@@ -1,0 +1,202 @@
+"""Per-role node lifecycle in the master: chief/evaluator/worker
+policies, critical-node semantics, and evaluator scheduling.
+
+Parity target: the reference's per-role managers and critical-node
+marking (dlrover/python/master/node/worker.py:32-150,
+training_node.py:40-81) — recast as a RolePolicy table the JobManager
+applies at registration instead of one manager class per role.
+"""
+
+import pytest
+
+from dlrover_tpu.common.constants import (
+    EVALUATOR_NODE_ID_BASE,
+    JobExitReason,
+    NodeAction,
+    NodeStatus,
+    NodeType,
+    TrainingExceptionLevel,
+)
+from dlrover_tpu.master.job_manager import (
+    JobManager,
+    parse_critical_workers,
+)
+
+
+def _fail(jm, node_id, fatal=False):
+    return jm.handle_failure_report(
+        node_id,
+        "boom",
+        TrainingExceptionLevel.NODE_ERROR,
+        restart_count=0,
+        fatal=fatal,
+    )
+
+
+class TestRolePolicies:
+    def test_chief_and_evaluator_register_critical(self):
+        jm = JobManager()
+        chief = jm.register_node(node_type=NodeType.CHIEF, node_id=0)
+        ev = jm.register_node(node_type=NodeType.EVALUATOR, node_id=1)
+        worker = jm.register_node(node_type=NodeType.WORKER, node_id=2)
+        assert chief.critical and ev.critical
+        assert not worker.critical
+
+    def test_parse_critical_workers_specs(self):
+        assert parse_critical_workers("") == {}
+        assert parse_critical_workers("none") == {}
+        assert parse_critical_workers("all") == {-1: None}
+        assert parse_critical_workers("0:3,5:1") == {0: 3, 5: 1}
+        assert parse_critical_workers("0:3,") == {0: 3}  # trailing comma
+
+    @pytest.mark.parametrize("bad", ["0-3", "x:2", "-1:2", "0:-2"])
+    def test_parse_critical_workers_rejects_malformed(self, bad):
+        with pytest.raises(ValueError):
+            parse_critical_workers(bad)
+
+    def test_critical_worker_spec_applies(self):
+        jm = JobManager(critical_workers="0:1")
+        w0 = jm.register_node(node_type=NodeType.WORKER, node_id=0)
+        w1 = jm.register_node(node_type=NodeType.WORKER, node_id=1)
+        assert w0.critical and w0.max_relaunch_count == 1
+        assert not w1.critical
+
+
+class TestRoleRecovery:
+    def test_evaluator_killed_is_relaunched(self):
+        jm = JobManager(max_relaunch=2)
+        jm.register_node(node_type=NodeType.EVALUATOR, node_id=0)
+        action = _fail(jm, 0)
+        assert action == NodeAction.RELAUNCH_NODE
+        replacement = jm.get_node(0)
+        assert replacement.status == NodeStatus.PENDING
+        assert replacement.type == NodeType.EVALUATOR
+        assert replacement.critical  # carried to the new incarnation
+        assert not jm.job_failed()
+        # The relaunched evaluator comes back and re-registers.
+        back = jm.register_node(node_type=NodeType.EVALUATOR, node_id=0)
+        assert back.status == NodeStatus.RUNNING
+
+    def test_chief_loss_beyond_budget_fails_job(self):
+        jm = JobManager(max_relaunch=1)
+        jm.register_node(node_type=NodeType.CHIEF, node_id=0)
+        assert _fail(jm, 0) == NodeAction.RELAUNCH_NODE
+        assert not jm.job_failed()
+        # Replacement registers, then dies again — budget exhausted.
+        jm.register_node(node_type=NodeType.CHIEF, node_id=0)
+        assert _fail(jm, 0) == NodeAction.STOP
+        assert jm.job_failed()
+        reason, detail = jm.job_failure
+        assert reason == JobExitReason.CRITICAL_NODE_FAILED
+        assert "chief" in detail
+
+    def test_noncritical_worker_loss_keeps_job_alive(self):
+        jm = JobManager(max_relaunch=0)
+        jm.register_node(node_type=NodeType.WORKER, node_id=0)
+        assert _fail(jm, 0) == NodeAction.STOP
+        assert not jm.job_failed()  # elastic shrink, not job failure
+
+    def test_critical_worker_loss_fails_job(self):
+        jm = JobManager(critical_workers="all", max_relaunch=0)
+        jm.register_node(node_type=NodeType.WORKER, node_id=0)
+        assert _fail(jm, 0) == NodeAction.STOP
+        assert jm.job_failed()
+
+    def test_fatal_error_on_critical_node_fails_job_immediately(self):
+        jm = JobManager()
+        jm.register_node(node_type=NodeType.EVALUATOR, node_id=0)
+        assert _fail(jm, 0, fatal=True) == NodeAction.STOP
+        assert jm.job_failed()
+
+
+class TestRoleQueriesAndScheduling:
+    def test_is_chief_running(self):
+        jm = JobManager()
+        assert not jm.is_chief_running()
+        jm.register_node(node_type=NodeType.CHIEF, node_id=0)
+        assert jm.is_chief_running()
+        jm.handle_node_succeeded(0)
+        assert not jm.is_chief_running()
+
+    def test_ensure_role_schedules_missing_evaluator(self):
+        jm = JobManager()
+        launched = jm.ensure_role(NodeType.EVALUATOR, 1)
+        assert len(launched) == 1
+        node = launched[0]
+        assert node.type == NodeType.EVALUATOR
+        assert node.status == NodeStatus.PENDING
+        assert node.critical  # role policy applied at scheduling
+        # Namespaced id: evaluator 0 never collides with worker 0, and
+        # the arriving evaluator agent (which keys its RPCs by
+        # evaluator_node_id(rank)) claims this exact node.
+        assert node.id == EVALUATOR_NODE_ID_BASE
+        # The platform launched it; its agent attaches under the id.
+        attached = jm.register_node(
+            node_type=NodeType.EVALUATOR, node_id=node.id
+        )
+        assert attached.status == NodeStatus.RUNNING
+        # Idempotent: enough evaluators alive, nothing new launched.
+        assert jm.ensure_role(NodeType.EVALUATOR, 1) == []
+        # The launch went through the scaler as a ScalePlan.
+        assert any(
+            p.launch_nodes for p in jm.scaler.executed_plans
+        )
+
+    def test_retire_role_removes_alive_evaluators(self):
+        jm = JobManager()
+        jm.register_node(node_type=NodeType.EVALUATOR, node_id=0)
+        jm.retire_role(NodeType.EVALUATOR)
+        assert jm.get_node(0).status == NodeStatus.DELETED
+
+    def test_scheduled_evaluator_never_claimed_does_not_fail_job(self):
+        """A pre-scheduled evaluator the platform cannot launch times
+        out PENDING and is abandoned — the job was healthy without it
+        and must stay healthy (only a lost *replacement* of a
+        previously-running critical node fails the job)."""
+        jm = JobManager(pending_timeout=0.0)
+        jm.register_node(node_type=NodeType.WORKER, node_id=0)
+        jm.ensure_role(NodeType.EVALUATOR, 1)
+        jm.check_nodes_once()
+        ev = jm.get_node(EVALUATOR_NODE_ID_BASE)
+        assert ev.status == NodeStatus.FAILED
+        assert not jm.job_failed()
+
+    def test_worker_and_evaluator_same_rank_are_distinct_nodes(self):
+        jm = JobManager()
+        w = jm.register_node(node_type=NodeType.WORKER, node_id=0)
+        ev = jm.register_node(
+            node_type=NodeType.EVALUATOR,
+            node_id=EVALUATOR_NODE_ID_BASE,
+        )
+        assert w.id != ev.id
+        assert w.type == NodeType.WORKER
+        assert ev.type == NodeType.EVALUATOR
+
+    def test_terminate_job_reclaims_fleet(self):
+        jm = JobManager()
+        jm.register_node(node_type=NodeType.WORKER, node_id=0)
+        jm.register_node(node_type=NodeType.WORKER, node_id=1)
+        jm.register_node(
+            node_type=NodeType.EVALUATOR,
+            node_id=EVALUATOR_NODE_ID_BASE,
+        )
+        jm.terminate_job()
+        assert all(
+            n.status == NodeStatus.DELETED for n in jm.list_nodes()
+        )
+        removed = [
+            n.id
+            for p in jm.scaler.executed_plans
+            for n in p.remove_nodes
+        ]
+        assert sorted(removed) == [0, 1, EVALUATOR_NODE_ID_BASE]
+
+    def test_completion_counts_chief_not_evaluator(self):
+        jm = JobManager()
+        jm.register_node(node_type=NodeType.WORKER, node_id=0)
+        jm.register_node(node_type=NodeType.CHIEF, node_id=1)
+        jm.register_node(node_type=NodeType.EVALUATOR, node_id=2)
+        jm.handle_node_succeeded(0)
+        assert not jm.all_workers_done()  # chief still running
+        jm.handle_node_succeeded(1)
+        assert jm.all_workers_done()  # evaluator does not gate
