@@ -97,8 +97,20 @@ class JobMaster:
             return
         self.task_manager.recover_node_tasks(node.id)
         self.speed_monitor.remove_running_node(node.id)
-        for rdzv in (self.elastic_rdzv, self.check_rdzv):
-            rdzv.remove_alive_node(node.id, node_rank=node.rank)
+        # Only training-world roles ever entered the rendezvous (the
+        # register path skips evaluators, and PS hosts register via
+        # their own RPC): removing an evaluator here would evict the
+        # WORKER with the same rank from the waiting set.
+        if node.type not in (NodeType.EVALUATOR, NodeType.EMBEDDING):
+            for rdzv in (self.elastic_rdzv, self.check_rdzv):
+                rdzv.remove_alive_node(node.id, node_rank=node.rank)
+        if node.type == NodeType.EMBEDDING:
+            # A dead PS host (heartbeat timeout / cluster event): move
+            # its partitions to the survivors now — clients are already
+            # blocking on the stale map.
+            from dlrover_tpu.common.constants import node_ps_id
+
+            self.ps_manager.remove_ps(node_ps_id(node.id))
 
     @property
     def port(self) -> int:
@@ -112,6 +124,12 @@ class JobMaster:
         self._server.start()
         self.job_manager.start()
         self.task_manager.start()
+        # Any job may register PS hosts (sparse path); their liveness
+        # probing must not depend on --ps_autoscale. A dead PS is
+        # failed over in ~10 s — well inside the sparse client's
+        # stale-map retry budget — vs the 180 s node-heartbeat timeout.
+        # No-op while no PS is registered.
+        self.ps_manager.start_liveness_monitor()
         if self.evaluator_count > 0:
             self.job_manager.ensure_role(
                 NodeType.EVALUATOR, self.evaluator_count
@@ -165,6 +183,7 @@ class JobMaster:
         self._stopped.set()
         if self.ps_auto_scaler is not None:
             self.ps_auto_scaler.stop()
+        self.ps_manager.stop_liveness_monitor()
         self.task_manager.stop()
         self.job_manager.stop()
         self._server.stop(0)

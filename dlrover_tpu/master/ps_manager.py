@@ -46,6 +46,9 @@ class PsManager:
         self._clients: Dict[int, RpcClient] = {}
         self._stats: Dict[int, msg.PsStatsReport] = {}
         self._stats_time: Dict[int, float] = {}
+        self._ping_failures: Dict[int, int] = {}
+        self._liveness_stop = threading.Event()
+        self._liveness_thread: Optional[threading.Thread] = None
 
     # -- accessors -------------------------------------------------------
 
@@ -67,14 +70,18 @@ class PsManager:
         )
 
     def _client(self, ps_id: int) -> RpcClient:
-        addr = self._map.ps_addrs[ps_id]
-        c = self._clients.get(ps_id)
-        if c is None or c.addr != addr:
-            if c is not None:
-                c.close()
-            c = RpcClient(addr)
-            self._clients[ps_id] = c
-        return c
+        # Takes the (reentrant) lock itself: callers on the liveness
+        # thread and flush path run outside locked sections, and the
+        # cache must not race register_ps/remove_ps closing entries.
+        with self._lock:
+            addr = self._map.ps_addrs[ps_id]
+            c = self._clients.get(ps_id)
+            if c is None or c.addr != addr:
+                if c is not None:
+                    c.close()
+                c = RpcClient(addr)
+                self._clients[ps_id] = c
+            return c
 
     # -- membership ------------------------------------------------------
 
@@ -85,6 +92,7 @@ class PsManager:
             is_new = ps_id not in self._map.ps_addrs
             self._map.ps_addrs[ps_id] = addr
             self._clients.pop(ps_id, None)
+            self._ping_failures.pop(ps_id, None)
             if is_new or not self._map.assignment:
                 self._rebalance(reason=f"register ps {ps_id}")
             else:
@@ -243,6 +251,92 @@ class PsManager:
                 logger.warning("PS %d flush failed", ps_id,
                                exc_info=True)
         return total
+
+    # -- liveness --------------------------------------------------------
+
+    def start_liveness_monitor(
+        self,
+        interval: float = 2.0,
+        failure_threshold: int = 2,
+        ping_timeout: float = 3.0,
+    ) -> None:
+        """Detect abrupt PS death and fail it over automatically.
+
+        Each tick pings every registered PS with a stats RPC; after
+        ``failure_threshold`` consecutive failures the node is treated
+        as dead and :meth:`remove_ps` runs — survivors take over its
+        partitions restored from the last delta flush, the map version
+        bumps, and blocked clients unblock on their next map refresh.
+        Complements (and works without) the master's node-event path,
+        e.g. for in-process drills with no servicer heartbeats.
+
+        Invariant the defaults must keep: worst-case detection latency
+        — ``failure_threshold * (interval + ping_timeout)`` = 10 s —
+        must stay well inside the sparse client's stale-map retry
+        budget (DistributedKvClient: max_retries backoff totalling
+        ~39 s), or a blocked training step would exhaust its retries
+        and crash before the new map is published.
+        """
+        if self._liveness_thread is not None:
+            return
+        self._liveness_stop.clear()
+
+        def loop() -> None:
+            while not self._liveness_stop.wait(interval):
+                self.check_liveness(failure_threshold, ping_timeout)
+
+        self._liveness_thread = threading.Thread(
+            target=loop, name="ps-liveness", daemon=True
+        )
+        self._liveness_thread.start()
+
+    def stop_liveness_monitor(self) -> None:
+        self._liveness_stop.set()
+        if self._liveness_thread is not None:
+            self._liveness_thread.join(timeout=5.0)
+            self._liveness_thread = None
+
+    def check_liveness(
+        self, failure_threshold: int = 2, ping_timeout: float = 3.0
+    ) -> List[int]:
+        """One liveness pass; returns the PS ids failed over."""
+        with self._lock:
+            ps_ids = sorted(self._map.ps_addrs)
+        dead: List[int] = []
+        for ps_id in ps_ids:
+            try:
+                self._client(ps_id).get(
+                    msg.PsStatsRequest(), timeout=ping_timeout
+                )
+            except Exception:  # noqa: BLE001 — any failure counts
+                with self._lock:
+                    if ps_id not in self._map.ps_addrs:
+                        # Deliberately removed (drain/remove) while we
+                        # were pinging: not a strike.
+                        self._ping_failures.pop(ps_id, None)
+                        continue
+                    self._ping_failures[ps_id] = (
+                        self._ping_failures.get(ps_id, 0) + 1
+                    )
+                    failures = self._ping_failures[ps_id]
+                logger.warning(
+                    "PS %d liveness ping failed (%d/%d)",
+                    ps_id, failures, failure_threshold,
+                )
+                if failures >= failure_threshold:
+                    dead.append(ps_id)
+            else:
+                with self._lock:
+                    self._ping_failures.pop(ps_id, None)
+        for ps_id in dead:
+            logger.error(
+                "PS %d unresponsive for %d pings; failing over",
+                ps_id, failure_threshold,
+            )
+            with self._lock:
+                self._ping_failures.pop(ps_id, None)
+            self.remove_ps(ps_id)
+        return dead
 
     # -- telemetry -------------------------------------------------------
 

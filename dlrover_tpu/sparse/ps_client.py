@@ -39,9 +39,14 @@ class DistributedKvClient:
         self,
         map_source,
         embedding_dims: Dict[str, int],
-        max_retries: int = 8,
+        max_retries: int = 12,
         retry_interval: float = 0.5,
     ):
+        # The default retry budget (backoff sleeps totalling ~39 s)
+        # must comfortably exceed the PsManager liveness monitor's
+        # worst-case detection latency (~10 s at its defaults): a
+        # sparse op blocking on a dead PS has to still be retrying
+        # when the rebalanced map is published.
         self._map_source = map_source
         self.embedding_dims = dict(embedding_dims)
         self.max_retries = max_retries
@@ -159,14 +164,22 @@ class DistributedKvClient:
         self._fan_out(keys, call)
 
     def table_size(self, table: str) -> int:
-        """Total rows across shards (stats fan-out; test/ops helper)."""
+        """Total rows across reachable shards (stats fan-out; test/ops
+        helper). A shard that died but has not been failed over yet is
+        skipped — telemetry must not crash a loop that the sparse ops
+        themselves would survive via their stale-map retries."""
         pmap = self._refresh_map(force=True)
         total = 0
         for ps_id in pmap.ps_ids():
             addr = pmap.ps_addrs.get(ps_id)
             if addr is None:
                 continue
-            stats = self._client_for(addr).get(msg.PsStatsRequest())
+            try:
+                stats = self._client_for(addr).get(
+                    msg.PsStatsRequest()
+                )
+            except Exception:  # noqa: BLE001 — mid-failover shard
+                continue
             total += stats.tables.get(table, 0)
         return total
 

@@ -9,12 +9,22 @@ kv_sparse_apply_group_adam — ref tfplus group_adam.py), and the dense
 tower with optax. Reference counterpart: tfplus example/dcn/train.py
 on TF parameter servers.
 
-Run:  python examples/ctr/train.py [--steps 200] [--drill]
+Run:  python examples/ctr/train.py [--steps 200] [--drill MODE]
 
---drill kills one PS mid-training after a delta flush; the survivor
-restores its partitions from the per-partition checkpoint files and
-training continues with no lost embeddings (the sparse analogue of the
-flash-checkpoint recovery drill).
+--drill graceful kills one PS mid-training after a delta flush; the
+survivor restores its partitions from the per-partition checkpoint
+files and training continues with no lost embeddings (the sparse
+analogue of the flash-checkpoint recovery drill).
+
+--drill abrupt is the real PS-failover drill (ref: the estimator
+executor's version-checked PS failover,
+trainer/tensorflow/failover/tensorflow_failover.py:33): one PS dies
+with NO flush and NO master notification. The training loop's next
+sparse op blocks in the client's stale-map retry; the PsManager
+liveness monitor detects the dead PS, rebalances its partitions onto
+the survivors (restored from the last periodic delta flush), bumps the
+map version, and the blocked client resumes — updates lost are bounded
+by --flush-every. --drill-json writes the recovery stats artifact.
 """
 
 from __future__ import annotations
@@ -87,8 +97,17 @@ def main(argv=None) -> int:
     p.add_argument("--n-ps", type=int, default=2)
     p.add_argument("--optimizer", default="group_adam")
     p.add_argument("--l21", type=float, default=1e-4)
-    p.add_argument("--drill", action="store_true",
-                   help="kill one PS mid-run; training must survive")
+    p.add_argument("--drill", nargs="?", const="graceful", default="",
+                   choices=["graceful", "abrupt"],
+                   help="kill one PS mid-run; training must survive. "
+                   "graceful: flush + orderly removal. abrupt: no "
+                   "flush, no notification -- the liveness monitor "
+                   "must detect it and fail over")
+    p.add_argument("--flush-every", type=int, default=25,
+                   help="periodic delta-flush cadence (steps); bounds "
+                   "the updates an abrupt PS death can lose")
+    p.add_argument("--drill-json", default="",
+                   help="write the drill recovery stats JSON here")
     p.add_argument("--max-ram-rows", type=int, default=0,
                    help=">0 enables the hybrid RAM/disk tier: at most "
                    "this many embedding rows stay resident per PS")
@@ -125,11 +144,27 @@ def main(argv=None) -> int:
     opt_state = opt.init(dense)
     grad_fn = jax.jit(jax.value_and_grad(loss_fn, argnums=(0, 1)))
 
+    if args.drill == "abrupt":
+        # Fast cadence so the in-process drill resolves in seconds;
+        # production uses the defaults (5 s ticks, 3 strikes).
+        mgr.start_liveness_monitor(
+            interval=0.5, failure_threshold=2, ping_timeout=2.0
+        )
+
     rng = np.random.default_rng(0)
     kill_at = args.steps // 2
+    if args.drill == "abrupt" and args.flush_every:
+        # Keep the kill OFF a flush boundary: an abrupt death right
+        # after a periodic flush would lose zero updates and the drill
+        # would not exercise the bounded-loss contract it documents.
+        if kill_at % args.flush_every == 0:
+            kill_at += max(1, args.flush_every // 2)
     losses = []
+    drill_stats = {}
+    last_flush_rows = 0
     t0 = time.time()
     for step in range(1, args.steps + 1):
+        step_start = time.time()
         keys, labels = synthetic_batch(rng, args.batch)
         emb = client.lookup("emb", keys.ravel())
         emb = jnp.asarray(
@@ -151,23 +186,67 @@ def main(argv=None) -> int:
         )
         losses.append(float(loss))
 
+        if drill_stats.get("kill_step") == step - 1:
+            # First full step after the kill: everything blocked in it
+            # (stale-map retries + rebalance) is the recovery cost.
+            drill_stats["recovery_s"] = round(
+                time.time() - drill_stats.pop("_kill_time"), 3
+            )
+            drill_stats["map_version_after"] = (
+                mgr.partition_map.version
+            )
+            drill_stats["rows_after_recovery"] = client.table_size(
+                "emb"
+            )
+            print(
+                f"DRILL: recovered in {drill_stats['recovery_s']}s "
+                f"(map v{drill_stats['map_version_before']} -> "
+                f"v{drill_stats['map_version_after']}, rows "
+                f"{drill_stats['rows_after_recovery']})"
+            )
+
+        if args.flush_every and step % args.flush_every == 0:
+            last_flush_rows = mgr.flush_all(step)
+
         if args.drill and step == kill_at:
-            flushed = mgr.flush_all(step)
             vid = max(servers)
             victim = servers.pop(vid)
             rows = len(victim.table("emb"))
-            victim.stop()
-            mgr.remove_ps(vid)
-            print(
-                f"DRILL: flushed {flushed} rows, killed PS with "
-                f"{rows} rows at step {step}; survivors restore "
-                "from delta files"
-            )
+            drill_stats = {
+                "drill": f"ps_{args.drill}_kill",
+                "killed_ps": vid,
+                "kill_step": step,
+                "victim_rows": rows,
+                "rows_at_last_flush": last_flush_rows,
+                "map_version_before": mgr.partition_map.version,
+                "_kill_time": time.time(),
+            }
+            if args.drill == "graceful":
+                flushed = mgr.flush_all(step)
+                drill_stats["rows_at_last_flush"] = flushed
+                victim.stop()
+                mgr.remove_ps(vid)
+                print(
+                    f"DRILL: flushed {flushed} rows, killed PS with "
+                    f"{rows} rows at step {step}; survivors restore "
+                    "from delta files"
+                )
+            else:
+                # Abrupt: no flush, no notification. The next sparse
+                # op blocks until the liveness monitor fails it over.
+                victim.stop()
+                print(
+                    f"DRILL: PS {vid} died abruptly at step {step} "
+                    f"({rows} rows in memory, last flush "
+                    f"{last_flush_rows}); waiting for liveness "
+                    "failover"
+                )
 
         if step % 20 == 0 or step == 1:
             print(
                 f"step {step}: loss {loss:.4f} "
-                f"rows={client.table_size('emb')}",
+                f"rows={client.table_size('emb')} "
+                f"({time.time() - step_start:.2f}s)",
                 flush=True,
             )
 
@@ -178,9 +257,24 @@ def main(argv=None) -> int:
         f"done: {args.steps} steps in {dt:.1f}s, loss "
         f"{head:.4f} -> {tail:.4f}"
     )
+    mgr.stop_liveness_monitor()
     client.close()
     for ps in servers.values():
         ps.stop()
+    if args.drill_json and drill_stats:
+        import json
+
+        drill_stats.pop("_kill_time", None)
+        drill_stats.update(
+            loss_head=round(head, 4),
+            loss_tail=round(tail, 4),
+            steps=args.steps,
+            flush_every=args.flush_every,
+            n_ps_before=args.n_ps,
+        )
+        with open(args.drill_json, "w") as f:
+            json.dump(drill_stats, f, indent=1)
+        print(f"drill stats -> {args.drill_json}")
     if not tail < head:
         print("FAIL: loss did not decrease", file=sys.stderr)
         return 1
