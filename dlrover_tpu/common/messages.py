@@ -433,6 +433,9 @@ class PsApplyRequest:
     optimizer: str = "adam"
     keys: Optional[Tensor] = None
     grads: Optional[Tensor] = None
+    # Optional per-key auxiliary rows, same [n, dim] layout as grads
+    # (adahessian: the Hutchinson Hessian-diagonal estimates).
+    aux: Optional[Tensor] = None
     step: int = 0
     lr: float = 1e-3
     hyperparams: Dict[str, float] = dataclasses.field(default_factory=dict)

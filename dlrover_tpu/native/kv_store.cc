@@ -801,6 +801,144 @@ void kv_sparse_apply_adabelief(void* param_h, void* m_h, void* s_h,
   });
 }
 
+// AMSGrad (Reddi et al. 2018, ref training_ops.cc AMSGrad variants):
+// Adam whose denominator uses the running MAX of the second moment,
+// so the effective step size never grows back after a large gradient.
+void kv_sparse_apply_amsgrad(void* param_h, void* m_h, void* v_h,
+                             void* vhat_h, const int64_t* keys,
+                             const float* grads, int64_t n, float lr,
+                             float beta1, float beta2, float eps,
+                             int64_t step) {
+  auto* param = static_cast<KvStore*>(param_h);
+  auto* mstore = static_cast<KvStore*>(m_h);
+  auto* vstore = static_cast<KvStore*>(v_h);
+  auto* vhatstore = static_cast<KvStore*>(vhat_h);
+  int dim = param->dim();
+  float bc1 = 1.0f - std::pow(beta1, static_cast<float>(step));
+  float bc2 = 1.0f - std::pow(beta2, static_cast<float>(step));
+  param->for_each_key(keys, n, step, [&](int64_t i, float* p) {
+    const float* g = grads + i * dim;
+    int64_t key = keys[i];
+    mstore->for_each_key(&key, 1, step, [&](int64_t, float* m) {
+      vstore->for_each_key(&key, 1, step, [&](int64_t, float* v) {
+        vhatstore->for_each_key(&key, 1, step, [&](int64_t, float* vh) {
+          for (int d = 0; d < dim; ++d) {
+            m[d] = beta1 * m[d] + (1.0f - beta1) * g[d];
+            v[d] = beta2 * v[d] + (1.0f - beta2) * g[d] * g[d];
+            vh[d] = std::max(vh[d], v[d]);
+            p[d] -= lr * (m[d] / bc1) / (std::sqrt(vh[d] / bc2) + eps);
+          }
+        });
+      });
+    });
+  });
+}
+
+// Rectified Adam (Liu et al. 2020, ref training_ops.cc RectifiedAdam):
+// while the variance estimate's effective sample size rho_t is too
+// small to be trusted (<= 4), take unadapted momentum-SGD steps;
+// afterwards scale the adaptive step by the rectification ratio r_t.
+void kv_sparse_apply_radam(void* param_h, void* m_h, void* v_h,
+                           const int64_t* keys, const float* grads,
+                           int64_t n, float lr, float beta1, float beta2,
+                           float eps, int64_t step) {
+  auto* param = static_cast<KvStore*>(param_h);
+  auto* mstore = static_cast<KvStore*>(m_h);
+  auto* vstore = static_cast<KvStore*>(v_h);
+  int dim = param->dim();
+  float t = static_cast<float>(step);
+  float bc1 = 1.0f - std::pow(beta1, t);
+  float bc2 = 1.0f - std::pow(beta2, t);
+  float rho_inf = 2.0f / (1.0f - beta2) - 1.0f;
+  float beta2_t = std::pow(beta2, t);
+  float rho_t = rho_inf - 2.0f * t * beta2_t / (1.0f - beta2_t);
+  bool rectify = rho_t > 4.0f;
+  float r_t = 1.0f;
+  if (rectify) {
+    r_t = std::sqrt(((rho_t - 4.0f) * (rho_t - 2.0f) * rho_inf) /
+                    ((rho_inf - 4.0f) * (rho_inf - 2.0f) * rho_t));
+  }
+  param->for_each_key(keys, n, step, [&](int64_t i, float* p) {
+    const float* g = grads + i * dim;
+    int64_t key = keys[i];
+    mstore->for_each_key(&key, 1, step, [&](int64_t, float* m) {
+      vstore->for_each_key(&key, 1, step, [&](int64_t, float* v) {
+        for (int d = 0; d < dim; ++d) {
+          m[d] = beta1 * m[d] + (1.0f - beta1) * g[d];
+          v[d] = beta2 * v[d] + (1.0f - beta2) * g[d] * g[d];
+          float mhat = m[d] / bc1;
+          if (rectify) {
+            p[d] -= lr * r_t * mhat / (std::sqrt(v[d] / bc2) + eps);
+          } else {
+            p[d] -= lr * mhat;
+          }
+        }
+      });
+    });
+  });
+}
+
+// Adadelta (Zeiler 2012, ref training_ops.cc Adadelta): step size
+// self-tunes from the ratio of accumulated update and gradient RMS —
+// no global learning-rate sensitivity (lr is the usual final scale).
+void kv_sparse_apply_adadelta(void* param_h, void* accum_h,
+                              void* accum_update_h, const int64_t* keys,
+                              const float* grads, int64_t n, float lr,
+                              float rho, float eps, int64_t step) {
+  auto* param = static_cast<KvStore*>(param_h);
+  auto* accum = static_cast<KvStore*>(accum_h);
+  auto* accum_up = static_cast<KvStore*>(accum_update_h);
+  int dim = param->dim();
+  param->for_each_key(keys, n, step, [&](int64_t i, float* p) {
+    const float* g = grads + i * dim;
+    int64_t key = keys[i];
+    accum->for_each_key(&key, 1, step, [&](int64_t, float* a) {
+      accum_up->for_each_key(&key, 1, step, [&](int64_t, float* au) {
+        for (int d = 0; d < dim; ++d) {
+          a[d] = rho * a[d] + (1.0f - rho) * g[d] * g[d];
+          float update = std::sqrt(au[d] + eps) /
+                         std::sqrt(a[d] + eps) * g[d];
+          au[d] = rho * au[d] + (1.0f - rho) * update * update;
+          p[d] -= lr * update;
+        }
+      });
+    });
+  });
+}
+
+// AdaHessian (Yao et al. 2021, ref training_ops.cc AdaHessian): the
+// second moment tracks the (Hutchinson-estimated, caller-supplied)
+// Hessian diagonal instead of the squared gradient; hessian_power
+// interpolates between Adam-like (0) and full Newton-ish (1) scaling.
+void kv_sparse_apply_adahessian(void* param_h, void* m_h, void* v_h,
+                                const int64_t* keys, const float* grads,
+                                const float* hessian, int64_t n, float lr,
+                                float beta1, float beta2, float eps,
+                                float hessian_power, int64_t step) {
+  auto* param = static_cast<KvStore*>(param_h);
+  auto* mstore = static_cast<KvStore*>(m_h);
+  auto* vstore = static_cast<KvStore*>(v_h);
+  int dim = param->dim();
+  float bc1 = 1.0f - std::pow(beta1, static_cast<float>(step));
+  float bc2 = 1.0f - std::pow(beta2, static_cast<float>(step));
+  param->for_each_key(keys, n, step, [&](int64_t i, float* p) {
+    const float* g = grads + i * dim;
+    const float* h = hessian + i * dim;
+    int64_t key = keys[i];
+    mstore->for_each_key(&key, 1, step, [&](int64_t, float* m) {
+      vstore->for_each_key(&key, 1, step, [&](int64_t, float* v) {
+        for (int d = 0; d < dim; ++d) {
+          m[d] = beta1 * m[d] + (1.0f - beta1) * g[d];
+          v[d] = beta2 * v[d] + (1.0f - beta2) * h[d] * h[d];
+          float denom =
+              std::pow(std::sqrt(v[d] / bc2), hessian_power) + eps;
+          p[d] -= lr * (m[d] / bc1) / denom;
+        }
+      });
+    });
+  });
+}
+
 void kv_sparse_apply_momentum(void* param_h, void* mom_h, const int64_t* keys,
                               const float* grads, int64_t n, float lr,
                               float momentum, int64_t step) {

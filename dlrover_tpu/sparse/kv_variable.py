@@ -159,6 +159,29 @@ def _lib() -> ctypes.CDLL:
                 ctypes.c_float, ctypes.c_float, ctypes.c_float,
                 ctypes.c_int64,
             ]
+            lib.kv_sparse_apply_amsgrad.argtypes = [
+                ctypes.c_void_p, ctypes.c_void_p, ctypes.c_void_p,
+                ctypes.c_void_p, i64p, f32p, ctypes.c_int64,
+                ctypes.c_float, ctypes.c_float, ctypes.c_float,
+                ctypes.c_float, ctypes.c_int64,
+            ]
+            lib.kv_sparse_apply_radam.argtypes = [
+                ctypes.c_void_p, ctypes.c_void_p, ctypes.c_void_p,
+                i64p, f32p, ctypes.c_int64, ctypes.c_float,
+                ctypes.c_float, ctypes.c_float, ctypes.c_float,
+                ctypes.c_int64,
+            ]
+            lib.kv_sparse_apply_adadelta.argtypes = [
+                ctypes.c_void_p, ctypes.c_void_p, ctypes.c_void_p,
+                i64p, f32p, ctypes.c_int64, ctypes.c_float,
+                ctypes.c_float, ctypes.c_float, ctypes.c_int64,
+            ]
+            lib.kv_sparse_apply_adahessian.argtypes = [
+                ctypes.c_void_p, ctypes.c_void_p, ctypes.c_void_p,
+                i64p, f32p, f32p, ctypes.c_int64, ctypes.c_float,
+                ctypes.c_float, ctypes.c_float, ctypes.c_float,
+                ctypes.c_float, ctypes.c_int64,
+            ]
             _LIB = lib
     return _LIB
 
@@ -410,6 +433,59 @@ class KvVariable:
                 lr, kw.get("beta1", 0.9), kw.get("beta2", 0.999),
                 kw.get("eps", 1e-16), max(step, 1),
             )
+        elif optimizer == "amsgrad":
+            lib.kv_sparse_apply_amsgrad(
+                h,
+                self._slot("m").handle,
+                self._slot("v").handle,
+                self._slot("vhat").handle,
+                ukeys, ugrads, ukeys.size,
+                lr, kw.get("beta1", 0.9), kw.get("beta2", 0.999),
+                kw.get("eps", 1e-8), max(step, 1),
+            )
+        elif optimizer == "radam":
+            lib.kv_sparse_apply_radam(
+                h,
+                self._slot("m").handle,
+                self._slot("v").handle,
+                ukeys, ugrads, ukeys.size,
+                lr, kw.get("beta1", 0.9), kw.get("beta2", 0.999),
+                kw.get("eps", 1e-8), max(step, 1),
+            )
+        elif optimizer == "adadelta":
+            lib.kv_sparse_apply_adadelta(
+                h,
+                self._slot("accum_ad").handle,
+                self._slot("accum_update").handle,
+                ukeys, ugrads, ukeys.size,
+                lr, kw.get("rho", 0.95), kw.get("eps", 1e-6), step,
+            )
+        elif optimizer == "adahessian":
+            # The Hutchinson-estimated Hessian diagonal rows come from
+            # the trainer (same [n, dim] layout as grads) — the kernel
+            # cannot estimate curvature from gradients alone.
+            hessian = kw.get("hessian")
+            if hessian is None:
+                raise ValueError(
+                    "adahessian requires hessian= rows aligned with "
+                    "keys (Hutchinson diagonal estimates)"
+                )
+            hessian = np.ascontiguousarray(
+                hessian, np.float32
+            ).reshape(keys.size, self.embedding_dim)
+            uhess = np.zeros(
+                (ukeys.size, self.embedding_dim), np.float32
+            )
+            np.add.at(uhess, inv, hessian)
+            lib.kv_sparse_apply_adahessian(
+                h,
+                self._slot("m").handle,
+                self._slot("v").handle,
+                ukeys, ugrads, uhess, ukeys.size,
+                lr, kw.get("beta1", 0.9), kw.get("beta2", 0.999),
+                kw.get("eps", 1e-8),
+                kw.get("hessian_power", 1.0), max(step, 1),
+            )
         else:
             raise ValueError(f"unknown sparse optimizer {optimizer!r}")
 
@@ -516,7 +592,8 @@ class KvVariable:
 class SparseOptimizer:
     """Convenience: one object applying the same rule to many
     KvVariables. Rules: adam | adagrad | ftrl | momentum | lamb |
-    adabelief | group_adam | group_ftrl — the group_* variants carry
+    adabelief | amsgrad | radam | adadelta | adahessian |
+    group_adam | group_ftrl — the group_* variants carry
     the reference's group-lasso L21 row sparsification
     (tfplus python/training/group_adam.py, sparse_group_ftrl.py;
     kernels in native/kv_store.cc)."""

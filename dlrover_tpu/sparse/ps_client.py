@@ -141,13 +141,21 @@ class DistributedKvClient:
         step: int,
         optimizer: str = "adam",
         lr: float = 1e-3,
+        hessian=None,
         **hyperparams,
     ) -> None:
+        """``hessian``: per-key auxiliary rows in the same layout as
+        ``grads`` (adahessian's Hutchinson diagonal estimates); sliced
+        per shard alongside the gradients."""
         keys = np.ascontiguousarray(keys, np.int64).ravel()
         dim = self.embedding_dims[table]
         grads = np.ascontiguousarray(grads, np.float32).reshape(
             keys.size, dim
         )
+        if hessian is not None:
+            hessian = np.ascontiguousarray(
+                hessian, np.float32
+            ).reshape(keys.size, dim)
 
         def call(addr, version, sub_keys, idx):
             self._client_for(addr).get(msg.PsApplyRequest(
@@ -155,6 +163,11 @@ class DistributedKvClient:
                 optimizer=optimizer,
                 keys=msg.Tensor.from_numpy(sub_keys),
                 grads=msg.Tensor.from_numpy(grads[idx]),
+                aux=(
+                    msg.Tensor.from_numpy(hessian[idx])
+                    if hessian is not None
+                    else None
+                ),
                 step=step,
                 lr=lr,
                 hyperparams=dict(hyperparams),

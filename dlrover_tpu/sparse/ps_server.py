@@ -175,11 +175,14 @@ class PsServer:
         self._count()
         keys = req.keys.to_numpy()
         grads = req.grads.to_numpy()
+        extra = {}
+        if req.aux is not None:
+            extra["hessian"] = req.aux.to_numpy()
         with self._lock:
             self._check_version(req.map_version, keys)
             self._tables[req.table].apply_gradients(
                 req.optimizer, keys, grads, req.step, lr=req.lr,
-                **req.hyperparams,
+                **extra, **req.hyperparams,
             )
 
     # -- reshard / checkpoint -------------------------------------------

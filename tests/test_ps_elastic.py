@@ -269,3 +269,25 @@ class TestCheckpointFlush:
         third = mgr.flush_all(step=3)
         assert 0 < third <= 8
         client.close()
+
+
+class TestAuxTensorWire:
+    def test_adahessian_over_the_wire(self, cluster):
+        """The aux tensor (Hutchinson Hessian diagonals) rides
+        PsApplyRequest next to the gradients and is sliced per shard
+        exactly like them."""
+        mgr, servers, _ = cluster
+        client = _make_client(mgr)
+        keys = np.arange(64, dtype=np.int64)
+        before = client.lookup("emb", keys).copy()
+        grads = np.random.default_rng(0).normal(
+            size=(64, DIMS["emb"])
+        ).astype(np.float32)
+        client.apply_gradients(
+            "emb", keys, grads, step=1, optimizer="adahessian",
+            lr=0.1, hessian=grads, hessian_power=1.0,
+        )
+        after = client.lookup("emb", keys, train=False)
+        assert not np.allclose(before, after)
+        assert np.isfinite(after).all()
+        client.close()

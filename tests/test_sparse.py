@@ -497,3 +497,154 @@ class TestHybridStorageTier:
         removed = kv.evict(min_frequency=2)  # all rows have freq 1
         assert removed == total
         assert len(kv) == 0
+
+
+def test_sparse_amsgrad_matches_torch_per_row():
+    """Fused C++ sparse AMSGrad == torch.optim.Adam(amsgrad=True):
+    the max accumulator runs on the RAW second moment with the bias
+    correction applied in the denominator (torch convention; optax
+    instead maxes the bias-corrected moment, which differs early)."""
+    torch = pytest.importorskip("torch")
+
+    dim = 8
+    kv = KvVariable("emb", embedding_dim=dim, seed=13)
+    keys = np.array([5, 9], np.int64)
+    init_vals = kv.gather(keys).copy()
+    grads = np.random.default_rng(3).normal(size=(2, dim)).astype(
+        np.float32
+    )
+
+    p = torch.nn.Parameter(torch.tensor(init_vals))
+    opt = torch.optim.Adam(
+        [p], lr=1e-2, betas=(0.9, 0.999), eps=1e-8, amsgrad=True
+    )
+    for step in range(1, 5):
+        kv.apply_gradients(
+            "amsgrad", keys, grads, step=step, lr=1e-2, eps=1e-8,
+        )
+        opt.zero_grad()
+        p.grad = torch.tensor(grads)
+        opt.step()
+    np.testing.assert_allclose(
+        kv.gather(keys, train=False), p.detach().numpy(),
+        atol=1e-5, rtol=1e-4,
+    )
+
+
+def test_sparse_radam_matches_optax():
+    """Both unrectified (early steps at beta2=0.999: rho_t <= 4) and
+    rectified (beta2=0.9 crosses the threshold fast) regimes."""
+    import jax.numpy as jnp
+    import optax
+
+    for beta2, steps in ((0.999, 3), (0.9, 12)):
+        dim = 8
+        kv = KvVariable("emb", embedding_dim=dim, seed=14)
+        keys = np.array([2, 6], np.int64)
+        init_vals = kv.gather(keys).copy()
+        grads = np.random.default_rng(4).normal(
+            size=(2, dim)
+        ).astype(np.float32)
+        opt = optax.radam(
+            1e-2, b2=beta2, eps=1e-8, threshold=4.0
+        )
+        dense = jnp.asarray(init_vals)
+        state = opt.init(dense)
+        for step in range(1, steps + 1):
+            kv.apply_gradients(
+                "radam", keys, grads, step=step, lr=1e-2,
+                beta2=beta2, eps=1e-8,
+            )
+            updates, state = opt.update(
+                jnp.asarray(grads), state, dense
+            )
+            dense = optax.apply_updates(dense, updates)
+        np.testing.assert_allclose(
+            kv.gather(keys, train=False), np.asarray(dense),
+            atol=1e-5, rtol=1e-4,
+        )
+
+
+def test_sparse_adadelta_matches_optax():
+    import jax.numpy as jnp
+    import optax
+
+    dim = 8
+    kv = KvVariable("emb", embedding_dim=dim, seed=15)
+    keys = np.array([1, 7], np.int64)
+    init_vals = kv.gather(keys).copy()
+    grads = np.random.default_rng(5).normal(size=(2, dim)).astype(
+        np.float32
+    )
+    opt = optax.adadelta(0.5, rho=0.95, eps=1e-6)
+    dense = jnp.asarray(init_vals)
+    state = opt.init(dense)
+    for step in range(1, 6):
+        kv.apply_gradients(
+            "adadelta", keys, grads, step=step, lr=0.5,
+            rho=0.95, eps=1e-6,
+        )
+        updates, state = opt.update(jnp.asarray(grads), state, dense)
+        dense = optax.apply_updates(dense, updates)
+    np.testing.assert_allclose(
+        kv.gather(keys, train=False), np.asarray(dense),
+        atol=1e-5, rtol=1e-4,
+    )
+
+
+def test_sparse_adahessian_reduces_to_adam_when_h_equals_g():
+    """With hessian rows == gradient rows and hessian_power=1, the
+    AdaHessian second moment tracks g^2 — identical to Adam. A crisp
+    invariant of the kernel math (Yao et al. 2021 eq. 9-10)."""
+    dim = 8
+    kv_h = KvVariable("emb", embedding_dim=dim, seed=16)
+    kv_a = KvVariable("emb2", embedding_dim=dim, seed=16)
+    keys = np.array([3, 11], np.int64)
+    np.testing.assert_array_equal(
+        kv_h.gather(keys), kv_a.gather(keys)
+    )  # same seed -> same init
+    grads = np.random.default_rng(6).normal(size=(2, dim)).astype(
+        np.float32
+    )
+    for step in range(1, 4):
+        kv_h.apply_gradients(
+            "adahessian", keys, grads, step=step, lr=1e-2,
+            hessian=grads, hessian_power=1.0,
+        )
+        kv_a.apply_gradients("adam", keys, grads, step=step, lr=1e-2)
+    np.testing.assert_allclose(
+        kv_h.gather(keys, train=False),
+        kv_a.gather(keys, train=False),
+        atol=1e-6, rtol=1e-5,
+    )
+
+
+def test_sparse_adahessian_requires_hessian():
+    kv = KvVariable("emb", embedding_dim=4)
+    keys = np.array([1], np.int64)
+    grads = np.zeros((1, 4), np.float32)
+    with pytest.raises(ValueError, match="hessian"):
+        kv.apply_gradients("adahessian", keys, grads, step=1)
+
+
+def test_sparse_adahessian_power_dampens_adaptivity():
+    """hessian_power=0 collapses the denominator to 1+eps (pure
+    momentum); the two extremes must differ given curvature."""
+    dim = 4
+    kv0 = KvVariable("emb", embedding_dim=dim, seed=17)
+    kv1 = KvVariable("emb2", embedding_dim=dim, seed=17)
+    keys = np.array([1], np.int64)
+    grads = np.full((1, dim), 0.1, np.float32)
+    hess = np.full((1, dim), 2.0, np.float32)
+    for step in range(1, 3):
+        kv0.apply_gradients(
+            "adahessian", keys, grads, step=step, lr=1e-2,
+            hessian=hess, hessian_power=0.0,
+        )
+        kv1.apply_gradients(
+            "adahessian", keys, grads, step=step, lr=1e-2,
+            hessian=hess, hessian_power=1.0,
+        )
+    d0 = kv0.gather(keys, train=False)
+    d1 = kv1.gather(keys, train=False)
+    assert not np.allclose(d0, d1)
