@@ -53,6 +53,12 @@ class Op:
     kind: str = "matmul"
     weight_shape: Optional[Tuple[int, int]] = None
     bytes_per_param: int = 2  # bf16
+    # Per-edge override of plan_chain's global activation_bytes: the
+    # bytes of THIS op's output activation. Fill from a module profile
+    # (utils/module_profiler.ModuleCost.out_bytes per scope) so edges
+    # with expanded features (e.g. the 4x MLP hidden) pay their real
+    # reshard cost instead of the chain-wide average.
+    activation_bytes: Optional[float] = None
 
 
 @dataclasses.dataclass
@@ -108,21 +114,33 @@ def plan_chain(
             for op in ops
         ]
     INF = float("inf")
-    # reshard cost entering an op: from state a to state b
-    gather = activation_bytes  # S -> R all-gather
+    # Reshard cost entering an op: from state a to state b, priced by
+    # the bytes of the activation crossing that edge — the producing
+    # op's activation_bytes override when profiled, else the global.
     slice_ = 0.0  # R -> S is a local slice under GSPMD
 
-    def edge(a: str, b: str) -> float:
+    def edge(a: str, b: str, edge_bytes: float) -> float:
         if a == b:
             return 0.0
-        return gather if (a, b) == (S, R) else slice_
+        return edge_bytes if (a, b) == (S, R) else slice_
 
     # dp[state] = (cost, back-pointer list)
     dp: Dict[str, Tuple[float, List[Placement]]] = {
         R: (0.0, []),
         S: (INF, []),  # batch enters replicated
     }
+    prev_edge_bytes = activation_bytes
     for op in ops:
+        if op.activation_bytes is not None:
+            op_out_bytes = op.activation_bytes
+        elif op.kind == "elementwise":
+            # An elementwise op's output is the size of its input:
+            # inherit the flowing edge bytes so an un-annotated gelu
+            # between a profiled matmul and the reduce doesn't reset
+            # the price of the eventual gather to the global average.
+            op_out_bytes = prev_edge_bytes
+        else:
+            op_out_bytes = activation_bytes
         nxt: Dict[str, Tuple[float, List[Placement]]] = {
             R: (INF, []),
             S: (INF, []),
@@ -136,9 +154,9 @@ def plan_chain(
                         continue
                     cost = (
                         pcost
-                        + edge(prev_state, a)
+                        + edge(prev_state, a, prev_edge_bytes)
                         + mem_weight * wbytes
-                        + comm * activation_bytes
+                        + comm * op_out_bytes
                     )
                     if cost < nxt[b][0]:
                         spec = {
@@ -166,7 +184,7 @@ def plan_chain(
             for state, (pcost, ppath) in dp.items():
                 if pcost == INF:
                     continue
-                cost = pcost + edge(state, R)
+                cost = pcost + edge(state, R, prev_edge_bytes)
                 if cost < nxt[R][0]:
                     nxt[R] = (
                         cost,
@@ -176,6 +194,7 @@ def plan_chain(
         else:
             raise ValueError(f"unknown op kind {op.kind!r}")
         dp = nxt
+        prev_edge_bytes = op_out_bytes
 
     cost, path = dp[final_state]
     if cost == INF:

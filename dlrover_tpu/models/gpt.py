@@ -162,20 +162,27 @@ def _default_attention(q, k, v, causal=True):
 
 
 def _block(x, lp, cfg: GPTConfig, attn_fn):
-    """One transformer block. lp = this layer's param slice."""
+    """One transformer block. lp = this layer's param slice.
+
+    The ``jax.named_scope`` annotations are load-bearing: the
+    module profiler (utils/module_profiler.py) attributes FLOPs /
+    bytes per scope from the jaxpr, feeding the strategy engine's
+    roofline prior and the TP planner's per-edge costs."""
     B, T, E = x.shape
     H, D = cfg.n_head, cfg.head_dim
-    h = _layer_norm(x, lp["ln1_g"], lp["ln1_b"])
-    qkv = h @ lp["wqkv"]  # [B,T,3E]
-    q, k, v = jnp.split(qkv, 3, axis=-1)
-    q = q.reshape(B, T, H, D)
-    k = k.reshape(B, T, H, D)
-    v = v.reshape(B, T, H, D)
-    att = attn_fn(q, k, v).reshape(B, T, E)
-    x = x + att @ lp["wo"]
-    h = _layer_norm(x, lp["ln2_g"], lp["ln2_b"])
-    h = jax.nn.gelu(h @ lp["wi"] + lp["bi"])
-    x = x + h @ lp["wo2"] + lp["bo2"]
+    with jax.named_scope("attn"):
+        h = _layer_norm(x, lp["ln1_g"], lp["ln1_b"])
+        qkv = h @ lp["wqkv"]  # [B,T,3E]
+        q, k, v = jnp.split(qkv, 3, axis=-1)
+        q = q.reshape(B, T, H, D)
+        k = k.reshape(B, T, H, D)
+        v = v.reshape(B, T, H, D)
+        att = attn_fn(q, k, v).reshape(B, T, E)
+        x = x + att @ lp["wo"]
+    with jax.named_scope("mlp"):
+        h = _layer_norm(x, lp["ln2_g"], lp["ln2_b"])
+        h = jax.nn.gelu(h @ lp["wi"] + lp["bi"])
+        x = x + h @ lp["wo2"] + lp["bo2"]
     return x
 
 
@@ -213,8 +220,9 @@ def backbone(
     if attn_fn is None:
         attn_fn = default_attention_for(cfg)
     B, T = tokens.shape
-    x = params["wte"][tokens] + params["wpe"][:T][None]
-    x = x.astype(cfg.dtype)
+    with jax.named_scope("embed"):
+        x = params["wte"][tokens] + params["wpe"][:T][None]
+        x = x.astype(cfg.dtype)
     from dlrover_tpu.accelerate.remat import wire_block
 
     block = wire_block(
@@ -239,12 +247,13 @@ def forward(
     """tokens [B, T] int32 -> logits [B, T, vocab] float32."""
     x = backbone(params, tokens, cfg, attn_fn)
     # Tied embeddings (nanoGPT): logits via wte^T, f32 for stable loss.
-    logits = jnp.einsum(
-        "bte,ve->btv",
-        x,
-        params["wte"],
-        preferred_element_type=jnp.float32,
-    )
+    with jax.named_scope("head"):
+        logits = jnp.einsum(
+            "bte,ve->btv",
+            x,
+            params["wte"],
+            preferred_element_type=jnp.float32,
+        )
     return logits
 
 

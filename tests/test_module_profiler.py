@@ -1,0 +1,247 @@
+"""Per-module jaxpr profiler + roofline search prior.
+
+Parity target: AProfiler's per-module FLOPs/latency attribution
+feeding the strategy engine (atorch/utils/prof.py:39,490). The "done"
+criterion from the round brief: the strategy search finds the
+known-best config in fewer dry-runs when seeded by the profiler's
+roofline prior than by the memory prior.
+"""
+
+import functools
+
+import jax
+import jax.numpy as jnp
+import pytest
+
+from dlrover_tpu.utils.module_profiler import (
+    ModuleCost,
+    predict_step_time,
+    profile_modules,
+    total_cost,
+)
+
+
+def _toy(p, x):
+    with jax.named_scope("proj"):
+        h = x @ p["w1"]
+    with jax.named_scope("act"):
+        h = jax.nn.relu(h)
+    return h.sum()
+
+
+class TestAttribution:
+    def test_matmul_flops_exact(self):
+        p = {"w1": jnp.ones((64, 32))}
+        x = jnp.ones((8, 64))
+        costs = profile_modules(_toy, p, x)
+        # 2 * M * N * K = 2 * 8 * 32 * 64
+        assert costs["proj"].flops == pytest.approx(2 * 8 * 32 * 64)
+
+    def test_grad_roughly_doubles_matmul_flops(self):
+        p = {"w1": jnp.ones((64, 32))}
+        x = jnp.ones((8, 64))
+        fwd = profile_modules(_toy, p, x)["proj"].flops
+        both = profile_modules(_toy, p, x, grad=True)["proj"].flops
+        # value_and_grad differentiates wrt params only: fwd + dW
+        # (no dX matmul for a leaf input), plus small elementwise.
+        assert both == pytest.approx(2 * fwd, rel=0.1)
+
+    def test_scan_multiplies_by_length(self):
+        def f(p, x):
+            def body(c, _):
+                with jax.named_scope("cell"):
+                    return c @ p["w"], None
+            h, _ = jax.lax.scan(body, x, None, length=5)
+            return h.sum()
+
+        p = {"w": jnp.ones((16, 16))}
+        x = jnp.ones((4, 16))
+        costs = profile_modules(f, p, x)
+        assert costs["cell"].flops == pytest.approx(
+            5 * 2 * 4 * 16 * 16
+        )
+
+    def test_abstract_inputs_no_execution(self):
+        p = {"w1": jax.ShapeDtypeStruct((64, 32), jnp.float32)}
+        x = jax.ShapeDtypeStruct((8, 64), jnp.float32)
+        costs = profile_modules(_toy, p, x)
+        assert costs["proj"].flops > 0
+
+    def test_gpt_scopes_and_ordering(self):
+        from dlrover_tpu.models import gpt
+
+        cfg = gpt.GPTConfig.nano()
+        params = jax.eval_shape(
+            functools.partial(gpt.init_params, cfg=cfg),
+            jax.random.PRNGKey(0),
+        )
+        tok = jax.ShapeDtypeStruct((2, cfg.block_size), jnp.int32)
+        loss = functools.partial(gpt.loss_fn, cfg=cfg)
+        costs = profile_modules(
+            loss, params, tok, tok, grad=True, top_level_only=True
+        )
+        for scope in ("head", "mlp", "attn", "embed"):
+            assert scope in costs, costs.keys()
+        # nano GPT: the vocab head dominates, mlp has 2x the matmul
+        # volume of attention projections.
+        assert costs["head"].flops > costs["mlp"].flops
+        assert costs["mlp"].flops > costs["attn"].flops
+        # Nothing substantial left unattributed.
+        total = total_cost(costs)
+        assert costs.get("<root>", ModuleCost()).flops < (
+            0.05 * total.flops
+        )
+
+
+class TestRooflinePrior:
+    def _strategies(self):
+        from dlrover_tpu.accelerate.strategy import Strategy
+
+        mesh = (("data", 1),)
+        return (
+            Strategy(mesh, remat="none", micro_batch_size=2),
+            Strategy(mesh, remat="full", micro_batch_size=2),
+        )
+
+    def test_remat_costs_flops(self):
+        per_sample = ModuleCost(flops=1e12, bytes=1e9)
+        none_s, full_s = self._strategies()
+        t_none = predict_step_time(per_sample, none_s, 1)
+        t_full = predict_step_time(per_sample, full_s, 1)
+        assert t_full > t_none  # recompute is not free
+
+    def test_dtype_costs_bandwidth(self):
+        import dataclasses
+
+        # Bandwidth-bound regime: few FLOPs, lots of traffic.
+        per_sample = ModuleCost(flops=1e9, bytes=1e12)
+        none_s, _ = self._strategies()
+        f32_s = dataclasses.replace(none_s, dtype="float32")
+        assert predict_step_time(
+            per_sample, f32_s, 1
+        ) > predict_step_time(per_sample, none_s, 1)
+
+    def test_search_finds_known_best_in_fewer_dry_runs(self):
+        """The round's done-criterion, measured: when both remat
+        variants fit in memory, the known-best GPT config (no remat —
+        fewer FLOPs) is dry-run FIRST under the roofline prior, while
+        the memory prior (lower resident bytes = better) tries the
+        remat candidate first and needs one more dry-run."""
+        import dataclasses as dc
+
+        from dlrover_tpu.accelerate.analyser import (
+            analyse_model,
+            estimate_step_memory,
+        )
+        from dlrover_tpu.accelerate.api import _roofline_prior
+        from dlrover_tpu.accelerate.bayes_search import (
+            BayesStrategySearch,
+        )
+        from dlrover_tpu.models import gpt
+
+        cfg = dc.replace(
+            gpt.GPTConfig.nano(), n_layer=2, block_size=64
+        )
+        model_init = functools.partial(gpt.init_params, cfg=cfg)
+        model_loss = functools.partial(gpt.loss_fn, cfg=cfg)
+        tok = jnp.zeros((2, cfg.block_size), jnp.int32)
+        candidates = list(self._strategies())
+        best = candidates[0]  # no-remat: fewer FLOPs, fits easily
+
+        roof = _roofline_prior(
+            model_init, model_loss, (tok, tok), candidates, 1
+        )
+        assert roof is not None
+
+        analysis = analyse_model(model_init)
+        mem = [
+            estimate_step_memory(analysis, s, 1 << 20, 16 << 30)[0]
+            for s in candidates
+        ]
+
+        def dry_runs_until_best(prior):
+            search = BayesStrategySearch(
+                candidates, cost_prior=prior
+            )
+            for n in range(1, len(candidates) + 1):
+                cand = search.suggest()
+                if cand == best:
+                    return n
+                search.observe(cand, 1.0)  # any finite throughput
+            return len(candidates) + 1
+
+        n_roofline = dry_runs_until_best(roof)
+        n_memory = dry_runs_until_best(mem)
+        assert n_roofline == 1
+        assert n_roofline < n_memory
+
+
+class TestCompileCache:
+    def test_enable_sets_config_and_creates_dir(self, tmp_path):
+        from dlrover_tpu.accelerate.api import (
+            enable_persistent_compile_cache,
+        )
+
+        old = jax.config.jax_compilation_cache_dir
+        try:
+            jax.config.update("jax_compilation_cache_dir", None)
+            d = enable_persistent_compile_cache(
+                str(tmp_path / "xla")
+            )
+            assert (tmp_path / "xla").is_dir()
+            assert jax.config.jax_compilation_cache_dir == d
+            # A configured cache is never clobbered.
+            d2 = enable_persistent_compile_cache(
+                str(tmp_path / "other")
+            )
+            assert d2 == d
+            assert jax.config.jax_compilation_cache_dir == d
+        finally:
+            jax.config.update("jax_compilation_cache_dir", old)
+
+
+class TestTpPlannerPerEdgeBytes:
+    def test_profiled_edge_bytes_change_the_plan(self):
+        from dlrover_tpu.accelerate.tp_planner import Op, plan_chain
+
+        # A 2-matmul chain ending in a reduce (must be R). With a huge
+        # SECOND activation, ending m2 sharded and gathering is
+        # expensive, so m2 should go "row" (psum) when its true output
+        # bytes are known; with the uniform default both matmuls look
+        # alike.
+        ops = [
+            Op("m1", "matmul", (256, 256)),
+            Op("m2", "matmul", (256, 256),
+               activation_bytes=64_000_000.0),
+            Op("loss", "reduce"),
+        ]
+        plan = plan_chain(
+            ops, tensor_size=4, activation_bytes=1000.0,
+            mem_weight=8.0,
+        )
+        by_name = {p.name: p for p in plan}
+        # m2's output is enormous: the planner must not leave it
+        # sharded-then-gathered NOR psum it (comm is priced in its
+        # own bytes); the cheap path is column m1 (R->S) then row m2
+        # paying psum... which costs 64MB — worse than replicating
+        # m2's weight (256*256*2 bytes * 8 weight) — so m2 ends
+        # replicated on the S path is impossible (needs R in). The
+        # exact optimum: m1 column (R->S), m2 row (S->R) would pay
+        # 64e6; m1+m2 replicated pays 2*8*128KB ~ 2e6. Assert the
+        # planner avoids the 64 MB move.
+        assert by_name["m2"].strategy != "row"
+        # And with uniform small bytes the classic megatron pairing
+        # IS chosen — the override is what changed the plan.
+        plan_uniform = plan_chain(
+            [
+                Op("m1", "matmul", (256, 256)),
+                Op("m2", "matmul", (256, 256)),
+                Op("loss", "reduce"),
+            ],
+            tensor_size=4,
+            activation_bytes=1000.0,
+            mem_weight=8.0,
+        )
+        by_name_u = {p.name: p for p in plan_uniform}
+        assert by_name_u["m1"].strategy == "column"
+        assert by_name_u["m2"].strategy == "row"
