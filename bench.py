@@ -39,9 +39,6 @@ import time
 
 REFERENCE_HFU = 0.496
 
-# Peak bf16 TFLOP/s per chip by TPU generation.
-PEAK_TFLOPS = {"v4": 275.0, "v5e": 197.0, "v5p": 459.0, "v6e": 918.0}
-
 _PROBE_SRC = """
 import os, time
 import jax
@@ -58,23 +55,16 @@ print("PROBE_OK", len(jax.devices()), round(time.time() - t0, 1))
 
 
 def detect_peak_tflops() -> float:
+    # Table + device-kind resolution live in utils/profiler.py (the
+    # single source of truth); only the measurement child calls this,
+    # so the jax-importing module is safe to pull in here.
+    from dlrover_tpu.utils.profiler import PEAK_TFLOPS, chip_peaks
+
     gen = os.getenv("PALLAS_AXON_TPU_GEN", "")
     for key, val in PEAK_TFLOPS.items():
         if key in gen:
             return val
-    import jax
-
-    # device_kind strings look like "TPU v4", "TPU v5 lite", "TPU v5p",
-    # "TPU v6 lite" — "lite" marks the e variants.
-    kind = jax.devices()[0].device_kind.lower()
-    lite = "lite" in kind or "e" in kind.split("v")[-1][:2]
-    for ver in ("v6", "v5", "v4"):
-        if ver in kind:
-            if ver == "v4":
-                return PEAK_TFLOPS["v4"]
-            key = ver + ("e" if lite else "p")
-            return PEAK_TFLOPS.get(key, PEAK_TFLOPS["v5e"])
-    return 197.0  # unknown: assume v5e
+    return chip_peaks()[0]
 
 
 def measure() -> int:

@@ -130,6 +130,7 @@ class JobManager:
         pending_timeout: Optional[float] = None,
         role_policies: Optional[Dict[str, RolePolicy]] = None,
         critical_workers: str = "",
+        monitor_interval: float = 30.0,
     ):
         from dlrover_tpu.common.config import Context
 
@@ -143,6 +144,7 @@ class JobManager:
             if pending_timeout is None else pending_timeout
         )
         self._next_node_id = 0
+        self._monitor_interval = monitor_interval
         self._stop = threading.Event()
         self._monitor_thread: Optional[threading.Thread] = None
         # subscribers: fn(node, event_type)
@@ -486,7 +488,7 @@ class JobManager:
         self._monitor_thread.start()
 
     def _monitor_loop(self) -> None:
-        while not self._stop.wait(30.0):
+        while not self._stop.wait(self._monitor_interval):
             self.check_nodes_once()
 
     def check_nodes_once(self) -> None:

@@ -34,6 +34,14 @@ def parse_args(argv=None):
     # Standalone evaluator nodes the master schedules; the trainer's
     # evaluate loop attaches to them (role: NodeType.EVALUATOR).
     parser.add_argument("--evaluator_count", type=int, default=0)
+    # Node-death detection knobs (drills/tests tighten these; the
+    # defaults match production pod-failure budgets).
+    parser.add_argument(
+        "--heartbeat_timeout", type=float, default=180.0
+    )
+    parser.add_argument(
+        "--monitor_interval", type=float, default=30.0
+    )
     return parser.parse_args(argv)
 
 
@@ -48,6 +56,8 @@ def main(argv=None) -> int:
             rdzv_timeout=args.rdzv_timeout,
             critical_workers=args.critical_workers,
             evaluator_count=args.evaluator_count,
+            heartbeat_timeout=args.heartbeat_timeout,
+            monitor_interval=args.monitor_interval,
         )
     except ValueError as exc:
         logger.error("invalid arguments: %s", exc)

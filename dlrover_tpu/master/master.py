@@ -39,6 +39,8 @@ class JobMaster:
         scaler: Optional[Scaler] = None,
         critical_workers: str = "",
         evaluator_count: int = 0,
+        heartbeat_timeout: float = 180.0,
+        monitor_interval: float = 30.0,
     ):
         """``node_num`` is the desired (max) world size; ``min_nodes``
         (default = node_num) is the smallest world the job may proceed
@@ -49,7 +51,10 @@ class JobMaster:
         self.node_num = node_num
         self.evaluator_count = evaluator_count
         self.job_manager = JobManager(
-            scaler=scaler, critical_workers=critical_workers
+            scaler=scaler,
+            critical_workers=critical_workers,
+            heartbeat_timeout=heartbeat_timeout,
+            monitor_interval=monitor_interval,
         )
         self.task_manager = TaskManager()
         self.speed_monitor = SpeedMonitor()
@@ -104,6 +109,22 @@ class JobMaster:
         if node.type not in (NodeType.EVALUATOR, NodeType.EMBEDDING):
             for rdzv in (self.elastic_rdzv, self.check_rdzv):
                 rdzv.remove_alive_node(node.id, node_rank=node.rank)
+            # Survivors must not block on collectives with the dead
+            # peer until some long transport timeout: push a restart
+            # so their next heartbeat sends them back to rendezvous,
+            # which completes with the shrunken world (>= min_nodes).
+            # (ref: torch elastic restarts the worker group on
+            # membership change, elastic_agent/torch/training.py:564.)
+            from dlrover_tpu.common.constants import EventAction
+
+            for peer in self.job_manager.alive_nodes():
+                if peer.id != node.id and peer.type in (
+                    NodeType.WORKER,
+                    NodeType.CHIEF,
+                ):
+                    self.servicer.push_action(
+                        peer.id, EventAction.RESTART_TRAINING.value
+                    )
         if node.type == NodeType.EMBEDDING:
             # A dead PS host (heartbeat timeout / cluster event): move
             # its partitions to the survivors now — clients are already

@@ -82,6 +82,11 @@ def parse_args(argv=None):
     )
     parser.add_argument("--rdzv_timeout", type=float, default=600.0)
     parser.add_argument(
+        "--heartbeat_interval", type=float, default=15.0,
+        help="agent->master heartbeat cadence (drills tighten this "
+        "together with the master's --heartbeat_timeout)",
+    )
+    parser.add_argument(
         "--role",
         type=str,
         default="worker",
@@ -227,6 +232,7 @@ def run(args) -> int:
         network_check=args.network_check,
         exclude_straggler=args.exclude_straggler,
         rdzv_timeout=args.rdzv_timeout,
+        heartbeat_interval=args.heartbeat_interval,
     )
     agent = ElasticAgent(config, entry_cmd)
     try:

@@ -171,23 +171,78 @@ class ElasticTrainer:
     def shard_microbatches(
         self, tokens, targets
     ) -> Tuple[jax.Array, jax.Array]:
-        """[accum * micro * shards, ...] host arrays ->
-        [accum, micro * shards, ...] device arrays laid out on the
-        mesh."""
-        accum = self.accum_steps
-        n = accum * self.micro_batch_size * self.num_shards
-        tokens = tokens[:n].reshape((accum, -1) + tokens.shape[1:])
-        targets = targets[:n].reshape((accum, -1) + targets.shape[1:])
+        """Host arrays -> [accum, micro * shards, ...] device arrays
+        laid out on the mesh.
+
+        Single-process: pass the full global batch
+        ([samples_per_step, ...]). Multi-process: each process passes
+        only ITS portion ([local_samples_per_step, ...] — the samples
+        its sharded sampler produced); the global array is assembled
+        from the per-process shards, never requiring (or silently
+        duplicating) identical host data across processes."""
         spec = prune_specs_to_mesh(self.mesh, self._mb_spec)
         sharding = NamedSharding(self.mesh, spec)
+        accum = self.accum_steps
+        n_proc = jax.process_count()
+        if n_proc <= 1:
+            n = self.samples_per_step
+            tokens = tokens[:n].reshape(
+                (accum, -1) + tokens.shape[1:]
+            )
+            targets = targets[:n].reshape(
+                (accum, -1) + targets.shape[1:]
+            )
+            return (
+                jax.device_put(tokens, sharding),
+                jax.device_put(targets, sharding),
+            )
+        import numpy as np
+
+        n = self.local_samples_per_step
+        global_mb = self.micro_batch_size * self.num_shards
+        local = np.asarray(tokens[:n]).reshape(
+            (accum, -1) + tuple(tokens.shape[1:])
+        )
+        local_t = np.asarray(targets[:n]).reshape(
+            (accum, -1) + tuple(targets.shape[1:])
+        )
+        gshape = lambda a: (accum, global_mb) + a.shape[2:]  # noqa: E731
         return (
-            jax.device_put(tokens, sharding),
-            jax.device_put(targets, sharding),
+            jax.make_array_from_process_local_data(
+                sharding, local, gshape(local)
+            ),
+            jax.make_array_from_process_local_data(
+                sharding, local_t, gshape(local_t)
+            ),
         )
 
     @property
     def samples_per_step(self) -> int:
         return self.accum_steps * self.micro_batch_size * self.num_shards
+
+    @property
+    def local_samples_per_step(self) -> int:
+        """Samples THIS process must supply per optimizer step (its
+        sharded sampler's slice of the global batch).
+
+        Requires the batch-sharding mesh axes (data/fsdp) to span
+        whole processes — num_shards divisible by process_count — so
+        every process owns an equal contiguous slice of every
+        microbatch. A mesh whose batch axes do NOT cover all
+        processes (e.g. tensor-parallel-only multi-host) replicates
+        the batch across processes, which this per-process-slice
+        contract cannot express; feed pre-sharded device arrays to
+        train_step directly in that regime."""
+        n_proc = jax.process_count()
+        if self.num_shards % n_proc:
+            raise ValueError(
+                f"batch shards ({self.num_shards}) not divisible by "
+                f"processes ({n_proc}): the batch axes of this mesh "
+                "do not span whole hosts, so a per-process batch "
+                "slice does not exist — pass pre-sharded arrays to "
+                "train_step instead"
+            )
+        return self.samples_per_step // n_proc
 
     def train_step(self, params, opt_state, tokens, targets):
         """One optimizer update over ``accum`` microbatches.

@@ -182,17 +182,21 @@ class Trainer:
                 sampler.load_state_dict(dict(sampler_state))
             else:
                 # Old checkpoint without sampler state: estimate with
-                # the trainer's rounded-up samples_per_step, not the
-                # raw global batch size.
+                # the per-process draw (the loader pulls
+                # local_samples_per_step from this process's shard),
+                # not the global batch size.
                 sampler.consumed = (
-                    start_step * trainer.samples_per_step
+                    start_step * trainer.local_samples_per_step
                 ) % max(len(self.dataset), 1)
             logger.info("resumed from checkpoint step %d", start_step)
         trainer.step_num = start_step
 
+        # Each process loads only ITS slice of the global batch (the
+        # sampler is process-sharded); shard_microbatches assembles
+        # the global device array from the per-process portions.
         loader = ElasticDataLoader(
             self.dataset,
-            batch_size=trainer.samples_per_step,
+            batch_size=trainer.local_samples_per_step,
             sampler=sampler,
             collate_fn=self.collate_fn,
         )

@@ -35,15 +35,7 @@ from dlrover_tpu.common.log import get_logger
 
 logger = get_logger("module_profiler")
 
-# Peak bf16 TFLOP/s and HBM GB/s per chip by generation (public specs;
-# same table family as bench.py / utils/profiler.py).
-PEAK_TFLOPS = {"v4": 275.0, "v5e": 197.0, "v5p": 459.0, "v6e": 918.0}
-PEAK_HBM_GBPS = {
-    "v4": 1228.0,
-    "v5e": 819.0,
-    "v5p": 2765.0,
-    "v6e": 1640.0,
-}
+from dlrover_tpu.utils.profiler import chip_peaks  # noqa: E402
 
 
 @dataclasses.dataclass
@@ -251,22 +243,6 @@ _REMAT_FLOPS_FACTOR = {
 _DTYPE_BYTES_FACTOR = {"bfloat16": 1.0, "float32": 2.0, "half": 1.0}
 
 
-def _chip_peaks() -> Tuple[float, float]:
-    """(TFLOP/s, GB/s) of the current chip; CPU falls back to a
-    nominal ratio that still ranks compute-bound vs bandwidth-bound
-    candidates sensibly."""
-    if jax.default_backend() == "tpu":
-        kind = jax.devices()[0].device_kind.lower()
-        lite = "lite" in kind
-        for ver in ("v6", "v5", "v4"):
-            if ver in kind:
-                key = "v4" if ver == "v4" else ver + (
-                    "e" if lite else "p"
-                )
-                return PEAK_TFLOPS[key], PEAK_HBM_GBPS[key]
-    return PEAK_TFLOPS["v5e"], PEAK_HBM_GBPS["v5e"]
-
-
 def predict_step_time(
     per_sample: ModuleCost,
     strategy,
@@ -284,7 +260,7 @@ def predict_step_time(
     numbers are rough; the RANKING is what seeds the search.
     """
     if peak_tflops is None or peak_hbm_gbps is None:
-        pf, pb = _chip_peaks()
+        pf, pb = chip_peaks()
         peak_tflops = peak_tflops or pf
         peak_hbm_gbps = peak_hbm_gbps or pb
     from dlrover_tpu.accelerate.remat import canonical

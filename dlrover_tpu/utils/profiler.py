@@ -17,8 +17,36 @@ from typing import Any, Callable, Dict, Optional, Tuple
 
 import jax
 
-# Peak bf16 TFLOP/s per chip (same table as bench.py).
+# Peak bf16 TFLOP/s and HBM GB/s per chip by generation (public
+# specs). The single source of truth — bench.py and the module
+# profiler read these tables.
 PEAK_TFLOPS = {"v4": 275.0, "v5e": 197.0, "v5p": 459.0, "v6e": 918.0}
+PEAK_HBM_GBPS = {
+    "v4": 1228.0,
+    "v5e": 819.0,
+    "v5p": 2765.0,
+    "v6e": 1640.0,
+}
+
+
+def chip_peaks(default: str = "v5e") -> Tuple[float, float]:
+    """(peak TFLOP/s, peak HBM GB/s) of the current backend's chip.
+    Unknown kinds (new generations, CPU) fall back to ``default`` so
+    rankings still work rather than raising."""
+    key = default
+    if jax.default_backend() == "tpu":
+        kind = jax.devices()[0].device_kind.lower()
+        lite = "lite" in kind or "e" in kind.split("v")[-1][:2]
+        for ver in ("v6", "v5", "v4"):
+            if ver in kind:
+                key = "v4" if ver == "v4" else ver + (
+                    "e" if lite else "p"
+                )
+                break
+    return (
+        PEAK_TFLOPS.get(key, PEAK_TFLOPS[default]),
+        PEAK_HBM_GBPS.get(key, PEAK_HBM_GBPS[default]),
+    )
 
 
 @dataclasses.dataclass

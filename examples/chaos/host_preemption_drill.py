@@ -1,0 +1,235 @@
+"""Two-host elasticity drill: kill one host (agent + trainer), verify
+the survivor re-rendezvouses into the shrunken world, resumes from the
+flash checkpoint, and the killed host later rejoins to re-grow the
+world (ref: torch elastic's membership-change restart,
+elastic_agent/torch/training.py:564-619; BASELINE north star: recover
+to >=90% throughput within 120 s of a host preemption).
+
+Topology: one master (tight failure-detection knobs), two agents as
+separate OS processes, each spawning a trainer that does a REAL
+jax.distributed init over a 2-process CPU world (2 virtual devices
+per process). The kill is a SIGKILL of host 1's whole process group —
+no orderly shutdown, no checkpoint flush, exactly a preempted VM.
+
+Recovery chain exercised end to end:
+  master heartbeat watchdog -> node DELETED -> rendezvous alive-set
+  shrink + RESTART_TRAINING pushed to survivors -> survivor agent
+  kills its (blocked) trainer -> re-rendezvous (world 2 -> 1) ->
+  jax.distributed re-init -> flash-checkpoint restore -> stepping.
+Then host 1 relaunches: join -> num_nodes_waiting>0 on the survivor
+-> restart -> world 1 -> 2 -> both stepping again.
+
+Run: python examples/chaos/host_preemption_drill.py
+     [--steps 400] [--output RECOVERY_2HOST.json]
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import signal
+import subprocess
+import sys
+import tempfile
+import time
+
+REPO = os.path.dirname(
+    os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+)
+
+
+def read_step(path: str):
+    try:
+        with open(path) as f:
+            d = json.load(f)
+        return int(d.get("step", -1)), float(d.get("ts", 0.0))
+    except (OSError, ValueError):
+        return -1, 0.0
+
+
+def start_master(tmp: str):
+    proc = subprocess.Popen(
+        [
+            sys.executable, "-m", "dlrover_tpu.master.main",
+            "--node_num", "2", "--min_nodes", "1",
+            "--rdzv_timeout", "5",
+            "--heartbeat_timeout", "6",
+            "--monitor_interval", "2",
+        ],
+        stdout=subprocess.PIPE,
+        stderr=open(os.path.join(tmp, "master.log"), "w"),
+        text=True,
+        cwd=REPO,
+        env={**os.environ, "JAX_PLATFORMS": "cpu"},
+    )
+    deadline = time.time() + 30
+    port = None
+    while time.time() < deadline and port is None:
+        line = proc.stdout.readline()
+        if line.startswith("DLROVER_TPU_MASTER_PORT="):
+            port = int(line.strip().split("=")[1])
+    if port is None:
+        raise RuntimeError("master never printed its port")
+    return proc, f"127.0.0.1:{port}"
+
+
+def start_agent(
+    rank: int, master_addr: str, tmp: str, steps: int
+):
+    """One 'host': agent + its trainer, own process group, own
+    per-host job name (separate /dev/shm staging, like a real host)."""
+    env = {
+        **os.environ,
+        "JAX_PLATFORMS": "cpu",
+        "XLA_FLAGS": "--xla_force_host_platform_device_count=2",
+        "DLROVER_TPU_JOB_NAME": f"host_drill_n{rank}",
+        "DLROVER_TPU_METRICS_FILE": os.path.join(
+            tmp, f"metrics_n{rank}.json"
+        ),
+        "JAX_COMPILATION_CACHE_DIR": os.path.join(tmp, "jaxcache"),
+        "JAX_PERSISTENT_CACHE_MIN_ENTRY_SIZE_BYTES": "0",
+        "JAX_PERSISTENT_CACHE_MIN_COMPILE_TIME_SECS": "0",
+    }
+    return subprocess.Popen(
+        [
+            sys.executable, "-m", "dlrover_tpu.trainer.elastic_run",
+            "--nnodes", "1:2",
+            "--node_rank", str(rank),
+            "--nproc_per_node", "2",
+            "--master", master_addr,
+            "--heartbeat_interval", "2",
+            "--max_restarts", "6",
+            "--rdzv_timeout", "120",
+            "examples/nanogpt/train.py", "--",
+            "--smoke",
+            "--steps", str(steps),
+            "--checkpoint-dir", os.path.join(tmp, "ckpt"),
+            "--checkpoint-every", "5",
+            "--global-batch-size", "8",
+            "--micro-batch-size", "2",
+        ],
+        stdout=open(os.path.join(tmp, f"agent_n{rank}.log"), "w"),
+        stderr=subprocess.STDOUT,
+        cwd=REPO,
+        env=env,
+        start_new_session=True,  # own group: SIGKILL takes trainer too
+    )
+
+
+def wait_stepping(metrics: str, after_ts: float, deadline_s: float,
+                  min_step: int = 1):
+    """Block until the metrics file shows progress past after_ts;
+    returns (step, ts) or None on timeout."""
+    deadline = time.time() + deadline_s
+    prev = -1
+    while time.time() < deadline:
+        time.sleep(1.0)
+        step, ts = read_step(metrics)
+        if ts > after_ts and step >= min_step and step > prev >= 0:
+            return step, ts
+        if ts > after_ts and step >= min_step:
+            prev = step
+    return None
+
+
+def main() -> int:
+    p = argparse.ArgumentParser()
+    p.add_argument("--steps", type=int, default=400)
+    p.add_argument("--recovery-budget", type=float, default=120.0)
+    p.add_argument("--output", default="")
+    args = p.parse_args()
+
+    tmp = tempfile.mkdtemp(prefix="host_drill_")
+    m0 = os.path.join(tmp, "metrics_n0.json")
+    m1 = os.path.join(tmp, "metrics_n1.json")
+
+    master, addr = start_master(tmp)
+    agents = {}
+    try:
+        agents[0] = start_agent(0, addr, tmp, args.steps)
+        agents[1] = start_agent(1, addr, tmp, args.steps)
+
+        # Phase 0: both hosts stepping in the 2-node world.
+        t0 = time.time()
+        ok0 = wait_stepping(m0, t0 - 1, 600, min_step=3)
+        ok1 = wait_stepping(m1, t0 - 1, 600, min_step=3)
+        if not (ok0 and ok1):
+            print("DRILL FAIL: 2-host world never reached steady "
+                  "stepping; see", tmp)
+            return 1
+        pre_kill_step = max(ok0[0], ok1[0])
+        print(f"steady 2-host stepping at step ~{pre_kill_step}")
+
+        # Phase 1: preempt host 1 — SIGKILL its whole process group.
+        t_kill = time.time()
+        os.killpg(agents[1].pid, signal.SIGKILL)
+        agents[1].wait()
+        print("host 1 preempted (SIGKILL of agent+trainer)")
+
+        resumed = wait_stepping(
+            m0, t_kill, args.recovery_budget, min_step=1
+        )
+        if resumed is None:
+            print("DRILL FAIL: survivor never resumed; see", tmp)
+            return 1
+        shrink_recovery_s = resumed[1] - t_kill
+        resumed_step = resumed[0]
+        print(
+            f"survivor resumed at step {resumed_step} "
+            f"{shrink_recovery_s:.1f}s after the kill (world 2 -> 1)"
+        )
+        with open(os.path.join(tmp, "agent_n0.log")) as f:
+            log0 = f.read()
+        shrank = "rank=0/1" in log0
+        # Phase 2: host 1 comes back and the world re-grows.
+        t_rejoin = time.time()
+        agents[1] = start_agent(1, addr, tmp, args.steps)
+        regrown = wait_stepping(
+            m1, t_rejoin, args.recovery_budget * 2, min_step=1
+        )
+        rejoin_recovery_s = (
+            regrown[1] - t_rejoin if regrown else None
+        )
+        if regrown:
+            print(
+                f"host 1 rejoined and is stepping again "
+                f"{rejoin_recovery_s:.1f}s after relaunch "
+                "(world 1 -> 2)"
+            )
+
+        result = {
+            "drill": "host_preemption_2host",
+            "shrink_recovery_s": round(shrink_recovery_s, 1),
+            "rejoin_recovery_s": (
+                round(rejoin_recovery_s, 1) if regrown else None
+            ),
+            "pre_kill_step": pre_kill_step,
+            "resumed_step": resumed_step,
+            "world_shrank_to_one": shrank,
+            "world_regrew": bool(regrown),
+            "within_budget": shrink_recovery_s
+            <= args.recovery_budget,
+            "recovery_budget_s": args.recovery_budget,
+        }
+        print(json.dumps(result))
+        if args.output:
+            with open(args.output, "w") as f:
+                json.dump(result, f, indent=1)
+        return 0 if (result["within_budget"] and shrank) else 1
+    finally:
+        for a in agents.values():
+            if a.poll() is None:
+                try:
+                    os.killpg(a.pid, signal.SIGKILL)
+                except ProcessLookupError:
+                    pass
+        master.terminate()
+        try:
+            master.wait(10)
+        except subprocess.TimeoutExpired:
+            master.kill()
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -165,7 +165,10 @@ def main(argv=None) -> int:
     tokens_seen = 0
     loss = float("nan")  # stays NaN when fully resumed (no steps left)
     for step in range(start_step + 1, args.steps + 1):
-        tok, tgt = next_batch(trainer.samples_per_step)
+        # Each process feeds its own shard of the global batch (the
+        # sampler is sharded by process); the trainer assembles the
+        # global device array from the per-process portions.
+        tok, tgt = next_batch(trainer.local_samples_per_step)
         params, opt_state, loss = trainer.train_step(
             params, opt_state, jnp.asarray(tok), jnp.asarray(tgt)
         )

@@ -166,3 +166,43 @@ def test_compressed_sync_on_multislice_outer_axis():
 def test_sync_bytes_accounting():
     assert sync_bytes_per_element(8) == 3.0  # vs 4.0 baseline
     assert sync_bytes_per_element(4) == 2.5
+
+
+def test_compressed_sync_on_two_slice_mesh_converges():
+    """Integration (VERDICT r2 item 9): the compressed gradient sync
+    running on a mesh whose data axis spans TWO virtual slices — the
+    quantized all-gather is the collective that rides DCN on real
+    multi-slice hardware — converges in parity with the exact step."""
+    mesh = build_mesh(
+        MeshConfig(data=8, num_slices=2),
+        slice_ids=[i // 4 for i in range(8)],
+    )
+    d = 512
+    w_true = jax.random.normal(jax.random.PRNGKey(5), (d,))
+    xs = jax.random.normal(jax.random.PRNGKey(6), (64, d))
+    ys = xs @ w_true
+
+    def loss_fn(params, x, y):
+        return jnp.mean((x @ params["w"] - y) ** 2)
+
+    opt = optax.sgd(0.05)
+    step_c = make_compressed_train_step(mesh, loss_fn, opt, bits=8)
+
+    def exact_step(p, s, x, y):
+        loss, g = jax.value_and_grad(loss_fn)(p, x, y)
+        u, s = opt.update(g, s, p)
+        return optax.apply_updates(p, u), s, {"loss": loss}
+
+    def run(step):
+        p = {"w": jnp.zeros((d,))}
+        s = opt.init(p)
+        for _ in range(40):
+            p, s, m = step(p, s, xs, ys)
+        return p, float(m["loss"])
+
+    p_c, l_c = run(step_c)
+    p_e, l_e = run(jax.jit(exact_step))
+    assert l_c < 1e-2
+    np.testing.assert_allclose(
+        np.asarray(p_c["w"]), np.asarray(p_e["w"]), atol=5e-2
+    )
