@@ -1,0 +1,63 @@
+"""Operator container entrypoint: ``python -m dlrover_tpu.operator.main``.
+
+In-cluster by default (service-account token + CA); ``--apiserver``
+points anywhere else (kind port-forward, the test's simulated
+apiserver). Ref: go/operator/main.go manager setup.
+"""
+
+from __future__ import annotations
+
+import argparse
+import signal
+import sys
+
+from dlrover_tpu.common.log import get_logger
+from dlrover_tpu.operator.k8s_client import K8sApi
+from dlrover_tpu.operator.runtime import OperatorRuntime
+
+logger = get_logger("operator.main")
+
+
+def parse_args(argv=None):
+    p = argparse.ArgumentParser("dlrover-tpu-operator")
+    p.add_argument(
+        "--apiserver", default="",
+        help="apiserver base URL (default: in-cluster config)",
+    )
+    p.add_argument("--namespace", default="")
+    p.add_argument("--resync", type=float, default=30.0)
+    p.add_argument(
+        "--leader-elect", action="store_true", dest="leader_elect",
+        help="coordination.k8s.io Lease leader election (run >1 "
+        "replica safely)",
+    )
+    return p.parse_args(argv)
+
+
+def main(argv=None) -> int:
+    args = parse_args(argv)
+    api = (
+        K8sApi(args.apiserver)
+        if args.apiserver
+        else K8sApi.in_cluster()
+    )
+    namespace = args.namespace or K8sApi.namespace()
+    runtime = OperatorRuntime(
+        api,
+        namespace,
+        resync_seconds=args.resync,
+        leader_elect=args.leader_elect,
+    )
+
+    def _term(signum, frame):
+        logger.info("signal %s; shutting down", signum)
+        runtime.stop()
+
+    signal.signal(signal.SIGTERM, _term)
+    signal.signal(signal.SIGINT, _term)
+    runtime.run()
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

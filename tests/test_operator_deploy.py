@@ -1,0 +1,416 @@
+"""Reconcile e2e against a simulated apiserver (VERDICT r2 item 3).
+
+The operator runtime + stdlib REST client are driven over REAL HTTP:
+a mini apiserver (http.server) stores pods / elasticjobs / scaleplans
+/ leases as JSON and speaks the list/create/merge-patch/delete verbs
+with label selectors. Watch requests return 400, exercising the
+documented fallback to list-based resync. Nothing is mocked inside the
+client — the HTTP wire is the seam.
+"""
+
+from __future__ import annotations
+
+import json
+import threading
+import time
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+from urllib.parse import parse_qs, urlparse
+
+import pytest
+
+from dlrover_tpu.operator.k8s_client import (
+    ApiError,
+    K8sApi,
+    LeaderElector,
+)
+from dlrover_tpu.operator.runtime import OperatorRuntime
+
+GROUP = "elastic.iml.github.io"
+NS = "default"
+
+
+class MiniApiServer:
+    """Enough of the k8s REST API for the operator: namespaced
+    collections with LIST (labelSelector), GET, POST, merge-PATCH,
+    PUT (resourceVersion compare-and-swap), DELETE. Real-server
+    fidelity where it bites: elasticjobs have a status SUBRESOURCE
+    (root patches silently drop .status, /status patches only apply
+    it — matching deploy/crd-elasticjob.yaml), and every write bumps
+    metadata.resourceVersion. Watch -> 400 (resync fallback path)."""
+
+    def __init__(self):
+        # path prefix -> {name: object}
+        self.store = {
+            "pods": {},
+            "elasticjobs": {},
+            "scaleplans": {},
+            "leases": {},
+        }
+        self._lock = threading.Lock()
+        server = self
+
+        class Handler(BaseHTTPRequestHandler):
+            def log_message(self, *a):  # quiet
+                pass
+
+            def _send(self, code, body=None):
+                data = json.dumps(body or {}).encode()
+                self.send_response(code)
+                self.send_header(
+                    "Content-Type", "application/json"
+                )
+                self.send_header("Content-Length", str(len(data)))
+                self.end_headers()
+                self.wfile.write(data)
+
+            def _route(self):
+                """-> (collection, name) or (None, None)."""
+                parts = urlparse(self.path).path.strip("/").split("/")
+                # /api/v1/namespaces/{ns}/pods[/{name}]
+                # /apis/{g}/{v}/namespaces/{ns}/{plural}[/{name}]
+                if parts[:2] == ["api", "v1"]:
+                    rest = parts[2:]
+                else:
+                    rest = parts[3:]
+                if len(rest) >= 3 and rest[0] == "namespaces":
+                    plural = rest[2]
+                    name = rest[3] if len(rest) > 3 else None
+                    sub = rest[4] if len(rest) > 4 else None
+                    return plural, name, sub
+                return None, None, None
+
+            def _read_body(self):
+                n = int(self.headers.get("Content-Length", 0))
+                return (
+                    json.loads(self.rfile.read(n)) if n else {}
+                )
+
+            def do_GET(self):
+                q = parse_qs(urlparse(self.path).query)
+                if q.get("watch") == ["true"]:
+                    return self._send(
+                        400, {"message": "watch not supported"}
+                    )
+                plural, name, _ = self._route()
+                if plural not in server.store:
+                    return self._send(404, {"message": "no route"})
+                with server._lock:
+                    if name:
+                        obj = server.store[plural].get(name)
+                        if obj is None:
+                            return self._send(
+                                404, {"message": f"{name} not found"}
+                            )
+                        return self._send(200, obj)
+                    items = list(server.store[plural].values())
+                sel = q.get("labelSelector", [""])[0]
+                if sel:
+                    key, _, val = sel.partition("=")
+                    items = [
+                        o
+                        for o in items
+                        if o.get("metadata", {})
+                        .get("labels", {})
+                        .get(key)
+                        == val
+                    ]
+                return self._send(200, {"items": items})
+
+            def do_POST(self):
+                plural, _, _ = self._route()
+                if plural not in server.store:
+                    return self._send(404, {"message": "no route"})
+                body = self._read_body()
+                name = body.get("metadata", {}).get("name", "")
+                with server._lock:
+                    if name in server.store[plural]:
+                        return self._send(
+                            409, {"message": "already exists"}
+                        )
+                    if plural == "pods":
+                        body.setdefault("status", {})[
+                            "phase"
+                        ] = "Running"
+                    body.setdefault("metadata", {})[
+                        "resourceVersion"
+                    ] = "1"
+                    server.store[plural][name] = body
+                return self._send(201, body)
+
+            @staticmethod
+            def _bump(obj):
+                meta = obj.setdefault("metadata", {})
+                meta["resourceVersion"] = str(
+                    int(meta.get("resourceVersion", "0")) + 1
+                )
+
+            def do_PATCH(self):
+                plural, name, sub = self._route()
+                if plural not in server.store or not name:
+                    return self._send(404, {"message": "no route"})
+                patch = self._read_body()
+                # Status-subresource semantics (elasticjobs enable it
+                # in deploy/crd-elasticjob.yaml): a root patch DROPS
+                # .status; only /status applies it — and applies
+                # nothing else.
+                if plural == "elasticjobs":
+                    if sub == "status":
+                        patch = {"status": patch.get("status", {})}
+                    else:
+                        patch = {
+                            k: v
+                            for k, v in patch.items()
+                            if k != "status"
+                        }
+                elif sub == "status":
+                    patch = {"status": patch.get("status", {})}
+
+                def merge(dst, src):
+                    for k, v in src.items():
+                        if isinstance(v, dict) and isinstance(
+                            dst.get(k), dict
+                        ):
+                            merge(dst[k], v)
+                        elif v is None:
+                            dst.pop(k, None)
+                        else:
+                            dst[k] = v
+
+                with server._lock:
+                    obj = server.store[plural].get(name)
+                    if obj is None:
+                        return self._send(
+                            404, {"message": f"{name} not found"}
+                        )
+                    merge(obj, patch)
+                    self._bump(obj)
+                return self._send(200, obj)
+
+            def do_PUT(self):
+                plural, name, _ = self._route()
+                if plural not in server.store or not name:
+                    return self._send(404, {"message": "no route"})
+                body = self._read_body()
+                rv = body.get("metadata", {}).get("resourceVersion")
+                with server._lock:
+                    obj = server.store[plural].get(name)
+                    if obj is None:
+                        return self._send(
+                            404, {"message": f"{name} not found"}
+                        )
+                    cur = obj.get("metadata", {}).get(
+                        "resourceVersion"
+                    )
+                    if rv is not None and rv != cur:
+                        return self._send(
+                            409,
+                            {
+                                "message": "the object has been "
+                                "modified (resourceVersion "
+                                f"{rv} != {cur})"
+                            },
+                        )
+                    body.setdefault("metadata", {})[
+                        "resourceVersion"
+                    ] = cur
+                    self._bump(body)
+                    server.store[plural][name] = body
+                return self._send(200, body)
+
+            def do_DELETE(self):
+                plural, name, _ = self._route()
+                with server._lock:
+                    gone = server.store.get(plural, {}).pop(
+                        name, None
+                    )
+                if gone is None:
+                    return self._send(
+                        404, {"message": f"{name} not found"}
+                    )
+                return self._send(200, gone)
+
+        self.httpd = ThreadingHTTPServer(("127.0.0.1", 0), Handler)
+        self.thread = threading.Thread(
+            target=self.httpd.serve_forever, daemon=True
+        )
+        self.thread.start()
+
+    @property
+    def url(self) -> str:
+        return f"http://127.0.0.1:{self.httpd.server_port}"
+
+    def set_pod_phase(self, name: str, phase: str) -> None:
+        with self._lock:
+            self.store["pods"][name]["status"]["phase"] = phase
+
+    def close(self):
+        self.httpd.shutdown()
+
+
+@pytest.fixture()
+def apiserver():
+    s = MiniApiServer()
+    yield s
+    s.close()
+
+
+def _job_cr(name="train1", replicas=2, master_restart_limit=2):
+    return {
+        "apiVersion": f"{GROUP}/v1alpha1",
+        "kind": "ElasticJob",
+        "metadata": {"name": name, "namespace": NS},
+        "spec": {
+            "replicaSpecs": {
+                "worker": {"replicas": replicas, "restartCount": 3}
+            }
+        },
+    }
+
+
+def _jobs_path(name=""):
+    base = f"/apis/{GROUP}/v1alpha1/namespaces/{NS}/elasticjobs"
+    return f"{base}/{name}" if name else base
+
+
+class TestReconcileE2E:
+    def test_job_creates_master_pod_and_status(self, apiserver):
+        api = K8sApi(apiserver.url)
+        api.create(_jobs_path(), _job_cr())
+        rt = OperatorRuntime(api, NS, resync_seconds=0.2)
+        rt.resync_once()
+        # Master pod exists with the job label, via real HTTP.
+        pods = api.get(
+            f"/api/v1/namespaces/{NS}/pods",
+            params={"labelSelector": "dlrover-job=train1"},
+        )["items"]
+        assert [
+            p["metadata"]["name"] for p in pods
+        ] == ["train1-master"]
+        # CR status written back.
+        status = api.get(_jobs_path("train1")).get("status", {})
+        assert status.get("phase") == "Running"
+
+    def test_master_failure_restart_then_job_failed(self, apiserver):
+        api = K8sApi(apiserver.url)
+        api.create(
+            _jobs_path(), _job_cr(name="flaky")
+        )
+        rt = OperatorRuntime(api, NS, resync_seconds=0.2)
+        rt.resync_once()
+        for i in range(3):  # limit is 2 restarts
+            apiserver.set_pod_phase("flaky-master", "Failed")
+            rt.resync_once()
+            rt.resync_once()  # recreate happens on the next pass
+        status = api.get(_jobs_path("flaky")).get("status", {})
+        assert status.get("phase") == "Failed"
+        assert status.get("masterRestarts", 0) >= 3
+
+    def test_scaleplan_executed_and_job_deletion_cleans_up(
+        self, apiserver
+    ):
+        api = K8sApi(apiserver.url)
+        api.create(_jobs_path(), _job_cr(name="scaled"))
+        rt = OperatorRuntime(api, NS, resync_seconds=0.2)
+        rt.resync_once()
+        api.create(
+            f"/apis/{GROUP}/v1alpha1/namespaces/{NS}/scaleplans",
+            {
+                "apiVersion": f"{GROUP}/v1alpha1",
+                "kind": "ScalePlan",
+                "metadata": {"name": "scaled-plan-1"},
+                "spec": {
+                    "ownerJob": "scaled",
+                    "createPods": [
+                        {
+                            "name": "scaled-worker-0",
+                            "id": 0,
+                            "type": "worker",
+                            "resource": {
+                                "cpu": "4",
+                                "memory": "8192Mi",
+                            },
+                        }
+                    ],
+                },
+            },
+        )
+        rt.resync_once()
+        names = {
+            p["metadata"]["name"]
+            for p in api.get(
+                f"/api/v1/namespaces/{NS}/pods"
+            )["items"]
+        }
+        assert names == {"scaled-master", "scaled-worker-0"}
+        # Deleting the CR tears the pods down on the next resync.
+        api.delete(_jobs_path("scaled"))
+        rt.resync_once()
+        assert (
+            api.get(f"/api/v1/namespaces/{NS}/pods")["items"] == []
+        )
+
+    def test_run_loop_with_watch_fallback(self, apiserver):
+        """The full entrypoint loop against an apiserver with no
+        watch support: the 400 falls back to resync, which reconciles
+        a job created after startup."""
+        api = K8sApi(apiserver.url)
+        rt = OperatorRuntime(api, NS, resync_seconds=0.2)
+        t = threading.Thread(target=rt.run, daemon=True)
+        t.start()
+        try:
+            api.create(_jobs_path(), _job_cr(name="late"))
+            deadline = time.time() + 10
+            while time.time() < deadline:
+                pods = api.get(
+                    f"/api/v1/namespaces/{NS}/pods",
+                    params={"labelSelector": "dlrover-job=late"},
+                )["items"]
+                if pods:
+                    break
+                time.sleep(0.1)
+            assert pods, "run loop never reconciled the late job"
+        finally:
+            rt.stop()
+            t.join(timeout=5)
+
+
+class TestLeaderElection:
+    def test_single_holder_and_takeover_after_expiry(self, apiserver):
+        api = K8sApi(apiserver.url)
+        a = LeaderElector(api, NS, identity="a", lease_seconds=1)
+        b = LeaderElector(api, NS, identity="b", lease_seconds=1)
+        assert a.try_acquire()
+        assert not b.try_acquire()  # a holds a fresh lease
+        assert a.try_acquire()  # renewal succeeds
+        time.sleep(1.2)  # lease expires un-renewed
+        assert b.try_acquire()  # b takes over
+        assert not a.try_acquire()  # and now a must stand by
+
+    def test_expired_lease_race_has_single_winner(self, apiserver):
+        """Two electors that both observed the same expired lease:
+        the PUT carries the read resourceVersion, so exactly one CAS
+        write wins and the loser returns False."""
+        api = K8sApi(apiserver.url)
+        a = LeaderElector(api, NS, identity="a", lease_seconds=1)
+        b = LeaderElector(api, NS, identity="b", lease_seconds=1)
+        assert a.try_acquire()
+        time.sleep(1.2)  # expired for both observers
+        results = {}
+        barrier = threading.Barrier(2)
+
+        def race(elector, key):
+            barrier.wait()
+            results[key] = elector.try_acquire()
+
+        ta = threading.Thread(target=race, args=(a, "a"))
+        tb = threading.Thread(target=race, args=(b, "b"))
+        ta.start(); tb.start(); ta.join(); tb.join()
+        assert sorted(results.values()) == [False, True], results
+
+
+class TestClientErrors:
+    def test_api_error_carries_status(self, apiserver):
+        api = K8sApi(apiserver.url)
+        with pytest.raises(ApiError) as err:
+            api.get(_jobs_path("missing"))
+        assert err.value.status == 404
