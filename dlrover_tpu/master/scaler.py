@@ -97,7 +97,19 @@ class FakeClusterClient(ClusterClient):
         self.services[spec["name"]] = spec
 
     def patch_custom_object(self, name: str, body: Dict) -> None:
-        self.custom_objects[name] = body
+        # Merge-patch semantics, like the real apiserver: a status
+        # patch must not clobber the object's spec.
+        def merge(dst: Dict, src: Dict) -> None:
+            for k, v in src.items():
+                if isinstance(v, dict) and isinstance(
+                    dst.get(k), dict
+                ):
+                    merge(dst[k], v)
+                else:
+                    dst[k] = v
+
+        obj = self.custom_objects.setdefault(name, {})
+        merge(obj, body)
 
     def watch_pods(self, job_name: str) -> Iterator[Dict]:
         while True:

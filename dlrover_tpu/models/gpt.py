@@ -48,6 +48,9 @@ class GPTConfig:
     remat: Any = True
     # None = auto (flash on TPU at long context); True/False forces.
     use_flash_attention: Optional[bool] = None
+    # None = auto (fused Pallas norm kernels on TPU,
+    # ops/layer_norm.py); True/False forces.
+    use_fused_norm: Optional[bool] = None
 
     @property
     def head_dim(self) -> int:
@@ -146,6 +149,16 @@ def _layer_norm(x, g, b, eps=1e-5):
     return out.astype(x.dtype)
 
 
+def use_fused_norm(cfg) -> bool:
+    """Fused Pallas norms (ops/layer_norm.py) on TPU by default: the
+    residual spine is HBM-bound and the fused add+norm halves its
+    memory passes. Off-TPU the plain XLA norm is faster than
+    interpreter-mode Pallas."""
+    if cfg.use_fused_norm is not None:
+        return cfg.use_fused_norm
+    return jax.default_backend() == "tpu"
+
+
 def _default_attention(q, k, v, causal=True):
     """Plain fused attention (single-shard fallback; the sharded path
     comes from parallel.ring_attention.make_sharded_attention)."""
@@ -170,17 +183,34 @@ def _block(x, lp, cfg: GPTConfig, attn_fn):
     roofline prior and the TP planner's per-edge costs."""
     B, T, E = x.shape
     H, D = cfg.n_head, cfg.head_dim
+    fused = use_fused_norm(cfg)
+    if fused:
+        from dlrover_tpu.ops.layer_norm import (
+            fused_add_layer_norm,
+            fused_layer_norm,
+        )
     with jax.named_scope("attn"):
-        h = _layer_norm(x, lp["ln1_g"], lp["ln1_b"])
+        if fused:
+            h = fused_layer_norm(x, lp["ln1_g"], lp["ln1_b"])
+        else:
+            h = _layer_norm(x, lp["ln1_g"], lp["ln1_b"])
         qkv = h @ lp["wqkv"]  # [B,T,3E]
         q, k, v = jnp.split(qkv, 3, axis=-1)
         q = q.reshape(B, T, H, D)
         k = k.reshape(B, T, H, D)
         v = v.reshape(B, T, H, D)
         att = attn_fn(q, k, v).reshape(B, T, E)
-        x = x + att @ lp["wo"]
+        att_out = att @ lp["wo"]
     with jax.named_scope("mlp"):
-        h = _layer_norm(x, lp["ln2_g"], lp["ln2_b"])
+        if fused:
+            # The attention residual add rides inside the norm kernel
+            # (one HBM pass for the branch point).
+            h, x = fused_add_layer_norm(
+                att_out, x, lp["ln2_g"], lp["ln2_b"]
+            )
+        else:
+            x = x + att_out
+            h = _layer_norm(x, lp["ln2_g"], lp["ln2_b"])
         h = jax.nn.gelu(h @ lp["wi"] + lp["bi"])
         x = x + h @ lp["wo2"] + lp["bo2"]
     return x

@@ -52,6 +52,8 @@ class LlamaConfig:
     dtype: Any = jnp.bfloat16
     remat: Any = True  # same named policies as GPTConfig.remat
     use_flash_attention: Optional[bool] = None
+    # None = auto (fused Pallas RMSNorm on TPU, ops/layer_norm.py).
+    use_fused_norm: Optional[bool] = None
     # > 0 switches every block's MLP to a mixture-of-experts routed
     # over the ``expert`` mesh axis (models/moe.py — Mixtral-shaped
     # family; experts use the GShard FFN formulation). ``intermediate``
@@ -275,7 +277,18 @@ def _block(x, lp, cfg: LlamaConfig, attn_fn, cos, sin):
     the router load-balancing loss for MoE blocks."""
     B, T, E = x.shape
     H, Hkv, D = cfg.n_head, cfg.n_kv_head, cfg.head_dim
-    h = _rms_norm(x, lp["rms1"], cfg.rms_eps)
+    from dlrover_tpu.models.gpt import use_fused_norm
+
+    fused = use_fused_norm(cfg)
+    if fused:
+        from dlrover_tpu.ops.layer_norm import (
+            fused_add_rms_norm,
+            fused_rms_norm,
+        )
+
+        h = fused_rms_norm(x, lp["rms1"], eps=cfg.rms_eps)
+    else:
+        h = _rms_norm(x, lp["rms1"], cfg.rms_eps)
     q = (h @ lp["wq"]).reshape(B, T, H, D)
     k = (h @ lp["wk"]).reshape(B, T, Hkv, D)
     v = (h @ lp["wv"]).reshape(B, T, Hkv, D)
@@ -286,8 +299,14 @@ def _block(x, lp, cfg: LlamaConfig, attn_fn, cos, sin):
         k = jnp.repeat(k, cfg.q_per_kv, axis=2)
         v = jnp.repeat(v, cfg.q_per_kv, axis=2)
     att = attn_fn(q, k, v).reshape(B, T, E)
-    x = x + att @ lp["wo"]
-    h = _rms_norm(x, lp["rms2"], cfg.rms_eps)
+    if fused:
+        # Attention residual add fused into the second norm's kernel.
+        h, x = fused_add_rms_norm(
+            att @ lp["wo"], x, lp["rms2"], eps=cfg.rms_eps
+        )
+    else:
+        x = x + att @ lp["wo"]
+        h = _rms_norm(x, lp["rms2"], cfg.rms_eps)
     return mlp_tail(x, h, lp, cfg)
 
 

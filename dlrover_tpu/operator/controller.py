@@ -213,6 +213,11 @@ class ElasticJobController:
             if (
                 spec_body.get("ownerJob") != job.name
                 or plan_name in self._executed_plans
+                # Durable marker: an operator restart / HA leader
+                # failover starts with an empty in-memory set and
+                # must not replay plans already executed by a
+                # previous incarnation.
+                or body.get("status", {}).get("executed")
             ):
                 continue
             self._executed_plans.add(plan_name)
@@ -276,6 +281,18 @@ class ElasticJobController:
                     self.client.delete_pod(item["name"])
                 except Exception:  # noqa: BLE001
                     pass
+            try:
+                self.client.patch_custom_object(
+                    plan_name, {"status": {"executed": True}}
+                )
+            except Exception:  # noqa: BLE001 — worst case the
+                # in-memory set still guards this incarnation; the
+                # next one may replay (at-least-once, like the ref).
+                logger.warning(
+                    "scaleplan %s: executed-marker patch failed",
+                    plan_name,
+                    exc_info=True,
+                )
 
     # -- loop ---------------------------------------------------------------
 

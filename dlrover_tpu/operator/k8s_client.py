@@ -243,7 +243,11 @@ class LeaderElector:
                 # offset (an hour of error flips the expiry verdict).
                 t = calendar.timegm(
                     time.strptime(
-                        renew.split(".")[0], "%Y-%m-%dT%H:%M:%S"
+                        # Fractional seconds are optional and a bare
+                        # 'Z' survives the split — an unparsed live
+                        # lease must not read as expired/stealable.
+                        renew.split(".")[0].rstrip("Zz"),
+                        "%Y-%m-%dT%H:%M:%S",
                     )
                 )
                 expired = (

@@ -55,6 +55,7 @@ def elasticjob_from_cr(body: Dict) -> ElasticJob:
             ),
             restart_limit=int(worker.get("restartCount", 3)),
         ),
+        master_restart_limit=int(spec.get("masterRestartLimit", 2)),
         pod_template=dict(spec.get("podTemplate", {})),
     )
     status = body.get("status", {})
@@ -117,7 +118,6 @@ class OperatorRuntime:
                 # ours — keep the in-memory progression.
                 known.workers = job.workers
                 known.pod_template = job.pod_template
-            prev = (known.phase, known.master_restarts)
             try:
                 self.controller.reconcile(known.name)
             except Exception:  # noqa: BLE001 — keep reconciling others
@@ -125,8 +125,16 @@ class OperatorRuntime:
                     "reconcile %s failed", known.name, exc_info=True
                 )
                 continue
-            if (known.phase, known.master_restarts) != prev or not (
-                body.get("status", {}).get("phase")
+            # Level-triggered status write-back: compare against what
+            # the apiserver actually has, so one failed patch (e.g. at
+            # a terminal transition) is retried on every resync until
+            # it lands, rather than being gated on an in-memory
+            # transition that will never recur.
+            cr_status = body.get("status", {})
+            if (
+                cr_status.get("phase") != known.phase
+                or cr_status.get("masterRestarts", 0)
+                != known.master_restarts
             ):
                 try:
                     self.client.patch_status(

@@ -1,0 +1,190 @@
+"""Fused Pallas LayerNorm/RMSNorm (+residual) vs plain XLA norms.
+
+Interpreter mode on CPU exercises the exact kernels that compile on
+TPU (same policy as tests/test_flash_attention.py). Parity target:
+the reference's fused dropout_add_layer_norm integration
+(atorch/modules/transformer/layers.py:74) at dropout 0.
+"""
+
+import functools
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from dlrover_tpu.ops.layer_norm import (
+    fused_add_layer_norm,
+    fused_add_rms_norm,
+    fused_layer_norm,
+    fused_rms_norm,
+)
+
+
+def ref_ln(x, g, b, eps=1e-5):
+    x32 = x.astype(jnp.float32)
+    mu = jnp.mean(x32, -1, keepdims=True)
+    var = jnp.var(x32, -1, keepdims=True)
+    out = (x32 - mu) * jax.lax.rsqrt(var + eps) * g
+    if b is not None:
+        out = out + b
+    return out.astype(x.dtype)
+
+
+def ref_rms(x, g, eps=1e-6):
+    x32 = x.astype(jnp.float32)
+    s = jax.lax.rsqrt(jnp.mean(x32**2, -1, keepdims=True) + eps)
+    return (x32 * s * g).astype(x.dtype)
+
+
+@pytest.fixture()
+def data():
+    k = jax.random.PRNGKey(0)
+    # 210 rows: not a multiple of the row block, exercises padding.
+    x = jax.random.normal(k, (3, 70, 64), jnp.float32)
+    r = jax.random.normal(jax.random.PRNGKey(1), x.shape)
+    g = jax.random.normal(jax.random.PRNGKey(2), (64,)) + 1.0
+    b = jax.random.normal(jax.random.PRNGKey(3), (64,)) * 0.1
+    return x, r, g, b
+
+
+class TestForward:
+    def test_layer_norm_matches(self, data):
+        x, _, g, b = data
+        np.testing.assert_allclose(
+            fused_layer_norm(x, g, b), ref_ln(x, g, b),
+            atol=1e-5, rtol=1e-5,
+        )
+
+    def test_rms_norm_matches(self, data):
+        x, _, g, _ = data
+        np.testing.assert_allclose(
+            fused_rms_norm(x, g), ref_rms(x, g),
+            atol=1e-5, rtol=1e-5,
+        )
+
+    def test_add_layer_norm_fuses_residual(self, data):
+        x, r, g, b = data
+        out, resid = fused_add_layer_norm(x, r, g, b)
+        np.testing.assert_allclose(
+            out, ref_ln(x + r, g, b), atol=1e-5, rtol=1e-5
+        )
+        np.testing.assert_allclose(resid, x + r, atol=1e-6)
+
+    def test_bf16_no_bias_under_jit(self, data):
+        x, _, g, _ = data
+        xb = x.astype(jnp.bfloat16)
+        got = jax.jit(fused_layer_norm)(xb, g, None)
+        want = ref_ln(xb, g, None)
+        np.testing.assert_allclose(
+            got.astype(jnp.float32), want.astype(jnp.float32),
+            atol=3e-2, rtol=3e-2,
+        )
+
+
+class TestBackward:
+    def test_layer_norm_grads_match(self, data):
+        x, _, g, b = data
+
+        def f(x, g, b):
+            return jnp.sum(jnp.sin(fused_layer_norm(x, g, b)))
+
+        def ref(x, g, b):
+            return jnp.sum(jnp.sin(ref_ln(x, g, b)))
+
+        got = jax.grad(f, (0, 1, 2))(x, g, b)
+        want = jax.grad(ref, (0, 1, 2))(x, g, b)
+        for a, w in zip(got, want):
+            np.testing.assert_allclose(a, w, atol=2e-4, rtol=2e-4)
+
+    def test_add_norm_grads_include_residual_cotangent(self, data):
+        """The (out, resid) second output feeds downstream compute:
+        both cotangent paths into y = x + r must combine."""
+        x, r, g, b = data
+
+        def f(x, r, g, b):
+            o, res = fused_add_layer_norm(x, r, g, b)
+            return jnp.sum(jnp.sin(o)) + jnp.sum(res * 0.3)
+
+        def ref(x, r, g, b):
+            y = x + r
+            return jnp.sum(jnp.sin(ref_ln(y, g, b))) + jnp.sum(
+                y * 0.3
+            )
+
+        got = jax.grad(f, (0, 1, 2, 3))(x, r, g, b)
+        want = jax.grad(ref, (0, 1, 2, 3))(x, r, g, b)
+        for a, w in zip(got, want):
+            np.testing.assert_allclose(a, w, atol=2e-4, rtol=2e-4)
+
+    def test_add_rms_grads_match(self, data):
+        x, r, g, _ = data
+
+        def f(x, r, g):
+            o, res = fused_add_rms_norm(x, r, g)
+            return jnp.sum(jnp.cos(o)) + jnp.sum(res * 0.1)
+
+        def ref(x, r, g):
+            y = x + r
+            return jnp.sum(jnp.cos(ref_rms(y, g))) + jnp.sum(
+                y * 0.1
+            )
+
+        got = jax.grad(f, (0, 1, 2))(x, r, g)
+        want = jax.grad(ref, (0, 1, 2))(x, r, g)
+        for a, w in zip(got, want):
+            np.testing.assert_allclose(a, w, atol=2e-4, rtol=2e-4)
+
+
+class TestModelIntegration:
+    def test_gpt_loss_and_grads_parity_fused_vs_plain(self):
+        import dataclasses
+
+        from dlrover_tpu.models import gpt
+
+        base = gpt.GPTConfig(
+            vocab_size=128, block_size=32, n_layer=2, n_head=2,
+            n_embd=32, dtype=jnp.float32, remat=False,
+        )
+        tok = jax.random.randint(
+            jax.random.PRNGKey(0), (2, 32), 0, 128
+        )
+        params = gpt.init_params(jax.random.PRNGKey(1), cfg=base)
+        out = {}
+        for fused in (False, True):
+            cfg = dataclasses.replace(base, use_fused_norm=fused)
+            loss_fn = functools.partial(gpt.loss_fn, cfg=cfg)
+            loss, grads = jax.value_and_grad(loss_fn)(
+                params, tok, tok
+            )
+            out[fused] = (float(loss), grads)
+        assert out[False][0] == pytest.approx(out[True][0], rel=1e-5)
+        for a, w in zip(
+            jax.tree.leaves(out[True][1]),
+            jax.tree.leaves(out[False][1]),
+        ):
+            np.testing.assert_allclose(a, w, atol=1e-4, rtol=1e-3)
+
+    def test_llama_loss_parity_fused_vs_plain(self):
+        import dataclasses
+
+        from dlrover_tpu.models import llama
+
+        base = llama.LlamaConfig(
+            vocab_size=128, block_size=32, n_layer=2, n_head=4,
+            n_kv_head=2, n_embd=32, intermediate=64,
+            dtype=jnp.float32, remat=False,
+        )
+        tok = jax.random.randint(
+            jax.random.PRNGKey(0), (2, 32), 0, 128
+        )
+        params = llama.init_params(jax.random.PRNGKey(1), cfg=base)
+        losses = {}
+        for fused in (False, True):
+            cfg = dataclasses.replace(base, use_fused_norm=fused)
+            losses[fused] = float(
+                llama.loss_fn(params, tok, tok, cfg=cfg)
+            )
+        assert losses[True] == pytest.approx(
+            losses[False], rel=1e-5
+        )
