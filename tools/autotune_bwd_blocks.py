@@ -1,0 +1,69 @@
+"""Autotune the flash-attention BACKWARD block sizes on real hardware.
+
+Sweeps (block_q_bwd, block_k_bwd) over the divisibility-chain-valid
+grid at the shipped forward blocks (512/1024), full remat, batch 16,
+save-logits CE — the bench.py configuration — plus a fused-norm A/B,
+and prints the ranked results with the winning bench spec.
+
+Run (TPU):  python tools/autotune_bwd_blocks.py [--quick]
+Each config costs one compile (~20-40 s cold; the persistent compile
+cache makes re-runs cheap) + ~2 s of measurement.
+"""
+
+from __future__ import annotations
+
+import argparse
+import math
+import sys
+
+import _repo_path  # noqa: F401
+
+
+def valid_chain(blocks) -> bool:
+    return math.lcm(*blocks) <= 2 * max(blocks)
+
+
+def main() -> int:
+    p = argparse.ArgumentParser()
+    p.add_argument("--quick", action="store_true",
+                   help="only the most promising half of the grid")
+    p.add_argument("--fwd", default="512,1024",
+                   help="forward block_q,block_k")
+    args = p.parse_args()
+
+    import jax
+
+    if jax.default_backend() != "tpu":
+        print("warning: not on TPU; timings are meaningless",
+              file=sys.stderr)
+
+    from perf_sweep import run_config  # noqa: E402
+    from dlrover_tpu.parallel.mesh import (  # noqa: E402
+        MeshConfig,
+        build_mesh,
+    )
+
+    bq, bk = (int(x) for x in args.fwd.split(","))
+    candidates = []
+    sizes = (128, 256, 512, 1024)
+    for bqb in sizes:
+        for bkb in sizes:
+            if args.quick and (bqb < 256 or bkb < 256):
+                continue
+            if valid_chain((bq, bk, bqb, bkb)):
+                candidates.append((bqb, bkb))
+
+    mesh = build_mesh(MeshConfig(data=len(jax.devices())))
+    print(f"sweeping {len(candidates)} bwd-block configs at "
+          f"fwd {bq}/{bk} (+ fused-norm A/B at defaults)")
+    # Baseline A/B first: fused norms on (default) vs off.
+    run_config(mesh, f"full,flash,16,{bq},{bk},sl")
+    run_config(mesh, f"full,flash,16,{bq},{bk},sl,-,-,nofn")
+    for bqb, bkb in candidates:
+        run_config(mesh, f"full,flash,16,{bq},{bk},sl,{bqb},{bkb}")
+    print("pick the fastest line; bench.py BENCH_* env then pins it")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -7,6 +7,7 @@ Usage:
          step's non-attention cost by subtraction)
   sl: save-logits cross-entropy variant (pass "sl"; "-" to skip)
   bqb,bkb: backward-kernel block sizes (default = forward blocks)
+  nofn (anywhere): disable the fused Pallas norms (A/B the default)
 
 Prints one line per config: config, step ms, MFU, vs_baseline.
 """
@@ -57,6 +58,9 @@ def build_spec(spec: str):
     save_logits = len(parts) > 5 and parts[5] == "sl"
     block_q_bwd = _blk(6)
     block_k_bwd = _blk(7)
+    # Trailing "nofn" disables the fused Pallas norms (A/B the
+    # residual-spine fusion on real hardware).
+    fused_norm = None if "nofn" not in parts else False
     remat = {
         "full": True, "attn": "attention", "none": False,
         "dots": "dots", "offload": "offload",
@@ -64,7 +68,8 @@ def build_spec(spec: str):
     use_flash = flash_s == "flash"
 
     cfg = dataclasses.replace(
-        gpt.GPTConfig.gpt2(), remat=remat, use_flash_attention=use_flash
+        gpt.GPTConfig.gpt2(), remat=remat,
+        use_flash_attention=use_flash, use_fused_norm=fused_norm,
     )
     attn_fn = None
     if flash_s == "noop":
