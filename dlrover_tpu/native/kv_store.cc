@@ -939,6 +939,99 @@ void kv_sparse_apply_adahessian(void* param_h, void* m_h, void* v_h,
   });
 }
 
+// RMSProp (Tieleman & Hinton), torch conventions throughout: eps
+// OUTSIDE the sqrt, momentum buffer holds the UNSCALED step
+// (buf = momentum*buf + g/denom; p -= lr*buf) so a changing lr
+// schedule applies the current lr to the whole buffer. mom_h may be
+// null when momentum == 0 — no second slot store is allocated.
+void kv_sparse_apply_rmsprop(void* param_h, void* ms_h, void* mom_h,
+                             const int64_t* keys, const float* grads,
+                             int64_t n, float lr, float rho,
+                             float momentum, float eps, int64_t step) {
+  auto* param = static_cast<KvStore*>(param_h);
+  auto* msstore = static_cast<KvStore*>(ms_h);
+  auto* momstore = static_cast<KvStore*>(mom_h);
+  int dim = param->dim();
+  param->for_each_key(keys, n, step, [&](int64_t i, float* p) {
+    const float* g = grads + i * dim;
+    int64_t key = keys[i];
+    msstore->for_each_key(&key, 1, step, [&](int64_t, float* ms) {
+      if (momstore == nullptr) {
+        for (int d = 0; d < dim; ++d) {
+          ms[d] = rho * ms[d] + (1.0f - rho) * g[d] * g[d];
+          p[d] -= lr * g[d] / (std::sqrt(ms[d]) + eps);
+        }
+        return;
+      }
+      momstore->for_each_key(&key, 1, step, [&](int64_t, float* m) {
+        for (int d = 0; d < dim; ++d) {
+          ms[d] = rho * ms[d] + (1.0f - rho) * g[d] * g[d];
+          m[d] = momentum * m[d] + g[d] / (std::sqrt(ms[d]) + eps);
+          p[d] -= lr * m[d];
+        }
+      });
+    });
+  });
+}
+
+// Adamax (Kingma & Ba 2015 §7.1): infinity-norm second moment —
+// u = max(beta2*u, |g|); no bias correction needed on u.
+void kv_sparse_apply_adamax(void* param_h, void* m_h, void* u_h,
+                            const int64_t* keys, const float* grads,
+                            int64_t n, float lr, float beta1,
+                            float beta2, float eps, int64_t step) {
+  auto* param = static_cast<KvStore*>(param_h);
+  auto* mstore = static_cast<KvStore*>(m_h);
+  auto* ustore = static_cast<KvStore*>(u_h);
+  int dim = param->dim();
+  float bc1 = 1.0f - std::pow(beta1, static_cast<float>(step));
+  param->for_each_key(keys, n, step, [&](int64_t i, float* p) {
+    const float* g = grads + i * dim;
+    int64_t key = keys[i];
+    mstore->for_each_key(&key, 1, step, [&](int64_t, float* m) {
+      ustore->for_each_key(&key, 1, step, [&](int64_t, float* u) {
+        for (int d = 0; d < dim; ++d) {
+          m[d] = beta1 * m[d] + (1.0f - beta1) * g[d];
+          u[d] = std::max(beta2 * u[d], std::fabs(g[d]));
+          p[d] -= lr * (m[d] / bc1) / (u[d] + eps);
+        }
+      });
+    });
+  });
+}
+
+// Nadam (Dozat 2016): Nesterov-accelerated Adam — the update mixes
+// the bias-corrected momentum with the current gradient's own
+// bias-corrected contribution.
+void kv_sparse_apply_nadam(void* param_h, void* m_h, void* v_h,
+                           const int64_t* keys, const float* grads,
+                           int64_t n, float lr, float beta1,
+                           float beta2, float eps, int64_t step) {
+  auto* param = static_cast<KvStore*>(param_h);
+  auto* mstore = static_cast<KvStore*>(m_h);
+  auto* vstore = static_cast<KvStore*>(v_h);
+  int dim = param->dim();
+  float t = static_cast<float>(step);
+  float bc1 = 1.0f - std::pow(beta1, t);
+  float bc1_next = 1.0f - std::pow(beta1, t + 1.0f);
+  float bc2 = 1.0f - std::pow(beta2, t);
+  param->for_each_key(keys, n, step, [&](int64_t i, float* p) {
+    const float* g = grads + i * dim;
+    int64_t key = keys[i];
+    mstore->for_each_key(&key, 1, step, [&](int64_t, float* m) {
+      vstore->for_each_key(&key, 1, step, [&](int64_t, float* v) {
+        for (int d = 0; d < dim; ++d) {
+          m[d] = beta1 * m[d] + (1.0f - beta1) * g[d];
+          v[d] = beta2 * v[d] + (1.0f - beta2) * g[d] * g[d];
+          float mhat = beta1 * m[d] / bc1_next +
+                       (1.0f - beta1) * g[d] / bc1;
+          p[d] -= lr * mhat / (std::sqrt(v[d] / bc2) + eps);
+        }
+      });
+    });
+  });
+}
+
 void kv_sparse_apply_momentum(void* param_h, void* mom_h, const int64_t* keys,
                               const float* grads, int64_t n, float lr,
                               float momentum, int64_t step) {

@@ -182,6 +182,24 @@ def _lib() -> ctypes.CDLL:
                 ctypes.c_float, ctypes.c_float, ctypes.c_float,
                 ctypes.c_float, ctypes.c_int64,
             ]
+            lib.kv_sparse_apply_rmsprop.argtypes = [
+                ctypes.c_void_p, ctypes.c_void_p, ctypes.c_void_p,
+                i64p, f32p, ctypes.c_int64, ctypes.c_float,
+                ctypes.c_float, ctypes.c_float, ctypes.c_float,
+                ctypes.c_int64,
+            ]
+            lib.kv_sparse_apply_adamax.argtypes = [
+                ctypes.c_void_p, ctypes.c_void_p, ctypes.c_void_p,
+                i64p, f32p, ctypes.c_int64, ctypes.c_float,
+                ctypes.c_float, ctypes.c_float, ctypes.c_float,
+                ctypes.c_int64,
+            ]
+            lib.kv_sparse_apply_nadam.argtypes = [
+                ctypes.c_void_p, ctypes.c_void_p, ctypes.c_void_p,
+                i64p, f32p, ctypes.c_int64, ctypes.c_float,
+                ctypes.c_float, ctypes.c_float, ctypes.c_float,
+                ctypes.c_int64,
+            ]
             _LIB = lib
     return _LIB
 
@@ -486,6 +504,36 @@ class KvVariable:
                 kw.get("eps", 1e-8),
                 kw.get("hessian_power", 1.0), max(step, 1),
             )
+        elif optimizer == "rmsprop":
+            momentum = kw.get("momentum", 0.0)
+            lib.kv_sparse_apply_rmsprop(
+                h,
+                self._slot("ms").handle,
+                # Plain RMSProp keeps a single accumulator: don't
+                # allocate a momentum table nobody reads.
+                self._slot("mom_rms").handle if momentum else None,
+                ukeys, ugrads, ukeys.size,
+                lr, kw.get("rho", 0.9), momentum,
+                kw.get("eps", 1e-7), step,
+            )
+        elif optimizer == "adamax":
+            lib.kv_sparse_apply_adamax(
+                h,
+                self._slot("m").handle,
+                self._slot("u_inf").handle,
+                ukeys, ugrads, ukeys.size,
+                lr, kw.get("beta1", 0.9), kw.get("beta2", 0.999),
+                kw.get("eps", 1e-8), max(step, 1),
+            )
+        elif optimizer == "nadam":
+            lib.kv_sparse_apply_nadam(
+                h,
+                self._slot("m").handle,
+                self._slot("v").handle,
+                ukeys, ugrads, ukeys.size,
+                lr, kw.get("beta1", 0.9), kw.get("beta2", 0.999),
+                kw.get("eps", 1e-8), max(step, 1),
+            )
         else:
             raise ValueError(f"unknown sparse optimizer {optimizer!r}")
 
@@ -592,8 +640,9 @@ class KvVariable:
 class SparseOptimizer:
     """Convenience: one object applying the same rule to many
     KvVariables. Rules: adam | adagrad | ftrl | momentum | lamb |
-    adabelief | amsgrad | radam | adadelta | adahessian |
-    group_adam | group_ftrl — the group_* variants carry
+    adabelief | amsgrad | radam | adadelta | adahessian | rmsprop |
+    adamax | nadam | group_adam | group_ftrl — the group_* variants
+    carry
     the reference's group-lasso L21 row sparsification
     (tfplus python/training/group_adam.py, sparse_group_ftrl.py;
     kernels in native/kv_store.cc)."""

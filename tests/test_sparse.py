@@ -648,3 +648,85 @@ def test_sparse_adahessian_power_dampens_adaptivity():
     d0 = kv0.gather(keys, train=False)
     d1 = kv1.gather(keys, train=False)
     assert not np.allclose(d0, d1)
+
+
+def test_sparse_adamax_matches_optax():
+    import jax.numpy as jnp
+    import optax
+
+    dim = 8
+    kv = KvVariable("emb", embedding_dim=dim, seed=18)
+    keys = np.array([2, 9], np.int64)
+    init_vals = kv.gather(keys).copy()
+    grads = np.random.default_rng(7).normal(size=(2, dim)).astype(
+        np.float32
+    )
+    opt = optax.adamax(1e-2, eps=1e-8)
+    dense = jnp.asarray(init_vals)
+    state = opt.init(dense)
+    for step in range(1, 5):
+        kv.apply_gradients(
+            "adamax", keys, grads, step=step, lr=1e-2, eps=1e-8,
+        )
+        updates, state = opt.update(jnp.asarray(grads), state, dense)
+        dense = optax.apply_updates(dense, updates)
+    np.testing.assert_allclose(
+        kv.gather(keys, train=False), np.asarray(dense),
+        atol=1e-5, rtol=1e-4,
+    )
+
+
+def test_sparse_nadam_matches_optax():
+    import jax.numpy as jnp
+    import optax
+
+    dim = 8
+    kv = KvVariable("emb", embedding_dim=dim, seed=19)
+    keys = np.array([4, 6], np.int64)
+    init_vals = kv.gather(keys).copy()
+    grads = np.random.default_rng(8).normal(size=(2, dim)).astype(
+        np.float32
+    )
+    opt = optax.nadam(1e-2, eps=1e-8)
+    dense = jnp.asarray(init_vals)
+    state = opt.init(dense)
+    for step in range(1, 5):
+        kv.apply_gradients(
+            "nadam", keys, grads, step=step, lr=1e-2, eps=1e-8,
+        )
+        updates, state = opt.update(jnp.asarray(grads), state, dense)
+        dense = optax.apply_updates(dense, updates)
+    np.testing.assert_allclose(
+        kv.gather(keys, train=False), np.asarray(dense),
+        atol=1e-5, rtol=1e-4,
+    )
+
+
+def test_sparse_rmsprop_matches_torch():
+    """Torch convention: eps OUTSIDE the sqrt (optax puts it inside),
+    with classical momentum on the scaled step."""
+    torch = pytest.importorskip("torch")
+
+    dim = 8
+    kv = KvVariable("emb", embedding_dim=dim, seed=20)
+    keys = np.array([1, 5], np.int64)
+    init_vals = kv.gather(keys).copy()
+    grads = np.random.default_rng(9).normal(size=(2, dim)).astype(
+        np.float32
+    )
+    p = torch.nn.Parameter(torch.tensor(init_vals))
+    opt = torch.optim.RMSprop(
+        [p], lr=1e-2, alpha=0.9, eps=1e-7, momentum=0.5
+    )
+    for step in range(1, 5):
+        kv.apply_gradients(
+            "rmsprop", keys, grads, step=step, lr=1e-2, rho=0.9,
+            momentum=0.5, eps=1e-7,
+        )
+        opt.zero_grad()
+        p.grad = torch.tensor(grads)
+        opt.step()
+    np.testing.assert_allclose(
+        kv.gather(keys, train=False), p.detach().numpy(),
+        atol=1e-5, rtol=1e-4,
+    )

@@ -44,6 +44,10 @@ def build_spec(spec: str):
     flash attention with the kernel's own autotuned block sizes and
     batch 16."""
     parts = spec.split(",")
+    # "nofn" is a flag token, not positional: strip it before the
+    # positional fields so it really works anywhere in the spec.
+    fused_norm = False if "nofn" in parts else None
+    parts = [p for p in parts if p != "nofn"]
     remat_s = parts[0]
     flash_s = parts[1] if len(parts) > 1 else "flash"
     batch = int(parts[2]) if len(parts) > 2 else 16
@@ -58,9 +62,6 @@ def build_spec(spec: str):
     save_logits = len(parts) > 5 and parts[5] == "sl"
     block_q_bwd = _blk(6)
     block_k_bwd = _blk(7)
-    # Trailing "nofn" disables the fused Pallas norms (A/B the
-    # residual-spine fusion on real hardware).
-    fused_norm = None if "nofn" not in parts else False
     remat = {
         "full": True, "attn": "attention", "none": False,
         "dots": "dots", "offload": "offload",
