@@ -69,16 +69,25 @@ def _factorizations(n: int, n_axes: int) -> List[Tuple[int, ...]]:
 
 def candidate_strategies(
     n_devices: int,
-    axes: Tuple[str, ...] = ("data", "fsdp", "tensor"),
-    micro_batch_sizes: Tuple[int, ...] = (8,),
+    axes: Tuple[str, ...] = ("data", "fsdp", "seq", "tensor"),
+    micro_batch_sizes: Tuple[int, ...] = (4, 8, 16),
     dtypes: Tuple[str, ...] = ("bfloat16",),
     optimizers: Tuple[str, ...] = ("adamw",),
-    remats: Tuple[bool, ...] = (True,),
+    remats: Tuple[object, ...] = (False, "attention", True),
     max_tensor: int = 8,
 ) -> List[Strategy]:
     """Enumerate the raw candidate grid (the reference's
-    CombinationAlgorithm, auto/engine/sg_algo/combination_sg.py:16);
-    the analyser prunes it before any dry-run."""
+    CombinationAlgorithm, auto/engine/sg_algo/combination_sg.py:16).
+
+    The default grid spans every mesh factorization over
+    data/fsdp/seq/tensor x remat policy x micro-batch — hundreds of
+    candidates at 8 devices. That breadth is affordable because
+    nothing here compiles: the memory model prunes, the module
+    profiler's roofline prior ranks, and only the top handful are
+    dry-run (auto_accelerate max_dry_runs). A seq axis without ring
+    attention stays CORRECT under GSPMD (sharding annotations never
+    change semantics, XLA inserts the collectives); the dry-run
+    decides whether it is fast."""
     out = []
     for factors in _factorizations(n_devices, len(axes)):
         shape = tuple(zip(axes, factors))
