@@ -1,0 +1,51 @@
+"""Brain service entrypoint: ``python -m dlrover_tpu.brain.main``.
+
+The standalone deployment of the historical resource optimizer (ref:
+the Go brain's processor service + MySQL store,
+go/brain/pkg/datastore/...): one long-lived process, a durable sqlite
+file, masters connect with brain.server.RemoteBrain.
+"""
+
+from __future__ import annotations
+
+import argparse
+import signal
+import sys
+import threading
+
+from dlrover_tpu.brain.server import BrainRpcServer, BrainService
+from dlrover_tpu.common.log import get_logger
+
+logger = get_logger("brain.main")
+
+
+def parse_args(argv=None):
+    p = argparse.ArgumentParser("dlrover-tpu-brain")
+    p.add_argument(
+        "--db", default="brain.db",
+        help="sqlite datastore path (the durable cross-job history)",
+    )
+    p.add_argument("--port", type=int, default=0)
+    return p.parse_args(argv)
+
+
+def main(argv=None) -> int:
+    args = parse_args(argv)
+    server = BrainRpcServer(BrainService(args.db), port=args.port)
+    server.start()
+    print(f"DLROVER_TPU_BRAIN_PORT={server.port}", flush=True)
+    stop = threading.Event()
+
+    def _term(signum, frame):
+        logger.info("signal %s; shutting down", signum)
+        stop.set()
+
+    signal.signal(signal.SIGTERM, _term)
+    signal.signal(signal.SIGINT, _term)
+    stop.wait()
+    server.stop()
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

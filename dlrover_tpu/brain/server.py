@@ -1,0 +1,173 @@
+"""Standalone brain service + client.
+
+The reference runs the Brain as its own deployment (gRPC service +
+MySQL datastore, go/brain/pkg/...): jobs come and go but the brain
+accumulates cross-job history. The in-process BrainService already
+carries the full algorithm suite over sqlite; this module makes it a
+SERVICE: an RPC server any master can call, a client that mirrors the
+BrainService method surface, and a CLI entrypoint
+(``python -m dlrover_tpu.brain.main --db /data/brain.db``) whose
+sqlite file is the durable datastore (the MySQL analogue for a
+single-writer service).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+
+from dlrover_tpu.brain.service import (
+    ALGORITHMS,
+    BrainService,
+    JobMetricsRecord,
+    RuntimeSample,
+    run_algorithm,
+)
+from dlrover_tpu.common import messages as msg
+from dlrover_tpu.common.comm import RpcClient, RpcDispatcher, RpcServer
+from dlrover_tpu.common.log import get_logger
+
+logger = get_logger("brain.server")
+
+
+class BrainRpcServer:
+    """Hosts a BrainService behind the typed-msgpack RPC envelope."""
+
+    def __init__(self, brain: BrainService, port: int = 0):
+        self.brain = brain
+        dispatcher = RpcDispatcher()
+        dispatcher.register_report(
+            msg.BrainPersistRequest, self._persist
+        )
+        dispatcher.register_get(
+            msg.BrainOptimizeRequest, self._optimize
+        )
+        self._server = RpcServer(dispatcher, port=port)
+
+    @property
+    def port(self) -> int:
+        return self._server.port
+
+    @property
+    def addr(self) -> str:
+        return self._server.addr
+
+    def start(self) -> None:
+        self._server.start()
+        logger.info("brain serving on %s", self.addr)
+
+    def stop(self) -> None:
+        self._server.stop(0)
+
+    # -- handlers --------------------------------------------------------
+
+    @staticmethod
+    def _known_fields(cls, payload: dict) -> dict:
+        """Drop unknown payload keys, matching the wire schema's
+        forward-compat guarantee (messages.py drops unknown fields on
+        decode; the opaque payload dict must behave the same so a
+        newer client's extra fields don't crash an older brain)."""
+        names = {f.name for f in dataclasses.fields(cls)}
+        return {k: v for k, v in payload.items() if k in names}
+
+    def _persist(self, req: msg.BrainPersistRequest):
+        if req.kind == "metrics":
+            self.brain.persist_metrics(
+                JobMetricsRecord(
+                    **self._known_fields(JobMetricsRecord, req.payload)
+                )
+            )
+        elif req.kind == "sample":
+            self.brain.persist_runtime_sample(
+                RuntimeSample(
+                    **self._known_fields(RuntimeSample, req.payload)
+                )
+            )
+        elif req.kind == "ps_job":
+            self.brain.persist_ps_job(**req.payload)
+        else:
+            raise ValueError(f"unknown persist kind {req.kind!r}")
+        return None
+
+    def _optimize(self, req: msg.BrainOptimizeRequest):
+        try:
+            result = run_algorithm(
+                self.brain, req.algorithm, *req.args, **req.kwargs
+            )
+        except Exception as exc:  # noqa: BLE001 — report, don't kill
+            logger.warning(
+                "algorithm %s failed", req.algorithm, exc_info=True
+            )
+            return msg.BrainOptimizeResponse(
+                ok=False, error=f"{type(exc).__name__}: {exc}"
+            )
+        return msg.BrainOptimizeResponse(ok=True, result=result)
+
+
+class RemoteBrain:
+    """Client mirroring the BrainService surface over RPC — drop-in
+    for BrainResourceOptimizer and the master's persistence hooks, so
+    'in-process sqlite brain' and 'standalone brain deployment' are
+    the same code path with a different constructor."""
+
+    def __init__(self, addr: str, timeout: float = 10.0):
+        self._client = RpcClient(addr, timeout=timeout)
+
+    def close(self) -> None:
+        self._client.close()
+
+    # -- persistence -----------------------------------------------------
+
+    def persist_metrics(self, rec: JobMetricsRecord) -> None:
+        self._client.report(
+            msg.BrainPersistRequest(
+                kind="metrics", payload=dataclasses.asdict(rec)
+            )
+        )
+
+    def persist_runtime_sample(self, s: RuntimeSample) -> None:
+        self._client.report(
+            msg.BrainPersistRequest(
+                kind="sample", payload=dataclasses.asdict(s)
+            )
+        )
+
+    def persist_ps_job(self, **kw) -> None:
+        self._client.report(
+            msg.BrainPersistRequest(kind="ps_job", payload=dict(kw))
+        )
+
+    # -- algorithms ------------------------------------------------------
+
+    def _call(self, algorithm: str, *args, **kwargs):
+        resp = self._client.get(
+            msg.BrainOptimizeRequest(
+                algorithm=algorithm, args=list(args),
+                kwargs=dict(kwargs),
+            )
+        )
+        if not resp.ok:
+            raise RuntimeError(
+                f"brain algorithm {algorithm} failed: {resp.error}"
+            )
+        return resp.result
+
+
+def _add_algorithm_proxies() -> None:
+    """Generate one RemoteBrain method per BrainService algorithm
+    method, so the client tracks the service surface automatically.
+    Aliases (two algorithm names, one method) simply overwrite: any
+    registered name reaches the same remote method."""
+    for algo, method in ALGORITHMS.items():
+
+        def proxy(self, *args, _algo=algo, **kw):
+            return self._call(_algo, *args, **kw)
+
+        proxy.__name__ = method
+        proxy.__doc__ = (
+            f"Remote call of BrainService.{method} (algorithm "
+            f"{algo!r})."
+        )
+        setattr(RemoteBrain, method, proxy)
+
+
+_add_algorithm_proxies()

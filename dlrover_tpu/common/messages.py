@@ -558,3 +558,37 @@ class PsSetPartitionsRequest:
 
     partitions: List[int] = dataclasses.field(default_factory=list)
     map_version: int = 0
+
+
+# -- brain service wire messages (standalone brain: brain/server.py) --
+
+
+@message
+class BrainPersistRequest:
+    """Master/agents -> brain: persist one record. ``kind`` selects
+    the table ("metrics" | "sample" | "ps_job"); ``payload`` carries
+    the record's fields (JobMetricsRecord / RuntimeSample /
+    persist_ps_job kwargs)."""
+
+    kind: str = ""
+    payload: Dict[str, Any] = dataclasses.field(default_factory=dict)
+
+
+@message
+class BrainOptimizeRequest:
+    """Master -> brain: run a registered algorithm (the reference's
+    brain.Optimize RPC with its ProcessorID dispatch,
+    go/brain/pkg/optimizer/...). ``args``/``kwargs`` feed the
+    algorithm's positional/keyword parameters after the service."""
+
+    algorithm: str = ""
+    args: List[Any] = dataclasses.field(default_factory=list)
+    kwargs: Dict[str, Any] = dataclasses.field(default_factory=dict)
+
+
+@message
+class BrainOptimizeResponse:
+    ok: bool = True
+    # Algorithm result, JSON-ish (None / number / dict / list).
+    result: Any = None
+    error: str = ""
