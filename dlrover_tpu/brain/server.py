@@ -55,8 +55,11 @@ class BrainRpcServer:
         self._server.start()
         logger.info("brain serving on %s", self.addr)
 
-    def stop(self) -> None:
-        self._server.stop(0)
+    def stop(self, grace: float = 5.0) -> None:
+        # Drain in-flight persists on shutdown: a hard cancel would
+        # leave masters unable to tell whether their history record
+        # committed.
+        self._server.stop(grace)
 
     # -- handlers --------------------------------------------------------
 
@@ -83,7 +86,20 @@ class BrainRpcServer:
                 )
             )
         elif req.kind == "ps_job":
-            self.brain.persist_ps_job(**req.payload)
+            import inspect
+
+            params = set(
+                inspect.signature(
+                    self.brain.persist_ps_job
+                ).parameters
+            )
+            self.brain.persist_ps_job(
+                **{
+                    k: v
+                    for k, v in req.payload.items()
+                    if k in params
+                }
+            )
         else:
             raise ValueError(f"unknown persist kind {req.kind!r}")
         return None

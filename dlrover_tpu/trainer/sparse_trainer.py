@@ -1,0 +1,150 @@
+"""High-level PS-elastic training loop (dense tower + sparse tables).
+
+The capability of the reference's estimator executor with
+version-checked PS failover (trainer/tensorflow/executor/
+estimator_executor.py:52, failover/tensorflow_failover.py:33),
+reshaped for the split compute model: the dense tower trains in JAX
+(jit + optax), embeddings live in KvVariable tables on PS shards, and
+one ``SparseTrainer.train_step`` does lookup -> grad -> dense update +
+fused sparse apply. Failover is inherited, not re-implemented here:
+the sparse client's stale-map retry blocks the step while the
+PsManager liveness monitor rebalances a dead PS, then the step
+resumes — drilled end to end by ``examples/ctr/train.py --drill
+abrupt`` (RECOVERY_PS_r03.json).
+
+Periodic delta flushes (``flush_every``) bound the updates an abrupt
+PS death can lose; ``state_dict``/``load_state_dict`` carry the dense
+side for flash checkpoints while the PS side restores from its own
+per-partition files.
+"""
+
+from __future__ import annotations
+
+import time
+from typing import Callable, Dict, Optional, Tuple
+
+import jax
+import numpy as np
+
+from dlrover_tpu.common.log import get_logger
+
+logger = get_logger("sparse_trainer")
+
+
+class SparseTrainer:
+    """One object owning the dense/sparse split of a CTR-style step.
+
+    Parameters
+    ----------
+    client: DistributedKvClient (or KvVariable-compatible single-host
+        table set) routing lookups/updates to PS shards.
+    loss_and_grads: ``(dense_params, emb, *batch) ->
+        (loss, (dense_grads, emb_grads))`` — typically
+        ``jax.jit(jax.value_and_grad(loss, argnums=(0, 1)))``.
+    dense_optimizer: optax transformation for the dense tower.
+    table: embedding table name.
+    embedding_dim: rows' width.
+    sparse_optimizer / sparse_hparams: fused sparse rule + kwargs
+        (sparse/kv_variable.py rules, e.g. "group_adam", l21=...).
+    flush_manager: optional PsManager — enables the periodic
+        delta-flush cadence (``flush_every`` steps).
+    """
+
+    def __init__(
+        self,
+        client,
+        loss_and_grads: Callable,
+        dense_optimizer,
+        dense_params,
+        table: str = "emb",
+        embedding_dim: int = 8,
+        sparse_optimizer: str = "group_adam",
+        sparse_lr: float = 0.05,
+        sparse_hparams: Optional[Dict] = None,
+        flush_manager=None,
+        flush_every: int = 100,
+    ):
+        self.client = client
+        self.loss_and_grads = loss_and_grads
+        self.optimizer = dense_optimizer
+        self.dense = dense_params
+        self.opt_state = dense_optimizer.init(dense_params)
+        self.table = table
+        self.embedding_dim = embedding_dim
+        self.sparse_optimizer = sparse_optimizer
+        self.sparse_lr = sparse_lr
+        self.sparse_hparams = dict(sparse_hparams or {})
+        self.flush_manager = flush_manager
+        self.flush_every = flush_every
+        self.step_num = 0
+        # Rows persisted by the most recent periodic flush (drill /
+        # ops telemetry: bounds what an abrupt PS death can lose).
+        self.last_flush_rows = 0
+
+    def train_step(self, keys: np.ndarray, *batch) -> float:
+        """One update: lookup -> dense+embedding grads -> dense optax
+        update + fused sparse apply (+ periodic flush). ``keys`` is
+        the flat (or [B, F]) id tensor; extra args go to the loss.
+
+        A PS dying mid-step blocks inside the lookup/apply stale-map
+        retries until the master rebalances, then proceeds — the loop
+        never sees the failure."""
+        import jax.numpy as jnp
+        import optax
+
+        self.step_num += 1
+        flat = np.ascontiguousarray(keys, np.int64).ravel()
+        # Embeddings arrive as flat [N, D] rows aligned with ``flat``;
+        # the loss reshapes to its own field layout (e.g. [B, F*D]).
+        emb = jnp.asarray(self.client.lookup(self.table, flat))
+        loss, (dgrad, egrad) = self.loss_and_grads(
+            self.dense, emb, *batch
+        )
+        updates, self.opt_state = self.optimizer.update(
+            dgrad, self.opt_state, self.dense
+        )
+        self.dense = optax.apply_updates(self.dense, updates)
+        self.client.apply_gradients(
+            self.table,
+            flat,
+            np.asarray(egrad).reshape(-1, self.embedding_dim),
+            step=self.step_num,
+            optimizer=self.sparse_optimizer,
+            lr=self.sparse_lr,
+            **self.sparse_hparams,
+        )
+        if (
+            self.flush_manager is not None
+            and self.flush_every
+            and self.step_num % self.flush_every == 0
+        ):
+            t0 = time.time()
+            self.last_flush_rows = self.flush_manager.flush_all(
+                self.step_num
+            )
+            logger.info(
+                "step %d: delta-flushed %d rows in %.2fs",
+                self.step_num, self.last_flush_rows,
+                time.time() - t0,
+            )
+        return float(loss)
+
+    # -- dense-side checkpoint state ------------------------------------
+
+    def state_dict(self) -> Tuple:
+        return (self.dense, self.opt_state, self.step_num)
+
+    def load_state_dict(self, state: Tuple) -> None:
+        self.dense, self.opt_state, self.step_num = state
+
+    def device_state(self):
+        """(dense_params, opt_state) pytree — hand to the flash
+        checkpoint engine; the sparse side checkpoints via the PS
+        delta-flush files."""
+        return (self.dense, self.opt_state)
+
+
+def make_ctr_loss_and_grads(loss_fn: Callable) -> Callable:
+    """``loss_fn(dense, emb, *batch) -> scalar`` to the jitted
+    (loss, (dense_grads, emb_grads)) form SparseTrainer consumes."""
+    return jax.jit(jax.value_and_grad(loss_fn, argnums=(0, 1)))

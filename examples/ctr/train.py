@@ -139,10 +139,28 @@ def main(argv=None) -> int:
         lambda: mgr.partition_map, {"emb": EMB_DIM},
     )
 
-    dense = dense_init(jax.random.PRNGKey(0))
-    opt = optax.adamw(1e-2)
-    opt_state = opt.init(dense)
-    grad_fn = jax.jit(jax.value_and_grad(loss_fn, argnums=(0, 1)))
+    from dlrover_tpu.trainer.sparse_trainer import (
+        SparseTrainer,
+        make_ctr_loss_and_grads,
+    )
+
+    def ctr_loss(dense, emb, labels):
+        emb = emb.reshape(-1, N_FIELDS * EMB_DIM)
+        return loss_fn(dense, emb, labels)
+
+    trainer = SparseTrainer(
+        client,
+        make_ctr_loss_and_grads(ctr_loss),
+        optax.adamw(1e-2),
+        dense_init(jax.random.PRNGKey(0)),
+        table="emb",
+        embedding_dim=EMB_DIM,
+        sparse_optimizer=args.optimizer,
+        sparse_lr=0.05,
+        sparse_hparams={"l21": args.l21},
+        flush_manager=mgr,
+        flush_every=args.flush_every,
+    )
 
     if args.drill == "abrupt":
         # Fast cadence so the in-process drill resolves in seconds;
@@ -161,30 +179,15 @@ def main(argv=None) -> int:
             kill_at += max(1, args.flush_every // 2)
     losses = []
     drill_stats = {}
-    last_flush_rows = 0
     t0 = time.time()
     for step in range(1, args.steps + 1):
         step_start = time.time()
         keys, labels = synthetic_batch(rng, args.batch)
-        emb = client.lookup("emb", keys.ravel())
-        emb = jnp.asarray(
-            emb.reshape(args.batch, N_FIELDS * EMB_DIM)
-        )
-        loss, (dgrad, egrad) = grad_fn(
-            dense, emb, jnp.asarray(labels)
-        )
-        updates, opt_state = opt.update(dgrad, opt_state, dense)
-        dense = optax.apply_updates(dense, updates)
-        client.apply_gradients(
-            "emb",
-            keys.ravel(),
-            np.asarray(egrad).reshape(-1, EMB_DIM),
-            step=step,
-            optimizer=args.optimizer,
-            lr=0.05,
-            l21=args.l21,
-        )
-        losses.append(float(loss))
+        # One high-level step: lookup -> grads -> dense update +
+        # fused sparse apply + periodic flush, surviving PS failover
+        # inside (trainer/sparse_trainer.py).
+        loss = trainer.train_step(keys.ravel(), jnp.asarray(labels))
+        losses.append(loss)
 
         if drill_stats.get("kill_step") == step - 1:
             # First full step after the kill: everything blocked in it
@@ -205,9 +208,6 @@ def main(argv=None) -> int:
                 f"{drill_stats['rows_after_recovery']})"
             )
 
-        if args.flush_every and step % args.flush_every == 0:
-            last_flush_rows = mgr.flush_all(step)
-
         if args.drill and step == kill_at:
             vid = max(servers)
             victim = servers.pop(vid)
@@ -217,7 +217,7 @@ def main(argv=None) -> int:
                 "killed_ps": vid,
                 "kill_step": step,
                 "victim_rows": rows,
-                "rows_at_last_flush": last_flush_rows,
+                "rows_at_last_flush": trainer.last_flush_rows,
                 "map_version_before": mgr.partition_map.version,
                 "_kill_time": time.time(),
             }
@@ -238,7 +238,7 @@ def main(argv=None) -> int:
                 print(
                     f"DRILL: PS {vid} died abruptly at step {step} "
                     f"({rows} rows in memory, last flush "
-                    f"{last_flush_rows}); waiting for liveness "
+                    f"{trainer.last_flush_rows}); waiting for liveness "
                     "failover"
                 )
 

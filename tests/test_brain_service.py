@@ -124,11 +124,20 @@ class TestCli:
             text=True,
         )
         try:
+            import select
+
             port = None
             deadline = time.time() + 20
             while time.time() < deadline and port is None:
                 if proc.poll() is not None:
                     break  # died before printing the port
+                # Non-blocking read: readline() on a silent-but-alive
+                # child would hang past the deadline.
+                ready, _, _ = select.select(
+                    [proc.stdout], [], [], 0.2
+                )
+                if not ready:
+                    continue
                 line = proc.stdout.readline()
                 if line.startswith("DLROVER_TPU_BRAIN_PORT="):
                     port = int(line.strip().split("=")[1])

@@ -178,3 +178,74 @@ class TestMasterWiring:
         # handle_node_gone relaunches within budget; the DELETED event
         # still fires and must remove the PS from the partition map.
         assert 1 not in mgr.partition_map.ps_addrs
+
+
+class TestSparseTrainer:
+    def test_high_level_loop_trains_and_survives_ps_kill(
+        self, cluster
+    ):
+        """D21 closure: the high-level PS training loop
+        (trainer/sparse_trainer.py) trains the dense+sparse split and
+        survives an abrupt PS death mid-run without the loop seeing
+        the failure."""
+        import jax
+        import jax.numpy as jnp
+        import optax
+
+        from dlrover_tpu.trainer.sparse_trainer import (
+            SparseTrainer,
+            make_ctr_loss_and_grads,
+        )
+
+        mgr, servers = cluster
+        client = _client(mgr)
+        dim = DIMS["emb"]
+
+        def loss_fn(dense, emb, labels):
+            logits = (emb @ dense["w"]).reshape(-1, 8).sum(-1)
+            return jnp.mean(
+                optax.sigmoid_binary_cross_entropy(logits, labels)
+            )
+
+        trainer = SparseTrainer(
+            client,
+            make_ctr_loss_and_grads(loss_fn),
+            optax.adam(1e-2),
+            {"w": jnp.ones((dim,)) * 0.1},
+            table="emb",
+            embedding_dim=dim,
+            sparse_optimizer="adagrad",
+            sparse_lr=0.3,
+            flush_manager=mgr,
+            flush_every=5,
+        )
+        rng = np.random.default_rng(0)
+
+        def batch():
+            keys = rng.integers(0, 256, size=(16, 8)).astype("int64")
+            labels = (keys.sum(1) % 2).astype("float32")
+            return keys.ravel(), jnp.asarray(labels)
+
+        losses = [trainer.train_step(*batch()) for _ in range(12)]
+        assert trainer.last_flush_rows > 0  # periodic flush ran
+
+        # Abrupt PS death; fail over concurrently while the next
+        # train_step blocks in its sparse ops.
+        victim = servers.pop(1)
+        victim.stop()
+
+        def failover():
+            time.sleep(0.3)
+            mgr.check_liveness(failure_threshold=1)
+
+        t = threading.Thread(target=failover)
+        t.start()
+        post = [trainer.train_step(*batch()) for _ in range(8)]
+        t.join()
+        assert all(np.isfinite(post))
+        assert trainer.step_num == 20
+        # dense state round-trips for flash checkpoints
+        state = trainer.state_dict()
+        trainer.load_state_dict(state)
+        assert trainer.step_num == 20
+        client.close()
