@@ -220,14 +220,45 @@ def _run_child(argv: list[str], timeout_s: float) -> tuple[str, str, str]:
     return out, "ok", ""
 
 
+# Transient signatures are checked FIRST: jax surfaces tunnel outages
+# as e.g. "XlaRuntimeError: UNAVAILABLE: ...", which must stay
+# retryable even though it contains an *Error name. Then deterministic
+# Python crash signatures forfeit the budget immediately. Anything
+# unrecognized defaults to RETRYABLE — the tunnel's failure texts vary
+# (DEADLINE_EXCEEDED, connection reset, truncated stderr, ...), and a
+# wasted retry budget is cheaper than misretrying never.
+_TRANSIENT_SIGNATURES = (
+    "UNAVAILABLE",
+    "DEADLINE_EXCEEDED",
+    "initialize backend",
+    "onnection",  # Connection/connection reset/refused
+    "timed out",
+)
+_DETERMINISTIC_SIGNATURES = (
+    "ImportError",
+    "ModuleNotFoundError",
+    "SyntaxError",
+    "AttributeError",
+    "NameError",
+    "TypeError",
+    "ValueError",
+    "KeyError",
+    "IndexError",
+    "AssertionError",
+    "child printed no JSON",
+)
+
+
 def _classify(status: str, detail: str) -> str:
     if status == "never_ran":
         return "budget_exhausted"
     if status == "timeout":
         return "tpu_hang"
-    if "UNAVAILABLE" in detail or "initialize backend" in detail:
+    if any(sig in detail for sig in _TRANSIENT_SIGNATURES):
         return "tpu_unavailable"
-    return "bench_error"
+    if any(sig in detail for sig in _DETERMINISTIC_SIGNATURES):
+        return "bench_error"
+    return "tpu_unavailable"
 
 
 def _emit_failure(error_class: str, detail: str, attempts: int) -> None:

@@ -481,6 +481,25 @@ def _flash_lse_bwd(causal, scale, block_q, block_k, block_q_bwd,
 _flash_lse.defvjp(_flash_lse_fwd, _flash_lse_bwd)
 
 
+def _check_block_chain(blocks, t: int) -> int:
+    """lcm of ``blocks``, rejecting sets whose combined lcm would
+    materially inflate the padded sequence. Divisibility-chain-ish
+    sets (lcm <= 2*max) always pass; a coprime set passes only when
+    the padding it actually forces at this ``t`` stays under one
+    max-block of slack — so tuned configs where t already divides the
+    lcm keep working, while e.g. bq=512/bqb=384 at t=520 (pad to
+    1536, ~3x kernel work) are rejected."""
+    lcm = math.lcm(*blocks)
+    if lcm > 2 * max(blocks) and (-t) % lcm >= max(blocks):
+        raise ValueError(
+            f"block sizes {tuple(blocks)} are too coprime at t={t}: "
+            f"padding to their lcm ({lcm}) would inflate the "
+            "sequence for every kernel, not just the one being tuned "
+            "— pick sizes that divide one another"
+        )
+    return lcm
+
+
 def default_block_sizes(t: int) -> tuple:
     """Autotuned (block_q, block_k) by sequence length (measured on
     v5e, GPT-2 train step): 512 blocks beat 128 by ~2.5x at T=1024
@@ -557,7 +576,13 @@ def flash_attention(
     req_kb = req_k if block_k_bwd is None else block_k_bwd
     reqs = (req_q, req_k, req_qb, req_kb)
     in_range = [r for r in reqs if r <= cap]
-    unit = math.lcm(*in_range) if in_range else 1
+    # Guard the in-range blocks BEFORE substituting padded_base (a
+    # multiple of their lcm): the substitution makes padded_base the
+    # max of the final block set, so the post-substitution check alone
+    # can never fire for coprime in-range blocks — e.g. bq=512,
+    # bqb=384, bk=1024 at t=520 must be rejected, not silently padded
+    # 520 -> 1536 (~3x kernel work).
+    unit = _check_block_chain(in_range, t) if in_range else 1
     padded_base = max(8, math.ceil(t / unit) * unit)
     block_q, block_k, block_q_bwd, block_k_bwd = (
         r if r <= cap else padded_base for r in reqs
@@ -565,18 +590,12 @@ def flash_attention(
 
     # Pad so the padded length is divisible by EVERY block size (lcm),
     # otherwise the floor-divided grids would silently drop tail
-    # blocks. Guard against lcm explosion: all four block sizes must
-    # form a divisibility chain (lcm == max), or a backward-side knob
-    # would silently inflate the FORWARD pass (e.g. bk=128 with
-    # bkb=96 pads T=1024 to 1152; bkb=520 vs bq=512 would pad 32x).
+    # blocks. Inflation protection lives entirely in the
+    # pre-substitution check above: after substitution padded_base is
+    # a multiple of lcm(in_range) and the max of the set, so this lcm
+    # equals padded_base (or lcm(in_range) when nothing was
+    # substituted) and cannot explode.
     blocks = (block_q, block_k, block_q_bwd, block_k_bwd)
-    if math.lcm(*blocks) > 2 * max(blocks):
-        raise ValueError(
-            f"block sizes {blocks} are too coprime: padding to their "
-            f"lcm ({math.lcm(*blocks)}) would inflate the sequence "
-            "for every kernel, not just the one being tuned — pick "
-            "sizes that divide one another"
-        )
     pad = (-t) % math.lcm(*blocks)
 
     def to_kernel_layout(x):

@@ -40,6 +40,19 @@ from dlrover_tpu.ops.flash_attention import (
 )
 
 DEFAULT_BLOCK_ROWS = 256
+# Per-ref VMEM budget for a [block_rows, E] f32 block. The backward
+# kernel keeps ~6 such refs live per grid step, so 1 MiB/ref stays
+# well under the ~16 MiB/core VMEM even before double-buffering.
+_ROW_BLOCK_BYTE_BUDGET = 1 << 20
+
+
+def pick_block_rows(e: int) -> int:
+    """Default row-block for embedding width ``e``: the fixed
+    DEFAULT_BLOCK_ROWS while a [rows, e] f32 block fits the byte
+    budget, shrinking (multiples of 8) as ``e`` grows so wide models
+    (e >= 1024) cannot overflow VMEM."""
+    rows = _ROW_BLOCK_BYTE_BUDGET // (max(e, 1) * 4)
+    return min(DEFAULT_BLOCK_ROWS, max(8, rows - rows % 8))
 
 
 def _rows_pad(n: int, block: int) -> int:
@@ -320,7 +333,7 @@ def fused_layer_norm(
     g: jax.Array,
     b: Optional[jax.Array] = None,
     eps: float = 1e-5,
-    block_rows: int = DEFAULT_BLOCK_ROWS,
+    block_rows: Optional[int] = None,
     interpret: Optional[bool] = None,
 ) -> jax.Array:
     """LayerNorm over the last axis, f32 statistics, any float input
@@ -328,6 +341,8 @@ def fused_layer_norm(
     """
     if interpret is None:
         interpret = _use_interpret()
+    if block_rows is None:
+        block_rows = pick_block_rows(x.shape[-1])
     return _norm(x, g, b, eps, False, block_rows, interpret)
 
 
@@ -335,12 +350,14 @@ def fused_rms_norm(
     x: jax.Array,
     g: jax.Array,
     eps: float = 1e-6,
-    block_rows: int = DEFAULT_BLOCK_ROWS,
+    block_rows: Optional[int] = None,
     interpret: Optional[bool] = None,
 ) -> jax.Array:
     """RMSNorm over the last axis (Llama family)."""
     if interpret is None:
         interpret = _use_interpret()
+    if block_rows is None:
+        block_rows = pick_block_rows(x.shape[-1])
     return _norm(x, g, None, eps, True, block_rows, interpret)
 
 
@@ -350,7 +367,7 @@ def fused_add_layer_norm(
     g: jax.Array,
     b: Optional[jax.Array] = None,
     eps: float = 1e-5,
-    block_rows: int = DEFAULT_BLOCK_ROWS,
+    block_rows: Optional[int] = None,
     interpret: Optional[bool] = None,
 ) -> Tuple[jax.Array, jax.Array]:
     """(norm(x + residual), x + residual) with the add fused into the
@@ -361,6 +378,8 @@ def fused_add_layer_norm(
     """
     if interpret is None:
         interpret = _use_interpret()
+    if block_rows is None:
+        block_rows = pick_block_rows(x.shape[-1])
     return _add_norm(
         x, residual, g, b, eps, False, block_rows, interpret
     )
@@ -371,12 +390,14 @@ def fused_add_rms_norm(
     residual: jax.Array,
     g: jax.Array,
     eps: float = 1e-6,
-    block_rows: int = DEFAULT_BLOCK_ROWS,
+    block_rows: Optional[int] = None,
     interpret: Optional[bool] = None,
 ) -> Tuple[jax.Array, jax.Array]:
     """(rmsnorm(x + residual), x + residual) — Llama residual spine."""
     if interpret is None:
         interpret = _use_interpret()
+    if block_rows is None:
+        block_rows = pick_block_rows(x.shape[-1])
     return _add_norm(
         x, residual, g, None, eps, True, block_rows, interpret
     )

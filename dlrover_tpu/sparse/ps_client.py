@@ -83,34 +83,51 @@ class DistributedKvClient:
 
     def _fan_out(self, keys: np.ndarray, call):
         """Group flat ``keys`` by owning PS and run ``call(addr,
-        version, sub_keys, idx)`` per shard in parallel; retries the
-        whole round with a fresh map on StaleMapError-style failures."""
+        version, sub_keys, idx)`` per shard in parallel.
+
+        Success is tracked per key position: a shard whose call
+        committed is never re-sent, so a retry after a mid-round PS
+        death (stale map, rebalance in flight) only replays the keys
+        that actually failed. This keeps ``apply_gradients`` — which
+        is not idempotent — from double-applying updates on surviving
+        partitions during failover.
+        """
         last_err: Optional[Exception] = None
+        pending = np.arange(keys.size)
         for attempt in range(self.max_retries):
             pmap = self._refresh_map(force=attempt > 0)
-            groups = pmap.group_keys(keys)
+            groups = pmap.group_keys(keys[pending])
             futs = []
-            for ps_id, idx in groups.items():
+            for ps_id, local_idx in groups.items():
+                idx = pending[local_idx]
                 addr = pmap.ps_addrs.get(ps_id)
                 if addr is None:
+                    # Stays pending; a fresh map next attempt should
+                    # route these keys to a live shard.
                     last_err = RpcError(f"no address for PS {ps_id}")
-                    break
-                futs.append(self._pool.submit(
+                    continue
+                futs.append((idx, self._pool.submit(
                     call, addr, pmap.version, keys[idx], idx
-                ))
-            else:
+                )))
+            done = []
+            for idx, f in futs:
                 try:
-                    for f in futs:
-                        f.result()
-                    return
+                    f.result()
+                    done.append(idx)
                 except Exception as e:  # noqa: BLE001 — retried
                     last_err = e
+            if done:
+                pending = np.setdiff1d(
+                    pending, np.concatenate(done), assume_unique=True
+                )
+            if pending.size == 0:
+                return
             # A reshard is in flight or a PS died: wait for the master
-            # to publish a new map, then retry from scratch.
+            # to publish a new map, then retry the failed keys only.
             time.sleep(self.retry_interval * (1 + attempt))
         raise RpcError(
-            f"sparse op failed after {self.max_retries} retries: "
-            f"{last_err}"
+            f"sparse op failed after {self.max_retries} retries "
+            f"({pending.size}/{keys.size} keys unapplied): {last_err}"
         )
 
     # -- API -------------------------------------------------------------

@@ -164,7 +164,10 @@ def main(argv=None) -> int:
 
     if args.drill == "abrupt":
         # Fast cadence so the in-process drill resolves in seconds;
-        # production uses the defaults (5 s ticks, 3 strikes).
+        # production uses PsManager.start_liveness_monitor's defaults
+        # (2 s ticks, 2 strikes, 3 s ping timeout -> ~10 s worst-case
+        # detection, which the sparse client's ~39 s retry budget is
+        # sized against — see ps_client.py).
         mgr.start_liveness_monitor(
             interval=0.5, failure_threshold=2, ping_timeout=2.0
         )
