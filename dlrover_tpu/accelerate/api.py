@@ -13,6 +13,7 @@ auto/engine/ is unnecessary by construction.
 from __future__ import annotations
 
 import dataclasses
+import functools
 import time
 from typing import Any, Callable, Dict, List, Optional, Sequence, Tuple
 
@@ -130,6 +131,91 @@ class AccelerateResult:
     search_log: Optional[List[Dict]] = None
 
 
+def _seq_attention_opts(model_loss) -> Dict:
+    """Read the attention preferences out of a ``cfg`` bound into the
+    loss closure (the models' functools.partial convention): a
+    ``use_flash_attention=True/False`` pin survives the seq-parallel
+    binding instead of being overridden by 'auto', and a declared
+    ``cfg.causal`` (GPTConfig/LlamaConfig field) decides the mask."""
+    fn = model_loss
+    while isinstance(fn, functools.partial):
+        cfg = fn.keywords.get("cfg")
+        if cfg is not None:
+            opts: Dict = {}
+            pin = getattr(cfg, "use_flash_attention", None)
+            if pin is not None:
+                opts["impl"] = "flash" if pin else "xla"
+            causal = getattr(cfg, "causal", None)
+            if causal is not None:
+                opts["causal"] = causal
+            return opts
+        fn = fn.func
+    return {}
+
+
+def _maybe_bind_seq_attention(
+    model_loss,
+    mesh,
+    strategy: Strategy,
+    seq_attention_kwargs: Optional[Dict] = None,
+):
+    """Honor Strategy.seq_impl: when the mesh has a real seq axis and
+    the model exposes an unbound ``attn_fn`` hook (models/gpt.py,
+    models/llama.py loss signatures), bind the chosen sequence-parallel
+    attention family. Models without the hook (or with attn_fn already
+    bound by the caller) are left alone — GSPMD sharding of the plain
+    attention stays correct either way, the family knob just decides
+    which collective schedule runs.
+
+    Causality comes from ``cfg.causal`` when the model declares it
+    (GPTConfig/LlamaConfig do) or from ``seq_attention_kwargs``;
+    otherwise causal=True is ASSUMED and the log says so — a
+    non-causal model without the declaration must either bind its own
+    attn_fn (which disables this hook) or pass
+    ``seq_attention_kwargs={"causal": False}``. A cfg-pinned
+    ``use_flash_attention`` is honored via :func:`_seq_attention_opts`;
+    explicit kwargs win over both.
+    """
+    import inspect
+
+    if mesh.shape.get("seq", 1) == 1:
+        return model_loss
+    try:
+        param = inspect.signature(model_loss).parameters.get("attn_fn")
+    except (TypeError, ValueError):
+        return model_loss
+    if param is None:
+        return model_loss
+    bound_default = (
+        param.default is not inspect.Parameter.empty
+        and param.default is not None
+    )
+    if bound_default:
+        # The caller already chose an attention fn — never override.
+        return model_loss
+    from dlrover_tpu.parallel.seq_attention import make_seq_attention
+
+    opts = _seq_attention_opts(model_loss)
+    opts.update(seq_attention_kwargs or {})
+    assumed = "causal" not in opts
+    attn = make_seq_attention(
+        mesh, seq_impl=strategy.seq_impl, **opts
+    )
+    logger.info(
+        "seq-parallel attention bound: seq_impl=%s opts=%s%s",
+        strategy.seq_impl,
+        opts,
+        (
+            " (causal=True ASSUMED — declare cfg.causal or pass "
+            'seq_attention_kwargs={"causal": False} for a '
+            "non-causal model)"
+            if assumed
+            else ""
+        ),
+    )
+    return functools.partial(model_loss, attn_fn=attn)
+
+
 def _build_for_strategy(
     strategy: Strategy,
     model_init: Callable,
@@ -138,6 +224,7 @@ def _build_for_strategy(
     learning_rate: float,
     devices,
     optimizer_kwargs: Optional[Dict] = None,
+    seq_attention_kwargs: Optional[Dict] = None,
 ):
     mesh_cfg = MeshConfig(**strategy.mesh_dict)
     n_needed = 1
@@ -152,7 +239,10 @@ def _build_for_strategy(
     init, _ = make_sharded_init(
         mesh, model_init, logical_axes, optimizer
     )
-    step = make_train_step(mesh, model_loss, optimizer)
+    loss = _maybe_bind_seq_attention(
+        model_loss, mesh, strategy, seq_attention_kwargs
+    )
+    step = make_train_step(mesh, loss, optimizer)
     return mesh, optimizer, init, step
 
 
@@ -281,6 +371,7 @@ def auto_accelerate(
     hbm_bytes: Optional[int] = None,
     max_dry_runs: int = 6,
     optimizer_kwargs: Optional[Dict] = None,
+    seq_attention_kwargs: Optional[Dict] = None,
 ) -> AccelerateResult:
     """Pick (or apply) a strategy and return the compiled pieces.
 
@@ -288,12 +379,17 @@ def auto_accelerate(
     None it analyses, prunes by memory estimate, dry-runs the top
     candidates and keeps the fastest. ``optimizer_kwargs`` forwards
     schedule/clipping knobs to make_optimizer.
+    ``seq_attention_kwargs`` overrides the seq-parallel attention
+    binding for seq-sharded strategies (e.g. ``{"causal": False}``
+    for a non-causal model — the binding assumes a causal LM
+    otherwise; see _maybe_bind_seq_attention).
     """
     devices = list(devices if devices is not None else jax.devices())
     if strategy is not None:
         mesh, optimizer, init, step = _build_for_strategy(
             strategy, model_init, model_loss, logical_axes,
             learning_rate, devices, optimizer_kwargs,
+            seq_attention_kwargs,
         )
         return AccelerateResult(
             strategy=strategy,
@@ -352,6 +448,7 @@ def auto_accelerate(
             build_cache[key] = _build_for_strategy(
                 s, model_init, model_loss, logical_axes,
                 learning_rate, devices, optimizer_kwargs,
+                seq_attention_kwargs,
             )
         return build_cache[key]
 

@@ -25,6 +25,11 @@ class Strategy:
     dtype: str = "bfloat16"  # compute/weights dtype policy
     optimizer: str = "adamw"  # adamw | agd | adam8bit | adam4bit | sgd
     micro_batch_size: int = 8
+    # Sequence-parallel family when the mesh has a seq axis:
+    # "auto" (a2a when heads-per-tensor-shard divides by seq shards,
+    # ring otherwise — parallel/seq_attention.py), or forced
+    # "ring"/"a2a".
+    seq_impl: str = "auto"
 
     @property
     def mesh_dict(self) -> Dict[str, int]:
@@ -37,10 +42,11 @@ class Strategy:
 
     def name(self) -> str:
         mesh = "x".join(f"{a}{s}" for a, s in self.mesh_shape if s > 1)
+        sp = "" if self.seq_impl == "auto" else f"-sp:{self.seq_impl}"
         return (
             f"{mesh or 'single'}-{self.dtype}"
             f"-remat:{self._remat_name()}-{self.optimizer}"
-            f"-mb{self.micro_batch_size}"
+            f"-mb{self.micro_batch_size}{sp}"
         )
 
     def to_json(self) -> str:
@@ -75,6 +81,7 @@ def candidate_strategies(
     optimizers: Tuple[str, ...] = ("adamw",),
     remats: Tuple[object, ...] = (False, "attention", True),
     max_tensor: int = 8,
+    seq_impls: Tuple[str, ...] = ("auto",),
 ) -> List[Strategy]:
     """Enumerate the raw candidate grid (the reference's
     CombinationAlgorithm, auto/engine/sg_algo/combination_sg.py:16).
@@ -94,8 +101,11 @@ def candidate_strategies(
         d = dict(shape)
         if d.get("tensor", 1) > max_tensor:
             continue
-        for mb, dt, opt, rm in itertools.product(
-            micro_batch_sizes, dtypes, optimizers, remats
+        # The seq_impl knob only distinguishes candidates when a seq
+        # axis exists (otherwise every family degenerates identically).
+        sps = seq_impls if d.get("seq", 1) > 1 else ("auto",)
+        for mb, dt, opt, rm, sp in itertools.product(
+            micro_batch_sizes, dtypes, optimizers, remats, sps
         ):
             out.append(
                 Strategy(
@@ -104,6 +114,7 @@ def candidate_strategies(
                     dtype=dt,
                     optimizer=opt,
                     micro_batch_size=mb,
+                    seq_impl=sp,
                 )
             )
     return out

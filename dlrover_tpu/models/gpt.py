@@ -51,6 +51,10 @@ class GPTConfig:
     # None = auto (fused Pallas norm kernels on TPU,
     # ops/layer_norm.py); True/False forces.
     use_fused_norm: Optional[bool] = None
+    # Declared attention masking. Decoder-only LMs are causal; the
+    # auto_accelerate seq-parallel binding reads this so a non-causal
+    # model config is never silently given a causal mask.
+    causal: bool = True
 
     @property
     def head_dim(self) -> int:
@@ -231,11 +235,12 @@ def default_attention_for(cfg: GPTConfig) -> Callable:
         use_flash = (
             jax.default_backend() == "tpu" and cfg.block_size >= 512
         )
+    causal = getattr(cfg, "causal", True)
     if use_flash:
         from dlrover_tpu.ops.flash_attention import flash_attention
 
-        return functools.partial(flash_attention, causal=True)
-    return functools.partial(_default_attention, causal=True)
+        return functools.partial(flash_attention, causal=causal)
+    return functools.partial(_default_attention, causal=causal)
 
 
 def backbone(

@@ -54,6 +54,9 @@ class LlamaConfig:
     use_flash_attention: Optional[bool] = None
     # None = auto (fused Pallas RMSNorm on TPU, ops/layer_norm.py).
     use_fused_norm: Optional[bool] = None
+    # Declared attention masking (read by the auto_accelerate
+    # seq-parallel binding, like GPTConfig.causal).
+    causal: bool = True
     # > 0 switches every block's MLP to a mixture-of-experts routed
     # over the ``expert`` mesh axis (models/moe.py — Mixtral-shaped
     # family; experts use the GShard FFN formulation). ``intermediate``

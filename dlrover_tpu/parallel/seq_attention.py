@@ -1,0 +1,100 @@
+"""Sequence-parallel attention dispatcher: ring vs all-to-all.
+
+The repo carries two context-parallel families (SURVEY §5 long-context
+stance; ref: atorch's DistributedSoftmaxAttn/_attn variants,
+atorch/modules/distributed_transformer/distributed_attention.py:80):
+
+* ``ring`` (parallel/ring_attention.py): K/V blocks rotate around the
+  ``seq`` axis; O(T/s) activation memory, works for any head count,
+  but causal work is imbalanced by ring position.
+* ``a2a`` (parallel/ulysses.py): one all_to_all turns sequence shards
+  into head shards, every device runs full-sequence flash attention
+  over its head group; perfectly balanced causal work, but needs
+  heads (per tensor shard) divisible by the seq axis and holds full-T
+  activations during attention.
+
+``make_seq_attention`` is the one constructor models and the strategy
+engine use: an explicit ``seq_impl`` forces a family, ``"auto"``
+applies :func:`choose_seq_impl` at trace time (head count is static
+under jit, so the choice compiles away).
+"""
+
+from __future__ import annotations
+
+from typing import Optional
+
+from jax.sharding import Mesh
+
+SEQ_IMPLS = ("auto", "ring", "a2a")
+
+
+def choose_seq_impl(
+    n_heads: int, seq_shards: int, tensor_shards: int = 1
+) -> str:
+    """The auto rule: a2a when every seq shard can own an equal slice
+    of this tensor shard's heads (better causal load balance, one
+    bulk exchange instead of s-1 hops), ring otherwise (no head-count
+    constraint, O(T/s) memory)."""
+    if seq_shards <= 1:
+        return "ring"  # degenerate: ring's single-shard fallback
+    if n_heads % tensor_shards:
+        return "ring"
+    heads_per_shard = n_heads // tensor_shards
+    return "a2a" if heads_per_shard % seq_shards == 0 else "ring"
+
+
+def make_seq_attention(
+    mesh: Mesh,
+    causal: bool = True,
+    axis_name: str = "seq",
+    batch_axes=("data", "fsdp"),
+    head_axis: Optional[str] = "tensor",
+    impl: str = "auto",
+    seq_impl: str = "auto",
+):
+    """Sharded attention for a mesh with a ``seq`` axis.
+
+    ``impl`` picks the kernel (flash/xla/auto, as in
+    ring_attention.make_sharded_attention); ``seq_impl`` picks the
+    parallelism family (ring/a2a/auto). The returned fn takes global
+    [B, T, H, D] q/k/v under jit.
+    """
+    if seq_impl not in SEQ_IMPLS:
+        raise ValueError(
+            f"unknown seq_impl {seq_impl!r}; expected one of {SEQ_IMPLS}"
+        )
+    from dlrover_tpu.parallel.ring_attention import make_sharded_attention
+    from dlrover_tpu.parallel.ulysses import make_a2a_attention
+
+    kwargs = dict(
+        causal=causal,
+        axis_name=axis_name,
+        batch_axes=batch_axes,
+        head_axis=head_axis,
+        impl=impl,
+    )
+    if seq_impl == "ring":
+        return make_sharded_attention(mesh, **kwargs)
+    if seq_impl == "a2a":
+        return make_a2a_attention(mesh, **kwargs)
+
+    seq_shards = mesh.shape.get(axis_name, 1)
+    tensor_shards = (
+        mesh.shape.get(head_axis, 1) if head_axis is not None else 1
+    )
+    built = {}
+
+    def attn(q, k, v):
+        # q.shape[2] is the GLOBAL head count (shard_map happens
+        # inside the family constructors), static at trace time.
+        choice = choose_seq_impl(q.shape[2], seq_shards, tensor_shards)
+        if choice not in built:
+            ctor = (
+                make_a2a_attention
+                if choice == "a2a"
+                else make_sharded_attention
+            )
+            built[choice] = ctor(mesh, **kwargs)
+        return built[choice](q, k, v)
+
+    return attn

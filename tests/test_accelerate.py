@@ -1,5 +1,6 @@
 """auto_accelerate: analyser, candidate pruning, dry-run search."""
 
+import dataclasses
 import functools
 
 import jax
@@ -125,6 +126,102 @@ def test_search_picks_a_strategy_and_logs():
     assert res.throughput is not None and res.throughput > 0
     ran = [e for e in res.search_log if "samples_per_sec" in e]
     assert len(ran) == 2
+
+
+def test_candidate_strategies_seq_impl_knob():
+    """seq_impls only multiplies candidates that have a real seq axis."""
+    cands = candidate_strategies(8, seq_impls=("ring", "a2a"))
+    with_seq = [c for c in cands if c.mesh_dict.get("seq", 1) > 1]
+    without_seq = [c for c in cands if c.mesh_dict.get("seq", 1) == 1]
+    assert {c.seq_impl for c in with_seq} == {"ring", "a2a"}
+    assert {c.seq_impl for c in without_seq} == {"auto"}
+    assert len({c.name() for c in cands}) == len(cands)
+    # round-trips through json
+    s = with_seq[0]
+    assert Strategy.from_json(s.to_json()) == s
+
+
+@pytest.mark.parametrize("seq_impl", ["ring", "a2a", "auto"])
+def test_seq_strategy_trains_with_each_impl(seq_impl):
+    """A seq-sharded strategy binds the chosen sequence-parallel
+    attention family into the built step (CFG has 2 heads, seq=2:
+    the a2a head constraint holds, auto also routes to a2a)."""
+    init, loss, axes = _model()
+    s = Strategy(
+        mesh_shape=(("data", 2), ("seq", 2)),
+        dtype="float32",
+        micro_batch_size=4,
+        seq_impl=seq_impl,
+    )
+    res = auto_accelerate(
+        init, loss, axes, _sample_batch(), strategy=s,
+        devices=jax.devices()[:4],
+    )
+    params, opt_state = res.init_fn(jax.random.PRNGKey(0))
+    tokens, targets = res.shard_batch_fn(*_sample_batch(4))
+    losses = []
+    for _ in range(5):
+        params, opt_state, metrics = res.step_fn(
+            params, opt_state, tokens, targets
+        )
+        losses.append(float(metrics["loss"]))
+    assert losses[-1] < losses[0]
+
+
+def test_seq_binding_honors_model_attention_pin():
+    """The auto-binding must not override a cfg-pinned attention
+    kernel choice, and must leave models with a caller-bound attn_fn
+    alone."""
+    from dlrover_tpu.accelerate.api import (
+        _maybe_bind_seq_attention,
+        _seq_attention_opts,
+    )
+    from dlrover_tpu.parallel.mesh import MeshConfig, build_mesh
+
+    # cfg pin -> impl forwarded; causal always declared by GPTConfig
+    pinned = functools.partial(
+        gpt.loss_fn,
+        cfg=dataclasses.replace(CFG, use_flash_attention=False),
+    )
+    assert _seq_attention_opts(pinned) == {
+        "impl": "xla", "causal": True,
+    }
+    assert _seq_attention_opts(
+        functools.partial(gpt.loss_fn, cfg=CFG)
+    ) == {"causal": True}
+    # a non-causal declaration rides through to the binding
+    assert _seq_attention_opts(
+        functools.partial(
+            gpt.loss_fn, cfg=dataclasses.replace(CFG, causal=False)
+        )
+    ) == {"causal": False}
+
+    mesh = build_mesh(
+        MeshConfig(data=2, seq=2), devices=jax.devices()[:4]
+    )
+    s = Strategy(mesh_shape=(("data", 2), ("seq", 2)))
+    # caller already bound attn_fn: binding is a no-op
+    prebound = functools.partial(
+        gpt.loss_fn, cfg=CFG, attn_fn=gpt._default_attention
+    )
+    assert _maybe_bind_seq_attention(prebound, mesh, s) is prebound
+    # unbound hook gets wrapped; explicit kwargs thread through
+    bound = _maybe_bind_seq_attention(
+        functools.partial(gpt.loss_fn, cfg=CFG), mesh, s,
+        seq_attention_kwargs={"causal": True},
+    )
+    assert isinstance(bound, functools.partial)
+    assert "attn_fn" in bound.keywords
+
+    # a REQUIRED attn_fn hook (no default) is still bound, not skipped
+    def required_hook_loss(params, tokens, targets, *, attn_fn):
+        return gpt.loss_fn(
+            params, tokens, targets, cfg=CFG, attn_fn=attn_fn
+        )
+
+    bound2 = _maybe_bind_seq_attention(required_hook_loss, mesh, s)
+    assert isinstance(bound2, functools.partial)
+    assert "attn_fn" in bound2.keywords
 
 
 def test_search_raises_when_nothing_fits():

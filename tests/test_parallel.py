@@ -167,6 +167,154 @@ class TestRingFlashAttention:
             )
 
 
+class TestA2AAttention:
+    """Ulysses-style all-to-all sequence parallelism vs plain
+    attention (the second context-parallel family next to the ring;
+    ref atorch distributed_attention.py:80)."""
+
+    def _qkv(self, b=2, t=64, h=4, d=16, seed=4):
+        kq, kk, kv = jax.random.split(jax.random.PRNGKey(seed), 3)
+        shape = (b, t, h, d)
+        return (
+            jax.random.normal(kq, shape, jnp.float32),
+            jax.random.normal(kk, shape, jnp.float32),
+            jax.random.normal(kv, shape, jnp.float32),
+        )
+
+    @pytest.mark.parametrize("causal", [False, True])
+    def test_forward_matches_plain(self, causal):
+        from dlrover_tpu.parallel.ulysses import make_a2a_attention
+
+        mesh = build_mesh(MeshConfig(seq=4, data=2))
+        q, k, v = self._qkv()  # 4 heads % 4 seq shards == 0
+        a2a = make_a2a_attention(mesh, causal=causal)
+        got = jax.jit(a2a)(q, k, v)
+        want = gpt._default_attention(q, k, v, causal=causal)
+        np.testing.assert_allclose(
+            np.asarray(got), np.asarray(want), atol=2e-5, rtol=2e-5
+        )
+
+    @pytest.mark.parametrize("causal", [False, True])
+    def test_forward_with_tensor_cosharding(self, causal):
+        """heads shard over tensor FIRST; the a2a then swaps each
+        tensor shard's head group (4 heads / tensor 2 = 2, % seq 2
+        == 0)."""
+        from dlrover_tpu.parallel.ulysses import make_a2a_attention
+
+        mesh = build_mesh(MeshConfig(data=2, seq=2, tensor=2))
+        q, k, v = self._qkv()
+        a2a = make_a2a_attention(mesh, causal=causal)
+        got = jax.jit(a2a)(q, k, v)
+        want = gpt._default_attention(q, k, v, causal=causal)
+        np.testing.assert_allclose(
+            np.asarray(got), np.asarray(want), atol=2e-5, rtol=2e-5
+        )
+
+    def test_flash_kernel_inner(self):
+        from dlrover_tpu.parallel.ulysses import make_a2a_attention
+
+        mesh = build_mesh(MeshConfig(seq=4, data=2))
+        q, k, v = self._qkv()
+        a2a = make_a2a_attention(mesh, causal=True, impl="flash")
+        got = jax.jit(a2a)(q, k, v)
+        want = gpt._default_attention(q, k, v, causal=True)
+        np.testing.assert_allclose(
+            np.asarray(got), np.asarray(want), atol=2e-5, rtol=2e-5
+        )
+
+    def test_gradients_match_plain(self):
+        from dlrover_tpu.parallel.ulysses import make_a2a_attention
+
+        mesh = build_mesh(MeshConfig(seq=4, data=2))
+        q, k, v = self._qkv(t=32, d=8)
+        a2a = make_a2a_attention(mesh, causal=True)
+
+        def loss_a2a(q, k, v):
+            return jnp.sum(jnp.square(a2a(q, k, v)))
+
+        def loss_plain(q, k, v):
+            return jnp.sum(
+                jnp.square(gpt._default_attention(q, k, v, causal=True))
+            )
+
+        g1 = jax.jit(jax.grad(loss_a2a, argnums=(0, 1, 2)))(q, k, v)
+        g2 = jax.grad(loss_plain, argnums=(0, 1, 2))(q, k, v)
+        for a, b_ in zip(g1, g2):
+            np.testing.assert_allclose(
+                np.asarray(a), np.asarray(b_), atol=5e-5, rtol=5e-4
+            )
+
+    def test_head_divisibility_guard(self):
+        from dlrover_tpu.parallel.ulysses import make_a2a_attention
+
+        mesh = build_mesh(MeshConfig(seq=8))
+        q, k, v = self._qkv(h=4)  # 4 heads, 8 seq shards
+        a2a = make_a2a_attention(mesh, causal=True)
+        with pytest.raises(ValueError, match="divisible"):
+            jax.jit(a2a)(q, k, v)
+
+    def test_single_shard_fallback(self):
+        from dlrover_tpu.parallel.ulysses import make_a2a_attention
+
+        mesh = build_mesh(MeshConfig(data=8))  # seq axis = 1
+        q, k, v = self._qkv(b=1, t=16, h=2, d=8)
+        a2a = make_a2a_attention(mesh, causal=True)
+        want = gpt._default_attention(q, k, v, causal=True)
+        np.testing.assert_allclose(
+            np.asarray(a2a(q, k, v)), np.asarray(want),
+            atol=2e-5, rtol=2e-5,
+        )
+
+
+class TestSeqImplDispatch:
+    """make_seq_attention: the strategy-facing knob over ring vs a2a."""
+
+    def test_choose_rules(self):
+        from dlrover_tpu.parallel.seq_attention import choose_seq_impl
+
+        assert choose_seq_impl(4, 1) == "ring"  # degenerate
+        assert choose_seq_impl(8, 4) == "a2a"
+        assert choose_seq_impl(6, 4) == "ring"  # 6 % 4 != 0
+        # tensor co-sharding: 8/2=4 heads per tensor shard
+        assert choose_seq_impl(8, 2, tensor_shards=2) == "a2a"
+        assert choose_seq_impl(4, 4, tensor_shards=2) == "ring"
+        assert choose_seq_impl(8, 3, tensor_shards=3) == "ring"
+
+    @pytest.mark.parametrize("n_head", [2, 4])
+    def test_auto_matches_plain_both_branches(self, n_head):
+        """h=4 on seq=4 routes to a2a, h=2 to ring — both must be
+        numerically plain attention."""
+        from dlrover_tpu.parallel.seq_attention import make_seq_attention
+
+        mesh = build_mesh(MeshConfig(seq=4, data=2))
+        kq, kk, kv = jax.random.split(jax.random.PRNGKey(7), 3)
+        shape = (2, 32, n_head, 8)
+        q = jax.random.normal(kq, shape, jnp.float32)
+        k = jax.random.normal(kk, shape, jnp.float32)
+        v = jax.random.normal(kv, shape, jnp.float32)
+        attn = make_seq_attention(mesh, causal=True)
+        want = gpt._default_attention(q, k, v, causal=True)
+        np.testing.assert_allclose(
+            np.asarray(jax.jit(attn)(q, k, v)), np.asarray(want),
+            atol=2e-5, rtol=2e-5,
+        )
+
+    def test_explicit_impls_and_validation(self):
+        from dlrover_tpu.parallel.seq_attention import make_seq_attention
+
+        mesh = build_mesh(MeshConfig(seq=2, data=4))
+        q = jax.random.normal(jax.random.PRNGKey(8), (4, 16, 2, 8))
+        for forced in ("ring", "a2a"):
+            attn = make_seq_attention(mesh, causal=True, seq_impl=forced)
+            want = gpt._default_attention(q, q, q, causal=True)
+            np.testing.assert_allclose(
+                np.asarray(jax.jit(attn)(q, q, q)), np.asarray(want),
+                atol=2e-5, rtol=2e-5,
+            )
+        with pytest.raises(ValueError, match="seq_impl"):
+            make_seq_attention(mesh, seq_impl="bogus")
+
+
 def _tiny_cfg(**kw):
     base = dict(
         vocab_size=256,
