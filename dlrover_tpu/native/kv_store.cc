@@ -1050,4 +1050,234 @@ void kv_sparse_apply_momentum(void* param_h, void* mom_h, const int64_t* keys,
   });
 }
 
+// AdaDQH (Ant's adaptive quasi-Hessian family; published as AGD,
+// NeurIPS'23 — dense twin optim/agd.py): the difference of successive
+// bias-corrected momenta approximates the Hessian diagonal, and the
+// denominator max(sqrt(v_hat), eps) auto-switches each coordinate
+// between the adaptive and SGD-with-momentum regimes. Restated from
+// the published update rule (ref registrations:
+// tfplus/kv_variable/ops/training_ops.cc ApplyAdaDQH /
+// KvVariableSparseApplyAdaDQH):
+//   m_t   = b1 m + (1-b1) g
+//   u_t   = m_t/(1-b1^t) - m_{t-1}/(1-b1^{t-1})      (u_1 = m_1/bc1)
+//   v_t   = b2 v + (1-b2) u_t^2
+//   p    -= lr * (m_t/(1-b1^t)) / max(sqrt(v_t/(1-b2^t)), eps)
+void kv_sparse_apply_adadqh(void* param_h, void* m_h, void* v_h,
+                            const int64_t* keys, const float* grads,
+                            int64_t n, float lr, float beta1, float beta2,
+                            float eps, int64_t step) {
+  auto* param = static_cast<KvStore*>(param_h);
+  auto* mstore = static_cast<KvStore*>(m_h);
+  auto* vstore = static_cast<KvStore*>(v_h);
+  int dim = param->dim();
+  float t = static_cast<float>(step);
+  float bc1 = 1.0f - std::pow(beta1, t);
+  float bc2 = 1.0f - std::pow(beta2, t);
+  float bc1_old = step > 1 ? 1.0f - std::pow(beta1, t - 1.0f) : 1.0f;
+  param->for_each_key(keys, n, step, [&](int64_t i, float* p) {
+    const float* g = grads + i * dim;
+    int64_t key = keys[i];
+    mstore->for_each_key(&key, 1, step, [&](int64_t, float* m) {
+      vstore->for_each_key(&key, 1, step, [&](int64_t, float* v) {
+        for (int d = 0; d < dim; ++d) {
+          float m_old_hat = m[d] / bc1_old;
+          m[d] = beta1 * m[d] + (1.0f - beta1) * g[d];
+          float u = m[d] / bc1 - m_old_hat;
+          v[d] = beta2 * v[d] + (1.0f - beta2) * u * u;
+          p[d] -= lr * (m[d] / bc1) /
+                  std::fmax(std::sqrt(v[d] / bc2), eps);
+        }
+      });
+    });
+  });
+}
+
+// Group AdaDQH with group lasso (ref KvVariableGroupSparseApplyAdaDQHV2):
+// the AdaDQH moments feed an FTRL-proximal linear accumulator whose
+// per-step "sigma" is the growth of the eps-floored RMS denominator;
+// l1/l2/l21 arrive in loss units and are scaled by lr (the V2
+// convention), and rows whose L21-shrunk linear norm falls below
+// l21*lr*sqrt(dim) collapse to exact zeros (our storewise equivalent
+// of the reference's key blacklist).
+void kv_sparse_apply_group_adadqh(void* param_h, void* linear_h, void* m_h,
+                                  void* v_h, const int64_t* keys,
+                                  const float* grads, int64_t n, float lr,
+                                  float beta1, float beta2, float eps,
+                                  float l1, float l2, float l21,
+                                  int64_t step) {
+  auto* param = static_cast<KvStore*>(param_h);
+  auto* linear = static_cast<KvStore*>(linear_h);
+  auto* mstore = static_cast<KvStore*>(m_h);
+  auto* vstore = static_cast<KvStore*>(v_h);
+  int dim = param->dim();
+  float t = static_cast<float>(step);
+  float b1p = std::pow(beta1, t);
+  float b2p = std::pow(beta2, t);
+  float bc1 = 1.0f - b1p;
+  float bc1_old = step > 1 ? 1.0f - std::pow(beta1, t - 1.0f) : 1.0f;
+  float l1s = l1 * lr, l2s = l2 * lr, l21s = l21 * lr;
+  float alpha = lr * std::sqrt(1.0f - b2p) / bc1;
+  float eps_adj = eps * std::sqrt(1.0f - b2p);
+  // the PREVIOUS step's eps floor — sigma must measure denominator
+  // growth between consecutive steps, not against a moving floor
+  // (b2p/beta2 = beta2^(t-1); at t=1 this is 1, floor 0)
+  float last_eps_adj = eps * std::sqrt(1.0f - b2p / beta2);
+  float l21_norm = l21s * std::sqrt(static_cast<float>(dim));
+  param->for_each_key(keys, n, step, [&](int64_t i, float* p) {
+    const float* g = grads + i * dim;
+    int64_t key = keys[i];
+    linear->for_each_key(&key, 1, step, [&](int64_t, float* l) {
+      mstore->for_each_key(&key, 1, step, [&](int64_t, float* m) {
+        vstore->for_each_key(&key, 1, step, [&](int64_t, float* v) {
+          float norm_sq = 0.0f;
+          for (int d = 0; d < dim; ++d) {
+            float m_old_hat = m[d] / bc1_old;
+            float v_prev = v[d];
+            m[d] = beta1 * m[d] + (1.0f - beta1) * g[d];
+            float u = m[d] / bc1 - m_old_hat;
+            v[d] = beta2 * v_prev + (1.0f - beta2) * u * u;
+            float denom_new = std::fmax(std::sqrt(v[d]), eps_adj);
+            float denom_old =
+                std::fmax(std::sqrt(v_prev), last_eps_adj);
+            l[d] += m[d] * alpha - (denom_new - denom_old) * p[d];
+            float adj = std::fmin(std::fmax(l[d], -l1s), l1s);
+            float l1l = adj - l[d];
+            norm_sq += l1l * l1l;
+          }
+          float norm = std::sqrt(norm_sq);
+          if (norm > l21_norm) {
+            float scale = 1.0f - l21_norm / norm;
+            for (int d = 0; d < dim; ++d) {
+              float adj = std::fmin(std::fmax(l[d], -l1s), l1s);
+              float l1l = adj - l[d];
+              float y =
+                  std::fmax(std::sqrt(v[d]), eps_adj) + 2.0f * l2s;
+              p[d] = l1l * scale / y;
+            }
+          } else {
+            std::memset(p, 0, sizeof(float) * dim);
+          }
+        });
+      });
+    });
+  });
+}
+
+// LambHessian (ref ApplyLambHessian / KvVariableGroupSparseApplyLambHessian):
+// LAMB's trust-ratio update with the second moment driven by a
+// trainer-supplied Hutchinson Hessian-diagonal estimate instead of
+// g^2 — layerwise normalization becomes per-ROW here, the natural
+// unit for an embedding table.
+void kv_sparse_apply_lamb_hessian(void* param_h, void* m_h, void* v_h,
+                                  const int64_t* keys, const float* grads,
+                                  const float* hessian, int64_t n, float lr,
+                                  float beta1, float beta2, float eps,
+                                  int64_t step) {
+  auto* param = static_cast<KvStore*>(param_h);
+  auto* mstore = static_cast<KvStore*>(m_h);
+  auto* vstore = static_cast<KvStore*>(v_h);
+  int dim = param->dim();
+  float t = static_cast<float>(step);
+  float adjust = std::sqrt(1.0f - std::pow(beta2, t)) /
+                 (1.0f - std::pow(beta1, t));
+  std::vector<float> u(dim);
+  param->for_each_key(keys, n, step, [&](int64_t i, float* p) {
+    const float* g = grads + i * dim;
+    const float* hz = hessian + i * dim;
+    int64_t key = keys[i];
+    mstore->for_each_key(&key, 1, step, [&](int64_t, float* m) {
+      vstore->for_each_key(&key, 1, step, [&](int64_t, float* v) {
+        float p_norm_sq = 0.0f, u_norm_sq = 0.0f;
+        for (int d = 0; d < dim; ++d) {
+          m[d] = beta1 * m[d] + (1.0f - beta1) * g[d];
+          v[d] = beta2 * v[d] + (1.0f - beta2) * hz[d] * hz[d];
+          u[d] = (m[d] * adjust) / (std::sqrt(v[d]) + eps);
+          p_norm_sq += p[d] * p[d];
+          u_norm_sq += u[d] * u[d];
+        }
+        float p_norm = std::sqrt(p_norm_sq);
+        float u_norm = std::sqrt(u_norm_sq);
+        float ratio = (p_norm > 0.0f && u_norm > 0.0f)
+                          ? p_norm / (u_norm + 1e-8f)
+                          : 1.0f;
+        for (int d = 0; d < dim; ++d) p[d] -= lr * ratio * u[d];
+      });
+    });
+  });
+}
+
+// Group LambHessian: the trust-ratio-scaled curvature step feeds the
+// same FTRL-proximal linear/group-lasso machinery as group_adam —
+// sigma is the growth of the bias-corrected curvature RMS, and the
+// y denominator carries 1/lr (this family's convention, unlike the
+// V2 lr-scaled-regularizer convention above).
+void kv_sparse_apply_group_lamb_hessian(
+    void* param_h, void* accum_h, void* linear_h, void* m_h, void* v_h,
+    const int64_t* keys, const float* grads, const float* hessian,
+    int64_t n, float lr, float beta1, float beta2, float eps, float l1,
+    float l2, float l21, int64_t step) {
+  auto* param = static_cast<KvStore*>(param_h);
+  auto* accum = static_cast<KvStore*>(accum_h);
+  auto* linear = static_cast<KvStore*>(linear_h);
+  auto* mstore = static_cast<KvStore*>(m_h);
+  auto* vstore = static_cast<KvStore*>(v_h);
+  int dim = param->dim();
+  float t = static_cast<float>(step);
+  float bc1 = 1.0f - std::pow(beta1, t);
+  float bc2 = 1.0f - std::pow(beta2, t);
+  float l21_norm = l21 * std::sqrt(static_cast<float>(dim));
+  std::vector<float> r(dim);
+  std::vector<float> new_accum(dim);
+  param->for_each_key(keys, n, step, [&](int64_t i, float* p) {
+    const float* g = grads + i * dim;
+    const float* hz = hessian + i * dim;
+    int64_t key = keys[i];
+    accum->for_each_key(&key, 1, step, [&](int64_t, float* a) {
+      linear->for_each_key(&key, 1, step, [&](int64_t, float* l) {
+        mstore->for_each_key(&key, 1, step, [&](int64_t, float* m) {
+          vstore->for_each_key(&key, 1, step, [&](int64_t, float* v) {
+            float p_norm_sq = 0.0f, r_norm_sq = 0.0f;
+            for (int d = 0; d < dim; ++d) {
+              m[d] = beta1 * m[d] + (1.0f - beta1) * g[d];
+              v[d] = beta2 * v[d] + (1.0f - beta2) * hz[d] * hz[d];
+              new_accum[d] = v[d] / bc2;
+              r[d] = (m[d] / bc1) /
+                     (std::sqrt(new_accum[d]) + eps);
+              p_norm_sq += p[d] * p[d];
+              r_norm_sq += r[d] * r[d];
+            }
+            float p_norm = std::sqrt(p_norm_sq);
+            float r_norm = std::sqrt(r_norm_sq);
+            float ratio = (p_norm > 0.0f && r_norm > 0.0f)
+                              ? p_norm / (r_norm + 1e-8f)
+                              : 1.0f;
+            float norm_sq = 0.0f;
+            for (int d = 0; d < dim; ++d) {
+              l[d] += (m[d] / bc1) * ratio -
+                      (std::sqrt(new_accum[d]) - std::sqrt(a[d])) /
+                          lr * p[d];
+              a[d] = new_accum[d];
+              float adj = std::fmin(std::fmax(l[d], -l1), l1);
+              float l1l = adj - l[d];
+              norm_sq += l1l * l1l;
+            }
+            float norm = std::sqrt(norm_sq);
+            if (norm > l21_norm) {
+              float scale = 1.0f - l21_norm / norm;
+              for (int d = 0; d < dim; ++d) {
+                float adj = std::fmin(std::fmax(l[d], -l1), l1);
+                float l1l = adj - l[d];
+                float y = (std::sqrt(a[d]) + eps) / lr + 2.0f * l2;
+                p[d] = l1l * scale / y;
+              }
+            } else {
+              std::memset(p, 0, sizeof(float) * dim);
+            }
+          });
+        });
+      });
+    });
+  });
+}
+
 }  // extern "C"

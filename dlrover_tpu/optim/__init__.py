@@ -8,6 +8,10 @@ mutation, no process groups; gradient averaging is whatever psum the
 surrounding pjit inserts.
 """
 
+from dlrover_tpu.optim.adadqh import (  # noqa: F401
+    adadqh,
+    adadqh_hypergradients,
+)
 from dlrover_tpu.optim.agd import agd, scale_by_agd  # noqa: F401
 from dlrover_tpu.optim.low_bit import adam_4bit, adam_8bit  # noqa: F401
 from dlrover_tpu.optim.wsam import WeightedSAM  # noqa: F401

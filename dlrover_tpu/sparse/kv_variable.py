@@ -200,6 +200,32 @@ def _lib() -> ctypes.CDLL:
                 ctypes.c_float, ctypes.c_float, ctypes.c_float,
                 ctypes.c_int64,
             ]
+            lib.kv_sparse_apply_adadqh.argtypes = [
+                ctypes.c_void_p, ctypes.c_void_p, ctypes.c_void_p,
+                i64p, f32p, ctypes.c_int64, ctypes.c_float,
+                ctypes.c_float, ctypes.c_float, ctypes.c_float,
+                ctypes.c_int64,
+            ]
+            lib.kv_sparse_apply_group_adadqh.argtypes = [
+                ctypes.c_void_p, ctypes.c_void_p, ctypes.c_void_p,
+                ctypes.c_void_p, i64p, f32p, ctypes.c_int64,
+                ctypes.c_float, ctypes.c_float, ctypes.c_float,
+                ctypes.c_float, ctypes.c_float, ctypes.c_float,
+                ctypes.c_float, ctypes.c_int64,
+            ]
+            lib.kv_sparse_apply_lamb_hessian.argtypes = [
+                ctypes.c_void_p, ctypes.c_void_p, ctypes.c_void_p,
+                i64p, f32p, f32p, ctypes.c_int64, ctypes.c_float,
+                ctypes.c_float, ctypes.c_float, ctypes.c_float,
+                ctypes.c_int64,
+            ]
+            lib.kv_sparse_apply_group_lamb_hessian.argtypes = [
+                ctypes.c_void_p, ctypes.c_void_p, ctypes.c_void_p,
+                ctypes.c_void_p, ctypes.c_void_p, i64p, f32p, f32p,
+                ctypes.c_int64, ctypes.c_float, ctypes.c_float,
+                ctypes.c_float, ctypes.c_float, ctypes.c_float,
+                ctypes.c_float, ctypes.c_float, ctypes.c_int64,
+            ]
             _LIB = lib
     return _LIB
 
@@ -534,6 +560,72 @@ class KvVariable:
                 lr, kw.get("beta1", 0.9), kw.get("beta2", 0.999),
                 kw.get("eps", 1e-8), max(step, 1),
             )
+        elif optimizer == "adadqh":
+            # Ant's quasi-Hessian family (published as AGD; dense twin
+            # optim/agd.py, ref tfplus ApplyAdaDQH registrations).
+            lib.kv_sparse_apply_adadqh(
+                h,
+                self._slot("m").handle,
+                self._slot("v").handle,
+                ukeys, ugrads, ukeys.size,
+                lr, kw.get("beta1", 0.9), kw.get("beta2", 0.999),
+                kw.get("eps", 1e-5), max(step, 1),
+            )
+        elif optimizer == "group_adadqh":
+            # AdaDQH + group lasso (ref
+            # KvVariableGroupSparseApplyAdaDQHV2): l1/l2/l21 in loss
+            # units, scaled by lr inside the kernel (V2 convention).
+            lib.kv_sparse_apply_group_adadqh(
+                h,
+                self._slot("linear_dqh").handle,
+                self._slot("m").handle,
+                self._slot("v").handle,
+                ukeys, ugrads, ukeys.size,
+                lr, kw.get("beta1", 0.9), kw.get("beta2", 0.999),
+                kw.get("eps", 1e-5), kw.get("l1", 0.0),
+                kw.get("l2", 0.0), kw.get("l21", 0.0), max(step, 1),
+            )
+        elif optimizer in ("lamb_hessian", "group_lamb_hessian"):
+            # LAMB trust ratio with a curvature-driven second moment:
+            # needs the same trainer-supplied Hutchinson rows as
+            # adahessian.
+            hessian = kw.get("hessian")
+            if hessian is None:
+                raise ValueError(
+                    f"{optimizer} requires hessian= rows aligned "
+                    "with keys (Hutchinson diagonal estimates)"
+                )
+            hessian = np.ascontiguousarray(
+                hessian, np.float32
+            ).reshape(keys.size, self.embedding_dim)
+            uhess = np.zeros(
+                (ukeys.size, self.embedding_dim), np.float32
+            )
+            np.add.at(uhess, inv, hessian)
+            if optimizer == "lamb_hessian":
+                lib.kv_sparse_apply_lamb_hessian(
+                    h,
+                    self._slot("m").handle,
+                    self._slot("v").handle,
+                    ukeys, ugrads, uhess, ukeys.size,
+                    lr, kw.get("beta1", 0.9),
+                    kw.get("beta2", 0.999),
+                    kw.get("eps", 1e-6), max(step, 1),
+                )
+            else:
+                lib.kv_sparse_apply_group_lamb_hessian(
+                    h,
+                    self._slot("accum_lh").handle,
+                    self._slot("linear_lh").handle,
+                    self._slot("m").handle,
+                    self._slot("v").handle,
+                    ukeys, ugrads, uhess, ukeys.size,
+                    lr, kw.get("beta1", 0.9),
+                    kw.get("beta2", 0.999),
+                    kw.get("eps", 1e-6), kw.get("l1", 0.0),
+                    kw.get("l2", 0.0), kw.get("l21", 0.0),
+                    max(step, 1),
+                )
         else:
             raise ValueError(f"unknown sparse optimizer {optimizer!r}")
 

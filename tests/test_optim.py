@@ -282,3 +282,92 @@ def test_wsam_adaptive_perturbation_radius():
     norm = float(jnp.linalg.norm(e_w))
     max_p = float(jnp.max(jnp.abs(big["w"])))
     assert 0.0 < norm <= rho * max_p * 1.01
+
+
+def test_dense_adadqh_is_the_agd_core():
+    """optim.adadqh is the AGD rule with delta named eps (AdaDQH is
+    the family's tfplus-era name — optim/adadqh.py module doc)."""
+    import jax
+    import jax.numpy as jnp
+    import optax
+
+    from dlrover_tpu.optim import adadqh, agd
+
+    params = {"w": jnp.array([0.5, -0.3, 1.2])}
+    grads = {"w": jnp.array([0.1, -0.2, 0.05])}
+    o1, o2 = adadqh(1e-2, eps=1e-5), agd(1e-2, delta=1e-5)
+    s1, s2 = o1.init(params), o2.init(params)
+    p1, p2 = params, params
+    for _ in range(4):
+        u1, s1 = o1.update(grads, s1, p1)
+        u2, s2 = o2.update(grads, s2, p2)
+        p1 = optax.apply_updates(p1, u1)
+        p2 = optax.apply_updates(p2, u2)
+    np.testing.assert_allclose(
+        np.asarray(p1["w"]), np.asarray(p2["w"]), atol=1e-7
+    )
+
+
+def test_adadqh_hypergradients_branch_gating():
+    """eps_hg is nonzero exactly where the eps floor is the active
+    max() branch; lr_hg is the negated normalized momentum direction
+    (ComputeAdaDQHHG behavior, previous-step bias corrections)."""
+    import jax.numpy as jnp
+
+    from dlrover_tpu.optim import adadqh_hypergradients
+
+    b1, b2, lr, eps, step = 0.9, 0.999, 1e-2, 1e-2, 3
+    m = jnp.array([0.5, 0.5])
+    # first coord: huge curvature (adaptive branch); second: tiny
+    # curvature (eps-floored branch)
+    v = jnp.array([4.0, 1e-12])
+    lr_hg, eps_hg = adadqh_hypergradients(
+        m, v, lr, eps, b1, b2, step
+    )
+    t_prev = step - 1
+    bc1, bc2 = 1 - b1**t_prev, 1 - b2**t_prev
+    adjust = np.sqrt(bc2) / bc1
+    eps_adj = eps * np.sqrt(bc2)
+    assert float(eps_hg[0]) == 0.0  # adaptive branch: no eps effect
+    assert float(eps_hg[1]) != 0.0
+    np.testing.assert_allclose(
+        float(lr_hg[0]), -adjust * 0.5 / 2.0, rtol=1e-6
+    )
+    np.testing.assert_allclose(
+        float(lr_hg[1]), -adjust * 0.5 / eps_adj, rtol=1e-6
+    )
+
+    # both hypergradients must match central finite differences of
+    # the documented update -lr * m_hat / max(sqrt(v_hat), eps)
+    def update(lr_, eps_, vi):
+        den = max(
+            np.sqrt(float(vi)), eps_ * np.sqrt(bc2)
+        )
+        return -lr_ * adjust * 0.5 / den * 1.0  # m=0.5
+
+    h = 1e-6
+    for i, vi in enumerate([4.0, 1e-12]):
+        np.testing.assert_allclose(
+            float(lr_hg[i]),
+            (update(lr + h, eps, vi) - update(lr - h, eps, vi))
+            / (2 * h),
+            rtol=1e-4,
+        )
+        np.testing.assert_allclose(
+            float(eps_hg[i]),
+            (update(lr, eps + h, vi) - update(lr, eps - h, vi))
+            / (2 * h),
+            rtol=1e-4, atol=1e-10,
+        )
+    # SAM term shifts lr_hg only
+    delta = jnp.array([1.0, 1.0])
+    lr_hg2, eps_hg2 = adadqh_hypergradients(
+        m, v, lr, eps, b1, b2, step, sam_delta=delta, alpha=0.7,
+    )
+    np.testing.assert_allclose(
+        np.asarray(lr_hg2), np.asarray(lr_hg - 0.3 * delta),
+        rtol=1e-6,
+    )
+    np.testing.assert_allclose(
+        np.asarray(eps_hg2), np.asarray(eps_hg), rtol=1e-6
+    )

@@ -730,3 +730,283 @@ def test_sparse_rmsprop_matches_torch():
         kv.gather(keys, train=False), p.detach().numpy(),
         atol=1e-5, rtol=1e-4,
     )
+
+
+# ---- AdaDQH family (Ant's quasi-Hessian optimizer, ref tfplus
+# ApplyAdaDQH / KvVariableGroupSparseApplyAdaDQHV2) ------------------
+
+
+def test_sparse_adadqh_matches_dense_agd():
+    """Fused C++ AdaDQH == the dense optax AGD core (optim/adadqh.py
+    documents the naming: AdaDQH is the family's tfplus-era name)."""
+    import jax.numpy as jnp
+    import optax
+
+    from dlrover_tpu.optim import adadqh as dense_adadqh
+
+    dim = 8
+    kv = KvVariable("emb", embedding_dim=dim, seed=21)
+    keys = np.array([4, 13], np.int64)
+    init_vals = kv.gather(keys).copy()
+    grads = np.random.default_rng(7).normal(size=(2, dim)).astype(
+        np.float32
+    )
+
+    opt = dense_adadqh(1e-2, b1=0.9, b2=0.999, eps=1e-5)
+    dense = {str(i): jnp.asarray(init_vals[i]) for i in range(2)}
+    state = opt.init(dense)
+    for step in range(1, 5):
+        kv.apply_gradients(
+            "adadqh", keys, grads, step=step, lr=1e-2,
+            beta1=0.9, beta2=0.999, eps=1e-5,
+        )
+        gtree = {str(i): jnp.asarray(grads[i]) for i in range(2)}
+        updates, state = opt.update(gtree, state, dense)
+        dense = optax.apply_updates(dense, updates)
+    got = kv.gather(keys, train=False)
+    want = np.stack([np.asarray(dense[str(i)]) for i in range(2)])
+    np.testing.assert_allclose(got, want, atol=1e-5, rtol=1e-4)
+
+
+def test_sparse_adadqh_eps_floor_is_sgd_regime():
+    """With eps far above any curvature estimate, every coordinate
+    takes momentum-SGD steps -lr*m_hat/eps — the auto-switch's SGD
+    branch, verified against the closed form."""
+    dim = 4
+    lr, b1, eps = 1e-2, 0.9, 1e6
+    kv = KvVariable("emb", embedding_dim=dim, seed=22)
+    keys = np.array([5], np.int64)
+    p = kv.gather(keys).copy().astype(np.float64)
+    grads = np.random.default_rng(8).normal(size=(1, dim)).astype(
+        np.float32
+    )
+    m = np.zeros(dim, np.float64)
+    for step in range(1, 4):
+        kv.apply_gradients(
+            "adadqh", keys, grads, step=step, lr=lr, beta1=b1,
+            eps=eps,
+        )
+        m = b1 * m + (1 - b1) * grads[0]
+        p[0] -= lr * (m / (1 - b1**step)) / eps
+    np.testing.assert_allclose(
+        kv.gather(keys, train=False), p.astype(np.float32),
+        atol=1e-6, rtol=1e-5,
+    )
+
+
+def _group_adadqh_numpy(p0, grads, steps, lr, b1, b2, eps, l1, l2,
+                        l21):
+    """Independent restatement of the published group AdaDQH-V2 rule."""
+    n, dim = p0.shape
+    p = p0.astype(np.float64).copy()
+    m = np.zeros_like(p)
+    v = np.zeros_like(p)
+    lin = np.zeros_like(p)
+    l1s, l2s, l21s = l1 * lr, l2 * lr, l21 * lr
+    l21_norm = l21s * np.sqrt(dim)
+    for t in range(1, steps + 1):
+        bc1 = 1 - b1**t
+        bc1_old = 1.0 if t == 1 else 1 - b1 ** (t - 1)
+        b2p = b2**t
+        alpha = lr * np.sqrt(1 - b2p) / bc1
+        eps_adj = eps * np.sqrt(1 - b2p)
+        last_eps_adj = eps * np.sqrt(1 - b2p / b2)
+        m_old_hat = m / bc1_old
+        v_prev = v.copy()
+        m = b1 * m + (1 - b1) * grads
+        u = m / bc1 - m_old_hat
+        v = b2 * v_prev + (1 - b2) * u * u
+        denom_new = np.maximum(np.sqrt(v), eps_adj)
+        denom_old = np.maximum(np.sqrt(v_prev), last_eps_adj)
+        lin += m * alpha - (denom_new - denom_old) * p
+        adj = np.clip(lin, -l1s, l1s)
+        l1l = adj - lin
+        norm = np.sqrt((l1l**2).sum(axis=1, keepdims=True))
+        scale = np.where(
+            norm > l21_norm, 1 - l21_norm / np.maximum(norm, 1e-30),
+            0.0,
+        )
+        y = np.maximum(np.sqrt(v), eps_adj) + 2 * l2s
+        p = np.where(norm > l21_norm, l1l * scale / y, 0.0)
+    return p.astype(np.float32)
+
+
+def test_group_adadqh_matches_reference_formula():
+    dim = 8
+    kv = KvVariable("emb", embedding_dim=dim, seed=23)
+    keys = np.array([1, 2], np.int64)
+    init_vals = kv.gather(keys).copy()
+    grads = np.random.default_rng(9).normal(size=(2, dim)).astype(
+        np.float32
+    )
+    kw = dict(lr=0.05, b1=0.9, b2=0.999, eps=1e-5, l1=0.001,
+              l2=0.01, l21=0.001)
+    for step in range(1, 4):
+        kv.apply_gradients(
+            "group_adadqh", keys, grads, step=step, lr=kw["lr"],
+            beta1=kw["b1"], beta2=kw["b2"], eps=kw["eps"],
+            l1=kw["l1"], l2=kw["l2"], l21=kw["l21"],
+        )
+    want = _group_adadqh_numpy(
+        init_vals, grads, 3, kw["lr"], kw["b1"], kw["b2"],
+        kw["eps"], kw["l1"], kw["l2"], kw["l21"],
+    )
+    np.testing.assert_allclose(
+        kv.gather(keys, train=False), want, atol=1e-5, rtol=1e-4,
+    )
+
+
+def test_group_adadqh_l21_sparsifies():
+    dim = 8
+    kv = KvVariable("emb", embedding_dim=dim, seed=24)
+    keys = np.array([10, 20], np.int64)
+    grads = np.stack([
+        np.ones(dim, np.float32),
+        np.full(dim, 1e-4, np.float32),
+    ])
+    for step in range(1, 20):
+        kv.apply_gradients(
+            "group_adadqh", keys, grads, step=step, lr=0.1, l21=0.05,
+        )
+    vals = kv.gather(keys, train=False)
+    assert np.abs(vals[0]).max() > 0
+    np.testing.assert_array_equal(vals[1], np.zeros(dim))
+
+
+# ---- LambHessian (ref ApplyLambHessian /
+# KvVariableGroupSparseApplyLambHessian) -----------------------------
+
+
+def _lamb_hessian_numpy(p0, grads, hess, steps, lr, b1, b2, eps):
+    p = p0.astype(np.float64).copy()
+    m = np.zeros_like(p)
+    v = np.zeros_like(p)
+    for t in range(1, steps + 1):
+        adjust = np.sqrt(1 - b2**t) / (1 - b1**t)
+        m = b1 * m + (1 - b1) * grads
+        v = b2 * v + (1 - b2) * hess * hess
+        u = m * adjust / (np.sqrt(v) + eps)
+        p_norm = np.sqrt((p**2).sum(axis=1, keepdims=True))
+        u_norm = np.sqrt((u**2).sum(axis=1, keepdims=True))
+        ratio = np.where(
+            (p_norm > 0) & (u_norm > 0), p_norm / (u_norm + 1e-8),
+            1.0,
+        )
+        p -= lr * ratio * u
+    return p.astype(np.float32)
+
+
+def test_sparse_lamb_hessian_matches_reference_formula():
+    dim = 8
+    kv = KvVariable("emb", embedding_dim=dim, seed=25)
+    keys = np.array([6, 15], np.int64)
+    init_vals = kv.gather(keys).copy()
+    rng = np.random.default_rng(10)
+    grads = rng.normal(size=(2, dim)).astype(np.float32)
+    hess = np.abs(rng.normal(size=(2, dim))).astype(np.float32)
+    for step in range(1, 4):
+        kv.apply_gradients(
+            "lamb_hessian", keys, grads, step=step, lr=1e-2,
+            hessian=hess, eps=1e-6,
+        )
+    want = _lamb_hessian_numpy(
+        init_vals, grads, hess, 3, 1e-2, 0.9, 0.999, 1e-6
+    )
+    np.testing.assert_allclose(
+        kv.gather(keys, train=False), want, atol=1e-5, rtol=1e-4,
+    )
+
+
+def test_lamb_hessian_requires_hessian():
+    kv = KvVariable("emb", embedding_dim=4)
+    keys = np.array([1], np.int64)
+    grads = np.zeros((1, 4), np.float32)
+    with pytest.raises(ValueError, match="hessian"):
+        kv.apply_gradients("lamb_hessian", keys, grads, step=1)
+    with pytest.raises(ValueError, match="hessian"):
+        kv.apply_gradients(
+            "group_lamb_hessian", keys, grads, step=1
+        )
+
+
+def test_group_lamb_hessian_l21_sparsifies():
+    dim = 8
+    kv = KvVariable("emb", embedding_dim=dim, seed=26)
+    keys = np.array([10, 20], np.int64)
+    grads = np.stack([
+        np.ones(dim, np.float32),
+        np.full(dim, 1e-4, np.float32),
+    ])
+    hess = np.abs(grads)
+    for step in range(1, 20):
+        kv.apply_gradients(
+            "group_lamb_hessian", keys, grads, step=step, lr=0.1,
+            hessian=hess, l21=0.01,
+        )
+    vals = kv.gather(keys, train=False)
+    assert np.abs(vals[0]).max() > 0
+    np.testing.assert_array_equal(vals[1], np.zeros(dim))
+
+
+def _group_lamb_hessian_numpy(p0, grads, hess, steps, lr, b1, b2,
+                              eps, l1, l2, l21):
+    """Independent restatement: trust-ratio-scaled curvature step into
+    the FTRL-proximal linear/group-lasso machinery (group_adam-style
+    1/lr convention)."""
+    n, dim = p0.shape
+    p = p0.astype(np.float64).copy()
+    m = np.zeros_like(p)
+    v = np.zeros_like(p)
+    a = np.zeros_like(p)
+    lin = np.zeros_like(p)
+    l21_norm = l21 * np.sqrt(dim)
+    for t in range(1, steps + 1):
+        bc1, bc2 = 1 - b1**t, 1 - b2**t
+        m = b1 * m + (1 - b1) * grads
+        v = b2 * v + (1 - b2) * hess * hess
+        new_a = v / bc2
+        r = (m / bc1) / (np.sqrt(new_a) + eps)
+        p_norm = np.sqrt((p**2).sum(axis=1, keepdims=True))
+        r_norm = np.sqrt((r**2).sum(axis=1, keepdims=True))
+        ratio = np.where(
+            (p_norm > 0) & (r_norm > 0), p_norm / (r_norm + 1e-8),
+            1.0,
+        )
+        lin += (m / bc1) * ratio - (np.sqrt(new_a) - np.sqrt(a)) / lr * p
+        a = new_a
+        adj = np.clip(lin, -l1, l1)
+        l1l = adj - lin
+        norm = np.sqrt((l1l**2).sum(axis=1, keepdims=True))
+        scale = np.where(
+            norm > l21_norm, 1 - l21_norm / np.maximum(norm, 1e-30),
+            0.0,
+        )
+        y = (np.sqrt(a) + eps) / lr + 2 * l2
+        p = np.where(norm > l21_norm, l1l * scale / y, 0.0)
+    return p.astype(np.float32)
+
+
+def test_group_lamb_hessian_matches_reference_formula():
+    dim = 8
+    kv = KvVariable("emb", embedding_dim=dim, seed=27)
+    keys = np.array([1, 2], np.int64)
+    init_vals = kv.gather(keys).copy()
+    rng = np.random.default_rng(11)
+    grads = rng.normal(size=(2, dim)).astype(np.float32)
+    hess = np.abs(rng.normal(size=(2, dim))).astype(np.float32)
+    kw = dict(lr=0.05, b1=0.9, b2=0.999, eps=1e-6, l1=0.001,
+              l2=0.01, l21=0.001)
+    for step in range(1, 4):
+        kv.apply_gradients(
+            "group_lamb_hessian", keys, grads, step=step,
+            lr=kw["lr"], hessian=hess, beta1=kw["b1"],
+            beta2=kw["b2"], eps=kw["eps"], l1=kw["l1"],
+            l2=kw["l2"], l21=kw["l21"],
+        )
+    want = _group_lamb_hessian_numpy(
+        init_vals, grads, hess, 3, kw["lr"], kw["b1"], kw["b2"],
+        kw["eps"], kw["l1"], kw["l2"], kw["l21"],
+    )
+    np.testing.assert_allclose(
+        kv.gather(keys, train=False), want, atol=1e-5, rtol=1e-4,
+    )
