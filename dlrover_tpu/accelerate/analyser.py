@@ -85,6 +85,12 @@ def estimate_step_memory(
         * _OPT_STATE_MULT.get(strategy.optimizer, 2.0)
         / model_shards
     )
+    # Pipe note: 1F1B (parallel/pipeline.py) keeps up to `pipe`
+    # microbatches in flight, each resident for 1/pipe of the layers —
+    # activation residency stays ~the full-model single-microbatch
+    # figure, so act is deliberately NOT divided by pipe. (GPipe-style
+    # scheduling would multiply it by n_micro/pipe instead; the
+    # framework's scheduler is 1F1B.)
     act = activation_bytes_per_sample * strategy.micro_batch_size
     from dlrover_tpu.accelerate.remat import canonical
 

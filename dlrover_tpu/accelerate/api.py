@@ -311,8 +311,13 @@ def _roofline_prior(
                 model_loss, params_s, one_tok, one_tgt, grad=True
             )
         )
+        param_bytes = 4 * sum(
+            int(np.prod(l.shape)) for l in jax.tree.leaves(params_s)
+        )
         return [
-            predict_step_time(per_sample, s, n_devices)
+            predict_step_time(
+                per_sample, s, n_devices, param_bytes=param_bytes
+            )
             for s in strategies
         ]
     except Exception:  # noqa: BLE001 — fall back to the memory prior
@@ -404,6 +409,25 @@ def auto_accelerate(
     analysis = analyse_model(model_init)
     if candidates is None:
         candidates = candidate_strategies(len(devices))
+    # The generic (init, loss) contract gives no stage decomposition,
+    # so the GSPMD step CANNOT execute a pipe axis as 1F1B — it would
+    # replicate across it while the memory model assumes stage-sharded
+    # params. Keep pipe candidates in the GRID (plan mode / explicit
+    # strategies / parallel.pipeline users see them) but out of the
+    # dry-run search until a pipeline builder is wired.
+    n_pipe = sum(
+        1 for c in candidates if c.mesh_dict.get("pipe", 1) > 1
+    )
+    if n_pipe:
+        logger.info(
+            "strategy search: excluding %d pipe>1 candidates "
+            "(no pipeline builder for this model; use "
+            "parallel.pipeline.pipeline_train directly)",
+            n_pipe,
+        )
+        candidates = [
+            c for c in candidates if c.mesh_dict.get("pipe", 1) == 1
+        ]
     hbm = hbm_bytes if hbm_bytes is not None else (16 << 30)
 
     viable: List[Strategy] = []

@@ -75,20 +75,21 @@ def _factorizations(n: int, n_axes: int) -> List[Tuple[int, ...]]:
 
 def candidate_strategies(
     n_devices: int,
-    axes: Tuple[str, ...] = ("data", "fsdp", "seq", "tensor"),
+    axes: Tuple[str, ...] = ("data", "fsdp", "seq", "tensor", "pipe"),
     micro_batch_sizes: Tuple[int, ...] = (4, 8, 16),
     dtypes: Tuple[str, ...] = ("bfloat16",),
     optimizers: Tuple[str, ...] = ("adamw",),
     remats: Tuple[object, ...] = (False, "attention", True),
     max_tensor: int = 8,
+    max_pipe: int = 8,
     seq_impls: Tuple[str, ...] = ("auto",),
 ) -> List[Strategy]:
     """Enumerate the raw candidate grid (the reference's
     CombinationAlgorithm, auto/engine/sg_algo/combination_sg.py:16).
 
     The default grid spans every mesh factorization over
-    data/fsdp/seq/tensor x remat policy x micro-batch — hundreds of
-    candidates at 8 devices. That breadth is affordable because
+    data/fsdp/seq/tensor/pipe x remat policy x micro-batch — hundreds
+    of candidates at 8 devices. That breadth is affordable because
     nothing here compiles: the memory model prunes, the module
     profiler's roofline prior ranks, and only the top handful are
     dry-run (auto_accelerate max_dry_runs). A seq axis without ring
@@ -100,6 +101,8 @@ def candidate_strategies(
         shape = tuple(zip(axes, factors))
         d = dict(shape)
         if d.get("tensor", 1) > max_tensor:
+            continue
+        if d.get("pipe", 1) > max_pipe:
             continue
         # The seq_impl knob only distinguishes candidates when a seq
         # axis exists (otherwise every family degenerates identically).

@@ -359,6 +359,24 @@ class KvVariable:
             )
         return self._slots[slot_name]
 
+    def _hessian_rows(self, kw, optimizer, keys, ukeys, inv):
+        """Validate and dedupe trainer-supplied Hutchinson Hessian-
+        diagonal rows (same [n, dim] layout and duplicate-key
+        combining as the gradients) for the curvature optimizers
+        (adahessian, lamb_hessian, group_lamb_hessian)."""
+        hessian = kw.get("hessian")
+        if hessian is None:
+            raise ValueError(
+                f"{optimizer} requires hessian= rows aligned with "
+                "keys (Hutchinson diagonal estimates)"
+            )
+        hessian = np.ascontiguousarray(hessian, np.float32).reshape(
+            keys.size, self.embedding_dim
+        )
+        uhess = np.zeros((ukeys.size, self.embedding_dim), np.float32)
+        np.add.at(uhess, inv, hessian)
+        return uhess
+
     def apply_gradients(
         self,
         optimizer: str,
@@ -505,22 +523,7 @@ class KvVariable:
                 lr, kw.get("rho", 0.95), kw.get("eps", 1e-6), step,
             )
         elif optimizer == "adahessian":
-            # The Hutchinson-estimated Hessian diagonal rows come from
-            # the trainer (same [n, dim] layout as grads) — the kernel
-            # cannot estimate curvature from gradients alone.
-            hessian = kw.get("hessian")
-            if hessian is None:
-                raise ValueError(
-                    "adahessian requires hessian= rows aligned with "
-                    "keys (Hutchinson diagonal estimates)"
-                )
-            hessian = np.ascontiguousarray(
-                hessian, np.float32
-            ).reshape(keys.size, self.embedding_dim)
-            uhess = np.zeros(
-                (ukeys.size, self.embedding_dim), np.float32
-            )
-            np.add.at(uhess, inv, hessian)
+            uhess = self._hessian_rows(kw, optimizer, keys, ukeys, inv)
             lib.kv_sparse_apply_adahessian(
                 h,
                 self._slot("m").handle,
@@ -589,19 +592,7 @@ class KvVariable:
             # LAMB trust ratio with a curvature-driven second moment:
             # needs the same trainer-supplied Hutchinson rows as
             # adahessian.
-            hessian = kw.get("hessian")
-            if hessian is None:
-                raise ValueError(
-                    f"{optimizer} requires hessian= rows aligned "
-                    "with keys (Hutchinson diagonal estimates)"
-                )
-            hessian = np.ascontiguousarray(
-                hessian, np.float32
-            ).reshape(keys.size, self.embedding_dim)
-            uhess = np.zeros(
-                (ukeys.size, self.embedding_dim), np.float32
-            )
-            np.add.at(uhess, inv, hessian)
+            uhess = self._hessian_rows(kw, optimizer, keys, ukeys, inv)
             if optimizer == "lamb_hessian":
                 lib.kv_sparse_apply_lamb_hessian(
                     h,

@@ -243,12 +243,20 @@ _REMAT_FLOPS_FACTOR = {
 _DTYPE_BYTES_FACTOR = {"bfloat16": 1.0, "float32": 2.0, "half": 1.0}
 
 
+# Aggregate ICI bandwidth per chip for inter-device collectives,
+# GB/s. Order-of-magnitude (v5e ~ 4x ~400Gbps links); only the RATIO
+# against HBM bandwidth matters for ranking.
+DEFAULT_ICI_GBPS = 90.0
+
+
 def predict_step_time(
     per_sample: ModuleCost,
     strategy,
     n_devices: int,
     peak_tflops: Optional[float] = None,
     peak_hbm_gbps: Optional[float] = None,
+    param_bytes: Optional[int] = None,
+    ici_gbps: float = DEFAULT_ICI_GBPS,
 ) -> float:
     """Roofline estimate of one train-step's seconds for a strategy.
 
@@ -258,6 +266,17 @@ def predict_step_time(
     micro-batch scales work, every mesh axis shards it, remat
     multiplies FLOPs, the dtype policy scales memory traffic. Absolute
     numbers are rough; the RANKING is what seeds the search.
+
+    With ``param_bytes`` the estimate adds per-step ICI time — the
+    term that separates the parallelism FAMILIES: data/fsdp axes
+    re-synchronize parameters/gradients every step (traffic scales
+    with model size), pipe ships only stage-boundary activations but
+    pays the 1F1B bubble (n_micro/(n_micro+P-1) efficiency at the
+    n_micro=2P convention parallel/pipeline.py's dryrun uses). A deep
+    model on a slow interconnect ranks pipe above fsdp; a small model
+    ranks fsdp above pipe — matching the reference's treatment of
+    pipeline_parallel as a searchable method rather than a default
+    (optimization_library.py:38-56).
     """
     if peak_tflops is None or peak_hbm_gbps is None:
         pf, pb = chip_peaks()
@@ -285,19 +304,69 @@ def predict_step_time(
     )
     t_compute = flops / (peak_tflops * 1e12)
     t_memory = traffic / (peak_hbm_gbps * 1e9)
+    t = max(t_compute, t_memory)
+
+    pipe = mesh.get("pipe", 1)
+    if pipe > 1:
+        # 1F1B bubble at the n_micro = 2*pipe convention.
+        n_micro = 2 * pipe
+        t *= (n_micro + pipe - 1) / n_micro
+
+    if param_bytes is not None:
+        # Inter-device traffic per device per step, by axis family:
+        # fsdp all-gathers weights (fwd+bwd) and reduce-scatters
+        # grads, data all-reduces grads — both scale with MODEL size;
+        # tensor all-reduces partial activations inside every layer —
+        # scales with ACTIVATION size; pipe ships only stage-boundary
+        # activations (negligible next to any of these, its cost is
+        # the bubble above).
+        dsize = 2 if strategy.dtype in ("bfloat16", "half") else 4
+        model_bytes = param_bytes * dsize / 4  # param_bytes is f32
+        model_shards = (
+            mesh.get("fsdp", 1)
+            * mesh.get("tensor", 1)
+            * pipe
+        )
+        sync = 0.0
+        f = mesh.get("fsdp", 1)
+        if f > 1:
+            sync += 3.0 * (model_bytes / model_shards) * (f - 1)
+        d = mesh.get("data", 1)
+        if d > 1:
+            # ring all-reduce of this device's grad shard
+            sync += (
+                2.0 * (model_bytes / model_shards) * (d - 1) / d
+            )
+        tp = mesh.get("tensor", 1)
+        if tp > 1:
+            # two partial-sum all-reduces per layer fwd + the mirrored
+            # pair in bwd, approximated by the profiled activation
+            # output traffic of this device's micro-batch
+            act_bytes = (
+                per_sample.out_bytes
+                * strategy.micro_batch_size
+                * byte_f
+                / min(shards, n_devices)
+            )
+            sync += 4.0 * act_bytes * (tp - 1) / tp
+        t += sync / (ici_gbps * 1e9)
+
     # Per-step time normalized per sample so different micro-batch
     # sizes rank by throughput, not raw latency.
-    return max(t_compute, t_memory) / strategy.micro_batch_size
+    return t / strategy.micro_batch_size
 
 
 def strategy_time_priors(
     per_sample: ModuleCost,
     strategies,
     n_devices: int,
+    param_bytes: Optional[int] = None,
 ) -> list:
     """Lower-is-better per-sample step-time priors for a candidate
     list (drop-in for BayesStrategySearch's cost_prior)."""
     return [
-        predict_step_time(per_sample, s, n_devices)
+        predict_step_time(
+            per_sample, s, n_devices, param_bytes=param_bytes
+        )
         for s in strategies
     ]

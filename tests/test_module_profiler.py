@@ -121,6 +121,50 @@ class TestRooflinePrior:
             per_sample, f32_s, 1
         ) > predict_step_time(per_sample, none_s, 1)
 
+    def test_pipe_bubble_costs_time(self):
+        """Without a comm model, a pipe mesh must rank BELOW the
+        equivalent fsdp mesh — the 1F1B bubble is pure overhead."""
+        from dlrover_tpu.accelerate.strategy import Strategy
+
+        per_sample = ModuleCost(flops=1e12, bytes=1e9)
+        fsdp = Strategy((("fsdp", 8),), remat="none",
+                        micro_batch_size=4)
+        pipe = Strategy((("pipe", 8),), remat="none",
+                        micro_batch_size=4)
+        assert predict_step_time(
+            per_sample, pipe, 8
+        ) > predict_step_time(per_sample, fsdp, 8)
+
+    def test_deep_model_ranks_pipe_above_fsdp(self):
+        """The reason pipeline is in the search space at all (ref
+        optimization_library.py:38-56): a DEEP model's per-step fsdp
+        param re-sync traffic dwarfs the 1F1B bubble, so with the ICI
+        term the ranking flips — pipe above pure FSDP — while a small
+        model keeps fsdp on top."""
+        from dlrover_tpu.accelerate.strategy import Strategy
+
+        per_sample = ModuleCost(flops=1e12, bytes=1e9)
+        fsdp = Strategy((("fsdp", 8),), remat="none",
+                        micro_batch_size=4)
+        pipe = Strategy((("pipe", 8),), remat="none",
+                        micro_batch_size=4)
+        deep_params = 40 << 30  # 10B params f32 basis
+        small_params = 40 << 20
+        t_fsdp_deep = predict_step_time(
+            per_sample, fsdp, 8, param_bytes=deep_params
+        )
+        t_pipe_deep = predict_step_time(
+            per_sample, pipe, 8, param_bytes=deep_params
+        )
+        assert t_pipe_deep < t_fsdp_deep
+        t_fsdp_small = predict_step_time(
+            per_sample, fsdp, 8, param_bytes=small_params
+        )
+        t_pipe_small = predict_step_time(
+            per_sample, pipe, 8, param_bytes=small_params
+        )
+        assert t_fsdp_small < t_pipe_small
+
     def test_search_finds_known_best_in_fewer_dry_runs(self):
         """The round's done-criterion, measured: when both remat
         variants fit in memory, the known-best GPT config (no remat —
