@@ -17,8 +17,10 @@ annotations on one jitted function.
 
 from dlrover_tpu.accelerate.api import (  # noqa: F401
     AccelerateResult,
+    PlanEntry,
     auto_accelerate,
     make_optimizer,
+    plan_strategies,
 )
 from dlrover_tpu.accelerate.strategy import Strategy  # noqa: F401
 from dlrover_tpu.accelerate.analyser import analyse_model  # noqa: F401

@@ -287,16 +287,31 @@ def _roofline_prior(
     sample_batch,
     strategies: List[Strategy],
     n_devices: int,
+    chip: Optional[str] = None,
 ) -> Optional[List[float]]:
     """Per-strategy predicted step time (lower = better) from the
     module profiler's jaxpr walk — no compilation, one abstract
-    trace. None when the model cannot be traced abstractly."""
+    trace. None when the model cannot be traced abstractly.
+    ``chip`` ranks for a NAMED target generation (utils/profiler.py
+    peak tables) instead of whatever this host is — essential when
+    planning for a simulated topology from a CPU CI machine."""
     try:
         from dlrover_tpu.utils.module_profiler import (
             predict_step_time,
             profile_modules,
             total_cost,
         )
+        from dlrover_tpu.utils.profiler import (
+            PEAK_HBM_GBPS,
+            PEAK_TFLOPS,
+        )
+
+        peaks = {}
+        if chip is not None:
+            peaks = {
+                "peak_tflops": PEAK_TFLOPS[chip],
+                "peak_hbm_gbps": PEAK_HBM_GBPS[chip],
+            }
 
         params_s = jax.eval_shape(model_init, jax.random.PRNGKey(0))
         tok, tgt = sample_batch
@@ -316,7 +331,8 @@ def _roofline_prior(
         )
         return [
             predict_step_time(
-                per_sample, s, n_devices, param_bytes=param_bytes
+                per_sample, s, n_devices, param_bytes=param_bytes,
+                **peaks,
             )
             for s in strategies
         ]
@@ -361,6 +377,78 @@ def _dry_run(
     jax.block_until_ready(loss)
     dt = (time.perf_counter() - t0) / steps
     return n / dt, compile_s
+
+
+@dataclasses.dataclass
+class PlanEntry:
+    """One viable strategy from plan-only analysis."""
+
+    strategy: Strategy
+    est_bytes_per_device: int
+    predicted_step_s: Optional[float] = None
+
+
+def plan_strategies(
+    model_init: Callable[[jax.Array], Any],
+    n_devices: int,
+    hbm_bytes: int,
+    activation_bytes_per_sample: int,
+    candidates: Optional[List[Strategy]] = None,
+    model_loss: Optional[Callable] = None,
+    sample_batch: Optional[Tuple] = None,
+    chip: Optional[str] = None,
+    _analysis: Optional[ModelAnalysis] = None,
+) -> List[PlanEntry]:
+    """Plan-only strategy analysis: which candidates FIT a simulated
+    topology, ranked — no devices, no compile, pure eval_shape (the
+    reference engine's planning loop before its dry-runs,
+    atorch/auto/accelerate.py:196-227). Usable in CI for topologies
+    far larger than the test machine (e.g. a Llama-2-7B plan for
+    v5p-32 — pass ``chip="v5p"`` so the roofline ranks with the
+    TARGET generation's peaks, not this host's). With
+    ``model_loss``+``sample_batch`` the ranking uses the
+    module-profiler roofline (still abstract — jaxpr walk); otherwise
+    the memory estimate ranks. Also the analysis core of
+    :func:`auto_accelerate`'s search (single source of the memory
+    gate + prior wiring).
+    """
+    if chip is not None:
+        from dlrover_tpu.utils.profiler import PEAK_TFLOPS
+
+        if chip not in PEAK_TFLOPS:
+            # Fail fast: inside _roofline_prior a bad name would be
+            # swallowed by its broad fallback and silently degrade
+            # the ranking to bytes-resident.
+            raise ValueError(
+                f"unknown chip {chip!r}; known: "
+                f"{sorted(PEAK_TFLOPS)}"
+            )
+    analysis = _analysis if _analysis is not None else analyse_model(
+        model_init
+    )
+    if candidates is None:
+        candidates = candidate_strategies(n_devices)
+    entries: List[PlanEntry] = []
+    for cand in candidates:
+        est, fits = estimate_step_memory(
+            analysis, cand, activation_bytes_per_sample, hbm_bytes
+        )
+        if fits:
+            entries.append(PlanEntry(cand, est))
+    if not entries:
+        return []
+    if model_loss is not None and sample_batch is not None:
+        prior = _roofline_prior(
+            model_init, model_loss, sample_batch,
+            [e.strategy for e in entries], n_devices, chip=chip,
+        )
+        if prior is not None:
+            for e, p in zip(entries, prior):
+                e.predicted_step_s = p
+            entries.sort(key=lambda e: e.predicted_step_s)
+            return entries
+    entries.sort(key=lambda e: e.est_bytes_per_device)
+    return entries
 
 
 def auto_accelerate(
@@ -430,37 +518,35 @@ def auto_accelerate(
         ]
     hbm = hbm_bytes if hbm_bytes is not None else (16 << 30)
 
-    viable: List[Strategy] = []
-    mem_prior: List[float] = []
-    for cand in candidates:
-        est, fits = estimate_step_memory(
-            analysis, cand, activation_bytes_per_sample, hbm
-        )
-        if fits:
-            viable.append(cand)
-            mem_prior.append(est)
+    # Memory gates viability; the roofline over the module profile
+    # SEEDS the search (predicted step time ranks candidates far
+    # better than bytes-resident, so the likely winner is dry-run
+    # first and the budget shrinks). plan_strategies is the single
+    # source of that gate + prior wiring (also usable standalone for
+    # simulated topologies).
+    entries = plan_strategies(
+        model_init, len(devices), hbm, activation_bytes_per_sample,
+        candidates=candidates, model_loss=model_loss,
+        sample_batch=sample_batch, _analysis=analysis,
+    )
     logger.info(
         "strategy search: %d candidates, %d fit in memory",
         len(candidates),
-        len(viable),
+        len(entries),
     )
-    if not viable:
+    if not entries:
         raise RuntimeError(
             f"no strategy fits: model {analysis.n_params:,} params "
             f"needs more than {hbm} bytes/device on {len(devices)} "
             "devices"
         )
-    # Memory gates viability; the roofline over the module profile
-    # SEEDS the search (predicted step time ranks candidates far
-    # better than bytes-resident, so the likely winner is dry-run
-    # first and the budget shrinks).
-    cost_prior = (
-        _roofline_prior(
-            model_init, model_loss, sample_batch, viable,
-            len(devices),
-        )
-        or mem_prior
-    )
+    viable = [e.strategy for e in entries]
+    cost_prior = [
+        e.predicted_step_s
+        if e.predicted_step_s is not None
+        else float(e.est_bytes_per_device)
+        for e in entries
+    ]
 
     # Compile cache: one build (and one XLA compile) per strategy —
     # the winner's executable is handed back, not recompiled.

@@ -331,11 +331,14 @@ def predict_step_time(
         f = mesh.get("fsdp", 1)
         if f > 1:
             sync += 3.0 * (model_bytes / model_shards) * (f - 1)
-        d = mesh.get("data", 1)
-        if d > 1:
+        # Every axis that REPLICATES parameters must re-synchronize
+        # gradients: data and seq both do (sequence shards compute
+        # partial grads for the whole non-pipe-sharded model).
+        reps = mesh.get("data", 1) * mesh.get("seq", 1)
+        if reps > 1:
             # ring all-reduce of this device's grad shard
             sync += (
-                2.0 * (model_bytes / model_shards) * (d - 1) / d
+                2.0 * (model_bytes / model_shards) * (reps - 1) / reps
             )
         tp = mesh.get("tensor", 1)
         if tp > 1:
