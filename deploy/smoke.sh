@@ -1,0 +1,82 @@
+#!/usr/bin/env bash
+# One-command real-cluster smoke test for the dlrover-tpu operator:
+# applies deploy/ to whatever cluster kubectl currently points at,
+# submits the golden ElasticJob, and waits for its phase to reach
+# Running (ref analogue: the Go operator's controller e2e,
+# go/operator/pkg/controllers/elasticjob_controller.go:85).
+#
+# Usage: deploy/smoke.sh [--image <registry>/dlrover-tpu/operator:tag]
+#                        [--timeout 300]
+#
+# This is the validation step tests/test_operator_deploy.py CANNOT
+# perform (no kind/minikube in the CI image — it e2e-tests against a
+# simulated apiserver speaking the real HTTP API instead). Run this
+# whenever a real cluster is available.
+set -euo pipefail
+
+IMAGE=""
+TIMEOUT=300
+while [[ $# -gt 0 ]]; do
+  case "$1" in
+    --image) IMAGE="$2"; shift 2 ;;
+    --timeout) TIMEOUT="$2"; shift 2 ;;
+    *) echo "unknown arg: $1" >&2; exit 2 ;;
+  esac
+done
+
+ROOT="$(cd "$(dirname "$0")/.." && pwd)"
+cd "$ROOT"
+
+echo "== context: $(kubectl config current-context)"
+
+if [[ -n "$IMAGE" ]]; then
+  echo "== building + pushing $IMAGE"
+  docker build -f deploy/Dockerfile -t "$IMAGE" .
+  docker push "$IMAGE"
+fi
+
+echo "== applying CRDs, RBAC, operator"
+kubectl apply -f deploy/crd-elasticjob.yaml
+kubectl apply -f deploy/crd-scaleplan.yaml
+kubectl apply -f deploy/rbac.yaml
+if [[ -n "$IMAGE" ]]; then
+  sed "s#image: .*dlrover-tpu/operator.*#image: $IMAGE#" \
+    deploy/operator.yaml | kubectl apply -f -
+else
+  kubectl apply -f deploy/operator.yaml
+fi
+
+echo "== waiting for the operator deployment"
+kubectl -n dlrover-tpu rollout status deploy/dlrover-tpu-operator \
+  --timeout="${TIMEOUT}s"
+
+echo "== submitting the golden ElasticJob"
+kubectl apply -f tests/golden/elasticjob.yaml
+
+echo "== waiting for phase Running (timeout ${TIMEOUT}s)"
+deadline=$((SECONDS + TIMEOUT))
+phase=""
+while (( SECONDS < deadline )); do
+  phase=$(kubectl get elasticjob ctr-train -n default \
+    -o jsonpath='{.status.phase}' 2>/dev/null || true)
+  echo "   phase: ${phase:-<none>}"
+  case "$phase" in
+    Running|Succeeded) break ;;
+    Failed)
+      echo "SMOKE FAIL: job phase Failed" >&2
+      kubectl describe elasticjob ctr-train -n default >&2 || true
+      exit 1 ;;
+  esac
+  sleep 5
+done
+
+if [[ "$phase" != "Running" && "$phase" != "Succeeded" ]]; then
+  echo "SMOKE FAIL: job never reached Running within ${TIMEOUT}s" >&2
+  kubectl get pods -n default -l dlrover-job=ctr-train >&2 || true
+  kubectl logs -n dlrover-tpu deploy/dlrover-tpu-operator \
+    --tail=50 >&2 || true
+  exit 1
+fi
+
+echo "SMOKE OK: ElasticJob ctr-train is $phase"
+kubectl get pods -n default -l dlrover-job=ctr-train

@@ -24,6 +24,7 @@ from dlrover_tpu.common.log import get_logger
 logger = get_logger("agent_monitor")
 
 METRICS_FILE_ENV = "DLROVER_TPU_METRICS_FILE"
+PHASES_FILE_ENV = "DLROVER_TPU_PHASES_FILE"
 
 
 def default_metrics_file() -> str:
@@ -129,6 +130,32 @@ class TrainingMonitor:
             json.dump(
                 {"step": step, "tokens": tokens, "ts": time.time()}, f
             )
+        os.replace(tmp, path)
+
+    @staticmethod
+    def mark_phase(name: str, path: Optional[str] = None) -> None:
+        """Timestamp a startup/recovery phase boundary from the
+        TRAINING process (proc_start, dist_ready, built, restore_done,
+        first_step_done, ...). Written only when
+        DLROVER_TPU_PHASES_FILE is set (or ``path`` given) — chaos
+        drills use the marks to break a recovery time into
+        explainable, budget-checkable segments. Each trainer (re)start
+        overwrites the file from its own proc_start, so the file
+        always describes the LATEST attempt."""
+        path = path or os.getenv(PHASES_FILE_ENV)
+        if not path:
+            return
+        marks = {}
+        if name != "proc_start":
+            try:
+                with open(path) as f:
+                    marks = json.load(f)
+            except (OSError, ValueError):
+                marks = {}
+        marks[name] = time.time()
+        tmp = f"{path}.tmp{os.getpid()}"
+        with open(tmp, "w") as f:
+            json.dump(marks, f)
         os.replace(tmp, path)
 
     def report_once(self) -> Optional[int]:

@@ -49,6 +49,9 @@ class PsManager:
         self._ping_failures: Dict[int, int] = {}
         self._liveness_stop = threading.Event()
         self._liveness_thread: Optional[threading.Thread] = None
+        # Set by check_liveness after an automatic failover: ps_id,
+        # t_detected, t_map_published, map_version (drill telemetry).
+        self.last_failover: Optional[Dict] = None
 
     # -- accessors -------------------------------------------------------
 
@@ -335,7 +338,17 @@ class PsManager:
             )
             with self._lock:
                 self._ping_failures.pop(ps_id, None)
+            t_detected = time.time()
             self.remove_ps(ps_id)
+            with self._lock:
+                # Phase record for chaos drills: when the monitor
+                # declared death vs when the rebalanced map published.
+                self.last_failover = {
+                    "ps_id": ps_id,
+                    "t_detected": t_detected,
+                    "t_map_published": time.time(),
+                    "map_version": self._map.version,
+                }
         return dead
 
     # -- telemetry -------------------------------------------------------

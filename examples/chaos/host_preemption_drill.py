@@ -48,6 +48,42 @@ def read_step(path: str):
         return -1, 0.0
 
 
+def recovery_phases(phases_path: str, t_event: float):
+    """Split a recovery interval into explainable segments from the
+    trainer's phase marks (TrainingMonitor.mark_phase). Marks describe
+    the trainer attempt STARTED AFTER ``t_event``; returns None when
+    the file predates the event (e.g. a restart that never got to
+    proc_start)."""
+    try:
+        with open(phases_path) as f:
+            marks = json.load(f)
+    except (OSError, ValueError):
+        return None
+    order = (
+        "proc_start", "dist_ready", "built", "restore_done",
+        "first_step_done",
+    )
+    if any(k not in marks for k in order):
+        return None
+    if marks["proc_start"] < t_event:
+        return None  # stale file from the pre-event attempt
+    seg = {
+        # master watchdog detection + restart push + agent respawn
+        "detect_respawn_s": marks["proc_start"] - t_event,
+        # master re-rendezvous + jax.distributed re-init
+        "rendezvous_init_s": marks["dist_ready"] - marks["proc_start"],
+        # strategy build + sharded param init (compile #1)
+        "build_s": marks["built"] - marks["dist_ready"],
+        # flash-checkpoint streaming restore
+        "restore_s": marks["restore_done"] - marks["built"],
+        # first train step (compile #2)
+        "first_step_s": (
+            marks["first_step_done"] - marks["restore_done"]
+        ),
+    }
+    return {k: round(v, 2) for k, v in seg.items()}
+
+
 def start_master(tmp: str):
     proc = subprocess.Popen(
         [
@@ -86,6 +122,9 @@ def start_agent(
         "DLROVER_TPU_JOB_NAME": f"host_drill_n{rank}",
         "DLROVER_TPU_METRICS_FILE": os.path.join(
             tmp, f"metrics_n{rank}.json"
+        ),
+        "DLROVER_TPU_PHASES_FILE": os.path.join(
+            tmp, f"phases_n{rank}.json"
         ),
         "JAX_COMPILATION_CACHE_DIR": os.path.join(tmp, "jaxcache"),
         "JAX_PERSISTENT_CACHE_MIN_ENTRY_SIZE_BYTES": "0",
@@ -182,6 +221,11 @@ def main() -> int:
         with open(os.path.join(tmp, "agent_n0.log")) as f:
             log0 = f.read()
         shrank = "rank=0/1" in log0
+        # Snapshot NOW: the phase-2 regrow restarts the survivor's
+        # trainer again and would overwrite these marks.
+        shrink_phases = recovery_phases(
+            os.path.join(tmp, "phases_n0.json"), t_kill
+        )
         # Phase 2: host 1 comes back and the world re-grows.
         t_rejoin = time.time()
         agents[1] = start_agent(1, addr, tmp, args.steps)
@@ -201,8 +245,16 @@ def main() -> int:
         result = {
             "drill": "host_preemption_2host",
             "shrink_recovery_s": round(shrink_recovery_s, 1),
+            "shrink_phases": shrink_phases,
             "rejoin_recovery_s": (
                 round(rejoin_recovery_s, 1) if regrown else None
+            ),
+            "rejoin_phases": (
+                recovery_phases(
+                    os.path.join(tmp, "phases_n1.json"), t_rejoin
+                )
+                if regrown
+                else None
             ),
             "pre_kill_step": pre_kill_step,
             "resumed_step": resumed_step,

@@ -195,20 +195,35 @@ def main(argv=None) -> int:
         if drill_stats.get("kill_step") == step - 1:
             # First full step after the kill: everything blocked in it
             # (stale-map retries + rebalance) is the recovery cost.
-            drill_stats["recovery_s"] = round(
-                time.time() - drill_stats.pop("_kill_time"), 3
-            )
+            t_unblocked = time.time()
+            t_kill = drill_stats.pop("_kill_time")
+            drill_stats["recovery_s"] = round(t_unblocked - t_kill, 3)
             drill_stats["map_version_after"] = (
                 mgr.partition_map.version
             )
             drill_stats["rows_after_recovery"] = client.table_size(
                 "emb"
             )
+            fo = mgr.last_failover
+            if args.drill == "abrupt" and fo is not None:
+                # Phase breakdown: liveness detection latency, the
+                # rebalance+restore inside remove_ps, and the blocked
+                # client's unblock-to-step-complete time.
+                drill_stats["phases"] = {
+                    "detect_s": round(fo["t_detected"] - t_kill, 3),
+                    "rebalance_restore_s": round(
+                        fo["t_map_published"] - fo["t_detected"], 3
+                    ),
+                    "client_resume_s": round(
+                        t_unblocked - fo["t_map_published"], 3
+                    ),
+                }
             print(
                 f"DRILL: recovered in {drill_stats['recovery_s']}s "
                 f"(map v{drill_stats['map_version_before']} -> "
                 f"v{drill_stats['map_version_after']}, rows "
-                f"{drill_stats['rows_after_recovery']})"
+                f"{drill_stats['rows_after_recovery']}, phases "
+                f"{drill_stats.get('phases')})"
             )
 
         if args.drill and step == kill_at:

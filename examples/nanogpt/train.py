@@ -77,7 +77,11 @@ def main(argv=None) -> int:
         StorageType,
     )
 
+    # Phase marks (no-ops unless DLROVER_TPU_PHASES_FILE is set):
+    # chaos drills split recovery time into these segments.
+    TrainingMonitor.mark_phase("proc_start")
     jax_env.setup_distributed()
+    TrainingMonitor.mark_phase("dist_ready")
 
     if args.smoke:
         cfg = gpt.GPTConfig(
@@ -122,6 +126,7 @@ def main(argv=None) -> int:
         micro_batch_size=args.micro_batch_size,
     )
     params, opt_state = res.init_fn(jax.random.PRNGKey(0))
+    TrainingMonitor.mark_phase("built")
 
     ckpt_dir = args.checkpoint_dir or os.path.join(
         tempfile.gettempdir(), "dlrover_tpu_nanogpt_ckpt"
@@ -141,6 +146,7 @@ def main(argv=None) -> int:
         params, opt_state = restored
         start_step = ckpt.last_restored_step
         print(f"restored checkpoint at step {start_step}")
+    TrainingMonitor.mark_phase("restore_done")
 
     sampler = ElasticDistributedSampler(
         dataset_size=len(data) - cfg.block_size - 1,
@@ -173,6 +179,9 @@ def main(argv=None) -> int:
             params, opt_state, jnp.asarray(tok), jnp.asarray(tgt)
         )
         tokens_seen += trainer.samples_per_step * cfg.block_size
+        if step == start_step + 1:
+            # First step covers the train-step compile.
+            TrainingMonitor.mark_phase("first_step_done")
         TrainingMonitor.write_metrics(step, tokens=tokens_seen)
         if step % 10 == 0 or step == args.steps:
             dt = time.time() - t0

@@ -41,3 +41,27 @@ def test_two_host_preemption_drill(tmp_path):
     assert result["world_regrew"]
     assert result["within_budget"]
     assert result["shrink_recovery_s"] <= 180
+
+    # Phase breakdown (VERDICT r3 weak #5): the recovery time must be
+    # explainable — every segment present, non-negative, within its
+    # own budget, and summing to ~the total.
+    phases = result["shrink_phases"]
+    assert phases is not None, "shrink phases missing"
+    budgets = {
+        # master watchdog (6 s heartbeat timeout + 2 s monitor tick)
+        # + restart push + agent respawn
+        "detect_respawn_s": 45.0,
+        "rendezvous_init_s": 60.0,
+        "build_s": 90.0,  # cold compile #1 on one CPU core
+        "restore_s": 30.0,
+        "first_step_s": 90.0,  # cold compile #2
+    }
+    for name, budget in budgets.items():
+        assert 0.0 <= phases[name] <= budget, (
+            f"phase {name}={phases[name]}s over its {budget}s budget"
+        )
+    # The observed total lags first_step_done by the drill's 1 s
+    # metrics polling plus one extra confirming step.
+    assert (
+        abs(sum(phases.values()) - result["shrink_recovery_s"]) < 10.0
+    ), f"phases {phases} do not explain {result['shrink_recovery_s']}s"
