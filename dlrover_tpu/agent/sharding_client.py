@@ -95,14 +95,26 @@ class IndexShardingClient(ShardingClient):
         dataset_name: str,
         batch_size: int,
         client: Optional[MasterClient] = None,
+        defer_completion: bool = False,
     ):
+        """``defer_completion=True`` changes WHEN a fully-consumed
+        shard is reported done: not at the moment its last index is
+        popped (the producer may still die with the materialized batch
+        in hand — silently lost, since the master would never
+        re-dispatch a "done" shard), but at the next explicit
+        :meth:`confirm_delivered` call, which producers place right
+        after the downstream hand-off (shm ring put / remote push
+        ack). That makes shard completion mean "delivered", the
+        at-least-once contract the chaos drills check."""
         super().__init__(dataset_name, client)
         self.batch_size = batch_size
+        self.defer_completion = defer_completion
         self._indices: Deque[int] = deque()
         self._index_lock = threading.Lock()
         # task_id -> remaining sample count; completion reported at 0
         self._task_remaining: Dict[int, int] = {}
         self._current_task_queue: Deque[int] = deque()
+        self._consumed_unconfirmed: List[int] = []
         self._exhausted = False
 
     def fetch_sample_index(self) -> Optional[int]:
@@ -129,6 +141,9 @@ class IndexShardingClient(ShardingClient):
                 if self._task_remaining[tid] == 0:
                     self._current_task_queue.popleft()
                     done_tid = tid
+                    if self.defer_completion:
+                        self._consumed_unconfirmed.append(done_tid)
+                        return
                     # Report outside the lock via a thread to keep the
                     # input pipeline non-blocking.
                     threading.Thread(
@@ -138,6 +153,27 @@ class IndexShardingClient(ShardingClient):
                     ).start()
                 return
             self._current_task_queue.popleft()
+
+    def confirm_delivered(self) -> int:
+        """Report done every fully-consumed shard whose indices were
+        all popped BEFORE this call (defer_completion mode). Producers
+        call it right after a successful downstream hand-off; batches
+        are built in pop order, so the hand-off covers everything
+        popped so far. Returns the number of shards reported.
+
+        The reports ride a daemon thread like the non-defer path —
+        the delivery-ordering requirement is already satisfied the
+        moment the tids leave the unconfirmed list, so the producer's
+        batch loop need not stall on master round-trips."""
+        with self._index_lock:
+            ready, self._consumed_unconfirmed = (
+                self._consumed_unconfirmed, []
+            )
+        for tid in ready:
+            threading.Thread(
+                target=self.report_task_done, args=(tid,), daemon=True
+            ).start()
+        return len(ready)
 
     def _prefetch(self) -> None:
         task = self.get_task(wait=True)
@@ -159,4 +195,9 @@ class IndexShardingClient(ShardingClient):
             self._indices.clear()
             self._task_remaining.clear()
             self._current_task_queue.clear()
+            # Unconfirmed completions must NOT survive a reset: the
+            # master re-queues those shards, and confirming a stale
+            # tid afterwards would mark the re-queued shard done with
+            # its batches undelivered.
+            self._consumed_unconfirmed.clear()
             self._exhausted = False

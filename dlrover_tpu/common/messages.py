@@ -592,3 +592,39 @@ class BrainOptimizeResponse:
     # Algorithm result, JSON-ish (None / number / dict / list).
     result: Any = None
     error: str = ""
+
+
+# ---------------------------------------------------------------------------
+# Cross-pod data ingest (ref: atorch coworker pods feeding training
+# pods over RPC, atorch/data/coworker_dataset.py:16,25-40 +
+# shm_context.py — there torch rpc, here the typed msgpack layer)
+# ---------------------------------------------------------------------------
+
+
+@message
+class DataBatchPush:
+    """Remote coworker pod -> training host: one preprocessed batch.
+
+    The training host's BatchIngestServer (data/ingest.py) copies the
+    arrays into its local shm ring; the reply is a DataBatchAck whose
+    ``accepted=False`` is backpressure (ring full) — the pod retries
+    after a backoff instead of overrunning the consumer."""
+
+    pod_id: int = 0
+    seq: int = 0
+    arrays: Dict[str, Tensor] = dataclasses.field(default_factory=dict)
+
+
+@message
+class DataBatchAck:
+    accepted: bool = True
+
+
+@message
+class DataStreamEnd:
+    """Remote pod -> training host: this pod's stream is over (or
+    failed, when ``error`` is non-empty)."""
+
+    pod_id: int = 0
+    produced: int = 0
+    error: str = ""

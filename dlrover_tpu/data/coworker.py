@@ -112,8 +112,14 @@ def _sharded_batches_main(
     from dlrover_tpu.agent.sharding_client import IndexShardingClient
 
     client = MasterClient(master_addr, node_id=node_id)
+    # defer_completion: a shard is reported done only after the batch
+    # carrying its last index was handed downstream — the yield
+    # resumes once the consumer (shm ring put / remote RPC push)
+    # accepted the previous batch, so confirming there guarantees
+    # nothing reported "done" can die with this producer.
     shard_client = IndexShardingClient(
-        dataset_name, batch_size=batch_size, client=client
+        dataset_name, batch_size=batch_size, client=client,
+        defer_completion=True,
     )
     pending: list = []
     while True:
@@ -121,11 +127,59 @@ def _sharded_batches_main(
         if idx is None:
             if pending:
                 yield fetch_fn(np.asarray(pending, np.int64))
+            shard_client.confirm_delivered()
             return
         pending.append(idx)
         if len(pending) >= batch_size:
             yield fetch_fn(np.asarray(pending, np.int64))
+            shard_client.confirm_delivered()
             pending = []
+
+
+def drain_batches(
+    ring: ShmBatchRing,
+    ended: set,
+    expected: int,
+    error_ends_stream: bool = False,
+    deadline: Optional[float] = None,
+):
+    """Shared ring-consume loop: yield batches until ``expected``
+    producer ids are in ``ended`` (the caller's set — a supervisor
+    thread may add to it concurrently, as CoworkerDataLoader does).
+
+    ``error_ends_stream``: whether an {"error": id} control terminates
+    that producer's stream — True for remote pods (nobody respawns
+    them here; the master re-dispatches their shards), False for local
+    coworkers (the loader's supervisor respawns and decides when to
+    give up). ``deadline`` (absolute time) raises TimeoutError.
+    """
+    while len(ended) < expected:
+        if deadline is not None and time.time() > deadline:
+            raise TimeoutError(
+                f"{expected - len(ended)} producers never finished"
+            )
+        item = ring.get(timeout=1.0)
+        if item is None:
+            continue
+        batch, info = item
+        if batch is None:  # control
+            if "end" in info:
+                if info["end"] in ended:
+                    logger.warning(
+                        "duplicate end-of-stream from producer %s — "
+                        "check producer/pod id uniqueness",
+                        info["end"],
+                    )
+                ended.add(info["end"])
+            elif "error" in info:
+                logger.warning(
+                    "producer %s failed: %s",
+                    info.get("error"), info.get("message"),
+                )
+                if error_ends_stream:
+                    ended.add(info["error"])
+            continue
+        yield batch
 
 
 class CoworkerDataLoader:
@@ -222,21 +276,12 @@ class CoworkerDataLoader:
     # -- consumption -----------------------------------------------------
 
     def __iter__(self) -> Iterator[Dict[str, np.ndarray]]:
-        while len(self._ended) < self.num_workers:
-            item = self._ring.get(timeout=1.0)
-            if item is None:
-                continue
-            batch, info = item
-            if batch is None:  # control
-                if "end" in info:
-                    self._ended.add(info["end"])
-                elif "error" in info:
-                    logger.warning(
-                        "coworker %s failed: %s",
-                        info.get("error"), info.get("message"),
-                    )
-                continue
-            yield batch
+        # error controls do NOT end a stream here: the supervisor
+        # respawns crashed workers and sends the give-up end itself.
+        yield from drain_batches(
+            self._ring, self._ended, self.num_workers,
+            error_ends_stream=False,
+        )
 
     def batches(self, max_batches: Optional[int] = None):
         for i, b in enumerate(self):

@@ -1,0 +1,63 @@
+"""Deployable CPU coworker pod entrypoint.
+
+    python -m dlrover_tpu.data.coworker_pod \
+        --ingest <train-host:port> \
+        --master <master:port> --dataset ds --batch-size 64 \
+        --fetch my_pkg.preprocess:fetch_batch [--pod-id 0]
+
+The pod pulls elastic index shards from the master's dynamic sharding
+service, materializes them with the user's ``fetch(indices) -> {name:
+ndarray}`` function, and streams the batches to the training host's
+BatchIngestServer (data/ingest.py). This is the reference's separate
+CPU-pod coworker (atorch/data/coworker_dataset.py) as a one-command
+container entry; the k8s operator schedules it like any worker pod.
+"""
+
+from __future__ import annotations
+
+import argparse
+import importlib
+import sys
+
+
+def _resolve(spec: str):
+    mod_name, _, fn_name = spec.partition(":")
+    if not fn_name:
+        raise SystemExit(
+            f"--fetch must be module:function, got {spec!r}"
+        )
+    return getattr(importlib.import_module(mod_name), fn_name)
+
+
+def main(argv=None) -> int:
+    p = argparse.ArgumentParser(description=__doc__)
+    p.add_argument("--ingest", required=True,
+                   help="training host's BatchIngestServer addr")
+    p.add_argument("--master", required=True,
+                   help="job master addr (dynamic sharding service)")
+    p.add_argument("--dataset", required=True)
+    p.add_argument("--batch-size", type=int, default=64)
+    p.add_argument("--fetch", required=True,
+                   help="module:function mapping indices -> batch")
+    p.add_argument("--pod-id", type=int, default=0)
+    args = p.parse_args(argv)
+
+    from dlrover_tpu.data.coworker import make_sharded_batches
+    from dlrover_tpu.data.ingest import run_remote_coworker
+
+    make_batches = make_sharded_batches(
+        args.master,
+        args.dataset,
+        batch_size=args.batch_size,
+        fetch_fn=_resolve(args.fetch),
+        node_id=args.pod_id,
+    )
+    sent = run_remote_coworker(
+        args.ingest, make_batches, pod_id=args.pod_id
+    )
+    print(f"coworker pod {args.pod_id}: streamed {sent} batches")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,210 @@
+"""Cross-pod coworker transport: CPU pods feeding TPU hosts over RPC.
+
+Capability parity with the reference's coworker *pod* architecture
+(atorch/atorch/data/coworker_dataset.py:16,25-40 + shm_context.py):
+there, preprocessing runs on separate CPU pods that the training pod
+reaches over torch RPC. Here the same shape rides this framework's
+typed msgpack/gRPC layer (common/comm.py):
+
+* the TRAINING host runs a :class:`BatchIngestServer` — an RPC
+  endpoint that copies pushed batches into the local shm ring
+  (data/shm_ring.py), so the training process consumes remote and
+  same-host batches through one identical interface;
+* each CPU pod runs :func:`run_remote_coworker` (or the
+  ``python -m dlrover_tpu.data.coworker_pod`` CLI) — it materializes
+  batches (optionally pulling elastic index shards from the master's
+  dynamic sharding service, data/coworker.py make_sharded_batches)
+  and pushes them with backpressure: a full ring answers
+  ``accepted=False`` and the pod backs off;
+* fault tolerance is inherited, not re-invented: a pod killed
+  mid-shard leaves its task in the master's doing queue and the
+  timeout watchdog re-dispatches it to surviving pods
+  (at-least-once), exactly the same-host story.
+"""
+
+from __future__ import annotations
+
+import time
+from typing import Callable, Dict, Iterator, Optional, Set
+
+import numpy as np
+
+from dlrover_tpu.common import messages as msg
+from dlrover_tpu.common.comm import (
+    RpcClient,
+    RpcDispatcher,
+    RpcError,
+    RpcServer,
+)
+from dlrover_tpu.common.log import get_logger
+from dlrover_tpu.data.shm_ring import ShmBatchRing
+
+logger = get_logger("ingest")
+
+
+class BatchIngestServer:
+    """Training-host endpoint: remote batch pushes -> local shm ring.
+
+    Owns the ring (``server=True``); consume with :meth:`batches` or
+    hand ``ring`` to existing consumer code. ``put_timeout`` bounds
+    how long a push waits for a free slot before the ack says
+    ``accepted=False`` (backpressure to the pod)."""
+
+    def __init__(
+        self,
+        name: str = "ingest",
+        num_slots: int = 8,
+        slot_bytes: int = 64 << 20,
+        port: int = 0,
+        put_timeout: float = 1.0,
+    ):
+        self.ring = ShmBatchRing(
+            name, num_slots, slot_bytes, server=True
+        )
+        self.num_slots = num_slots
+        self.put_timeout = put_timeout
+        self._accepted = 0
+        self._rejected = 0
+        dispatcher = RpcDispatcher()
+        dispatcher.register_get(msg.DataBatchPush, self._on_push)
+        dispatcher.register_get(msg.DataStreamEnd, self._on_end)
+        self._server = RpcServer(dispatcher, port=port)
+
+    @property
+    def addr(self) -> str:
+        return self._server.addr
+
+    def start(self) -> "BatchIngestServer":
+        self._server.start()
+        logger.info("batch ingest listening on %s", self.addr)
+        return self
+
+    def stop(self) -> None:
+        self._server.stop(grace=1.0)
+        self.ring.close(unlink=True)
+
+    # -- handlers (RPC worker threads) ----------------------------------
+
+    def _on_push(self, req: msg.DataBatchPush) -> msg.DataBatchAck:
+        batch = {k: t.to_numpy() for k, t in req.arrays.items()}
+        ok = self.ring.put(
+            batch,
+            extra={"worker": req.pod_id, "seq": req.seq},
+            timeout=self.put_timeout,
+        )
+        if ok:
+            self._accepted += 1
+        else:
+            self._rejected += 1
+        return msg.DataBatchAck(accepted=ok)
+
+    def _on_end(self, req: msg.DataStreamEnd) -> msg.DataBatchAck:
+        if req.error:
+            self.ring.put_control(
+                {"error": req.pod_id, "message": req.error}
+            )
+        else:
+            self.ring.put_control(
+                {"end": req.pod_id, "produced": req.produced}
+            )
+        return msg.DataBatchAck(accepted=True)
+
+    # -- consumption -----------------------------------------------------
+
+    def batches(
+        self,
+        expected_pods: int,
+        timeout: Optional[float] = None,
+    ) -> Iterator[Dict[str, np.ndarray]]:
+        """Yield batches until every expected pod reported
+        end-of-stream (same contract as CoworkerDataLoader.__iter__).
+        A pod's error-end TERMINATES its stream — nobody here respawns
+        remote pods, and the master re-dispatches their in-flight
+        shards to survivors — so a crash-looping pod cannot hang the
+        training host. ``timeout`` bounds the TOTAL wait; None =
+        forever."""
+        from dlrover_tpu.data.coworker import drain_batches
+
+        ended: Set[int] = set()
+        deadline = None if timeout is None else time.time() + timeout
+        yield from drain_batches(
+            self.ring, ended, expected_pods,
+            error_ends_stream=True, deadline=deadline,
+        )
+
+
+class RemoteBatchSender:
+    """Pod-side pusher with backpressure handling."""
+
+    def __init__(
+        self,
+        ingest_addr: str,
+        pod_id: int,
+        backoff: float = 0.05,
+        max_backoff: float = 2.0,
+    ):
+        self.pod_id = pod_id
+        self.backoff = backoff
+        self.max_backoff = max_backoff
+        self._client = RpcClient(ingest_addr)
+        self._seq = 0
+
+    def push(self, batch: Dict[str, np.ndarray]) -> None:
+        """Send one batch; blocks (with exponential backoff) while the
+        training host's ring is full."""
+        req = msg.DataBatchPush(
+            pod_id=self.pod_id,
+            seq=self._seq,
+            arrays={
+                k: msg.Tensor.from_numpy(v) for k, v in batch.items()
+            },
+        )
+        delay = self.backoff
+        while True:
+            ack = self._client.get(req)
+            if ack.accepted:
+                self._seq += 1
+                return
+            time.sleep(delay)
+            delay = min(delay * 2, self.max_backoff)
+
+    def end(self, error: str = "") -> None:
+        try:
+            self._client.get(
+                msg.DataStreamEnd(
+                    pod_id=self.pod_id,
+                    produced=self._seq,
+                    error=error,
+                )
+            )
+        except RpcError:
+            logger.warning(
+                "pod %d could not deliver end-of-stream", self.pod_id,
+                exc_info=True,
+            )
+
+    def close(self) -> None:
+        self._client.close()
+
+
+def run_remote_coworker(
+    ingest_addr: str,
+    make_batches: Callable[[int], Iterator[Dict[str, np.ndarray]]],
+    pod_id: int = 0,
+) -> int:
+    """A CPU pod's main loop: materialize batches and stream them to
+    the training host. Returns the number of batches sent. Exceptions
+    are reported to the consumer as an error-end before re-raising
+    (the master's shard watchdog then re-dispatches any in-flight
+    shard to surviving pods)."""
+    sender = RemoteBatchSender(ingest_addr, pod_id)
+    try:
+        for batch in make_batches(pod_id):
+            sender.push(batch)
+        sender.end()
+        return sender._seq
+    except Exception as exc:  # noqa: BLE001 — report, then re-raise
+        sender.end(error=str(exc)[:500])
+        raise
+    finally:
+        sender.close()

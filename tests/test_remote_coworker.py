@@ -1,0 +1,259 @@
+"""Cross-pod coworker transport: separate OS processes standing in
+for CPU pods stream preprocessed batches over the typed-RPC layer into
+the training host's shm ring (VERDICT r3 item 3; ref
+atorch/data/coworker_dataset.py:16,25-40 — coworker PODS, not sibling
+processes).
+"""
+
+import multiprocessing as mp
+import os
+import signal
+import time
+import uuid
+
+import numpy as np
+import pytest
+
+from dlrover_tpu.data.ingest import BatchIngestServer
+
+
+@pytest.fixture(autouse=True)
+def _isolated_job(monkeypatch):
+    monkeypatch.setenv(
+        "DLROVER_TPU_JOB_NAME", f"pod{uuid.uuid4().hex[:8]}"
+    )
+    yield
+
+
+def _pod_batches(pod_id, n_batches=6, dim=8):
+    for i in range(n_batches):
+        yield {
+            "x": np.full((4, dim), pod_id * 100 + i, np.float32),
+            "ids": np.arange(4, dtype=np.int64) + pod_id * 1000 + i,
+        }
+
+
+def _pod_main(ingest_addr, pod_id, job_name):
+    os.environ["DLROVER_TPU_JOB_NAME"] = job_name
+    from dlrover_tpu.data.ingest import run_remote_coworker
+
+    run_remote_coworker(ingest_addr, _pod_batches, pod_id=pod_id)
+
+
+def _failing_batches(pod_id):
+    yield {"x": np.ones((2, 2), np.float32)}
+    raise RuntimeError("synthetic remote preprocessing failure")
+
+
+def _failing_pod_main(ingest_addr, pod_id, job_name):
+    os.environ["DLROVER_TPU_JOB_NAME"] = job_name
+    from dlrover_tpu.data.ingest import run_remote_coworker
+
+    try:
+        run_remote_coworker(
+            ingest_addr, _failing_batches, pod_id=pod_id
+        )
+    except RuntimeError:
+        pass  # the error-end was already delivered
+
+
+def _fetch(indices):
+    return {
+        "idx": np.asarray(indices, np.int64),
+        "x": np.asarray(indices, np.float32) * 0.5,
+    }
+
+
+def _sharded_pod_main(
+    ingest_addr, master_addr, pod_id, job_name, slow_s
+):
+    os.environ["DLROVER_TPU_JOB_NAME"] = job_name
+    from dlrover_tpu.data.coworker import make_sharded_batches
+    from dlrover_tpu.data.ingest import run_remote_coworker
+
+    base = make_sharded_batches(
+        master_addr, "ds", batch_size=4, fetch_fn=_fetch,
+        node_id=pod_id,
+    )
+
+    def throttled(worker_id):
+        for batch in base(worker_id):
+            if slow_s:
+                time.sleep(slow_s)
+            yield batch
+
+    run_remote_coworker(ingest_addr, throttled, pod_id=pod_id)
+
+
+class TestRemoteIngest:
+    def test_two_pods_stream_all_batches_over_rpc(self):
+        """Every batch from two 'pods' (separate spawn processes,
+        gRPC transport) arrives intact through the training host's
+        ring; throughput is recorded as a sanity number."""
+        ingest = BatchIngestServer(
+            name=f"ing{uuid.uuid4().hex[:6]}",
+            num_slots=4,
+            slot_bytes=1 << 16,
+        ).start()
+        ctx = mp.get_context("spawn")
+        job = os.environ["DLROVER_TPU_JOB_NAME"]
+        pods = [
+            ctx.Process(
+                target=_pod_main, args=(ingest.addr, w, job)
+            )
+            for w in range(2)
+        ]
+        try:
+            t0 = time.time()
+            for p in pods:
+                p.start()
+            got = list(ingest.batches(expected_pods=2, timeout=120))
+            dt = time.time() - t0
+            assert len(got) == 12  # 2 pods x 6 batches
+            # payload integrity: every (pod, i) constant block arrived
+            seen = sorted(float(b["x"][0, 0]) for b in got)
+            want = sorted(
+                float(p * 100 + i) for p in range(2) for i in range(6)
+            )
+            assert seen == want
+            # throughput sanity (includes pod spawn + jax-free import)
+            print(f"remote ingest: {len(got) / dt:.1f} batches/s")
+            assert len(got) / dt > 0.5
+            for p in pods:
+                p.join(timeout=30)
+                assert p.exitcode == 0
+        finally:
+            for p in pods:
+                if p.is_alive():
+                    p.terminate()
+            ingest.stop()
+
+    def test_backpressure_blocks_producer_not_loses_batches(self):
+        """A tiny ring (1 slot) forces accepted=False acks; the pod
+        backs off and retries — nothing is dropped."""
+        ingest = BatchIngestServer(
+            name=f"ing{uuid.uuid4().hex[:6]}",
+            num_slots=1,
+            slot_bytes=1 << 16,
+            put_timeout=0.05,
+        ).start()
+        ctx = mp.get_context("spawn")
+        job = os.environ["DLROVER_TPU_JOB_NAME"]
+        pod = ctx.Process(target=_pod_main, args=(ingest.addr, 0, job))
+        try:
+            pod.start()
+            got = []
+            for batch in ingest.batches(expected_pods=1, timeout=120):
+                got.append(batch)
+                time.sleep(0.1)  # slow consumer
+            assert len(got) == 6
+            assert ingest._rejected > 0  # backpressure actually fired
+            pod.join(timeout=30)
+            assert pod.exitcode == 0
+        finally:
+            if pod.is_alive():
+                pod.terminate()
+            ingest.stop()
+
+    def test_failed_pod_error_end_terminates_stream(self):
+        """A pod whose preprocessing raises reports an error-end; the
+        consumer must treat that as the end of the pod's stream (no
+        one respawns remote pods here) instead of hanging forever."""
+        ingest = BatchIngestServer(
+            name=f"ing{uuid.uuid4().hex[:6]}",
+            num_slots=4,
+            slot_bytes=1 << 16,
+        ).start()
+        ctx = mp.get_context("spawn")
+        job = os.environ["DLROVER_TPU_JOB_NAME"]
+        pod = ctx.Process(
+            target=_failing_pod_main, args=(ingest.addr, 0, job)
+        )
+        try:
+            pod.start()
+            got = list(ingest.batches(expected_pods=1, timeout=60))
+            assert len(got) == 1  # the batch before the crash arrived
+            pod.join(timeout=30)
+        finally:
+            if pod.is_alive():
+                pod.terminate()
+            ingest.stop()
+
+    def test_chaos_killed_pod_shard_redispatched_by_master(self):
+        """The elastic story end to end: two pods pull index shards
+        from a REAL master's dynamic sharding service and stream over
+        RPC; one pod is SIGKILLed mid-stream; the master's timeout
+        watchdog re-dispatches its in-flight shard, the surviving pod
+        drains the dataset, and every sample index arrives at least
+        once."""
+        from dlrover_tpu.agent.master_client import MasterClient
+        from dlrover_tpu.agent.sharding_client import (
+            IndexShardingClient,
+        )
+        from dlrover_tpu.master.master import JobMaster
+
+        master = JobMaster(port=0, node_num=1, rdzv_timeout=2.0)
+        master.prepare()
+        # tight shard-timeout so the kill's doing-shard re-dispatches
+        # within the test budget (watchdog ticks every 15 s)
+        master.task_manager.shard_timeout = 5.0
+        ingest = BatchIngestServer(
+            name=f"ing{uuid.uuid4().hex[:6]}",
+            num_slots=8,
+            slot_bytes=1 << 16,
+        ).start()
+        ctx = mp.get_context("spawn")
+        job = os.environ["DLROVER_TPU_JOB_NAME"]
+        try:
+            setup = IndexShardingClient(
+                "ds", batch_size=4,
+                client=MasterClient(master.addr, node_id=0),
+            )
+            setup.create_dataset(
+                dataset_size=48, batch_size=4,
+                num_minibatches_per_shard=2,
+            )
+            # pod 1 is slow, guaranteeing it holds an in-flight shard
+            # when killed
+            pods = {
+                0: ctx.Process(
+                    target=_sharded_pod_main,
+                    args=(ingest.addr, master.addr, 0, job, 0.0),
+                ),
+                1: ctx.Process(
+                    target=_sharded_pod_main,
+                    args=(ingest.addr, master.addr, 1, job, 0.5),
+                ),
+            }
+            for p in pods.values():
+                p.start()
+
+            seen = []
+            it = ingest.batches(expected_pods=2, timeout=180)
+            killed = False
+            while True:
+                try:
+                    batch = next(it)
+                except StopIteration:
+                    break
+                seen.extend(batch["idx"].tolist())
+                if not killed and len(seen) >= 8:
+                    os.kill(pods[1].pid, signal.SIGKILL)
+                    pods[1].join(timeout=10)
+                    killed = True
+                    # a SIGKILLed pod sends nothing at all: stand in
+                    # for its pod-supervisor and close its stream (an
+                    # error-end would do the same via
+                    # error_ends_stream)
+                    ingest.ring.put_control({"end": 1})
+            assert killed
+            # at-least-once: every index delivered despite the kill
+            assert set(range(48)) <= set(seen)
+            pods[0].join(timeout=30)
+            assert pods[0].exitcode == 0
+        finally:
+            for p in pods.values():
+                if p.is_alive():
+                    p.terminate()
+            ingest.stop()
+            master.stop()
