@@ -225,6 +225,7 @@ def _build_for_strategy(
     devices,
     optimizer_kwargs: Optional[Dict] = None,
     seq_attention_kwargs: Optional[Dict] = None,
+    pipeline_builder: Optional[Callable] = None,
 ):
     mesh_cfg = MeshConfig(**strategy.mesh_dict)
     n_needed = 1
@@ -236,6 +237,19 @@ def _build_for_strategy(
     optimizer = make_optimizer(
         strategy.optimizer, learning_rate, **(optimizer_kwargs or {})
     )
+    if strategy.mesh_dict.get("pipe", 1) > 1:
+        # A pipe axis needs a model-supplied pipeline builder (e.g.
+        # models/gpt_pipeline.GptPipelineBuilder) — the generic GSPMD
+        # step cannot run 1F1B. auto_accelerate filters pipe>1
+        # candidates out of the search when no builder is given, so
+        # reaching here without one is caller error.
+        if pipeline_builder is None:
+            raise ValueError(
+                f"strategy {strategy.name()} has a pipe axis but no "
+                "pipeline_builder was provided"
+            )
+        init, step = pipeline_builder(mesh, strategy, optimizer)
+        return mesh, optimizer, init, step
     init, _ = make_sharded_init(
         mesh, model_init, logical_axes, optimizer
     )
@@ -465,6 +479,7 @@ def auto_accelerate(
     max_dry_runs: int = 6,
     optimizer_kwargs: Optional[Dict] = None,
     seq_attention_kwargs: Optional[Dict] = None,
+    pipeline_builder: Optional[Callable] = None,
 ) -> AccelerateResult:
     """Pick (or apply) a strategy and return the compiled pieces.
 
@@ -476,13 +491,17 @@ def auto_accelerate(
     binding for seq-sharded strategies (e.g. ``{"causal": False}``
     for a non-causal model — the binding assumes a causal LM
     otherwise; see _maybe_bind_seq_attention).
+    ``pipeline_builder(mesh, strategy, optimizer) -> (init_fn,
+    step_fn)`` makes pipe>1 strategies EXECUTABLE (e.g.
+    models/gpt_pipeline.GptPipelineBuilder); without one they are
+    excluded from the search.
     """
     devices = list(devices if devices is not None else jax.devices())
     if strategy is not None:
         mesh, optimizer, init, step = _build_for_strategy(
             strategy, model_init, model_loss, logical_axes,
             learning_rate, devices, optimizer_kwargs,
-            seq_attention_kwargs,
+            seq_attention_kwargs, pipeline_builder,
         )
         return AccelerateResult(
             strategy=strategy,
@@ -498,24 +517,28 @@ def auto_accelerate(
     if candidates is None:
         candidates = candidate_strategies(len(devices))
     # The generic (init, loss) contract gives no stage decomposition,
-    # so the GSPMD step CANNOT execute a pipe axis as 1F1B — it would
-    # replicate across it while the memory model assumes stage-sharded
-    # params. Keep pipe candidates in the GRID (plan mode / explicit
+    # so the GSPMD step cannot execute a pipe axis as 1F1B. With a
+    # model-supplied ``pipeline_builder`` pipe candidates are real;
+    # without one they stay in the GRID (plan mode / explicit
     # strategies / parallel.pipeline users see them) but out of the
-    # dry-run search until a pipeline builder is wired.
-    n_pipe = sum(
-        1 for c in candidates if c.mesh_dict.get("pipe", 1) > 1
-    )
-    if n_pipe:
-        logger.info(
-            "strategy search: excluding %d pipe>1 candidates "
-            "(no pipeline builder for this model; use "
-            "parallel.pipeline.pipeline_train directly)",
-            n_pipe,
+    # dry-run search.
+    if pipeline_builder is None:
+        n_pipe = sum(
+            1 for c in candidates if c.mesh_dict.get("pipe", 1) > 1
         )
-        candidates = [
-            c for c in candidates if c.mesh_dict.get("pipe", 1) == 1
-        ]
+        if n_pipe:
+            logger.info(
+                "strategy search: excluding %d pipe>1 candidates "
+                "(no pipeline_builder for this model; pass one — e.g. "
+                "models/gpt_pipeline.GptPipelineBuilder — to search "
+                "them)",
+                n_pipe,
+            )
+            candidates = [
+                c
+                for c in candidates
+                if c.mesh_dict.get("pipe", 1) == 1
+            ]
     hbm = hbm_bytes if hbm_bytes is not None else (16 << 30)
 
     # Memory gates viability; the roofline over the module profile
@@ -558,7 +581,7 @@ def auto_accelerate(
             build_cache[key] = _build_for_strategy(
                 s, model_init, model_loss, logical_axes,
                 learning_rate, devices, optimizer_kwargs,
-                seq_attention_kwargs,
+                seq_attention_kwargs, pipeline_builder,
             )
         return build_cache[key]
 

@@ -53,12 +53,23 @@ class ShardingClient:
             storage_type=storage_type,
         )
 
-    def get_task(self, wait: bool = True, timeout: float = 300.0):
-        """Returns the next Task or None when the dataset is exhausted."""
+    def get_task(
+        self,
+        wait: bool = True,
+        timeout: float = 300.0,
+        return_wait: bool = False,
+    ):
+        """Returns the next Task or None when the dataset is
+        exhausted. ``return_wait=True`` hands back the WAIT task
+        itself instead of blocking/None, so callers holding
+        deliverables (deferred-completion producers) can flush before
+        the master's wait-for-doing-shards would deadlock them."""
         deadline = time.time() + timeout
         while True:
             task = self._client.get_task(self.dataset_name)
             if task.task_type == TaskType.WAIT:
+                if return_wait:
+                    return task
                 if not wait or time.time() > deadline:
                     return None
                 time.sleep(1.0)
@@ -117,15 +128,29 @@ class IndexShardingClient(ShardingClient):
         self._consumed_unconfirmed: List[int] = []
         self._exhausted = False
 
-    def fetch_sample_index(self) -> Optional[int]:
-        """Next sample index, or None when the dataset is exhausted."""
+    #: fetch_sample_index(block=False) sentinel: the master answered
+    #: WAIT (doing shards may still be re-queued) — the caller should
+    #: flush/confirm anything it holds and retry.
+    WOULD_WAIT = object()
+
+    def fetch_sample_index(self, block: bool = True):
+        """Next sample index; None when the dataset is exhausted.
+
+        ``block=False`` returns :data:`WOULD_WAIT` instead of blocking
+        when the master says WAIT. Deferred-completion producers MUST
+        use this: with ``defer_completion=True`` the un-confirmed
+        shard they still hold is exactly what the master is waiting
+        on, so blocking here would deadlock until the shard timeout
+        re-queues it — and then a stale confirm would mark the
+        re-dispatched copy done with its tail batch undelivered."""
         with self._index_lock:
             if self._indices:
                 self._account_consumed()
                 return self._indices.popleft()
         if self._exhausted:
             return None
-        self._prefetch()
+        if not self._prefetch(block=block):
+            return self.WOULD_WAIT
         with self._index_lock:
             if not self._indices:
                 return None
@@ -175,11 +200,15 @@ class IndexShardingClient(ShardingClient):
             ).start()
         return len(ready)
 
-    def _prefetch(self) -> None:
-        task = self.get_task(wait=True)
+    def _prefetch(self, block: bool = True) -> bool:
+        """Pull one shard into the local queue. Returns False when
+        ``block=False`` and the master answered WAIT."""
+        task = self.get_task(wait=block, return_wait=not block)
+        if task is not None and task.task_type == TaskType.WAIT:
+            return False
         if task is None:
             self._exhausted = True
-            return
+            return True
         shard = task.shard
         if shard.record_indices:
             indices: List[int] = list(shard.record_indices)
@@ -189,6 +218,7 @@ class IndexShardingClient(ShardingClient):
             self._indices.extend(indices)
             self._task_remaining[task.task_id] = len(indices)
             self._current_task_queue.append(task.task_id)
+        return True
 
     def reset(self) -> None:
         with self._index_lock:

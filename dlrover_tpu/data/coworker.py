@@ -123,7 +123,18 @@ def _sharded_batches_main(
     )
     pending: list = []
     while True:
-        idx = shard_client.fetch_sample_index()
+        # Never BLOCK on the sharding service while holding
+        # deliverables: the master's WAIT may be waiting on our own
+        # unconfirmed shard (end-of-dataset with a partial tail batch
+        # would deadlock until the shard timeout, then double-deliver).
+        idx = shard_client.fetch_sample_index(block=False)
+        if idx is shard_client.WOULD_WAIT:
+            if pending:
+                yield fetch_fn(np.asarray(pending, np.int64))
+                pending = []
+            shard_client.confirm_delivered()
+            time.sleep(0.5)
+            continue
         if idx is None:
             if pending:
                 yield fetch_fn(np.asarray(pending, np.int64))
@@ -214,6 +225,7 @@ class CoworkerDataLoader:
         self._procs: Dict[int, mp.Process] = {}
         self._restarts: Dict[int, int] = {}
         self._ended: set = set()
+        self._gave_up: set = set()
         self._stop = threading.Event()
         self._supervisor: Optional[threading.Thread] = None
 
@@ -252,7 +264,11 @@ class CoworkerDataLoader:
         over so iteration can still finish."""
         while not self._stop.wait(0.5):
             for w, p in list(self._procs.items()):
-                if p.is_alive() or w in self._ended:
+                if (
+                    p.is_alive()
+                    or w in self._ended
+                    or w in self._gave_up
+                ):
                     continue
                 if p.exitcode == 0:
                     continue  # clean exit: end control already sent
@@ -270,7 +286,11 @@ class CoworkerDataLoader:
                         "coworker %d exhausted %d restarts; "
                         "ending its stream", w, self.max_restarts,
                     )
-                    self._ended.add(w)
+                    # _gave_up (not _ended) stops the respawn loop;
+                    # the control message is the ONE place the worker
+                    # enters _ended — marking both would make
+                    # drain_batches cry duplicate-producer-id.
+                    self._gave_up.add(w)
                     self._ring.put_control({"end": w, "gave_up": True})
 
     # -- consumption -----------------------------------------------------

@@ -151,7 +151,16 @@ class RemoteBatchSender:
 
     def push(self, batch: Dict[str, np.ndarray]) -> None:
         """Send one batch; blocks (with exponential backoff) while the
-        training host's ring is full."""
+        training host's ring is full.
+
+        Deliberate trade-off: a rejected push re-transmits the whole
+        payload on retry. The alternative — the server parking the
+        request until a slot frees — pins one of its finite RPC
+        worker threads per blocked pod and can starve the lookup/apply
+        traffic sharing the endpoint. The server's ``put_timeout``
+        (default 1 s of in-handler waiting) already absorbs short
+        stalls; persistent backpressure means the consumer is the
+        bottleneck and the re-sends are idle-NIC work."""
         req = msg.DataBatchPush(
             pod_id=self.pod_id,
             seq=self._seq,

@@ -1,0 +1,282 @@
+"""Pipelined GPT training: the full model over the ``pipe`` mesh axis.
+
+The missing piece between parallel/pipeline.py (generic 1F1B over
+uniform-activation stages) and the GPT family: a real transformer has
+an embedding before the uniform block stack and a norm+unembedding
+after it. This module assembles the complete differentiable step the
+way pipelines do it in practice (ref: the reference's PiPPy stage
+split puts embed/head on the edge stages,
+atorch/compilers/pipe_compiler/distributed_pippy_compiler.py):
+
+* the EMBEDDING runs outside the pipeline (data-parallel, replicated
+  over pipe — it is a gather, negligible next to a block); its
+  backward uses the per-microbatch input cotangents the 1F1B schedule
+  collects at logical stage 0 (``collect_input_grads``);
+* the BLOCK STACK — the model's entire FLOPs body — pipelines with
+  the interleaved 1F1B schedule, stage params stacked
+  [n_stages, v_chunks, L/(n*V), ...];
+* the HEAD (final norm + tied unembedding cross-entropy) evaluates at
+  the last logical stage inside the schedule (``with_head``), its
+  gradients psum'd out; the tied ``wte`` gradient is the sum of its
+  embedding-side and head-side contributions.
+
+Losses match the dense ``gpt.loss_fn`` exactly (same math, different
+schedule) — the parity test trains both steps from one init and
+compares trajectories.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import functools
+from typing import Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+import optax
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+from dlrover_tpu.models import gpt
+from dlrover_tpu.parallel.pipeline import (
+    pipeline_train,
+    split_stages_interleaved,
+)
+
+
+def _stage_fn(chunk, x, cfg: gpt.GPTConfig, attn_fn):
+    """One pipeline chunk = a scan over its share of the blocks."""
+
+    def body(h, lp):
+        return gpt._block(h, lp, cfg=cfg, attn_fn=attn_fn), None
+
+    out, _ = jax.lax.scan(body, x, chunk)
+    return out
+
+
+def _head_loss(y, tgt, head, cfg: gpt.GPTConfig):
+    """Final norm + tied unembedding + mean token cross-entropy for
+    ONE microbatch (y [mb, T, E], tgt [mb, T])."""
+    h = gpt._layer_norm(y, head["lnf_g"], head["lnf_b"])
+    logits = (h @ head["wte"].T).astype(jnp.float32)
+    logp = jax.nn.log_softmax(logits, axis=-1)
+    ll = jnp.take_along_axis(logp, tgt[..., None], axis=-1)
+    return -jnp.mean(ll)
+
+
+def split_params(params, n_stages: int, v_chunks: int):
+    """GPT param tree -> (staged_blocks, embed, head)."""
+    staged = split_stages_interleaved(
+        params["blocks"], n_stages, v_chunks
+    )
+    embed = {"wte": params["wte"], "wpe": params["wpe"]}
+    head = {
+        "lnf_g": params["lnf_g"],
+        "lnf_b": params["lnf_b"],
+        "wte": params["wte"],  # tied unembedding
+    }
+    return staged, embed, head
+
+
+def merge_grads(
+    staged_grads, embed_grads, head_grads, n_stages: int,
+    v_chunks: int,
+):
+    """Inverse of :func:`split_params` for gradients: re-stack block
+    grads to the scanned [L, ...] layout and sum the tied wte
+    contributions."""
+    nV = n_stages * v_chunks
+
+    def unstage(g):
+        # [n, V, L/nV, ...] -> [V, n, L/nV, ...] -> [L, ...]
+        q = jnp.swapaxes(g, 0, 1)
+        return q.reshape((-1,) + g.shape[3:])
+
+    blocks = jax.tree.map(unstage, staged_grads)
+    del nV
+    return {
+        "blocks": blocks,
+        "wte": embed_grads["wte"] + head_grads["wte"],
+        "wpe": embed_grads["wpe"],
+        "lnf_g": head_grads["lnf_g"],
+        "lnf_b": head_grads["lnf_b"],
+    }
+
+
+def make_gpt_pipeline_step(
+    mesh: Mesh,
+    cfg: gpt.GPTConfig,
+    optimizer: optax.GradientTransformation,
+    n_micro: Optional[int] = None,
+    v_chunks: int = 1,
+    attn_fn=None,
+    batch_axes: Tuple[str, ...] = ("data", "fsdp"),
+):
+    """Build ``step(params, opt_state, tokens, targets) -> (params,
+    opt_state, metrics)`` training the FULL GPT with its block stack
+    1F1B-pipelined over the mesh's ``pipe`` axis.
+
+    ``params``/``opt_state`` stay in the model's native layout (the
+    same trees the dense step and the flash checkpointer use) — the
+    stage split/merge happens inside the jitted step, so checkpoints
+    and elastic restarts are pipeline-agnostic. ``tokens`` [B, T] is
+    cut into ``n_micro`` microbatches (default 2 * pipe size, the
+    bubble-amortizing 1F1B convention).
+    """
+    n_stages = mesh.shape.get("pipe", 1)
+    if n_micro is None:
+        n_micro = max(2 * n_stages, 1)
+    if cfg.n_layer % (n_stages * v_chunks):
+        raise ValueError(
+            f"n_layer={cfg.n_layer} must divide into "
+            f"pipe({n_stages}) x v_chunks({v_chunks}) stages"
+        )
+    if attn_fn is None:
+        attn_fn = functools.partial(
+            gpt._default_attention, causal=getattr(cfg, "causal", True)
+        )
+    batch_axes = tuple(
+        a for a in batch_axes if mesh.shape.get(a, 1) > 1
+    )
+    batch_spec = P(batch_axes) if batch_axes else P()
+
+    pipe_step = pipeline_train(
+        mesh,
+        functools.partial(_stage_fn, cfg=cfg, attn_fn=attn_fn),
+        functools.partial(_head_loss, cfg=cfg),
+        v_chunks=v_chunks,
+        batch_spec=batch_spec,
+        with_head=True,
+        collect_input_grads=True,
+    )
+
+    def embed(e, toks):
+        T = toks.shape[-1]
+        return (e["wte"][toks] + e["wpe"][:T][None]).astype(cfg.dtype)
+
+    def loss_and_grads(params, tokens, targets):
+        staged, embed_p, head_p = split_params(
+            params, n_stages, v_chunks
+        )
+        B, T = tokens.shape
+        if B % n_micro:
+            raise ValueError(
+                f"batch {B} must divide into {n_micro} microbatches"
+            )
+        mb = B // n_micro
+        toks_mb = tokens.reshape(n_micro, mb, T)
+        tgts_mb = targets.reshape(n_micro, mb, T)
+
+        x0, embed_vjp = jax.vjp(
+            lambda e: jax.vmap(lambda t: embed(e, t))(toks_mb),
+            embed_p,
+        )
+        loss, staged_grads, head_grads, dx0 = pipe_step(
+            staged, x0, tgts_mb, head_p
+        )
+        # dx0 carries per-microbatch cotangents of the UN-meaned
+        # per-microbatch losses; 1/M here restores d(mean)/d(x0).
+        (embed_grads,) = embed_vjp(
+            (dx0 / n_micro).astype(x0.dtype)
+        )
+        grads = merge_grads(
+            staged_grads, embed_grads, head_grads, n_stages, v_chunks
+        )
+        return loss, grads
+
+    def step(params, opt_state, tokens, targets):
+        loss, grads = loss_and_grads(params, tokens, targets)
+        grads = jax.tree.map(
+            lambda g, p: g.astype(p.dtype), grads, params
+        )
+        updates, opt_state = optimizer.update(
+            grads, opt_state, params
+        )
+        params = optax.apply_updates(params, updates)
+        return params, opt_state, {
+            "loss": loss,
+            "grad_norm": optax.global_norm(grads),
+        }
+
+    return jax.jit(step, donate_argnums=(0, 1))
+
+
+def shard_params_for_pipeline(
+    mesh: Mesh, params, n_stages: Optional[int] = None
+):
+    """Device-put a native GPT param tree so each block layer lives on
+    its pipeline stage (leading L axis sharded over ``pipe``) and
+    edge params replicate — the layout the staged step reads without
+    resharding."""
+    if n_stages is None:
+        n_stages = mesh.shape.get("pipe", 1)
+    blocks = jax.tree.map(
+        lambda p: jax.device_put(
+            p, NamedSharding(mesh, P("pipe"))
+        ),
+        params["blocks"],
+    )
+    rep = NamedSharding(mesh, P())
+    out = {
+        k: jax.device_put(v, rep)
+        for k, v in params.items()
+        if k != "blocks"
+    }
+    out["blocks"] = blocks
+    return out
+
+
+def feasible_n_micro(
+    batch: int, pipe: int, batch_shards: int
+) -> Optional[int]:
+    """Largest microbatch count that satisfies the 1F1B constraints
+    for a global ``batch``: a multiple of ``pipe`` dividing the batch,
+    with each microbatch's rows divisible across the batch-sharding
+    axes. Prefers 2*pipe (the bubble-amortizing convention), then the
+    largest feasible; None when nothing fits."""
+    feasible = [
+        m
+        for m in range(pipe, batch + 1, pipe)
+        if batch % m == 0 and (batch // m) % batch_shards == 0
+    ]
+    if not feasible:
+        return None
+    return 2 * pipe if 2 * pipe in feasible else max(feasible)
+
+
+@dataclasses.dataclass
+class GptPipelineBuilder:
+    """auto_accelerate pipeline hook for the GPT family: builds
+    (init_fn, step_fn) for a pipe>1 strategy. See
+    accelerate/api.py's pipe-candidate handling. The microbatch count
+    is derived from the STRATEGY's batch size so generated search
+    candidates (any micro_batch_size x pipe combination) dry-run
+    instead of tripping divisibility errors."""
+
+    cfg: gpt.GPTConfig
+    v_chunks: int = 1
+
+    def __call__(self, mesh, strategy, optimizer):
+        init = functools.partial(gpt.init_params, cfg=self.cfg)
+
+        def init_fn(key):
+            params = shard_params_for_pipeline(mesh, init(key))
+            return params, optimizer.init(params)
+
+        pipe = mesh.shape.get("pipe", 1)
+        batch_shards = mesh.shape.get("data", 1) * mesh.shape.get(
+            "fsdp", 1
+        )
+        n_micro = feasible_n_micro(
+            strategy.micro_batch_size, pipe, batch_shards
+        )
+        if n_micro is None:
+            raise ValueError(
+                f"no feasible microbatch count: batch "
+                f"{strategy.micro_batch_size} over pipe={pipe}, "
+                f"batch shards={batch_shards}"
+            )
+        step = make_gpt_pipeline_step(
+            mesh, self.cfg, optimizer, n_micro=n_micro,
+            v_chunks=self.v_chunks,
+        )
+        return init_fn, step

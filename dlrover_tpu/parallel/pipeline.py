@@ -175,10 +175,12 @@ def _1f1b_body(
     params,        # local [1, V, ...] leaves
     microbatches,  # [M, mb, ...] replicated over pipe
     targets,       # [M, ...] replicated over pipe
+    head_params,   # extra loss-side params (None = plain loss_fn)
     axis_name: str,
     V: int,
     n: int,
     batch_axes: tuple = (),
+    collect_input_grads: bool = False,
 ):
     d = jax.lax.axis_index(axis_name)
     M = microbatches.shape[0]
@@ -213,7 +215,8 @@ def _1f1b_body(
     R = min(M, 4 * n + 4)
 
     def wave(carry, t):
-        y_prev, d_prev, stash, grad_acc, loss_acc = carry
+        (y_prev, d_prev, stash, grad_acc, loss_acc,
+         head_acc, dx_buf) = carry
 
         # ---- forward sub-step -----------------------------------------
         recv = jax.lax.ppermute(y_prev, axis_name, fwd_perm)
@@ -260,10 +263,38 @@ def _1f1b_body(
             ),
             targets,
         )
-        loss_mb, dy_loss = jax.value_and_grad(
-            lambda yy: loss_fn(yy, tgt)
-        )(y_b)
         is_last = jnp.logical_and(d == n - 1, v_b == V - 1)
+        if head_params is None:
+            loss_mb, dy_loss = jax.value_and_grad(
+                lambda yy: loss_fn(yy, tgt)
+            )(y_b)
+            dhead = None
+        else:
+            # The head (norm + unembedding CE for a transformer) can
+            # dwarf a single stage's FLOPs; lax.cond skips its
+            # forward+backward entirely on non-last stages instead of
+            # masking the result to zero afterwards.
+            def _head_branch(args):
+                yy, hp = args
+                return jax.value_and_grad(
+                    lambda y_, h_: loss_fn(y_, tgt, h_),
+                    argnums=(0, 1),
+                )(yy, hp)
+
+            def _skip_branch(args):
+                yy, hp = args
+                return (
+                    jnp.float32(0.0),
+                    (
+                        jnp.zeros_like(yy),
+                        jax.tree.map(jnp.zeros_like, hp),
+                    ),
+                )
+
+            loss_mb, (dy_loss, dhead) = jax.lax.cond(
+                is_last, _head_branch, _skip_branch,
+                (y_b, head_params),
+            )
         dy = jnp.where(is_last, dy_loss, recv_d)
         dp, dx = vjp_fn(dy)
         # jnp.where, NOT multiply-by-mask: bubble waves run stage_fn
@@ -285,8 +316,35 @@ def _1f1b_body(
         loss_acc = loss_acc + jnp.where(
             jnp.logical_and(valid_b, is_last), loss_mb, 0.0
         )
+        if head_acc is not None:
+            take_head = jnp.logical_and(valid_b, is_last)
+            head_acc = jax.tree.map(
+                lambda acc, g: acc
+                + jnp.where(take_head, g.astype(acc.dtype), 0.0),
+                head_acc,
+                dhead,
+            )
+        if dx_buf is not None:
+            # Stage-0 chunk-0 backwards produce d(loss)/d(microbatch):
+            # the caller differentiates its pre-pipeline compute
+            # (e.g. the embedding) with these cotangents.
+            is_first_b = jnp.logical_and(d == 0, v_b == 0)
+            take_dx = jnp.logical_and(valid_b, is_first_b)
+            slot = jnp.clip(mb_b, 0, M - 1)
+            cur = jax.lax.dynamic_index_in_dim(
+                dx_buf, slot, 0, keepdims=False
+            )
+            dx_buf = jax.lax.dynamic_update_index_in_dim(
+                dx_buf,
+                jnp.where(take_dx, dx.astype(dx_buf.dtype), cur),
+                slot,
+                0,
+            )
         d_prev_new = jnp.where(valid_b, dx, jnp.zeros_like(dx))
-        return (y, d_prev_new, stash, grad_acc, loss_acc), None
+        return (
+            y, d_prev_new, stash, grad_acc, loss_acc, head_acc,
+            dx_buf,
+        ), None
 
     y0 = jnp.zeros(y_shape.shape, y_shape.dtype)
     d0 = jnp.zeros(y_shape.shape, y_shape.dtype)
@@ -295,15 +353,46 @@ def _1f1b_body(
     grad0 = jax.tree.map(
         lambda p: jnp.zeros(p.shape, jnp.float32), local_params
     )
-    (y_f, d_f, _, grads, loss), _ = jax.lax.scan(
+    head0 = (
+        None
+        if head_params is None
+        else jax.tree.map(
+            lambda p: jnp.zeros(p.shape, jnp.float32), head_params
+        )
+    )
+    dx0 = (
+        jnp.zeros((M,) + y_shape.shape, jnp.float32)
+        if collect_input_grads
+        else None
+    )
+    (y_f, d_f, _, grads, loss, head_grads, dx_all), _ = jax.lax.scan(
         wave,
-        (y0, d0, stash0, grad0, jnp.float32(0.0)),
+        (y0, d0, stash0, grad0, jnp.float32(0.0), head0, dx0),
         jnp.arange(total_waves),
     )
     # Mean over microbatches; loss lives on the last logical stage
     # only, grads on their own stage — psum the loss, keep grads local.
     loss = jax.lax.psum(loss, axis_name) / M
     grads = jax.tree.map(lambda g: g / M, grads)
+    if head_grads is not None:
+        # Nonzero only on the last logical stage's device: replicate.
+        head_grads = jax.tree.map(
+            lambda g: jax.lax.psum(g, axis_name) / M, head_grads
+        )
+    if dx_all is not None:
+        # Nonzero only on stage-0 devices: replicate across pipe.
+        # Per-microbatch cotangents are NOT divided by M — the caller
+        # applies the same 1/M mean when reducing its pre-pipeline
+        # grads, keeping d(mean loss)/d(input) exact.
+        dx_all = jax.lax.psum(dx_all, axis_name)
+        if batch_axes:
+            # loss_fn normalizes over the SHARD-LOCAL microbatch rows;
+            # the global loss is the pmean over batch shards, so each
+            # shard's input cotangent carries a 1/nshards factor (the
+            # stage grads get this via their pmean below — dx stays
+            # shard-local, so scale it directly).
+            nshards = jax.lax.psum(1, batch_axes)
+            dx_all = dx_all / nshards
     if batch_axes:
         # microbatches are sharded over these axes: each shard saw
         # only its slice, so loss/grads are shard-local means.
@@ -311,7 +400,16 @@ def _1f1b_body(
         grads = jax.tree.map(
             lambda g: jax.lax.pmean(g, batch_axes), grads
         )
-    return loss, jax.tree.map(lambda g: g[None], grads)  # [1, V, ...]
+        if head_grads is not None:
+            head_grads = jax.tree.map(
+                lambda g: jax.lax.pmean(g, batch_axes), head_grads
+            )
+        # dx_all stays shard-local: it is the cotangent of THIS
+        # shard's microbatch slice.
+    out_grads = jax.tree.map(lambda g: g[None], grads)  # [1, V, ...]
+    if head_params is None and not collect_input_grads:
+        return loss, out_grads
+    return loss, out_grads, head_grads, dx_all
 
 
 def pipeline_train(
@@ -322,6 +420,8 @@ def pipeline_train(
     v_chunks: int = 1,
     params_spec: Optional[Any] = None,
     batch_spec: P = P(),
+    with_head: bool = False,
+    collect_input_grads: bool = False,
 ):
     """Builds a 1F1B (``v_chunks=1``) or interleaved-1F1B training
     step: ``step(stage_params, microbatches, targets) -> (loss,
@@ -337,6 +437,19 @@ def pipeline_train(
       ``grads`` are means over all ``M`` microbatches.
     * ``M`` must be a multiple of the ``pipe`` axis size.
 
+    Full-model hooks (how a transformer with an embedding and an
+    unembedding head pipelines its uniform-activation middle):
+
+    * ``with_head=True``: the step takes a fourth argument —
+      replicated loss-side params — and ``loss_fn(y, target,
+      head_params)``; the step returns their mean gradient (psum'd
+      from the last logical stage) as a third output.
+    * ``collect_input_grads=True``: the step also returns
+      d(mean loss)/d(microbatches) * M, the per-microbatch cotangents
+      flowing out of logical stage 0 — the caller backpropagates its
+      pre-pipeline compute (embedding) with them and applies the same
+      1/M mean itself.
+
     Unlike :func:`pipeline_apply` + ``jax.grad`` (GPipe), activation
     stash is O(n_stages * v_chunks) microbatch inputs instead of O(M)
     scan residuals, and the backward schedule starts while forwards
@@ -345,25 +458,47 @@ def pipeline_train(
     n_stages = mesh.shape.get(axis_name, 1)
     if params_spec is None:
         params_spec = P(axis_name)
+    plain = not with_head and not collect_input_grads
 
     if n_stages == 1:
-        def step_single(stage_params, microbatches, targets):
+        def step_single(stage_params, microbatches, targets,
+                        head_params=None):
             local = jax.tree.map(lambda p: p[0], stage_params)
 
-            def whole(params_, mbs):
+            def whole(params_, mbs, hp):
                 def one(mb, tgt):
                     x = mb
                     for v in range(v_chunks):
                         x = stage_fn(
                             jax.tree.map(lambda p: p[v], params_), x
                         )
+                    if with_head:
+                        return loss_fn(x, tgt, hp)
                     return loss_fn(x, tgt)
 
                 losses = jax.vmap(one)(mbs, targets)
                 return jnp.mean(losses)
 
-            loss, grads = jax.value_and_grad(whole)(local, microbatches)
-            return loss, jax.tree.map(lambda g: g[None], grads)
+            argnums = (0,)
+            if collect_input_grads:
+                argnums += (1,)
+            if with_head:
+                argnums += (2,)
+            loss, grad_parts = jax.value_and_grad(
+                whole, argnums=argnums
+            )(local, microbatches, head_params)
+            parts = dict(zip(argnums, grad_parts))
+            out = (loss, jax.tree.map(lambda g: g[None], parts[0]))
+            if plain:
+                return out
+            M = microbatches.shape[0]
+            return out + (
+                parts.get(2) if with_head else None,
+                # match the sharded path's un-meaned convention
+                jax.tree.map(lambda g: g * M, parts[1])
+                if collect_input_grads
+                else None,
+            )
 
         return step_single
 
@@ -380,15 +515,41 @@ def pipeline_train(
         V=v_chunks,
         n=n_stages,
         batch_axes=tuple(batch_axes),
+        collect_input_grads=collect_input_grads,
     )
     mb_spec = P(None, *batch_spec)
-    return shard_map(
-        body,
+    if plain:
+        def body_plain(params, microbatches, targets):
+            return body(params, microbatches, targets, None)
+
+        return shard_map(
+            body_plain,
+            mesh=mesh,
+            in_specs=(params_spec, mb_spec, mb_spec),
+            out_specs=(P(), P(axis_name)),
+            check_vma=False,
+        )
+
+    def body_full(params, microbatches, targets, head_params):
+        return body(params, microbatches, targets, head_params)
+
+    sharded = shard_map(
+        body_full,
         mesh=mesh,
-        in_specs=(params_spec, mb_spec, mb_spec),
-        out_specs=(P(), P(axis_name)),
+        in_specs=(params_spec, mb_spec, mb_spec, P()),
+        out_specs=(
+            P(),
+            P(axis_name),
+            P() if with_head else None,
+            mb_spec if collect_input_grads else None,
+        ),
         check_vma=False,
     )
+
+    def step(stage_params, microbatches, targets, head_params=None):
+        return sharded(stage_params, microbatches, targets, head_params)
+
+    return step
 
 
 def split_stages_interleaved(tree, n_stages: int, v_chunks: int):
