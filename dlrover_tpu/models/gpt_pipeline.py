@@ -37,10 +37,11 @@ import optax
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
 from dlrover_tpu.models import gpt
-from dlrover_tpu.parallel.pipeline import (
-    pipeline_train,
-    split_stages_interleaved,
+from dlrover_tpu.models.pipeline_lm import (
+    feasible_n_micro,
+    make_pipelined_lm_step,
 )
+from dlrover_tpu.parallel.pipeline import split_stages_interleaved
 
 
 def _stage_fn(chunk, x, cfg: gpt.GPTConfig, attn_fn):
@@ -113,18 +114,12 @@ def make_gpt_pipeline_step(
 ):
     """Build ``step(params, opt_state, tokens, targets) -> (params,
     opt_state, metrics)`` training the FULL GPT with its block stack
-    1F1B-pipelined over the mesh's ``pipe`` axis.
-
-    ``params``/``opt_state`` stay in the model's native layout (the
-    same trees the dense step and the flash checkpointer use) — the
-    stage split/merge happens inside the jitted step, so checkpoints
-    and elastic restarts are pipeline-agnostic. ``tokens`` [B, T] is
+    1F1B-pipelined over the mesh's ``pipe`` axis (the generic
+    assembly lives in models/pipeline_lm.py). ``tokens`` [B, T] is
     cut into ``n_micro`` microbatches (default 2 * pipe size, the
     bubble-amortizing 1F1B convention).
     """
     n_stages = mesh.shape.get("pipe", 1)
-    if n_micro is None:
-        n_micro = max(2 * n_stages, 1)
     if cfg.n_layer % (n_stages * v_chunks):
         raise ValueError(
             f"n_layer={cfg.n_layer} must divide into "
@@ -134,70 +129,26 @@ def make_gpt_pipeline_step(
         attn_fn = functools.partial(
             gpt._default_attention, causal=getattr(cfg, "causal", True)
         )
-    batch_axes = tuple(
-        a for a in batch_axes if mesh.shape.get(a, 1) > 1
-    )
-    batch_spec = P(batch_axes) if batch_axes else P()
-
-    pipe_step = pipeline_train(
-        mesh,
-        functools.partial(_stage_fn, cfg=cfg, attn_fn=attn_fn),
-        functools.partial(_head_loss, cfg=cfg),
-        v_chunks=v_chunks,
-        batch_spec=batch_spec,
-        with_head=True,
-        collect_input_grads=True,
-    )
 
     def embed(e, toks):
         T = toks.shape[-1]
         return (e["wte"][toks] + e["wpe"][:T][None]).astype(cfg.dtype)
 
-    def loss_and_grads(params, tokens, targets):
-        staged, embed_p, head_p = split_params(
-            params, n_stages, v_chunks
-        )
-        B, T = tokens.shape
-        if B % n_micro:
-            raise ValueError(
-                f"batch {B} must divide into {n_micro} microbatches"
-            )
-        mb = B // n_micro
-        toks_mb = tokens.reshape(n_micro, mb, T)
-        tgts_mb = targets.reshape(n_micro, mb, T)
-
-        x0, embed_vjp = jax.vjp(
-            lambda e: jax.vmap(lambda t: embed(e, t))(toks_mb),
-            embed_p,
-        )
-        loss, staged_grads, head_grads, dx0 = pipe_step(
-            staged, x0, tgts_mb, head_p
-        )
-        # dx0 carries per-microbatch cotangents of the UN-meaned
-        # per-microbatch losses; 1/M here restores d(mean)/d(x0).
-        (embed_grads,) = embed_vjp(
-            (dx0 / n_micro).astype(x0.dtype)
-        )
-        grads = merge_grads(
-            staged_grads, embed_grads, head_grads, n_stages, v_chunks
-        )
-        return loss, grads
-
-    def step(params, opt_state, tokens, targets):
-        loss, grads = loss_and_grads(params, tokens, targets)
-        grads = jax.tree.map(
-            lambda g, p: g.astype(p.dtype), grads, params
-        )
-        updates, opt_state = optimizer.update(
-            grads, opt_state, params
-        )
-        params = optax.apply_updates(params, updates)
-        return params, opt_state, {
-            "loss": loss,
-            "grad_norm": optax.global_norm(grads),
-        }
-
-    return jax.jit(step, donate_argnums=(0, 1))
+    return make_pipelined_lm_step(
+        mesh,
+        optimizer,
+        split_params=lambda p: split_params(p, n_stages, v_chunks),
+        merge_grads=lambda s, e, h: merge_grads(
+            s, e, h, n_stages, v_chunks
+        ),
+        embed_fn=embed,
+        stage_fn=functools.partial(_stage_fn, cfg=cfg, attn_fn=attn_fn),
+        head_loss_fn=functools.partial(_head_loss, cfg=cfg),
+        n_stages=n_stages,
+        n_micro=n_micro,
+        v_chunks=v_chunks,
+        batch_axes=batch_axes,
+    )
 
 
 def shard_params_for_pipeline(
@@ -223,24 +174,6 @@ def shard_params_for_pipeline(
     }
     out["blocks"] = blocks
     return out
-
-
-def feasible_n_micro(
-    batch: int, pipe: int, batch_shards: int
-) -> Optional[int]:
-    """Largest microbatch count that satisfies the 1F1B constraints
-    for a global ``batch``: a multiple of ``pipe`` dividing the batch,
-    with each microbatch's rows divisible across the batch-sharding
-    axes. Prefers 2*pipe (the bubble-amortizing convention), then the
-    largest feasible; None when nothing fits."""
-    feasible = [
-        m
-        for m in range(pipe, batch + 1, pipe)
-        if batch % m == 0 and (batch // m) % batch_shards == 0
-    ]
-    if not feasible:
-        return None
-    return 2 * pipe if 2 * pipe in feasible else max(feasible)
 
 
 @dataclasses.dataclass

@@ -1,0 +1,183 @@
+"""Pipelined Llama training: the second model family through the
+full-LM 1F1B assembly (models/pipeline_lm.py; GPT twin
+models/gpt_pipeline.py).
+
+Edge placement: token embedding outside the schedule (RoPE needs no
+positional embedding table — cos/sin are compile-time constants baked
+into every stage), the RMSNorm/GQA/SwiGLU block stack pipelined, and
+final RMSNorm + untied lm_head cross-entropy at the last stage.
+
+Dense MLPs only: a MoE block's router aux-loss is a second output
+channel the uniform-activation pipeline contract doesn't carry —
+``make_llama_pipeline_step`` rejects ``n_experts > 0`` rather than
+silently dropping the load-balancing term.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import functools
+from typing import Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+import optax
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+from dlrover_tpu.models import gpt, llama
+from dlrover_tpu.models.pipeline_lm import (
+    feasible_n_micro,
+    make_pipelined_lm_step,
+)
+from dlrover_tpu.parallel.pipeline import split_stages_interleaved
+
+
+def _stage_fn(chunk, x, cfg: llama.LlamaConfig, attn_fn, cos, sin):
+    # The table is built once at block_size; the actual sequence may
+    # be shorter (T is static at trace time, so this slice is free).
+    T = x.shape[1]
+    cos, sin = cos[:T], sin[:T]
+
+    def body(h, lp):
+        h2, _aux = llama._block(
+            h, lp, cfg=cfg, attn_fn=attn_fn, cos=cos, sin=sin
+        )
+        return h2, None
+
+    out, _ = jax.lax.scan(body, x, chunk)
+    return out
+
+
+def _head_loss(y, tgt, head, cfg: llama.LlamaConfig):
+    h = llama._rms_norm(y, head["rmsf"], cfg.rms_eps)
+    logits = jnp.einsum(
+        "...te,ve->...tv", h, head["lm_head"],
+        preferred_element_type=jnp.float32,
+    )
+    logp = jax.nn.log_softmax(logits, axis=-1)
+    ll = jnp.take_along_axis(logp, tgt[..., None], axis=-1)
+    return -jnp.mean(ll)
+
+
+def split_params(params, n_stages: int, v_chunks: int):
+    staged = split_stages_interleaved(
+        params["blocks"], n_stages, v_chunks
+    )
+    embed = {"wte": params["wte"]}
+    head = {"rmsf": params["rmsf"], "lm_head": params["lm_head"]}
+    return staged, embed, head
+
+
+def merge_grads(staged_grads, embed_grads, head_grads):
+    def unstage(g):
+        q = jnp.swapaxes(g, 0, 1)
+        return q.reshape((-1,) + g.shape[3:])
+
+    return {
+        "blocks": jax.tree.map(unstage, staged_grads),
+        "wte": embed_grads["wte"],
+        "rmsf": head_grads["rmsf"],
+        "lm_head": head_grads["lm_head"],
+    }
+
+
+def make_llama_pipeline_step(
+    mesh: Mesh,
+    cfg: llama.LlamaConfig,
+    optimizer: optax.GradientTransformation,
+    n_micro: Optional[int] = None,
+    v_chunks: int = 1,
+    attn_fn=None,
+    batch_axes: Tuple[str, ...] = ("data", "fsdp"),
+):
+    """Full-Llama 1F1B training step (see module doc). Dense MLPs
+    only; params/opt_state stay in the native checkpoint layout."""
+    if cfg.n_experts > 0:
+        raise ValueError(
+            "pipelined Llama supports dense MLPs only: the MoE "
+            "router aux-loss does not fit the uniform-activation "
+            "stage contract (use the GSPMD expert-parallel path)"
+        )
+    n_stages = mesh.shape.get("pipe", 1)
+    if cfg.n_layer % (n_stages * v_chunks):
+        raise ValueError(
+            f"n_layer={cfg.n_layer} must divide into "
+            f"pipe({n_stages}) x v_chunks({v_chunks}) stages"
+        )
+    if attn_fn is None:
+        attn_fn = functools.partial(
+            gpt._default_attention, causal=getattr(cfg, "causal", True)
+        )
+    cos, sin = llama.rope_table(cfg, cfg.block_size)
+
+    def embed(e, toks):
+        return e["wte"][toks].astype(cfg.dtype)
+
+    return make_pipelined_lm_step(
+        mesh,
+        optimizer,
+        split_params=lambda p: split_params(p, n_stages, v_chunks),
+        merge_grads=merge_grads,
+        embed_fn=embed,
+        stage_fn=functools.partial(
+            _stage_fn, cfg=cfg, attn_fn=attn_fn, cos=cos, sin=sin
+        ),
+        head_loss_fn=functools.partial(_head_loss, cfg=cfg),
+        n_stages=n_stages,
+        n_micro=n_micro,
+        v_chunks=v_chunks,
+        batch_axes=batch_axes,
+    )
+
+
+def shard_params_for_pipeline(mesh: Mesh, params):
+    """Block layers onto their pipeline stages, edge params
+    replicated (the Llama twin of
+    gpt_pipeline.shard_params_for_pipeline)."""
+    blocks = jax.tree.map(
+        lambda p: jax.device_put(p, NamedSharding(mesh, P("pipe"))),
+        params["blocks"],
+    )
+    rep = NamedSharding(mesh, P())
+    out = {
+        k: jax.device_put(v, rep)
+        for k, v in params.items()
+        if k != "blocks"
+    }
+    out["blocks"] = blocks
+    return out
+
+
+@dataclasses.dataclass
+class LlamaPipelineBuilder:
+    """auto_accelerate pipeline hook for the Llama family (the GPT
+    twin is gpt_pipeline.GptPipelineBuilder)."""
+
+    cfg: llama.LlamaConfig
+    v_chunks: int = 1
+
+    def __call__(self, mesh, strategy, optimizer):
+        init = functools.partial(llama.init_params, cfg=self.cfg)
+
+        def init_fn(key):
+            params = shard_params_for_pipeline(mesh, init(key))
+            return params, optimizer.init(params)
+
+        pipe = mesh.shape.get("pipe", 1)
+        batch_shards = mesh.shape.get("data", 1) * mesh.shape.get(
+            "fsdp", 1
+        )
+        n_micro = feasible_n_micro(
+            strategy.micro_batch_size, pipe, batch_shards
+        )
+        if n_micro is None:
+            raise ValueError(
+                f"no feasible microbatch count: batch "
+                f"{strategy.micro_batch_size} over pipe={pipe}, "
+                f"batch shards={batch_shards}"
+            )
+        step = make_llama_pipeline_step(
+            mesh, self.cfg, optimizer, n_micro=n_micro,
+            v_chunks=self.v_chunks,
+        )
+        return init_fn, step

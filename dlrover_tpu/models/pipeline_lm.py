@@ -1,0 +1,118 @@
+"""Generic full-LM pipeline assembly over parallel/pipeline.py.
+
+Factors what every pipelined language model shares (the shape PiPPy's
+stage split produces in the reference,
+atorch/compilers/pipe_compiler/distributed_pippy_compiler.py): the
+embedding runs outside the 1F1B schedule (replicated over pipe, its
+backward driven by the collected stage-0 input cotangents), the
+uniform block stack pipelines, and the head loss evaluates at the
+last logical stage with its own gradients. Model families instantiate
+it with their split/embed/stage/head callables —
+models/gpt_pipeline.py and models/llama_pipeline.py are the two
+in-tree users.
+"""
+
+from __future__ import annotations
+
+from typing import Callable, Optional, Tuple
+
+import jax
+import optax
+from jax.sharding import Mesh, PartitionSpec as P
+
+from dlrover_tpu.parallel.pipeline import pipeline_train
+
+
+def make_pipelined_lm_step(
+    mesh: Mesh,
+    optimizer: optax.GradientTransformation,
+    split_params: Callable,   # params -> (staged, embed_p, head_p)
+    merge_grads: Callable,    # (staged_g, embed_g, head_g) -> grads
+    embed_fn: Callable,       # (embed_p, tokens[mb,T]) -> x[mb,T,E]
+    stage_fn: Callable,       # (chunk, x[mb,T,E]) -> y[mb,T,E]
+    head_loss_fn: Callable,   # (y[mb,T,E], tgt[mb,T], head_p) -> loss
+    n_stages: int,
+    n_micro: Optional[int] = None,
+    v_chunks: int = 1,
+    batch_axes: Tuple[str, ...] = ("data", "fsdp"),
+):
+    """Build ``step(params, opt_state, tokens, targets)`` training the
+    full LM with its block stack 1F1B-pipelined. ``params`` and
+    ``opt_state`` stay in the model's native layout (checkpoints and
+    elastic restarts are pipeline-agnostic); the stage split/merge
+    happens inside the jitted step."""
+    if n_micro is None:
+        n_micro = max(2 * n_stages, 1)
+    batch_axes = tuple(
+        a for a in batch_axes if mesh.shape.get(a, 1) > 1
+    )
+    batch_spec = P(batch_axes) if batch_axes else P()
+
+    pipe_step = pipeline_train(
+        mesh,
+        stage_fn,
+        head_loss_fn,
+        v_chunks=v_chunks,
+        batch_spec=batch_spec,
+        with_head=True,
+        collect_input_grads=True,
+    )
+
+    def loss_and_grads(params, tokens, targets):
+        staged, embed_p, head_p = split_params(params)
+        B, T = tokens.shape
+        if B % n_micro:
+            raise ValueError(
+                f"batch {B} must divide into {n_micro} microbatches"
+            )
+        mb = B // n_micro
+        toks_mb = tokens.reshape(n_micro, mb, T)
+        tgts_mb = targets.reshape(n_micro, mb, T)
+
+        x0, embed_vjp = jax.vjp(
+            lambda e: jax.vmap(lambda t: embed_fn(e, t))(toks_mb),
+            embed_p,
+        )
+        loss, staged_grads, head_grads, dx0 = pipe_step(
+            staged, x0, tgts_mb, head_p
+        )
+        # dx0 carries per-microbatch cotangents of the UN-meaned
+        # per-microbatch losses; 1/M here restores d(mean)/d(x0).
+        (embed_grads,) = embed_vjp(
+            (dx0 / n_micro).astype(x0.dtype)
+        )
+        return loss, merge_grads(staged_grads, embed_grads, head_grads)
+
+    def step(params, opt_state, tokens, targets):
+        loss, grads = loss_and_grads(params, tokens, targets)
+        grads = jax.tree.map(
+            lambda g, p: g.astype(p.dtype), grads, params
+        )
+        updates, opt_state = optimizer.update(
+            grads, opt_state, params
+        )
+        params = optax.apply_updates(params, updates)
+        return params, opt_state, {
+            "loss": loss,
+            "grad_norm": optax.global_norm(grads),
+        }
+
+    return jax.jit(step, donate_argnums=(0, 1))
+
+
+def feasible_n_micro(
+    batch: int, pipe: int, batch_shards: int
+) -> Optional[int]:
+    """Largest microbatch count satisfying the 1F1B constraints for a
+    global ``batch``: a multiple of ``pipe`` dividing the batch, with
+    each microbatch's rows divisible across the batch-sharding axes.
+    Prefers 2*pipe (the bubble-amortizing convention), then the
+    largest feasible; None when nothing fits."""
+    feasible = [
+        m
+        for m in range(pipe, batch + 1, pipe)
+        if batch % m == 0 and (batch // m) % batch_shards == 0
+    ]
+    if not feasible:
+        return None
+    return 2 * pipe if 2 * pipe in feasible else max(feasible)
