@@ -55,6 +55,15 @@ def evaluator_node_id(index: int) -> int:
     return EVALUATOR_NODE_ID_BASE + index
 
 
+# Coworker (DATA_WORKER) pods likewise: their pod ids start at 0 and
+# must not merge onto worker/PS/evaluator node-table entries.
+DATA_WORKER_NODE_ID_BASE = 3_000_000
+
+
+def data_worker_node_id(pod_id: int) -> int:
+    return DATA_WORKER_NODE_ID_BASE + pod_id
+
+
 class NodeStatus:
     """Lifecycle states of a node; transitions in common/status_flow.py."""
 

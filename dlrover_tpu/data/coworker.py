@@ -110,8 +110,47 @@ def _sharded_batches_main(
 ):
     from dlrover_tpu.agent.master_client import MasterClient
     from dlrover_tpu.agent.sharding_client import IndexShardingClient
+    from dlrover_tpu.common.constants import (
+        NodeType,
+        data_worker_node_id,
+    )
 
-    client = MasterClient(master_addr, node_id=node_id)
+    client = MasterClient(
+        master_addr, node_id=data_worker_node_id(node_id)
+    )
+    # Register as a DATA_WORKER node and heartbeat: the master's
+    # watchdog then DELETEs a silently-dead pod and recovers its
+    # doing-shards immediately (recover_node_tasks) instead of
+    # waiting out the shard timeout. Best-effort — a master without
+    # node monitoring still redispatches via the watchdog.
+    registered = False
+    try:
+        client.register_node(node_type=NodeType.DATA_WORKER)
+        registered = True
+        # Beat well inside any plausible master heartbeat_timeout
+        # (env-tunable for operators who shorten the watchdog).
+        import os as _os
+
+        beat_s = float(
+            _os.getenv("DLROVER_TPU_COWORKER_HEARTBEAT_S", "1.0")
+        )
+
+        def _beat():
+            while True:
+                time.sleep(beat_s)
+                try:
+                    client.heartbeat()
+                except Exception:  # noqa: BLE001 — best-effort
+                    pass
+
+        threading.Thread(
+            target=_beat, name="coworker-heartbeat", daemon=True
+        ).start()
+    except Exception:  # noqa: BLE001 — registration is optional
+        logger.warning(
+            "data-worker registration failed; relying on shard "
+            "timeouts for failover", exc_info=True,
+        )
     # defer_completion: a shard is reported done only after the batch
     # carrying its last index was handed downstream — the yield
     # resumes once the consumer (shm ring put / remote RPC push)
@@ -139,6 +178,13 @@ def _sharded_batches_main(
             if pending:
                 yield fetch_fn(np.asarray(pending, np.int64))
             shard_client.confirm_delivered()
+            if registered:
+                # Park the node in SUCCEEDED so the watchdog does not
+                # later declare the finished pod dead and relaunch it.
+                try:
+                    client.report_succeeded()
+                except Exception:  # noqa: BLE001 — best-effort
+                    pass
             return
         pending.append(idx)
         if len(pending) >= batch_size:

@@ -103,10 +103,16 @@ class JobMaster:
         self.task_manager.recover_node_tasks(node.id)
         self.speed_monitor.remove_running_node(node.id)
         # Only training-world roles ever entered the rendezvous (the
-        # register path skips evaluators, and PS hosts register via
-        # their own RPC): removing an evaluator here would evict the
-        # WORKER with the same rank from the waiting set.
-        if node.type not in (NodeType.EVALUATOR, NodeType.EMBEDDING):
+        # register path skips evaluators and data workers, and PS
+        # hosts register via their own RPC): removing one here would
+        # evict the WORKER with the same rank from the waiting set —
+        # and a dead DATA_WORKER must never restart the training
+        # fleet; its only cleanup is the shard requeue above.
+        if node.type not in (
+            NodeType.EVALUATOR,
+            NodeType.EMBEDDING,
+            NodeType.DATA_WORKER,
+        ):
             for rdzv in (self.elastic_rdzv, self.check_rdzv):
                 rdzv.remove_alive_node(node.id, node_rank=node.rank)
             # Survivors must not block on collectives with the dead

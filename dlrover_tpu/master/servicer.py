@@ -246,13 +246,15 @@ class MasterServicer:
             node_id=req.node_id if req.node_id >= 0 else None,
             addr=req.node_ip,
         )
-        # Evaluators live outside the training world: they must not
-        # enter the rendezvous alive-sets (their check times would
-        # pollute the worker straggler median) nor the speed monitor's
-        # step accounting.
+        # Evaluators and data workers live outside the training
+        # world: they must not enter the rendezvous alive-sets (their
+        # check times would pollute the worker straggler median) nor
+        # the speed monitor's step accounting.
         from dlrover_tpu.common.constants import NodeType
 
-        if node.type != NodeType.EVALUATOR:
+        if node.type not in (
+            NodeType.EVALUATOR, NodeType.DATA_WORKER
+        ):
             self.speed_monitor.add_running_node(node.id)
             for mgr in self.rdzv_managers.values():
                 mgr.add_alive_node(node.id)

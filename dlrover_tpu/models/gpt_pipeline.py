@@ -27,19 +27,19 @@ compares trajectories.
 
 from __future__ import annotations
 
-import dataclasses
 import functools
 from typing import Optional, Tuple
 
 import jax
 import jax.numpy as jnp
 import optax
-from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+from jax.sharding import Mesh
 
 from dlrover_tpu.models import gpt
 from dlrover_tpu.models.pipeline_lm import (
-    feasible_n_micro,
+    LmPipelineBuilder,
     make_pipelined_lm_step,
+    shard_params_for_pipeline,  # noqa: F401 — re-export (tests/docs)
 )
 from dlrover_tpu.parallel.pipeline import split_stages_interleaved
 
@@ -151,65 +151,18 @@ def make_gpt_pipeline_step(
     )
 
 
-def shard_params_for_pipeline(
-    mesh: Mesh, params, n_stages: Optional[int] = None
-):
-    """Device-put a native GPT param tree so each block layer lives on
-    its pipeline stage (leading L axis sharded over ``pipe``) and
-    edge params replicate — the layout the staged step reads without
-    resharding."""
-    if n_stages is None:
-        n_stages = mesh.shape.get("pipe", 1)
-    blocks = jax.tree.map(
-        lambda p: jax.device_put(
-            p, NamedSharding(mesh, P("pipe"))
-        ),
-        params["blocks"],
-    )
-    rep = NamedSharding(mesh, P())
-    out = {
-        k: jax.device_put(v, rep)
-        for k, v in params.items()
-        if k != "blocks"
-    }
-    out["blocks"] = blocks
-    return out
-
-
-@dataclasses.dataclass
-class GptPipelineBuilder:
-    """auto_accelerate pipeline hook for the GPT family: builds
-    (init_fn, step_fn) for a pipe>1 strategy. See
-    accelerate/api.py's pipe-candidate handling. The microbatch count
-    is derived from the STRATEGY's batch size so generated search
-    candidates (any micro_batch_size x pipe combination) dry-run
-    instead of tripping divisibility errors."""
-
-    cfg: gpt.GPTConfig
-    v_chunks: int = 1
-
-    def __call__(self, mesh, strategy, optimizer):
-        init = functools.partial(gpt.init_params, cfg=self.cfg)
-
-        def init_fn(key):
-            params = shard_params_for_pipeline(mesh, init(key))
-            return params, optimizer.init(params)
-
-        pipe = mesh.shape.get("pipe", 1)
-        batch_shards = mesh.shape.get("data", 1) * mesh.shape.get(
-            "fsdp", 1
-        )
-        n_micro = feasible_n_micro(
-            strategy.micro_batch_size, pipe, batch_shards
-        )
-        if n_micro is None:
-            raise ValueError(
-                f"no feasible microbatch count: batch "
-                f"{strategy.micro_batch_size} over pipe={pipe}, "
-                f"batch shards={batch_shards}"
+def GptPipelineBuilder(
+    cfg: gpt.GPTConfig, v_chunks: int = 1
+) -> LmPipelineBuilder:
+    """auto_accelerate pipeline hook for the GPT family (the generic
+    machinery — strategy-derived microbatch count, stage-sharded
+    init — lives in pipeline_lm.LmPipelineBuilder)."""
+    return LmPipelineBuilder(
+        init_params=functools.partial(gpt.init_params, cfg=cfg),
+        make_step=lambda mesh, opt, n_micro, v: (
+            make_gpt_pipeline_step(
+                mesh, cfg, opt, n_micro=n_micro, v_chunks=v
             )
-        step = make_gpt_pipeline_step(
-            mesh, self.cfg, optimizer, n_micro=n_micro,
-            v_chunks=self.v_chunks,
-        )
-        return init_fn, step
+        ),
+        v_chunks=v_chunks,
+    )

@@ -15,19 +15,19 @@ silently dropping the load-balancing term.
 
 from __future__ import annotations
 
-import dataclasses
 import functools
 from typing import Optional, Tuple
 
 import jax
 import jax.numpy as jnp
 import optax
-from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+from jax.sharding import Mesh
 
 from dlrover_tpu.models import gpt, llama
 from dlrover_tpu.models.pipeline_lm import (
-    feasible_n_micro,
+    LmPipelineBuilder,
     make_pipelined_lm_step,
+    shard_params_for_pipeline,  # noqa: F401 — re-export (tests/docs)
 )
 from dlrover_tpu.parallel.pipeline import split_stages_interleaved
 
@@ -130,54 +130,18 @@ def make_llama_pipeline_step(
     )
 
 
-def shard_params_for_pipeline(mesh: Mesh, params):
-    """Block layers onto their pipeline stages, edge params
-    replicated (the Llama twin of
-    gpt_pipeline.shard_params_for_pipeline)."""
-    blocks = jax.tree.map(
-        lambda p: jax.device_put(p, NamedSharding(mesh, P("pipe"))),
-        params["blocks"],
-    )
-    rep = NamedSharding(mesh, P())
-    out = {
-        k: jax.device_put(v, rep)
-        for k, v in params.items()
-        if k != "blocks"
-    }
-    out["blocks"] = blocks
-    return out
-
-
-@dataclasses.dataclass
-class LlamaPipelineBuilder:
-    """auto_accelerate pipeline hook for the Llama family (the GPT
-    twin is gpt_pipeline.GptPipelineBuilder)."""
-
-    cfg: llama.LlamaConfig
-    v_chunks: int = 1
-
-    def __call__(self, mesh, strategy, optimizer):
-        init = functools.partial(llama.init_params, cfg=self.cfg)
-
-        def init_fn(key):
-            params = shard_params_for_pipeline(mesh, init(key))
-            return params, optimizer.init(params)
-
-        pipe = mesh.shape.get("pipe", 1)
-        batch_shards = mesh.shape.get("data", 1) * mesh.shape.get(
-            "fsdp", 1
-        )
-        n_micro = feasible_n_micro(
-            strategy.micro_batch_size, pipe, batch_shards
-        )
-        if n_micro is None:
-            raise ValueError(
-                f"no feasible microbatch count: batch "
-                f"{strategy.micro_batch_size} over pipe={pipe}, "
-                f"batch shards={batch_shards}"
+def LlamaPipelineBuilder(
+    cfg: llama.LlamaConfig, v_chunks: int = 1
+) -> LmPipelineBuilder:
+    """auto_accelerate pipeline hook for the Llama family (generic
+    machinery in pipeline_lm.LmPipelineBuilder; GPT twin in
+    gpt_pipeline)."""
+    return LmPipelineBuilder(
+        init_params=functools.partial(llama.init_params, cfg=cfg),
+        make_step=lambda mesh, opt, n_micro, v: (
+            make_llama_pipeline_step(
+                mesh, cfg, opt, n_micro=n_micro, v_chunks=v
             )
-        step = make_llama_pipeline_step(
-            mesh, self.cfg, optimizer, n_micro=n_micro,
-            v_chunks=self.v_chunks,
-        )
-        return init_fn, step
+        ),
+        v_chunks=v_chunks,
+    )

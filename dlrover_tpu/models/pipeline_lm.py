@@ -100,6 +100,66 @@ def make_pipelined_lm_step(
     return jax.jit(step, donate_argnums=(0, 1))
 
 
+def shard_params_for_pipeline(
+    mesh: Mesh, params, stacked_key: str = "blocks"
+):
+    """Device-put a native LM param tree so the stacked block subtree
+    lives layer-per-stage (leading axis over ``pipe``) and everything
+    else replicates — the layout the staged step reads without
+    resharding."""
+    from jax.sharding import NamedSharding
+
+    blocks = jax.tree.map(
+        lambda p: jax.device_put(p, NamedSharding(mesh, P("pipe"))),
+        params[stacked_key],
+    )
+    rep = NamedSharding(mesh, P())
+    out = {
+        k: jax.device_put(v, rep)
+        for k, v in params.items()
+        if k != stacked_key
+    }
+    out[stacked_key] = blocks
+    return out
+
+
+class LmPipelineBuilder:
+    """Generic auto_accelerate pipeline hook: derives a feasible
+    microbatch count from each strategy and assembles (init_fn,
+    step_fn). Model families provide ``init_params(key)`` and
+    ``make_step(mesh, optimizer, n_micro, v_chunks)`` — see
+    gpt_pipeline.GptPipelineBuilder / llama_pipeline.
+    LlamaPipelineBuilder for the two in-tree instantiations."""
+
+    def __init__(self, init_params, make_step, v_chunks: int = 1):
+        self.init_params = init_params
+        self.make_step = make_step
+        self.v_chunks = v_chunks
+
+    def __call__(self, mesh, strategy, optimizer):
+        def init_fn(key):
+            params = shard_params_for_pipeline(
+                mesh, self.init_params(key)
+            )
+            return params, optimizer.init(params)
+
+        pipe = mesh.shape.get("pipe", 1)
+        batch_shards = mesh.shape.get("data", 1) * mesh.shape.get(
+            "fsdp", 1
+        )
+        n_micro = feasible_n_micro(
+            strategy.micro_batch_size, pipe, batch_shards
+        )
+        if n_micro is None:
+            raise ValueError(
+                f"no feasible microbatch count: batch "
+                f"{strategy.micro_batch_size} over pipe={pipe}, "
+                f"batch shards={batch_shards}"
+            )
+        step = self.make_step(mesh, optimizer, n_micro, self.v_chunks)
+        return init_fn, step
+
+
 def feasible_n_micro(
     batch: int, pipe: int, batch_shards: int
 ) -> Optional[int]:

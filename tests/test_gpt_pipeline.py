@@ -139,6 +139,35 @@ class TestGptPipelineParity:
             losses.append(float(m["loss"]))
         assert losses[-1] < losses[0] - 0.1
 
+    def test_search_dry_runs_pipe_candidates_with_builder(self):
+        """The search path end to end: with a pipeline_builder, pipe
+        candidates are kept, BUILT, and measured alongside dense ones
+        (previously they were excluded wholesale)."""
+        from dlrover_tpu.accelerate import Strategy, auto_accelerate
+        from dlrover_tpu.models.gpt_pipeline import GptPipelineBuilder
+
+        init = functools.partial(gpt.init_params, cfg=CFG)
+        loss = functools.partial(gpt.loss_fn, cfg=CFG)
+        axes = gpt.param_logical_axes(CFG)
+        cands = [
+            Strategy(mesh_shape=(("data", 4),), micro_batch_size=4,
+                     dtype="float32"),
+            Strategy(mesh_shape=(("data", 2), ("pipe", 2)),
+                     micro_batch_size=4, dtype="float32"),
+        ]
+        tok = jnp.zeros((2, CFG.block_size), jnp.int32)
+        res = auto_accelerate(
+            init, loss, axes, (tok, tok),
+            devices=jax.devices()[:4],
+            candidates=cands,
+            hbm_bytes=1 << 30,
+            activation_bytes_per_sample=1 << 10,
+            pipeline_builder=GptPipelineBuilder(CFG),
+        )
+        ran = [e for e in res.search_log if "samples_per_sec" in e]
+        assert len(ran) == 2, res.search_log  # BOTH were measured
+        assert res.strategy in cands
+
     def test_pipe_without_builder_raises_on_explicit_strategy(self):
         from dlrover_tpu.accelerate import Strategy, auto_accelerate
 

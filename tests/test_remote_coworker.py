@@ -179,6 +179,94 @@ class TestRemoteIngest:
                 pod.terminate()
             ingest.stop()
 
+    def test_killed_pod_recovered_by_heartbeat_watchdog(self):
+        """Registered coworker pods heartbeat as DATA_WORKER nodes:
+        the master's watchdog DELETEs a silently-dead pod and
+        recovers its doing-shards via the node-death path — no need
+        to wait out the (much longer) shard timeout."""
+        from dlrover_tpu.agent.master_client import MasterClient
+        from dlrover_tpu.agent.sharding_client import (
+            IndexShardingClient,
+        )
+        from dlrover_tpu.common.constants import (
+            NodeType,
+            data_worker_node_id,
+        )
+        from dlrover_tpu.master.master import JobMaster
+
+        master = JobMaster(
+            port=0, node_num=1, rdzv_timeout=2.0,
+            heartbeat_timeout=4.0, monitor_interval=1.0,
+        )
+        master.prepare()
+        # shard timeout deliberately huge: only the heartbeat path
+        # can recover within the test budget
+        master.task_manager.shard_timeout = 3600.0
+        ingest = BatchIngestServer(
+            name=f"ing{uuid.uuid4().hex[:6]}",
+            num_slots=8,
+            slot_bytes=1 << 16,
+        ).start()
+        ctx = mp.get_context("spawn")
+        job = os.environ["DLROVER_TPU_JOB_NAME"]
+        try:
+            setup = IndexShardingClient(
+                "ds", batch_size=4,
+                client=MasterClient(master.addr, node_id=0),
+            )
+            setup.create_dataset(
+                dataset_size=32, batch_size=4,
+                num_minibatches_per_shard=2,
+            )
+            pods = {
+                0: ctx.Process(
+                    target=_sharded_pod_main,
+                    args=(ingest.addr, master.addr, 0, job, 0.0),
+                ),
+                1: ctx.Process(
+                    target=_sharded_pod_main,
+                    args=(ingest.addr, master.addr, 1, job, 0.5),
+                ),
+            }
+            for p in pods.values():
+                p.start()
+            node1 = data_worker_node_id(1)
+            # both pods registered as data workers
+            deadline = time.time() + 60
+            while time.time() < deadline:
+                nodes = master.job_manager.list_nodes(
+                    NodeType.DATA_WORKER
+                )
+                if len(nodes) >= 2:
+                    break
+                time.sleep(0.5)
+            assert any(n.id == node1 for n in nodes)
+
+            seen = []
+            it = ingest.batches(expected_pods=2, timeout=120)
+            killed = False
+            while True:
+                try:
+                    batch = next(it)
+                except StopIteration:
+                    break
+                seen.extend(batch["idx"].tolist())
+                if not killed and len(seen) >= 8:
+                    os.kill(pods[1].pid, signal.SIGKILL)
+                    pods[1].join(timeout=10)
+                    killed = True
+                    ingest.ring.put_control({"end": 1})
+            assert killed
+            assert set(range(32)) <= set(seen)
+            pods[0].join(timeout=30)
+            assert pods[0].exitcode == 0
+        finally:
+            for p in pods.values():
+                if p.is_alive():
+                    p.terminate()
+            ingest.stop()
+            master.stop()
+
     def test_chaos_killed_pod_shard_redispatched_by_master(self):
         """The elastic story end to end: two pods pull index shards
         from a REAL master's dynamic sharding service and stream over
