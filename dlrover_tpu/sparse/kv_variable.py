@@ -233,6 +233,15 @@ def _lib() -> ctypes.CDLL:
 _INIT_RANDOM, _INIT_ZEROS, _INIT_CONST = 0, 1, 2
 
 
+def _gather_or_zeros(lib, handle, keys: np.ndarray, dim: int):
+    """Shared non-inserting gather: [n] int64 keys -> [n, dim] f32
+    (zeros for absent keys) from any store handle."""
+    keys = np.ascontiguousarray(keys, np.int64)
+    out = np.empty((keys.size, dim), np.float32)
+    lib.kv_gather_or_zeros(handle, keys, keys.size, out)
+    return out
+
+
 class _Store:
     """RAII over one C++ KvStore."""
 
@@ -281,6 +290,9 @@ class KvVariable:
         )
         # optimizer slot stores, created lazily per optimizer
         self._slots: Dict[str, _Store] = {}
+        # which optimizer last wrote the slots (several families
+        # share the "m"/"v" names with different semantics)
+        self._last_optimizer: Optional[str] = None
         self._seed = seed
         self._num_shards = num_shards
         self._disk_tier_path = disk_tier_path
@@ -328,13 +340,18 @@ class KvVariable:
         """[n] int64 -> [n, dim] f32. train=True inserts missing keys
         (GatherOrInsert); train=False returns zeros (GatherOrZeros)."""
         keys = np.ascontiguousarray(keys, np.int64)
-        out = np.empty((keys.size, self.embedding_dim), np.float32)
-        fn = (
-            self._store._lib.kv_gather_or_insert
-            if train
-            else self._store._lib.kv_gather_or_zeros
-        )
-        fn(self._store.handle, keys.ravel(), keys.size, out)
+        if train:
+            out = np.empty(
+                (keys.size, self.embedding_dim), np.float32
+            )
+            self._store._lib.kv_gather_or_insert(
+                self._store.handle, keys.ravel(), keys.size, out
+            )
+        else:
+            out = _gather_or_zeros(
+                self._store._lib, self._store.handle, keys.ravel(),
+                self.embedding_dim,
+            )
         return out.reshape(keys.shape + (self.embedding_dim,))
 
     def assign(self, keys: np.ndarray, values: np.ndarray, step: int = 0):
@@ -358,6 +375,66 @@ class KvVariable:
                 init_mode,
             )
         return self._slots[slot_name]
+
+    def gather_slot(self, slot_name: str, keys) -> np.ndarray:
+        """[n] int64 -> [n, dim] f32 rows of an optimizer slot store
+        (zeros for keys the optimizer has not touched). Raises on a
+        slot name no optimizer has created — silent zeros would mask
+        typos."""
+        if slot_name not in self._slots:
+            if not self._slots:
+                # no optimizer ran yet: every slot is all-zeros
+                return np.zeros(
+                    (np.asarray(keys).size, self.embedding_dim),
+                    np.float32,
+                )
+            raise KeyError(
+                f"unknown slot {slot_name!r}; existing: "
+                f"{sorted(self._slots)}"
+            )
+        store = self._slots[slot_name]
+        keys = np.ascontiguousarray(keys, np.int64).ravel()
+        return _gather_or_zeros(
+            store._lib, store.handle, keys, self.embedding_dim
+        )
+
+    def adadqh_hypergradients(
+        self,
+        keys,
+        lr: float,
+        step: int,
+        eps: float = 1e-5,
+        beta1: float = 0.9,
+        beta2: float = 0.999,
+    ):
+        """Per-row (lr_hg, eps_hg) for keys trained with the
+        ``adadqh`` family — the sparse surface of the reference's
+        KvVariableComputeAdaDQHHG op (tfplus
+        kv_variable/ops/training_ops.cc), built from the m/v slot
+        rows and the dense hypergradient math
+        (optim/adadqh.py adadqh_hypergradients, finite-diff tested).
+
+        Refuses tables whose slots were written by a different
+        optimizer: adam/lamb/... also keep "m"/"v" slots, but their v
+        tracks raw-gradient moments, not AdaDQH's gradient-difference
+        curvature — hypergradients computed from them would be
+        numerically plausible and semantically wrong."""
+        if self._last_optimizer not in (
+            None, "adadqh", "group_adadqh"
+        ):
+            raise ValueError(
+                "adadqh_hypergradients needs adadqh-family slots; "
+                f"this table was last trained with "
+                f"{self._last_optimizer!r}"
+            )
+        from dlrover_tpu.optim import adadqh_hypergradients
+
+        m = self.gather_slot("m", keys)
+        v = self.gather_slot("v", keys)
+        lr_hg, eps_hg = adadqh_hypergradients(
+            m, v, lr, eps, beta1, beta2, step
+        )
+        return np.asarray(lr_hg), np.asarray(eps_hg)
 
     def _hessian_rows(self, kw, optimizer, keys, ukeys, inv):
         """Validate and dedupe trainer-supplied Hutchinson Hessian-
@@ -396,6 +473,7 @@ class KvVariable:
         ugrads = np.zeros((ukeys.size, self.embedding_dim), np.float32)
         np.add.at(ugrads, inv, grads)
 
+        self._last_optimizer = optimizer
         lib = self._store._lib
         h = self._store.handle
         if optimizer == "adam":

@@ -1010,3 +1010,57 @@ def test_group_lamb_hessian_matches_reference_formula():
     np.testing.assert_allclose(
         kv.gather(keys, train=False), want, atol=1e-5, rtol=1e-4,
     )
+
+
+def test_kv_adadqh_hypergradients_surface():
+    """The sparse twin of ComputeAdaDQHHG: per-row (lr_hg, eps_hg)
+    from the m/v slots, matching the dense (finite-diff tested)
+    construction on the same rows; untouched keys give zeros."""
+    from dlrover_tpu.optim import adadqh_hypergradients
+
+    dim = 8
+    kv = KvVariable("emb", embedding_dim=dim, seed=28)
+    keys = np.array([7, 21], np.int64)
+    grads = np.random.default_rng(12).normal(size=(2, dim)).astype(
+        np.float32
+    )
+    lr, eps, b1, b2 = 1e-2, 1e-5, 0.9, 0.999
+    for step in range(1, 4):
+        kv.apply_gradients(
+            "adadqh", keys, grads, step=step, lr=lr, beta1=b1,
+            beta2=b2, eps=eps,
+        )
+    lr_hg, eps_hg = kv.adadqh_hypergradients(
+        keys, lr=lr, step=4, eps=eps, beta1=b1, beta2=b2
+    )
+    want_lr, want_eps = adadqh_hypergradients(
+        kv.gather_slot("m", keys), kv.gather_slot("v", keys),
+        lr, eps, b1, b2, 4,
+    )
+    np.testing.assert_allclose(lr_hg, np.asarray(want_lr), rtol=1e-6)
+    np.testing.assert_allclose(
+        eps_hg, np.asarray(want_eps), rtol=1e-6
+    )
+    assert np.abs(lr_hg).max() > 0  # trained rows have direction
+
+    # untouched keys: zero hypergradients, no accidental inserts —
+    # the main store AND the slot stores must not grow
+    size_before = len(kv)
+    slot_sizes = {n: len(s) for n, s in kv._slots.items()}
+    z_lr, z_eps = kv.adadqh_hypergradients(
+        np.array([999], np.int64), lr=lr, step=4
+    )
+    np.testing.assert_array_equal(z_lr, np.zeros((1, dim)))
+    np.testing.assert_array_equal(z_eps, np.zeros((1, dim)))
+    assert len(kv) == size_before
+    assert {n: len(s) for n, s in kv._slots.items()} == slot_sizes
+
+    # slots written by a DIFFERENT optimizer are refused, not
+    # silently misinterpreted
+    kv2 = KvVariable("emb2", embedding_dim=dim, seed=29)
+    kv2.apply_gradients("adam", keys, grads, step=1, lr=lr)
+    with pytest.raises(ValueError, match="adadqh-family"):
+        kv2.adadqh_hypergradients(keys, lr=lr, step=2)
+    # misspelled slot names raise instead of returning silent zeros
+    with pytest.raises(KeyError, match="unknown slot"):
+        kv.gather_slot("M", keys)
