@@ -299,6 +299,41 @@ class TestSeqImplDispatch:
             atol=2e-5, rtol=2e-5,
         )
 
+    def test_llama_gqa_composes_with_a2a(self):
+        """The Llama family's grouped-query attention repeats kv heads
+        to full count BEFORE attn_fn, so the a2a family's head
+        constraint sees full heads — the dispatcher must route and
+        match the dense forward."""
+        from dlrover_tpu.models import llama
+        from dlrover_tpu.parallel.seq_attention import (
+            choose_seq_impl,
+            make_seq_attention,
+        )
+
+        cfg = llama.LlamaConfig(
+            vocab_size=64, block_size=32, n_layer=2, n_head=4,
+            n_kv_head=2, n_embd=32, intermediate=64,
+            dtype=jnp.float32, remat=False,
+        )
+        mesh = build_mesh(MeshConfig(data=2, seq=4))
+        assert choose_seq_impl(cfg.n_head, 4, 1) == "a2a"
+        params = llama.init_params(jax.random.PRNGKey(0), cfg)
+        tok = jax.random.randint(
+            jax.random.PRNGKey(1), (2, cfg.block_size), 0,
+            cfg.vocab_size,
+        )
+        tgt = jnp.roll(tok, -1, axis=1)
+        dense = float(llama.loss_fn(params, tok, tgt, cfg=cfg))
+        attn = make_seq_attention(mesh, causal=True)
+        sharded = float(
+            jax.jit(
+                functools.partial(
+                    llama.loss_fn, cfg=cfg, attn_fn=attn
+                )
+            )(params, tok, tgt)
+        )
+        np.testing.assert_allclose(sharded, dense, rtol=2e-5)
+
     def test_explicit_impls_and_validation(self):
         from dlrover_tpu.parallel.seq_attention import make_seq_attention
 
