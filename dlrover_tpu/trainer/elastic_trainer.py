@@ -82,13 +82,22 @@ class ElasticTrainer:
     def __init__(
         self,
         mesh: Mesh,
-        loss_fn: Callable,
+        loss_fn: Optional[Callable],
         optimizer: optax.GradientTransformation,
         global_batch_size: int,
         micro_batch_size: int,
         report_fn: Optional[Callable[[TrainerReport], None]] = None,
         accum_dtype=None,
+        step_fn: Optional[Callable] = None,
     ):
+        """``step_fn``: a prebuilt full-batch training step —
+        ``step_fn(params, opt_state, tokens[B, ...], targets) ->
+        (params, opt_state, metrics)`` — replacing the built-in
+        scan-accumulation step. This is how pipelined training rides
+        the elastic loop: pass a models/pipeline_lm step (its internal
+        1F1B microbatching takes over the role of grad accumulation;
+        the fixed-global-batch contract and per-process batch
+        assembly are unchanged). ``loss_fn`` may be None then."""
         self.mesh = mesh
         self.loss_fn = loss_fn
         self.optimizer = optimizer
@@ -103,18 +112,47 @@ class ElasticTrainer:
         # the tradeoff is bf16's ~8-bit mantissa on the running sum.
         self.accum_dtype = accum_dtype
         self.num_shards = data_shards(mesh)
-        self.accum_steps = gradient_accumulation_steps(
-            global_batch_size, micro_batch_size, self.num_shards
-        )
         self.step_num = 0
-        self._compiled = self._build_step()
+        if step_fn is not None:
+            if loss_fn is not None:
+                raise ValueError(
+                    "pass either loss_fn or step_fn, not both — "
+                    "step_fn would silently win"
+                )
+            # The external step (e.g. a 1F1B pipeline) consumes the
+            # WHOLE global batch in one call and owns its own
+            # microbatching: accumulation collapses to 1, and the
+            # per-shard slice must be exactly micro_batch_size so
+            # [1, global] stays a plain block-sharded batch (an
+            # accum>1 flatten would interleave shard ownership and
+            # force resharding inside the step).
+            if micro_batch_size * self.num_shards != global_batch_size:
+                raise ValueError(
+                    f"step_fn mode needs micro_batch_size "
+                    f"({micro_batch_size}) x batch shards "
+                    f"({self.num_shards}) == global_batch_size "
+                    f"({global_batch_size}); rebuild the trainer "
+                    "with the resized mesh's per-shard batch"
+                )
+            self.accum_steps = 1
+            self._compiled = self._wrap_flat_step(step_fn)
+        else:
+            if loss_fn is None:
+                raise ValueError(
+                    "loss_fn is required without a prebuilt step_fn"
+                )
+            self.accum_steps = gradient_accumulation_steps(
+                global_batch_size, micro_batch_size, self.num_shards
+            )
+            self._compiled = self._build_step()
         logger.info(
             "elastic trainer: %d shards x micro %d x accum %d >= "
-            "global %d",
+            "global %d%s",
             self.num_shards,
             micro_batch_size,
             self.accum_steps,
             global_batch_size,
+            " (external step_fn)" if step_fn is not None else "",
         )
 
     # -- step construction --------------------------------------------------
@@ -166,6 +204,34 @@ class ElasticTrainer:
             return params, opt_state, loss_sum / accum
 
         self._mb_spec = mb_spec
+        return train_step
+
+    def _wrap_flat_step(self, step_fn):
+        """Adapt an external full-batch step to the trainer's
+        [accum, per_shard_batch, ...] microbatch layout: flatten the
+        leading dims back to one batch axis (the external step — e.g.
+        a 1F1B pipeline — owns its own microbatching) and normalize
+        its metrics to the scalar loss the loop reports."""
+        bspec = batch_spec(self.mesh)
+        self._mb_spec = P(None, *bspec)
+
+        @jax.jit
+        def train_step(params, opt_state, tokens, targets):
+            # accum is pinned to 1 in step_fn mode, so this flatten
+            # just drops the leading singleton — the batch dim keeps
+            # its block sharding; jitted so it fuses into the step.
+            flat_tok = tokens.reshape((-1,) + tokens.shape[2:])
+            flat_tgt = targets.reshape((-1,) + targets.shape[2:])
+            params, opt_state, metrics = step_fn(
+                params, opt_state, flat_tok, flat_tgt
+            )
+            loss = (
+                metrics["loss"]
+                if isinstance(metrics, dict)
+                else metrics
+            )
+            return params, opt_state, loss
+
         return train_step
 
     def shard_microbatches(

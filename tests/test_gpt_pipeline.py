@@ -222,6 +222,80 @@ class TestGptPipelineParity:
             resumed, dense[3:], rtol=2e-3, atol=2e-4
         )
 
+    def test_elastic_trainer_drives_pipeline_step(self):
+        """Elastic pipelined training: the ElasticTrainer's fixed
+        global batch + per-process assembly with a 1F1B step plugged
+        in as step_fn (the schedule's microbatching takes over grad
+        accumulation). Trajectory must match the dense ElasticTrainer
+        on the same global batch."""
+        import optax
+
+        from dlrover_tpu.trainer.elastic_trainer import ElasticTrainer
+
+        batches = _batches(4, batch=8, seed=11)
+
+        mesh_d = build_mesh(
+            MeshConfig(data=4), devices=jax.devices()[:4]
+        )
+        opt = optax.adamw(1e-2)
+        dense_tr = ElasticTrainer(
+            mesh_d,
+            functools.partial(gpt.loss_fn, cfg=CFG),
+            opt,
+            global_batch_size=8,
+            micro_batch_size=2,
+        )
+        params = gpt.init_params(jax.random.PRNGKey(0), CFG)
+        opt_state = opt.init(params)
+        dense_losses = []
+        for tok, tgt in batches:
+            params, opt_state, loss = dense_tr.train_step(
+                params, opt_state, np.asarray(tok), np.asarray(tgt)
+            )
+            dense_losses.append(float(loss))
+
+        mesh_p = build_mesh(
+            MeshConfig(data=2, pipe=2), devices=jax.devices()[:4]
+        )
+        pipe_step = make_gpt_pipeline_step(mesh_p, CFG, opt, n_micro=4)
+        pipe_tr = ElasticTrainer(
+            mesh_p,
+            None,
+            opt,
+            global_batch_size=8,
+            micro_batch_size=4,
+            step_fn=pipe_step,
+        )
+        params_p = shard_params_for_pipeline(
+            mesh_p, gpt.init_params(jax.random.PRNGKey(0), CFG)
+        )
+        opt_state_p = opt.init(params_p)
+        pipe_losses = []
+        for tok, tgt in batches:
+            params_p, opt_state_p, loss = pipe_tr.train_step(
+                params_p, opt_state_p, np.asarray(tok),
+                np.asarray(tgt),
+            )
+            pipe_losses.append(float(loss))
+        np.testing.assert_allclose(
+            pipe_losses, dense_losses, rtol=2e-3, atol=2e-4
+        )
+        assert pipe_tr.step_num == dense_tr.step_num == 4
+
+    def test_elastic_trainer_requires_loss_or_step(self):
+        import optax
+
+        from dlrover_tpu.trainer.elastic_trainer import ElasticTrainer
+
+        mesh = build_mesh(
+            MeshConfig(data=4), devices=jax.devices()[:4]
+        )
+        with pytest.raises(ValueError, match="loss_fn"):
+            ElasticTrainer(
+                mesh, None, optax.adamw(1e-2),
+                global_batch_size=8, micro_batch_size=2,
+            )
+
     def test_layer_count_must_divide_stages(self):
         mesh = build_mesh(
             MeshConfig(data=1, pipe=4), devices=jax.devices()[:4]
