@@ -7,10 +7,12 @@ positional embedding table — cos/sin are compile-time constants baked
 into every stage), the RMSNorm/GQA/SwiGLU block stack pipelined, and
 final RMSNorm + untied lm_head cross-entropy at the last stage.
 
-Dense MLPs only: a MoE block's router aux-loss is a second output
-channel the uniform-activation pipeline contract doesn't carry —
-``make_llama_pipeline_step`` rejects ``n_experts > 0`` rather than
-silently dropping the load-balancing term.
+MoE configs are supported through the schedule's ``stage_aux``
+channel: each chunk emits its summed router load-balancing loss,
+which the 1F1B body accumulates across stages, means over
+microbatches, and differentiates (cotangent 1 per valid backward) —
+so the pipelined objective is the microbatched-serial one, never a
+silently-dropped balancing term.
 """
 
 from __future__ import annotations
@@ -33,19 +35,33 @@ from dlrover_tpu.parallel.pipeline import split_stages_interleaved
 
 
 def _stage_fn(chunk, x, cfg: llama.LlamaConfig, attn_fn, cos, sin):
-    # The table is built once at block_size; the actual sequence may
-    # be shorter (T is static at trace time, so this slice is free).
+    # Dense path: same scan, aux discarded (the zero-aux carry is
+    # DCE'd by XLA) — the llama.py backbone/backbone_with_aux pattern.
+    return _stage_fn_aux(
+        chunk, x, cfg=cfg, attn_fn=attn_fn, cos=cos, sin=sin
+    )[0]
+
+
+def _stage_fn_aux(chunk, x, cfg: llama.LlamaConfig, attn_fn, cos,
+                  sin):
+    """MoE variant: also returns this chunk's summed router
+    load-balancing loss (the pipeline's stage_aux channel). The RoPE
+    table is built once at block_size; the actual sequence may be
+    shorter (T is static at trace time, so this slice is free)."""
     T = x.shape[1]
     cos, sin = cos[:T], sin[:T]
 
-    def body(h, lp):
-        h2, _aux = llama._block(
+    def body(carry, lp):
+        h, aux_sum = carry
+        h2, aux = llama._block(
             h, lp, cfg=cfg, attn_fn=attn_fn, cos=cos, sin=sin
         )
-        return h2, None
+        return (h2, aux_sum + aux), None
 
-    out, _ = jax.lax.scan(body, x, chunk)
-    return out
+    (out, aux), _ = jax.lax.scan(
+        body, (x, jnp.zeros((), jnp.float32)), chunk
+    )
+    return out, aux
 
 
 def _head_loss(y, tgt, head, cfg: llama.LlamaConfig):
@@ -90,14 +106,12 @@ def make_llama_pipeline_step(
     attn_fn=None,
     batch_axes: Tuple[str, ...] = ("data", "fsdp"),
 ):
-    """Full-Llama 1F1B training step (see module doc). Dense MLPs
-    only; params/opt_state stay in the native checkpoint layout."""
-    if cfg.n_experts > 0:
-        raise ValueError(
-            "pipelined Llama supports dense MLPs only: the MoE "
-            "router aux-loss does not fit the uniform-activation "
-            "stage contract (use the GSPMD expert-parallel path)"
-        )
+    """Full-Llama 1F1B training step; params/opt_state stay in
+    the native checkpoint layout. MoE configs ride the schedule's
+    stage_aux channel: each chunk also emits its summed router
+    load-balancing loss, added (microbatch-meaned) to the objective
+    and differentiated through — the pipelined twin of
+    backbone_with_aux's per-batch aux sum."""
     n_stages = mesh.shape.get("pipe", 1)
     if cfg.n_layer % (n_stages * v_chunks):
         raise ValueError(
@@ -109,6 +123,8 @@ def make_llama_pipeline_step(
             gpt._default_attention, causal=getattr(cfg, "causal", True)
         )
     cos, sin = llama.rope_table(cfg, cfg.block_size)
+    moe = cfg.n_experts > 0
+    stage = _stage_fn_aux if moe else _stage_fn
 
     def embed(e, toks):
         return e["wte"][toks].astype(cfg.dtype)
@@ -120,13 +136,14 @@ def make_llama_pipeline_step(
         merge_grads=merge_grads,
         embed_fn=embed,
         stage_fn=functools.partial(
-            _stage_fn, cfg=cfg, attn_fn=attn_fn, cos=cos, sin=sin
+            stage, cfg=cfg, attn_fn=attn_fn, cos=cos, sin=sin
         ),
         head_loss_fn=functools.partial(_head_loss, cfg=cfg),
         n_stages=n_stages,
         n_micro=n_micro,
         v_chunks=v_chunks,
         batch_axes=batch_axes,
+        stage_aux=moe,
     )
 
 

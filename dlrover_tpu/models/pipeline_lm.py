@@ -35,6 +35,7 @@ def make_pipelined_lm_step(
     n_micro: Optional[int] = None,
     v_chunks: int = 1,
     batch_axes: Tuple[str, ...] = ("data", "fsdp"),
+    stage_aux: bool = False,
 ):
     """Build ``step(params, opt_state, tokens, targets)`` training the
     full LM with its block stack 1F1B-pipelined. ``params`` and
@@ -56,6 +57,7 @@ def make_pipelined_lm_step(
         batch_spec=batch_spec,
         with_head=True,
         collect_input_grads=True,
+        stage_aux=stage_aux,
     )
 
     def loss_and_grads(params, tokens, targets):

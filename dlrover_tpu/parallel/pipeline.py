@@ -181,6 +181,7 @@ def _1f1b_body(
     n: int,
     batch_axes: tuple = (),
     collect_input_grads: bool = False,
+    stage_aux: bool = False,
 ):
     d = jax.lax.axis_index(axis_name)
     M = microbatches.shape[0]
@@ -209,6 +210,8 @@ def _1f1b_body(
         stage_fn, _chunk_at(local_params, jnp.int32(0), V),
         microbatches[0],
     )
+    if stage_aux:
+        y_shape = y_shape[0]
     # Ring buffer of stashed chunk inputs, per chunk. The in-flight
     # window per chunk is <= ~2n + n sawtooth slack; 4n+4 is safe and
     # still O(n), independent of M (the whole point vs GPipe).
@@ -216,7 +219,7 @@ def _1f1b_body(
 
     def wave(carry, t):
         (y_prev, d_prev, stash, grad_acc, loss_acc,
-         head_acc, dx_buf) = carry
+         head_acc, dx_buf, aux_acc) = carry
 
         # ---- forward sub-step -----------------------------------------
         recv = jax.lax.ppermute(y_prev, axis_name, fwd_perm)
@@ -232,7 +235,15 @@ def _1f1b_body(
         )
         is_first = jnp.logical_and(d == 0, v_f == 0)
         x_in = jnp.where(is_first, inject, recv)
-        y = stage_fn(_chunk_at(local_params, v_f, V), x_in)
+        if stage_aux:
+            y, aux_f = stage_fn(_chunk_at(local_params, v_f, V), x_in)
+            # where, not multiply: bubble waves compute aux on
+            # garbage inputs and 0 * inf would poison the sum
+            aux_acc = aux_acc + jnp.where(
+                valid_f, aux_f.astype(jnp.float32), 0.0
+            )
+        else:
+            y = stage_fn(_chunk_at(local_params, v_f, V), x_in)
 
         slot_f = jnp.clip(v_f, 0, V - 1) * R + mb_f % R
         old = jax.lax.dynamic_index_in_dim(
@@ -256,7 +267,10 @@ def _1f1b_body(
             stash, slot_b, 0, keepdims=False
         )
         chunk_p = _chunk_at(local_params, v_b, V)
-        y_b, vjp_fn = jax.vjp(stage_fn, chunk_p, x_b)
+        if stage_aux:
+            (y_b, _aux_b), vjp_fn = jax.vjp(stage_fn, chunk_p, x_b)
+        else:
+            y_b, vjp_fn = jax.vjp(stage_fn, chunk_p, x_b)
         tgt = jax.tree.map(
             lambda a: jax.lax.dynamic_index_in_dim(
                 a, jnp.clip(mb_b, 0, M - 1), 0, keepdims=False
@@ -296,7 +310,13 @@ def _1f1b_body(
                 (y_b, head_params),
             )
         dy = jnp.where(is_last, dy_loss, recv_d)
-        dp, dx = vjp_fn(dy)
+        if stage_aux:
+            # aux cotangent 1 per VALID backward (un-meaned, same /M
+            # as the grads below): d(total aux)/d(this chunk's aux)
+            daux = jnp.where(valid_b, 1.0, 0.0).astype(jnp.float32)
+            dp, dx = vjp_fn((dy, daux))
+        else:
+            dp, dx = vjp_fn(dy)
         # jnp.where, NOT multiply-by-mask: bubble waves run stage_fn
         # on garbage stash values, and 0 * inf = NaN would poison the
         # accumulator for the rest of the scan.
@@ -343,7 +363,7 @@ def _1f1b_body(
         d_prev_new = jnp.where(valid_b, dx, jnp.zeros_like(dx))
         return (
             y, d_prev_new, stash, grad_acc, loss_acc, head_acc,
-            dx_buf,
+            dx_buf, aux_acc,
         ), None
 
     y0 = jnp.zeros(y_shape.shape, y_shape.dtype)
@@ -365,14 +385,23 @@ def _1f1b_body(
         if collect_input_grads
         else None
     )
-    (y_f, d_f, _, grads, loss, head_grads, dx_all), _ = jax.lax.scan(
+    (
+        y_f, d_f, _, grads, loss, head_grads, dx_all, aux_sum
+    ), _ = jax.lax.scan(
         wave,
-        (y0, d0, stash0, grad0, jnp.float32(0.0), head0, dx0),
+        (
+            y0, d0, stash0, grad0, jnp.float32(0.0), head0, dx0,
+            jnp.float32(0.0),
+        ),
         jnp.arange(total_waves),
     )
     # Mean over microbatches; loss lives on the last logical stage
     # only, grads on their own stage — psum the loss, keep grads local.
     loss = jax.lax.psum(loss, axis_name) / M
+    if stage_aux:
+        # every device accumulated its own chunks' aux; the total is
+        # the cross-pipe sum, meaned over microbatches like the loss
+        loss = loss + jax.lax.psum(aux_sum, axis_name) / M
     grads = jax.tree.map(lambda g: g / M, grads)
     if head_grads is not None:
         # Nonzero only on the last logical stage's device: replicate.
@@ -422,6 +451,7 @@ def pipeline_train(
     batch_spec: P = P(),
     with_head: bool = False,
     collect_input_grads: bool = False,
+    stage_aux: bool = False,
 ):
     """Builds a 1F1B (``v_chunks=1``) or interleaved-1F1B training
     step: ``step(stage_params, microbatches, targets) -> (loss,
@@ -449,6 +479,10 @@ def pipeline_train(
       flowing out of logical stage 0 — the caller backpropagates its
       pre-pipeline compute (embedding) with them and applies the same
       1/M mean itself.
+    * ``stage_aux=True``: ``stage_fn`` returns ``(y, aux)`` with a
+      scalar auxiliary loss per chunk (MoE router load-balancing);
+      the step's loss adds the cross-stage, microbatch-meaned aux sum
+      and differentiates through it (cotangent 1 per valid backward).
 
     Unlike :func:`pipeline_apply` + ``jax.grad`` (GPipe), activation
     stash is O(n_stages * v_chunks) microbatch inputs instead of O(M)
@@ -468,13 +502,22 @@ def pipeline_train(
             def whole(params_, mbs, hp):
                 def one(mb, tgt):
                     x = mb
+                    aux_total = jnp.float32(0.0)
                     for v in range(v_chunks):
-                        x = stage_fn(
-                            jax.tree.map(lambda p: p[v], params_), x
+                        chunk = jax.tree.map(
+                            lambda p: p[v], params_
                         )
-                    if with_head:
-                        return loss_fn(x, tgt, hp)
-                    return loss_fn(x, tgt)
+                        if stage_aux:
+                            x, aux = stage_fn(chunk, x)
+                            aux_total = aux_total + aux
+                        else:
+                            x = stage_fn(chunk, x)
+                    base = (
+                        loss_fn(x, tgt, hp)
+                        if with_head
+                        else loss_fn(x, tgt)
+                    )
+                    return base + aux_total
 
                 losses = jax.vmap(one)(mbs, targets)
                 return jnp.mean(losses)
@@ -516,6 +559,7 @@ def pipeline_train(
         n=n_stages,
         batch_axes=tuple(batch_axes),
         collect_input_grads=collect_input_grads,
+        stage_aux=stage_aux,
     )
     mb_spec = P(None, *batch_spec)
     if plain:
