@@ -154,31 +154,58 @@ class TestLlamaPipelineParity:
         np.testing.assert_allclose(
             float(m["loss"]), want, rtol=2e-4
         )
-        # aux actually contributes (nonzero router loss)
-        dense_ce_only = float(
-            np.mean(
-                [
-                    -np.mean(
-                        np.take_along_axis(
-                            np.asarray(
-                                jax.nn.log_softmax(
-                                    llama.forward(
-                                        ref_params,
-                                        tok[i : i + 2],
-                                        cfg=moe_cfg,
-                                    ),
-                                    axis=-1,
-                                )
-                            ),
-                            np.asarray(tgt[i : i + 2])[..., None],
-                            axis=-1,
-                        )
-                    )
-                    for i in range(0, 8, 2)
-                ]
+        # aux actually contributes: the model's own backbone reports
+        # a nonzero summed router loss on these microbatches
+        aux_terms = [
+            float(
+                llama.backbone_with_aux(
+                    ref_params, tok[i : i + 2], cfg=moe_cfg
+                )[1]
             )
+            for i in range(0, 8, 2)
+        ]
+        assert min(aux_terms) > 0
+
+    def test_moe_aux_single_stage_fallback(self):
+        """pipe=1 exercises step_single's aux path — the router term
+        must not silently vanish on an unpipelined mesh."""
+        moe_cfg = llama.LlamaConfig(
+            vocab_size=64, block_size=16, n_layer=4, n_head=4,
+            n_kv_head=2, n_embd=32, intermediate=64,
+            dtype=jnp.float32, remat=False, n_experts=4,
         )
-        assert float(m["loss"]) > dense_ce_only  # aux term present
+        mesh = build_mesh(
+            MeshConfig(data=4), devices=jax.devices()[:4]
+        )
+        opt = optax.adamw(1e-2)
+        params = shard_params_for_pipeline(
+            mesh, llama.init_params(jax.random.PRNGKey(0), moe_cfg)
+        )
+        opt_state = opt.init(params)
+        step = make_llama_pipeline_step(
+            mesh, moe_cfg, opt, n_micro=4
+        )
+        tok = jax.random.randint(
+            jax.random.PRNGKey(7), (8, moe_cfg.block_size), 0,
+            moe_cfg.vocab_size,
+        )
+        tgt = jnp.roll(tok, -1, axis=1)
+        ref_params = llama.init_params(jax.random.PRNGKey(0), moe_cfg)
+        # pipe=1 runs step_single (plain jit, no shard_map): the loss
+        # is computed per FULL microbatch, so the serial reference is
+        # the mean over the same mb=2 microbatches.
+        losses = [
+            float(
+                llama.loss_fn(
+                    ref_params, tok[i : i + 2], tgt[i : i + 2],
+                    cfg=moe_cfg,
+                )
+            )
+            for i in range(0, 8, 2)
+        ]
+        want = float(np.mean(losses))
+        _, _, m = step(params, opt_state, tok, tgt)
+        np.testing.assert_allclose(float(m["loss"]), want, rtol=2e-4)
 
     def test_moe_aux_interleaved_and_batch_sharded(self):
         """The aux channel's other schedule paths: interleaved chunks

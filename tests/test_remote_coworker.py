@@ -196,7 +196,11 @@ class TestRemoteIngest:
 
         master = JobMaster(
             port=0, node_num=1, rdzv_timeout=2.0,
-            heartbeat_timeout=4.0, monitor_interval=1.0,
+            # 8x the 1 s beat cadence: a loaded single-core CI machine
+            # can starve a pod's beat thread for seconds — a falsely
+            # killed LIVE pod is harmless for at-least-once but fails
+            # the exitcode assert below.
+            heartbeat_timeout=8.0, monitor_interval=1.0,
         )
         master.prepare()
         # shard timeout deliberately huge: only the heartbeat path
