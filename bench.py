@@ -169,6 +169,9 @@ def measure() -> int:
                 "value": round(per_chip, 1),
                 "unit": "tokens/s/chip",
                 "vs_baseline": round(vs_baseline, 4),
+                # Raw MFU vs nominal peak, so the tokens/s value and the
+                # HFU-normalized ratio can never be conflated downstream.
+                "mfu": round(mfu, 4),
             }
         )
     )

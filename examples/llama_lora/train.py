@@ -25,6 +25,12 @@ import os
 import sys
 import time
 
+# Running as a script puts examples/llama_lora (not the repo root)
+# first on sys.path; fix up here rather than via PYTHONPATH, which
+# breaks the axon plugin's jax_plugins discovery (tools/_repo_path).
+sys.path.insert(0, os.path.dirname(os.path.dirname(
+    os.path.dirname(os.path.abspath(__file__)))))
+
 # CPU-mesh by default (the env may preset a TPU platform; the tiny
 # preset is a smoke run). Pass --tpu to use the ambient platform.
 if "--tpu" not in sys.argv:

@@ -16,6 +16,12 @@ import argparse
 import os
 import sys
 
+# Running as a script puts examples/nanogpt (not the repo root) first
+# on sys.path; fix up here rather than via PYTHONPATH, which breaks
+# the axon plugin's jax_plugins discovery (see tools/_repo_path).
+sys.path.insert(0, os.path.dirname(os.path.dirname(
+    os.path.dirname(os.path.abspath(__file__)))))
+
 if "--tpu" not in sys.argv:
     os.environ["JAX_PLATFORMS"] = "cpu"
 

@@ -27,6 +27,12 @@ import time
 
 import numpy as np
 
+# Running as a script puts examples/nanogpt (not the repo root) first
+# on sys.path; fix up here rather than via PYTHONPATH, which breaks
+# the axon plugin's jax_plugins discovery (see tools/_repo_path).
+sys.path.insert(0, os.path.dirname(os.path.dirname(
+    os.path.dirname(os.path.abspath(__file__)))))
+
 
 def parse_args(argv=None):
     p = argparse.ArgumentParser()
