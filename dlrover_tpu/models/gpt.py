@@ -163,16 +163,29 @@ def use_fused_norm(cfg) -> bool:
     return jax.default_backend() == "tpu"
 
 
-def _default_attention(q, k, v, causal=True):
+def _default_attention(q, k, v, causal=True, window=None):
     """Plain fused attention (single-shard fallback; the sharded path
-    comes from parallel.ring_attention.make_sharded_attention)."""
+    comes from parallel.ring_attention.make_sharded_attention).
+    ``window`` applies the same Mistral-style sliding-window band as
+    the flash kernel (query i sees keys (i-window, i])."""
+    if window is not None and not causal:
+        # Same contract as flash_attention: a one-sided band with
+        # bidirectional attention would mean different models per
+        # backend, not a graceful fallback.
+        raise ValueError(
+            "window (sliding-window attention) requires causal=True"
+        )
     b, lq, h, d = q.shape
     s = jnp.einsum(
         "bqhd,bkhd->bhqk", q, k, preferred_element_type=jnp.float32
     ) / np.sqrt(d)
-    if causal:
+    if causal or window is not None:
         pos = jnp.arange(lq)
-        mask = pos[:, None] >= pos[None, :]
+        mask = jnp.ones((lq, lq), bool)
+        if causal:
+            mask &= pos[:, None] >= pos[None, :]
+        if window is not None:
+            mask &= (pos[:, None] - pos[None, :]) < window
         s = jnp.where(mask[None, None], s, -1e30)
     p = jax.nn.softmax(s, axis=-1).astype(v.dtype)
     return jnp.einsum("bhqk,bkhd->bqhd", p, v)
@@ -236,11 +249,16 @@ def default_attention_for(cfg: GPTConfig) -> Callable:
             jax.default_backend() == "tpu" and cfg.block_size >= 512
         )
     causal = getattr(cfg, "causal", True)
+    window = getattr(cfg, "sliding_window", None)
     if use_flash:
         from dlrover_tpu.ops.flash_attention import flash_attention
 
-        return functools.partial(flash_attention, causal=causal)
-    return functools.partial(_default_attention, causal=causal)
+        return functools.partial(
+            flash_attention, causal=causal, window=window
+        )
+    return functools.partial(
+        _default_attention, causal=causal, window=window
+    )
 
 
 def backbone(

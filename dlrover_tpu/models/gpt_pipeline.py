@@ -127,7 +127,9 @@ def make_gpt_pipeline_step(
         )
     if attn_fn is None:
         attn_fn = functools.partial(
-            gpt._default_attention, causal=getattr(cfg, "causal", True)
+            gpt._default_attention,
+            causal=getattr(cfg, "causal", True),
+            window=getattr(cfg, "sliding_window", None),
         )
 
     def embed(e, toks):

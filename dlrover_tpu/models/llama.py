@@ -64,6 +64,11 @@ class LlamaConfig:
     n_experts: int = 0
     moe_top_k: int = 2
     moe_capacity_factor: float = 1.25
+    # Mistral-style sliding-window attention: query i sees keys
+    # (i-sliding_window, i]. None = full causal attention. The flash
+    # kernel skips kv blocks entirely below the band (O(T*window)
+    # work); the plain fallback applies the same band mask.
+    sliding_window: Optional[int] = None
 
     @property
     def head_dim(self) -> int:
@@ -110,6 +115,22 @@ class LlamaConfig:
             intermediate=128,
             dtype=jnp.float32,
             remat=False,
+        )
+
+    @staticmethod
+    def mistral_7b() -> "LlamaConfig":
+        """Mistral-7B-v0.1: Llama backbone + GQA + 4k sliding window
+        over an 8k context."""
+        return LlamaConfig(
+            vocab_size=32000,
+            block_size=8192,
+            n_layer=32,
+            n_head=32,
+            n_kv_head=8,
+            n_embd=4096,
+            intermediate=14336,
+            rope_theta=10000.0,
+            sliding_window=4096,
         )
 
     @staticmethod

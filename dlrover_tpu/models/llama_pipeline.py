@@ -120,7 +120,9 @@ def make_llama_pipeline_step(
         )
     if attn_fn is None:
         attn_fn = functools.partial(
-            gpt._default_attention, causal=getattr(cfg, "causal", True)
+            gpt._default_attention,
+            causal=getattr(cfg, "causal", True),
+            window=getattr(cfg, "sliding_window", None),
         )
     cos, sin = llama.rope_table(cfg, cfg.block_size)
     moe = cfg.n_experts > 0

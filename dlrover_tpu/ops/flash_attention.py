@@ -56,10 +56,11 @@ def _compiler_params(semantics):
         return pltpu.CompilerParams()
 
 
-def _block_mask(iq, jk, block_q, block_k, causal, seq_len, pad):
+def _block_mask(iq, jk, block_q, block_k, causal, seq_len, pad,
+                window):
     """Mask for block (iq, jk) — only called for blocks that cross the
-    diagonal or the padding edge; interior blocks never generate
-    iotas/compares."""
+    diagonal, the sliding-window band edge, or the padding edge;
+    interior blocks never generate iotas/compares."""
     q_pos = iq * block_q + jax.lax.broadcasted_iota(
         jnp.int32, (block_q, block_k), 0
     )
@@ -72,15 +73,22 @@ def _block_mask(iq, jk, block_q, block_k, causal, seq_len, pad):
     if causal:
         cm = q_pos >= k_pos
         mask = cm if mask is None else jnp.logical_and(mask, cm)
+    if window is not None:
+        # Sliding window: query i sees keys (i-window, i] — `window`
+        # keys including itself (Mistral convention).
+        wm = (q_pos - k_pos) < window
+        mask = wm if mask is None else jnp.logical_and(mask, wm)
     return mask
 
 
 def _dispatch_block(iq, jk, accumulate, *, causal, pad, block_q,
-                    block_k, seq_len):
+                    block_k, seq_len, window):
     """Run ``accumulate(masked=...)`` for block (iq, jk), skipping
-    fully-future causal blocks and masking only blocks that cross the
-    diagonal or the padding edge."""
-    if not causal and not pad:
+    fully-future causal blocks and blocks entirely below the sliding
+    window band, masking only blocks that cross the diagonal, the
+    band edge, or the padding edge — so windowed attention does
+    O(T*window) MXU work, not O(T^2)."""
+    if not causal and not pad and window is None:
         accumulate(masked=False)
         return
     if causal:
@@ -90,8 +98,26 @@ def _dispatch_block(iq, jk, accumulate, *, causal, pad, block_q,
         run = True
         crosses_diag = False
     crosses_pad = ((jk * block_k + block_k) > seq_len) if pad else False
+    crosses_band = False
+    if window is not None:
+        # Lowest visible key for any row in this q block is
+        # (iq*block_q) - window + 1 (the FIRST row's band start); the
+        # block is dead when even its last key is below that.
+        run = jnp.logical_and(
+            run,
+            (jk * block_k + block_k - 1) >= (iq * block_q - window + 1),
+        )
+        # The LAST row's band start is the highest; any key below it
+        # needs the element mask.
+        crosses_band = (
+            (jk * block_k)
+            < (iq * block_q + block_q - 1 - window + 1)
+        )
     needs_mask = jnp.logical_and(
-        run, jnp.logical_or(crosses_diag, crosses_pad)
+        run,
+        jnp.logical_or(
+            jnp.logical_or(crosses_diag, crosses_pad), crosses_band
+        ),
     )
     fast = jnp.logical_and(run, jnp.logical_not(needs_mask))
 
@@ -121,6 +147,7 @@ def _fwd_kernel(
     *,
     scale: float,
     causal: bool,
+    window,
     block_q: int,
     block_k: int,
     num_kv: int,
@@ -149,7 +176,7 @@ def _fwd_kernel(
             s = s * scale
         if masked:
             mask = _block_mask(
-                iq, jk, block_q, block_k, causal, seq_len, pad
+                iq, jk, block_q, block_k, causal, seq_len, pad, window
             )
             s = jnp.where(mask, s, NEG_INF)
 
@@ -158,9 +185,12 @@ def _fwd_kernel(
         m_new = jnp.maximum(m_prev, jnp.max(s, axis=1, keepdims=True))
         alpha = jnp.exp(m_prev - m_new)  # (block_q, 1): 1-lane exps
         p = jnp.exp(s - m_new)
-        if masked and pad:
-            # Only padding can leave a row with no unmasked key (m_new
-            # = NEG_INF -> exp(0) = 1); under pure causal masking every
+        if masked and (pad or window is not None):
+            # Padding — and sliding windows — can leave a row with no
+            # unmasked key in an executed block (m_new = NEG_INF ->
+            # exp(0) = 1): under a window, a row's band may start in a
+            # later kv block than the first one the block-level skip
+            # admits for its q block. Under pure causal masking every
             # executed row has a finite m_new, so exp(NEG_INF - m_new)
             # already underflows to exactly 0 and the select is waste.
             p = jnp.where(mask, p, 0.0)
@@ -175,7 +205,7 @@ def _fwd_kernel(
 
     _dispatch_block(
         iq, jk, _accumulate, causal=causal, pad=pad, block_q=block_q,
-        block_k=block_k, seq_len=seq_len,
+        block_k=block_k, seq_len=seq_len, window=window,
     )
 
     @pl.when(jk == num_kv - 1)
@@ -188,7 +218,8 @@ def _fwd_kernel(
         lse_ref[0, 0] = m_scr[:] + jnp.log(l_safe)
 
 
-def _fwd(q, k, v, causal, scale, block_q, block_k, seq_len, interpret):
+def _fwd(q, k, v, causal, window, scale, block_q, block_k, seq_len,
+         interpret):
     """q/k/v: [B, H, T, D] (T padded to block multiple). Returns
     (o [B,H,T,D], lse [B,H,T,1]). ``seq_len`` is the true length:
     keys beyond it are masked out."""
@@ -199,6 +230,7 @@ def _fwd(q, k, v, causal, scale, block_q, block_k, seq_len, interpret):
         _fwd_kernel,
         scale=scale,
         causal=causal,
+        window=window,
         block_q=block_q,
         block_k=block_k,
         num_kv=num_kv,
@@ -259,6 +291,7 @@ def _bwd_kernel(
     *,
     scale: float,
     causal: bool,
+    window,
     block_q: int,
     block_k: int,
     num_q: int,
@@ -293,7 +326,7 @@ def _bwd_kernel(
         p = jnp.exp(s - lse)
         if masked:
             mask = _block_mask(
-                iq, jk, block_q, block_k, causal, seq_len, pad
+                iq, jk, block_q, block_k, causal, seq_len, pad, window
             )
             p = jnp.where(mask, p, 0.0)
         pt = p.astype(do.dtype)
@@ -329,7 +362,7 @@ def _bwd_kernel(
 
     _dispatch_block(
         iq, jk, _accumulate, causal=causal, pad=pad, block_q=block_q,
-        block_k=block_k, seq_len=seq_len,
+        block_k=block_k, seq_len=seq_len, window=window,
     )
 
     @pl.when(iq == num_q - 1)
@@ -343,8 +376,8 @@ def _bwd_kernel(
 
 
 def _bwd(
-    q, k, v, o, lse, do, causal, scale, block_q, block_k, seq_len,
-    interpret, g_lse=None,
+    q, k, v, o, lse, do, causal, window, scale, block_q, block_k,
+    seq_len, interpret, g_lse=None,
 ):
     b, h, t, d = q.shape
     num_q = t // block_q
@@ -364,6 +397,7 @@ def _bwd(
         _bwd_kernel,
         scale=scale,
         causal=causal,
+        window=window,
         block_q=block_q,
         block_k=block_k,
         num_q=num_q,
@@ -419,28 +453,30 @@ def _bwd(
 
 
 @functools.partial(
-    jax.custom_vjp, nondiff_argnums=(3, 4, 5, 6, 7, 8, 9, 10)
+    jax.custom_vjp, nondiff_argnums=(3, 4, 5, 6, 7, 8, 9, 10, 11)
 )
-def _flash(q, k, v, causal, scale, block_q, block_k, block_q_bwd,
-           block_k_bwd, seq_len, interpret):
-    o, _ = _fwd(q, k, v, causal, scale, block_q, block_k, seq_len, interpret)
+def _flash(q, k, v, causal, window, scale, block_q, block_k,
+           block_q_bwd, block_k_bwd, seq_len, interpret):
+    o, _ = _fwd(q, k, v, causal, window, scale, block_q, block_k,
+                seq_len, interpret)
     return o
 
 
-def _flash_fwd(q, k, v, causal, scale, block_q, block_k, block_q_bwd,
-               block_k_bwd, seq_len, interpret):
+def _flash_fwd(q, k, v, causal, window, scale, block_q, block_k,
+               block_q_bwd, block_k_bwd, seq_len, interpret):
     o, lse = _fwd(
-        q, k, v, causal, scale, block_q, block_k, seq_len, interpret
+        q, k, v, causal, window, scale, block_q, block_k, seq_len,
+        interpret
     )
     return o, (q, k, v, o, lse)
 
 
-def _flash_bwd(causal, scale, block_q, block_k, block_q_bwd,
+def _flash_bwd(causal, window, scale, block_q, block_k, block_q_bwd,
                block_k_bwd, seq_len, interpret, res, g):
     q, k, v, o, lse = res
     return _bwd(
-        q, k, v, o, lse, g, causal, scale, block_q_bwd, block_k_bwd,
-        seq_len, interpret,
+        q, k, v, o, lse, g, causal, window, scale, block_q_bwd,
+        block_k_bwd, seq_len, interpret,
     )
 
 
@@ -448,33 +484,36 @@ _flash.defvjp(_flash_fwd, _flash_bwd)
 
 
 @functools.partial(
-    jax.custom_vjp, nondiff_argnums=(3, 4, 5, 6, 7, 8, 9, 10)
+    jax.custom_vjp, nondiff_argnums=(3, 4, 5, 6, 7, 8, 9, 10, 11)
 )
-def _flash_lse(q, k, v, causal, scale, block_q, block_k, block_q_bwd,
-               block_k_bwd, seq_len, interpret):
+def _flash_lse(q, k, v, causal, window, scale, block_q, block_k,
+               block_q_bwd, block_k_bwd, seq_len, interpret):
     """Like _flash but also returns the per-row logsumexp — the
     ingredient ring attention needs to merge normalized block outputs
     across devices (parallel/ring_attention.py)."""
     return _fwd(
-        q, k, v, causal, scale, block_q, block_k, seq_len, interpret
+        q, k, v, causal, window, scale, block_q, block_k, seq_len,
+        interpret
     )
 
 
-def _flash_lse_fwd(q, k, v, causal, scale, block_q, block_k,
+def _flash_lse_fwd(q, k, v, causal, window, scale, block_q, block_k,
                    block_q_bwd, block_k_bwd, seq_len, interpret):
     o, lse = _fwd(
-        q, k, v, causal, scale, block_q, block_k, seq_len, interpret
+        q, k, v, causal, window, scale, block_q, block_k, seq_len,
+        interpret
     )
     return (o, lse), (q, k, v, o, lse)
 
 
-def _flash_lse_bwd(causal, scale, block_q, block_k, block_q_bwd,
-                   block_k_bwd, seq_len, interpret, res, g):
+def _flash_lse_bwd(causal, window, scale, block_q, block_k,
+                   block_q_bwd, block_k_bwd, seq_len, interpret, res,
+                   g):
     g_o, g_lse = g
     q, k, v, o, lse = res
     return _bwd(
-        q, k, v, o, lse, g_o, causal, scale, block_q_bwd, block_k_bwd,
-        seq_len, interpret, g_lse=g_lse,
+        q, k, v, o, lse, g_o, causal, window, scale, block_q_bwd,
+        block_k_bwd, seq_len, interpret, g_lse=g_lse,
     )
 
 
@@ -528,6 +567,7 @@ def flash_attention(
     block_k_bwd: Optional[int] = None,
     interpret: Optional[bool] = None,
     return_lse: bool = False,
+    window: Optional[int] = None,
 ) -> "jax.Array | tuple[jax.Array, jax.Array]":
     """Flash attention on [batch, seq, heads, head_dim] inputs.
 
@@ -546,10 +586,24 @@ def flash_attention(
     independently of the forward's (they default to the forward
     blocks); the backward's access pattern (kv-outer grid, dq
     full-sequence scratch) can favor different tiles.
+
+    ``window`` enables Mistral-style sliding-window attention: query
+    i attends to keys (i-window, i], and kv blocks entirely below the
+    band are skipped — O(T*window) MXU work instead of O(T^2).
+    Requires ``causal=True``.
     """
     if interpret is None:
         interpret = _use_interpret()
     b, t, h, d = q.shape
+    if window is not None:
+        if not causal:
+            raise ValueError(
+                "window (sliding-window attention) requires causal=True"
+            )
+        if window < 1:
+            raise ValueError(f"window must be >= 1, got {window}")
+        if window >= t:
+            window = None  # band covers the whole sequence: plain causal
     if scale is None:
         scale = 1.0 / (d**0.5)
     # Power-of-2 scales (every power-of-4 head_dim, e.g. 64 -> 1/8)
@@ -607,13 +661,13 @@ def flash_attention(
     qk, kk, vk = map(to_kernel_layout, (q, k, v))
     if return_lse:
         o, lse = _flash_lse(
-            qk, kk, vk, causal, scale, block_q, block_k,
+            qk, kk, vk, causal, window, scale, block_q, block_k,
             block_q_bwd, block_k_bwd, t, interpret,
         )
         o = o[:, :, :t].transpose(0, 2, 1, 3)
         return o.astype(q.dtype), lse[:, :, :t, 0]
     o = _flash(
-        qk, kk, vk, causal, scale, block_q, block_k,
+        qk, kk, vk, causal, window, scale, block_q, block_k,
         block_q_bwd, block_k_bwd, t, interpret,
     )
     o = o[:, :, :t].transpose(0, 2, 1, 3)

@@ -160,3 +160,115 @@ def test_oversized_block_with_tiny_sequence():
     )
     ref = _default_attention(q, k, v, causal=True)
     np.testing.assert_allclose(out, ref, atol=2e-5, rtol=2e-5)
+
+
+# ---------------------------------------------------------------------------
+# Sliding-window attention (Mistral-style band)
+# ---------------------------------------------------------------------------
+
+
+@pytest.mark.parametrize(
+    "t,window,bq,bk",
+    [
+        (256, 64, 128, 128),   # band narrower than a block
+        (256, 100, 64, 64),    # band not a block multiple
+        (256, 200, 128, 64),   # band wider than a block, unequal tiles
+        (192, 64, 128, 64),    # padded sequence (192 -> 256) + window
+        (128, 1, 64, 64),      # degenerate: each query sees only itself
+    ],
+)
+def test_window_forward_matches_reference(t, window, bq, bk):
+    q, k, v = _rand_qkv(jax.random.PRNGKey(10), 2, t, 2, 64)
+    out = flash_attention(
+        q, k, v, causal=True, window=window, block_q=bq, block_k=bk,
+        interpret=True,
+    )
+    ref = _default_attention(q, k, v, causal=True, window=window)
+    np.testing.assert_allclose(out, ref, atol=2e-5, rtol=2e-5)
+
+
+def test_window_band_starts_beyond_first_executed_block():
+    """Regression guard for the fully-masked-row hazard: with
+    block_q=128 and window=32, the last rows of a q block have bands
+    starting several kv blocks after the block-skip's earliest
+    admitted block (which is chosen for the FIRST row). Fully-masked
+    rows in executed blocks must contribute exp(0)=1 to nothing."""
+    q, k, v = _rand_qkv(jax.random.PRNGKey(11), 1, 256, 2, 32)
+    out = flash_attention(
+        q, k, v, causal=True, window=32, block_q=128, block_k=32,
+        interpret=True,
+    )
+    ref = _default_attention(q, k, v, causal=True, window=32)
+    np.testing.assert_allclose(out, ref, atol=2e-5, rtol=2e-5)
+
+
+@pytest.mark.parametrize("window", [48, 128])
+def test_window_gradients_match_reference(window):
+    q, k, v = _rand_qkv(jax.random.PRNGKey(12), 1, 192, 2, 32)
+
+    def loss_flash(q, k, v):
+        o = flash_attention(
+            q, k, v, causal=True, window=window, block_q=64,
+            block_k=64, interpret=True,
+        )
+        return jnp.sum(jnp.sin(o))
+
+    def loss_ref(q, k, v):
+        o = _default_attention(q, k, v, causal=True, window=window)
+        return jnp.sum(jnp.sin(o))
+
+    g_flash = jax.grad(loss_flash, argnums=(0, 1, 2))(q, k, v)
+    g_ref = jax.grad(loss_ref, argnums=(0, 1, 2))(q, k, v)
+    for a, b in zip(g_flash, g_ref):
+        np.testing.assert_allclose(a, b, atol=5e-5, rtol=5e-4)
+
+
+def test_window_wider_than_sequence_is_plain_causal():
+    q, k, v = _rand_qkv(jax.random.PRNGKey(13), 1, 128, 2, 64)
+    wide = flash_attention(
+        q, k, v, causal=True, window=4096, interpret=True
+    )
+    plain = flash_attention(q, k, v, causal=True, interpret=True)
+    np.testing.assert_allclose(wide, plain, atol=0, rtol=0)
+
+
+def test_window_requires_causal():
+    q, k, v = _rand_qkv(jax.random.PRNGKey(14), 1, 64, 2, 32)
+    with pytest.raises(ValueError, match="causal"):
+        flash_attention(
+            q, k, v, causal=False, window=16, interpret=True
+        )
+
+
+def test_window_with_lse_matches_and_grads():
+    """return_lse path (ring-attention ingredient) with a window: lse
+    must equal the reference band logsumexp and stay differentiable."""
+    q, k, v = _rand_qkv(jax.random.PRNGKey(15), 1, 128, 2, 32)
+
+    o, lse = flash_attention(
+        q, k, v, causal=True, window=48, block_q=64, block_k=64,
+        interpret=True, return_lse=True,
+    )
+    # Reference lse over the band.
+    d = q.shape[-1]
+    s = jnp.einsum(
+        "bqhd,bkhd->bhqk", q, k, preferred_element_type=jnp.float32
+    ) / np.sqrt(d)
+    pos = jnp.arange(q.shape[1])
+    mask = (pos[:, None] >= pos[None, :]) & (
+        (pos[:, None] - pos[None, :]) < 48
+    )
+    s = jnp.where(mask[None, None], s, -1e30)
+    ref_lse = jax.nn.logsumexp(s, axis=-1)
+    np.testing.assert_allclose(lse, ref_lse, atol=2e-5, rtol=2e-5)
+
+    def loss(q, k, v):
+        o, lse = flash_attention(
+            q, k, v, causal=True, window=48, block_q=64, block_k=64,
+            interpret=True, return_lse=True,
+        )
+        return jnp.sum(jnp.sin(o)) + jnp.sum(jnp.cos(lse))
+
+    grads = jax.grad(loss, argnums=(0, 1, 2))(q, k, v)
+    for g in grads:
+        assert bool(jnp.all(jnp.isfinite(g)))

@@ -1,6 +1,10 @@
 """Microbenchmark: flash-attention kernel vs XLA attention, fwd+bwd.
 
-Usage: python tools/attn_bench.py [B T H D]
+Usage: python tools/attn_bench.py [B T H D] [--window W]
+
+--window adds sliding-window rows (band W) to the sweep: expected
+speedup over full causal approaches T/(2W) as T grows (dead kv blocks
+are skipped, ops/flash_attention.py _dispatch_block).
 """
 
 from __future__ import annotations
@@ -31,9 +35,18 @@ def timeit(fn, *args, n=20):
 
 
 def main():
+    args = sys.argv[1:]
+    window = None
+    if "--window" in args:
+        i = args.index("--window")
+        try:
+            window = int(args[i + 1])
+        except (IndexError, ValueError):
+            sys.exit("usage: attn_bench.py [B T H D] --window <int>")
+        del args[i:i + 2]
     B, T, H, D = 16, 1024, 12, 64
-    if len(sys.argv) > 4:
-        B, T, H, D = map(int, sys.argv[1:5])
+    if len(args) >= 4:
+        B, T, H, D = map(int, args[:4])
     key = jax.random.PRNGKey(0)
     kq, kk, kv, kg = jax.random.split(key, 4)
     q = jax.random.normal(kq, (B, T, H, D), jnp.bfloat16)
@@ -67,6 +80,15 @@ def main():
                 flash_attention, causal=True, block_q=bq, block_k=bk
             ),
         )
+    if window is not None:
+        for bq, bk in [(128, 128), (256, 256), (512, 512)]:
+            bench(
+                f"flash W={window} bq={bq} bk={bk}",
+                functools.partial(
+                    flash_attention, causal=True, window=window,
+                    block_q=bq, block_k=bk,
+                ),
+            )
 
 
 if __name__ == "__main__":
