@@ -35,10 +35,12 @@ def _np(t) -> np.ndarray:
 
 
 def llama_config_from_hf(hf_config) -> LlamaConfig:
-    """Map an HF Llama (or Mixtral) config to ours — Mixtral configs
-    carry num_local_experts/num_experts_per_tok, which switch the
-    native family into MoE mode."""
+    """Map an HF Llama (or Mistral/Mixtral) config to ours — Mixtral
+    configs carry num_local_experts/num_experts_per_tok, which switch
+    the native family into MoE mode; a Mistral ``sliding_window``
+    carries through to the banded flash kernel."""
     return LlamaConfig(
+        sliding_window=getattr(hf_config, "sliding_window", None),
         vocab_size=hf_config.vocab_size,
         block_size=hf_config.max_position_embeddings,
         n_layer=hf_config.num_hidden_layers,

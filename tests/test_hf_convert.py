@@ -126,3 +126,54 @@ def test_tied_embeddings_fallback(hf_model):
     }
     params = llama_params_from_hf(sd, cfg)
     np.testing.assert_array_equal(params["lm_head"], params["wte"])
+
+
+def test_mistral_config_carries_sliding_window():
+    """A Mistral HF config (Llama arch + sliding_window) maps onto the
+    native family with the band intact; sliding_window=8 is NARROWER
+    than the 32-token probe, so the band actively masks and the logits
+    parity vs HF eager Mistral proves both implementations agree on
+    the (q - k < window) band convention."""
+    hf_cfg = transformers.MistralConfig(
+        vocab_size=128,
+        hidden_size=64,
+        intermediate_size=128,
+        num_hidden_layers=2,
+        num_attention_heads=4,
+        num_key_value_heads=2,
+        max_position_embeddings=64,
+        rms_norm_eps=1e-5,
+        rope_theta=10000.0,
+        sliding_window=8,
+        tie_word_embeddings=False,
+        attn_implementation="eager",
+    )
+    cfg = llama_config_from_hf(hf_cfg)
+    assert cfg.sliding_window == 8
+    assert cfg.n_kv_head == 2
+
+    torch.manual_seed(1)
+    model = transformers.MistralForCausalLM(hf_cfg)
+    model.eval()
+    params = llama_params_from_hf(model.state_dict(), cfg)
+
+    import dataclasses
+
+    cfg = dataclasses.replace(
+        cfg, dtype=np.float32, remat=False, use_flash_attention=False
+    )
+    tokens_np = np.random.default_rng(1).integers(
+        0, cfg.vocab_size, size=(2, 32)
+    )
+    with torch.no_grad():
+        want = model(
+            torch.from_numpy(tokens_np)
+        ).logits.float().numpy()
+    got = np.asarray(
+        llama.forward(
+            jax.tree.map(np.asarray, params),
+            tokens_np.astype(np.int32),
+            cfg,
+        )
+    )
+    np.testing.assert_allclose(got, want, atol=2e-4, rtol=2e-3)
