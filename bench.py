@@ -102,6 +102,18 @@ def measure() -> int:
         gpt.GPTConfig.gpt2(),
         remat=os.getenv("BENCH_REMAT", "1") == "1",
     )
+    # Autotune pins (tools/autotune_bwd_blocks.py winner -> the watch
+    # loop re-runs with these): BENCH_BLOCKS="bq,bk,bqb,bkb",
+    # BENCH_FUSED_NORM=0/1.
+    if os.getenv("BENCH_BLOCKS"):
+        blocks = tuple(
+            int(x) for x in os.environ["BENCH_BLOCKS"].split(",")
+        )
+        cfg = dataclasses.replace(cfg, attn_blocks=blocks)
+    if os.getenv("BENCH_FUSED_NORM"):
+        cfg = dataclasses.replace(
+            cfg, use_fused_norm=os.environ["BENCH_FUSED_NORM"] == "1"
+        )
     if os.getenv("BENCH_SMOKE", "0") == "1":
         # Tiny model: validates the capture path end-to-end (probe,
         # child, JSON relay) in seconds on any backend. Not a perf run.

@@ -55,6 +55,11 @@ class GPTConfig:
     # auto_accelerate seq-parallel binding reads this so a non-causal
     # model config is never silently given a causal mask.
     causal: bool = True
+    # Flash-attention tile override (block_q, block_k, block_q_bwd,
+    # block_k_bwd); None = kernel defaults (default_block_sizes + the
+    # forward blocks for the backward). The hardware autotune sweep
+    # (tools/autotune_bwd_blocks.py) pins its winner here.
+    attn_blocks: Optional[tuple] = None
 
     @property
     def head_dim(self) -> int:
@@ -253,8 +258,17 @@ def default_attention_for(cfg: GPTConfig) -> Callable:
     if use_flash:
         from dlrover_tpu.ops.flash_attention import flash_attention
 
+        blocks = getattr(cfg, "attn_blocks", None)
+        block_kwargs = {}
+        if blocks is not None:
+            bq, bk, bqb, bkb = blocks
+            block_kwargs = dict(
+                block_q=bq, block_k=bk,
+                block_q_bwd=bqb, block_k_bwd=bkb,
+            )
         return functools.partial(
-            flash_attention, causal=causal, window=window
+            flash_attention, causal=causal, window=window,
+            **block_kwargs,
         )
     return functools.partial(
         _default_attention, causal=causal, window=window

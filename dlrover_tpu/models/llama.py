@@ -69,6 +69,9 @@ class LlamaConfig:
     # kernel skips kv blocks entirely below the band (O(T*window)
     # work); the plain fallback applies the same band mask.
     sliding_window: Optional[int] = None
+    # Flash tile override (block_q, block_k, block_q_bwd, block_k_bwd)
+    # — same contract as GPTConfig.attn_blocks.
+    attn_blocks: Optional[tuple] = None
 
     @property
     def head_dim(self) -> int:

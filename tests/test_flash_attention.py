@@ -272,3 +272,25 @@ def test_window_with_lse_matches_and_grads():
     grads = jax.grad(loss, argnums=(0, 1, 2))(q, k, v)
     for g in grads:
         assert bool(jnp.all(jnp.isfinite(g)))
+
+
+def test_cfg_attn_blocks_pin_flows_to_kernel():
+    """GPTConfig.attn_blocks (the autotune pin) must reach the flash
+    kernel call and produce reference-equal output."""
+    import dataclasses
+
+    from dlrover_tpu.models import gpt
+
+    cfg = dataclasses.replace(
+        gpt.GPTConfig.gpt2(), use_flash_attention=True,
+        attn_blocks=(64, 128, 64, 64),
+    )
+    attn = gpt.default_attention_for(cfg)
+    assert attn.keywords["block_q"] == 64
+    assert attn.keywords["block_k"] == 128
+    assert attn.keywords["block_q_bwd"] == 64
+    assert attn.keywords["block_k_bwd"] == 64
+    q, k, v = _rand_qkv(jax.random.PRNGKey(16), 1, 128, 2, 32)
+    out = attn(q, k, v, interpret=True)
+    ref = _default_attention(q, k, v, causal=True)
+    np.testing.assert_allclose(out, ref, atol=2e-5, rtol=2e-5)

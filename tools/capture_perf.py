@@ -1,0 +1,186 @@
+"""Land the round's verified perf numbers the moment the TPU answers.
+
+Unattended capture chain (VERDICT r4 item 1):
+
+1. loop the health-gated bench until it succeeds -> PERF_r04.json
+   gets a ``stage=baseline`` record;
+2. run the backward-block autotune + fused-norm A/B
+   (tools/autotune_bwd_blocks.py --quick) and pick the fastest line;
+3. pin the winner via BENCH_BLOCKS / BENCH_FUSED_NORM and re-bench
+   -> ``stage=tuned`` record.
+
+Every successful measurement is appended to PERF_r04.json atomically,
+so a tunnel outage mid-chain never erases landed results; the tuned
+re-bench is retried a few times before giving up (the baseline record
+survives regardless).
+
+Run:  nohup python tools/capture_perf.py >/tmp/capture_perf.log 2>&1 &
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import re
+import subprocess
+import sys
+import time
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+PERF = os.path.join(REPO, "PERF_r04.json")
+
+
+def log(msg: str) -> None:
+    print(f"[{time.strftime('%F %T')}] {msg}", flush=True)
+
+
+def append_perf(rec: dict) -> None:
+    """Append atomically. A hard-won measurement must survive even a
+    corrupt history file: the record is salvaged to a side file and
+    the chain continues (the corrupt original is never overwritten)."""
+    try:
+        hist = []
+        if os.path.exists(PERF):
+            hist = json.load(open(PERF))
+            assert isinstance(hist, list), f"{PERF} is not a list"
+        hist.append(rec)
+        tmp = PERF + ".tmp"
+        json.dump(hist, open(tmp, "w"), indent=1)
+        os.replace(tmp, PERF)
+        log(f"PERF_r04.json <- {rec}")
+    except Exception as exc:  # noqa: BLE001
+        salvage = PERF + ".salvaged"
+        with open(salvage, "a") as f:
+            f.write(json.dumps(rec) + "\n")
+        log(
+            f"PERF history unusable ({exc!r}); record salvaged to "
+            f"{salvage} — merge by hand"
+        )
+
+
+def run_bench(extra_env: dict, timeout_s: float) -> dict | None:
+    """One bench.py run; returns the parsed JSON record or None."""
+    try:
+        p = subprocess.run(
+            [sys.executable, "bench.py"],
+            env={**os.environ, **extra_env},
+            capture_output=True,
+            text=True,
+            cwd=REPO,
+            timeout=timeout_s,
+        )
+    except subprocess.TimeoutExpired:
+        return None
+    for line in p.stdout.splitlines():
+        if line.startswith("{"):
+            try:
+                return json.loads(line)
+            except json.JSONDecodeError:
+                return None
+    return None
+
+
+def winner_env(spec: str) -> dict:
+    """Map a perf_sweep spec (the autotune's fastest line) onto the
+    BENCH_* pins bench.py reads. Field layout: perf_sweep.build_spec —
+    remat,flash,batch,bq,bk,sl[,bqb,bkb], 'nofn' strippable flag."""
+    parts = spec.split(",")
+    fused = "0" if "nofn" in parts else "1"
+    parts = [p for p in parts if p != "nofn"]
+
+    def blk(i, default):
+        if len(parts) <= i or parts[i] == "-":
+            return default
+        return int(parts[i])
+
+    bq = blk(3, 512)
+    bk = blk(4, 1024)
+    bqb = blk(6, bq)
+    bkb = blk(7, bk)
+    return {
+        "BENCH_BLOCKS": f"{bq},{bk},{bqb},{bkb}",
+        "BENCH_FUSED_NORM": fused,
+    }
+
+
+def parse_autotune(out: str) -> tuple | None:
+    """Fastest (spec, step_ms) from perf_sweep result lines."""
+    best = None
+    for line in out.splitlines():
+        m = re.match(r"^(\S+)\s+step=\s*([0-9.]+)ms", line)
+        if m:
+            spec, ms = m.group(1), float(m.group(2))
+            if best is None or ms < best[1]:
+                best = (spec, ms)
+    return best
+
+
+def main() -> int:
+    # Stage 1: baseline, looped until the tunnel answers.
+    attempt = 0
+    while True:
+        attempt += 1
+        rec = run_bench(
+            {"BENCH_MAX_WAIT_S": "600", "BENCH_PROBE_TIMEOUT": "90"},
+            timeout_s=1800,
+        )
+        if rec and not rec.get("error"):
+            rec.update(
+                stage="baseline",
+                config="shipped defaults",
+                ts=time.strftime("%Y-%m-%dT%H:%M:%SZ", time.gmtime()),
+            )
+            append_perf(rec)
+            break
+        log(f"baseline attempt {attempt}: {rec}")
+        time.sleep(90)
+
+    # Stage 2: autotune sweep (partial output still usable on timeout).
+    log("autotune sweep starting")
+    out = ""
+    try:
+        p = subprocess.run(
+            [sys.executable, "tools/autotune_bwd_blocks.py", "--quick"],
+            capture_output=True,
+            text=True,
+            cwd=REPO,
+            timeout=2700,
+        )
+        out = p.stdout
+    except subprocess.TimeoutExpired as exc:
+        out = exc.stdout or ""
+        log("autotune timed out; using partial results")
+    best = parse_autotune(out)
+    if best is None:
+        log("no autotune results; stopping after baseline")
+        return 0
+    spec, ms = best
+    log(f"autotune winner: {spec} at {ms}ms")
+    pins = winner_env(spec)
+
+    # Stage 3: tuned re-bench with the winner pinned.
+    for i in range(3):
+        rec = run_bench(
+            {
+                **pins,
+                "BENCH_MAX_WAIT_S": "600",
+                "BENCH_PROBE_TIMEOUT": "90",
+            },
+            timeout_s=1800,
+        )
+        if rec and not rec.get("error"):
+            rec.update(
+                stage="tuned",
+                config=f"{spec} -> {pins}",
+                ts=time.strftime("%Y-%m-%dT%H:%M:%SZ", time.gmtime()),
+            )
+            append_perf(rec)
+            return 0
+        log(f"tuned re-bench attempt {i + 1}: {rec}")
+        time.sleep(90)
+    log("tuned re-bench never landed; baseline record stands")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

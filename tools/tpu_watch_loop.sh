@@ -1,49 +1,8 @@
 #!/usr/bin/env bash
-# Round-4 capture loop: keep trying the health-gated bench until the
-# TPU tunnel answers, then land the verified number in PERF_r04.json
-# and stop. Designed to run unattended in tmux for hours; every
-# attempt's outcome is appended to /tmp/tpu_watch_r4b.log.
+# Thin wrapper: the unattended perf-capture chain lives in
+# tools/capture_perf.py (baseline bench loop -> autotune -> tuned
+# re-bench, each landed in PERF_r04.json atomically). Logs to
+# /tmp/tpu_watch_r4b.log.
 set -u
 cd "$(dirname "$0")/.."
-
-LOG=/tmp/tpu_watch_r4b.log
-echo "[$(date +%F' '%T)] watch loop starting" >> "$LOG"
-attempt=0
-while true; do
-  attempt=$((attempt + 1))
-  # bench.py itself health-probes, retries with backoff inside this
-  # budget, and always prints one JSON line.
-  out=$(BENCH_MAX_WAIT_S=600 BENCH_PROBE_TIMEOUT=90 python bench.py \
-        2>>"$LOG")
-  echo "[$(date +%F' '%T)] attempt $attempt: $out" >> "$LOG"
-  if [ -n "$out" ] && ! grep -q '"error"' <<<"$out"; then
-    echo "$out" > /tmp/bench_success.json
-    if python - <<'EOF' >> "$LOG" 2>&1
-import json, os, time
-line = open("/tmp/bench_success.json").read().strip().splitlines()[-1]
-rec = json.loads(line)
-rec.update(stage="baseline", config="shipped defaults",
-           ts=time.strftime("%Y-%m-%dT%H:%M:%SZ", time.gmtime()))
-hist = []
-if os.path.exists("PERF_r04.json"):
-    # A corrupt/non-list history must fail loudly, not be overwritten.
-    hist = json.load(open("PERF_r04.json"))
-    assert isinstance(hist, list), f"PERF_r04.json is not a list: {hist!r}"
-hist.append(rec)
-tmp = "PERF_r04.json.tmp"
-json.dump(hist, open(tmp, "w"), indent=1)
-os.replace(tmp, "PERF_r04.json")
-print("PERF_r04.json <-", rec)
-EOF
-    then
-      echo "[$(date +%F' '%T)] SUCCESS - loop exiting" >> "$LOG"
-      break
-    else
-      echo "[$(date +%F' '%T)] bench OK but PERF_r04.json append" \
-           "FAILED - fix by hand; raw line in /tmp/bench_success.json" \
-           >> "$LOG"
-      break
-    fi
-  fi
-  sleep 90
-done
+exec python tools/capture_perf.py >> /tmp/tpu_watch_r4b.log 2>&1
