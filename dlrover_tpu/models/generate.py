@@ -153,7 +153,27 @@ def gpt_prefill(params, cache: KVCache, tokens, cfg):
     return logits, KVCache(k=k_new, v=v_new)
 
 
-def llama_prefill(params, cache: KVCache, tokens, cfg, rope=None):
+def _llama_qkv(h, lp, cfg, B, T):
+    """q/k/v projections incl. the optional GLM-style bias, reshaped
+    to [B, T, heads, D]."""
+    q, k, v = h @ lp["wq"], h @ lp["wk"], h @ lp["wv"]
+    if getattr(cfg, "qkv_bias", False):
+        q, k, v = q + lp["bq"], k + lp["bk"], v + lp["bv"]
+    D = cfg.head_dim
+    return (
+        q.reshape(B, T, cfg.n_head, D),
+        k.reshape(B, T, cfg.n_kv_head, D),
+        v.reshape(B, T, cfg.n_kv_head, D),
+    )
+
+
+def llama_prefill(params, cache: KVCache, tokens, cfg, rope=None,
+                  causal=True):
+    """``causal=False`` runs the prompt bidirectionally — GLM
+    prefix-LM generation (models/glm.py): the prompt is the prefix,
+    so its k/v (at EVERY layer — deeper layers' k/v depend on the
+    mask through the hiddens) must be contextualized with the full
+    bidirectional mask before causal decode steps extend it."""
     B, T0 = tokens.shape
     H, Hkv, D, E = cfg.n_head, cfg.n_kv_head, cfg.head_dim, cfg.n_embd
     cos_t, sin_t = rope if rope is not None else llama_mod.rope_table(
@@ -165,20 +185,16 @@ def llama_prefill(params, cache: KVCache, tokens, cfg, rope=None):
     def body(x, layer):
         lp, k_c, v_c = layer
         h = llama_mod._rms_norm(x, lp["rms1"], cfg.rms_eps)
-        q = llama_mod.apply_rope(
-            (h @ lp["wq"]).reshape(B, T0, H, D), cos, sin
-        )
-        k = llama_mod.apply_rope(
-            (h @ lp["wk"]).reshape(B, T0, Hkv, D), cos, sin
-        )
-        v = (h @ lp["wv"]).reshape(B, T0, Hkv, D)
+        q, k, v = _llama_qkv(h, lp, cfg, B, T0)
+        q = llama_mod.apply_rope(q, cos, sin)
+        k = llama_mod.apply_rope(k, cos, sin)
         k_c = jax.lax.dynamic_update_slice(k_c, k, (0, 0, 0, 0))
         v_c = jax.lax.dynamic_update_slice(v_c, v, (0, 0, 0, 0))
         if Hkv != H:
             k = jnp.repeat(k, cfg.q_per_kv, axis=2)
             v = jnp.repeat(v, cfg.q_per_kv, axis=2)
         att = gpt_mod._default_attention(
-            q, k, v, causal=True
+            q, k, v, causal=causal
         ).reshape(B, T0, E)
         x = x + att @ lp["wo"]
         h = llama_mod._rms_norm(x, lp["rms2"], cfg.rms_eps)
@@ -206,13 +222,9 @@ def llama_decode_step(params, cache: KVCache, token, pos, cfg,
     def body(x, layer):
         lp, k_c, v_c = layer
         h = llama_mod._rms_norm(x, lp["rms1"], cfg.rms_eps)
-        q = llama_mod.apply_rope(
-            (h @ lp["wq"]).reshape(B, 1, H, D), cos, sin
-        )
-        k = llama_mod.apply_rope(
-            (h @ lp["wk"]).reshape(B, 1, Hkv, D), cos, sin
-        )
-        v = (h @ lp["wv"]).reshape(B, 1, Hkv, D)
+        q, k, v = _llama_qkv(h, lp, cfg, B, 1)
+        q = llama_mod.apply_rope(q, cos, sin)
+        k = llama_mod.apply_rope(k, cos, sin)
         k_c = jax.lax.dynamic_update_slice(k_c, k, (0, pos, 0, 0))
         v_c = jax.lax.dynamic_update_slice(v_c, v, (0, pos, 0, 0))
         if Hkv != H:
@@ -239,7 +251,10 @@ def _fns_for(cfg) -> tuple:
     if isinstance(cfg, llama_mod.LlamaConfig):
         rope = llama_mod.rope_table(cfg, cfg.block_size)
         return (
-            functools.partial(llama_prefill, rope=rope),
+            functools.partial(
+                llama_prefill, rope=rope,
+                causal=not getattr(cfg, "prefix_lm", False),
+            ),
             functools.partial(llama_decode_step, rope=rope),
         )
     if isinstance(cfg, gpt_mod.GPTConfig):

@@ -1,0 +1,157 @@
+"""GLM family (ChatGLM2/3) on the Llama backbone.
+
+The last of the reference's four module-replacement families
+(BERT/GPT2/LLaMA/GLM — /root/reference/atorch/atorch/auto/opt_lib/
+module_replace_optimization.py; parallel GLM blocks
+/root/reference/atorch/atorch/modules/distributed_modules/
+transformer.py). Architecturally ChatGLM2/3 is the Llama backbone
+with three deltas, all expressed as config switches on
+models/llama.py rather than a parallel module forest:
+
+* bias on the q/k/v projections (``qkv_bias=True``);
+* rotary embedding over half the head dims (``rotary_pct=0.5``),
+  the rest passing through unrotated;
+* grouped-query attention with 2 kv groups (``n_kv_head=2``).
+
+The GLM-distinctive *training* surface is blank-infilling: a prefix
+of context tokens attends bidirectionally, the generation suffix
+causally (ops/prefix_lm.py, composed from the flash kernels via LSE
+merge). :func:`prefix_attention_for` binds a static prefix length
+into an attention fn the backbone scan consumes unchanged, and
+:func:`prefix_lm_loss_fn` scores only suffix positions — the
+blank-infilling objective.
+
+Everything the strategy engine knows about Llama (sharding axes,
+module profiles, TP plans, pipeline splits, remat/offload policies)
+transfers: the parameters and jaxpr shapes are the backbone's own.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Callable, Optional
+
+import jax
+import jax.numpy as jnp
+
+from dlrover_tpu.models import llama
+
+Params = llama.Params
+
+
+def chatglm2_6b(**overrides) -> llama.LlamaConfig:
+    """ChatGLM2-6B shape: L28 H32 E4096, 2 kv groups, ffn 13696,
+    65024-token vocab, half-dim RoPE, qkv bias."""
+    cfg = llama.LlamaConfig(
+        vocab_size=65024,
+        block_size=32768,
+        n_layer=28,
+        n_head=32,
+        n_kv_head=2,
+        n_embd=4096,
+        intermediate=13696,
+        rope_theta=10000.0,
+        qkv_bias=True,
+        rotary_pct=0.5,
+        prefix_lm=True,
+    )
+    return dataclasses.replace(cfg, **overrides)
+
+
+def chatglm3_6b(**overrides) -> llama.LlamaConfig:
+    """ChatGLM3-6B: same architecture as ChatGLM2, 8k context."""
+    return chatglm2_6b(block_size=8192, **overrides)
+
+
+def tiny(**overrides) -> llama.LlamaConfig:
+    """Test-size GLM: exercises qkv bias + partial rotary + GQA."""
+    cfg = llama.LlamaConfig(
+        vocab_size=256,
+        block_size=64,
+        n_layer=2,
+        n_head=4,
+        n_kv_head=2,
+        n_embd=64,
+        intermediate=128,
+        qkv_bias=True,
+        rotary_pct=0.5,
+        prefix_lm=True,
+        dtype=jnp.float32,
+        remat=False,
+    )
+    return dataclasses.replace(cfg, **overrides)
+
+
+# Parameter init/axes/forward are the backbone's own.
+init_params = llama.init_params
+param_logical_axes = llama.param_logical_axes
+forward = llama.forward
+loss_fn = llama.loss_fn
+
+
+def prefix_attention_for(
+    cfg: llama.LlamaConfig, prefix_len: int
+) -> Callable:
+    """Attention fn with GLM's prefix-LM mask bound in.
+
+    ``prefix_len`` is static — the backbone jit compiles one program
+    per distinct length, so batch construction should bucket prompts
+    to a few lengths (the standard XLA static-shape contract).
+    Flash-kernel composition when the config would use flash;
+    the dense masked reference otherwise.
+    """
+    from dlrover_tpu.ops.prefix_lm import (
+        prefix_lm_attention,
+        prefix_lm_attention_reference,
+    )
+
+    use_flash = cfg.use_flash_attention
+    if use_flash is None:
+        # Same auto rule as gpt.default_attention_for: the Pallas
+        # composition on TPU from 512 context up; the dense masked
+        # reference elsewhere (interpreted Pallas on CPU would be
+        # orders of magnitude slower than the XLA softmax).
+        use_flash = (
+            jax.default_backend() == "tpu" and cfg.block_size >= 512
+        )
+    if use_flash:
+        return lambda q, k, v: prefix_lm_attention(
+            q, k, v, prefix_len
+        )
+    return lambda q, k, v: prefix_lm_attention_reference(
+        q, k, v, prefix_len
+    )
+
+
+def prefix_lm_forward(
+    params: Params,
+    tokens: jax.Array,
+    cfg: llama.LlamaConfig,
+    prefix_len: int,
+) -> jax.Array:
+    return llama.forward(
+        params, tokens, cfg, prefix_attention_for(cfg, prefix_len)
+    )
+
+
+def prefix_lm_loss_fn(
+    params: Params,
+    tokens: jax.Array,
+    targets: jax.Array,
+    cfg: llama.LlamaConfig,
+    prefix_len: int,
+) -> jax.Array:
+    """Blank-infilling objective: next-token CE over suffix positions
+    only (prefix positions are context, not prediction targets)."""
+    x, aux = llama.backbone_with_aux(
+        params, tokens, cfg, prefix_attention_for(cfg, prefix_len)
+    )
+    logits = llama.head_logits(params, x)
+    logp = jax.nn.log_softmax(logits, axis=-1)
+    ll = jnp.take_along_axis(logp, targets[..., None], axis=-1)[..., 0]
+    t = tokens.shape[1]
+    suffix = (jnp.arange(t) >= prefix_len).astype(ll.dtype)
+    denom = jnp.maximum(suffix.sum(), 1.0)
+    return -(ll * suffix[None, :]).sum() / (
+        denom * tokens.shape[0]
+    ) + aux

@@ -188,3 +188,147 @@ def llama_params_from_hf(
             f"{sorted(leftover)[:6]}"
         )
     return params
+
+
+# ---------------------------------------------------------------------------
+# ChatGLM2/3 (GLM family, models/glm.py)
+# ---------------------------------------------------------------------------
+
+
+def _interleaved_to_halves_perm(rot: int) -> np.ndarray:
+    """Index permutation mapping ChatGLM's interleaved rotary layout
+    (pairs (x_{2j}, x_{2j+1}) rotated together) onto our split-halves
+    apply_rope layout (x_j with x_{j+rot/2}). perm[j] = source index
+    in the interleaved layout for target position j."""
+    half = rot // 2
+    perm = np.empty(rot, np.int64)
+    perm[:half] = 2 * np.arange(half)
+    perm[half:] = 2 * np.arange(half) + 1
+    return perm
+
+
+def glm_config_from_hf(hf_config) -> LlamaConfig:
+    """Map a ChatGLM2/3 HF config onto the native GLM shape
+    (models/glm.py: Llama backbone + qkv bias + half-dim rotary)."""
+    return LlamaConfig(
+        vocab_size=hf_config.padded_vocab_size,
+        block_size=hf_config.seq_length,
+        n_layer=hf_config.num_layers,
+        n_head=hf_config.num_attention_heads,
+        n_kv_head=(
+            hf_config.multi_query_group_num
+            if getattr(hf_config, "multi_query_attention", False)
+            else hf_config.num_attention_heads
+        ),
+        n_embd=hf_config.hidden_size,
+        intermediate=hf_config.ffn_hidden_size,
+        rms_eps=hf_config.layernorm_epsilon,
+        qkv_bias=getattr(hf_config, "add_qkv_bias", True),
+        rotary_pct=0.5,
+        # Same generation semantics as the native presets: prompts
+        # prefill bidirectionally (models/glm.py).
+        prefix_lm=True,
+    )
+
+
+def glm_params_from_hf(
+    state_dict, cfg: LlamaConfig, dtype: Any = np.float32
+) -> Dict[str, Any]:
+    """ChatGLM2/3 state_dict -> our param pytree.
+
+    Three layout conversions on top of the Llama mapping:
+
+    * the fused ``query_key_value`` weight/bias splits into wq/wk/wv
+      rows ([E + 2*kv, E] row-major: q then k then v);
+    * the fused SwiGLU ``dense_h_to_4h`` ([2I, E], silu(first half) *
+      second half) splits into w_gate/w_up;
+    * ChatGLM rotates interleaved pairs over the first half of each
+      head; our apply_rope rotates split halves — the q/k columns of
+      each head's rotary slice are permuted so the two conventions
+      compute the same function (validated by
+      tests/test_glm.py::test_rotary_permutation_equivalence).
+    """
+    if hasattr(state_dict, "state_dict"):
+        raise TypeError("pass model.state_dict(), not the model")
+    sd = dict(state_dict)
+    used = set()
+
+    def get(name):
+        for key in (name, f"transformer.{name}"):
+            if key in sd:
+                used.add(key)
+                return _np(sd[key])
+        raise KeyError(f"ChatGLM state_dict is missing {name!r}")
+
+    L, E, D = cfg.n_layer, cfg.n_embd, cfg.head_dim
+    kv = cfg.n_kv_head * D
+    rot = int(D * cfg.rotary_pct)
+    perm = _interleaved_to_halves_perm(rot)
+
+    def permute_heads(w, n_heads):
+        """Permute each head's rotary slice of the OUTPUT dim.
+        w: [..., n_heads*D] column-major heads."""
+        shaped = w.reshape(w.shape[:-1] + (n_heads, D))
+        fixed = np.concatenate(
+            [shaped[..., perm], shaped[..., rot:]], axis=-1
+        )
+        return fixed.reshape(w.shape)
+
+    wq_l, wk_l, wv_l, bq_l, bk_l, bv_l = [], [], [], [], [], []
+    gate_l, up_l, down_l, wo_l, r1_l, r2_l = [], [], [], [], [], []
+    for i in range(L):
+        pre = f"encoder.layers.{i}"
+        qkv_w = get(f"{pre}.self_attention.query_key_value.weight")
+        wq_l.append(permute_heads(qkv_w[:E].T, cfg.n_head))
+        wk_l.append(permute_heads(qkv_w[E:E + kv].T, cfg.n_kv_head))
+        wv_l.append(qkv_w[E + kv:].T)
+        if cfg.qkv_bias:
+            qkv_b = get(f"{pre}.self_attention.query_key_value.bias")
+            bq_l.append(permute_heads(qkv_b[:E], cfg.n_head))
+            bk_l.append(
+                permute_heads(qkv_b[E:E + kv], cfg.n_kv_head)
+            )
+            bv_l.append(qkv_b[E + kv:])
+        wo_l.append(get(f"{pre}.self_attention.dense.weight").T)
+        h4 = get(f"{pre}.mlp.dense_h_to_4h.weight")
+        gate_l.append(h4[: cfg.intermediate].T)
+        up_l.append(h4[cfg.intermediate:].T)
+        down_l.append(get(f"{pre}.mlp.dense_4h_to_h.weight").T)
+        r1_l.append(get(f"{pre}.input_layernorm.weight"))
+        r2_l.append(get(f"{pre}.post_attention_layernorm.weight"))
+
+    blocks = {
+        "rms1": np.stack(r1_l).astype(np.float32),
+        "wq": np.stack(wq_l).astype(dtype),
+        "wk": np.stack(wk_l).astype(dtype),
+        "wv": np.stack(wv_l).astype(dtype),
+        "wo": np.stack(wo_l).astype(dtype),
+        "rms2": np.stack(r2_l).astype(np.float32),
+        "w_gate": np.stack(gate_l).astype(dtype),
+        "w_up": np.stack(up_l).astype(dtype),
+        "w_down": np.stack(down_l).astype(dtype),
+    }
+    if cfg.qkv_bias:
+        blocks.update(
+            bq=np.stack(bq_l).astype(dtype),
+            bk=np.stack(bk_l).astype(dtype),
+            bv=np.stack(bv_l).astype(dtype),
+        )
+    params = {
+        "wte": get("embedding.word_embeddings.weight").astype(dtype),
+        "blocks": blocks,
+        "rmsf": get("encoder.final_layernorm.weight").astype(
+            np.float32
+        ),
+        "lm_head": get("output_layer.weight").astype(dtype),
+    }
+    leftover = {
+        k for k in sd
+        if k not in used and "rotary_pos_emb" not in k
+    }
+    if leftover:
+        raise ValueError(
+            "ChatGLM state_dict contains tensors this converter "
+            f"does not map: {sorted(leftover)[:6]}"
+        )
+    return params

@@ -72,6 +72,17 @@ class LlamaConfig:
     # Flash tile override (block_q, block_k, block_q_bwd, block_k_bwd)
     # — same contract as GPTConfig.attn_blocks.
     attn_blocks: Optional[tuple] = None
+    # Learned bias on the q/k/v projections (the ChatGLM2/3 shape —
+    # models/glm.py; Llama/Mistral keep the default False).
+    qkv_bias: bool = False
+    # Prefix-LM generation semantics (GLM): prompts prefill with the
+    # full bidirectional mask — every layer's prompt k/v depends on
+    # the mask through the hiddens — then decode steps run causally.
+    prefix_lm: bool = False
+    # Fraction of head_dim that receives rotary embedding; the rest
+    # passes through unrotated (ChatGLM applies RoPE to half the
+    # dims). 1.0 = full-dim RoPE (Llama).
+    rotary_pct: float = 1.0
 
     @property
     def head_dim(self) -> int:
@@ -86,6 +97,13 @@ class LlamaConfig:
             raise ValueError(
                 f"n_head={self.n_head} not divisible by "
                 f"n_kv_head={self.n_kv_head}"
+            )
+        rot = int(self.head_dim * self.rotary_pct)
+        if not 0 < rot <= self.head_dim or rot % 2:
+            raise ValueError(
+                f"rotary_pct={self.rotary_pct} gives {rot} rotary "
+                f"dims of head_dim={self.head_dim}; need an even "
+                "count in (0, head_dim]"
             )
 
     @staticmethod
@@ -204,6 +222,12 @@ def init_params(key: jax.Array, cfg: LlamaConfig) -> Params:
         "wo": stack(keys[4], (E, E), resid_std),
         "rms2": jnp.ones((L, E), jnp.float32),
     }
+    if cfg.qkv_bias:
+        blocks.update(
+            bq=jnp.zeros((L, E), cfg.dtype),
+            bk=jnp.zeros((L, Hkv * D), cfg.dtype),
+            bv=jnp.zeros((L, Hkv * D), cfg.dtype),
+        )
     if cfg.n_experts > 0:
         from dlrover_tpu.models.moe import init_moe_params
 
@@ -239,6 +263,12 @@ def param_logical_axes(cfg: LlamaConfig) -> Params:
         "wo": ("layers", "heads", "embed"),
         "rms2": ("layers", None),
     }
+    if cfg.qkv_bias:
+        blocks.update(
+            bq=("layers", "heads"),
+            bk=("layers", "heads"),
+            bv=("layers", "heads"),
+        )
     if cfg.n_experts > 0:
         from dlrover_tpu.models.moe import moe_logical_axes
 
@@ -276,10 +306,12 @@ def _rms_norm(x, g, eps):
 
 
 def rope_table(cfg: LlamaConfig, t: int) -> Tuple[jax.Array, jax.Array]:
-    """cos/sin tables [T, D/2] in f32, computed once outside the layer
-    scan (the reference recomputes them per forward inside the HF
-    rotary module)."""
-    d2 = cfg.head_dim // 2
+    """cos/sin tables [T, rot/2] in f32, computed once outside the
+    layer scan (the reference recomputes them per forward inside the
+    HF rotary module). ``rot = head_dim * rotary_pct`` — partial
+    rotary (GLM) just shrinks the table; apply_rope reads the rotated
+    width off the table shape."""
+    d2 = int(cfg.head_dim * cfg.rotary_pct) // 2
     inv_freq = 1.0 / (
         cfg.rope_theta ** (np.arange(0, d2, dtype=np.float32) / d2)
     )
@@ -289,14 +321,17 @@ def rope_table(cfg: LlamaConfig, t: int) -> Tuple[jax.Array, jax.Array]:
 
 
 def apply_rope(x: jax.Array, cos: jax.Array, sin: jax.Array) -> jax.Array:
-    """x: [B, T, H, D] -> rotated, split-halves convention (HF Llama)."""
-    d2 = x.shape[-1] // 2
-    x1, x2 = x[..., :d2], x[..., d2:]
+    """x: [B, T, H, D] -> rotated, split-halves convention (HF
+    Llama). When the table covers fewer than D dims (rotary_pct < 1),
+    the trailing D - 2*table dims pass through unrotated."""
+    d2 = cos.shape[-1]
+    x1, x2 = x[..., :d2], x[..., d2:2 * d2]
     c = cos[None, :, None, :].astype(x.dtype)
     s = sin[None, :, None, :].astype(x.dtype)
-    return jnp.concatenate(
-        [x1 * c - x2 * s, x2 * c + x1 * s], axis=-1
-    )
+    parts = [x1 * c - x2 * s, x2 * c + x1 * s]
+    if 2 * d2 < x.shape[-1]:
+        parts.append(x[..., 2 * d2:])
+    return jnp.concatenate(parts, axis=-1)
 
 
 def _block(x, lp, cfg: LlamaConfig, attn_fn, cos, sin):
@@ -316,9 +351,12 @@ def _block(x, lp, cfg: LlamaConfig, attn_fn, cos, sin):
         h = fused_rms_norm(x, lp["rms1"], eps=cfg.rms_eps)
     else:
         h = _rms_norm(x, lp["rms1"], cfg.rms_eps)
-    q = (h @ lp["wq"]).reshape(B, T, H, D)
-    k = (h @ lp["wk"]).reshape(B, T, Hkv, D)
-    v = (h @ lp["wv"]).reshape(B, T, Hkv, D)
+    q, k, v = h @ lp["wq"], h @ lp["wk"], h @ lp["wv"]
+    if cfg.qkv_bias:
+        q, k, v = q + lp["bq"], k + lp["bk"], v + lp["bv"]
+    q = q.reshape(B, T, H, D)
+    k = k.reshape(B, T, Hkv, D)
+    v = v.reshape(B, T, Hkv, D)
     q = apply_rope(q, cos, sin)
     k = apply_rope(k, cos, sin)
     if Hkv != H:

@@ -1,0 +1,107 @@
+"""Prefix-LM attention composed from the verified flash kernels.
+
+The GLM family trains with blank-infilling: context tokens (the
+"prefix") attend bidirectionally to each other, generated tokens (the
+"suffix") attend to the whole prefix plus causally to earlier suffix
+tokens (reference: atorch's GLM module stack,
+/root/reference/atorch/atorch/modules/distributed_modules/transformer.py,
+whose parallel GLM blocks consume exactly this mask through HF GLM's
+``get_masks``).
+
+The mask decomposes exactly onto kernels we already trust
+(ops/flash_attention.py) with no new masking code:
+
+* a suffix row i >= p attends keys {j <= i} ∪ {j < p} = {j <= i}
+  (p <= i makes the prefix part a subset of the causal part) — so
+  suffix rows are PURELY CAUSAL rows of the ordinary causal kernel;
+* a prefix row i < p attends {j <= i} ∪ {j < p} = {j < p} — full
+  bidirectional attention within the square prefix block.
+
+So: one non-causal flash call on the p x p prefix, one causal flash
+call on the full t x t sequence, concat prefix rows of the first
+with suffix rows of the second. Both calls are square (the kernel's
+contract); every FLOP runs inside the flash kernel; the composition
+is differentiable through ordinary slicing. The causal call computes
+its first p rows redundantly (~p^2/2 extra MXU work, bounded by 2x
+at p = t) — the price of zero new kernel code paths; a rectangular-
+grid kernel variant can reclaim it later if profiles justify it.
+
+``prefix_len`` is static — under jit each distinct prefix length
+compiles once, the XLA-friendly contract (SURVEY.md: no
+data-dependent shapes inside jit).
+"""
+
+from __future__ import annotations
+
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+
+
+def prefix_lm_attention(
+    q: jax.Array,
+    k: jax.Array,
+    v: jax.Array,
+    prefix_len: int,
+    scale: Optional[float] = None,
+    interpret: Optional[bool] = None,
+) -> jax.Array:
+    """Prefix-LM attention on [B, T, H, D] inputs.
+
+    ``prefix_len`` (static python int) positions attend
+    bidirectionally among themselves; the remaining ``T -
+    prefix_len`` positions attend to the full prefix and causally
+    within the suffix. Degenerate cases delegate straight to the
+    flash kernel: ``prefix_len == 0`` is causal attention,
+    ``prefix_len == T`` is full bidirectional attention.
+    """
+    from dlrover_tpu.ops.flash_attention import flash_attention
+
+    b, t, h, d = q.shape
+    p = int(prefix_len)
+    if not 0 <= p <= t:
+        raise ValueError(f"prefix_len={p} outside [0, {t}]")
+    if scale is None:
+        scale = 1.0 / (d**0.5)
+    if p == 0:
+        return flash_attention(
+            q, k, v, causal=True, scale=scale, interpret=interpret
+        )
+    if p == t:
+        return flash_attention(
+            q, k, v, causal=False, scale=scale, interpret=interpret
+        )
+
+    o_pre = flash_attention(
+        q[:, :p], k[:, :p], v[:, :p], causal=False, scale=scale,
+        interpret=interpret,
+    )
+    o_causal = flash_attention(
+        q, k, v, causal=True, scale=scale, interpret=interpret
+    )
+    return jnp.concatenate([o_pre, o_causal[:, p:]], axis=1)
+
+
+def prefix_lm_attention_reference(
+    q: jax.Array,
+    k: jax.Array,
+    v: jax.Array,
+    prefix_len: int,
+    scale: Optional[float] = None,
+) -> jax.Array:
+    """Dense O(T^2) reference (and non-flash fallback): the same
+    mask materialized, softmax in f32."""
+    b, t, h, d = q.shape
+    if scale is None:
+        scale = 1.0 / (d**0.5)
+    pos = jnp.arange(t)
+    mask = (pos[None, :] <= pos[:, None]) | (pos[None, :] < prefix_len)
+    s = jnp.einsum(
+        "bqhd,bkhd->bhqk", q, k, preferred_element_type=jnp.float32
+    ) * scale
+    s = jnp.where(mask[None, None], s, -jnp.inf)
+    w = jax.nn.softmax(s, axis=-1)
+    return jnp.einsum(
+        "bhqk,bkhd->bqhd", w, v.astype(jnp.float32)
+    ).astype(q.dtype)

@@ -1,0 +1,303 @@
+"""GLM family: prefix-LM attention, qkv bias, partial rotary.
+
+Parity targets: the reference's GLM module replacement + parallel GLM
+blocks (/root/reference/atorch/atorch/auto/opt_lib/
+module_replace_optimization.py, atorch/modules/distributed_modules/
+transformer.py). Here GLM is the Llama backbone with config switches
+(models/glm.py) and the prefix-LM mask is composed from the flash
+kernels via LSE merge (ops/prefix_lm.py).
+"""
+
+import dataclasses
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from dlrover_tpu.models import generate, glm, llama
+from dlrover_tpu.ops.prefix_lm import (
+    prefix_lm_attention,
+    prefix_lm_attention_reference,
+)
+
+
+@pytest.fixture(scope="module")
+def cfg():
+    return glm.tiny()
+
+
+@pytest.fixture(scope="module")
+def params(cfg):
+    return glm.init_params(jax.random.PRNGKey(0), cfg)
+
+
+def _qkv(key, b=2, t=64, h=4, d=16):
+    kq, kk, kv = jax.random.split(key, 3)
+    shape = (b, t, h, d)
+    return (
+        jax.random.normal(kq, shape, jnp.float32),
+        jax.random.normal(kk, shape, jnp.float32),
+        jax.random.normal(kv, shape, jnp.float32),
+    )
+
+
+@pytest.mark.parametrize("prefix_len", [0, 1, 17, 32, 63, 64])
+def test_prefix_attention_matches_dense(prefix_len):
+    """The flash-composed prefix op == the dense masked softmax at
+    every prefix length incl. the degenerate ends and odd splits."""
+    q, k, v = _qkv(jax.random.PRNGKey(1))
+    got = prefix_lm_attention(q, k, v, prefix_len, interpret=True)
+    want = prefix_lm_attention_reference(q, k, v, prefix_len)
+    np.testing.assert_allclose(
+        np.asarray(got), np.asarray(want), atol=2e-5, rtol=1e-4
+    )
+
+
+def test_prefix_attention_grad_matches_dense():
+    """The LSE-merge composition is differentiable end to end and its
+    gradients match the dense reference's."""
+    q, k, v = _qkv(jax.random.PRNGKey(2), b=1, t=32, h=2, d=8)
+
+    def f_flash(q, k, v):
+        return jnp.sum(
+            prefix_lm_attention(q, k, v, 13, interpret=True) ** 2
+        )
+
+    def f_dense(q, k, v):
+        return jnp.sum(
+            prefix_lm_attention_reference(q, k, v, 13) ** 2
+        )
+
+    g1 = jax.grad(f_flash, argnums=(0, 1, 2))(q, k, v)
+    g2 = jax.grad(f_dense, argnums=(0, 1, 2))(q, k, v)
+    for a, b in zip(g1, g2):
+        np.testing.assert_allclose(
+            np.asarray(a), np.asarray(b), atol=5e-5, rtol=1e-3
+        )
+
+
+def test_prefix_is_bidirectional_suffix_is_causal(cfg, params):
+    """Within the prefix, LATE tokens influence EARLY hidden states;
+    suffix tokens never influence prefix hidden states."""
+    p = 32
+    tokens = jax.random.randint(
+        jax.random.PRNGKey(3), (1, cfg.block_size), 8, cfg.vocab_size
+    )
+    attn = glm.prefix_attention_for(cfg, p)
+    h0 = llama.backbone(params, tokens, cfg, attn)
+    # Late-prefix edit reaches position 0: bidirectional prefix.
+    h1 = llama.backbone(
+        params, tokens.at[0, p - 1].set(4), cfg, attn
+    )
+    assert not np.allclose(
+        np.asarray(h0[0, 0]), np.asarray(h1[0, 0]), atol=1e-6
+    )
+    # Suffix edit never reaches the prefix: causal boundary.
+    h2 = llama.backbone(
+        params, tokens.at[0, -1].set(4), cfg, attn
+    )
+    np.testing.assert_allclose(
+        np.asarray(h0[0, :p]), np.asarray(h2[0, :p]), atol=1e-6
+    )
+
+
+def test_prefix_loss_scores_suffix_only(cfg, params):
+    """Blank-infilling: targets at prefix positions are ignored."""
+    p = 24
+    key = jax.random.PRNGKey(4)
+    tokens = jax.random.randint(
+        key, (2, cfg.block_size), 8, cfg.vocab_size
+    )
+    targets = jnp.roll(tokens, -1, axis=1)
+    l0 = glm.prefix_lm_loss_fn(params, tokens, targets, cfg, p)
+    scrambled = targets.at[:, : p - 1].set(7)
+    l1 = glm.prefix_lm_loss_fn(params, tokens, scrambled, cfg, p)
+    np.testing.assert_allclose(float(l0), float(l1), rtol=1e-6)
+    # ... but suffix targets do count.
+    l2 = glm.prefix_lm_loss_fn(
+        params, tokens, targets.at[:, -2].set(7), cfg, p
+    )
+    assert abs(float(l0) - float(l2)) > 1e-7
+
+
+def test_qkv_bias_params_and_grads(cfg, params):
+    """The GLM config materializes q/k/v biases and they receive
+    gradient (i.e. they are actually wired into the block)."""
+    assert params["blocks"]["bq"].shape == (
+        cfg.n_layer, cfg.n_embd,
+    )
+    assert params["blocks"]["bk"].shape == (
+        cfg.n_layer, cfg.n_kv_head * cfg.head_dim,
+    )
+    tokens = jax.random.randint(
+        jax.random.PRNGKey(5), (1, 16), 8, cfg.vocab_size
+    )
+    targets = jnp.roll(tokens, -1, axis=1)
+    g = jax.grad(
+        lambda p: glm.loss_fn(p, tokens, targets, cfg)
+    )(params)
+    for name in ("bq", "bk", "bv"):
+        assert float(jnp.abs(g["blocks"][name]).sum()) > 0.0
+    axes = glm.param_logical_axes(cfg)
+    assert axes["blocks"]["bq"] == ("layers", "heads")
+
+
+def test_partial_rotary_passthrough(cfg):
+    """rotary_pct=0.5: the trailing half of each head passes through
+    apply_rope unrotated; the leading half is position-dependent."""
+    t, d = 8, cfg.head_dim
+    cos, sin = llama.rope_table(cfg, t)
+    assert cos.shape == (t, d // 4)  # tables cover rot/2 = d/4 dims
+    x = jax.random.normal(
+        jax.random.PRNGKey(6), (1, t, 2, d), jnp.float32
+    )
+    y = llama.apply_rope(x, cos, sin)
+    np.testing.assert_allclose(
+        np.asarray(y[..., d // 2:]), np.asarray(x[..., d // 2:])
+    )
+    assert not np.allclose(
+        np.asarray(y[..., : d // 2]), np.asarray(x[..., : d // 2])
+    )
+
+
+def test_glm_generation_prefill_matches_prefix_forward(cfg, params):
+    """GLM generation prefills the prompt BIDIRECTIONALLY (the prompt
+    is the prefix, and every layer's prompt k/v depends on the mask
+    through the hiddens — a causal prefill would build a different
+    cache from layer 2 on). Verify the bidirectional prefill's logits
+    equal the prefix-LM forward's last-position logits, and that a
+    causal prefill does NOT — the regression a multi-layer network
+    catches but a 1-layer one wouldn't."""
+    t0 = 24
+    prompt = jax.random.randint(
+        jax.random.PRNGKey(7), (2, t0), 8, cfg.vocab_size
+    )
+    want = glm.prefix_lm_forward(
+        params, prompt, dataclasses.replace(cfg, block_size=t0),
+        prefix_len=t0,
+    )[:, -1]
+    cache = generate._cache_for(cfg, 2, t0, cfg.n_kv_head)
+    assert cfg.prefix_lm  # generate.sample picks causal=False itself
+    got, _ = generate.llama_prefill(
+        params, cache, prompt, cfg, causal=False
+    )
+    np.testing.assert_allclose(
+        np.asarray(got), np.asarray(want), atol=2e-4, rtol=1e-3
+    )
+    wrong, _ = generate.llama_prefill(
+        params, cache, prompt, cfg, causal=True
+    )
+    assert not np.allclose(
+        np.asarray(wrong), np.asarray(want), atol=1e-3
+    )
+
+
+def test_presets():
+    c2, c3 = glm.chatglm2_6b(), glm.chatglm3_6b()
+    assert c2.qkv_bias and c2.rotary_pct == 0.5 and c2.n_kv_head == 2
+    assert c3.block_size == 8192
+    # ~6.2B params at the ChatGLM2 shape.
+    n = sum(
+        int(np.prod(x.shape))
+        for x in jax.tree.leaves(
+            jax.eval_shape(
+                lambda k: glm.init_params(k, c2),
+                jax.random.PRNGKey(0),
+            )
+        )
+    )
+    assert 5.8e9 < n < 6.8e9
+
+
+def test_rotary_permutation_equivalence():
+    """ChatGLM rotates interleaved pairs; we rotate split halves.
+    The converter permutes q/k head columns so the two compute the
+    same function: ours(perm(x)) == perm(interleaved(x)). Since the
+    same permutation lands on q and k, per-head dot products — and
+    therefore attention — are unchanged."""
+    from dlrover_tpu.models.hf_convert import (
+        _interleaved_to_halves_perm,
+    )
+
+    t, d = 6, 16
+    rot = d // 2
+    cfg_like = glm.tiny(n_embd=16 * 4, n_head=4)  # head_dim 16
+    cos, sin = llama.rope_table(cfg_like, t)  # [t, rot/2]
+    x = np.random.default_rng(0).standard_normal((1, t, 1, d))
+    x = jnp.asarray(x, jnp.float32)
+
+    # Interleaved rotary (the ChatGLM convention) over first rot dims.
+    c = np.asarray(cos)[None, :, None, :]
+    s = np.asarray(sin)[None, :, None, :]
+    xr = np.asarray(x[..., :rot]).reshape(1, t, 1, rot // 2, 2)
+    inter = np.empty_like(xr)
+    inter[..., 0] = xr[..., 0] * c - xr[..., 1] * s
+    inter[..., 1] = xr[..., 1] * c + xr[..., 0] * s
+    inter_full = np.concatenate(
+        [inter.reshape(1, t, 1, rot), np.asarray(x[..., rot:])], -1
+    )
+
+    perm = _interleaved_to_halves_perm(rot)
+    ext = np.concatenate([perm, np.arange(rot, d)])
+    ours = llama.apply_rope(x[..., ext], cos, sin)
+    np.testing.assert_allclose(
+        np.asarray(ours), inter_full[..., ext], atol=1e-6
+    )
+
+
+def test_chatglm_hf_conversion_roundtrip(cfg):
+    """Synthetic ChatGLM2-layout state_dict converts to a runnable
+    pytree: fused qkv/biases split, fused SwiGLU split, strict
+    leftover detection."""
+    from dlrover_tpu.models.hf_convert import glm_params_from_hf
+
+    rng = np.random.default_rng(1)
+    E, D, I = cfg.n_embd, cfg.head_dim, cfg.intermediate
+    kv = cfg.n_kv_head * D
+    sd = {
+        "transformer.embedding.word_embeddings.weight":
+            rng.standard_normal((cfg.vocab_size, E)) * 0.02,
+        "transformer.encoder.final_layernorm.weight": np.ones(E),
+        "transformer.output_layer.weight":
+            rng.standard_normal((cfg.vocab_size, E)) * 0.02,
+    }
+    for i in range(cfg.n_layer):
+        p = f"transformer.encoder.layers.{i}"
+        sd[f"{p}.self_attention.query_key_value.weight"] = (
+            rng.standard_normal((E + 2 * kv, E)) * 0.02
+        )
+        sd[f"{p}.self_attention.query_key_value.bias"] = (
+            rng.standard_normal(E + 2 * kv) * 0.02
+        )
+        sd[f"{p}.self_attention.dense.weight"] = (
+            rng.standard_normal((E, E)) * 0.02
+        )
+        sd[f"{p}.mlp.dense_h_to_4h.weight"] = (
+            rng.standard_normal((2 * I, E)) * 0.02
+        )
+        sd[f"{p}.mlp.dense_4h_to_h.weight"] = (
+            rng.standard_normal((E, I)) * 0.02
+        )
+        sd[f"{p}.input_layernorm.weight"] = np.ones(E)
+        sd[f"{p}.post_attention_layernorm.weight"] = np.ones(E)
+    params = glm_params_from_hf(sd, cfg)
+    ref = glm.init_params(jax.random.PRNGKey(0), cfg)
+
+    def shapes(tree):
+        return {
+            jax.tree_util.keystr(path): leaf.shape
+            for path, leaf in jax.tree_util.tree_leaves_with_path(tree)
+        }
+
+    assert shapes(params) == shapes(ref)
+    tokens = jnp.zeros((1, 8), jnp.int32)
+    logits = glm.forward(
+        jax.tree.map(jnp.asarray, params), tokens, cfg
+    )
+    assert logits.shape == (1, 8, cfg.vocab_size)
+    assert bool(jnp.all(jnp.isfinite(logits)))
+    # Unmapped tensors must refuse, not silently convert.
+    sd["transformer.encoder.layers.0.mystery.weight"] = np.ones(3)
+    with pytest.raises(ValueError, match="does not map"):
+        glm_params_from_hf(sd, cfg)

@@ -377,12 +377,15 @@ class TestReconcileE2E:
 class TestLeaderElection:
     def test_single_holder_and_takeover_after_expiry(self, apiserver):
         api = K8sApi(apiserver.url)
-        a = LeaderElector(api, NS, identity="a", lease_seconds=1)
-        b = LeaderElector(api, NS, identity="b", lease_seconds=1)
+        # 3 s lease: the pre-expiry asserts must all land inside the
+        # lease window even when a loaded CI box stalls this thread
+        # for a second or two between calls.
+        a = LeaderElector(api, NS, identity="a", lease_seconds=3)
+        b = LeaderElector(api, NS, identity="b", lease_seconds=3)
         assert a.try_acquire()
         assert not b.try_acquire()  # a holds a fresh lease
         assert a.try_acquire()  # renewal succeeds
-        time.sleep(1.2)  # lease expires un-renewed
+        time.sleep(3.6)  # lease expires un-renewed
         assert b.try_acquire()  # b takes over
         assert not a.try_acquire()  # and now a must stand by
 
@@ -394,7 +397,7 @@ class TestLeaderElection:
         a = LeaderElector(api, NS, identity="a", lease_seconds=1)
         b = LeaderElector(api, NS, identity="b", lease_seconds=1)
         assert a.try_acquire()
-        time.sleep(1.2)  # expired for both observers
+        time.sleep(1.5)  # expired for both observers
         results = {}
         barrier = threading.Barrier(2)
 

@@ -59,7 +59,11 @@ def append_perf(rec: dict) -> None:
 
 
 def run_bench(extra_env: dict, timeout_s: float) -> dict | None:
-    """One bench.py run; returns the parsed JSON record or None."""
+    """One bench.py run; returns the parsed JSON record or None.
+
+    Failure diagnostics are logged here (stderr tail, timeout vs
+    unparseable) — the unattended log must say WHY an attempt failed,
+    not just that it did."""
     try:
         p = subprocess.run(
             [sys.executable, "bench.py"],
@@ -69,14 +73,21 @@ def run_bench(extra_env: dict, timeout_s: float) -> dict | None:
             cwd=REPO,
             timeout=timeout_s,
         )
-    except subprocess.TimeoutExpired:
+    except subprocess.TimeoutExpired as exc:
+        tail = ((exc.stderr or "") + (exc.output or ""))[-500:]
+        log(f"bench.py timed out after {timeout_s:.0f}s; tail: {tail}")
         return None
     for line in p.stdout.splitlines():
         if line.startswith("{"):
             try:
                 return json.loads(line)
             except json.JSONDecodeError:
+                log(f"unparseable bench JSON line: {line[:300]}")
                 return None
+    log(
+        f"bench.py rc={p.returncode}, no JSON line; stderr tail: "
+        f"{(p.stderr or '')[-500:]}"
+    )
     return None
 
 
