@@ -93,9 +93,26 @@ def _bwd_kernel(dout_ref, resid_ref, g_ref, mu_ref, rstd_ref,
     mu = mu_ref[...]
     rstd = rstd_ref[...]
     xhat = (y - mu) * rstd
-    dg_ref[...] = jnp.sum(dout * xhat, axis=0, keepdims=True)
+
+    # dg/db partials: one (8, E) accumulator block shared by every
+    # grid step (real TPU lowering requires block sublanes divisible
+    # by 8 — a (1, E) row per step is not tileable). Sequential
+    # "arbitrary" grid semantics keep the block resident, so
+    # read-modify-write accumulation is sound (the flash kernel's dkv
+    # uses the same pattern); rows reduce 8-wise here and the final
+    # 8 -> 1 fold happens host-side.
+    @pl.when(pl.program_id(0) == 0)
+    def _zero():
+        dg_ref[...] = jnp.zeros_like(dg_ref)
+        if db_ref is not None:
+            db_ref[...] = jnp.zeros_like(db_ref)
+
+    r, e = dout.shape
+    dg_ref[...] += jnp.sum(
+        (dout * xhat).reshape(r // 8, 8, e), axis=0
+    )
     if db_ref is not None:
-        db_ref[...] = jnp.sum(dout, axis=0, keepdims=True)
+        db_ref[...] += jnp.sum(dout.reshape(r // 8, 8, e), axis=0)
     wdout = dout * g
     c2 = jnp.mean(wdout * xhat, axis=-1, keepdims=True)
     if rms:
@@ -194,6 +211,12 @@ def _kernel_fwd_dispatch(*refs, eps, rms, add_residual, has_bias):
 
 def _bwd(dout2, resid2, g, mu, rstd, *, rms, has_bias, block_rows,
          interpret):
+    if block_rows % 8:
+        raise ValueError(
+            f"block_rows={block_rows} must be a multiple of 8 (the "
+            "f32 sublane tile; the dg/db partial accumulator reduces "
+            "rows 8-wise)"
+        )
     n, e = dout2.shape
     pad = _rows_pad(n, block_rows)
     if pad:
@@ -208,17 +231,20 @@ def _bwd(dout2, resid2, g, mu, rstd, *, rms, has_bias, block_rows,
     row_spec = pl.BlockSpec((block_rows, e), lambda i: (i, 0))
     stat_spec = pl.BlockSpec((block_rows, 1), lambda i: (i, 0))
     gb_spec = pl.BlockSpec((1, e), lambda i: (0, 0))
-    part_spec = pl.BlockSpec((1, e), lambda i: (i, 0))
+    # Every grid step accumulates into the SAME (8, e) partial block
+    # (see _bwd_kernel): 8 sublanes is the minimum f32 tile height on
+    # real TPU, so per-block (1, e) rows would not lower.
+    part_spec = pl.BlockSpec((8, e), lambda i: (0, 0))
 
     out_specs = [row_spec, part_spec]
     out_shape = [
         jax.ShapeDtypeStruct((rows, e), dout2.dtype),
-        jax.ShapeDtypeStruct((nblocks, e), jnp.float32),
+        jax.ShapeDtypeStruct((8, e), jnp.float32),
     ]
     if has_bias:
         out_specs.append(part_spec)
         out_shape.append(
-            jax.ShapeDtypeStruct((nblocks, e), jnp.float32)
+            jax.ShapeDtypeStruct((8, e), jnp.float32)
         )
 
     def kernel(dout_ref, resid_ref, g_ref, mu_ref, rstd_ref, *outs):

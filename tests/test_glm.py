@@ -4,8 +4,9 @@ Parity targets: the reference's GLM module replacement + parallel GLM
 blocks (/root/reference/atorch/atorch/auto/opt_lib/
 module_replace_optimization.py, atorch/modules/distributed_modules/
 transformer.py). Here GLM is the Llama backbone with config switches
-(models/glm.py) and the prefix-LM mask is composed from the flash
-kernels via LSE merge (ops/prefix_lm.py).
+(models/glm.py) and the prefix-LM mask decomposes onto two square
+flash-kernel calls — bidirectional prefix block + causal suffix rows
+(ops/prefix_lm.py).
 """
 
 import dataclasses
@@ -55,7 +56,7 @@ def test_prefix_attention_matches_dense(prefix_len):
 
 
 def test_prefix_attention_grad_matches_dense():
-    """The LSE-merge composition is differentiable end to end and its
+    """The two-call composition is differentiable end to end and its
     gradients match the dense reference's."""
     q, k, v = _qkv(jax.random.PRNGKey(2), b=1, t=32, h=2, d=8)
 

@@ -74,8 +74,18 @@ def run_bench(extra_env: dict, timeout_s: float) -> dict | None:
             timeout=timeout_s,
         )
     except subprocess.TimeoutExpired as exc:
-        tail = ((exc.stderr or "") + (exc.output or ""))[-500:]
-        log(f"bench.py timed out after {timeout_s:.0f}s; tail: {tail}")
+        # exc.output/stderr are None (or bytes on older CPythons)
+        # when the child is killed mid-pipe; normalize defensively.
+        def _txt(v):
+            if isinstance(v, bytes):
+                return v.decode("utf-8", "replace")
+            return v or ""
+
+        tail = (_txt(exc.stderr) + _txt(exc.output))[-500:]
+        log(
+            f"bench.py timed out after {timeout_s:.0f}s"
+            + (f"; tail: {tail}" if tail else " (no output captured)")
+        )
         return None
     for line in p.stdout.splitlines():
         if line.startswith("{"):
