@@ -542,14 +542,21 @@ def _check_block_chain(blocks, t: int) -> int:
 def default_block_sizes(t: int) -> tuple:
     """Autotuned (block_q, block_k) by sequence length (measured on
     v5e, GPT-2 train step): 512 blocks beat 128 by ~2.5x at T=1024
-    (fewer grid steps, less per-block softmax bookkeeping), and
-    widening block_k to 1024 takes another 14 ms off the 16x1024 step
-    (164 vs 178 ms) — fewer online-softmax merges per query row. The
-    f32 score tile is [block_q, block_k] (2 MB at 512x1024), so these
-    caps stay VMEM-safe at any sequence length. block_k doubles only
-    when the sequence is a multiple of 2*block_q — otherwise unequal
-    blocks would pad to lcm(block_q, block_k), which explodes for
-    lengths like 520 (lcm(512, 520) = 33280)."""
+    (fewer grid steps, less per-block softmax bookkeeping), and the
+    r4 sweep (tools/autotune_bwd_blocks.py + perf_sweep) moved the
+    optimum to 1024x1024 — 158.8 ms vs 166.4 ms at 512x1024 on the
+    16x1024 step, 0.902 vs 0.861 vs_baseline. The f32 score tile is
+    [block_q, block_k] (4 MB at 1024x1024), VMEM-safe alongside the
+    q/k/v/o blocks at head dims up to 128. Below 1024 context the
+    block covers the sequence; block_k doubles only when the
+    sequence is a multiple of 2*block_q — otherwise unequal blocks
+    would pad to lcm(block_q, block_k), which explodes for lengths
+    like 520 (lcm(512, 520) = 33280)."""
+    if t % 1024 == 0:
+        # The measured r4 optimum — only where it costs no padding
+        # (t=1536 would pad to 2048, +33% kernel work; t=516 would
+        # yield a sublane-misaligned 516 block).
+        return 1024, 1024
     bq = max(min(512, t), 8)
     bk = 2 * bq if t % (2 * bq) == 0 else bq
     return bq, bk
