@@ -544,8 +544,9 @@ def default_block_sizes(t: int) -> tuple:
     v5e, GPT-2 train step): 512 blocks beat 128 by ~2.5x at T=1024
     (fewer grid steps, less per-block softmax bookkeeping), and the
     r4 sweep (tools/autotune_bwd_blocks.py + perf_sweep) moved the
-    optimum to 1024x1024 — 158.8 ms vs 166.4 ms at 512x1024 on the
-    16x1024 step, 0.902 vs 0.861 vs_baseline. The f32 score tile is
+    optimum to 1024x1024 — 158.8 ms vs 165.2 ms at 512x1024 on the
+    16x1024 step (fused norms off in both), 0.902 vs 0.867
+    vs_baseline. The f32 score tile is
     [block_q, block_k] (4 MB at 1024x1024), VMEM-safe alongside the
     q/k/v/o blocks at head dims up to 128. Below 1024 context the
     block covers the sequence; block_k doubles only when the

@@ -1,0 +1,317 @@
+"""Compile + parity-check every Pallas kernel on the REAL TPU.
+
+The round-4 lesson: interpret-mode tests (the CPU suite) do not
+enforce TPU tiling constraints — the round-3 fused-norm backward
+shipped three rounds of green CPU tests while being uncompilable on
+hardware (its (1, E) dg partials sat below the 8-sublane tile floor).
+This tool is the guard: one run lowers and executes every kernel
+variant on the live chip, checks numerics against the XLA reference,
+and writes KERNELS_r{N}.json for the record.
+
+Run on a TPU host:  python tools/tpu_kernel_smoke.py
+Exit code is the number of failing kernels (0 = all good).
+
+``--small`` shrinks shapes so the harness itself can be validated on
+CPU in interpret mode in seconds — that run checks the TOOL, not the
+hardware lowering (which is the entire point of the full run).
+"""
+
+from __future__ import annotations
+
+import functools
+import json
+import sys
+import time
+
+import _repo_path  # noqa: F401
+
+import os
+
+import jax
+
+# The site-installed axon hook overrides JAX_PLATFORMS at import
+# time; re-assert the env choice (same dance as bench.py) so
+# JAX_PLATFORMS=cpu --small really validates on CPU.
+if os.environ.get("JAX_PLATFORMS"):
+    jax.config.update("jax_platforms", os.environ["JAX_PLATFORMS"])
+import jax.numpy as jnp
+import numpy as np
+
+RESULTS = []
+SMALL = "--small" in sys.argv
+# (seq for flash checks, rows for xent) — small keeps CPU interpret
+# runs tractable; full exercises the shipped 1024x1024 tiles.
+SEQ = 128 if SMALL else 1024
+XENT_V = 1024 if SMALL else 50304
+
+
+def check(name, fn):
+    t0 = time.time()
+    try:
+        fn()
+        RESULTS.append(
+            {"kernel": name, "ok": True,
+             "seconds": round(time.time() - t0, 1)}
+        )
+        print(f"ok   {name} ({time.time() - t0:.1f}s)")
+    except Exception as exc:  # noqa: BLE001
+        RESULTS.append(
+            {"kernel": name, "ok": False,
+             "error": f"{type(exc).__name__}: {str(exc)[:300]}"}
+        )
+        print(f"FAIL {name}: {type(exc).__name__}: {str(exc)[:200]}")
+
+
+def _close(a, b, atol, rtol=1e-3):
+    np.testing.assert_allclose(
+        np.asarray(a, np.float32), np.asarray(b, np.float32),
+        atol=atol, rtol=rtol,
+    )
+
+
+def flash_checks():
+    from dlrover_tpu.ops.flash_attention import flash_attention
+    from dlrover_tpu.ops.prefix_lm import (
+        prefix_lm_attention,
+        prefix_lm_attention_reference,
+    )
+
+    def dense(q, k, v, causal, window=None):
+        b, t, h, d = q.shape
+        s = jnp.einsum(
+            "bqhd,bkhd->bhqk", q, k,
+            preferred_element_type=jnp.float32,
+        ) / (d**0.5)
+        pos = jnp.arange(t)
+        mask = jnp.ones((t, t), bool)
+        if causal:
+            mask &= pos[None, :] <= pos[:, None]
+        if window is not None:
+            mask &= (pos[:, None] - pos[None, :]) < window
+        s = jnp.where(mask[None, None], s, -jnp.inf)
+        w = jax.nn.softmax(s, axis=-1)
+        return jnp.einsum(
+            "bhqk,bkhd->bqhd", w, v.astype(jnp.float32)
+        ).astype(q.dtype)
+
+    key = jax.random.PRNGKey(0)
+    q, k, v = (
+        jax.random.normal(kk, (2, SEQ, 4, 64), jnp.float32)
+        for kk in jax.random.split(key, 3)
+    )
+
+    def grad_check(f_kernel, f_ref, *args, atol):
+        """Full parity: dq AND dk AND dv (a wrong dkv accumulation
+        must not exit 0 from a 'parity-check' tool)."""
+        argnums = tuple(range(len(args)))
+        gk = jax.jit(jax.grad(
+            lambda *a: jnp.sum(f_kernel(*a).astype(jnp.float32) ** 2),
+            argnums=argnums,
+        ))
+        gr = jax.jit(jax.grad(
+            lambda *a: jnp.sum(f_ref(*a).astype(jnp.float32) ** 2),
+            argnums=argnums,
+        ))
+        for got, want in zip(gk(*args), gr(*args)):
+            _close(got, want, atol)
+
+    # fwd+bwd (dq/dk/dv), causal and full, at the shipped 1024x1024
+    # tiles, in f32 AND bf16 — TPU sublane tile floors are
+    # dtype-dependent (8 for f32, 16 for bf16), so the production
+    # bf16 path needs its own lowering check.
+    for dt, tag, atol in (
+        (jnp.float32, "f32", 2e-2), (jnp.bfloat16, "bf16", 0.5),
+    ):
+        qd, kd, vd = q.astype(dt), k.astype(dt), v.astype(dt)
+        check(
+            f"flash_causal_fwd_bwd_{tag}",
+            functools.partial(
+                grad_check,
+                lambda q_, k_, v_: flash_attention(
+                    q_, k_, v_, causal=True
+                ),
+                lambda q_, k_, v_: dense(q_, k_, v_, True),
+                qd, kd, vd, atol=atol,
+            ),
+        )
+    check(
+        "flash_full_fwd_bwd",
+        lambda: grad_check(
+            lambda q_, k_, v_: flash_attention(q_, k_, v_, causal=False),
+            lambda q_, k_, v_: dense(q_, k_, v_, False),
+            q, k, v, atol=2e-2,
+        ),
+    )
+    # Sliding window (Mistral band) + non-1024 sequence (512 tiles),
+    # gradients included (the banded bwd has its own dispatch).
+    half = SEQ // 2
+    qs, ks, vs = q[:, :half], k[:, :half], v[:, :half]
+    check(
+        "flash_sliding_window_fwd_bwd",
+        lambda: grad_check(
+            lambda q_, k_, v_: flash_attention(
+                q_, k_, v_, causal=True, window=half // 4
+            ),
+            lambda q_, k_, v_: dense(q_, k_, v_, True, window=half // 4),
+            qs, ks, vs, atol=2e-2,
+        ),
+    )
+    # Odd length -> internal padding path.
+    odd = SEQ // 2 + 8
+    qo, ko, vo = q[:, :odd], k[:, :odd], v[:, :odd]
+    check(
+        "flash_padded_t520",
+        lambda: _close(
+            flash_attention(qo, ko, vo, causal=True),
+            dense(qo, ko, vo, True), 2e-3,
+        ),
+    )
+    # GLM prefix-LM composition (square prefix + causal suffix).
+    check(
+        "prefix_lm_composition",
+        lambda: _close(
+            prefix_lm_attention(q, k, v, SEQ // 3),
+            prefix_lm_attention_reference(q, k, v, SEQ // 3), 2e-3,
+        ),
+    )
+
+
+def norm_checks():
+    from dlrover_tpu.ops.layer_norm import (
+        fused_add_layer_norm,
+        fused_add_rms_norm,
+        fused_layer_norm,
+        fused_rms_norm,
+    )
+
+    x = jax.random.normal(jax.random.PRNGKey(1), (64, 768), jnp.float32)
+    g = jax.random.normal(jax.random.PRNGKey(2), (768,)) + 1.0
+    b = jax.random.normal(jax.random.PRNGKey(3), (768,))
+
+    def ref_rms(x, g, eps=1e-5):
+        s = jax.lax.rsqrt(jnp.mean(x * x, -1, keepdims=True) + eps)
+        return x * s * g
+
+    def ref_ln(x, g, b, eps=1e-5):
+        mu = jnp.mean(x, -1, keepdims=True)
+        s = jax.lax.rsqrt(
+            jnp.mean((x - mu) ** 2, -1, keepdims=True) + eps
+        )
+        return (x - mu) * s * g + b
+
+    def gcheck(fk, fr, args, atol=2e-2):
+        gk = jax.jit(jax.grad(lambda *a: jnp.sum(fk(*a) ** 2),
+                              argnums=tuple(range(len(args)))))
+        gr = jax.jit(jax.grad(lambda *a: jnp.sum(fr(*a) ** 2),
+                              argnums=tuple(range(len(args)))))
+        for got, want in zip(gk(*args), gr(*args)):
+            _close(got, want, atol)
+
+    check("rms_norm_fwd_bwd", lambda: gcheck(
+        fused_rms_norm, ref_rms, (x, g)))
+    check("layer_norm_bias_fwd_bwd", lambda: gcheck(
+        fused_layer_norm, ref_ln, (x, g, b)))
+    check("add_rms_norm_fwd_bwd", lambda: gcheck(
+        lambda x, r, g: fused_add_rms_norm(x, r, g)[0],
+        lambda x, r, g: ref_rms(x + r, g), (x, x * 0.5, g)))
+    # The exact variant GPT's fused path uses (bias + residual, the
+    # db/dg accumulator that carried the round-4 tiling bug) — in
+    # bf16 too, the production dtype.
+    check("add_layer_norm_bias_fwd_bwd", lambda: gcheck(
+        lambda x, r, g, b: fused_add_layer_norm(x, r, g, b)[0],
+        lambda x, r, g, b: ref_ln(x + r, g, b), (x, x * 0.5, g, b)))
+    xb = x.astype(jnp.bfloat16)
+    check("add_layer_norm_bias_fwd_bwd_bf16", lambda: gcheck(
+        lambda x, r, g, b: fused_add_layer_norm(x, r, g, b)[0]
+        .astype(jnp.float32),
+        lambda x, r, g, b: ref_ln(
+            x.astype(jnp.float32) + r.astype(jnp.float32), g, b
+        ),
+        (xb, (x * 0.5).astype(jnp.bfloat16), g, b), atol=0.3))
+
+
+def quant_checks():
+    from dlrover_tpu.ops.quantization import (
+        dequantize_blockwise,
+        dequantize_blockwise_4bit,
+        quantize_blockwise,
+        quantize_blockwise_4bit,
+    )
+
+    x = jax.random.normal(jax.random.PRNGKey(4), (512, 256) if SMALL else (4096, 512))
+
+    def rt8():
+        q, s, shape = quantize_blockwise(x)
+        y = dequantize_blockwise(q, s, shape)
+        assert float(jnp.abs(y - x).max()) < 0.05
+
+    def rt4():
+        q, s, shape = quantize_blockwise_4bit(x)
+        y = dequantize_blockwise_4bit(q, s, shape)
+        assert float(jnp.abs(y - x).max()) < 0.6
+
+    check("quantize_8bit_roundtrip", rt8)
+    check("quantize_4bit_roundtrip", rt4)
+
+
+def xent_checks():
+    from dlrover_tpu.ops.cross_entropy import fused_cross_entropy
+
+    n, e, v = (64, 128, XENT_V) if SMALL else (512, 256, 50304)
+    x = jax.random.normal(jax.random.PRNGKey(5), (n, e)) * 0.1
+    w = jax.random.normal(jax.random.PRNGKey(6), (v, e)) * 0.02
+    t = jax.random.randint(jax.random.PRNGKey(7), (n,), 0, v)
+
+    def ref(x, w):
+        logits = (x @ w.T).astype(jnp.float32)
+        lp = jax.nn.log_softmax(logits, -1)
+        return -jnp.mean(
+            jnp.take_along_axis(lp, t[:, None], axis=-1)
+        )
+
+    for save in (False, True):
+        def run(save=save):
+            gk = jax.jit(jax.grad(
+                lambda x, w: fused_cross_entropy(x, w, t, 8, save),
+                argnums=(0, 1),
+            ))
+            gr = jax.jit(jax.grad(ref, argnums=(0, 1)))
+            for got, want in zip(gk(x, w), gr(x, w)):
+                _close(got, want, 2e-3)
+
+        check(f"fused_xent_save_logits_{int(save)}", run)
+
+
+def main() -> int:
+    print(f"devices: {jax.devices()}")
+    if jax.default_backend() not in ("tpu", "axon"):
+        print("WARNING: not on TPU — this run does NOT validate "
+              "hardware lowering")
+    flash_checks()
+    norm_checks()
+    quant_checks()
+    xent_checks()
+    fails = [r for r in RESULTS if not r["ok"]]
+    out = {
+        "backend": jax.default_backend(),
+        "device": str(jax.devices()[0]),
+        "total": len(RESULTS),
+        "failed": len(fails),
+        "results": RESULTS,
+    }
+    # Only a full-shape run on the real chip earns the round
+    # artifact; a --small/CPU run validates the harness, not the
+    # hardware lowering, and must not masquerade as the record.
+    on_tpu = jax.default_backend() in ("tpu", "axon")
+    path = (
+        "KERNELS_r04.json" if (on_tpu and not SMALL)
+        else "/tmp/kernel_smoke_harness.json"
+    )
+    with open(path, "w") as f:
+        json.dump(out, f, indent=1)
+    print(json.dumps({"total": len(RESULTS), "failed": len(fails)}))
+    return len(fails)
+
+
+if __name__ == "__main__":
+    sys.exit(main())
