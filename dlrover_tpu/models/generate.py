@@ -47,14 +47,19 @@ def _cache_for(cfg, batch: int, max_len: int, n_kv: int) -> KVCache:
     )
 
 
-def _cached_attention(q, k_cache, v_cache, pos):
-    """q [B,1,H,D] against cache [B,T,H,D]; positions > pos masked."""
+def _cached_attention(q, k_cache, v_cache, pos, window=None):
+    """q [B,1,H,D] against cache [B,T,H,D]; positions > pos masked.
+    ``window`` applies the Mistral sliding band — the decode step
+    sees keys (pos-window, pos], matching the training mask."""
     b, t, h, d = k_cache.shape
     s = jnp.einsum(
         "bqhd,bkhd->bhqk", q, k_cache,
         preferred_element_type=jnp.float32,
     ) / np.sqrt(d)
-    mask = jnp.arange(t)[None, None, None, :] <= pos
+    idx = jnp.arange(t)[None, None, None, :]
+    mask = idx <= pos
+    if window is not None:
+        mask &= (pos - idx) < window
     s = jnp.where(mask, s, -1e30)
     p = jax.nn.softmax(s, axis=-1).astype(v_cache.dtype)
     return jnp.einsum("bhqk,bkhd->bqhd", p, v_cache)
@@ -194,7 +199,8 @@ def llama_prefill(params, cache: KVCache, tokens, cfg, rope=None,
             k = jnp.repeat(k, cfg.q_per_kv, axis=2)
             v = jnp.repeat(v, cfg.q_per_kv, axis=2)
         att = gpt_mod._default_attention(
-            q, k, v, causal=causal
+            q, k, v, causal=causal,
+            window=getattr(cfg, "sliding_window", None),
         ).reshape(B, T0, E)
         x = x + att @ lp["wo"]
         h = llama_mod._rms_norm(x, lp["rms2"], cfg.rms_eps)
@@ -232,7 +238,10 @@ def llama_decode_step(params, cache: KVCache, token, pos, cfg,
             v_full = jnp.repeat(v_c, cfg.q_per_kv, axis=2)
         else:
             k_full, v_full = k_c, v_c
-        att = _cached_attention(q, k_full, v_full, pos).reshape(B, 1, E)
+        att = _cached_attention(
+            q, k_full, v_full, pos,
+            window=getattr(cfg, "sliding_window", None),
+        ).reshape(B, 1, E)
         x = x + att @ lp["wo"]
         h = llama_mod._rms_norm(x, lp["rms2"], cfg.rms_eps)
         return _llama_mlp(x, h, lp, cfg), (k_c, v_c)

@@ -142,8 +142,14 @@ def prefix_lm_loss_fn(
     cfg: llama.LlamaConfig,
     prefix_len: int,
 ) -> jax.Array:
-    """Blank-infilling objective: next-token CE over suffix positions
-    only (prefix positions are context, not prediction targets)."""
+    """Blank-infilling objective: next-token CE over the positions
+    that PREDICT suffix tokens — the band [prefix_len - 1, T - 1).
+    Position prefix_len - 1 (the last prefix token) is included
+    because its logit head generates the FIRST suffix token at
+    sampling time; position T - 1 is excluded because its next-token
+    target lies outside the sequence (callers following the
+    ``jnp.roll(tokens, -1)`` convention would otherwise supervise
+    wrap-around garbage)."""
     x, aux = llama.backbone_with_aux(
         params, tokens, cfg, prefix_attention_for(cfg, prefix_len)
     )
@@ -151,8 +157,11 @@ def prefix_lm_loss_fn(
     logp = jax.nn.log_softmax(logits, axis=-1)
     ll = jnp.take_along_axis(logp, targets[..., None], axis=-1)[..., 0]
     t = tokens.shape[1]
-    suffix = (jnp.arange(t) >= prefix_len).astype(ll.dtype)
-    denom = jnp.maximum(suffix.sum(), 1.0)
-    return -(ll * suffix[None, :]).sum() / (
+    pos = jnp.arange(t)
+    band = (
+        (pos >= max(prefix_len - 1, 0)) & (pos < t - 1)
+    ).astype(ll.dtype)
+    denom = jnp.maximum(band.sum(), 1.0)
+    return -(ll * band[None, :]).sum() / (
         denom * tokens.shape[0]
     ) + aux

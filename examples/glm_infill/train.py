@@ -1,0 +1,136 @@
+"""GLM blank-infilling pretraining demo (the fourth module-replacement
+family — reference accelerates HF GLM through atorch module_replace,
+/root/reference/atorch/atorch/auto/opt_lib/module_replace_optimization.py;
+here GLM is native, models/glm.py).
+
+Run standalone on one host (CPU mesh or TPU):
+
+    python -m dlrover_tpu.trainer.elastic_run --standalone \
+        examples/glm_infill/train.py -- --smoke
+
+The GLM-specific surfaces exercised: the prefix-LM objective
+(bidirectional prefix context, causal suffix generation,
+suffix-only loss — ops/prefix_lm.py), qkv-bias + half-dim-rotary
+backbone switches, and generation with the bidirectional prefill
+(generate.llama_prefill(causal=False) via cfg.prefix_lm).
+
+Data is synthetic: the suffix is a deterministic transform of the
+prefix, so infilling is learnable and the loss demonstrably uses the
+bidirectional context.
+"""
+
+from __future__ import annotations
+
+import argparse
+import functools
+import os
+import sys
+import time
+
+import numpy as np
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(
+    os.path.dirname(os.path.abspath(__file__)))))
+
+
+def parse_args(argv=None):
+    p = argparse.ArgumentParser()
+    p.add_argument("--steps", type=int, default=0)
+    p.add_argument("--global-batch-size", type=int, default=32)
+    p.add_argument("--lr", type=float, default=2e-3)
+    p.add_argument("--smoke", action="store_true")
+    return p.parse_args(argv)
+
+
+def infill_batches(batch, t, prefix, vocab, seed=0):
+    """Prefix: random tokens; suffix: the prefix's opening segment
+    shifted by +3. Every suffix position copies from a CONSTANT
+    relative offset — the induction-head pattern a 2-layer model
+    learns in a few hundred steps, so the demo's infill accuracy
+    visibly climbs. (The mask semantics themselves — bidirectional
+    prefix, causal suffix — are proven by tests/test_glm.py; this
+    script demonstrates the training objective end to end.)"""
+    rng = np.random.default_rng(seed)
+    while True:
+        pre = rng.integers(8, vocab, size=(batch, prefix))
+        suf = (pre[:, : t - prefix] + 3) % vocab
+        tokens = np.concatenate([pre, suf], axis=1).astype(np.int32)
+        targets = np.roll(tokens, -1, axis=1)
+        yield tokens, targets
+
+
+def main(argv=None) -> int:
+    args = parse_args(argv)
+
+    import jax
+    import jax.numpy as jnp
+    import optax
+
+    from dlrover_tpu.models import generate, glm
+    from dlrover_tpu.parallel.mesh import MeshConfig, build_mesh
+    from dlrover_tpu.trainer import jax_env
+    from dlrover_tpu.trainer.step import (
+        make_sharded_init,
+        make_train_step,
+        shard_batch,
+    )
+
+    jax_env.setup_distributed()
+
+    cfg = glm.tiny(block_size=64)
+    prefix = 40
+    steps = args.steps or (8 if args.smoke else 400)
+
+    n_dev = len(jax.devices())
+    mesh = build_mesh(MeshConfig(data=n_dev))
+    opt = optax.adam(args.lr)
+    loss = functools.partial(
+        glm.prefix_lm_loss_fn, cfg=cfg, prefix_len=prefix
+    )
+    init, _ = make_sharded_init(
+        mesh, functools.partial(glm.init_params, cfg=cfg),
+        glm.param_logical_axes(cfg), opt,
+    )
+    params, opt_state = init(jax.random.PRNGKey(0))
+    step = make_train_step(mesh, loss, opt)
+
+    batches = infill_batches(
+        args.global_batch_size, cfg.block_size, prefix, cfg.vocab_size
+    )
+    t0 = time.time()
+    first = last = None
+    for i in range(steps):
+        tokens, targets = next(batches)
+        tokens, targets = shard_batch(
+            mesh, jnp.asarray(tokens), jnp.asarray(targets)
+        )
+        params, opt_state, m = step(params, opt_state, tokens, targets)
+        if i == 0:
+            first = float(m["loss"])
+        last = float(m["loss"])
+        if (i + 1) % max(1, steps // 8) == 0:
+            print(f"step {i + 1:4d} infill loss {last:.4f}")
+    print(
+        f"done: {steps} steps in {time.time() - t0:.1f}s, "
+        f"loss {first:.3f} -> {last:.3f}"
+    )
+
+    # Infill demo: greedy-generate the suffix from a fresh prefix;
+    # cfg.prefix_lm routes the prompt through the bidirectional
+    # prefill (the mask the model was trained with).
+    host = jax.tree.map(lambda x: jnp.asarray(jax.device_get(x)), params)
+    tokens, _ = next(batches)
+    prompt = jnp.asarray(tokens[:2, :prefix])
+    want = tokens[:2, prefix:]
+    out = generate.generate(
+        host, cfg, prompt,
+        max_new_tokens=cfg.block_size - prefix, temperature=0.0,
+    )
+    got = np.asarray(out[:, prefix:])
+    acc = float((got == want).mean())
+    print(f"greedy infill accuracy on fresh prefixes: {acc:.2%}")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

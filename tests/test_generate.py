@@ -108,3 +108,32 @@ def test_generate_rejects_overflow(gpt_setup):
     prompt = _tokens(cfg, b=1, t=30)
     with pytest.raises(ValueError):
         generate.generate(params, cfg, prompt, max_new_tokens=10)
+
+
+def test_mistral_windowed_decode_matches_forward():
+    """Sliding-window decode parity: with a prompt LONGER than the
+    window, the cached decode path must apply the same band mask as
+    the training forward — an unwindowed cache attention diverges at
+    every position past the window (the r4 regression this guards)."""
+    import dataclasses
+
+    cfg = dataclasses.replace(
+        llama.LlamaConfig.tiny(), sliding_window=8, block_size=32
+    )
+    params = llama.init_params(jax.random.PRNGKey(0), cfg)
+    tokens = jax.random.randint(
+        jax.random.PRNGKey(8), (2, 25), 8, cfg.vocab_size
+    )
+    got = generate.decode_logits_sequential(params, cfg, tokens)
+    want = llama.forward(params, tokens, cfg)
+    np.testing.assert_allclose(
+        np.asarray(got), np.asarray(want), atol=2e-4, rtol=2e-3
+    )
+    # Sanity that the window binds here: the unwindowed forward
+    # must NOT match at the last position (else this test is vacuous).
+    full = llama.forward(
+        params, tokens, dataclasses.replace(cfg, sliding_window=None)
+    )
+    assert not np.allclose(
+        np.asarray(full[:, -1]), np.asarray(want[:, -1]), atol=1e-4
+    )

@@ -103,8 +103,12 @@ def test_prefix_is_bidirectional_suffix_is_causal(cfg, params):
     )
 
 
-def test_prefix_loss_scores_suffix_only(cfg, params):
-    """Blank-infilling: targets at prefix positions are ignored."""
+def test_prefix_loss_scores_suffix_band_only(cfg, params):
+    """Blank-infilling: the supervised band is [prefix-1, T-1) — the
+    positions whose next-token logits produce suffix tokens. Targets
+    strictly inside the prefix AND the wrap-around last position are
+    ignored; the last-prefix position (which generates the FIRST
+    suffix token at sampling time) IS supervised."""
     p = 24
     key = jax.random.PRNGKey(4)
     tokens = jax.random.randint(
@@ -115,11 +119,18 @@ def test_prefix_loss_scores_suffix_only(cfg, params):
     scrambled = targets.at[:, : p - 1].set(7)
     l1 = glm.prefix_lm_loss_fn(params, tokens, scrambled, cfg, p)
     np.testing.assert_allclose(float(l0), float(l1), rtol=1e-6)
-    # ... but suffix targets do count.
-    l2 = glm.prefix_lm_loss_fn(
-        params, tokens, targets.at[:, -2].set(7), cfg, p
+    # Wrap-around position T-1 (target outside the sequence): ignored.
+    l_wrap = glm.prefix_lm_loss_fn(
+        params, tokens, targets.at[:, -1].set(7), cfg, p
     )
-    assert abs(float(l0) - float(l2)) > 1e-7
+    np.testing.assert_allclose(float(l0), float(l_wrap), rtol=1e-6)
+    # ... but in-band targets do count: a mid-suffix position, and
+    # the last-prefix position that emits the first suffix token.
+    for idx in (-2, p - 1):
+        l2 = glm.prefix_lm_loss_fn(
+            params, tokens, targets.at[:, idx].set(7), cfg, p
+        )
+        assert abs(float(l0) - float(l2)) > 1e-7, idx
 
 
 def test_qkv_bias_params_and_grads(cfg, params):

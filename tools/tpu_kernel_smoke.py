@@ -76,23 +76,10 @@ def flash_checks():
         prefix_lm_attention_reference,
     )
 
-    def dense(q, k, v, causal, window=None):
-        b, t, h, d = q.shape
-        s = jnp.einsum(
-            "bqhd,bkhd->bhqk", q, k,
-            preferred_element_type=jnp.float32,
-        ) / (d**0.5)
-        pos = jnp.arange(t)
-        mask = jnp.ones((t, t), bool)
-        if causal:
-            mask &= pos[None, :] <= pos[:, None]
-        if window is not None:
-            mask &= (pos[:, None] - pos[None, :]) < window
-        s = jnp.where(mask[None, None], s, -jnp.inf)
-        w = jax.nn.softmax(s, axis=-1)
-        return jnp.einsum(
-            "bhqk,bkhd->bqhd", w, v.astype(jnp.float32)
-        ).astype(q.dtype)
+    # The canonical XLA reference the flash kernel must agree with —
+    # the repo's own non-flash fallback, not a local re-derivation
+    # that could drift.
+    from dlrover_tpu.models.gpt import _default_attention as dense
 
     key = jax.random.PRNGKey(0)
     q, k, v = (
