@@ -48,21 +48,28 @@ def _cache_for(cfg, batch: int, max_len: int, n_kv: int) -> KVCache:
 
 
 def _cached_attention(q, k_cache, v_cache, pos, window=None):
-    """q [B,1,H,D] against cache [B,T,H,D]; positions > pos masked.
+    """q [B,1,H,D] against cache [B,T,H_kv,D]; positions > pos
+    masked. H may be a q_per_kv multiple of H_kv (grouped-query):
+    query heads fold into a group dim and attend the UN-expanded
+    cache — no repeated K/V copies in the decode hot path.
     ``window`` applies the Mistral sliding band — the decode step
     sees keys (pos-window, pos], matching the training mask."""
-    b, t, h, d = k_cache.shape
+    b, t, hkv, d = k_cache.shape
+    h = q.shape[2]
+    g = h // hkv
+    qg = q.reshape(b, 1, hkv, g, d)
     s = jnp.einsum(
-        "bqhd,bkhd->bhqk", q, k_cache,
+        "bqhgd,bkhd->bhgqk", qg, k_cache,
         preferred_element_type=jnp.float32,
     ) / np.sqrt(d)
-    idx = jnp.arange(t)[None, None, None, :]
+    idx = jnp.arange(t)[None, None, None, None, :]
     mask = idx <= pos
     if window is not None:
         mask &= (pos - idx) < window
     s = jnp.where(mask, s, -1e30)
     p = jax.nn.softmax(s, axis=-1).astype(v_cache.dtype)
-    return jnp.einsum("bhqk,bkhd->bqhd", p, v_cache)
+    o = jnp.einsum("bhgqk,bkhd->bqhgd", p, v_cache)
+    return o.reshape(b, 1, h, d)
 
 
 # ---------------------------------------------------------------------------
@@ -233,13 +240,10 @@ def llama_decode_step(params, cache: KVCache, token, pos, cfg,
         k = llama_mod.apply_rope(k, cos, sin)
         k_c = jax.lax.dynamic_update_slice(k_c, k, (0, pos, 0, 0))
         v_c = jax.lax.dynamic_update_slice(v_c, v, (0, pos, 0, 0))
-        if Hkv != H:
-            k_full = jnp.repeat(k_c, cfg.q_per_kv, axis=2)
-            v_full = jnp.repeat(v_c, cfg.q_per_kv, axis=2)
-        else:
-            k_full, v_full = k_c, v_c
+        # GQA handled inside _cached_attention (grouped einsum) —
+        # never materialize a q_per_kv-expanded cache copy per step.
         att = _cached_attention(
-            q, k_full, v_full, pos,
+            q, k_c, v_c, pos,
             window=getattr(cfg, "sliding_window", None),
         ).reshape(B, 1, E)
         x = x + att @ lp["wo"]

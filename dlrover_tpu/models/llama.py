@@ -105,6 +105,16 @@ class LlamaConfig:
                 f"dims of head_dim={self.head_dim}; need an even "
                 "count in (0, head_dim]"
             )
+        if self.prefix_lm and self.sliding_window is not None:
+            # A one-sided band over a bidirectional prefix is not a
+            # defined mask; reject at config time rather than deep
+            # inside the prefill scan (flash and the XLA fallback
+            # both refuse window with causal=False).
+            raise ValueError(
+                "prefix_lm and sliding_window are mutually "
+                "exclusive: the bidirectional prefix has no causal "
+                "band to window"
+            )
 
     @staticmethod
     def llama2_7b() -> "LlamaConfig":

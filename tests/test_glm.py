@@ -313,3 +313,64 @@ def test_chatglm_hf_conversion_roundtrip(cfg):
     sd["transformer.encoder.layers.0.mystery.weight"] = np.ones(3)
     with pytest.raises(ValueError, match="does not map"):
         glm_params_from_hf(sd, cfg)
+
+
+def test_glm_pipelines_like_llama():
+    """Family completeness through the stack: a GLM-flavored config
+    (qkv bias + half-dim rotary + GQA) trains through the 1F1B
+    pipeline assembly with trajectory parity against its dense step —
+    the bias leaves ride the same per-stage param split."""
+    import functools
+
+    import optax
+
+    from dlrover_tpu.models.llama_pipeline import (
+        make_llama_pipeline_step,
+        shard_params_for_pipeline,
+    )
+    from dlrover_tpu.parallel.mesh import MeshConfig, build_mesh
+    from dlrover_tpu.trainer.step import make_train_step, shard_batch
+
+    cfg = glm.tiny(
+        block_size=16, n_layer=4, n_embd=32, intermediate=64,
+        vocab_size=64,
+    )
+    batches = []
+    key = jax.random.PRNGKey(3)
+    for _ in range(3):
+        key, k = jax.random.split(key)
+        tok = jax.random.randint(k, (8, 16), 0, cfg.vocab_size)
+        batches.append((tok, jnp.roll(tok, -1, axis=1)))
+
+    dense_mesh = build_mesh(
+        MeshConfig(data=4), devices=jax.devices()[:4]
+    )
+    opt = optax.adamw(1e-2)
+    params = glm.init_params(jax.random.PRNGKey(0), cfg)
+    opt_state = opt.init(params)
+    step = make_train_step(
+        dense_mesh, functools.partial(llama.loss_fn, cfg=cfg), opt
+    )
+    dense_losses = []
+    for tok, tgt in batches:
+        tok, tgt = shard_batch(dense_mesh, tok, tgt)
+        params, opt_state, m = step(params, opt_state, tok, tgt)
+        dense_losses.append(float(m["loss"]))
+
+    pipe_mesh = build_mesh(
+        MeshConfig(data=2, pipe=2), devices=jax.devices()[:4]
+    )
+    p_params = shard_params_for_pipeline(
+        pipe_mesh, glm.init_params(jax.random.PRNGKey(0), cfg)
+    )
+    p_opt_state = opt.init(p_params)
+    p_step = make_llama_pipeline_step(pipe_mesh, cfg, opt)
+    pipe_losses = []
+    for tok, tgt in batches:
+        p_params, p_opt_state, m = p_step(
+            p_params, p_opt_state, tok, tgt
+        )
+        pipe_losses.append(float(m["loss"]))
+    np.testing.assert_allclose(
+        pipe_losses, dense_losses, rtol=2e-3, atol=2e-4
+    )
