@@ -24,7 +24,16 @@ blank-infilling objective.
 
 Everything the strategy engine knows about Llama (sharding axes,
 module profiles, TP plans, pipeline splits, remat/offload policies)
-transfers: the parameters and jaxpr shapes are the backbone's own.
+transfers: the parameters and jaxpr shapes are the backbone's own
+(trajectory parity through the 1F1B pipeline:
+tests/test_glm.py::test_glm_pipelines_like_llama).
+
+Known limitation: the prefix-LM attention is single-shard along the
+sequence — it composes with data/fsdp/tensor/pipe axes but not with
+``seq`` (ring/a2a) sharding, whose collectives assume a causal or
+fully-bidirectional mask. GLM *fine-tuning* (causal mode, the common
+ChatGLM2/3 SFT setup) uses the ordinary attention stack and shards
+everywhere Llama does.
 """
 
 from __future__ import annotations
