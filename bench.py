@@ -123,6 +123,7 @@ def measure() -> int:
             vocab_size=1024,
         )
     save_logits = os.getenv("BENCH_SAVE_LOGITS", "0") == "1"
+    xent_chunks = int(os.getenv("BENCH_XENT_CHUNKS", "8"))
 
     batch_per_chip = int(os.getenv("BENCH_BATCH_PER_CHIP", "18"))
     batch = batch_per_chip * n_chips
@@ -131,7 +132,8 @@ def measure() -> int:
 
     optimizer = optax.adamw(3e-4, weight_decay=0.1)
     loss = functools.partial(
-        gpt.loss_fn_fused, cfg=cfg, save_logits=save_logits
+        gpt.loss_fn_fused, cfg=cfg, save_logits=save_logits,
+        num_chunks=xent_chunks,
     )
     init, _ = make_sharded_init(
         mesh,

@@ -15,9 +15,9 @@ models/llama.py rather than a parallel module forest:
 
 The GLM-distinctive *training* surface is blank-infilling: a prefix
 of context tokens attends bidirectionally, the generation suffix
-causally (ops/prefix_lm.py — the mask decomposes onto a bidirectional
-prefix-square flash call plus the suffix rows of an ordinary causal
-flash call). :func:`prefix_attention_for` binds a static prefix length
+causally (ops/prefix_lm.py — a bidirectional prefix-square flash
+call plus a rectangular causal call of the suffix queries at their
+global offset, exact cost). :func:`prefix_attention_for` binds a static prefix length
 into an attention fn the backbone scan consumes unchanged, and
 :func:`prefix_lm_loss_fn` scores only suffix positions — the
 blank-infilling objective.

@@ -57,11 +57,14 @@ def _compiler_params(semantics):
 
 
 def _block_mask(iq, jk, block_q, block_k, causal, seq_len, pad,
-                window):
+                window, q_offset=0):
     """Mask for block (iq, jk) — only called for blocks that cross the
     diagonal, the sliding-window band edge, or the padding edge;
-    interior blocks never generate iotas/compares."""
-    q_pos = iq * block_q + jax.lax.broadcasted_iota(
+    interior blocks never generate iotas/compares. ``q_offset``
+    (static) shifts q rows to their global positions — the
+    rectangular case where q is a chunk of a longer sequence
+    (chunked prefill, prefix-LM suffix rows); 0 for square calls."""
+    q_pos = q_offset + iq * block_q + jax.lax.broadcasted_iota(
         jnp.int32, (block_q, block_k), 0
     )
     k_pos = jk * block_k + jax.lax.broadcasted_iota(
@@ -82,18 +85,20 @@ def _block_mask(iq, jk, block_q, block_k, causal, seq_len, pad,
 
 
 def _dispatch_block(iq, jk, accumulate, *, causal, pad, block_q,
-                    block_k, seq_len, window):
+                    block_k, seq_len, window, q_offset=0):
     """Run ``accumulate(masked=...)`` for block (iq, jk), skipping
     fully-future causal blocks and blocks entirely below the sliding
     window band, masking only blocks that cross the diagonal, the
     band edge, or the padding edge — so windowed attention does
-    O(T*window) MXU work, not O(T^2)."""
+    O(T*window) MXU work, not O(T^2). ``q_offset`` shifts q rows to
+    global positions (rectangular calls); 0 for square."""
     if not causal and not pad and window is None:
         accumulate(masked=False)
         return
+    q0 = q_offset + iq * block_q  # first row's global position
     if causal:
-        run = (jk * block_k) <= (iq * block_q + block_q - 1)
-        crosses_diag = (jk * block_k + block_k - 1) > (iq * block_q)
+        run = (jk * block_k) <= (q0 + block_q - 1)
+        crosses_diag = (jk * block_k + block_k - 1) > q0
     else:
         run = True
         crosses_diag = False
@@ -101,17 +106,17 @@ def _dispatch_block(iq, jk, accumulate, *, causal, pad, block_q,
     crosses_band = False
     if window is not None:
         # Lowest visible key for any row in this q block is
-        # (iq*block_q) - window + 1 (the FIRST row's band start); the
+        # q0 - window + 1 (the FIRST row's band start); the
         # block is dead when even its last key is below that.
         run = jnp.logical_and(
             run,
-            (jk * block_k + block_k - 1) >= (iq * block_q - window + 1),
+            (jk * block_k + block_k - 1) >= (q0 - window + 1),
         )
         # The LAST row's band start is the highest; any key below it
         # needs the element mask.
         crosses_band = (
             (jk * block_k)
-            < (iq * block_q + block_q - 1 - window + 1)
+            < (q0 + block_q - 1 - window + 1)
         )
     needs_mask = jnp.logical_and(
         run,
@@ -153,6 +158,7 @@ def _fwd_kernel(
     num_kv: int,
     seq_len: int,
     pad: bool,
+    q_offset: int,
 ):
     iq = pl.program_id(2)
     jk = pl.program_id(3)
@@ -176,7 +182,8 @@ def _fwd_kernel(
             s = s * scale
         if masked:
             mask = _block_mask(
-                iq, jk, block_q, block_k, causal, seq_len, pad, window
+                iq, jk, block_q, block_k, causal, seq_len, pad,
+                window, q_offset,
             )
             s = jnp.where(mask, s, NEG_INF)
 
@@ -206,6 +213,7 @@ def _fwd_kernel(
     _dispatch_block(
         iq, jk, _accumulate, causal=causal, pad=pad, block_q=block_q,
         block_k=block_k, seq_len=seq_len, window=window,
+        q_offset=q_offset,
     )
 
     @pl.when(jk == num_kv - 1)
@@ -219,13 +227,16 @@ def _fwd_kernel(
 
 
 def _fwd(q, k, v, causal, window, scale, block_q, block_k, seq_len,
-         interpret):
-    """q/k/v: [B, H, T, D] (T padded to block multiple). Returns
-    (o [B,H,T,D], lse [B,H,T,1]). ``seq_len`` is the true length:
-    keys beyond it are masked out."""
-    b, h, t, d = q.shape
-    num_q = t // block_q
-    num_kv = t // block_k
+         interpret, q_offset=0):
+    """q: [B, H, Tq, D]; k/v: [B, H, Tk, D] (each padded to its block
+    multiple — Tq == Tk for the square call). Returns (o [B,H,Tq,D],
+    lse [B,H,Tq,1]). ``seq_len`` is the true KEY length: keys beyond
+    it are masked out. ``q_offset`` is the global position of q row 0
+    (causal/window comparisons happen in key coordinates)."""
+    b, h, tq, d = q.shape
+    tk = k.shape[2]
+    num_q = tq // block_q
+    num_kv = tk // block_k
     kernel = functools.partial(
         _fwd_kernel,
         scale=scale,
@@ -235,7 +246,8 @@ def _fwd(q, k, v, causal, window, scale, block_q, block_k, seq_len,
         block_k=block_k,
         num_kv=num_kv,
         seq_len=seq_len,
-        pad=seq_len < t,
+        pad=seq_len < tk,
+        q_offset=q_offset,
     )
     return pl.pallas_call(
         kernel,
@@ -255,8 +267,8 @@ def _fwd(q, k, v, causal, window, scale, block_q, block_k, seq_len,
                          lambda b, h, i, j: (b, h, i, 0)),
         ],
         out_shape=[
-            jax.ShapeDtypeStruct((b, h, t, d), q.dtype),
-            jax.ShapeDtypeStruct((b, h, t, 1), jnp.float32),
+            jax.ShapeDtypeStruct((b, h, tq, d), q.dtype),
+            jax.ShapeDtypeStruct((b, h, tq, 1), jnp.float32),
         ],
         scratch_shapes=[
             pltpu.VMEM((block_q, 1), jnp.float32),
@@ -298,6 +310,7 @@ def _bwd_kernel(
     num_kv: int,
     seq_len: int,
     pad: bool,
+    q_offset: int,
 ):
     jk = pl.program_id(2)  # kv block (outer)
     iq = pl.program_id(3)  # q block (inner)
@@ -326,7 +339,8 @@ def _bwd_kernel(
         p = jnp.exp(s - lse)
         if masked:
             mask = _block_mask(
-                iq, jk, block_q, block_k, causal, seq_len, pad, window
+                iq, jk, block_q, block_k, causal, seq_len, pad,
+                window, q_offset,
             )
             p = jnp.where(mask, p, 0.0)
         pt = p.astype(do.dtype)
@@ -363,6 +377,7 @@ def _bwd_kernel(
     _dispatch_block(
         iq, jk, _accumulate, causal=causal, pad=pad, block_q=block_q,
         block_k=block_k, seq_len=seq_len, window=window,
+        q_offset=q_offset,
     )
 
     @pl.when(iq == num_q - 1)
@@ -377,12 +392,13 @@ def _bwd_kernel(
 
 def _bwd(
     q, k, v, o, lse, do, causal, window, scale, block_q, block_k,
-    seq_len, interpret, g_lse=None,
+    seq_len, interpret, g_lse=None, q_offset=0,
 ):
-    b, h, t, d = q.shape
-    num_q = t // block_q
-    num_kv = t // block_k
-    pad = seq_len < t
+    b, h, tq, d = q.shape
+    tk = k.shape[2]
+    num_q = tq // block_q
+    num_kv = tk // block_k
+    pad = seq_len < tk
     delta = jnp.sum(
         do.astype(jnp.float32) * o.astype(jnp.float32),
         axis=-1,
@@ -404,6 +420,7 @@ def _bwd(
         num_kv=num_kv,
         seq_len=seq_len,
         pad=pad,
+        q_offset=q_offset,
     )
     dq, dk, dv = pl.pallas_call(
         kernel,
@@ -423,19 +440,19 @@ def _bwd(
                          lambda b, h, j, i: (b, h, i, 0)),
         ],
         out_specs=[
-            pl.BlockSpec((1, 1, t, d), lambda b, h, j, i: (b, h, 0, 0)),
+            pl.BlockSpec((1, 1, tq, d), lambda b, h, j, i: (b, h, 0, 0)),
             pl.BlockSpec((1, 1, block_k, d),
                          lambda b, h, j, i: (b, h, j, 0)),
             pl.BlockSpec((1, 1, block_k, d),
                          lambda b, h, j, i: (b, h, j, 0)),
         ],
         out_shape=[
-            jax.ShapeDtypeStruct((b, h, t, d), q.dtype),
-            jax.ShapeDtypeStruct((b, h, t, d), k.dtype),
-            jax.ShapeDtypeStruct((b, h, t, d), v.dtype),
+            jax.ShapeDtypeStruct((b, h, tq, d), q.dtype),
+            jax.ShapeDtypeStruct((b, h, tk, d), k.dtype),
+            jax.ShapeDtypeStruct((b, h, tk, d), v.dtype),
         ],
         scratch_shapes=[
-            pltpu.VMEM((t, d), jnp.float32),
+            pltpu.VMEM((tq, d), jnp.float32),
             pltpu.VMEM((block_k, d), jnp.float32),
             pltpu.VMEM((block_k, d), jnp.float32),
         ],
@@ -453,30 +470,31 @@ def _bwd(
 
 
 @functools.partial(
-    jax.custom_vjp, nondiff_argnums=(3, 4, 5, 6, 7, 8, 9, 10, 11)
+    jax.custom_vjp, nondiff_argnums=(3, 4, 5, 6, 7, 8, 9, 10, 11, 12)
 )
 def _flash(q, k, v, causal, window, scale, block_q, block_k,
-           block_q_bwd, block_k_bwd, seq_len, interpret):
+           block_q_bwd, block_k_bwd, seq_len, interpret, q_offset=0):
     o, _ = _fwd(q, k, v, causal, window, scale, block_q, block_k,
-                seq_len, interpret)
+                seq_len, interpret, q_offset)
     return o
 
 
 def _flash_fwd(q, k, v, causal, window, scale, block_q, block_k,
-               block_q_bwd, block_k_bwd, seq_len, interpret):
+               block_q_bwd, block_k_bwd, seq_len, interpret,
+               q_offset=0):
     o, lse = _fwd(
         q, k, v, causal, window, scale, block_q, block_k, seq_len,
-        interpret
+        interpret, q_offset
     )
     return o, (q, k, v, o, lse)
 
 
 def _flash_bwd(causal, window, scale, block_q, block_k, block_q_bwd,
-               block_k_bwd, seq_len, interpret, res, g):
+               block_k_bwd, seq_len, interpret, q_offset, res, g):
     q, k, v, o, lse = res
     return _bwd(
         q, k, v, o, lse, g, causal, window, scale, block_q_bwd,
-        block_k_bwd, seq_len, interpret,
+        block_k_bwd, seq_len, interpret, q_offset=q_offset,
     )
 
 
@@ -484,36 +502,39 @@ _flash.defvjp(_flash_fwd, _flash_bwd)
 
 
 @functools.partial(
-    jax.custom_vjp, nondiff_argnums=(3, 4, 5, 6, 7, 8, 9, 10, 11)
+    jax.custom_vjp, nondiff_argnums=(3, 4, 5, 6, 7, 8, 9, 10, 11, 12)
 )
 def _flash_lse(q, k, v, causal, window, scale, block_q, block_k,
-               block_q_bwd, block_k_bwd, seq_len, interpret):
+               block_q_bwd, block_k_bwd, seq_len, interpret,
+               q_offset=0):
     """Like _flash but also returns the per-row logsumexp — the
     ingredient ring attention needs to merge normalized block outputs
     across devices (parallel/ring_attention.py)."""
     return _fwd(
         q, k, v, causal, window, scale, block_q, block_k, seq_len,
-        interpret
+        interpret, q_offset
     )
 
 
 def _flash_lse_fwd(q, k, v, causal, window, scale, block_q, block_k,
-                   block_q_bwd, block_k_bwd, seq_len, interpret):
+                   block_q_bwd, block_k_bwd, seq_len, interpret,
+                   q_offset=0):
     o, lse = _fwd(
         q, k, v, causal, window, scale, block_q, block_k, seq_len,
-        interpret
+        interpret, q_offset
     )
     return (o, lse), (q, k, v, o, lse)
 
 
 def _flash_lse_bwd(causal, window, scale, block_q, block_k,
-                   block_q_bwd, block_k_bwd, seq_len, interpret, res,
-                   g):
+                   block_q_bwd, block_k_bwd, seq_len, interpret,
+                   q_offset, res, g):
     g_o, g_lse = g
     q, k, v, o, lse = res
     return _bwd(
         q, k, v, o, lse, g_o, causal, window, scale, block_q_bwd,
         block_k_bwd, seq_len, interpret, g_lse=g_lse,
+        q_offset=q_offset,
     )
 
 
@@ -680,3 +701,107 @@ def flash_attention(
     )
     o = o[:, :, :t].transpose(0, 2, 1, 3)
     return o.astype(q.dtype)
+
+
+def flash_attention_rect(
+    q: jax.Array,
+    k: jax.Array,
+    v: jax.Array,
+    causal: bool = True,
+    q_offset: Optional[int] = None,
+    scale: Optional[float] = None,
+    block_q: Optional[int] = None,
+    block_k: Optional[int] = None,
+    block_q_bwd: Optional[int] = None,
+    block_k_bwd: Optional[int] = None,
+    interpret: Optional[bool] = None,
+    return_lse: bool = False,
+) -> "jax.Array | tuple[jax.Array, jax.Array]":
+    """Rectangular flash attention: q [B, Tq, H, D] against
+    k/v [B, Tk, H, D] with Tq != Tk allowed.
+
+    ``q_offset`` is the global position of q row 0 in key
+    coordinates: causal means q row i attends keys j <= q_offset + i.
+    Defaults to ``Tk - Tq`` — "the queries are the LAST Tq positions
+    of the key sequence", the chunked-prefill convention (a decode
+    chunk attends the whole cache causally). Pass 0 for "queries
+    start at key 0".
+
+    Use cases this unlocks at exact cost (no redundant square rows):
+
+    * chunked prefill — long prompts prefilled in bounded-memory
+      query chunks against the growing cache;
+    * prefix-LM suffix rows (ops/prefix_lm.py) — suffix queries
+      against the full sequence without recomputing prefix rows;
+    * cross-attention — ``causal=False`` with any Tq/Tk.
+
+    Each side pads independently to its own block multiples; padded
+    keys are masked via the true key length, padded q rows are
+    sliced off. Gradients flow to q, k and v (same fused backward,
+    rectangular grid). For Tq == Tk with q_offset == 0, prefer the
+    square :func:`flash_attention` (same kernels, tuned defaults).
+    """
+    if interpret is None:
+        interpret = _use_interpret()
+    b, tq0, h, d = q.shape
+    tk0 = k.shape[1]
+    if q_offset is None:
+        q_offset = tk0 - tq0
+    if causal and q_offset < 0:
+        raise ValueError(
+            f"causal rectangular attention needs q_offset >= 0 "
+            f"(got {q_offset}): q rows before key 0 would attend "
+            "nothing"
+        )
+    if scale is None:
+        scale = 1.0 / (d**0.5)
+    if scale != 1.0 and math.frexp(scale)[0] == 0.5:
+        q = q * jnp.asarray(scale, q.dtype)
+        scale = 1.0
+
+    # Per-side blocks: q-side sizes bound by Tq, k-side by Tk. Same
+    # rules as the square wrapper, applied per side: requests larger
+    # than the side substitute the padded base (so tuned configs that
+    # work on the square kernel keep working here), the coprime guard
+    # runs on the in-range requests, and every final block is rounded
+    # up to the 8-sublane tile (short suffixes like Tq=23 would
+    # otherwise emit an unloweable 23-row block; the round-up costs
+    # at most 7 pad rows).
+    def side(req, req_bwd, t, which):
+        cap = max(t, 8)
+        dflt = default_block_sizes(t)[which]
+        r1 = req or dflt
+        r2 = req_bwd or req or dflt
+        in_range = [r for r in (r1, r2) if r <= cap]
+        unit = _check_block_chain(in_range, t) if in_range else 1
+        padded_base = max(8, math.ceil(t / unit) * unit)
+        return tuple(
+            -(-(r if r <= cap else padded_base) // 8) * 8
+            for r in (r1, r2)
+        )
+
+    bq, bqb = side(block_q, block_q_bwd, tq0, 0)
+    bk, bkb = side(block_k, block_k_bwd, tk0, 1)
+    pad_q = (-tq0) % math.lcm(bq, bqb)
+    pad_k = (-tk0) % math.lcm(bk, bkb)
+
+    def to_kernel(x, pad):
+        x = jnp.transpose(x, (0, 2, 1, 3))
+        if pad:
+            x = jnp.pad(x, ((0, 0), (0, 0), (0, pad), (0, 0)))
+        return x
+
+    qk = to_kernel(q, pad_q)
+    kk_, vk = to_kernel(k, pad_k), to_kernel(v, pad_k)
+    if return_lse:
+        o, lse = _flash_lse(
+            qk, kk_, vk, causal, None, scale, bq, bk, bqb, bkb,
+            tk0, interpret, q_offset,
+        )
+        o = o[:, :, :tq0].transpose(0, 2, 1, 3)
+        return o.astype(q.dtype), lse[:, :, :tq0, 0]
+    o = _flash(
+        qk, kk_, vk, causal, None, scale, bq, bk, bqb, bkb,
+        tk0, interpret, q_offset,
+    )
+    return o[:, :, :tq0].transpose(0, 2, 1, 3).astype(q.dtype)

@@ -13,18 +13,16 @@ The mask decomposes exactly onto kernels we already trust
 
 * a suffix row i >= p attends keys {j <= i} ∪ {j < p} = {j <= i}
   (p <= i makes the prefix part a subset of the causal part) — so
-  suffix rows are PURELY CAUSAL rows of the ordinary causal kernel;
+  suffix rows are PURELY CAUSAL rows at their global offset;
 * a prefix row i < p attends {j <= i} ∪ {j < p} = {j < p} — full
   bidirectional attention within the square prefix block.
 
-So: one non-causal flash call on the p x p prefix, one causal flash
-call on the full t x t sequence, concat prefix rows of the first
-with suffix rows of the second. Both calls are square (the kernel's
-contract); every FLOP runs inside the flash kernel; the composition
-is differentiable through ordinary slicing. The causal call computes
-its first p rows redundantly (~p^2/2 extra MXU work, bounded by 2x
-at p = t) — the price of zero new kernel code paths; a rectangular-
-grid kernel variant can reclaim it later if profiles justify it.
+So: one non-causal flash call on the p x p prefix, one RECTANGULAR
+causal call (flash_attention_rect, q_offset = p) of the s = t - p
+suffix queries against all t keys — exact cost, no redundant prefix
+rows. Every FLOP runs inside the flash kernel; the composition is
+differentiable through ordinary slicing (dk/dv contributions from
+the two calls add where key ranges overlap).
 
 ``prefix_len`` is static — under jit each distinct prefix length
 compiles once, the XLA-friendly contract (SURVEY.md: no
@@ -56,7 +54,10 @@ def prefix_lm_attention(
     flash kernel: ``prefix_len == 0`` is causal attention,
     ``prefix_len == T`` is full bidirectional attention.
     """
-    from dlrover_tpu.ops.flash_attention import flash_attention
+    from dlrover_tpu.ops.flash_attention import (
+        flash_attention,
+        flash_attention_rect,
+    )
 
     b, t, h, d = q.shape
     p = int(prefix_len)
@@ -77,10 +78,11 @@ def prefix_lm_attention(
         q[:, :p], k[:, :p], v[:, :p], causal=False, scale=scale,
         interpret=interpret,
     )
-    o_causal = flash_attention(
-        q, k, v, causal=True, scale=scale, interpret=interpret
+    o_suf = flash_attention_rect(
+        q[:, p:], k, v, causal=True, q_offset=p, scale=scale,
+        interpret=interpret,
     )
-    return jnp.concatenate([o_pre, o_causal[:, p:]], axis=1)
+    return jnp.concatenate([o_pre, o_suf], axis=1)
 
 
 def prefix_lm_attention_reference(

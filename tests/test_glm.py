@@ -4,9 +4,9 @@ Parity targets: the reference's GLM module replacement + parallel GLM
 blocks (/root/reference/atorch/atorch/auto/opt_lib/
 module_replace_optimization.py, atorch/modules/distributed_modules/
 transformer.py). Here GLM is the Llama backbone with config switches
-(models/glm.py) and the prefix-LM mask decomposes onto two square
-flash-kernel calls — bidirectional prefix block + causal suffix rows
-(ops/prefix_lm.py).
+(models/glm.py) and the prefix-LM mask decomposes onto a square
+bidirectional prefix call + a rectangular causal suffix call
+(ops/prefix_lm.py, ops/flash_attention.py flash_attention_rect).
 """
 
 import dataclasses

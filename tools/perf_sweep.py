@@ -15,6 +15,7 @@ Prints one line per config: config, step ms, MFU, vs_baseline.
 from __future__ import annotations
 
 import dataclasses
+import os
 import functools
 import sys
 import time
@@ -93,9 +94,12 @@ def run_config(mesh, spec: str) -> None:
     cfg, attn_fn, batch, save_logits = build_spec(spec)
 
     optimizer = optax.adamw(3e-4, weight_decay=0.1)
+    # SWEEP_XENT_CHUNKS tunes the fused-CE recompute granularity
+    # (bigger chunks = bigger bwd matmuls, more logits HBM at once).
+    chunks = int(os.getenv("SWEEP_XENT_CHUNKS", "8"))
     loss = functools.partial(
         gpt.loss_fn_fused, cfg=cfg, attn_fn=attn_fn,
-        save_logits=save_logits,
+        save_logits=save_logits, num_chunks=chunks,
     )
     init, _ = make_sharded_init(
         mesh,

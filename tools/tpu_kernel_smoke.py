@@ -153,12 +153,42 @@ def flash_checks():
             dense(qo, ko, vo, True), 2e-3,
         ),
     )
-    # GLM prefix-LM composition (square prefix + causal suffix).
+    # GLM prefix-LM composition (square prefix + rectangular causal
+    # suffix) — exercises flash_attention_rect's lowering too.
     check(
         "prefix_lm_composition",
         lambda: _close(
             prefix_lm_attention(q, k, v, SEQ // 3),
             prefix_lm_attention_reference(q, k, v, SEQ // 3), 2e-3,
+        ),
+    )
+    # Rectangular grads (chunked-prefill shape: tail queries against
+    # the full key set, per-side padding).
+    from dlrover_tpu.ops.flash_attention import flash_attention_rect
+
+    def dense_rect(q_, k_, v_):
+        off = k_.shape[1] - q_.shape[1]
+        d_ = q_.shape[-1]
+        s = jnp.einsum(
+            "bqhd,bkhd->bhqk", q_, k_,
+            preferred_element_type=jnp.float32,
+        ) / (d_**0.5)
+        qp = off + jnp.arange(q_.shape[1])[:, None]
+        kp = jnp.arange(k_.shape[1])[None, :]
+        s = jnp.where((kp <= qp)[None, None], s, -jnp.inf)
+        w = jax.nn.softmax(s, axis=-1)
+        return jnp.einsum(
+            "bhqk,bkhd->bqhd", w, v_.astype(jnp.float32)
+        ).astype(q_.dtype)
+
+    tq = SEQ // 4
+    check(
+        "flash_rect_fwd_bwd",
+        lambda: grad_check(
+            lambda q_, k_, v_: flash_attention_rect(
+                q_, k_, v_, causal=True
+            ),
+            dense_rect, q[:, -tq:], k, v, atol=2e-2,
         ),
     )
 
