@@ -350,3 +350,95 @@ def decode_logits_sequential(params, cfg, tokens: jax.Array):
 
     _, logits = jax.lax.scan(body, cache, jnp.arange(t))
     return jnp.swapaxes(logits, 0, 1)
+
+
+def llama_prefill_chunked(params, cache: KVCache, tokens, cfg,
+                          chunk_size: int = 1024, rope=None):
+    """Bounded-memory prefill for LONG prompts: query chunks of
+    ``chunk_size`` run through all layers against the growing cache
+    via the rectangular flash kernel (flash_attention_rect, q_offset
+    = chunk start) — peak attention memory is O(chunk * T) with no
+    [T, T] score tile, versus the one-shot prefill's full-prompt
+    pass. Causal only (a bidirectional GLM prefix cannot be chunked:
+    early chunks would need future prefix context — use
+    ``llama_prefill(causal=False)``).
+
+    Returns the same (last-position logits, filled cache) contract as
+    :func:`llama_prefill`; parity is regression-tested chunk-by-chunk
+    (tests/test_flash_rect.py).
+    """
+    from dlrover_tpu.ops.flash_attention import flash_attention_rect
+
+    if getattr(cfg, "sliding_window", None) is not None:
+        raise ValueError(
+            "chunked prefill does not support sliding_window yet "
+            "(the rectangular kernel has no band masking); use "
+            "llama_prefill"
+        )
+    if getattr(cfg, "prefix_lm", False):
+        raise ValueError(
+            "prefix-LM prompts prefill bidirectionally and cannot "
+            "be chunked (early chunks would need future prefix "
+            "context); use llama_prefill(causal=False)"
+        )
+    B, T0 = tokens.shape
+    Hkv, E = cfg.n_kv_head, cfg.n_embd
+    cos_t, sin_t = rope if rope is not None else llama_mod.rope_table(
+        cfg, cfg.block_size
+    )
+    k_cache, v_cache = cache.k, cache.v
+    x_last = None
+    for start in range(0, T0, chunk_size):
+        end = min(start + chunk_size, T0)
+        c = end - start
+        cos, sin = cos_t[start:end], sin_t[start:end]
+        x = params["wte"][tokens[:, start:end]].astype(cfg.dtype)
+
+        def body(x, layer, start=start, end=end, c=c, cos=cos,
+                 sin=sin):
+            lp, k_c, v_c = layer
+            h = llama_mod._rms_norm(x, lp["rms1"], cfg.rms_eps)
+            q, k, v = _llama_qkv(h, lp, cfg, B, c)
+            q = llama_mod.apply_rope(q, cos, sin)
+            k = llama_mod.apply_rope(k, cos, sin)
+            k_c = jax.lax.dynamic_update_slice(
+                k_c, k, (0, start, 0, 0)
+            )
+            v_c = jax.lax.dynamic_update_slice(
+                v_c, v, (0, start, 0, 0)
+            )
+            k_vis, v_vis = k_c[:, :end], v_c[:, :end]
+            g = cfg.q_per_kv
+            if g == 1:
+                att = flash_attention_rect(
+                    q, k_vis, v_vis, causal=True, q_offset=start,
+                )
+            else:
+                # GQA without expanding the cache: q heads i*g+j use
+                # kv head i, so group j's strided head slice attends
+                # the raw cache — g kernel calls over a small q chunk
+                # instead of a q_per_kv-times K/V copy (which would
+                # peak at the one-shot prefill's footprint, defeating
+                # the point of chunking).
+                outs = [
+                    flash_attention_rect(
+                        q[:, :, j::g], k_vis, v_vis, causal=True,
+                        q_offset=start,
+                    )
+                    for j in range(g)
+                ]
+                att = jnp.stack(outs, axis=3).reshape(
+                    B, c, cfg.n_head, cfg.head_dim
+                )
+            att = att.reshape(B, c, E)
+            x = x + att @ lp["wo"]
+            h = llama_mod._rms_norm(x, lp["rms2"], cfg.rms_eps)
+            return _llama_mlp(x, h, lp, cfg), (k_c, v_c)
+
+        x, (k_cache, v_cache) = jax.lax.scan(
+            body, x, (params["blocks"], k_cache, v_cache)
+        )
+        x_last = x[:, -1:]
+    x = llama_mod._rms_norm(x_last, params["rmsf"], cfg.rms_eps)
+    logits = llama_mod.head_logits(params, x)[:, 0]
+    return logits, KVCache(k=k_cache, v=v_cache)

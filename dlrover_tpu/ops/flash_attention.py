@@ -770,14 +770,17 @@ def flash_attention_rect(
     def side(req, req_bwd, t, which):
         cap = max(t, 8)
         dflt = default_block_sizes(t)[which]
-        r1 = req or dflt
-        r2 = req_bwd or req or dflt
+        # Round to the 8-sublane tile BEFORE the coprime guard — the
+        # guard must judge the blocks that actually pad, or rounding
+        # could silently reintroduce the inflation it rejects (e.g.
+        # 24/12 -> 24/16, lcm 24 -> 48).
+        r1 = -(-(req or dflt) // 8) * 8
+        r2 = -(-(req_bwd or req or dflt) // 8) * 8
         in_range = [r for r in (r1, r2) if r <= cap]
         unit = _check_block_chain(in_range, t) if in_range else 1
-        padded_base = max(8, math.ceil(t / unit) * unit)
+        padded_base = -(-max(8, math.ceil(t / unit) * unit) // 8) * 8
         return tuple(
-            -(-(r if r <= cap else padded_base) // 8) * 8
-            for r in (r1, r2)
+            r if r <= cap else padded_base for r in (r1, r2)
         )
 
     bq, bqb = side(block_q, block_q_bwd, tq0, 0)

@@ -137,3 +137,33 @@ def test_mistral_windowed_decode_matches_forward():
     assert not np.allclose(
         np.asarray(full[:, -1]), np.asarray(want[:, -1]), atol=1e-4
     )
+
+
+def test_chunked_prefill_matches_one_shot(llama_setup):
+    """Bounded-memory chunked prefill (rectangular flash against the
+    growing cache) == the one-shot prefill: same last-position
+    logits, same cache contents — including a final partial chunk."""
+    cfg, params = llama_setup
+    t0 = 40  # chunks of 16 -> 16, 16, 8 (partial tail)
+    prompt = jax.random.randint(
+        jax.random.PRNGKey(11), (2, t0), 0, cfg.vocab_size
+    )
+    cache = generate._cache_for(cfg, 2, t0, cfg.n_kv_head)
+    want_logits, want_cache = generate.llama_prefill(
+        params, cache, prompt, cfg
+    )
+    got_logits, got_cache = generate.llama_prefill_chunked(
+        params, cache, prompt, cfg, chunk_size=16
+    )
+    np.testing.assert_allclose(
+        np.asarray(got_logits), np.asarray(want_logits),
+        atol=2e-4, rtol=1e-3,
+    )
+    np.testing.assert_allclose(
+        np.asarray(got_cache.k), np.asarray(want_cache.k),
+        atol=2e-5, rtol=1e-4,
+    )
+    np.testing.assert_allclose(
+        np.asarray(got_cache.v), np.asarray(want_cache.v),
+        atol=2e-5, rtol=1e-4,
+    )
