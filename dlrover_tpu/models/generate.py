@@ -369,12 +369,6 @@ def llama_prefill_chunked(params, cache: KVCache, tokens, cfg,
     """
     from dlrover_tpu.ops.flash_attention import flash_attention_rect
 
-    if getattr(cfg, "sliding_window", None) is not None:
-        raise ValueError(
-            "chunked prefill does not support sliding_window yet "
-            "(the rectangular kernel has no band masking); use "
-            "llama_prefill"
-        )
     if getattr(cfg, "prefix_lm", False):
         raise ValueError(
             "prefix-LM prompts prefill bidirectionally and cannot "
@@ -407,11 +401,19 @@ def llama_prefill_chunked(params, cache: KVCache, tokens, cfg,
             v_c = jax.lax.dynamic_update_slice(
                 v_c, v, (0, start, 0, 0)
             )
-            k_vis, v_vis = k_c[:, :end], v_c[:, :end]
+            win = getattr(cfg, "sliding_window", None)
+            # Under a band, clamp visible keys to it: per-chunk key
+            # traffic is O(chunk * window), not O(chunk * T) — the
+            # kernel's dead-block skip saves the MXU work but not
+            # the K/V block fetches.
+            lo = 0 if win is None else max(0, start - win + 1)
+            k_vis, v_vis = k_c[:, lo:end], v_c[:, lo:end]
+            off = start - lo
             g = cfg.q_per_kv
             if g == 1:
                 att = flash_attention_rect(
-                    q, k_vis, v_vis, causal=True, q_offset=start,
+                    q, k_vis, v_vis, causal=True, q_offset=off,
+                    window=win,
                 )
             else:
                 # GQA without expanding the cache: q heads i*g+j use
@@ -423,7 +425,7 @@ def llama_prefill_chunked(params, cache: KVCache, tokens, cfg,
                 outs = [
                     flash_attention_rect(
                         q[:, :, j::g], k_vis, v_vis, causal=True,
-                        q_offset=start,
+                        q_offset=off, window=win,
                     )
                     for j in range(g)
                 ]

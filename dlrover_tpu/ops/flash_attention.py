@@ -716,6 +716,7 @@ def flash_attention_rect(
     block_k_bwd: Optional[int] = None,
     interpret: Optional[bool] = None,
     return_lse: bool = False,
+    window: Optional[int] = None,
 ) -> "jax.Array | tuple[jax.Array, jax.Array]":
     """Rectangular flash attention: q [B, Tq, H, D] against
     k/v [B, Tk, H, D] with Tq != Tk allowed.
@@ -753,6 +754,20 @@ def flash_attention_rect(
             f"(got {q_offset}): q rows before key 0 would attend "
             "nothing"
         )
+    if window is not None:
+        # The band compares run in key coordinates with the same
+        # q_offset shift as the causal compare — Mistral chunked
+        # prefill: each chunk does O(chunk * window) work, dead kv
+        # blocks below the band skipped.
+        if not causal:
+            raise ValueError(
+                "window (sliding-window attention) requires "
+                "causal=True"
+            )
+        if window < 1:
+            raise ValueError(f"window must be >= 1, got {window}")
+        if window > q_offset + tq0:
+            window = None  # band covers every visible key
     if scale is None:
         scale = 1.0 / (d**0.5)
     if scale != 1.0 and math.frexp(scale)[0] == 0.5:
@@ -798,13 +813,13 @@ def flash_attention_rect(
     kk_, vk = to_kernel(k, pad_k), to_kernel(v, pad_k)
     if return_lse:
         o, lse = _flash_lse(
-            qk, kk_, vk, causal, None, scale, bq, bk, bqb, bkb,
+            qk, kk_, vk, causal, window, scale, bq, bk, bqb, bkb,
             tk0, interpret, q_offset,
         )
         o = o[:, :, :tq0].transpose(0, 2, 1, 3)
         return o.astype(q.dtype), lse[:, :, :tq0, 0]
     o = _flash(
-        qk, kk_, vk, causal, None, scale, bq, bk, bqb, bkb,
+        qk, kk_, vk, causal, window, scale, bq, bk, bqb, bkb,
         tk0, interpret, q_offset,
     )
     return o[:, :, :tq0].transpose(0, 2, 1, 3).astype(q.dtype)

@@ -145,3 +145,28 @@ def test_chunked_prefill_equals_full_causal():
     np.testing.assert_allclose(
         np.asarray(got), np.asarray(full), atol=2e-5, rtol=1e-4
     )
+
+
+def test_rect_window_matches_dense():
+    """Sliding band + q_offset: the rect kernel's band compares run
+    in key coordinates (Mistral chunked prefill)."""
+    q, k, v = _qkv(jax.random.PRNGKey(6), 16, 48)
+    got = flash_attention_rect(
+        q, k, v, causal=True, window=8, interpret=True
+    )
+    off = 48 - 16
+    b, tq, h, d = q.shape
+    s = jnp.einsum(
+        "bqhd,bkhd->bhqk", q, k, preferred_element_type=jnp.float32
+    ) / (d**0.5)
+    qp = off + jnp.arange(tq)[:, None]
+    kp = jnp.arange(48)[None, :]
+    mask = (kp <= qp) & ((qp - kp) < 8)
+    s = jnp.where(mask[None, None], s, -jnp.inf)
+    want = jnp.einsum(
+        "bhqk,bkhd->bqhd", jax.nn.softmax(s, axis=-1),
+        v.astype(jnp.float32),
+    )
+    np.testing.assert_allclose(
+        np.asarray(got), np.asarray(want), atol=2e-5, rtol=1e-4
+    )

@@ -167,3 +167,35 @@ def test_chunked_prefill_matches_one_shot(llama_setup):
         np.asarray(got_cache.v), np.asarray(want_cache.v),
         atol=2e-5, rtol=1e-4,
     )
+
+
+def test_mistral_chunked_prefill_matches_one_shot():
+    """Windowed chunked prefill == one-shot windowed prefill, with
+    the prompt long enough that the band binds across chunks."""
+    import dataclasses
+
+    cfg = dataclasses.replace(
+        llama.LlamaConfig.tiny(), sliding_window=8, block_size=64
+    )
+    params = llama.init_params(jax.random.PRNGKey(0), cfg)
+    prompt = jax.random.randint(
+        jax.random.PRNGKey(12), (2, 40), 0, cfg.vocab_size
+    )
+    cache = generate._cache_for(cfg, 2, 40, cfg.n_kv_head)
+    want, want_cache = generate.llama_prefill(
+        params, cache, prompt, cfg
+    )
+    got, got_cache = generate.llama_prefill_chunked(
+        params, cache, prompt, cfg, chunk_size=16
+    )
+    np.testing.assert_allclose(
+        np.asarray(got), np.asarray(want), atol=2e-4, rtol=1e-3
+    )
+    np.testing.assert_allclose(
+        np.asarray(got_cache.k), np.asarray(want_cache.k),
+        atol=2e-5, rtol=1e-4,
+    )
+    np.testing.assert_allclose(
+        np.asarray(got_cache.v), np.asarray(want_cache.v),
+        atol=2e-5, rtol=1e-4,
+    )
