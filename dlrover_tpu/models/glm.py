@@ -125,8 +125,9 @@ def prefix_attention_for(
             jax.default_backend() == "tpu" and cfg.block_size >= 512
         )
     if use_flash:
+        blocks = cfg.attn_blocks
         return lambda q, k, v: prefix_lm_attention(
-            q, k, v, prefix_len
+            q, k, v, prefix_len, attn_blocks=blocks
         )
     return lambda q, k, v: prefix_lm_attention_reference(
         q, k, v, prefix_len

@@ -266,14 +266,9 @@ def default_attention_for(cfg: GPTConfig) -> Callable:
     if use_flash:
         from dlrover_tpu.ops.flash_attention import flash_attention
 
-        blocks = getattr(cfg, "attn_blocks", None)
-        block_kwargs = {}
-        if blocks is not None:
-            bq, bk, bqb, bkb = blocks
-            block_kwargs = dict(
-                block_q=bq, block_k=bk,
-                block_q_bwd=bqb, block_k_bwd=bkb,
-            )
+        from dlrover_tpu.ops.flash_attention import blocks_kwargs
+
+        block_kwargs = blocks_kwargs(getattr(cfg, "attn_blocks", None))
         return functools.partial(
             flash_attention, causal=causal, window=window,
             **block_kwargs,

@@ -823,3 +823,16 @@ def flash_attention_rect(
         tk0, interpret, q_offset,
     )
     return o[:, :, :tq0].transpose(0, 2, 1, 3).astype(q.dtype)
+
+
+def blocks_kwargs(attn_blocks: Optional[tuple]) -> dict:
+    """(bq, bk, bqb, bkb) config tuple -> flash call kwargs — the one
+    definition of the ``attn_blocks`` contract (model configs carry
+    the tuple; gpt.default_attention_for and ops/prefix_lm.py unpack
+    it through here)."""
+    if attn_blocks is None:
+        return {}
+    bq, bk, bqb, bkb = attn_blocks
+    return dict(
+        block_q=bq, block_k=bk, block_q_bwd=bqb, block_k_bwd=bkb
+    )

@@ -44,6 +44,7 @@ def prefix_lm_attention(
     prefix_len: int,
     scale: Optional[float] = None,
     interpret: Optional[bool] = None,
+    attn_blocks: Optional[tuple] = None,
 ) -> jax.Array:
     """Prefix-LM attention on [B, T, H, D] inputs.
 
@@ -54,7 +55,10 @@ def prefix_lm_attention(
     flash kernel: ``prefix_len == 0`` is causal attention,
     ``prefix_len == T`` is full bidirectional attention.
     """
+    import math
+
     from dlrover_tpu.ops.flash_attention import (
+        blocks_kwargs,
         flash_attention,
         flash_attention_rect,
     )
@@ -65,22 +69,42 @@ def prefix_lm_attention(
         raise ValueError(f"prefix_len={p} outside [0, {t}]")
     if scale is None:
         scale = 1.0 / (d**0.5)
+    # Flash tile override (bq, bk, bqb, bkb) — the knob the model
+    # configs carry, tuned at the FULL sequence length. The rect
+    # suffix call clamps per side itself; the square sub-calls only
+    # take the tuning when their local length fits it cleanly
+    # (every block <= length and length a multiple of their lcm) —
+    # an arbitrary prefix length falls back to the per-length
+    # defaults rather than tripping the coprime-inflation guard with
+    # tiles the tuning never measured.
+    bkw = blocks_kwargs(attn_blocks)
+
+    def square_bkw(length):
+        if not bkw:
+            return {}
+        vals = tuple(bkw.values())
+        if max(vals) <= length and length % math.lcm(*vals) == 0:
+            return bkw
+        return {}
+
     if p == 0:
         return flash_attention(
-            q, k, v, causal=True, scale=scale, interpret=interpret
+            q, k, v, causal=True, scale=scale, interpret=interpret,
+            **square_bkw(t),
         )
     if p == t:
         return flash_attention(
-            q, k, v, causal=False, scale=scale, interpret=interpret
+            q, k, v, causal=False, scale=scale, interpret=interpret,
+            **square_bkw(t),
         )
 
     o_pre = flash_attention(
         q[:, :p], k[:, :p], v[:, :p], causal=False, scale=scale,
-        interpret=interpret,
+        interpret=interpret, **square_bkw(p),
     )
     o_suf = flash_attention_rect(
         q[:, p:], k, v, causal=True, q_offset=p, scale=scale,
-        interpret=interpret,
+        interpret=interpret, **bkw,
     )
     return jnp.concatenate([o_pre, o_suf], axis=1)
 

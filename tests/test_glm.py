@@ -374,3 +374,20 @@ def test_glm_pipelines_like_llama():
     np.testing.assert_allclose(
         pipe_losses, dense_losses, rtol=2e-3, atol=2e-4
     )
+
+
+@pytest.mark.parametrize("prefix_len", [17, 32])
+def test_prefix_attention_with_tuned_blocks(prefix_len):
+    """attn_blocks tuned at the full length: the suffix rect call
+    clamps per side, the prefix square call takes the tuning only
+    when its length fits cleanly (p=32 with 32-blocks) and falls
+    back to defaults otherwise (p=17) — parity either way."""
+    q, k, v = _qkv(jax.random.PRNGKey(9))
+    got = prefix_lm_attention(
+        q, k, v, prefix_len, interpret=True,
+        attn_blocks=(32, 32, 32, 32),
+    )
+    want = prefix_lm_attention_reference(q, k, v, prefix_len)
+    np.testing.assert_allclose(
+        np.asarray(got), np.asarray(want), atol=2e-5, rtol=1e-4
+    )
