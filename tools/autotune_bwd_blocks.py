@@ -1,7 +1,8 @@
 """Autotune the flash-attention BACKWARD block sizes on real hardware.
 
 Sweeps (block_q_bwd, block_k_bwd) over the divisibility-chain-valid
-grid at the shipped forward blocks (512/1024), full remat, batch 16,
+grid at the shipped forward blocks (1024/1024 — the r4 sweep
+optimum), full remat, batch 18,
 save-logits CE — the bench.py configuration — plus a fused-norm A/B,
 and prints the ranked results with the winning bench spec.
 
@@ -27,7 +28,7 @@ def main() -> int:
     p = argparse.ArgumentParser()
     p.add_argument("--quick", action="store_true",
                    help="only the most promising half of the grid")
-    p.add_argument("--fwd", default="512,1024",
+    p.add_argument("--fwd", default="1024,1024",
                    help="forward block_q,block_k")
     args = p.parse_args()
 
@@ -56,11 +57,12 @@ def main() -> int:
     mesh = build_mesh(MeshConfig(data=len(jax.devices())))
     print(f"sweeping {len(candidates)} bwd-block configs at "
           f"fwd {bq}/{bk} (+ fused-norm A/B at defaults)")
-    # Baseline A/B first: fused norms on (default) vs off.
-    run_config(mesh, f"full,flash,16,{bq},{bk},sl")
-    run_config(mesh, f"full,flash,16,{bq},{bk},sl,-,-,nofn")
+    # Baseline A/B first: fused norms off (the r4-measured default)
+    # vs on — keep re-checking the A/B as kernels evolve.
+    run_config(mesh, f"full,flash,18,{bq},{bk},-,nofn")
+    run_config(mesh, f"full,flash,18,{bq},{bk},-")
     for bqb, bkb in candidates:
-        run_config(mesh, f"full,flash,16,{bq},{bk},sl,{bqb},{bkb}")
+        run_config(mesh, f"full,flash,18,{bq},{bk},-,{bqb},{bkb},nofn")
     print("pick the fastest line; bench.py BENCH_* env then pins it")
     return 0
 

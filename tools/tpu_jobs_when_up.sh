@@ -1,0 +1,44 @@
+#!/bin/bash
+cd /root/repo
+probe() { timeout 90 python -c "import jax.numpy as jnp; (jnp.ones((256,256))@jnp.ones((256,256))).sum()" >/dev/null 2>&1; }
+for i in $(seq 1 200); do
+  if probe; then
+    echo "[$(date +%T)] probe ok (try $i)"
+    if [ ! -f KERNELS_r04.json ]; then
+      echo "[$(date +%T)] running kernel smoke"
+      timeout 1800 python -u tools/tpu_kernel_smoke.py >> /tmp/kernel_smoke.log 2>&1
+      echo "[$(date +%T)] smoke rc=$? (artifact: $(ls KERNELS_r04.json 2>/dev/null || echo none))"
+    elif [ ! -f AGD_CONVERGENCE_r04.json ]; then
+      echo "[$(date +%T)] running agd convergence (200 steps x 2)"
+      timeout 2700 python -u tools/agd_convergence.py --steps 200 >> /tmp/agd_conv.log 2>&1
+      echo "[$(date +%T)] agd rc=$?"
+    elif [ ! -f LONGCTX_r04.json ]; then
+      echo "[$(date +%T)] running long-context bench"
+      timeout 1800 python -u tools/longctx_bench.py >> /tmp/longctx.log 2>&1
+      echo "[$(date +%T)] longctx rc=$?"
+    elif [ ! -f /tmp/final_sweep.txt ]; then
+      echo "[$(date +%T)] final micro-sweep (offload/batch/xent-chunks)"
+      { timeout 1200 python -u tools/perf_sweep.py \
+          'offload,flash,18,1024,1024,-,nofn' \
+          'full,flash,17,1024,1024,-,nofn' \
+          'full,flash,19,1024,1024,-,nofn' ;
+        SWEEP_XENT_CHUNKS=4 timeout 600 python -u tools/perf_sweep.py 'full,flash,18,1024,1024,-,nofn' ;
+        SWEEP_XENT_CHUNKS=16 timeout 600 python -u tools/perf_sweep.py 'full,flash,18,1024,1024,-,nofn' ;
+      } > /tmp/final_sweep.txt 2>&1
+      echo "[$(date +%T)] final sweep done:"; cat /tmp/final_sweep.txt | grep -E "step=|FAILED"
+    elif [ ! -f /tmp/profile_step.txt ]; then
+      echo "[$(date +%T)] profiling the tuned step"
+      timeout 900 python -u tools/profile_step.py 'full,flash,18,1024,1024,-,nofn' > /tmp/profile_step.txt 2>&1
+      echo "[$(date +%T)] profile rc=$? ($(wc -l < /tmp/profile_step.txt) lines)"
+    elif [ ! -f /tmp/bench_stability.json ]; then
+      echo "[$(date +%T)] bench stability re-run"
+      BENCH_MAX_WAIT_S=600 timeout 900 python bench.py > /tmp/bench_stability.json 2>/dev/null
+      echo "[$(date +%T)] bench rc=$?: $(cat /tmp/bench_stability.json)"
+    else
+      echo "[$(date +%T)] all jobs done"; exit 0
+    fi
+  else
+    echo "[$(date +%T)] probe failed (try $i)"
+  fi
+  sleep 90
+done
