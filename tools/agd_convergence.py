@@ -136,7 +136,14 @@ def main() -> int:
                            "(atorch/docs/README-AGD.md:29)",
         "elapsed_s": round(time.time() - t0, 1),
     }
-    with open("AGD_CONVERGENCE_r04.json", "w") as f:
+    # Same artifact gating as the other round tools: only a full-size
+    # run on the real chip writes the round record.
+    on_tpu = jax.default_backend() in ("tpu", "axon")
+    path = (
+        "AGD_CONVERGENCE_r04.json" if (on_tpu and not small)
+        else "/tmp/agd_convergence_check.json"
+    )
+    with open(path, "w") as f:
         json.dump(out, f, indent=1)
     print(json.dumps(
         {"final_adamw": adamw[-1][1], "final_agd": final_agd,

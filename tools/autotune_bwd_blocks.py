@@ -3,7 +3,7 @@
 Sweeps (block_q_bwd, block_k_bwd) over the divisibility-chain-valid
 grid at the shipped forward blocks (1024/1024 — the r4 sweep
 optimum), full remat, batch 18,
-save-logits CE — the bench.py configuration — plus a fused-norm A/B,
+fused CE without saved logits — the bench.py configuration — plus a fused-norm A/B,
 and prints the ranked results with the winning bench spec.
 
 Run (TPU):  python tools/autotune_bwd_blocks.py [--quick]
@@ -58,9 +58,9 @@ def main() -> int:
     print(f"sweeping {len(candidates)} bwd-block configs at "
           f"fwd {bq}/{bk} (+ fused-norm A/B at defaults)")
     # Baseline A/B first: fused norms off (the r4-measured default)
-    # vs on — keep re-checking the A/B as kernels evolve.
+    # vs forced ON — keep re-checking the A/B as kernels evolve.
     run_config(mesh, f"full,flash,18,{bq},{bk},-,nofn")
-    run_config(mesh, f"full,flash,18,{bq},{bk},-")
+    run_config(mesh, f"full,flash,18,{bq},{bk},-,fn")
     for bqb, bkb in candidates:
         run_config(mesh, f"full,flash,18,{bq},{bk},-,{bqb},{bkb},nofn")
     print("pick the fastest line; bench.py BENCH_* env then pins it")

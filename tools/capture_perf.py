@@ -106,8 +106,15 @@ def winner_env(spec: str) -> dict:
     BENCH_* pins bench.py reads. Field layout: perf_sweep.build_spec —
     remat,flash,batch,bq,bk,sl[,bqb,bkb], 'nofn' strippable flag."""
     parts = spec.split(",")
-    fused = "0" if "nofn" in parts else "1"
-    parts = [p for p in parts if p != "nofn"]
+    # Pin fused norms only when the winner spec forced them; an
+    # unflagged spec ran the config default (off since r4), which is
+    # also bench.py's default - no pin needed.
+    fused = None
+    if "nofn" in parts:
+        fused = "0"
+    elif "fn" in parts:
+        fused = "1"
+    parts = [p for p in parts if p not in ("nofn", "fn")]
 
     def blk(i, default):
         if len(parts) <= i or parts[i] == "-":
@@ -118,10 +125,10 @@ def winner_env(spec: str) -> dict:
     bk = blk(4, 1024)
     bqb = blk(6, bq)
     bkb = blk(7, bk)
-    return {
-        "BENCH_BLOCKS": f"{bq},{bk},{bqb},{bkb}",
-        "BENCH_FUSED_NORM": fused,
-    }
+    env = {"BENCH_BLOCKS": f"{bq},{bk},{bqb},{bkb}"}
+    if fused is not None:
+        env["BENCH_FUSED_NORM"] = fused
+    return env
 
 
 def parse_autotune(out: str) -> tuple | None:

@@ -7,7 +7,8 @@ Usage:
          step's non-attention cost by subtraction)
   sl: save-logits cross-entropy variant (pass "sl"; "-" to skip)
   bqb,bkb: backward-kernel block sizes (default = forward blocks)
-  nofn (anywhere): disable the fused Pallas norms (A/B the default)
+  nofn / fn (anywhere): force the fused Pallas norms off / on
+         (absent = config default, off since the r4 measurement)
 
 Prints one line per config: config, step ms, MFU, vs_baseline.
 """
@@ -45,10 +46,17 @@ def build_spec(spec: str):
     flash attention with the kernel's own autotuned block sizes and
     batch 16."""
     parts = spec.split(",")
-    # "nofn" is a flag token, not positional: strip it before the
-    # positional fields so it really works anywhere in the spec.
-    fused_norm = False if "nofn" in parts else None
-    parts = [p for p in parts if p != "nofn"]
+    # "nofn"/"fn" are flag tokens, not positional: strip them before
+    # the positional fields so they really work anywhere in the spec.
+    # nofn forces the fused Pallas norms OFF, fn forces them ON;
+    # absent = the config default (off since r4 — see
+    # gpt.use_fused_norm).
+    fused_norm = None
+    if "nofn" in parts:
+        fused_norm = False
+    elif "fn" in parts:
+        fused_norm = True
+    parts = [p for p in parts if p not in ("nofn", "fn")]
     remat_s = parts[0]
     flash_s = parts[1] if len(parts) > 1 else "flash"
     batch = int(parts[2]) if len(parts) > 2 else 16
