@@ -28,12 +28,13 @@ transfers: the parameters and jaxpr shapes are the backbone's own
 (trajectory parity through the 1F1B pipeline:
 tests/test_glm.py::test_glm_pipelines_like_llama).
 
-Known limitation: the prefix-LM attention is single-shard along the
-sequence — it composes with data/fsdp/tensor/pipe axes but not with
-``seq`` (ring/a2a) sharding, whose collectives assume a causal or
-fully-bidirectional mask. GLM *fine-tuning* (causal mode, the common
-ChatGLM2/3 SFT setup) uses the ordinary attention stack and shards
-everywhere Llama does.
+Sequence sharding: single-shard prefix-LM uses the exact-cost
+composition (ops/prefix_lm.py); under ``seq`` sharding the two-pass
+prefix ring applies (parallel/ring_attention.py
+ring_prefix_lm_attention via make_sharded_prefix_attention — causal
+ring + prefix-masked bidirectional ring + positional select, ~2x a
+causal step). Causal-mode GLM (the common ChatGLM2/3 SFT setup)
+shards everywhere Llama does at no extra cost.
 """
 
 from __future__ import annotations
@@ -100,7 +101,7 @@ loss_fn = llama.loss_fn
 
 
 def prefix_attention_for(
-    cfg: llama.LlamaConfig, prefix_len: int
+    cfg: llama.LlamaConfig, prefix_len: int, mesh=None
 ) -> Callable:
     """Attention fn with GLM's prefix-LM mask bound in.
 
@@ -109,11 +110,24 @@ def prefix_attention_for(
     to a few lengths (the standard XLA static-shape contract).
     Flash-kernel composition when the config would use flash;
     the dense masked reference otherwise.
+
+    Pass ``mesh`` to sequence-shard: a mesh with seq > 1 routes to
+    the fused prefix ring (parallel/ring_attention.py
+    make_sharded_prefix_attention); the default is single-shard.
     """
     from dlrover_tpu.ops.prefix_lm import (
         prefix_lm_attention,
         prefix_lm_attention_reference,
     )
+
+    if mesh is not None and mesh.shape.get("seq", 1) > 1:
+        from dlrover_tpu.parallel.ring_attention import (
+            make_sharded_prefix_attention,
+        )
+
+        return make_sharded_prefix_attention(
+            mesh, prefix_len, attn_blocks=cfg.attn_blocks
+        )
 
     use_flash = cfg.use_flash_attention
     if use_flash is None:

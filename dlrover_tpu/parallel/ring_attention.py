@@ -249,3 +249,203 @@ def make_sharded_attention(
         out_specs=spec,
         check_vma=False,
     )
+
+
+def ring_prefix_lm_attention(
+    q: jax.Array,
+    k: jax.Array,
+    v: jax.Array,
+    prefix_len: int,
+    axis_name: str = "seq",
+    scale: Optional[float] = None,
+    interpret: Optional[bool] = None,
+    attn_blocks: Optional[tuple] = None,
+) -> jax.Array:
+    """GLM prefix-LM attention with the sequence sharded over a ring.
+
+    ONE fused ring scan with two online-softmax accumulators, keeping
+    the SPMD program uniform across devices (per-device static row
+    splits would break shard_map):
+
+    * the CAUSAL accumulator collects each block under the causal
+      ring schedule (earlier slot: dense; resident slot: causal
+      kernel; later: skip) — the exact result for suffix rows, whose
+      prefix keys are a subset of their causal keys;
+    * the PREFIX accumulator collects the same blocks under the
+      prefix-bidirectional schedule: blocks before the boundary
+      attend densely, the ONE block containing the boundary (index
+      ``prefix_len // block`` — static) contributes through a
+      static-shape rectangular flash call over its first
+      ``prefix_len % block`` keys, later blocks are skipped;
+    * rows at global position < prefix_len take the prefix result,
+      the rest the causal one.
+
+    K/V rotate the ring ONCE; a block needed densely by both
+    accumulators is computed once and merged twice. Worst-case cost
+    is under 2x a plain causal ring step — the price of
+    sequence-sharding a mask the collectives can't express directly;
+    single-shard GLM uses the exact-cost composition in
+    ops/prefix_lm.py.
+
+    ``prefix_len`` is the GLOBAL prefix length (static), validated
+    against the global sequence n * block.
+    """
+    from dlrover_tpu.ops.flash_attention import (
+        blocks_kwargs,
+        flash_attention,
+        flash_attention_rect,
+    )
+
+    b, lq, h, d = q.shape
+    n = jax.lax.psum(1, axis_name)
+    my_idx = jax.lax.axis_index(axis_name)
+    if scale is None:
+        scale = 1.0 / (d**0.5)
+    p = int(prefix_len)
+    if not 0 <= p <= n * lq:
+        raise ValueError(
+            f"prefix_len={p} outside [0, {n * lq}] (global seq = "
+            f"{n} ring blocks x {lq})"
+        )
+    bkw = blocks_kwargs(attn_blocks)
+    if p == 0:
+        return ring_attention_flash(
+            q, k, v, axis_name, causal=True, scale=scale,
+            interpret=interpret,
+        )
+
+    b_p = p // lq   # the ring block containing the boundary (static)
+    rem = p - b_p * lq  # prefix keys inside that block (static)
+
+    zeros = (
+        jnp.zeros((b, lq, h, d), jnp.float32),
+        jnp.full((b, h, lq), _NEG, jnp.float32),
+    )
+
+    def dense_blk(q_, k_, v_):
+        o, lse = flash_attention(
+            q_, k_, v_, causal=False, scale=scale,
+            interpret=interpret, return_lse=True, **bkw,
+        )
+        return o.astype(jnp.float32), lse
+
+    def causal_blk(q_, k_, v_):
+        o, lse = flash_attention(
+            q_, k_, v_, causal=True, scale=scale,
+            interpret=interpret, return_lse=True, **bkw,
+        )
+        return o.astype(jnp.float32), lse
+
+    def rect_blk(q_, k_, v_):
+        o, lse = flash_attention_rect(
+            q_, k_[:, :rem], v_[:, :rem], causal=False, q_offset=0,
+            scale=scale, interpret=interpret, return_lse=True,
+        )
+        return o.astype(jnp.float32), lse
+
+    def merge(acc, blk):
+        lse_acc, o_acc = acc
+        o_blk, lse_blk = blk
+        lse_new = jnp.logaddexp(lse_acc, lse_blk)
+        w_acc = jnp.exp(lse_acc - lse_new)
+        w_blk = jnp.exp(lse_blk - lse_new)
+        o_new = (
+            o_acc * w_acc.transpose(0, 2, 1)[..., None]
+            + o_blk * w_blk.transpose(0, 2, 1)[..., None]
+        )
+        return lse_new, o_new
+
+    def step(carry, t):
+        k_blk, v_blk, acc_c, acc_p = carry
+        src = (my_idx - t) % n
+        # The dense block value is shared: computed once when EITHER
+        # schedule needs it (causal: src < my_idx; prefix: src < b_p).
+        need_dense = jnp.logical_or(src < my_idx, src < b_p)
+        dense = jax.lax.cond(
+            need_dense, dense_blk, lambda *_: zeros, q, k_blk, v_blk
+        )
+
+        # Causal accumulator: dense for earlier slots, the causal
+        # kernel on the resident slot, skip for later slots.
+        c_idx = jnp.where(
+            src < my_idx, 0, jnp.where(src == my_idx, 1, 2)
+        )
+        blk_c = jax.lax.switch(
+            c_idx,
+            [lambda: dense, lambda: causal_blk(q, k_blk, v_blk),
+             lambda: zeros],
+        )
+        acc_c = merge(acc_c, blk_c)
+
+        # Prefix accumulator: dense before the boundary block, the
+        # rectangular slice on it (when it has prefix keys), skip
+        # after.
+        if rem > 0:
+            p_idx = jnp.where(
+                src < b_p, 0, jnp.where(src == b_p, 1, 2)
+            )
+            blk_p = jax.lax.switch(
+                p_idx,
+                [lambda: dense, lambda: rect_blk(q, k_blk, v_blk),
+                 lambda: zeros],
+            )
+        else:
+            p_idx = jnp.where(src < b_p, 0, 1)
+            blk_p = jax.lax.switch(
+                p_idx, [lambda: dense, lambda: zeros]
+            )
+        acc_p = merge(acc_p, blk_p)
+
+        perm = [(i, (i + 1) % n) for i in range(n)]
+        k_next = jax.lax.ppermute(k_blk, axis_name, perm)
+        v_next = jax.lax.ppermute(v_blk, axis_name, perm)
+        return (k_next, v_next, acc_c, acc_p), None
+
+    acc0 = (
+        jnp.full((b, h, lq), _NEG, jnp.float32),
+        jnp.zeros((b, lq, h, d), jnp.float32),
+    )
+    (_, _, (_, o_causal), (_, o_prefix)), _ = jax.lax.scan(
+        step, (k, v, acc0, acc0), jnp.arange(n)
+    )
+
+    pos = my_idx * lq + jnp.arange(lq)  # global row positions
+    take_prefix = (pos < p)[None, :, None, None]
+    return jnp.where(take_prefix, o_prefix, o_causal).astype(q.dtype)
+
+
+def make_sharded_prefix_attention(
+    mesh: Mesh,
+    prefix_len: int,
+    axis_name: str = "seq",
+    batch_axes=("data", "fsdp"),
+    head_axis: Optional[str] = "tensor",
+    attn_blocks: Optional[tuple] = None,
+):
+    """Prefix-LM attention for a mesh — the GLM analogue of
+    :func:`make_sharded_attention`. With ``seq`` sharding it runs the
+    fused two-accumulator ring (:func:`ring_prefix_lm_attention`);
+    without, the exact-cost single-shard composition
+    (ops/prefix_lm.py). ``attn_blocks`` threads the tuned flash
+    tiles through either path (model configs carry it)."""
+    if mesh.shape.get(axis_name, 1) == 1:
+        from dlrover_tpu.ops.prefix_lm import prefix_lm_attention
+
+        return functools.partial(
+            prefix_lm_attention, prefix_len=prefix_len,
+            attn_blocks=attn_blocks,
+        )
+    spec = P(batch_axes, axis_name, head_axis, None)
+    fn = functools.partial(
+        ring_prefix_lm_attention,
+        prefix_len=prefix_len,
+        axis_name=axis_name,
+        attn_blocks=attn_blocks,
+    )
+    return shard_map(
+        fn,
+        mesh=mesh,
+        in_specs=(spec, spec, spec),
+        out_specs=spec,
+        check_vma=False,
+    )
