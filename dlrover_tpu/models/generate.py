@@ -23,9 +23,9 @@ scan-over-sequence and never pay cache plumbing.
 
 from __future__ import annotations
 
-import dataclasses
+
 import functools
-from typing import Any, Callable, Dict, NamedTuple, Optional
+from typing import Any, Dict, NamedTuple, Optional
 
 import jax
 import jax.numpy as jnp

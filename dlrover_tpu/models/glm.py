@@ -40,7 +40,7 @@ shards everywhere Llama does at no extra cost.
 from __future__ import annotations
 
 import dataclasses
-from typing import Callable, Optional
+from typing import Callable
 
 import jax
 import jax.numpy as jnp
