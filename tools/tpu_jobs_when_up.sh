@@ -21,7 +21,14 @@ import jax.numpy as jnp
 " >/dev/null 2>&1
 }
 
+# Hard deadline: stop well before the round's driver-side bench
+# capture so two clients never contend for the single chip.
+DEADLINE_EPOCH=${DEADLINE_EPOCH:-0}
 for i in $(seq 1 200); do
+  if [ "$DEADLINE_EPOCH" -gt 0 ] && [ "$(date +%s)" -ge "$DEADLINE_EPOCH" ]; then
+    echo "[$(date +%T)] deadline reached; exiting to free the chip"
+    exit 0
+  fi
   if probe; then
     echo "[$(date +%T)] probe ok (try $i)"
     if [ ! -f KERNELS_r04.json ]; then
