@@ -141,17 +141,13 @@ def _seq_attention_opts(model_loss) -> Dict:
     while isinstance(fn, functools.partial):
         cfg = fn.keywords.get("cfg")
         if cfg is not None:
-            if getattr(cfg, "sliding_window", None) is not None:
-                # The ring/a2a schedules shard keys across devices and
-                # have no band-skip logic; silently dropping the window
-                # would change the model. Single-device flash supports
-                # it (ops/flash_attention.py window=).
-                raise NotImplementedError(
-                    "sliding_window attention does not compose with "
-                    "sequence parallelism yet — use a strategy without "
-                    "a seq axis for windowed configs"
-                )
             opts: Dict = {}
+            if getattr(cfg, "sliding_window", None) is not None:
+                # The ring statically skips band-dead hops, the a2a
+                # passes the band to its full-sequence inner kernel
+                # (parallel/ring_attention.py, parallel/ulysses.py) —
+                # windowed models shard over ``seq`` at banded cost.
+                opts["window"] = cfg.sliding_window
             pin = getattr(cfg, "use_flash_attention", None)
             if pin is not None:
                 opts["impl"] = "flash" if pin else "xla"

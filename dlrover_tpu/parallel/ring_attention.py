@@ -62,10 +62,17 @@ def ring_attention(
     axis_name: str = "seq",
     causal: bool = False,
     scale: Optional[float] = None,
+    window: Optional[int] = None,
 ) -> jax.Array:
     """Attention where q/k/v are sharded over ``axis_name`` on the
     sequence dimension. Shapes (per-device): [batch, seq_local, heads,
     head_dim]. Must run inside shard_map with ``axis_name`` unmapped.
+
+    ``window`` (requires ``causal=True``) applies the sliding-window
+    band by masking only — every ring step still runs, so this XLA
+    fallback is correct but O(T^2/shards); the flash path
+    (:func:`ring_attention_flash`) statically skips band-dead ring
+    steps and is the one to use for long windowed sequences.
     """
     b, lq, h, d = q.shape
     lk = k.shape[1]
@@ -73,6 +80,10 @@ def ring_attention(
     my_idx = jax.lax.axis_index(axis_name)
     if scale is None:
         scale = 1.0 / (d**0.5)
+    if window is not None and not causal:
+        raise ValueError(
+            "window (sliding-window attention) requires causal=True"
+        )
 
     q_pos = my_idx * lq + jnp.arange(lq)  # global query positions
 
@@ -82,6 +93,10 @@ def ring_attention(
         if causal:
             kv_pos = src_idx * lk + jnp.arange(lk)
             mask = q_pos[None, None, :, None] >= kv_pos[None, None, None, :]
+            if window is not None:
+                mask &= (
+                    q_pos[None, None, :, None] - kv_pos[None, None, None, :]
+                ) < window
         else:
             mask = None
         m_blk, l_blk, o_blk = _block_attn(q, k_blk, v_blk, scale, mask)
@@ -122,6 +137,7 @@ def ring_attention_flash(
     causal: bool = False,
     scale: Optional[float] = None,
     interpret: Optional[bool] = None,
+    window: Optional[int] = None,
 ) -> jax.Array:
     """Ring attention with the Pallas flash kernel as the per-block
     engine: each ring step runs flash attention against the resident
@@ -137,14 +153,38 @@ def ring_attention_flash(
     causal kernel, later slots are skipped (zero compute beyond the
     branch). Per-device work is therefore imbalanced by ring position
     — inherent to causal ring attention.
+
+    ``window`` (requires ``causal=True``) runs Mistral-style
+    sliding-window attention with a STATICALLY truncated ring: a K/V
+    block at ring distance t spans key offsets [t*lq - lq + 1,
+    t*lq + lq - 1] from its queries, so once (t-1)*lq + 1 > window-1
+    the block is outside the band for EVERY device and the schedule
+    stops — both compute and ppermute hops truncate to
+    t_stop = min(n-1, (window + lq - 2) // lq), giving
+    O(T * window / shards) work and O(window) communication per
+    device instead of O(T^2/shards) / O(T). Live non-resident steps
+    run the rectangular banded kernel (flash_attention_rect with
+    q_offset = t*lq) at exact cost.
     """
-    from dlrover_tpu.ops.flash_attention import flash_attention
+    from dlrover_tpu.ops.flash_attention import (
+        flash_attention,
+        flash_attention_rect,
+    )
 
     b, lq, h, d = q.shape
     n = jax.lax.psum(1, axis_name)
     my_idx = jax.lax.axis_index(axis_name)
     if scale is None:
         scale = 1.0 / (d**0.5)
+    if window is not None:
+        if not causal:
+            raise ValueError(
+                "window (sliding-window attention) requires causal=True"
+            )
+        if window < 1:
+            raise ValueError(f"window must be >= 1, got {window}")
+        if window >= n * lq:
+            window = None  # band covers the global sequence
 
     def flash_blk(q_, k_, v_, causal_):
         o, lse = flash_attention(
@@ -152,6 +192,12 @@ def ring_attention_flash(
             interpret=interpret, return_lse=True,
         )
         return o.astype(jnp.float32), lse
+
+    if window is not None:
+        return _ring_flash_windowed(
+            q, k, v, axis_name, int(window), scale, interpret,
+            flash_attention, flash_attention_rect,
+        )
 
     def step(carry, t):
         k_blk, v_blk, lse_acc, o_acc = carry
@@ -192,6 +238,80 @@ def ring_attention_flash(
     return o_f.astype(q.dtype)
 
 
+def _ring_flash_windowed(
+    q, k, v, axis_name, window, scale, interpret,
+    flash_attention, flash_attention_rect,
+):
+    """Sliding-window causal ring (see ring_attention_flash docstring).
+
+    The loop over ring distance t is a STATIC Python loop (n is the
+    static mesh-axis size), so the band-dead tail of the ring —
+    distances with (t-1)*lq + 1 > window-1 — is never traced at all:
+    no flash calls, no ppermute hops. Per live step:
+
+    * t = 0: the resident block, square causal+window kernel;
+    * t >= 1: the block sits at static key offset t*lq below the
+      queries — devices with my_idx >= t run the banded rectangular
+      kernel (q_offset = t*lq makes the causal compare inactive and
+      the window compare exact); devices with my_idx < t would
+      receive a wrapped FUTURE block, and contribute zeros via
+      lax.cond. (Per the SPMD cond caveat on ring_prefix_lm_attention,
+      XLA may compute both branches and select — correctness is
+      unaffected; the static truncation above is where the asymptotic
+      saving lives and it does not depend on cond lowering.)
+    """
+    b, lq, h, d = q.shape
+    n = jax.lax.psum(1, axis_name)
+    my_idx = jax.lax.axis_index(axis_name)
+    t_stop = min(n - 1, (window + lq - 2) // lq)
+
+    zeros = (
+        jnp.zeros((b, lq, h, d), jnp.float32),
+        jnp.full((b, h, lq), _NEG, jnp.float32),
+    )
+
+    def resident(q_, k_, v_):
+        o, lse = flash_attention(
+            q_, k_, v_, causal=True, window=window, scale=scale,
+            interpret=interpret, return_lse=True,
+        )
+        return o.astype(jnp.float32), lse
+
+    def banded(q_, k_, v_, off):
+        o, lse = flash_attention_rect(
+            q_, k_, v_, causal=True, q_offset=off, window=window,
+            scale=scale, interpret=interpret, return_lse=True,
+        )
+        return o.astype(jnp.float32), lse
+
+    lse_acc = jnp.full((b, h, lq), _NEG, jnp.float32)
+    o_acc = jnp.zeros((b, lq, h, d), jnp.float32)
+    k_blk, v_blk = k, v
+    for t in range(t_stop + 1):
+        if t == 0:
+            o_blk, lse_blk = resident(q, k_blk, v_blk)
+        else:
+            o_blk, lse_blk = jax.lax.cond(
+                my_idx >= t,
+                lambda q_, k_, v_, t=t: banded(q_, k_, v_, t * lq),
+                lambda *_: zeros,
+                q, k_blk, v_blk,
+            )
+        lse_new = jnp.logaddexp(lse_acc, lse_blk)
+        w_acc = jnp.exp(lse_acc - lse_new)
+        w_blk = jnp.exp(lse_blk - lse_new)
+        o_acc = (
+            o_acc * w_acc.transpose(0, 2, 1)[..., None]
+            + o_blk * w_blk.transpose(0, 2, 1)[..., None]
+        )
+        lse_acc = lse_new
+        if t < t_stop:
+            perm = [(i, (i + 1) % n) for i in range(n)]
+            k_blk = jax.lax.ppermute(k_blk, axis_name, perm)
+            v_blk = jax.lax.ppermute(v_blk, axis_name, perm)
+    return o_acc.astype(q.dtype)
+
+
 def make_sharded_attention(
     mesh: Mesh,
     causal: bool = True,
@@ -199,6 +319,7 @@ def make_sharded_attention(
     batch_axes=("data", "fsdp"),
     head_axis: Optional[str] = "tensor",
     impl: str = "auto",
+    window: Optional[int] = None,
 ):
     """Wrap ring attention in shard_map for the given mesh.
 
@@ -208,9 +329,18 @@ def make_sharded_attention(
     ``impl``: "flash" uses the Pallas per-block kernel
     (ring_attention_flash), "xla" the einsum path (ring_attention),
     "auto" picks flash on TPU.
+
+    ``window`` (requires ``causal=True``) applies Mistral-style
+    sliding-window attention on every path: the flash ring statically
+    skips band-dead ring hops (O(T*window/shards) work), the XLA ring
+    masks, and the single-shard fallbacks pass it to the kernel.
     """
     if impl not in ("auto", "flash", "xla"):
         raise ValueError(f"unknown ring attention impl {impl!r}")
+    if window is not None and not causal:
+        raise ValueError(
+            "window (sliding-window attention) requires causal=True"
+        )
     use_flash = (
         impl == "flash"
         or (impl == "auto" and jax.default_backend() == "tpu")
@@ -221,26 +351,25 @@ def make_sharded_attention(
         if use_flash:
             from dlrover_tpu.ops.flash_attention import flash_attention
 
-            return functools.partial(flash_attention, causal=causal)
+            return functools.partial(
+                flash_attention, causal=causal, window=window
+            )
 
-        # No sequence sharding: plain (still jit-fused) attention.
-        def plain(q, k, v):
-            b, lq, h, d = q.shape
-            scale = 1.0 / (d**0.5)
-            mask = None
-            if causal:
-                pos = jnp.arange(lq)
-                mask = pos[None, None, :, None] >= pos[None, None, None, :]
-            m, l, o = _block_attn(q, k, v, scale, mask)
-            l = jnp.maximum(l, 1e-20)
-            return (o / l.transpose(0, 2, 1)[..., None]).astype(q.dtype)
+        # No sequence sharding: plain (still jit-fused) attention —
+        # the one definition of the dense causal/window mask lives in
+        # gpt._default_attention (ulysses.py's degenerate path ends
+        # here too).
+        from dlrover_tpu.models.gpt import _default_attention
 
-        return plain
+        return functools.partial(
+            _default_attention, causal=causal, window=window
+        )
 
     fn = functools.partial(
         ring_attention_flash if use_flash else ring_attention,
         axis_name=axis_name,
         causal=causal,
+        window=window,
     )
     return shard_map(
         fn,
@@ -286,6 +415,19 @@ def ring_prefix_lm_attention(
     sequence-sharding a mask the collectives can't express directly;
     single-shard GLM uses the exact-cost composition in
     ops/prefix_lm.py.
+
+    Cost caveat (unverified on hardware): the ``lax.cond``/
+    ``lax.switch`` predicates here depend on the traced
+    ``axis_index``, and under SPMD partitioning XLA may lower such
+    conditionals to compute-both-branches + select rather than a real
+    branch. If it does, the skip/dense gating saves nothing and a
+    worst-case step costs up to dense + causal + rect per slot (~3x a
+    causal ring step) in FLOPs — still correct, and still O(T^2 /
+    shards) memory, but the FLOP saving advertised above should be
+    confirmed with a per-op profile on a real chip before relying on
+    it (tools/profile_step.py). A masking-based schedule (zeroing
+    contributions instead of branching) would make the cost explicit
+    and uniform if profiling shows both branches execute.
 
     ``prefix_len`` is the GLOBAL prefix length (static), validated
     against the global sequence n * block.

@@ -51,13 +51,16 @@ def make_seq_attention(
     head_axis: Optional[str] = "tensor",
     impl: str = "auto",
     seq_impl: str = "auto",
+    window: Optional[int] = None,
 ):
     """Sharded attention for a mesh with a ``seq`` axis.
 
     ``impl`` picks the kernel (flash/xla/auto, as in
     ring_attention.make_sharded_attention); ``seq_impl`` picks the
-    parallelism family (ring/a2a/auto). The returned fn takes global
-    [B, T, H, D] q/k/v under jit.
+    parallelism family (ring/a2a/auto). ``window`` applies the
+    sliding-window band on whichever family is chosen (ring: static
+    band-dead hop skipping; a2a: banded inner kernel). The returned
+    fn takes global [B, T, H, D] q/k/v under jit.
     """
     if seq_impl not in SEQ_IMPLS:
         raise ValueError(
@@ -72,6 +75,7 @@ def make_seq_attention(
         batch_axes=batch_axes,
         head_axis=head_axis,
         impl=impl,
+        window=window,
     )
     if seq_impl == "ring":
         return make_sharded_attention(mesh, **kwargs)

@@ -85,6 +85,7 @@ def make_a2a_attention(
     batch_axes=("data", "fsdp"),
     head_axis: Optional[str] = "tensor",
     impl: str = "auto",
+    window: Optional[int] = None,
 ):
     """shard_map wrapper mirroring ring_attention.make_sharded_attention
     — drop-in for a model's ``attn_fn`` on a mesh with a ``seq`` axis.
@@ -94,9 +95,21 @@ def make_a2a_attention(
     with tensor parallelism the same way the ring does (heads shard
     over ``tensor`` first; the a2a then needs heads_per_tensor_shard %
     seq_shards == 0).
+
+    ``window`` (requires ``causal=True``): after the all_to_all every
+    device holds the FULL sequence for its head group, so the band is
+    just the inner kernel's ``window`` — the flash kernel skips
+    band-dead kv blocks (O(T*window) per device), the plain path
+    masks. Communication is unchanged (the a2a moves activations, not
+    K/V blocks, so unlike the ring there is no band-dead traffic to
+    skip).
     """
     if impl not in ("auto", "flash", "xla"):
         raise ValueError(f"unknown a2a attention impl {impl!r}")
+    if window is not None and not causal:
+        raise ValueError(
+            "window (sliding-window attention) requires causal=True"
+        )
     use_flash = (
         impl == "flash"
         or (impl == "auto" and jax.default_backend() == "tpu")
@@ -111,12 +124,21 @@ def make_a2a_attention(
         return make_sharded_attention(
             mesh, causal=causal, axis_name=axis_name,
             batch_axes=batch_axes, head_axis=head_axis, impl=impl,
+            window=window,
         )
 
     if use_flash:
         from dlrover_tpu.ops.flash_attention import flash_attention
 
-        inner = functools.partial(flash_attention, causal=causal)
+        inner = functools.partial(
+            flash_attention, causal=causal, window=window
+        )
+    elif window is not None:
+        from dlrover_tpu.models.gpt import _default_attention
+
+        inner = functools.partial(
+            _default_attention, causal=causal, window=window
+        )
     else:
         inner = None  # a2a_attention's default plain path
 

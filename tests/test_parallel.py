@@ -85,6 +85,132 @@ class TestRingAttention:
         )
 
 
+class TestWindowedSeqParallel:
+    """Sliding-window attention composed with sequence sharding
+    (VERDICT r4 weak #3): the windowed flash ring (static band-dead
+    hop skipping), the masked XLA ring, and the banded a2a must all
+    match single-device windowed attention, forward and backward."""
+
+    def _qkv(self, b=2, t=64, h=4, d=16, seed=7):
+        kq, kk, kv = jax.random.split(jax.random.PRNGKey(seed), 3)
+        shape = (b, t, h, d)
+        return (
+            jax.random.normal(kq, shape, jnp.float32),
+            jax.random.normal(kk, shape, jnp.float32),
+            jax.random.normal(kv, shape, jnp.float32),
+        )
+
+    # Windows chosen against lq=16 (t=64 over seq=4): inside one ring
+    # block, exactly one block, spanning two, spanning all, and wider
+    # than the sequence (degenerates to plain causal).
+    @pytest.mark.parametrize("window", [5, 16, 20, 40, 100])
+    @pytest.mark.parametrize("impl", ["flash", "xla"])
+    def test_ring_forward_matches_plain(self, window, impl):
+        mesh = build_mesh(MeshConfig(seq=4, data=2))
+        q, k, v = self._qkv()
+        ring = make_sharded_attention(
+            mesh, causal=True, impl=impl, window=window
+        )
+        got = jax.jit(ring)(q, k, v)
+        want = gpt._default_attention(
+            q, k, v, causal=True, window=window
+        )
+        np.testing.assert_allclose(
+            np.asarray(got), np.asarray(want), atol=2e-5, rtol=2e-5
+        )
+
+    @pytest.mark.parametrize("window", [5, 20])
+    def test_ring_gradients_match_plain(self, window):
+        mesh = build_mesh(MeshConfig(seq=4, data=2))
+        q, k, v = self._qkv(b=2, t=32, h=2, d=8)
+        ring = make_sharded_attention(
+            mesh, causal=True, impl="flash", window=window
+        )
+
+        def loss_ring(q, k, v):
+            return jnp.sum(jnp.square(ring(q, k, v)))
+
+        def loss_plain(q, k, v):
+            return jnp.sum(jnp.square(gpt._default_attention(
+                q, k, v, causal=True, window=window
+            )))
+
+        g1 = jax.jit(jax.grad(loss_ring, argnums=(0, 1, 2)))(q, k, v)
+        g2 = jax.grad(loss_plain, argnums=(0, 1, 2))(q, k, v)
+        for a, b_ in zip(g1, g2):
+            np.testing.assert_allclose(
+                np.asarray(a), np.asarray(b_), atol=5e-5, rtol=5e-4
+            )
+
+    @pytest.mark.parametrize("impl", ["flash", "xla"])
+    def test_a2a_forward_matches_plain(self, impl):
+        from dlrover_tpu.parallel.ulysses import make_a2a_attention
+
+        mesh = build_mesh(MeshConfig(seq=4, data=2))
+        q, k, v = self._qkv()
+        a2a = make_a2a_attention(
+            mesh, causal=True, impl=impl, window=20
+        )
+        got = jax.jit(a2a)(q, k, v)
+        want = gpt._default_attention(q, k, v, causal=True, window=20)
+        np.testing.assert_allclose(
+            np.asarray(got), np.asarray(want), atol=2e-5, rtol=2e-5
+        )
+
+    def test_seq_attention_dispatcher_passes_window(self):
+        from dlrover_tpu.parallel.seq_attention import make_seq_attention
+
+        mesh = build_mesh(MeshConfig(seq=4, data=2))
+        q, k, v = self._qkv()
+        for seq_impl in ("ring", "a2a", "auto"):
+            attn = make_seq_attention(
+                mesh, causal=True, seq_impl=seq_impl, window=12
+            )
+            got = jax.jit(attn)(q, k, v)
+            want = gpt._default_attention(
+                q, k, v, causal=True, window=12
+            )
+            np.testing.assert_allclose(
+                np.asarray(got), np.asarray(want),
+                atol=2e-5, rtol=2e-5, err_msg=seq_impl,
+            )
+
+    def test_window_requires_causal(self):
+        mesh = build_mesh(MeshConfig(seq=4, data=2))
+        with pytest.raises(ValueError, match="causal"):
+            make_sharded_attention(
+                mesh, causal=False, impl="flash", window=8
+            )
+
+    def test_windowed_ring_truncates_hops(self):
+        """The band-dead ring tail must not be traced: with window <=
+        lq the flash ring unrolls to exactly one ppermute pair (t=1
+        reaches back lq-1 keys; t>=2 is statically dead), and a window
+        reaching back two blocks to two pairs — checked on the jaxpr,
+        where the static truncation is visible without compiling."""
+        from dlrover_tpu.parallel.ring_attention import (
+            ring_attention_flash,
+        )
+        from jax import shard_map
+
+        mesh = build_mesh(MeshConfig(seq=4, data=2))
+        spec = P(("data",), "seq", None, None)
+        q, k, v = self._qkv()  # t=64 over seq=4 -> lq=16
+
+        def count_ppermutes(window):
+            fn = shard_map(
+                functools.partial(
+                    ring_attention_flash, causal=True, window=window
+                ),
+                mesh=mesh, in_specs=(spec, spec, spec),
+                out_specs=spec, check_vma=False,
+            )
+            return str(jax.make_jaxpr(fn)(q, k, v)).count("ppermute")
+
+        assert count_ppermutes(16) == 2   # k+v, t=1 only
+        assert count_ppermutes(20) == 4   # band reaches block t=2
+
+
 class TestRingFlashAttention:
     """Ring attention with the Pallas flash kernel per block
     (interpret mode on the CPU mesh) vs plain attention."""
