@@ -366,6 +366,14 @@ def llama_prefill_chunked(params, cache: KVCache, tokens, cfg,
     Returns the same (last-position logits, filled cache) contract as
     :func:`llama_prefill`; parity is regression-tested chunk-by-chunk
     (tests/test_flash_rect.py).
+
+    Compilation note: the Python chunk loop traces one program per
+    distinct (chunk start, chunk length) pair per call — ceil(T0 /
+    chunk_size) compiles on first use for a given prompt length.
+    Amortized over a long prompt this is cheap (the final ragged chunk
+    is the only shape that varies between prompt lengths), but latency-
+    sensitive servers should bucket prompt lengths to multiples of
+    ``chunk_size``.
     """
     from dlrover_tpu.ops.flash_attention import flash_attention_rect
 
@@ -376,6 +384,11 @@ def llama_prefill_chunked(params, cache: KVCache, tokens, cfg,
             "context); use llama_prefill(causal=False)"
         )
     B, T0 = tokens.shape
+    if T0 < 1:
+        raise ValueError(
+            "llama_prefill_chunked needs at least one prompt token "
+            f"(got tokens of shape {tokens.shape})"
+        )
     Hkv, E = cfg.n_kv_head, cfg.n_embd
     cos_t, sin_t = rope if rope is not None else llama_mod.rope_table(
         cfg, cfg.block_size

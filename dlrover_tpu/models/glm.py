@@ -174,6 +174,14 @@ def prefix_lm_loss_fn(
     target lies outside the sequence (callers following the
     ``jnp.roll(tokens, -1)`` convention would otherwise supervise
     wrap-around garbage)."""
+    t_static = tokens.shape[1]
+    if max(prefix_len - 1, 0) >= t_static - 1:
+        raise ValueError(
+            f"prefix_len={prefix_len} leaves no supervised positions in a "
+            f"length-{t_static} sequence (band [{max(prefix_len - 1, 0)}, "
+            f"{t_static - 1}) is empty) — a mis-bucketed batch would train "
+            "on nothing"
+        )
     x, aux = llama.backbone_with_aux(
         params, tokens, cfg, prefix_attention_for(cfg, prefix_len)
     )

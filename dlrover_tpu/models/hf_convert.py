@@ -209,7 +209,22 @@ def _interleaved_to_halves_perm(rot: int) -> np.ndarray:
 
 def glm_config_from_hf(hf_config) -> LlamaConfig:
     """Map a ChatGLM2/3 HF config onto the native GLM shape
-    (models/glm.py: Llama backbone + qkv bias + half-dim rotary)."""
+    (models/glm.py: Llama backbone + qkv bias + half-dim rotary).
+
+    Long-context ChatGLM checkpoints (e.g. the 32k variants) scale the
+    rotary base by ``rope_ratio`` — HF's modeling_chatglm computes
+    ``base = 10000 * rope_ratio`` — so it is read into rope_theta here
+    rather than silently defaulted.  ``original_rope`` flips the
+    interleaved rotary convention; the permutation mapping assumes the
+    standard (True) layout, so a False value is rejected rather than
+    converted wrong."""
+    if not getattr(hf_config, "original_rope", True):
+        raise ValueError(
+            "ChatGLM config has original_rope=False (non-standard "
+            "rotary layout); the interleaved->split-halves rotary "
+            "permutation in glm_params_from_hf assumes the standard "
+            "layout and would convert this checkpoint incorrectly"
+        )
     return LlamaConfig(
         vocab_size=hf_config.padded_vocab_size,
         block_size=hf_config.seq_length,
@@ -225,6 +240,7 @@ def glm_config_from_hf(hf_config) -> LlamaConfig:
         rms_eps=hf_config.layernorm_epsilon,
         qkv_bias=getattr(hf_config, "add_qkv_bias", True),
         rotary_pct=0.5,
+        rope_theta=10000.0 * getattr(hf_config, "rope_ratio", 1.0),
         # Same generation semantics as the native presets: prompts
         # prefill bidirectionally (models/glm.py).
         prefix_lm=True,

@@ -169,6 +169,17 @@ def test_chunked_prefill_matches_one_shot(llama_setup):
     )
 
 
+def test_chunked_prefill_rejects_empty_prompt(llama_setup):
+    """A zero-length prompt would previously crash deep inside
+    _rms_norm with x_last=None (ADVICE r4); it must fail at the API
+    boundary with a clear message."""
+    cfg, params = llama_setup
+    empty = jnp.zeros((2, 0), jnp.int32)
+    cache = generate._cache_for(cfg, 2, 8, cfg.n_kv_head)
+    with pytest.raises(ValueError, match="at least one prompt token"):
+        generate.llama_prefill_chunked(params, cache, empty, cfg)
+
+
 def test_mistral_chunked_prefill_matches_one_shot():
     """Windowed chunked prefill == one-shot windowed prefill, with
     the prompt long enough that the band binds across chunks."""

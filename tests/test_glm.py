@@ -133,6 +133,21 @@ def test_prefix_loss_scores_suffix_band_only(cfg, params):
         assert abs(float(l0) - float(l2)) > 1e-7, idx
 
 
+def test_prefix_loss_rejects_empty_supervision_band(cfg, params):
+    """prefix_len >= T leaves zero supervised positions; a mis-bucketed
+    batch must fail loudly instead of training on aux-only ~0 loss
+    (ADVICE r4)."""
+    t = cfg.block_size
+    tokens = jnp.zeros((1, t), jnp.int32)
+    targets = jnp.zeros((1, t), jnp.int32)
+    for bad_p in (t, t + 5):
+        with pytest.raises(ValueError, match="no supervised positions"):
+            glm.prefix_lm_loss_fn(params, tokens, targets, cfg, bad_p)
+    # The largest legal prefix supervises exactly one position (T-2).
+    l = glm.prefix_lm_loss_fn(params, tokens, targets, cfg, t - 1)
+    assert jnp.isfinite(l)
+
+
 def test_qkv_bias_params_and_grads(cfg, params):
     """The GLM config materializes q/k/v biases and they receive
     gradient (i.e. they are actually wired into the block)."""
@@ -313,6 +328,33 @@ def test_chatglm_hf_conversion_roundtrip(cfg):
     sd["transformer.encoder.layers.0.mystery.weight"] = np.ones(3)
     with pytest.raises(ValueError, match="does not map"):
         glm_params_from_hf(sd, cfg)
+
+
+def test_glm_hf_config_reads_rope_ratio():
+    """Long-context ChatGLM checkpoints scale the rotary base via
+    rope_ratio (HF: base = 10000 * rope_ratio); a converter that
+    hard-codes theta=10000 would load 32k variants with wrong rotary
+    frequencies (ADVICE r4). Non-standard original_rope layouts must
+    refuse instead of converting wrong."""
+    from types import SimpleNamespace
+
+    from dlrover_tpu.models.hf_convert import glm_config_from_hf
+
+    def hf_cfg(**extra):
+        return SimpleNamespace(
+            padded_vocab_size=64, seq_length=128, num_layers=2,
+            num_attention_heads=4, multi_query_attention=True,
+            multi_query_group_num=2, hidden_size=32,
+            ffn_hidden_size=96, layernorm_epsilon=1e-5,
+            add_qkv_bias=True, **extra,
+        )
+
+    assert glm_config_from_hf(hf_cfg()).rope_theta == 10000.0
+    assert glm_config_from_hf(
+        hf_cfg(rope_ratio=50.0)
+    ).rope_theta == 500000.0
+    with pytest.raises(ValueError, match="original_rope"):
+        glm_config_from_hf(hf_cfg(original_rope=False))
 
 
 def test_glm_pipelines_like_llama():
