@@ -111,6 +111,7 @@ def make_gpt_pipeline_step(
     v_chunks: int = 1,
     attn_fn=None,
     batch_axes: Tuple[str, ...] = ("data", "fsdp"),
+    seq_axis: Optional[str] = None,
 ):
     """Build ``step(params, opt_state, tokens, targets) -> (params,
     opt_state, metrics)`` training the FULL GPT with its block stack
@@ -118,7 +119,23 @@ def make_gpt_pipeline_step(
     assembly lives in models/pipeline_lm.py). ``tokens`` [B, T] is
     cut into ``n_micro`` microbatches (default 2 * pipe size, the
     bubble-amortizing 1F1B convention).
+
+    ``seq_axis`` shards the token dimension over that mesh axis
+    inside the schedule (see make_pipelined_lm_step); the caller must
+    then supply an ``attn_fn`` that is collective over the axis
+    (e.g. ring attention called directly — the stage body is already
+    inside shard_map). GPT is seq-shard-friendly at the edges: the
+    positional embedding is added at embed time on the full sequence,
+    and the head loss is a shard-local token mean the schedule
+    pmean-corrects.
     """
+    if seq_axis is not None and mesh.shape.get(seq_axis, 1) > 1 \
+            and attn_fn is None:
+        raise ValueError(
+            "seq_axis sharding needs an explicitly collective attn_fn "
+            "(the default dense attention would silently attend "
+            "within each sequence shard only)"
+        )
     n_stages = mesh.shape.get("pipe", 1)
     if cfg.n_layer % (n_stages * v_chunks):
         raise ValueError(
@@ -150,6 +167,7 @@ def make_gpt_pipeline_step(
         n_micro=n_micro,
         v_chunks=v_chunks,
         batch_axes=batch_axes,
+        seq_axis=seq_axis,
     )
 
 

@@ -36,18 +36,34 @@ def make_pipelined_lm_step(
     v_chunks: int = 1,
     batch_axes: Tuple[str, ...] = ("data", "fsdp"),
     stage_aux: bool = False,
+    seq_axis: Optional[str] = None,
 ):
     """Build ``step(params, opt_state, tokens, targets)`` training the
     full LM with its block stack 1F1B-pipelined. ``params`` and
     ``opt_state`` stay in the model's native layout (checkpoints and
     elastic restarts are pipeline-agnostic); the stage split/merge
-    happens inside the jitted step."""
+    happens inside the jitted step.
+
+    ``seq_axis`` additionally shards the TOKEN dimension of every
+    microbatch (and target) over that mesh axis — sequence parallelism
+    inside the pipeline. The caller's ``stage_fn`` then sees
+    [mb, T/shards, E] activations inside an already-manual region and
+    must use collective attention directly (e.g.
+    ring_attention(axis_name=seq_axis), NOT a shard_map-wrapped
+    constructor), with any position-dependent terms (rope tables)
+    offset by the shard's axis_index. The 1F1B body's loss/grad pmean
+    over the combined batch+seq axes turns shard-local token means
+    into the exact global mean (equal shard sizes).
+    """
     if n_micro is None:
         n_micro = max(2 * n_stages, 1)
     batch_axes = tuple(
         a for a in batch_axes if mesh.shape.get(a, 1) > 1
     )
-    batch_spec = P(batch_axes) if batch_axes else P()
+    if seq_axis is not None and mesh.shape.get(seq_axis, 1) > 1:
+        batch_spec = P(batch_axes if batch_axes else None, seq_axis)
+    else:
+        batch_spec = P(batch_axes) if batch_axes else P()
 
     pipe_step = pipeline_train(
         mesh,

@@ -1,0 +1,145 @@
+"""16-device pipe x fsdp x seq x tensor composition dryrun (VERDICT r4
+weak #5 / next #5): the 7B/v5p-32 CI search emits mixed-parallel plans
+(tests/test_accelerate.py::test_llama2_7b_plan_for_v5p32_in_ci), but
+no mesh with ALL of pipe/fsdp/seq/tensor > 1 had ever been executed,
+even virtually. This runs exactly that composition — the v5p-32 plan
+family's mesh shape halved onto 16 virtual CPU devices (2x2x2x2),
+scaled-down GPT dims — through TWO full 1F1B training steps:
+
+* ``pipe``    — the 1F1B block-stack schedule (parallel/pipeline.py);
+* ``fsdp``    — microbatch rows sharded, loss/grads pmean'd across it;
+* ``seq``     — the TOKEN dimension sharded inside the schedule
+                (models/pipeline_lm.py seq_axis) with ring attention
+                called directly in the already-manual stage body;
+* ``tensor``  — attention heads split per shard inside the stage
+                (each tensor shard computes its head slice of the
+                ring, all_gather'd back).
+
+Ref analogue: atorch's mixed_parallel as an executable (not just
+plannable) method, atorch/auto/opt_lib/optimization_library.py:38-56.
+
+Run (fresh process — the device-count flag binds at first jax init):
+    python -u tools/dryrun_7b_composition.py
+Invoked as a subprocess by __graft_entry__.dryrun_multichip so the
+driver's MULTICHIP artifact carries this phase's result line.
+"""
+
+from __future__ import annotations
+
+import os
+import sys
+
+N_DEVICES = 16
+
+os.environ["JAX_PLATFORMS"] = "cpu"
+_flags = os.environ.get("XLA_FLAGS", "")
+if "xla_force_host_platform_device_count" not in _flags:
+    os.environ["XLA_FLAGS"] = (
+        _flags + f" --xla_force_host_platform_device_count={N_DEVICES}"
+    ).strip()
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, REPO)
+
+
+def main() -> int:
+    import jax
+
+    jax.config.update("jax_platforms", "cpu")
+    if len(jax.devices()) < N_DEVICES:
+        raise RuntimeError(
+            f"need {N_DEVICES} virtual devices, got "
+            f"{len(jax.devices())} — run in a fresh process"
+        )
+    import jax.numpy as jnp
+    import optax
+
+    from dlrover_tpu.models import gpt
+    from dlrover_tpu.models.gpt_pipeline import (
+        make_gpt_pipeline_step,
+        shard_params_for_pipeline,
+    )
+    from dlrover_tpu.parallel.mesh import MeshConfig, build_mesh
+    from dlrover_tpu.parallel.ring_attention import ring_attention
+
+    mesh = build_mesh(
+        MeshConfig(pipe=2, fsdp=2, seq=2, tensor=2),
+        devices=jax.devices()[:N_DEVICES],
+    )
+    assert all(
+        mesh.shape[a] > 1 for a in ("pipe", "fsdp", "seq", "tensor")
+    ), dict(mesh.shape)
+
+    # GPT-2 scaled down ~1000x: 4 layers over pipe=2 (v_chunks=1),
+    # 8 heads split over tensor=2, 64-token blocks split over seq=2.
+    cfg = gpt.GPTConfig(
+        vocab_size=256,
+        block_size=64,
+        n_layer=4,
+        n_head=8,
+        n_embd=64,
+        dtype=jnp.float32,
+        remat=True,
+    )
+
+    def attn_fn(q, k, v):
+        """Collective attention inside the pipeline's manual region:
+        heads split over ``tensor`` (each shard runs its slice of the
+        seq ring, outputs all_gather'd back — the stage weights are
+        replicated over tensor, so only attention compute shards),
+        sequence blocks over the ``seq`` ring."""
+        tp = jax.lax.psum(1, "tensor")  # static axis size
+        tidx = jax.lax.axis_index("tensor")
+        hp = q.shape[2] // tp
+
+        def sl(x):
+            return jax.lax.dynamic_slice_in_dim(
+                x, tidx * hp, hp, axis=2
+            )
+
+        o = ring_attention(
+            sl(q), sl(k), sl(v), axis_name="seq", causal=True
+        )
+        return jax.lax.all_gather(o, "tensor", axis=2, tiled=True)
+
+    optimizer = optax.adamw(1e-3)
+    step = make_gpt_pipeline_step(
+        mesh, cfg, optimizer, n_micro=4, attn_fn=attn_fn,
+        batch_axes=("data", "fsdp"), seq_axis="seq",
+    )
+    params = shard_params_for_pipeline(
+        mesh, gpt.init_params(jax.random.PRNGKey(0), cfg)
+    )
+    opt_state = optimizer.init(params)
+    tokens = jax.random.randint(
+        jax.random.PRNGKey(1), (8, cfg.block_size), 0, cfg.vocab_size
+    )
+    targets = jnp.roll(tokens, -1, axis=1)
+
+    # Parity oracle: the same model/init/batch through the dense
+    # single-program loss — the 4-axis composition must compute the
+    # SAME objective, not merely a finite one.
+    dense_params = gpt.init_params(jax.random.PRNGKey(0), cfg)
+    want = float(gpt.loss_fn(dense_params, tokens, targets, cfg=cfg))
+
+    params, opt_state, metrics = step(params, opt_state, tokens, targets)
+    loss1 = float(metrics["loss"])
+    assert loss1 == loss1, "7B-composition loss is NaN"
+    assert abs(loss1 - want) < 5e-3, (
+        f"composition loss {loss1:.5f} != dense oracle {want:.5f}"
+    )
+    # Second step proves the updated (still-sharded) params re-enter
+    # the compiled step — the full train-loop contract, not a one-off.
+    params, opt_state, metrics = step(params, opt_state, tokens, targets)
+    loss2 = float(metrics["loss"])
+    assert loss2 == loss2 and loss2 < loss1, (loss1, loss2)
+    print(
+        f"dryrun 7b-composition ok: mesh={dict(mesh.shape)} "
+        f"devices={N_DEVICES} loss={loss1:.4f}->{loss2:.4f} "
+        f"(dense oracle {want:.4f})"
+    )
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())
