@@ -5,7 +5,7 @@ nanoGPT pretraining (BASELINE.md; /root/reference/atorch/docs/
 README-AGD.md:29). This runs both optimizers on identical data and
 init for N steps of the bench model (GPT-2 124M unless --small) and
 reports loss-at-step plus steps-to-target ratios, writing
-AGD_CONVERGENCE_r04.json.
+AGD_CONVERGENCE_r05.json.
 
 Run:  python tools/agd_convergence.py [--small] [--steps N]
 """
@@ -137,10 +137,24 @@ def main() -> int:
         "elapsed_s": round(time.time() - t0, 1),
     }
     # Same artifact gating as the other round tools: only a full-size
-    # run on the real chip writes the round record.
+    # run on the real chip writes the round record. VERDICT r4 next #3
+    # sanctioned fallback: if the tunnel stays dead all round,
+    # AGD_ALLOW_CPU=1 lets a reduced-scale CPU run write the round
+    # artifact — loudly labeled, never silently passed off as
+    # hardware-scale.
     on_tpu = jax.default_backend() in ("tpu", "axon")
+    cpu_fallback = (
+        os.environ.get("AGD_ALLOW_CPU") == "1" and not on_tpu
+    )
+    if cpu_fallback:
+        out["note"] = (
+            "CPU fallback at reduced scale (TPU tunnel unavailable "
+            "all round) — convergence-ratio evidence only; absolute "
+            "wall-clock numbers are not hardware-representative"
+        )
+        out["scale"] = "reduced-cpu"
     path = (
-        "AGD_CONVERGENCE_r04.json" if (on_tpu and not small)
+        "AGD_CONVERGENCE_r05.json" if (on_tpu and not small) or cpu_fallback
         else "/tmp/agd_convergence_check.json"
     )
     with open(path, "w") as f:

@@ -5,7 +5,7 @@ its throughput as T grows — an O(T^2)-HBM attention would OOM where
 flash is merely compute-bound, and the sliding-window band should
 approach T/(2W) speedup as dead kv blocks are skipped. This measures
 flash fwd+bwd at long T (GPT-2-shaped heads) plus the banded variant,
-and writes LONGCTX_r04.json.
+and writes LONGCTX_r05.json.
 
 Run:  python tools/longctx_bench.py [--max-t 32768]
 """
@@ -93,7 +93,7 @@ def main() -> int:
         "results": results,
     }
     path = (
-        "LONGCTX_r04.json"
+        "LONGCTX_r05.json"
         if (jax.default_backend() in ("tpu", "axon") and not cpu_check)
         else "/tmp/longctx_check.json"
     )

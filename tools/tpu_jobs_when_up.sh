@@ -1,10 +1,12 @@
 #!/bin/bash
-# Probe-gated chain of the round's hardware jobs: the moment the TPU
-# tunnel answers, land (in order) the kernel smoke, the AGD
-# convergence artifact, the long-context bench, a final micro-sweep,
-# a step profile, and a bench stability re-run. Each stage's gate is
-# an artifact written ONLY on success, so a tunnel drop mid-stage
-# retries on the next probe instead of permanently skipping.
+# Probe-gated chain of the round's hardware jobs, ordered per the
+# round-4 verdict: the moment the TPU tunnel answers, land the bench
+# record FIRST (PERF_r05.json), the kernel smoke SECOND
+# (KERNELS_r05.json), then the multi-run stability record, AGD
+# convergence, long-context bench, decode bench, a step profile, and
+# finally the long autotune+tuned re-bench. Each stage's gate is an
+# artifact written ONLY on success, so a tunnel drop mid-stage retries
+# on the next probe instead of permanently skipping.
 #
 # Run:  nohup tools/tpu_jobs_when_up.sh >> /tmp/tpu_jobs.log 2>&1 &
 set -u
@@ -24,41 +26,37 @@ import jax.numpy as jnp
 # Hard deadline: stop well before the round's driver-side bench
 # capture so two clients never contend for the single chip.
 DEADLINE_EPOCH=${DEADLINE_EPOCH:-0}
-for i in $(seq 1 200); do
+for i in $(seq 1 400); do
   if [ "$DEADLINE_EPOCH" -gt 0 ] && [ "$(date +%s)" -ge "$DEADLINE_EPOCH" ]; then
     echo "[$(date +%T)] deadline reached; exiting to free the chip"
     exit 0
   fi
   if probe; then
     echo "[$(date +%T)] probe ok (try $i)"
-    if [ ! -f KERNELS_r04.json ]; then
+    if [ ! -f PERF_r05.json ]; then
+      echo "[$(date +%T)] landing the baseline bench record"
+      CAPTURE_STAGE=baseline timeout 1800 python -u tools/capture_perf.py >> /tmp/capture_perf.log 2>&1
+      echo "[$(date +%T)] baseline rc=$? (artifact: $(ls PERF_r05.json 2>/dev/null || echo none))"
+    elif [ ! -f KERNELS_r05.json ]; then
       echo "[$(date +%T)] running kernel smoke"
       timeout 1800 python -u tools/tpu_kernel_smoke.py >> /tmp/kernel_smoke.log 2>&1
-      echo "[$(date +%T)] smoke rc=$? (artifact: $(ls KERNELS_r04.json 2>/dev/null || echo none))"
-    elif [ ! -f AGD_CONVERGENCE_r04.json ]; then
+      echo "[$(date +%T)] smoke rc=$? (artifact: $(ls KERNELS_r05.json 2>/dev/null || echo none))"
+    elif [ ! -f STABILITY_r05.json ]; then
+      echo "[$(date +%T)] bench stability (3 runs)"
+      timeout 3600 python -u tools/bench_stability.py >> /tmp/bench_stability.log 2>&1
+      echo "[$(date +%T)] stability rc=$?"
+    elif [ ! -f AGD_CONVERGENCE_r05.json ]; then
       echo "[$(date +%T)] running agd convergence (200 steps x 2)"
       timeout 2700 python -u tools/agd_convergence.py --steps 200 >> /tmp/agd_conv.log 2>&1
       echo "[$(date +%T)] agd rc=$?"
-    elif [ ! -f LONGCTX_r04.json ]; then
+    elif [ ! -f LONGCTX_r05.json ]; then
       echo "[$(date +%T)] running long-context bench"
       timeout 1800 python -u tools/longctx_bench.py >> /tmp/longctx.log 2>&1
       echo "[$(date +%T)] longctx rc=$?"
-    elif [ ! -f /tmp/final_sweep.txt ]; then
-      echo "[$(date +%T)] final micro-sweep (offload/batch/xent-chunks)"
-      { timeout 1200 python -u tools/perf_sweep.py \
-          'offload,flash,18,1024,1024,-,nofn' \
-          'full,flash,17,1024,1024,-,nofn' \
-          'full,flash,19,1024,1024,-,nofn' ;
-        SWEEP_XENT_CHUNKS=4 timeout 600 python -u tools/perf_sweep.py 'full,flash,18,1024,1024,-,nofn' ;
-        SWEEP_XENT_CHUNKS=16 timeout 600 python -u tools/perf_sweep.py 'full,flash,18,1024,1024,-,nofn' ;
-      } > /tmp/final_sweep.partial 2>&1
-      # Gate only on real results: at least one timed line.
-      if grep -q "step=" /tmp/final_sweep.partial; then
-        mv /tmp/final_sweep.partial /tmp/final_sweep.txt
-        echo "[$(date +%T)] final sweep done:"; grep -E "step=|FAILED" /tmp/final_sweep.txt
-      else
-        echo "[$(date +%T)] final sweep produced no results; will retry"
-      fi
+    elif [ -f tools/decode_bench.py ] && [ ! -f DECODE_r05.json ]; then
+      echo "[$(date +%T)] running decode bench"
+      timeout 1800 python -u tools/decode_bench.py >> /tmp/decode_bench.log 2>&1
+      echo "[$(date +%T)] decode rc=$?"
     elif [ ! -f /tmp/profile_step.txt ]; then
       echo "[$(date +%T)] profiling the tuned step"
       if timeout 900 python -u tools/profile_step.py 'full,flash,18,1024,1024,-,nofn' > /tmp/profile_step.partial 2>&1; then
@@ -67,15 +65,14 @@ for i in $(seq 1 200); do
       else
         echo "[$(date +%T)] profile failed rc=$?; will retry"
       fi
-    elif [ ! -f /tmp/bench_stability.json ]; then
-      echo "[$(date +%T)] bench stability re-run"
-      BENCH_MAX_WAIT_S=600 timeout 900 python bench.py > /tmp/bench_stability.partial 2>>/tmp/bench_stability.err
-      if grep -q '"error"' /tmp/bench_stability.partial || ! grep -q '"value"' /tmp/bench_stability.partial; then
-        echo "[$(date +%T)] bench stability failed; will retry: $(cat /tmp/bench_stability.partial)"
-      else
-        mv /tmp/bench_stability.partial /tmp/bench_stability.json
-        echo "[$(date +%T)] bench stability: $(cat /tmp/bench_stability.json)"
-      fi
+    elif [ ! -f /tmp/capture_tune.done ]; then
+      echo "[$(date +%T)] autotune + tuned re-bench"
+      CAPTURE_STAGE=tune timeout 5400 python -u tools/capture_perf.py >> /tmp/capture_perf.log 2>&1
+      rc=$?
+      # The tune stage appends to PERF_r05.json on success; a rc=0 with
+      # no autotune results also returns 0 — either way, done once.
+      [ $rc -eq 0 ] && touch /tmp/capture_tune.done
+      echo "[$(date +%T)] tune rc=$rc"
     else
       echo "[$(date +%T)] all jobs done"; exit 0
     fi

@@ -321,7 +321,7 @@ def main() -> int:
     # hardware lowering, and must not masquerade as the record.
     on_tpu = jax.default_backend() in ("tpu", "axon")
     path = (
-        "KERNELS_r04.json" if (on_tpu and not SMALL)
+        "KERNELS_r05.json" if (on_tpu and not SMALL)
         else "/tmp/kernel_smoke_harness.json"
     )
     with open(path, "w") as f:
