@@ -96,8 +96,14 @@ def main() -> int:
             steps = int(sys.argv[i + 1])
     cfg = gpt.GPTConfig.gpt2() if not small else gpt.GPTConfig.nano()
     if small:
+        # Reduced-scale dims, overridable (AGD_LAYERS/BLOCK/VOCAB env)
+        # so the CPU fallback can run a mid-size study instead of the
+        # 2-layer nano default when wall-clock allows.
         cfg = dataclasses.replace(
-            cfg, n_layer=2, block_size=128, vocab_size=1024,
+            cfg,
+            n_layer=int(os.environ.get("AGD_LAYERS", 2)),
+            block_size=int(os.environ.get("AGD_BLOCK", 128)),
+            vocab_size=int(os.environ.get("AGD_VOCAB", 1024)),
             dtype=jnp.float32, remat=False,
         )
     mesh = build_mesh(MeshConfig(data=len(jax.devices())))
@@ -126,7 +132,11 @@ def main() -> int:
             "speedup": (round(sa / sb, 3) if sa and sb else None),
         }
     out = {
-        "model": "gpt2-124M" if not small else "nano-small",
+        "model": (
+            "gpt2-124M" if not small else
+            f"nano-small(L{cfg.n_layer},T{cfg.block_size},"
+            f"V{cfg.vocab_size})"
+        ),
         "steps": steps,
         "backend": jax.default_backend(),
         "adamw_trace": adamw,

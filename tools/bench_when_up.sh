@@ -3,7 +3,7 @@
 # number (VERDICT r4 item 1). Runs the health-gated bench, the
 # backward-block autotune + fused-norm A/B, pins winners via env, and
 # re-runs the bench; every successful measurement also lands in
-# builder-side PERF_r04.json so a later capture-window outage cannot
+# builder-side PERF_r05.json so a later capture-window outage cannot
 # erase the story.
 #
 # Usage: tools/bench_when_up.sh  (run from the repo root)
@@ -23,11 +23,11 @@ rec = json.loads(line)
 rec.update(stage="baseline", config="shipped defaults",
            ts=time.strftime("%Y-%m-%dT%H:%M:%SZ", time.gmtime()))
 hist = []
-try: hist = json.load(open("PERF_r04.json"))
+try: hist = json.load(open("PERF_r05.json"))
 except Exception: pass
 hist.append(rec)
-json.dump(hist, open("PERF_r04.json", "w"), indent=1)
-print("PERF_r04.json <-", rec)
+json.dump(hist, open("PERF_r05.json", "w"), indent=1)
+print("PERF_r05.json <-", rec)
 EOF
 
 echo "[$(STAMP)] step 2: backward-block autotune + fused-norm A/B"
@@ -36,4 +36,4 @@ python tools/autotune_bwd_blocks.py --quick | tee /tmp/autotune.txt
 echo "[$(STAMP)] step 3: re-bench with the autotune winner pinned"
 echo "  (read the winner line from /tmp/autotune.txt, export the"
 echo "   BENCH_* env it names, rerun: python bench.py, and append to"
-echo "   PERF_r04.json as stage=tuned)"
+echo "   PERF_r05.json as stage=tuned)"

@@ -192,7 +192,11 @@ def main() -> int:
     best = parse_autotune(out)
     if best is None:
         log("no autotune results; stopping after baseline")
-        return 0
+        # In tune-only mode the job chain keys its done-marker on
+        # rc=0; an empty autotune usually means the tunnel died
+        # mid-sweep, so report retryable and let the next probe
+        # re-enter the stage.
+        return 2 if stage_sel == "tune" else 0
     spec, ms = best
     log(f"autotune winner: {spec} at {ms}ms")
     pins = winner_env(spec)

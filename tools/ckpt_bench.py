@@ -61,7 +61,6 @@ def main() -> int:
     from dlrover_tpu.agent.ckpt_saver import AsyncCheckpointSaver
     from dlrover_tpu.trainer.flash_checkpoint.engine import (
         CheckpointEngine,
-        flatten_named,
         pack_shard_file,
     )
 

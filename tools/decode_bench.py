@@ -106,18 +106,26 @@ def main() -> int:
     print(f"[decode] gpt2-shape prefill: {dt*1e3:.1f} ms "
           f"({rec['gpt2_prefill_tok_s']} tok/s)", flush=True)
 
-    # Steady-state decode tok/s via the full generate loop: subtract
-    # the measured prefill to isolate the scan.
-    gen_fn = jax.jit(
-        lambda p, pr, k: generate.generate(
-            p, cfg, pr, max_new_tokens=new, temperature=0.0, key=k
+    # Steady-state decode tok/s: difference two generate lengths so
+    # prefill and fixed overheads cancel exactly (subtracting a
+    # separately-jitted prefill underflows when the two programs
+    # optimize differently).
+    def gen_at(n_new):
+        fn = jax.jit(
+            lambda p, pr, k: generate.generate(
+                p, cfg, pr, max_new_tokens=n_new, temperature=0.0,
+                key=k,
+            )
         )
-    )
-    dt_gen, _ = timed(gen_fn, params, prompt, jax.random.PRNGKey(2))
-    decode_s = max(dt_gen - dt, 1e-9)
-    rec["gpt2_generate_ms"] = round(dt_gen * 1e3, 2)
-    rec["gpt2_decode_tok_s"] = round(b * new / decode_s, 1)
-    rec["gpt2_decode_ms_per_tok"] = round(decode_s / new * 1e3, 3)
+        d, _ = timed(fn, params, prompt, jax.random.PRNGKey(2))
+        return d
+
+    half = max(new // 2, 1)
+    dt_full, dt_half = gen_at(new), gen_at(new - half)
+    decode_s = max(dt_full - dt_half, 1e-9)
+    rec["gpt2_generate_ms"] = round(dt_full * 1e3, 2)
+    rec["gpt2_decode_tok_s"] = round(b * half / decode_s, 1)
+    rec["gpt2_decode_ms_per_tok"] = round(decode_s / half * 1e3, 3)
     print(f"[decode] gpt2-shape decode: {rec['gpt2_decode_tok_s']} "
           f"tok/s ({rec['gpt2_decode_ms_per_tok']} ms/tok, "
           f"batch {b})", flush=True)
@@ -134,12 +142,15 @@ def main() -> int:
     dt_mono, _ = timed(mono_fn, mparams, mcache, mprompt)
     rec["mistral_prefill_mono_ms"] = round(dt_mono * 1e3, 2)
 
-    # Chunked prefill traces one program per chunk; timing includes
-    # only post-warmup calls (timed() warms up the whole loop).
-    def chunked(p, c, tok):
-        return generate.llama_prefill_chunked(
+    # jit the whole chunk loop (it unrolls at trace time) so both
+    # prefill paths compare as compiled programs — unjitted, the
+    # chunked path would pay per-op dispatch the monolithic one
+    # doesn't.
+    chunked = jax.jit(
+        lambda p, c, tok: generate.llama_prefill_chunked(
             p, c, tok, mcfg, chunk_size=chunk
         )
+    )
 
     dt_chunk, _ = timed(chunked, mparams, mcache, mprompt)
     rec["mistral_prefill_chunked_ms"] = round(dt_chunk * 1e3, 2)
@@ -151,17 +162,26 @@ def main() -> int:
           f"mono {dt_mono*1e3:.1f} ms vs chunked {dt_chunk*1e3:.1f} ms",
           flush=True)
 
-    # Windowed decode tok/s.
+    # Windowed decode tok/s — same two-length differencing.
     m_new = 8 if small else 128
-    mgen = jax.jit(
-        lambda p, pr, k: generate.generate(
-            p, mcfg, pr, max_new_tokens=m_new, temperature=0.0, key=k
+
+    def mgen_at(n_new):
+        fn = jax.jit(
+            lambda p, pr, k: generate.generate(
+                p, mcfg, pr, max_new_tokens=n_new, temperature=0.0,
+                key=k,
+            )
         )
+        d, _ = timed(fn, mparams, mprompt, jax.random.PRNGKey(5))
+        return d
+
+    m_half = max(m_new // 2, 1)
+    dt_mfull, dt_mhalf = mgen_at(m_new), mgen_at(m_new - m_half)
+    mdecode_s = max(dt_mfull - dt_mhalf, 1e-9)
+    rec["mistral_decode_tok_s"] = round(m_half / mdecode_s, 1)
+    rec["mistral_decode_ms_per_tok"] = round(
+        mdecode_s / m_half * 1e3, 3
     )
-    dt_mgen, _ = timed(mgen, mparams, mprompt, jax.random.PRNGKey(5))
-    mdecode_s = max(dt_mgen - dt_mono, 1e-9)
-    rec["mistral_decode_tok_s"] = round(m_new / mdecode_s, 1)
-    rec["mistral_decode_ms_per_tok"] = round(mdecode_s / m_new * 1e3, 3)
     print(f"[decode] mistral decode: {rec['mistral_decode_tok_s']} "
           f"tok/s at context {m_prompt}", flush=True)
 

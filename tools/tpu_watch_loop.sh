@@ -1,7 +1,7 @@
 #!/usr/bin/env bash
 # Thin wrapper: the unattended perf-capture chain lives in
 # tools/capture_perf.py (baseline bench loop -> autotune -> tuned
-# re-bench, each landed in PERF_r04.json atomically). Logs to
+# re-bench, each landed in PERF_r05.json atomically). Logs to
 # /tmp/tpu_watch_r4b.log.
 set -u
 cd "$(dirname "$0")/.."
