@@ -369,8 +369,11 @@ def _block(x, lp, cfg: LlamaConfig, attn_fn, cos, sin):
     v = v.reshape(B, T, Hkv, D)
     q = apply_rope(q, cos, sin)
     k = apply_rope(k, cos, sin)
-    if Hkv != H:
-        # grouped-query: broadcast each kv head over its query group
+    if Hkv != H and not getattr(attn_fn, "supports_gqa", False):
+        # grouped-query: broadcast each kv head over its query group.
+        # GQA-aware attention (the seq-parallel constructors) takes
+        # the COMPACT k/v instead — the ring/a2a then move 1/q_per_kv
+        # the bytes and broadcast per block on-device.
         k = jnp.repeat(k, cfg.q_per_kv, axis=2)
         v = jnp.repeat(v, cfg.q_per_kv, axis=2)
     att = attn_fn(q, k, v).reshape(B, T, E)

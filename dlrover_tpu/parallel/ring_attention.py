@@ -84,6 +84,7 @@ def ring_attention(
         raise ValueError(
             "window (sliding-window attention) requires causal=True"
         )
+    expand_kv = _gqa_expander(h, k.shape[2])
 
     q_pos = my_idx * lq + jnp.arange(lq)  # global query positions
 
@@ -99,7 +100,9 @@ def ring_attention(
                 ) < window
         else:
             mask = None
-        m_blk, l_blk, o_blk = _block_attn(q, k_blk, v_blk, scale, mask)
+        m_blk, l_blk, o_blk = _block_attn(
+            q, expand_kv(k_blk), expand_kv(v_blk), scale, mask
+        )
         # Online-softmax merge of block stats into the accumulator.
         m_new = jnp.maximum(m_acc, m_blk)
         corr_acc = jnp.exp(m_acc - m_new)
@@ -127,6 +130,24 @@ def ring_attention(
 
 
 _NEG = -1e30  # "-inf" that keeps exp/logaddexp NaN-free
+
+
+def _gqa_expander(h_q: int, h_kv: int):
+    """Grouped-query support for the ring families: K/V ride the ring
+    COMPACT (h_kv heads — 1/q_per_kv the ppermute bytes of the
+    expanded layout models used to pre-broadcast) and are broadcast
+    over their query group only at the per-block kernel call, where
+    XLA folds the repeat into the kernel's input copy. Returns the
+    per-block expansion fn."""
+    if h_kv == h_q:
+        return lambda x: x
+    if h_q % h_kv:
+        raise ValueError(
+            f"grouped-query attention needs q heads ({h_q}) divisible "
+            f"by kv heads ({h_kv})"
+        )
+    g = h_q // h_kv
+    return lambda x: jnp.repeat(x, g, axis=2)
 
 
 def ring_attention_flash(
@@ -185,11 +206,12 @@ def ring_attention_flash(
             raise ValueError(f"window must be >= 1, got {window}")
         if window >= n * lq:
             window = None  # band covers the global sequence
+    expand_kv = _gqa_expander(h, k.shape[2])
 
     def flash_blk(q_, k_, v_, causal_):
         o, lse = flash_attention(
-            q_, k_, v_, causal=causal_, scale=scale,
-            interpret=interpret, return_lse=True,
+            q_, expand_kv(k_), expand_kv(v_), causal=causal_,
+            scale=scale, interpret=interpret, return_lse=True,
         )
         return o.astype(jnp.float32), lse
 
@@ -264,6 +286,7 @@ def _ring_flash_windowed(
     n = jax.lax.psum(1, axis_name)
     my_idx = jax.lax.axis_index(axis_name)
     t_stop = min(n - 1, (window + lq - 2) // lq)
+    expand_kv = _gqa_expander(h, k.shape[2])
 
     zeros = (
         jnp.zeros((b, lq, h, d), jnp.float32),
@@ -272,15 +295,17 @@ def _ring_flash_windowed(
 
     def resident(q_, k_, v_):
         o, lse = flash_attention(
-            q_, k_, v_, causal=True, window=window, scale=scale,
-            interpret=interpret, return_lse=True,
+            q_, expand_kv(k_), expand_kv(v_), causal=True,
+            window=window, scale=scale, interpret=interpret,
+            return_lse=True,
         )
         return o.astype(jnp.float32), lse
 
     def banded(q_, k_, v_, off):
         o, lse = flash_attention_rect(
-            q_, k_, v_, causal=True, q_offset=off, window=window,
-            scale=scale, interpret=interpret, return_lse=True,
+            q_, expand_kv(k_), expand_kv(v_), causal=True,
+            q_offset=off, window=window, scale=scale,
+            interpret=interpret, return_lse=True,
         )
         return o.astype(jnp.float32), lse
 
@@ -351,8 +376,10 @@ def make_sharded_attention(
         if use_flash:
             from dlrover_tpu.ops.flash_attention import flash_attention
 
-            return functools.partial(
-                flash_attention, causal=causal, window=window
+            return _expand_kv_wrapper(
+                functools.partial(
+                    flash_attention, causal=causal, window=window
+                )
             )
 
         # No sequence sharding: plain (still jit-fused) attention —
@@ -361,8 +388,10 @@ def make_sharded_attention(
         # here too).
         from dlrover_tpu.models.gpt import _default_attention
 
-        return functools.partial(
-            _default_attention, causal=causal, window=window
+        return _expand_kv_wrapper(
+            functools.partial(
+                _default_attention, causal=causal, window=window
+            )
         )
 
     fn = functools.partial(
@@ -371,13 +400,42 @@ def make_sharded_attention(
         causal=causal,
         window=window,
     )
-    return shard_map(
+    sharded = shard_map(
         fn,
         mesh=mesh,
         in_specs=(spec, spec, spec),
         out_specs=spec,
         check_vma=False,
     )
+    tp = mesh.shape.get(head_axis, 1) if head_axis is not None else 1
+
+    def attn(q, k, v):
+        # Compact K/V needs its head dim to split over the tensor
+        # axis; when it can't (h_kv < tensor shards), pre-broadcast —
+        # correct, just without the traffic saving.
+        if k.shape[2] != q.shape[2] and k.shape[2] % tp:
+            expand = _gqa_expander(q.shape[2], k.shape[2])
+            k, v = expand(k), expand(v)
+        return sharded(q, k, v)
+
+    # Models may pass COMPACT grouped-query K/V (h_kv < h heads): the
+    # ring rotates the small tensors and broadcasts per block.
+    attn.supports_gqa = True
+    return attn
+
+
+def _expand_kv_wrapper(fn):
+    """Equal-heads kernels behind a constructor that advertises
+    grouped-query support: broadcast compact K/V over the query
+    groups right before the call (XLA folds the repeat into the
+    kernel's input transpose/copy)."""
+
+    def attn(q, k, v, **kw):
+        expand = _gqa_expander(q.shape[2], k.shape[2])
+        return fn(q, expand(k), expand(v), **kw)
+
+    attn.supports_gqa = True
+    return attn
 
 
 def ring_prefix_lm_attention(
@@ -443,6 +501,7 @@ def ring_prefix_lm_attention(
     my_idx = jax.lax.axis_index(axis_name)
     if scale is None:
         scale = 1.0 / (d**0.5)
+    expand_kv = _gqa_expander(h, k.shape[2])
     p = int(prefix_len)
     if not 0 <= p <= n * lq:
         raise ValueError(
@@ -466,22 +525,23 @@ def ring_prefix_lm_attention(
 
     def dense_blk(q_, k_, v_):
         o, lse = flash_attention(
-            q_, k_, v_, causal=False, scale=scale,
-            interpret=interpret, return_lse=True, **bkw,
+            q_, expand_kv(k_), expand_kv(v_), causal=False,
+            scale=scale, interpret=interpret, return_lse=True, **bkw,
         )
         return o.astype(jnp.float32), lse
 
     def causal_blk(q_, k_, v_):
         o, lse = flash_attention(
-            q_, k_, v_, causal=True, scale=scale,
-            interpret=interpret, return_lse=True, **bkw,
+            q_, expand_kv(k_), expand_kv(v_), causal=True,
+            scale=scale, interpret=interpret, return_lse=True, **bkw,
         )
         return o.astype(jnp.float32), lse
 
     def rect_blk(q_, k_, v_):
         o, lse = flash_attention_rect(
-            q_, k_[:, :rem], v_[:, :rem], causal=False, q_offset=0,
-            scale=scale, interpret=interpret, return_lse=True,
+            q_, expand_kv(k_[:, :rem]), expand_kv(v_[:, :rem]),
+            causal=False, q_offset=0, scale=scale,
+            interpret=interpret, return_lse=True,
         )
         return o.astype(jnp.float32), lse
 

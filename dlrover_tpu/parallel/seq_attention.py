@@ -101,4 +101,7 @@ def make_seq_attention(
             built[choice] = ctor(mesh, **kwargs)
         return built[choice](q, k, v)
 
+    # Both families accept compact grouped-query K/V (see
+    # ring_attention._gqa_expander / ulysses.a2a_attention).
+    attn.supports_gqa = True
     return attn

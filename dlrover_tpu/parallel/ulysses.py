@@ -49,11 +49,17 @@ def a2a_attention(
     """
     n = jax.lax.psum(1, axis_name)
     b, lt, h, d = q.shape
+    h_kv = k.shape[2]
     if h % n != 0:
         raise ValueError(
             f"a2a sequence parallelism needs heads ({h}) divisible "
             f"by the '{axis_name}' axis size ({n}); use ring "
             "attention when sequence shards outnumber heads"
+        )
+    if h_kv != h and h % h_kv:
+        raise ValueError(
+            f"grouped-query attention needs q heads ({h}) divisible "
+            f"by kv heads ({h_kv})"
         )
     if attn_fn is None:
         from dlrover_tpu.models.gpt import _default_attention
@@ -68,9 +74,21 @@ def a2a_attention(
             x, axis_name, split_axis=2, concat_axis=1, tiled=True
         )
 
+    g = h // h_kv
+    if g > 1 and h_kv % n:
+        # Compact kv heads don't split n ways: broadcast BEFORE the
+        # exchange (correct, no traffic saving).
+        k = jnp.repeat(k, g, axis=2)
+        v = jnp.repeat(v, g, axis=2)
+        g = 1
     qh = swap_to_heads(q)
     kh = swap_to_heads(k)
     vh = swap_to_heads(v)
+    if g > 1:
+        # Compact grouped-query K/V crossed the a2a at 1/g the bytes;
+        # broadcast over the query groups only now, locally.
+        kh = jnp.repeat(kh, g, axis=2)
+        vh = jnp.repeat(vh, g, axis=2)
     out = attn_fn(qh, kh, vh)
     # [B, T, H/s, D] -> [B, T/s, H, D]
     return jax.lax.all_to_all(
@@ -149,10 +167,32 @@ def make_a2a_attention(
         causal=causal,
         attn_fn=inner,
     )
-    return shard_map(
+    sharded = shard_map(
         fn,
         mesh=mesh,
         in_specs=(spec, spec, spec),
         out_specs=spec,
         check_vma=False,
     )
+
+    tp = mesh.shape.get(head_axis, 1) if head_axis is not None else 1
+
+    def attn(q, k, v):
+        # Same tensor-axis constraint as the ring wrapper: compact
+        # K/V must split its head dim over `tensor`, else
+        # pre-broadcast (correct, no traffic saving). _gqa_expander
+        # also validates the head ratio on the GLOBAL counts.
+        if k.shape[2] != q.shape[2] and k.shape[2] % tp:
+            from dlrover_tpu.parallel.ring_attention import (
+                _gqa_expander,
+            )
+
+            expand = _gqa_expander(q.shape[2], k.shape[2])
+            k, v = expand(k), expand(v)
+        return sharded(q, k, v)
+
+    # Compact grouped-query K/V accepted: it crosses the a2a at
+    # 1/q_per_kv the bytes when kv heads split over the axis, and is
+    # broadcast locally otherwise (a2a_attention).
+    attn.supports_gqa = True
+    return attn

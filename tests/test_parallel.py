@@ -211,6 +211,122 @@ class TestWindowedSeqParallel:
         assert count_ppermutes(20) == 4   # band reaches block t=2
 
 
+class TestGqaRing:
+    """Grouped-query attention through the sequence-parallel families
+    with COMPACT K/V: the ring rotates h_kv-head tensors (1/q_per_kv
+    the ppermute bytes) and broadcasts per block; the a2a exchanges
+    them compact when kv heads split over the axis. Parity against
+    the pre-expanded path, plus a jaxpr-level traffic assertion."""
+
+    H, HKV = 8, 2
+
+    def _qkv(self, b=2, t=64, d=16, seed=9):
+        kq, kk, kv = jax.random.split(jax.random.PRNGKey(seed), 3)
+        q = jax.random.normal(kq, (b, t, self.H, d), jnp.float32)
+        k = jax.random.normal(kk, (b, t, self.HKV, d), jnp.float32)
+        v = jax.random.normal(kv, (b, t, self.HKV, d), jnp.float32)
+        return q, k, v
+
+    def _expanded(self, k):
+        return jnp.repeat(k, self.H // self.HKV, axis=2)
+
+    @pytest.mark.parametrize("impl", ["flash", "xla"])
+    @pytest.mark.parametrize("window", [None, 20])
+    def test_ring_matches_expanded(self, impl, window):
+        mesh = build_mesh(MeshConfig(seq=4, data=2))
+        q, k, v = self._qkv()
+        ring = make_sharded_attention(
+            mesh, causal=True, impl=impl, window=window
+        )
+        assert ring.supports_gqa
+        got = jax.jit(ring)(q, k, v)
+        want = gpt._default_attention(
+            q, self._expanded(k), self._expanded(v),
+            causal=True, window=window,
+        )
+        np.testing.assert_allclose(
+            np.asarray(got), np.asarray(want), atol=2e-5, rtol=2e-5
+        )
+
+    def test_ring_gradients_match_expanded(self):
+        mesh = build_mesh(MeshConfig(seq=4, data=2))
+        q, k, v = self._qkv(t=32, d=8)
+        ring = make_sharded_attention(mesh, causal=True, impl="flash")
+
+        def loss_ring(q, k, v):
+            return jnp.sum(jnp.square(ring(q, k, v)))
+
+        def loss_ref(q, k, v):
+            return jnp.sum(jnp.square(gpt._default_attention(
+                q, self._expanded(k), self._expanded(v), causal=True
+            )))
+
+        g1 = jax.jit(jax.grad(loss_ring, argnums=(0, 1, 2)))(q, k, v)
+        g2 = jax.grad(loss_ref, argnums=(0, 1, 2))(q, k, v)
+        for a, b_ in zip(g1, g2):
+            np.testing.assert_allclose(
+                np.asarray(a), np.asarray(b_), atol=5e-5, rtol=5e-4
+            )
+
+    @pytest.mark.parametrize("seq_n", [2, 4])
+    def test_a2a_matches_expanded(self, seq_n):
+        """seq=2: kv heads (2) split over the axis — compact a2a
+        path; seq=4: they don't — pre-broadcast fallback."""
+        from dlrover_tpu.parallel.ulysses import make_a2a_attention
+
+        mesh = build_mesh(MeshConfig(seq=seq_n, data=8 // seq_n))
+        q, k, v = self._qkv(b=8 // seq_n)
+        a2a = make_a2a_attention(mesh, causal=True)
+        assert a2a.supports_gqa
+        got = jax.jit(a2a)(q, k, v)
+        want = gpt._default_attention(
+            q, self._expanded(k), self._expanded(v), causal=True
+        )
+        np.testing.assert_allclose(
+            np.asarray(got), np.asarray(want), atol=2e-5, rtol=2e-5
+        )
+
+    def test_single_shard_fallback_expands(self):
+        mesh = build_mesh(MeshConfig(data=8))  # seq axis = 1
+        q, k, v = self._qkv(b=1, t=16, d=8)
+        attn = make_sharded_attention(mesh, causal=True)
+        assert attn.supports_gqa
+        got = attn(q, k, v)
+        want = gpt._default_attention(
+            q, self._expanded(k), self._expanded(v), causal=True
+        )
+        np.testing.assert_allclose(
+            np.asarray(got), np.asarray(want), atol=2e-5, rtol=2e-5
+        )
+
+    def test_ring_rotates_compact_kv(self):
+        """The whole point: ppermute operands in the jaxpr carry the
+        COMPACT kv head count, not the expanded one."""
+        from dlrover_tpu.parallel.ring_attention import (
+            ring_attention_flash,
+        )
+        from jax import shard_map
+
+        mesh = build_mesh(MeshConfig(seq=4, data=2))
+        spec = P(("data",), "seq", None, None)
+        q, k, v = self._qkv()
+        fn = shard_map(
+            functools.partial(ring_attention_flash, causal=True),
+            mesh=mesh, in_specs=(spec, spec, spec),
+            out_specs=spec, check_vma=False,
+        )
+        txt = str(jax.make_jaxpr(fn)(q, k, v))
+        ppermute_lines = [
+            ln for ln in txt.splitlines() if "ppermute" in ln
+        ]
+        assert ppermute_lines, "no ppermute in jaxpr"
+        # Every rotated tensor is [b, lq, HKV, d] per device — the
+        # expanded head count (H=8) must NOT appear in any rotation.
+        for ln in ppermute_lines:
+            assert f",{self.HKV},"in ln.replace(" ", ""), ln
+            assert f",{self.H},"not in ln.replace(" ", ""), ln
+
+
 class TestRingFlashAttention:
     """Ring attention with the Pallas flash kernel per block
     (interpret mode on the CPU mesh) vs plain attention."""

@@ -166,7 +166,7 @@ def flash_checks():
     # the full key set, per-side padding).
     from dlrover_tpu.ops.flash_attention import flash_attention_rect
 
-    def dense_rect(q_, k_, v_):
+    def dense_rect(q_, k_, v_, win=None):
         off = k_.shape[1] - q_.shape[1]
         d_ = q_.shape[-1]
         s = jnp.einsum(
@@ -175,7 +175,10 @@ def flash_checks():
         ) / (d_**0.5)
         qp = off + jnp.arange(q_.shape[1])[:, None]
         kp = jnp.arange(k_.shape[1])[None, :]
-        s = jnp.where((kp <= qp)[None, None], s, -jnp.inf)
+        keep = kp <= qp
+        if win is not None:
+            keep &= (qp - kp) < win
+        s = jnp.where(keep[None, None], s, -jnp.inf)
         w = jax.nn.softmax(s, axis=-1)
         return jnp.einsum(
             "bhqk,bkhd->bqhd", w, v_.astype(jnp.float32)
@@ -189,6 +192,22 @@ def flash_checks():
                 q_, k_, v_, causal=True
             ),
             dense_rect, q[:, -tq:], k, v, atol=2e-2,
+        ),
+    )
+
+    # Banded rectangular (q_offset + window) — the windowed ring's
+    # live non-resident hop kernel (parallel/ring_attention.py
+    # _ring_flash_windowed) and windowed chunked prefill; new in r5,
+    # never compiled on hardware before this check.
+    win_w = SEQ // 8
+    check(
+        "flash_rect_windowed_fwd_bwd",
+        lambda: grad_check(
+            lambda q_, k_, v_: flash_attention_rect(
+                q_, k_, v_, causal=True, window=win_w
+            ),
+            lambda q_, k_, v_: dense_rect(q_, k_, v_, win=win_w),
+            q[:, -tq:], k, v, atol=2e-2,
         ),
     )
 
