@@ -29,7 +29,14 @@ from __future__ import annotations
 import os
 import sys
 
-N_DEVICES = 16
+# 16 default (2x2x2x2); DRYRUN_DEVICES=32 doubles the fsdp axis for
+# the full v5p-32 shape when wall-clock allows. Only these two shapes
+# are derivable (fsdp = N/8, batch = 4*fsdp) — fail fast on others.
+N_DEVICES = int(os.environ.get("DRYRUN_DEVICES", "16"))
+if N_DEVICES not in (16, 32):
+    raise SystemExit(
+        f"DRYRUN_DEVICES must be 16 or 32, got {N_DEVICES}"
+    )
 
 os.environ["JAX_PLATFORMS"] = "cpu"
 _flags = os.environ.get("XLA_FLAGS", "")
@@ -62,8 +69,9 @@ def main() -> int:
     from dlrover_tpu.parallel.mesh import MeshConfig, build_mesh
     from dlrover_tpu.parallel.ring_attention import ring_attention
 
+    fsdp = N_DEVICES // 8  # 2 at 16 devices, 4 at 32
     mesh = build_mesh(
-        MeshConfig(pipe=2, fsdp=2, seq=2, tensor=2),
+        MeshConfig(pipe=2, fsdp=fsdp, seq=2, tensor=2),
         devices=jax.devices()[:N_DEVICES],
     )
     assert all(
@@ -111,8 +119,12 @@ def main() -> int:
         mesh, gpt.init_params(jax.random.PRNGKey(0), cfg)
     )
     opt_state = optimizer.init(params)
+    # Microbatch rows must split over data x fsdp: with n_micro=4,
+    # batch = 4*fsdp gives mb = fsdp rows per microbatch.
+    batch = 4 * fsdp
     tokens = jax.random.randint(
-        jax.random.PRNGKey(1), (8, cfg.block_size), 0, cfg.vocab_size
+        jax.random.PRNGKey(1), (batch, cfg.block_size), 0,
+        cfg.vocab_size,
     )
     targets = jnp.roll(tokens, -1, axis=1)
 
