@@ -1,0 +1,38 @@
+"""CI wrapper for the long-context demo (examples/longctx): windowed
+attention + GQA compact-KV + seq-sharded ring through auto_accelerate,
+end to end as a user would run it. Same subprocess pattern as the
+chaos-drill wrappers."""
+
+import os
+import subprocess
+import sys
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def test_windowed_longctx_example_smoke():
+    proc = subprocess.run(
+        [
+            sys.executable,
+            os.path.join(
+                REPO, "examples", "longctx", "train_windowed.py"
+            ),
+            "--smoke",
+        ],
+        capture_output=True,
+        text=True,
+        timeout=600,
+        cwd=REPO,
+        env={
+            **os.environ,
+            "JAX_PLATFORMS": "cpu",
+            "XLA_FLAGS": "--xla_force_host_platform_device_count=8",
+        },
+    )
+    assert proc.returncode == 0, (
+        f"example failed:\n{proc.stdout[-2000:]}\n{proc.stderr[-2000:]}"
+    )
+    assert "windowed seq-sharded training: loss" in proc.stdout
+    # The script itself asserts the loss decreased; double-check the
+    # mesh actually had a seq axis (the demo's point).
+    assert "seq=2" in proc.stdout
