@@ -334,6 +334,13 @@ def predict_step_time(
         # Every axis that REPLICATES parameters must re-synchronize
         # gradients: data and seq both do (sequence shards compute
         # partial grads for the whole non-pipe-sharded model).
+        # Known omission: the seq axis's per-layer K/V ring rotation
+        # (parallel/ring_attention.py) is not modeled — ModuleCost is
+        # scope-aggregate, so per-layer KV bytes aren't available
+        # here. The omission under-costs seq slightly; it shrank by
+        # q_per_kv for GQA models when compact-KV rotation landed,
+        # and the dry-run measurement pass (not this prior) is what
+        # ranks finalists anyway.
         reps = mesh.get("data", 1) * mesh.get("seq", 1)
         if reps > 1:
             # ring all-reduce of this device's grad shard
