@@ -191,16 +191,17 @@ def test_flash_rect_fwd_bwd_blocks(dtype, tq, tk, off):
     assert viol.audited > 0, "pallas_call interception never fired"
 
 
-def test_flash_windowed_blocks():
+@pytest.mark.parametrize("dtype", [jnp.float32, jnp.bfloat16])
+def test_flash_windowed_blocks(dtype):
     from dlrover_tpu.ops.flash_attention import flash_attention
 
-    q, k, v = _qkv(1, 512, 2, 64, jnp.float32)
+    q, k, v = _qkv(1, 512, 2, 64, dtype)
     with record_violations() as viol:
         def loss(q, k, v):
             return jnp.sum(
                 flash_attention(
                     q, k, v, causal=True, window=100, interpret=True
-                ) ** 2
+                ).astype(jnp.float32) ** 2
             )
 
         jax.grad(loss, argnums=(0, 1, 2))(q, k, v)

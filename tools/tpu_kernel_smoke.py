@@ -102,13 +102,17 @@ def flash_checks():
         for got, want in zip(gk(*args), gr(*args)):
             _close(got, want, atol)
 
-    # fwd+bwd (dq/dk/dv), causal and full, at the shipped 1024x1024
-    # tiles, in f32 AND bf16 — TPU sublane tile floors are
-    # dtype-dependent (8 for f32, 16 for bf16), so the production
-    # bf16 path needs its own lowering check.
-    for dt, tag, atol in (
+    # One dtype/tolerance table for every dtype-parametrized check —
+    # TPU sublane tile floors are dtype-dependent (8 for f32, 16 for
+    # bf16), so the production bf16 path needs its own lowering check
+    # everywhere, at its own (looser) parity tolerance.
+    DTYPES = (
         (jnp.float32, "f32", 2e-2), (jnp.bfloat16, "bf16", 0.5),
-    ):
+    )
+
+    # fwd+bwd (dq/dk/dv), causal and full, at the shipped 1024x1024
+    # tiles, in f32 AND bf16.
+    for dt, tag, atol in DTYPES:
         qd, kd, vd = q.astype(dt), k.astype(dt), v.astype(dt)
         check(
             f"flash_causal_fwd_bwd_{tag}",
@@ -130,19 +134,26 @@ def flash_checks():
         ),
     )
     # Sliding window (Mistral band) + non-1024 sequence (512 tiles),
-    # gradients included (the banded bwd has its own dispatch).
+    # gradients included (the banded bwd has its own dispatch) — in
+    # bf16 too (the production decode dtype; its tile floors are 2x
+    # the f32 ones).
     half = SEQ // 2
     qs, ks, vs = q[:, :half], k[:, :half], v[:, :half]
-    check(
-        "flash_sliding_window_fwd_bwd",
-        lambda: grad_check(
-            lambda q_, k_, v_: flash_attention(
-                q_, k_, v_, causal=True, window=half // 4
+    for dt, tag, atol in DTYPES:
+        check(
+            f"flash_sliding_window_fwd_bwd_{tag}",
+            functools.partial(
+                grad_check,
+                lambda q_, k_, v_: flash_attention(
+                    q_, k_, v_, causal=True, window=half // 4
+                ),
+                lambda q_, k_, v_: dense(
+                    q_, k_, v_, True, window=half // 4
+                ),
+                qs.astype(dt), ks.astype(dt), vs.astype(dt),
+                atol=atol,
             ),
-            lambda q_, k_, v_: dense(q_, k_, v_, True, window=half // 4),
-            qs, ks, vs, atol=2e-2,
-        ),
-    )
+        )
     # Odd length -> internal padding path.
     odd = SEQ // 2 + 8
     qo, ko, vo = q[:, :odd], k[:, :odd], v[:, :odd]
@@ -200,16 +211,19 @@ def flash_checks():
     # _ring_flash_windowed) and windowed chunked prefill; new in r5,
     # never compiled on hardware before this check.
     win_w = SEQ // 8
-    check(
-        "flash_rect_windowed_fwd_bwd",
-        lambda: grad_check(
-            lambda q_, k_, v_: flash_attention_rect(
-                q_, k_, v_, causal=True, window=win_w
+    for dt, tag, atol in DTYPES:
+        check(
+            f"flash_rect_windowed_fwd_bwd_{tag}",
+            functools.partial(
+                grad_check,
+                lambda q_, k_, v_: flash_attention_rect(
+                    q_, k_, v_, causal=True, window=win_w
+                ),
+                lambda q_, k_, v_: dense_rect(q_, k_, v_, win=win_w),
+                q[:, -tq:].astype(dt), k.astype(dt), v.astype(dt),
+                atol=atol,
             ),
-            lambda q_, k_, v_: dense_rect(q_, k_, v_, win=win_w),
-            q[:, -tq:], k, v, atol=2e-2,
-        ),
-    )
+        )
 
 
 def norm_checks():
