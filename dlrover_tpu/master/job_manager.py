@@ -305,6 +305,21 @@ class JobManager:
             or "resource_exhausted" in text
         ):
             return NodeExitReason.OOM
+        # A PEER's death, not this node's: jax's coordination client
+        # force-aborts every surviving task when another task dies,
+        # with stderr that says the LEADER "was preempted/died" —
+        # that describes the other task. Classifying the survivor as
+        # PREEMPTED escalated to a node relaunch and the agent
+        # stopped supervising, so a coordinator-host kill took the
+        # whole job down (found by the alternating-victim soak
+        # drill). The surviving node is healthy: restart in place and
+        # re-rendezvous into the shrunken world.
+        if (
+            "coordination service" in text
+            or "jax distributed service detected fatal errors" in text
+            or "another task died" in text
+        ):
+            return NodeExitReason.KILLED
         if re.search(r"\bpreempt", text):
             return NodeExitReason.PREEMPTED
         return NodeExitReason.KILLED

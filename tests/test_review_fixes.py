@@ -239,6 +239,33 @@ def test_oom_classifier_word_boundary():
     assert jm.classify_exit("killed by oom", "process_error") == "oom"
 
 
+def test_peer_death_abort_is_not_classified_preempted():
+    """jax's coordination client aborts SURVIVORS of a peer death with
+    stderr saying the leader 'was preempted/died'; the \\bpreempt
+    match used to classify the healthy survivor as PREEMPTED ->
+    RELAUNCH_NODE -> agent stopped supervising, so killing the
+    coordinator host took the whole job down (found by the
+    alternating-victim soak drill). The survivor must classify as a
+    plain crash the agent restarts in place."""
+    jm = JobManager()
+    jax_abort = (
+        "Terminating process because the JAX distributed service "
+        "detected fatal errors. This most likely indicates that "
+        "another task died; see the other task logs.\n"
+        "absl::Status: UNAVAILABLE: Failed to send RPC to "
+        "coordination service. Either the leader task was "
+        "preempted/died/restarted unexpectedly or this task is "
+        "experiencing network issues."
+    )
+    assert jm.classify_exit(jax_abort, "process_error") == "killed"
+    # A REAL preemption notice (no coordination-service signature)
+    # still classifies as preempted.
+    assert (
+        jm.classify_exit("node preempted by scheduler", "process_error")
+        == "preempted"
+    )
+
+
 def test_stale_heartbeat_does_not_revive_pending_replacement():
     """A last-gasp heartbeat from the agent being replaced lands right
     after the relaunch; it must not flip the fresh PENDING node to
