@@ -315,10 +315,13 @@ class JobManager:
         # drill). The surviving node is healthy: restart in place and
         # re-rendezvous into the shrunken world.
         if (
-            "coordination service" in text
-            or "jax distributed service detected fatal errors" in text
+            "jax distributed service detected fatal errors" in text
             or "another task died" in text
         ):
+            # Only the specific abort fingerprints: a bare
+            # "coordination service" mention could ride along in the
+            # stderr of a GENUINELY preempted node and must not steal
+            # its RELAUNCH_NODE classification.
             return NodeExitReason.KILLED
         if re.search(r"\bpreempt", text):
             return NodeExitReason.PREEMPTED

@@ -114,10 +114,19 @@ def main(argv=None) -> int:
                    "the updates an abrupt PS death can lose")
     p.add_argument("--drill-json", default="",
                    help="write the drill recovery stats JSON here")
+    p.add_argument("--kills", type=int, default=1,
+                   help="soak mode: kill this many PS servers one "
+                   "after another (recovery measured per kill; needs "
+                   "n-ps > kills so a survivor remains)")
     p.add_argument("--max-ram-rows", type=int, default=0,
                    help=">0 enables the hybrid RAM/disk tier: at most "
                    "this many embedding rows stay resident per PS")
     args = p.parse_args(argv)
+    if args.drill and not 1 <= args.kills < args.n_ps:
+        p.error(
+            f"--kills must be in [1, n_ps) = [1, {args.n_ps}), got "
+            f"{args.kills}"
+        )
 
     tmp = tempfile.mkdtemp(prefix="ctr_")
     mgr = PsManager(num_partitions=32)
@@ -179,15 +188,35 @@ def main(argv=None) -> int:
         )
 
     rng = np.random.default_rng(0)
-    kill_at = args.steps // 2
-    if args.drill == "abrupt" and args.flush_every:
-        # Keep the kill OFF a flush boundary: an abrupt death right
-        # after a periodic flush would lose zero updates and the drill
-        # would not exercise the bounded-loss contract it documents.
-        if kill_at % args.flush_every == 0:
-            kill_at += max(1, args.flush_every // 2)
+    # Kill points spread over the run (one at the midpoint for the
+    # classic single-kill drill; evenly spaced for a soak) — each OFF
+    # a flush boundary: an abrupt death right after a periodic flush
+    # would lose zero updates and the drill would not exercise the
+    # bounded-loss contract it documents.
+    kill_steps = []
+    if args.drill:
+        for j in range(args.kills):
+            ks = args.steps * (j + 1) // (args.kills + 1)
+            # Walk forward past flush boundaries, collisions with an
+            # earlier kill, and step 0 — never silently drop a kill.
+            while ks < 1 or ks in kill_steps or (
+                args.drill == "abrupt"
+                and args.flush_every
+                and ks % args.flush_every == 0
+            ):
+                ks += 1
+            if ks > args.steps - 1:
+                raise SystemExit(
+                    f"--steps {args.steps} too small for --kills "
+                    f"{args.kills} with --flush-every "
+                    f"{args.flush_every}: kill {j} would land at "
+                    f"step {ks} with no step left to measure its "
+                    "recovery"
+                )
+            kill_steps.append(ks)
     losses = []
     drill_stats = {}
+    kills_done = []
     t0 = time.time()
     for step in range(1, args.steps + 1):
         step_start = time.time()
@@ -231,8 +260,9 @@ def main(argv=None) -> int:
                 f"{drill_stats['rows_after_recovery']}, phases "
                 f"{drill_stats.get('phases')})"
             )
+            kills_done.append(dict(drill_stats))
 
-        if args.drill and step == kill_at:
+        if args.drill and step in kill_steps:
             vid = max(servers)
             victim = servers.pop(vid)
             rows = len(victim.table("emb"))
@@ -285,19 +315,31 @@ def main(argv=None) -> int:
     client.close()
     for ps in servers.values():
         ps.stop()
-    if args.drill_json and drill_stats:
+    if args.drill_json and kills_done:
         import json
 
-        drill_stats.pop("_kill_time", None)
-        drill_stats.update(
+        # First kill's fields at top level (the one-shot drill
+        # contract, tests/test_ps_drill_phases.py); a soak appends
+        # the per-kill records and aggregates.
+        out = dict(kills_done[0])
+        out.pop("_kill_time", None)
+        out.update(
             loss_head=round(head, 4),
             loss_tail=round(tail, 4),
             steps=args.steps,
             flush_every=args.flush_every,
             n_ps_before=args.n_ps,
         )
+        if len(kills_done) > 1:
+            for k in kills_done:
+                k.pop("_kill_time", None)
+            recs = [k["recovery_s"] for k in kills_done]
+            out["kills"] = kills_done
+            out["n_kills"] = len(kills_done)
+            out["max_recovery_s"] = max(recs)
+            out["mean_recovery_s"] = round(sum(recs) / len(recs), 3)
         with open(args.drill_json, "w") as f:
-            json.dump(drill_stats, f, indent=1)
+            json.dump(out, f, indent=1)
         print(f"drill stats -> {args.drill_json}")
     if not tail < head:
         print("FAIL: loss did not decrease", file=sys.stderr)
