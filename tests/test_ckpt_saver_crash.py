@@ -1,0 +1,148 @@
+"""Saver-crash chaos: SIGKILL the agent-side checkpoint saver MID-
+PERSIST and prove the commit protocol's crash story at the process
+level — the tracker never references the interrupted step, the staged
+state survives in shm across the process death, and a restarted saver
+(the relaunched agent) flushes and commits it intact.
+
+This is the scenario the flash-checkpoint design exists for (ref
+async-checkpoint design: the trainer is only blocked for staging
+precisely BECAUSE the persist can die with the host); unit tests
+cover commit idempotency in-process, this covers the real kill."""
+
+import os
+import signal
+import subprocess
+import sys
+import time
+import uuid
+
+import numpy as np
+import pytest
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+CHILD = """
+import os, sys, time
+sys.path.insert(0, {repo!r})
+import numpy as np
+import jax
+jax.config.update("jax_platforms", "cpu")
+import jax.numpy as jnp
+from dlrover_tpu.agent.ckpt_saver import AsyncCheckpointSaver
+from dlrover_tpu.trainer.flash_checkpoint.engine import CheckpointEngine
+
+ckpt_dir = sys.argv[1]
+saver = AsyncCheckpointSaver(
+    checkpoint_dir=ckpt_dir, local_shard_num=1, global_shard_num=1,
+    commit_timeout=300.0,
+)
+saver.start()
+engine = CheckpointEngine(ckpt_dir, use_agent=True)
+# Large enough that the shard write takes seconds on this disk — the
+# parent kills us inside that window.
+state = {{
+    "big": jnp.arange(100 * 1024 * 1024 // 4, dtype=jnp.float32),
+    "small": jnp.full((8,), 7.0),
+}}
+assert engine.save_to_storage(42, state)
+print("STAGED", flush=True)
+time.sleep(600)  # parent SIGKILLs us mid-persist
+"""
+
+
+def test_saver_sigkill_mid_persist_recovers(tmp_path, monkeypatch):
+    job = f"crash{uuid.uuid4().hex[:8]}"
+    monkeypatch.setenv("DLROVER_TPU_JOB_NAME", job)
+    ckpt_dir = str(tmp_path / "ckpt")
+    os.makedirs(ckpt_dir)
+    script = tmp_path / "child.py"
+    script.write_text(CHILD.format(repo=REPO))
+
+    proc = subprocess.Popen(
+        [sys.executable, "-u", str(script), ckpt_dir],
+        stdout=subprocess.PIPE,
+        stderr=subprocess.STDOUT,
+        text=True,
+        cwd=REPO,
+        env={**os.environ, "JAX_PLATFORMS": "cpu"},
+        start_new_session=True,
+    )
+    try:
+        # Kill the instant the writing dir appears — mid shard write,
+        # before the commit rename.
+        deadline = time.time() + 120
+        killed_mid_write = False
+        while time.time() < deadline:
+            entries = (
+                os.listdir(ckpt_dir) if os.path.isdir(ckpt_dir) else []
+            )
+            if any(e.startswith("._writing_") for e in entries):
+                os.killpg(proc.pid, signal.SIGKILL)
+                killed_mid_write = True
+                break
+            if proc.poll() is not None:
+                pytest.fail(
+                    "child exited early:\n"
+                    + (proc.stdout.read() if proc.stdout else "")
+                )
+            time.sleep(0.002)
+        assert killed_mid_write, "writing dir never appeared"
+        proc.wait(30)
+
+        # The interrupted step must NOT be visible as committed.
+        tracker = os.path.join(ckpt_dir, "latest_checkpointed_step")
+        assert not os.path.exists(tracker) or int(
+            open(tracker).read().strip()
+        ) < 42
+
+        # A restarted agent adopts the SAME shm segment (it survived
+        # the process death — the design's whole point) and flushes
+        # the staged step to a committed checkpoint.
+        from dlrover_tpu.agent.ckpt_saver import AsyncCheckpointSaver
+        from dlrover_tpu.trainer.flash_checkpoint.engine import (
+            CheckpointEngine,
+        )
+
+        saver = AsyncCheckpointSaver(
+            checkpoint_dir=ckpt_dir, local_shard_num=1,
+            global_shard_num=1, commit_timeout=120.0,
+        )
+        saver.start()
+        try:
+            assert saver.save_shm_to_storage(), (
+                "restarted saver could not flush the staged shm "
+                "(stale lock from the killed process?)"
+            )
+            engine = CheckpointEngine(ckpt_dir, use_agent=False)
+            assert engine.latest_step() == 42
+            step, flat, _extra = engine.load_flat()
+            assert step == 42
+            big = flat["big"]
+            assert big.nbytes == 100 * 1024 * 1024
+            np.testing.assert_array_equal(
+                big[:5], np.arange(5, dtype=np.float32)
+            )
+            np.testing.assert_array_equal(
+                big[-1], np.float32(big.size - 1)
+            )
+            np.testing.assert_array_equal(
+                np.asarray(flat["small"]), np.full((8,), 7.0)
+            )
+        finally:
+            saver.close()
+    finally:
+        if proc.poll() is None:
+            try:
+                os.killpg(proc.pid, signal.SIGKILL)
+            except ProcessLookupError:
+                pass
+        # Unlink the child's ~110 MB shm segment on EVERY path —
+        # keyed by the handler's naming, not a saver object that may
+        # never have been constructed (uuid job names mean a leaked
+        # segment is never reclaimed by a rerun).
+        from dlrover_tpu.common.ckpt_shm import SharedMemoryHandler
+
+        try:
+            SharedMemoryHandler(0).unlink()
+        except Exception:  # noqa: BLE001
+            pass

@@ -8,6 +8,7 @@ rendezvous). Reuses the preemption drill's real-process helpers
 (master + agents + jax.distributed trainers)."""
 
 import os
+import re
 import signal
 import time
 
@@ -59,6 +60,16 @@ def test_double_flap_converges(tmp_path):
         c0 = wait_stepping(m0, t_conv, min(240, budget()), min_step=1)
         c1 = wait_stepping(m1, t_conv, min(240, budget()), min_step=1)
         assert c0 and c1, f"flap did not converge: {c0} {c1}; see {tmp}"
+        # Stepping alone is not convergence — a split brain (each host
+        # alone in its own world=1) would also step. The final spawn
+        # on BOTH agents must be in the re-formed 2-host world.
+        for rank in (0, 1):
+            with open(os.path.join(tmp, f"agent_n{rank}.log")) as f:
+                spawns = re.findall(r"rank=\d+/(\d+)", f.read())
+            assert spawns and spawns[-1] == "2", (
+                f"agent {rank}'s final world is /{spawns[-1:]}, "
+                f"not the re-formed 2-host world; see {tmp}"
+            )
     finally:
         for a in agents.values():
             if a.poll() is None:
