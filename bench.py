@@ -99,13 +99,18 @@ def measure() -> int:
     # fused-norm}; the pure bf16 matmul ceiling on this chip measures
     # 153 TF/s = 0.78 of nominal peak, which bounds any MFU quoted
     # against nominal.
+    # BENCH_REMAT: a remat.py policy name ("none"/"full"/"attention"/
+    # "dots"/"offload"), or legacy 0/1 (= none/full).
+    remat_env = os.getenv("BENCH_REMAT", "1")
+    remat = ({"1": True, "0": False}.get(remat_env, remat_env))
     cfg = dataclasses.replace(
         gpt.GPTConfig.gpt2(),
-        remat=os.getenv("BENCH_REMAT", "1") == "1",
+        remat=remat,
+        scan_unroll=int(os.getenv("BENCH_UNROLL", "1")),
     )
     # Autotune pins (tools/autotune_bwd_blocks.py winner -> the watch
     # loop re-runs with these): BENCH_BLOCKS="bq,bk,bqb,bkb",
-    # BENCH_FUSED_NORM=0/1.
+    # BENCH_FUSED_NORM=0/1, BENCH_UNROLL=K.
     if os.getenv("BENCH_BLOCKS"):
         blocks = tuple(
             int(x) for x in os.environ["BENCH_BLOCKS"].split(",")

@@ -60,6 +60,13 @@ class GPTConfig:
     # forward blocks for the backward). The hardware autotune sweep
     # (tools/autotune_bwd_blocks.py) pins its winner here.
     attn_blocks: Optional[tuple] = None
+    # lax.scan unroll factor for the layer stack. 1 = rolled (one
+    # compiled block, smallest program); k>1 lets XLA fuse across k
+    # consecutive layers and amortize the scan-carry
+    # dynamic-update-slice traffic the r5 step profile attributes
+    # ~16% of step time to. Must divide n_layer. A hardware-autotune
+    # axis, not a semantic knob.
+    scan_unroll: int = 1
 
     @property
     def head_dim(self) -> int:
@@ -304,7 +311,9 @@ def backbone(
     def scan_body(x, lp):
         return block(x, lp), None
 
-    x, _ = jax.lax.scan(scan_body, x, params["blocks"])
+    x, _ = jax.lax.scan(
+        scan_body, x, params["blocks"], unroll=cfg.scan_unroll
+    )
     return _layer_norm(x, params["lnf_g"], params["lnf_b"])
 
 

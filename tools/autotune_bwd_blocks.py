@@ -61,6 +61,15 @@ def main() -> int:
     # vs forced ON — keep re-checking the A/B as kernels evolve.
     run_config(mesh, f"full,flash,18,{bq},{bk},-,nofn")
     run_config(mesh, f"full,flash,18,{bq},{bk},-,fn")
+    # Layer-scan unroll sweep: the r5 step profile attributes ~16% of
+    # step time to scan-carry dynamic-update-slice fusions; unrolling
+    # lets XLA fuse across layers at the cost of program size. Also
+    # re-check the remat choice at the unrolled optimum — the
+    # full-remat win was measured rolled.
+    for u in (2, 3, 4, 6, 12):
+        run_config(mesh, f"full,flash,18,{bq},{bk},-,nofn,u{u}")
+    run_config(mesh, f"none,flash,18,{bq},{bk},-,nofn,u4")
+    run_config(mesh, f"dots,flash,18,{bq},{bk},-,nofn,u4")
     for bqb, bkb in candidates:
         run_config(mesh, f"full,flash,18,{bq},{bk},-,{bqb},{bkb},nofn")
     print("pick the fastest line; bench.py BENCH_* env then pins it")

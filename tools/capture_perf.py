@@ -114,7 +114,16 @@ def winner_env(spec: str) -> dict:
         fused = "0"
     elif "fn" in parts:
         fused = "1"
-    parts = [p for p in parts if p not in ("nofn", "fn")]
+    from perf_sweep import is_unroll_token
+
+    unroll = None
+    for p in parts:
+        if is_unroll_token(p):
+            unroll = p[1:]
+    parts = [
+        p for p in parts
+        if p not in ("nofn", "fn") and not is_unroll_token(p)
+    ]
 
     def blk(i, default):
         if len(parts) <= i or parts[i] == "-":
@@ -128,6 +137,15 @@ def winner_env(spec: str) -> dict:
     env = {"BENCH_BLOCKS": f"{bq},{bk},{bqb},{bkb}"}
     if fused is not None:
         env["BENCH_FUSED_NORM"] = fused
+    if unroll is not None:
+        env["BENCH_UNROLL"] = unroll
+    if parts and parts[0] != "full":
+        # bench.py defaults to full remat; pin any other winner.
+        # Sweep tokens are build_spec's grammar ("attn" etc.); bench
+        # wants remat.py policy names, so map through the same table.
+        env["BENCH_REMAT"] = {"attn": "attention"}.get(
+            parts[0], parts[0]
+        )
     return env
 
 

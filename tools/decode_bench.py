@@ -63,6 +63,11 @@ def main() -> int:
         "ts": time.strftime("%Y-%m-%dT%H:%M:%SZ", time.gmtime()),
     }
 
+    def ckpt():
+        """Measured-so-far checkpoint: a tunnel death mid-section
+        must not erase completed sections (the r5 longctx lesson)."""
+        json.dump(rec, open("/tmp/decode_partial.json", "w"), indent=1)
+
     # --- GPT-2-shaped Llama-family config (the bench model's shape) --
     if small:
         cfg = llama.LlamaConfig.tiny()
@@ -105,6 +110,7 @@ def main() -> int:
     rec["gpt2_prefill_tok_s"] = round(b * t_prompt / dt, 1)
     print(f"[decode] gpt2-shape prefill: {dt*1e3:.1f} ms "
           f"({rec['gpt2_prefill_tok_s']} tok/s)", flush=True)
+    ckpt()
 
     # Steady-state decode tok/s: difference two generate lengths so
     # prefill and fixed overheads cancel exactly (subtracting a
@@ -129,6 +135,7 @@ def main() -> int:
     print(f"[decode] gpt2-shape decode: {rec['gpt2_decode_tok_s']} "
           f"tok/s ({rec['gpt2_decode_ms_per_tok']} ms/tok, "
           f"batch {b})", flush=True)
+    ckpt()
 
     # --- windowed Mistral-tiny: chunked vs monolithic prefill --------
     mparams = llama.init_params(jax.random.fold_in(key, 3), mcfg)
@@ -161,6 +168,7 @@ def main() -> int:
     print(f"[decode] mistral prefill {m_prompt} tokens: "
           f"mono {dt_mono*1e3:.1f} ms vs chunked {dt_chunk*1e3:.1f} ms",
           flush=True)
+    ckpt()
 
     # Windowed decode tok/s — same two-length differencing.
     m_new = 8 if small else 128
@@ -184,6 +192,7 @@ def main() -> int:
     )
     print(f"[decode] mistral decode: {rec['mistral_decode_tok_s']} "
           f"tok/s at context {m_prompt}", flush=True)
+    ckpt()
 
     # Artifact convention (tools/README.md): only full-size hardware
     # runs write the repo-root round record; smoke runs go to /tmp.

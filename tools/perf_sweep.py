@@ -39,6 +39,12 @@ PEAK = 197e12
 REF_HFU = 0.496
 
 
+def is_unroll_token(p: str) -> bool:
+    """"uK" scan-unroll flag token. Shared with capture_perf's
+    winner_env so spec parsing and env pinning can't desynchronize."""
+    return len(p) > 1 and p[0] == "u" and p[1:].isdigit()
+
+
 def build_spec(spec: str):
     """Parse a sweep spec -> (cfg, attn_fn, batch, save_logits).
     Shared with tools/profile_step.py so the profiled config is
@@ -56,7 +62,15 @@ def build_spec(spec: str):
         fused_norm = False
     elif "fn" in parts:
         fused_norm = True
-    parts = [p for p in parts if p not in ("nofn", "fn")]
+    # "uK" (e.g. u2, u4): lax.scan unroll factor for the layer stack.
+    unroll = 1
+    for p in parts:
+        if is_unroll_token(p):
+            unroll = int(p[1:])
+    parts = [
+        p for p in parts
+        if p not in ("nofn", "fn") and not is_unroll_token(p)
+    ]
     remat_s = parts[0]
     flash_s = parts[1] if len(parts) > 1 else "flash"
     batch = int(parts[2]) if len(parts) > 2 else 16
@@ -80,6 +94,7 @@ def build_spec(spec: str):
     cfg = dataclasses.replace(
         gpt.GPTConfig.gpt2(), remat=remat,
         use_flash_attention=use_flash, use_fused_norm=fused_norm,
+        scan_unroll=unroll,
     )
     attn_fn = None
     if flash_s == "noop":

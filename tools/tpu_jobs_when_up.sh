@@ -45,10 +45,26 @@ for i in $(seq 1 400); do
       echo "[$(date +%T)] bench stability (3 runs)"
       timeout 3600 python -u tools/bench_stability.py >> /tmp/bench_stability.log 2>&1
       echo "[$(date +%T)] stability rc=$?"
+    elif [ ! -f /tmp/profile_step.txt ] && [ "$(cat /tmp/profile_step.fails 2>/dev/null || echo 0)" -lt 2 ]; then
+      # Moved ahead of the long stages: the per-op attribution gates
+      # the round's attention-optimization work, and a tunnel drop
+      # after stability must not leave the builder blind for hours.
+      # Capped at 2 failures so a deterministically broken profiler
+      # can't starve AGD/longctx/decode/tune of the whole window.
+      echo "[$(date +%T)] profiling the tuned step"
+      if timeout 900 python -u tools/profile_step.py 'full,flash,18,1024,1024,-,nofn' > /tmp/profile_step.partial 2>&1; then
+        mv /tmp/profile_step.partial /tmp/profile_step.txt
+        echo "[$(date +%T)] profile ok ($(wc -l < /tmp/profile_step.txt) lines)"
+      else
+        rc=$?
+        fails=$(( $(cat /tmp/profile_step.fails 2>/dev/null || echo 0) + 1 ))
+        echo "$fails" > /tmp/profile_step.fails
+        echo "[$(date +%T)] profile failed rc=$rc (failure $fails/2)"
+      fi
     elif [ ! -f AGD_CONVERGENCE_r05.json ] || grep -q reduced-cpu AGD_CONVERGENCE_r05.json; then
       # A labeled reduced-scale CPU fallback (written if the tunnel
       # stayed dead) is superseded by a real-chip run.
-      echo "[$(date +%T)] running agd convergence (200 steps x 2)"
+      echo "[$(date +%T)] running agd convergence (200 steps x 3 runs)"
       timeout 2700 python -u tools/agd_convergence.py --steps 200 >> /tmp/agd_conv.log 2>&1
       echo "[$(date +%T)] agd rc=$?"
     elif [ ! -f LONGCTX_r05.json ]; then
@@ -59,14 +75,6 @@ for i in $(seq 1 400); do
       echo "[$(date +%T)] running decode bench"
       timeout 1800 python -u tools/decode_bench.py >> /tmp/decode_bench.log 2>&1
       echo "[$(date +%T)] decode rc=$?"
-    elif [ ! -f /tmp/profile_step.txt ]; then
-      echo "[$(date +%T)] profiling the tuned step"
-      if timeout 900 python -u tools/profile_step.py 'full,flash,18,1024,1024,-,nofn' > /tmp/profile_step.partial 2>&1; then
-        mv /tmp/profile_step.partial /tmp/profile_step.txt
-        echo "[$(date +%T)] profile ok ($(wc -l < /tmp/profile_step.txt) lines)"
-      else
-        echo "[$(date +%T)] profile failed rc=$?; will retry"
-      fi
     elif [ ! -f /tmp/capture_tune.done ]; then
       echo "[$(date +%T)] autotune + tuned re-bench"
       CAPTURE_STAGE=tune timeout 5400 python -u tools/capture_perf.py >> /tmp/capture_perf.log 2>&1
