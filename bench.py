@@ -99,6 +99,33 @@ def measure() -> int:
     # fused-norm}; the pure bf16 matmul ceiling on this chip measures
     # 153 TF/s = 0.78 of nominal peak, which bounds any MFU quoted
     # against nominal.
+    # Autotune-persisted defaults: tools/capture_perf.py writes
+    # bench_tuned.json when a hardware sweep finds a config that
+    # beats the shipped defaults beyond noise. Explicit BENCH_* env
+    # still wins; the file only fills unset knobs, so the driver's
+    # plain `python bench.py` runs the best measured config.
+    # BENCH_IGNORE_TUNED=1 gives a true shipped-defaults run (the
+    # capture tool's baseline stage sets it so the tuned-vs-baseline
+    # comparison can never compare tuned against itself). A corrupt
+    # file must degrade to defaults, not kill the bench.
+    if os.getenv("BENCH_IGNORE_TUNED", "0") != "1":
+        try:
+            with open(
+                os.path.join(
+                    os.path.dirname(os.path.abspath(__file__)),
+                    "bench_tuned.json",
+                )
+            ) as _f:
+                for _k, _v in json.load(_f).get("pins", {}).items():
+                    os.environ.setdefault(_k, str(_v))
+            print("# applying bench_tuned.json autotune pins",
+                  file=sys.stderr)
+        except FileNotFoundError:
+            pass
+        except (ValueError, OSError, AttributeError) as _exc:
+            print(f"# ignoring unreadable bench_tuned.json: {_exc}",
+                  file=sys.stderr)
+
     # BENCH_REMAT: a remat.py policy name ("none"/"full"/"attention"/
     # "dots"/"offload"), or legacy 0/1 (= none/full).
     remat_env = os.getenv("BENCH_REMAT", "1")

@@ -279,6 +279,10 @@ def _fwd(q, k, v, causal, window, scale, block_q, block_k, seq_len,
             ("parallel", "parallel", "parallel", "arbitrary")
         ),
         interpret=interpret,
+        # Stable identity for jax.checkpoint policies: the save_attn
+        # remat policy (accelerate/remat.py) matches this name to
+        # save exactly (o, lse) and nothing else Pallas produces.
+        name="flash_attention_fwd",
     )(q, k, v)
 
 

@@ -238,6 +238,11 @@ _REMAT_FLOPS_FACTOR = {
     "attention": 1.08,
     "dots": 1.12,
     "offload": 1.0,
+    # full recompute minus the flash forward (the saved (o, lse)
+    # skip it): the attention share of a block fwd is ~25% at GPT-2
+    # shapes (r5 profile: 8.8 of 34.9 ms), so ~1/4 of the recompute
+    # third comes back off full's 4/3.
+    "save_attn": 1.25,
 }
 
 _DTYPE_BYTES_FACTOR = {"bfloat16": 1.0, "float32": 2.0, "half": 1.0}

@@ -51,7 +51,8 @@ class TestRematPolicies:
             canonical("bogus")
 
     @pytest.mark.parametrize(
-        "policy", ["full", "attention", "dots", "offload", True]
+        "policy",
+        ["full", "attention", "dots", "offload", "save_attn", True],
     )
     def test_policy_matches_no_remat(self, policy):
         """Loss and every gradient identical to remat='none' — remat
@@ -106,3 +107,76 @@ class TestRematPolicies:
         assert "remat:full" in Strategy(
             mesh_shape=(("data", 8),), remat=True
         ).name()
+
+
+def _pallas_outvar_counts(jaxpr, acc):
+    """Outvar count of every pallas_call eqn, recursively — the flash
+    forward has 2 outputs (o, lse), the backward 3 (dq, dk, dv)."""
+    for eqn in jaxpr.eqns:
+        if eqn.primitive.name == "pallas_call":
+            acc.append(len(eqn.outvars))
+        for key in ("jaxpr", "call_jaxpr", "fun_jaxpr", "branches"):
+            v = eqn.params.get(key)
+            if v is None:
+                continue
+            for x in v if isinstance(v, (tuple, list)) else [v]:
+                if hasattr(x, "jaxpr"):
+                    x = x.jaxpr
+                if hasattr(x, "eqns"):
+                    _pallas_outvar_counts(x, acc)
+    return acc
+
+
+class TestSaveAttnPolicy:
+    """save_attn's whole point is structural: the flash forward kernel
+    must be traced ONCE (its saved (o, lse) feed the backward), where
+    full remat traces it twice. Assert that on the jaxpr — a numerics
+    test alone would pass even if the policy silently stopped
+    working."""
+
+    def _grad_jaxpr(self, remat):
+        cfg = dataclasses.replace(
+            _cfg(remat),
+            block_size=128,
+            use_flash_attention=True,
+            attn_blocks=(128, 128, 128, 128),
+        )
+        params = gpt.init_params(jax.random.PRNGKey(0), cfg)
+        tokens = jnp.zeros((1, cfg.block_size), jnp.int32)
+        loss_fn = functools.partial(gpt.loss_fn, cfg=cfg)
+        return jax.make_jaxpr(jax.grad(loss_fn))(
+            params, tokens, tokens
+        )
+
+    def test_fwd_kernel_not_recomputed(self):
+        full = _pallas_outvar_counts(self._grad_jaxpr("full").jaxpr, [])
+        sa = _pallas_outvar_counts(
+            self._grad_jaxpr("save_attn").jaxpr, []
+        )
+        # full remat: fwd (2 outs) twice + bwd (3 outs) once per
+        # layer-scan trace; save_attn: fwd once + bwd once.
+        assert sorted(full) == [2, 2, 3], full
+        assert sorted(sa) == [2, 3], sa
+
+    def test_grad_parity_with_flash(self):
+        def grads(remat):
+            cfg = dataclasses.replace(
+                _cfg(remat),
+                block_size=128,
+                use_flash_attention=True,
+                attn_blocks=(128, 128, 128, 128),
+            )
+            params = gpt.init_params(jax.random.PRNGKey(0), cfg)
+            tokens = jax.random.randint(
+                jax.random.PRNGKey(1), (1, 128), 0, cfg.vocab_size
+            )
+            loss_fn = functools.partial(gpt.loss_fn, cfg=cfg)
+            return jax.jit(jax.grad(loss_fn))(params, tokens, tokens)
+
+        for a, b in zip(
+            jax.tree.leaves(grads("save_attn")),
+            jax.tree.leaves(grads("full")),
+        ):
+            np.testing.assert_allclose(
+                np.asarray(a), np.asarray(b), rtol=2e-4, atol=2e-4
+            )

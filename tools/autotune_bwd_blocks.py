@@ -70,6 +70,12 @@ def main() -> int:
         run_config(mesh, f"full,flash,18,{bq},{bk},-,nofn,u{u}")
     run_config(mesh, f"none,flash,18,{bq},{bk},-,nofn,u4")
     run_config(mesh, f"dots,flash,18,{bq},{bk},-,nofn,u4")
+    # save_attn: full recompute except the flash (o, lse) — skips the
+    # second fwd-kernel run in the backward. Sweep it rolled and at
+    # the unroll points since the two compose.
+    run_config(mesh, f"sattn,flash,18,{bq},{bk},-,nofn")
+    for u in (2, 4):
+        run_config(mesh, f"sattn,flash,18,{bq},{bk},-,nofn,u{u}")
     for bqb, bkb in candidates:
         run_config(mesh, f"full,flash,18,{bq},{bk},-,{bqb},{bkb},nofn")
     print("pick the fastest line; bench.py BENCH_* env then pins it")

@@ -143,10 +143,49 @@ def winner_env(spec: str) -> dict:
         # bench.py defaults to full remat; pin any other winner.
         # Sweep tokens are build_spec's grammar ("attn" etc.); bench
         # wants remat.py policy names, so map through the same table.
-        env["BENCH_REMAT"] = {"attn": "attention"}.get(
-            parts[0], parts[0]
-        )
+        env["BENCH_REMAT"] = {
+            "attn": "attention", "sattn": "save_attn"
+        }.get(parts[0], parts[0])
     return env
+
+
+def persist_winner(pins: dict, tuned_rec: dict, spec: str) -> None:
+    """Write the tuned pins to bench_tuned.json at the repo root when
+    the tuned record beats the baseline by more than measurement
+    noise. bench.py loads this file as its defaults (explicit BENCH_*
+    env still wins), so the driver's end-of-round capture runs the
+    best measured config even if no one edits the code defaults
+    before then. Threshold: +0.5% — half the 3-run stability spread
+    (STABILITY_r05.json: 1.26%) so a within-noise 'winner' never
+    displaces the known-good shipped defaults."""
+    try:
+        with open(os.path.join(REPO, "PERF_r05.json")) as f:
+            base = [
+                r for r in json.load(f) if r.get("stage") == "baseline"
+            ]
+    except Exception:  # noqa: BLE001
+        base = []
+    if not base:
+        return
+    if tuned_rec["value"] <= base[-1]["value"] * 1.005:
+        log(
+            f"tuned {tuned_rec['value']} within noise of baseline "
+            f"{base[-1]['value']}; not pinning"
+        )
+        return
+    # Atomic (tmp + replace): a SIGKILL mid-write must never leave a
+    # truncated file for every later bench run to trip over.
+    path = os.path.join(REPO, "bench_tuned.json")
+    with open(path + ".tmp", "w") as f:
+        json.dump(
+            {"pins": pins, "spec": spec,
+             "tuned_value": tuned_rec["value"],
+             "baseline_value": base[-1]["value"],
+             "ts": tuned_rec.get("ts")},
+            f, indent=1,
+        )
+    os.replace(path + ".tmp", path)
+    log(f"pinned winner to bench_tuned.json: {pins}")
 
 
 def parse_autotune(out: str) -> tuple | None:
@@ -173,7 +212,15 @@ def main() -> int:
         while True:
             attempt += 1
             rec = run_bench(
-                {"BENCH_MAX_WAIT_S": "600", "BENCH_PROBE_TIMEOUT": "90"},
+                {
+                    "BENCH_MAX_WAIT_S": "600",
+                    "BENCH_PROBE_TIMEOUT": "90",
+                    # True shipped defaults: a bench_tuned.json from
+                    # an earlier tune pass must not leak into the
+                    # baseline this stage records (the tuned gate
+                    # compares against this number).
+                    "BENCH_IGNORE_TUNED": "1",
+                },
                 timeout_s=1800,
             )
             if rec and not rec.get("error"):
@@ -236,6 +283,7 @@ def main() -> int:
                 ts=time.strftime("%Y-%m-%dT%H:%M:%SZ", time.gmtime()),
             )
             append_perf(rec)
+            persist_winner(pins, rec, spec)
             return 0
         log(f"tuned re-bench attempt {i + 1}: {rec}")
         time.sleep(90)

@@ -87,7 +87,7 @@ def build_spec(spec: str):
     block_k_bwd = _blk(7)
     remat = {
         "full": True, "attn": "attention", "none": False,
-        "dots": "dots", "offload": "offload",
+        "dots": "dots", "offload": "offload", "sattn": "save_attn",
     }[remat_s]
     use_flash = flash_s == "flash"
 
