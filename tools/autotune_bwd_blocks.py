@@ -76,6 +76,12 @@ def main() -> int:
     run_config(mesh, f"sattn,flash,18,{bq},{bk},-,nofn")
     for u in (2, 4):
         run_config(mesh, f"sattn,flash,18,{bq},{bk},-,nofn,u{u}")
+    # Fused-CE chunk count: the r5 trace prices the CE loops at
+    # 35.5 ms/step with the f32 dwte accumulator re-read per chunk;
+    # fewer chunks trade accumulator round-trips for logits HBM.
+    for xc in (2, 4, 16):
+        run_config(mesh, f"full,flash,18,{bq},{bk},-,nofn,xc{xc}")
+    run_config(mesh, f"sattn,flash,18,{bq},{bk},-,nofn,u4,xc4")
     for bqb, bkb in candidates:
         run_config(mesh, f"full,flash,18,{bq},{bk},-,{bqb},{bkb},nofn")
     print("pick the fastest line; bench.py BENCH_* env then pins it")

@@ -114,15 +114,18 @@ def winner_env(spec: str) -> dict:
         fused = "0"
     elif "fn" in parts:
         fused = "1"
-    from perf_sweep import is_unroll_token
+    from perf_sweep import is_unroll_token, is_xent_token
 
-    unroll = None
+    unroll = xent = None
     for p in parts:
         if is_unroll_token(p):
             unroll = p[1:]
+        elif is_xent_token(p):
+            xent = p[2:]
     parts = [
         p for p in parts
-        if p not in ("nofn", "fn") and not is_unroll_token(p)
+        if p not in ("nofn", "fn")
+        and not is_unroll_token(p) and not is_xent_token(p)
     ]
 
     def blk(i, default):
@@ -139,6 +142,8 @@ def winner_env(spec: str) -> dict:
         env["BENCH_FUSED_NORM"] = fused
     if unroll is not None:
         env["BENCH_UNROLL"] = unroll
+    if xent is not None:
+        env["BENCH_XENT_CHUNKS"] = xent
     if parts and parts[0] != "full":
         # bench.py defaults to full remat; pin any other winner.
         # Sweep tokens are build_spec's grammar ("attn" etc.); bench

@@ -45,12 +45,18 @@ def is_unroll_token(p: str) -> bool:
     return len(p) > 1 and p[0] == "u" and p[1:].isdigit()
 
 
+def is_xent_token(p: str) -> bool:
+    """"xcN" fused-CE chunk-count flag token (same sharing rule)."""
+    return len(p) > 2 and p[:2] == "xc" and p[2:].isdigit()
+
+
 def build_spec(spec: str):
-    """Parse a sweep spec -> (cfg, attn_fn, batch, save_logits).
-    Shared with tools/profile_step.py so the profiled config is
-    byte-identical to the benchmarked one. Omitted fields default to
-    flash attention with the kernel's own autotuned block sizes and
-    batch 16."""
+    """Parse a sweep spec -> (cfg, attn_fn, batch, save_logits,
+    xent_chunks). Shared with tools/profile_step.py so the profiled
+    config is byte-identical to the benchmarked one. Omitted fields
+    default to flash attention with the kernel's own autotuned block
+    sizes and batch 16; xent_chunks resolves here (xcN token, else
+    SWEEP_XENT_CHUNKS, else 8) so every caller sees one value."""
     parts = spec.split(",")
     # "nofn"/"fn" are flag tokens, not positional: strip them before
     # the positional fields so they really work anywhere in the spec.
@@ -63,13 +69,21 @@ def build_spec(spec: str):
     elif "fn" in parts:
         fused_norm = True
     # "uK" (e.g. u2, u4): lax.scan unroll factor for the layer stack.
-    unroll = 1
+    # "xcN" (e.g. xc4): fused-CE chunk count (r5 trace: the f32 dwte
+    # accumulator is re-read/written once per chunk — 144 MB x chunks
+    # of pure accumulator traffic at GPT-2 vocab).
+    unroll, xent_chunks = 1, None
     for p in parts:
         if is_unroll_token(p):
             unroll = int(p[1:])
+        elif is_xent_token(p):
+            xent_chunks = int(p[2:])
+    if xent_chunks is None:  # token absent — env fallback, then 8
+        xent_chunks = int(os.getenv("SWEEP_XENT_CHUNKS", "8"))
     parts = [
         p for p in parts
-        if p not in ("nofn", "fn") and not is_unroll_token(p)
+        if p not in ("nofn", "fn")
+        and not is_unroll_token(p) and not is_xent_token(p)
     ]
     remat_s = parts[0]
     flash_s = parts[1] if len(parts) > 1 else "flash"
@@ -110,16 +124,17 @@ def build_spec(spec: str):
             block_k=block_k, block_q_bwd=block_q_bwd,
             block_k_bwd=block_k_bwd,
         )
-    return cfg, attn_fn, batch, save_logits
+    return cfg, attn_fn, batch, save_logits, xent_chunks
 
 
 def run_config(mesh, spec: str) -> None:
-    cfg, attn_fn, batch, save_logits = build_spec(spec)
+    cfg, attn_fn, batch, save_logits, spec_chunks = build_spec(spec)
 
     optimizer = optax.adamw(3e-4, weight_decay=0.1)
-    # SWEEP_XENT_CHUNKS tunes the fused-CE recompute granularity
-    # (bigger chunks = bigger bwd matmuls, more logits HBM at once).
-    chunks = int(os.getenv("SWEEP_XENT_CHUNKS", "8"))
+    # Fused-CE recompute granularity (bigger chunks = bigger bwd
+    # matmuls and fewer dwte accumulator round-trips, more logits HBM
+    # at once); fully resolved by build_spec.
+    chunks = spec_chunks
     loss = functools.partial(
         gpt.loss_fn_fused, cfg=cfg, attn_fn=attn_fn,
         save_logits=save_logits, num_chunks=chunks,

@@ -40,12 +40,12 @@ def capture(spec: str, trace_dir: str) -> None:
     # build_spec is shared so the profiled program is the benched one.
     from perf_sweep import build_spec
 
-    cfg, attn_fn, batch, save_logits = build_spec(spec)
+    cfg, attn_fn, batch, save_logits, xent_chunks = build_spec(spec)
     mesh = build_mesh(MeshConfig(data=len(jax.devices())))
     optimizer = optax.adamw(3e-4, weight_decay=0.1)
     loss = functools.partial(
         gpt.loss_fn_fused, cfg=cfg, attn_fn=attn_fn,
-        save_logits=save_logits,
+        save_logits=save_logits, num_chunks=xent_chunks,
     )
     init, _ = make_sharded_init(
         mesh,

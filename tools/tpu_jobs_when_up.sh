@@ -67,6 +67,27 @@ for i in $(seq 1 400); do
       echo "[$(date +%T)] running agd convergence (200 steps x 3 runs)"
       timeout 2700 python -u tools/agd_convergence.py --steps 200 >> /tmp/agd_conv.log 2>&1
       echo "[$(date +%T)] agd rc=$?"
+    elif [ ! -f /tmp/capture_tune.done ] && [ "$(cat /tmp/capture_tune.fails 2>/dev/null || echo 0)" -lt 2 ]; then
+      # Ahead of longctx/decode: the tune winner auto-pins into
+      # bench_tuned.json, which the driver's end-of-round capture
+      # loads — the single highest-leverage stage for the headline
+      # if the window is short. The sweep now covers scan-unroll,
+      # save_attn, and xent-chunk axes besides the bwd blocks.
+      # Capped at 2 failed attempts here (a window shorter than the
+      # sweep would otherwise starve longctx/decode forever); a
+      # final uncapped retry sits after the decode stage.
+      echo "[$(date +%T)] autotune + tuned re-bench"
+      CAPTURE_STAGE=tune timeout 5400 python -u tools/capture_perf.py >> /tmp/capture_perf.log 2>&1
+      rc=$?
+      # The tune stage appends to PERF_r05.json on success; a rc=0 with
+      # no autotune results also returns 0 — either way, done once.
+      if [ $rc -eq 0 ]; then
+        touch /tmp/capture_tune.done
+      else
+        fails=$(( $(cat /tmp/capture_tune.fails 2>/dev/null || echo 0) + 1 ))
+        echo "$fails" > /tmp/capture_tune.fails
+      fi
+      echo "[$(date +%T)] tune rc=$rc"
     elif [ ! -f LONGCTX_r05.json ]; then
       echo "[$(date +%T)] running long-context bench"
       timeout 1800 python -u tools/longctx_bench.py >> /tmp/longctx.log 2>&1
@@ -76,13 +97,12 @@ for i in $(seq 1 400); do
       timeout 1800 python -u tools/decode_bench.py >> /tmp/decode_bench.log 2>&1
       echo "[$(date +%T)] decode rc=$?"
     elif [ ! -f /tmp/capture_tune.done ]; then
-      echo "[$(date +%T)] autotune + tuned re-bench"
+      # Uncapped tune retry once everything else has landed.
+      echo "[$(date +%T)] autotune retry (post-bench stages done)"
       CAPTURE_STAGE=tune timeout 5400 python -u tools/capture_perf.py >> /tmp/capture_perf.log 2>&1
       rc=$?
-      # The tune stage appends to PERF_r05.json on success; a rc=0 with
-      # no autotune results also returns 0 — either way, done once.
       [ $rc -eq 0 ] && touch /tmp/capture_tune.done
-      echo "[$(date +%T)] tune rc=$rc"
+      echo "[$(date +%T)] tune retry rc=$rc"
     else
       echo "[$(date +%T)] all jobs done"; exit 0
     fi
