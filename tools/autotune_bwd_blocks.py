@@ -55,6 +55,10 @@ def main() -> int:
                 candidates.append((bqb, bkb))
 
     mesh = build_mesh(MeshConfig(data=len(jax.devices())))
+    # Machine-readable device count: spec batch is GLOBAL for this
+    # mesh, bench.py's BENCH_BATCH_PER_CHIP is per-chip — the capture
+    # tool needs this line to convert units when pinning a winner.
+    print(f"n_devices: {len(jax.devices())}")
     print(f"sweeping {len(candidates)} bwd-block configs at "
           f"fwd {bq}/{bk} (+ fused-norm A/B at defaults)")
     # Baseline A/B first: fused norms off (the r4-measured default)
@@ -82,6 +86,11 @@ def main() -> int:
     for xc in (2, 4, 16):
         run_config(mesh, f"full,flash,18,{bq},{bk},-,nofn,xc{xc}")
     run_config(mesh, f"sattn,flash,18,{bq},{bk},-,nofn,u4,xc4")
+    # Batch interacts with the new memory knobs (save_attn saves
+    # more residuals, small xc holds bigger logits): re-check the
+    # b18 optimum one notch up and down on the combined candidate.
+    run_config(mesh, f"sattn,flash,20,{bq},{bk},-,nofn,u4,xc4")
+    run_config(mesh, f"sattn,flash,16,{bq},{bk},-,nofn,u4,xc4")
     for bqb, bkb in candidates:
         run_config(mesh, f"full,flash,18,{bq},{bk},-,{bqb},{bkb},nofn")
     print("pick the fastest line; bench.py BENCH_* env then pins it")

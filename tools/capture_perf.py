@@ -101,7 +101,7 @@ def run_bench(extra_env: dict, timeout_s: float) -> dict | None:
     return None
 
 
-def winner_env(spec: str) -> dict:
+def winner_env(spec: str, n_chips: int = 1) -> dict:
     """Map a perf_sweep spec (the autotune's fastest line) onto the
     BENCH_* pins bench.py reads. Field layout: perf_sweep.build_spec —
     remat,flash,batch,bq,bk,sl[,bqb,bkb], 'nofn' strippable flag."""
@@ -138,6 +138,12 @@ def winner_env(spec: str) -> dict:
     bqb = blk(6, bq)
     bkb = blk(7, bk)
     env = {"BENCH_BLOCKS": f"{bq},{bk},{bqb},{bkb}"}
+    # Unit conversion: the sweep spec's batch is GLOBAL across its
+    # mesh; bench.py's knob is per-chip (batch = knob * n_chips).
+    batch = blk(2, 18)
+    per_chip = max(1, batch // max(1, n_chips))
+    if per_chip != 18:  # bench.py's default batch-per-chip
+        env["BENCH_BATCH_PER_CHIP"] = str(per_chip)
     if fused is not None:
         env["BENCH_FUSED_NORM"] = fused
     if unroll is not None:
@@ -194,14 +200,19 @@ def persist_winner(pins: dict, tuned_rec: dict, spec: str) -> None:
 
 
 def parse_autotune(out: str) -> tuple | None:
-    """Fastest (spec, step_ms) from perf_sweep result lines."""
+    """Best (spec, tok_s) from perf_sweep result lines. Ranked by
+    tokens/s, NOT step time — the sweep now varies batch size, and a
+    smaller batch always wins on raw step-ms while losing on
+    throughput (the metric bench.py reports)."""
     best = None
     for line in out.splitlines():
-        m = re.match(r"^(\S+)\s+step=\s*([0-9.]+)ms", line)
+        m = re.match(
+            r"^(\S+)\s+step=\s*[0-9.]+ms\s+tok/s=\s*([0-9.]+)", line
+        )
         if m:
-            spec, ms = m.group(1), float(m.group(2))
-            if best is None or ms < best[1]:
-                best = (spec, ms)
+            spec, tok_s = m.group(1), float(m.group(2))
+            if best is None or tok_s > best[1]:
+                best = (spec, tok_s)
     return best
 
 
@@ -267,9 +278,12 @@ def main() -> int:
         # mid-sweep, so report retryable and let the next probe
         # re-enter the stage.
         return 2 if stage_sel == "tune" else 0
-    spec, ms = best
-    log(f"autotune winner: {spec} at {ms}ms")
-    pins = winner_env(spec)
+    spec, tok_s = best
+    m = re.search(r"^n_devices:\s*(\d+)", out, re.M)
+    n_chips = int(m.group(1)) if m else 1
+    log(f"autotune winner: {spec} at {tok_s:.0f} tok/s "
+        f"(sweep mesh: {n_chips} chip(s))")
+    pins = winner_env(spec, n_chips)
 
     # Stage 3: tuned re-bench with the winner pinned.
     for i in range(3):
