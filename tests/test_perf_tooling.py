@@ -1,0 +1,158 @@
+"""Regression tests for the perf measurement tooling: the sweep-spec
+grammar and the unattended capture chain's winner selection/pinning
+(tools/perf_sweep.py, tools/capture_perf.py).
+
+Every case here is a bug class the round-5 reviews actually caught:
+step-ms ranking that lets a smaller batch beat a higher-throughput
+config, global-vs-per-chip batch unit confusion, env vars silently
+overriding explicit spec tokens, and NaN traces winning best-of
+selection in the AGD study.
+"""
+
+import importlib
+import json
+import os
+import sys
+
+import pytest
+
+TOOLS = os.path.join(os.path.dirname(os.path.dirname(__file__)), "tools")
+if TOOLS not in sys.path:
+    sys.path.insert(0, TOOLS)
+
+perf_sweep = importlib.import_module("perf_sweep")
+capture_perf = importlib.import_module("capture_perf")
+
+
+class TestBuildSpec:
+    def test_positional_and_flag_tokens(self):
+        cfg, attn_fn, batch, sl, xc = perf_sweep.build_spec(
+            "sattn,flash,20,1024,512,-,nofn,u4,xc2"
+        )
+        assert cfg.remat == "save_attn"
+        assert cfg.scan_unroll == 4
+        assert cfg.use_fused_norm is False
+        assert batch == 20
+        assert sl is False
+        assert xc == 2
+
+    def test_flag_tokens_position_independent(self):
+        a = perf_sweep.build_spec("full,flash,18,1024,1024,-,nofn,u2")
+        b = perf_sweep.build_spec("full,flash,18,u2,1024,1024,-,nofn")
+        assert a[0].scan_unroll == b[0].scan_unroll == 2
+        assert a[2] == b[2] == 18
+
+    def test_explicit_xc8_beats_env(self, monkeypatch):
+        """xc8 must mean 8 even when SWEEP_XENT_CHUNKS says otherwise
+        — the printed result line is labeled with the spec, so the
+        measured program must match it."""
+        monkeypatch.setenv("SWEEP_XENT_CHUNKS", "4")
+        assert perf_sweep.build_spec("full,flash,18,-,-,-,xc8")[4] == 8
+        # absent token -> env fallback applies
+        assert perf_sweep.build_spec("full,flash,18")[4] == 4
+
+    def test_remat_token_table(self):
+        for tok, name in (
+            ("full", True), ("none", False), ("attn", "attention"),
+            ("sattn", "save_attn"), ("dots", "dots"),
+            ("offload", "offload"),
+        ):
+            assert perf_sweep.build_spec(f"{tok},flash,18")[0].remat == name
+
+
+class TestParseAutotune:
+    OUT = (
+        "n_devices: 1\n"
+        "full,flash,18,1024,1024,-,nofn      step=  166.0ms "
+        "tok/s=   111037 mfu=0.458 vs=0.924\n"
+        "sattn,flash,16,1024,1024,-,nofn,u4,xc4 step=  140.1ms "
+        "tok/s=   109900 mfu=0.470 vs=0.950\n"
+        "sattn,flash,20,1024,1024,-,nofn,u4,xc4 step=  172.0ms "
+        "tok/s=   119069 mfu=0.480 vs=0.960\n"
+        "bogus,flash,18 FAILED: ValueError: nope\n"
+    )
+
+    def test_ranks_by_tokens_per_second_not_step_ms(self):
+        spec, tok_s = capture_perf.parse_autotune(self.OUT)
+        # b16 has the best step time; b20 has the best throughput —
+        # throughput is what bench.py reports, so b20 must win.
+        assert spec.startswith("sattn,flash,20")
+        assert tok_s == 119069.0
+
+    def test_failed_lines_skipped_and_empty_is_none(self):
+        assert capture_perf.parse_autotune("x FAILED: boom") is None
+        assert capture_perf.parse_autotune("") is None
+
+
+class TestWinnerEnv:
+    def test_full_pin_set(self):
+        env = capture_perf.winner_env(
+            "sattn,flash,20,1024,1024,-,nofn,u4,xc4", n_chips=1
+        )
+        assert env == {
+            "BENCH_BLOCKS": "1024,1024,1024,1024",
+            "BENCH_BATCH_PER_CHIP": "20",
+            "BENCH_FUSED_NORM": "0",
+            "BENCH_UNROLL": "4",
+            "BENCH_XENT_CHUNKS": "4",
+            "BENCH_REMAT": "save_attn",
+        }
+
+    def test_batch_is_global_converted_per_chip(self):
+        """Sweep batch is global across its mesh; bench.py's knob is
+        per-chip. A 2-chip sweep at global 40 must pin 20/chip."""
+        env = capture_perf.winner_env(
+            "sattn,flash,40,1024,1024,-,nofn", n_chips=2
+        )
+        assert env["BENCH_BATCH_PER_CHIP"] == "20"
+
+    def test_default_batch_not_pinned(self):
+        env = capture_perf.winner_env("full,flash,18,512,1024,-,nofn")
+        assert "BENCH_BATCH_PER_CHIP" not in env
+
+    def test_attn_token_maps_to_policy_name(self):
+        env = capture_perf.winner_env("attn,flash,18,512,1024,-,nofn")
+        assert env["BENCH_REMAT"] == "attention"
+
+
+class TestPersistWinner:
+    def test_pins_only_beyond_noise_and_atomically(
+        self, tmp_path, monkeypatch
+    ):
+        monkeypatch.setattr(capture_perf, "REPO", str(tmp_path))
+        perf = tmp_path / "PERF_r05.json"
+        perf.write_text(json.dumps(
+            [{"stage": "baseline", "value": 100000.0}]
+        ))
+        pins = {"BENCH_UNROLL": "4"}
+        # within noise: no file
+        capture_perf.persist_winner(pins, {"value": 100300.0}, "s")
+        assert not (tmp_path / "bench_tuned.json").exists()
+        # beyond noise: pinned, valid JSON, no tmp litter
+        capture_perf.persist_winner(pins, {"value": 101000.0}, "s")
+        data = json.loads((tmp_path / "bench_tuned.json").read_text())
+        assert data["pins"] == pins
+        assert not (tmp_path / "bench_tuned.json.tmp").exists()
+
+    def test_no_baseline_no_pin(self, tmp_path, monkeypatch):
+        monkeypatch.setattr(capture_perf, "REPO", str(tmp_path))
+        capture_perf.persist_winner({}, {"value": 1.0}, "s")
+        assert not (tmp_path / "bench_tuned.json").exists()
+
+
+class TestAGDTraceSelection:
+    def test_nan_trace_never_wins(self):
+        """agd_convergence's best-trace guard: a diverged (NaN) final
+        loss must not beat a finite one via NaN-compare semantics."""
+        agd = importlib.import_module("agd_convergence")
+        runs = {
+            3e-5: [(5, 10.0), (10, float("nan"))],
+            6e-5: [(5, 10.5), (10, 9.8)],
+        }
+        lr, tr = agd.best_finite_trace(runs)
+        assert lr == 6e-5 and tr[-1][1] == 9.8
+
+    def test_all_diverged_still_returns(self):
+        agd = importlib.import_module("agd_convergence")
+        runs = {1e-3: [(5, float("nan"))]}
+        assert agd.best_finite_trace(runs)[0] == 1e-3

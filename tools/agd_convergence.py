@@ -81,6 +81,19 @@ def run(optimizer, cfg, mesh, steps, log_every):
     return trace
 
 
+def best_finite_trace(runs):
+    """(key, trace) with the lowest FINITE final loss; a NaN-diverged
+    run must never win (NaN compares false against everything, which
+    would freeze min() on whichever trace it met first). Falls back
+    to the raw dict only if every run diverged."""
+    import math
+
+    finite = {
+        k: tr for k, tr in runs.items() if math.isfinite(tr[-1][1])
+    }
+    return min((finite or runs).items(), key=lambda kv: kv[1][-1][1])
+
+
 def steps_to(trace, target):
     for s, l in trace:
         if l <= target:
@@ -134,17 +147,7 @@ def main() -> int:
                  "agd": {str(k): v for k, v in agd_runs.items()}},
                 f,
             )
-    # Best AGD trace by final loss; a NaN-diverged run must never win
-    # (NaN compares false against everything, so guard explicitly).
-    import math
-
-    finite = {
-        lr: tr for lr, tr in agd_runs.items()
-        if math.isfinite(tr[-1][1])
-    }
-    agd_lr, agd = min(
-        (finite or agd_runs).items(), key=lambda kv: kv[1][-1][1]
-    )
+    agd_lr, agd = best_finite_trace(agd_runs)
     # Ratio: AdamW steps / AGD steps to reach the loss AGD ends at
     # (and a mid target), >1 means AGD is faster.
     final_agd = agd[-1][1]
