@@ -64,8 +64,9 @@ class GPTConfig:
     # compiled block, smallest program); k>1 lets XLA fuse across k
     # consecutive layers and amortize the scan-carry
     # dynamic-update-slice traffic the r5 step profile attributes
-    # ~16% of step time to. Must divide n_layer. A hardware-autotune
-    # axis, not a semantic knob.
+    # ~16% of step time to. Any k >= 1 works — lax.scan handles a
+    # remainder group and clamps k > n_layer (tests assert both). A
+    # hardware-autotune axis, not a semantic knob.
     scan_unroll: int = 1
 
     @property

@@ -27,8 +27,8 @@ def _cfg(remat):
     )
 
 
-def _loss_and_grads(remat):
-    cfg = _cfg(remat)
+def _loss_and_grads(remat, **cfg_overrides):
+    cfg = dataclasses.replace(_cfg(remat), **cfg_overrides)
     params = gpt.init_params(jax.random.PRNGKey(0), cfg)
     tokens = jax.random.randint(
         jax.random.PRNGKey(1), (2, cfg.block_size), 0, cfg.vocab_size
@@ -180,3 +180,28 @@ class TestSaveAttnPolicy:
             np.testing.assert_allclose(
                 np.asarray(a), np.asarray(b), rtol=2e-4, atol=2e-4
             )
+
+
+class TestScanUnroll:
+    """cfg.scan_unroll is a pure scheduling knob: loss and gradients
+    must be bit-comparable across unroll factors, including a factor
+    that does not divide n_layer and one larger than it."""
+
+    @pytest.mark.parametrize("unroll", [2, 3])
+    def test_unroll_parity(self, unroll):
+        base_loss, base_g = _loss_and_grads(True, scan_unroll=1)
+        loss, g = _loss_and_grads(True, scan_unroll=unroll)
+        np.testing.assert_allclose(
+            float(loss), float(base_loss), rtol=1e-6
+        )
+        for a, b in zip(jax.tree.leaves(g), jax.tree.leaves(base_g)):
+            np.testing.assert_allclose(
+                np.asarray(a), np.asarray(b), rtol=1e-5, atol=1e-5
+            )
+
+    def test_unroll_exceeding_layers_ok(self):
+        cfg = dataclasses.replace(_cfg(False), scan_unroll=8)
+        params = gpt.init_params(jax.random.PRNGKey(0), cfg)
+        tokens = jnp.zeros((1, cfg.block_size), jnp.int32)
+        out = gpt.forward(params, tokens, cfg)
+        assert out.shape == (1, cfg.block_size, cfg.vocab_size)
