@@ -85,7 +85,11 @@ def main() -> int:
     # fewer chunks trade accumulator round-trips for logits HBM.
     for xc in (2, 4, 16):
         run_config(mesh, f"full,flash,18,{bq},{bk},-,nofn,xc{xc}")
+    # Lever combinations: each pair/triple, so the winner isn't
+    # hostage to one lever losing on hardware.
     run_config(mesh, f"sattn,flash,18,{bq},{bk},-,nofn,u4,xc4")
+    run_config(mesh, f"sattn,flash,18,{bq},{bk},-,nofn,xc4")
+    run_config(mesh, f"full,flash,18,{bq},{bk},-,nofn,u4,xc4")
     # Batch interacts with the new memory knobs (save_attn saves
     # more residuals, small xc holds bigger logits): re-check the
     # b18 optimum one notch up and down on the combined candidate.

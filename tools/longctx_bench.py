@@ -71,6 +71,9 @@ def main() -> int:
             return jax.jit(jax.grad(loss, argnums=(0, 1, 2)))
 
         row = {"t": t, "batch": b, "heads": h, "head_dim": d}
+        # Progress marker BEFORE the compile: the r5 tunnel died
+        # mid-compile at t=8192 and the log couldn't say where.
+        print(f"# t={t} b={b}: compiling + timing full...", flush=True)
         try:
             dt = bench(fwd_bwd(), q, k, v, n=1 if cpu_check else 10)
             # causal flash fwd+bwd ~ 3.5 * (T^2/2) * H * D * 2*B FLOPs
@@ -79,6 +82,7 @@ def main() -> int:
             row["full_tflops"] = round(flops / dt / 1e12, 1)
             w = 4096
             if t > w:
+                print(f"# t={t}: windowed (w={w})...", flush=True)
                 dtw = bench(fwd_bwd(window=w), q, k, v)
                 row["window4k_ms"] = round(dtw * 1e3, 2)
                 row["window_speedup"] = round(dt / dtw, 2)

@@ -15,8 +15,11 @@ import collections
 import glob
 import gzip
 import json
+import os
 import sys
 import tempfile
+
+TOOLS_DIR = os.path.dirname(os.path.abspath(__file__))
 
 import _repo_path  # noqa: F401
 
@@ -92,14 +95,34 @@ def report(trace_dir: str, top: int = 25) -> None:
         if "TPU" in n or "/device" in n.lower() or "XLA" in n
     }
     per_op = collections.Counter()
+    src_of = {}
     total = 0.0
     for e in events:
         if e.get("ph") != "X" or e.get("pid") not in device_pids:
             continue
         dur = float(e.get("dur", 0.0))
         name = e.get("name", "?")
+        # Purely numeric names are per-execution run-id envelopes
+        # (one per profiled step) duplicating jit_step's total — they
+        # drown the real per-op rows without adding information.
+        if name.isdigit():
+            continue
         per_op[name] += dur
         total += dur
+        if name not in src_of and e.get("args"):
+            a = e["args"]
+            src = a.get("source") or ""
+            tf_op = a.get("tf_op") or ""
+            # keep the repo-relative tail of the source path and the
+            # last two named-scope segments of the tf_op
+            if src:
+                repo = os.path.dirname(TOOLS_DIR) + os.sep
+                src = src.split(repo)[-1]
+            if tf_op:
+                tf_op = "/".join(tf_op.rstrip(":").split("/")[-2:])
+            attr = " ".join(x for x in (src, tf_op) if x)
+            if attr:  # first NON-EMPTY attribution wins
+                src_of[name] = attr
     if not per_op:
         print(
             f"lanes seen: {sorted(set(name_by_pid.values()))[:10]}",
@@ -111,7 +134,7 @@ def report(trace_dir: str, top: int = 25) -> None:
     for name, dur in per_op.most_common(top):
         print(
             f"{dur / total * 100:6.2f}%  {dur / 1e3 / 3:8.3f} ms/step"
-            f"  {name[:90]}"
+            f"  {name[:46]:46s} {src_of.get(name, '')[:70]}"
         )
 
 
