@@ -695,6 +695,46 @@ class TestShardedTraining:
             assert not wqkv.sharding.is_fully_replicated
         assert n_shards == 8  # placed on every device
 
+    def test_save_attn_remat_matches_full_when_sharded(self):
+        """save_attn under GSPMD: same loss as full remat on a
+        sharded mesh with the flash kernel forced — the checkpoint
+        policy must compose with sharded scan + the named pallas fwd
+        (tests/test_remat_policies.py proves the single-device
+        structure; this proves the mesh path)."""
+        mesh = build_mesh(MeshConfig(data=2, fsdp=4))
+        losses = {}
+        for remat in (True, "save_attn"):
+            cfg = _tiny_cfg(
+                remat=remat,
+                use_flash_attention=True,  # forces flash off-TPU too
+                block_size=128,
+                attn_blocks=(128, 128, 128, 128),
+            )
+            loss = functools.partial(gpt.loss_fn, cfg=cfg)
+            opt = optax.adamw(1e-3)
+            init, _ = make_sharded_init(
+                mesh,
+                functools.partial(gpt.init_params, cfg=cfg),
+                gpt.param_logical_axes(cfg),
+                opt,
+            )
+            params, opt_state = init(jax.random.PRNGKey(0))
+            step = make_train_step(mesh, loss, opt)
+            tokens = jax.random.randint(
+                jax.random.PRNGKey(1), (8, 128), 0, cfg.vocab_size
+            )
+            tokens, targets = shard_batch(
+                mesh, tokens, jnp.roll(tokens, -1, axis=1)
+            )
+            for _ in range(2):
+                params, opt_state, metrics = step(
+                    params, opt_state, tokens, targets
+                )
+            losses[str(remat)] = float(metrics["loss"])
+        assert losses["True"] == pytest.approx(
+            losses["save_attn"], rel=1e-5
+        )
+
     def test_seq_parallel_with_ring_attention(self):
         mesh = build_mesh(MeshConfig(seq=4, data=2))
         cfg = _tiny_cfg()
