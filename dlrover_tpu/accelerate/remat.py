@@ -17,13 +17,14 @@ Policies (cfg.remat / Strategy.remat accept these names):
   "dots"       recompute everything except matmul outputs
   "offload"    offload block-boundary residuals (checkpoint_name
                "block_out") to pinned host memory, save nothing else
-  "save_attn"  full recompute EXCEPT Pallas kernel outputs — for a
-               flash-attention block that is exactly (o, lse), so the
-               backward reuses them instead of re-running the flash
-               forward kernel. Trades ~T*E bytes/layer of HBM for the
-               whole attention recompute (r5 profile: the flash fwd is
-               8.8 ms of a 173 ms step at b18, re-run a second time
-               under "full"; the residual traffic costs ~1 ms).
+  "save_attn"  "full"'s saves PLUS the flash forward's (o, lse), so
+               the backward reuses them instead of re-running the
+               flash forward kernel (a dot-level policy can't see
+               inside the flash custom_vjp). Trades ~T*E bytes/layer
+               of HBM for the whole attention recompute (r5 profile:
+               the flash fwd is 8.8 ms of a 173 ms step at b18,
+               re-run a second time under "full"; the residual
+               traffic costs ~1 ms).
 
 Booleans keep working: True == "full", False == "none".
 """
@@ -56,26 +57,43 @@ def canonical(policy: Any) -> str:
     )
 
 
-def save_attn_policy():
-    """Saveable = the flash forward kernel's outputs (o, lse) — the
-    pallas_call named "flash_attention_fwd", nothing else.
-    jax.checkpoint's partial eval then feeds the saved (o, lse)
-    straight to the flash backward kernel as its residuals and
-    dead-code-eliminates the forward kernel from the recompute —
-    verified by counting pallas_call eqns in the grad jaxpr
-    (tests/test_remat_policies.py): full remat traces the fwd kernel
-    twice, this policy once. Everything else (norms — XLA or fused
-    Pallas — projections, MLP) still recomputes, so HBM stays near
-    full-remat levels. With XLA (non-flash) attention there is no
-    matching eqn and this degrades gracefully to "full"."""
+# The ONE definition of what "full" saves — save_attn is documented
+# as "full's saves plus the flash outputs", so both must build on the
+# same base or they silently diverge.
+def full_policy():
+    return jax.checkpoint_policies.dots_with_no_batch_dims_saveable
 
-    def policy(prim, *_, **params):
+
+def save_attn_policy():
+    """"full" remat's saves PLUS the flash forward kernel's outputs.
+
+    "full" here is ``dots_with_no_batch_dims_saveable`` — it already
+    saves the projection/MLP dot outputs (the scan-stacked residuals
+    in the r5 step trace); what it cannot save is the attention
+    output, because that lives INSIDE the flash custom_vjp whose
+    residuals a dot-level policy never sees. The union adds exactly
+    the pallas_call named "flash_attention_fwd": its saved (o, lse)
+    feed the flash backward kernel as residuals directly, and
+    jax.checkpoint's partial eval dead-code-eliminates the forward
+    kernel from the recompute — verified by counting pallas_call eqns
+    in the grad jaxpr (tests/test_remat_policies.py): full remat
+    traces the fwd kernel twice, this policy once, with everything
+    else saved/recomputed exactly as under "full". (Saving ONLY the
+    flash outputs — without full's dot saves — would force the
+    projection matmuls to recompute in the backward and lose more
+    than the skipped flash re-run gains.) With XLA (non-flash)
+    attention there is no matching eqn and this degrades gracefully
+    to "full"."""
+
+    def flash_fwd_saveable(prim, *_, **params):
         return (
             prim.name == "pallas_call"
             and params.get("name") == "flash_attention_fwd"
         )
 
-    return policy
+    return jax.checkpoint_policies.save_from_both_policies(
+        full_policy(), flash_fwd_saveable
+    )
 
 
 def offload_policy():
@@ -108,13 +126,7 @@ def apply_block_remat(
         return block_fn, jax.checkpoint(attn_fn)
     if name == "full":
         return (
-            jax.checkpoint(
-                block_fn,
-                policy=(
-                    jax.checkpoint_policies
-                    .dots_with_no_batch_dims_saveable
-                ),
-            ),
+            jax.checkpoint(block_fn, policy=full_policy()),
             attn_fn,
         )
     if name == "dots":
