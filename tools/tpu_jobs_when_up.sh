@@ -103,6 +103,22 @@ for i in $(seq 1 400); do
       rc=$?
       [ $rc -eq 0 ] && touch /tmp/capture_tune.done
       echo "[$(date +%T)] tune retry rc=$rc"
+    elif [ -f bench_tuned.json ] && [ ! -f /tmp/profile_tuned.txt ]; then
+      # Attribution of the TUNED step (where does the winner's time
+      # go) — spec comes straight from the pinned winner.
+      spec=$(python -c "import json;print(json.load(open('bench_tuned.json'))['spec'])" 2>/dev/null)
+      if [ -n "$spec" ]; then
+        echo "[$(date +%T)] profiling the tuned winner: $spec"
+        if timeout 900 python -u tools/profile_step.py "$spec" > /tmp/profile_tuned.partial 2>&1; then
+          mv /tmp/profile_tuned.partial /tmp/profile_tuned.txt
+          echo "[$(date +%T)] tuned profile ok"
+        else
+          echo "[$(date +%T)] tuned profile failed rc=$?"
+          touch /tmp/profile_tuned.txt  # single attempt; don't loop
+        fi
+      else
+        touch /tmp/profile_tuned.txt
+      fi
     else
       echo "[$(date +%T)] all jobs done"; exit 0
     fi
