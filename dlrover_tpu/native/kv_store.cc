@@ -1032,6 +1032,22 @@ void kv_sparse_apply_nadam(void* param_h, void* m_h, void* v_h,
   });
 }
 
+// Plain sparse gradient descent (ref: tfplus
+// kv_variable/python/training/gradient_descent.py over the
+// KvVariableSparseApplyGradientDescent kernel) — no slots; the
+// simplest member of the fused-apply family and the baseline the
+// adaptive ones are measured against.
+void kv_sparse_apply_sgd(void* param_h, const int64_t* keys,
+                         const float* grads, int64_t n, float lr,
+                         int64_t step) {
+  auto* param = static_cast<KvStore*>(param_h);
+  int dim = param->dim();
+  param->for_each_key(keys, n, step, [&](int64_t i, float* p) {
+    const float* g = grads + i * dim;
+    for (int d = 0; d < dim; ++d) p[d] -= lr * g[d];
+  });
+}
+
 void kv_sparse_apply_momentum(void* param_h, void* mom_h, const int64_t* keys,
                               const float* grads, int64_t n, float lr,
                               float momentum, int64_t step) {

@@ -134,6 +134,10 @@ def _lib() -> ctypes.CDLL:
                 ctypes.c_int64, ctypes.c_float, ctypes.c_float,
                 ctypes.c_int64,
             ]
+            lib.kv_sparse_apply_sgd.argtypes = [
+                ctypes.c_void_p, i64p, f32p, ctypes.c_int64,
+                ctypes.c_float, ctypes.c_int64,
+            ]
             lib.kv_sparse_apply_group_adam.argtypes = [
                 ctypes.c_void_p, ctypes.c_void_p, ctypes.c_void_p,
                 ctypes.c_void_p, ctypes.c_void_p, i64p, f32p,
@@ -521,6 +525,12 @@ class KvVariable:
                 ukeys, ugrads, ukeys.size,
                 lr, kw.get("momentum", 0.9), step,
             )
+        elif optimizer in ("sgd", "gradient_descent"):
+            # ref: tfplus python/training/gradient_descent.py — the
+            # slot-free baseline of the fused-apply family.
+            lib.kv_sparse_apply_sgd(
+                h, ukeys, ugrads, ukeys.size, lr, step
+            )
         elif optimizer == "group_adam":
             # Adam + group lasso (ref tfplus group_adam.py /
             # training_ops.cc:1065): rows whose L21-shrunk linear norm
@@ -800,11 +810,11 @@ class KvVariable:
 
 class SparseOptimizer:
     """Convenience: one object applying the same rule to many
-    KvVariables. Rules: adam | adagrad | ftrl | momentum | lamb |
-    adabelief | amsgrad | radam | adadelta | adahessian | rmsprop |
-    adamax | nadam | group_adam | group_ftrl — the group_* variants
-    carry
-    the reference's group-lasso L21 row sparsification
+    KvVariables. Rules: sgd (alias gradient_descent) | adam |
+    adagrad | ftrl | momentum | lamb | adabelief | amsgrad | radam |
+    adadelta | adahessian | rmsprop | adamax | nadam | group_adam |
+    group_ftrl — the group_* variants carry the reference's
+    group-lasso L21 row sparsification
     (tfplus python/training/group_adam.py, sparse_group_ftrl.py;
     kernels in native/kv_store.cc)."""
 

@@ -1064,3 +1064,30 @@ def test_kv_adadqh_hypergradients_surface():
     # misspelled slot names raise instead of returning silent zeros
     with pytest.raises(KeyError, match="unknown slot"):
         kv.gather_slot("M", keys)
+
+
+def test_sparse_sgd_exact_and_converges():
+    """Slot-free sparse gradient descent (ref tfplus
+    python/training/gradient_descent.py): exact p -= lr*g semantics,
+    alias 'gradient_descent' accepted, and convergence on the same
+    quadratic the other families use."""
+    dim = 4
+    kv = KvVariable("sgd_exact", embedding_dim=dim, seed=7)
+    keys = np.array([3], np.int64)
+    before = kv.gather(keys).copy()
+    g = np.ones((1, dim), np.float32)
+    kv.apply_gradients("sgd", keys, g, step=1, lr=0.25)
+    after = kv.gather(keys, train=False)
+    np.testing.assert_allclose(
+        before - after, 0.25 * np.ones((1, dim)), atol=1e-6
+    )
+
+    target = np.ones((1, dim), np.float32)
+    kv2 = KvVariable("sgd_conv", embedding_dim=dim, seed=8)
+    for step in range(1, 200):
+        vals = kv2.gather(keys)
+        kv2.apply_gradients(
+            "gradient_descent", keys, vals - target, step=step, lr=0.1
+        )
+    final = kv2.gather(keys, train=False)
+    assert np.abs(final - target).max() < 0.05

@@ -109,14 +109,14 @@ for i in $(seq 1 400); do
       spec=$(python -c "import json;print(json.load(open('bench_tuned.json'))['spec'])" 2>/dev/null)
       if [ -n "$spec" ]; then
         echo "[$(date +%T)] profiling the tuned winner: $spec"
-        if timeout 900 python -u tools/profile_step.py "$spec" > /tmp/profile_tuned.partial 2>&1; then
-          mv /tmp/profile_tuned.partial /tmp/profile_tuned.txt
-          echo "[$(date +%T)] tuned profile ok"
-        else
-          echo "[$(date +%T)] tuned profile failed rc=$?"
-          touch /tmp/profile_tuned.txt  # single attempt; don't loop
-        fi
+        timeout 900 python -u tools/profile_step.py "$spec" > /tmp/profile_tuned.partial 2>&1
+        rc=$?
+        # single attempt either way; keep the output (including the
+        # failure diagnostics) rather than touching an empty file
+        mv /tmp/profile_tuned.partial /tmp/profile_tuned.txt
+        echo "[$(date +%T)] tuned profile rc=$rc"
       else
+        echo "[$(date +%T)] bench_tuned.json has no readable spec; skipping tuned profile"
         touch /tmp/profile_tuned.txt
       fi
     else
