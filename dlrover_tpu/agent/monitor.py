@@ -19,6 +19,7 @@ import threading
 import time
 from typing import Optional
 
+from dlrover_tpu import obs
 from dlrover_tpu.common.log import get_logger
 
 logger = get_logger("agent_monitor")
@@ -124,6 +125,7 @@ class TrainingMonitor:
     ) -> None:
         """Called from the TRAINING process each step (cheap: one
         tmp-file rename)."""
+        obs.event("trainer.step", step=step, tokens=tokens)
         path = path or os.getenv(METRICS_FILE_ENV, default_metrics_file())
         tmp = f"{path}.tmp"
         with open(tmp, "w") as f:
@@ -142,6 +144,12 @@ class TrainingMonitor:
         explainable, budget-checkable segments. Each trainer (re)start
         overwrites the file from its own proc_start, so the file
         always describes the LATEST attempt."""
+        # Mirror every mark into the obs tracer (its own env gate,
+        # DLROVER_TPU_TRACE_FILE): the recovery-timeline reconstructor
+        # (obs/timeline.py) folds these "trainer.<mark>" events into
+        # the canonical failure-detect/rendezvous/restore/first-step
+        # breakdown. No-op when tracing is off.
+        obs.event(f"trainer.{name}")
         path = path or os.getenv(PHASES_FILE_ENV)
         if not path:
             return

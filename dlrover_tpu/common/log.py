@@ -1,19 +1,83 @@
 """Structured logging for all dlrover-tpu processes.
 
 One shared logger (parity: dlrover/python/common/log.py) with a
-rank/role-aware format so interleaved multi-process logs stay readable.
+rank/role-aware format so interleaved multi-process logs stay
+readable: role comes from ``DLROVER_TPU_ROLE`` (stamped by the elastic
+launcher), rank from ``JAX_PROCESS_INDEX`` or
+``DLROVER_TPU_NODE_RANK``. Setting ``DLROVER_TPU_LOG_JSON=1`` switches
+to machine-readable JSON lines (one object per record) for log
+pipelines.
 """
 
 from __future__ import annotations
 
+import json
 import logging
 import os
 import sys
 
 _FORMAT = (
-    "[%(asctime)s] [%(levelname)s] "
+    "[%(asctime)s] [%(levelname)s] [%(role_rank)s] "
     "[%(name)s:%(lineno)d] %(message)s"
 )
+
+
+def role_and_rank() -> tuple:
+    """(role, rank) of this process from the environment — the single
+    definition of that contract, shared with the obs tracer's event
+    tags. Role comes from ``DLROVER_TPU_ROLE`` (stamped by the elastic
+    launcher), rank from ``JAX_PROCESS_INDEX`` falling back to
+    ``DLROVER_TPU_NODE_RANK``; rank is -1 when absent/unparsable. Read
+    per-call: the launcher/agent may set the vars after import."""
+    role = os.getenv("DLROVER_TPU_ROLE", "") or ""
+    rank_s = os.getenv(
+        "JAX_PROCESS_INDEX", os.getenv("DLROVER_TPU_NODE_RANK", "")
+    )
+    try:
+        rank = int(rank_s)
+    except ValueError:
+        rank = -1
+    return role, rank
+
+
+def _role_rank() -> str:
+    """``role/rank`` log tag, e.g. ``worker/0``."""
+    role, rank = role_and_rank()
+    role = role or "-"
+    return f"{role}/{rank}" if rank >= 0 else role
+
+
+class _TextFormatter(logging.Formatter):
+    def format(self, record: logging.LogRecord) -> str:
+        record.role_rank = _role_rank()
+        return super().format(record)
+
+
+class _JsonFormatter(logging.Formatter):
+    """One JSON object per record (DLROVER_TPU_LOG_JSON=1)."""
+
+    def format(self, record: logging.LogRecord) -> str:
+        role, rank = role_and_rank()
+        role = role or "-"
+        payload = {
+            "ts": round(record.created, 3),
+            "level": record.levelname,
+            "logger": record.name,
+            "line": record.lineno,
+            "role": role,
+            "rank": rank,
+            "pid": record.process,
+            "msg": record.getMessage(),
+        }
+        if record.exc_info:
+            payload["exc"] = self.formatException(record.exc_info)
+        return json.dumps(payload, default=str)
+
+
+def _make_formatter() -> logging.Formatter:
+    if os.getenv("DLROVER_TPU_LOG_JSON", "") == "1":
+        return _JsonFormatter()
+    return _TextFormatter(_FORMAT)
 
 
 def _build_logger(name: str = "dlrover_tpu") -> logging.Logger:
@@ -23,13 +87,23 @@ def _build_logger(name: str = "dlrover_tpu") -> logging.Logger:
     level = os.getenv("DLROVER_TPU_LOG_LEVEL", "INFO").upper()
     logger.setLevel(level)
     handler = logging.StreamHandler(sys.stderr)
-    handler.setFormatter(logging.Formatter(_FORMAT))
+    handler.setFormatter(_make_formatter())
     logger.addHandler(handler)
     logger.propagate = False
     return logger
 
 
 default_logger = _build_logger()
+
+
+def reconfigure() -> None:
+    """Re-read the env knobs (JSON mode, level) onto the existing
+    handlers — for processes that set them after import."""
+    default_logger.setLevel(
+        os.getenv("DLROVER_TPU_LOG_LEVEL", "INFO").upper()
+    )
+    for handler in default_logger.handlers:
+        handler.setFormatter(_make_formatter())
 
 
 def get_logger(name: str) -> logging.Logger:

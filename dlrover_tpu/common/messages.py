@@ -372,6 +372,20 @@ class JobNodesResponse:
 
 
 @message
+class MetricsRequest:
+    """Fetch the master's metrics in Prometheus text format over the
+    control plane (same payload as the HTTP /metrics endpoint, for
+    agents/tools that already hold an RPC channel)."""
+
+    node_id: int = -1
+
+
+@message
+class MetricsResponse:
+    text: str = ""
+
+
+@message
 class ScalePlanMsg:
     """A resource plan: target number of nodes per type."""
 

@@ -20,6 +20,7 @@ import threading
 import time
 from typing import List, Optional
 
+from dlrover_tpu import obs
 from dlrover_tpu.common.constants import NodeStatus, NodeType
 from dlrover_tpu.common.log import get_logger
 from dlrover_tpu.common.node import Node, NodeResource
@@ -27,6 +28,12 @@ from dlrover_tpu.master.job_manager import JobManager, ScalePlan
 from dlrover_tpu.master.speed_monitor import SpeedMonitor
 
 logger = get_logger("auto_scaler")
+
+_SCALE_PLANS = obs.counter(
+    "dlrover_autoscale_plans_total",
+    "Scale plans issued by the auto-scalers",
+    ("kind",),
+)
 
 OOM_MEMORY_GROW_FACTOR = 1.5  # ref local_optimizer.py:96 grows OOM pods
 
@@ -343,6 +350,12 @@ class PsTrainingAutoScaler:
         for node in plan.launch_nodes:
             self.job_manager.adopt_node(node)
         self.job_manager.scaler.scale(plan)
+        _SCALE_PLANS.inc(kind="ps_hot_migration")
+        obs.event(
+            "autoscale.plan",
+            kind="ps_hot_migration",
+            launch=[n.id for n in plan.launch_nodes],
+        )
         return plan
 
     def _finish_migrations(self) -> None:
@@ -422,6 +435,12 @@ class PsTrainingAutoScaler:
         self.job_manager.scaler.scale(plan)
         logger.info(
             "ps-strategy worker adjust: %d -> %d", len(workers), target
+        )
+        _SCALE_PLANS.inc(kind="ps_worker_adjust")
+        obs.event(
+            "autoscale.plan",
+            kind="ps_worker_adjust",
+            current=len(workers), target=target,
         )
         return plan
 
@@ -549,6 +568,12 @@ class AllreduceAutoScaler:
         for node in plan.launch_nodes:
             self.job_manager.adopt_node(node)
         self.job_manager.scaler.scale(plan)
+        _SCALE_PLANS.inc(kind="allreduce_replace")
+        obs.event(
+            "autoscale.plan",
+            kind="allreduce_replace",
+            alive=len(alive), target=target, missing=missing,
+        )
         logger.info(
             "auto-scaler: %d alive of target %d -> launching %d "
             "(slices %s)",

@@ -16,6 +16,7 @@ import threading
 import time
 from typing import Callable, Dict, List, Optional
 
+from dlrover_tpu import obs
 from dlrover_tpu.common.constants import (
     JobExitReason,
     NodeAction,
@@ -29,6 +30,17 @@ from dlrover_tpu.common.log import get_logger
 from dlrover_tpu.common.node import Node, NodeResource
 
 logger = get_logger("job_manager")
+
+_NODE_EVENTS = obs.counter(
+    "dlrover_node_events_total",
+    "Node lifecycle events observed by the master",
+    ("event",),
+)
+_RELAUNCHES = obs.counter(
+    "dlrover_node_relaunch_total",
+    "Node relaunches ordered by the master",
+    ("reason",),
+)
 
 
 class ScalePlan:
@@ -224,6 +236,11 @@ class JobManager:
             self._apply_role_policy(node)
             node.update_status(NodeStatus.RUNNING)
             node.update_heartbeat()
+        _NODE_EVENTS.inc(event="register")
+        obs.event(
+            "node.register",
+            node_id=node.id, type=node.type, node_rank=node.rank,
+        )
         self._notify(node, NodeEventType.CREATED)
         return node
 
@@ -390,6 +407,12 @@ class JobManager:
             fatal,
             relaunch,
         )
+        _NODE_EVENTS.inc(event="fail")
+        obs.event(
+            "node.fail",
+            node_id=node_id, type=node.type,
+            reason=node.exit_reason or "", relaunch=relaunch,
+        )
         self._notify(node, NodeEventType.MODIFIED)
         if relaunch:
             self._relaunch(node)
@@ -420,6 +443,13 @@ class JobManager:
         return self._job_failure
 
     def _relaunch(self, node: Node) -> None:
+        _RELAUNCHES.inc(reason=node.exit_reason or "unknown")
+        obs.event(
+            "node.relaunch",
+            node_id=node.id, type=node.type,
+            reason=node.exit_reason or "",
+            relaunch_count=node.relaunch_count,
+        )
         plan = ScalePlan()
         new_node = Node(
             type=node.type,
@@ -468,6 +498,12 @@ class JobManager:
         logger.warning(
             "node %d gone (%s); relaunch=%s", node_id, reason, relaunch
         )
+        _NODE_EVENTS.inc(event="gone")
+        obs.event(
+            "node.gone",
+            node_id=node_id, type=node.type,
+            reason=node.exit_reason or "", relaunch=relaunch,
+        )
         self._notify(node, NodeEventType.DELETED)
         if relaunch:
             self._relaunch(node)
@@ -493,6 +529,7 @@ class JobManager:
             if node is not None:
                 node.update_status(NodeStatus.SUCCEEDED)
         if node is not None:
+            _NODE_EVENTS.inc(event="succeeded")
             self._notify(node, NodeEventType.MODIFIED)
 
     # -- hang watchdog ------------------------------------------------------
@@ -568,6 +605,12 @@ class JobManager:
                 "node %d heartbeat timeout (>%ss); treating as dead",
                 node.id,
                 self._heartbeat_timeout,
+            )
+            _NODE_EVENTS.inc(event="heartbeat_timeout")
+            obs.event(
+                "node.heartbeat_timeout",
+                node_id=node.id, type=node.type,
+                timeout_s=self._heartbeat_timeout,
             )
             self._notify(node, NodeEventType.DELETED)
             if node.should_relaunch():

@@ -42,6 +42,12 @@ def parse_args(argv=None):
     parser.add_argument(
         "--monitor_interval", type=float, default=30.0
     )
+    # Prometheus text exposition: GET /metrics on this port (0 =
+    # ephemeral, printed as DLROVER_TPU_METRICS_PORT=N; unset = no
+    # HTTP endpoint — metrics stay reachable over the MetricsRequest
+    # RPC either way).
+    parser.add_argument("--metrics_port", type=int, default=None)
+    parser.add_argument("--job_name", type=str, default="")
     return parser.parse_args(argv)
 
 
@@ -58,6 +64,8 @@ def main(argv=None) -> int:
             evaluator_count=args.evaluator_count,
             heartbeat_timeout=args.heartbeat_timeout,
             monitor_interval=args.monitor_interval,
+            job_name=args.job_name,
+            metrics_port=args.metrics_port,
         )
     except ValueError as exc:
         logger.error("invalid arguments: %s", exc)
@@ -67,6 +75,11 @@ def main(argv=None) -> int:
         master.start_ps_autoscaler(interval=args.ps_autoscale_interval)
     # Print the bound port on stdout so a parent process can discover it.
     print(f"DLROVER_TPU_MASTER_PORT={master.port}", flush=True)
+    if master.metrics_server is not None:
+        print(
+            f"DLROVER_TPU_METRICS_PORT={master.metrics_server.port}",
+            flush=True,
+        )
     return master.run()
 
 

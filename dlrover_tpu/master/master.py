@@ -8,6 +8,7 @@ CLI on the rank-0 host; on a cluster it runs in its own pod.
 
 from __future__ import annotations
 
+import os
 import threading
 import time
 from typing import Optional
@@ -17,6 +18,11 @@ from dlrover_tpu.common.constants import NodeType
 from dlrover_tpu.common.log import get_logger
 from dlrover_tpu.master.job_manager import JobManager, Scaler
 from dlrover_tpu.master.kv_store import KVStoreService
+from dlrover_tpu.master.metrics import (
+    JobMetricCollector,
+    LogReporter,
+    RegistryReporter,
+)
 from dlrover_tpu.master.rendezvous import (
     ElasticRendezvous,
     NetworkCheckRendezvous,
@@ -26,6 +32,8 @@ from dlrover_tpu.master.speed_monitor import SpeedMonitor
 from dlrover_tpu.master.task_manager import TaskManager
 
 logger = get_logger("master")
+
+METRICS_PORT_ENV = "DLROVER_TPU_METRICS_PORT"
 
 
 class JobMaster:
@@ -41,13 +49,18 @@ class JobMaster:
         evaluator_count: int = 0,
         heartbeat_timeout: float = 180.0,
         monitor_interval: float = 30.0,
+        job_name: str = "",
+        metrics_port: Optional[int] = None,
+        collect_interval: float = 60.0,
     ):
         """``node_num`` is the desired (max) world size; ``min_nodes``
         (default = node_num) is the smallest world the job may proceed
         with after losses — the elastic range of ``--nnodes min:max``.
         ``critical_workers`` ("", "all", "none", "0:3,5:1") marks
         workers whose permanent loss fails the job; ``evaluator_count``
-        standalone evaluator nodes are scheduled at prepare()."""
+        standalone evaluator nodes are scheduled at prepare().
+        ``metrics_port`` (or DLROVER_TPU_METRICS_PORT; 0 = ephemeral)
+        serves Prometheus text metrics at GET /metrics."""
         self.node_num = node_num
         self.evaluator_count = evaluator_count
         self.job_manager = JobManager(
@@ -84,6 +97,21 @@ class JobMaster:
         # master.start_ps_autoscaler() wires the hot-PS optimizer to
         # the registered PS fleet.
         self.ps_auto_scaler = None
+        # Job-fact aggregation (runtime, node counts, speed, failures)
+        # periodically logged AND mirrored into the obs registry the
+        # Prometheus endpoint serves.
+        self.metric_collector = JobMetricCollector(
+            job_name or os.getenv("DLROVER_TPU_JOB_NAME", "default"),
+            self.job_manager,
+            self.speed_monitor,
+            reporters=[LogReporter(), RegistryReporter()],
+            interval=collect_interval,
+        )
+        if metrics_port is None:
+            port_s = os.getenv(METRICS_PORT_ENV, "")
+            metrics_port = int(port_s) if port_s else None
+        self._metrics_port = metrics_port
+        self.metrics_server = None
         dispatcher = RpcDispatcher()
         self.servicer.register(dispatcher)
         self._server = RpcServer(dispatcher, port=port)
@@ -151,6 +179,14 @@ class JobMaster:
         self._server.start()
         self.job_manager.start()
         self.task_manager.start()
+        self.metric_collector.start()
+        if self._metrics_port is not None:
+            from dlrover_tpu.obs.exposition import MetricsHTTPServer
+
+            self.metrics_server = MetricsHTTPServer(
+                port=self._metrics_port
+            )
+            self.metrics_server.start()
         # Any job may register PS hosts (sparse path); their liveness
         # probing must not depend on --ps_autoscale. A dead PS is
         # failed over in ~10 s — well inside the sparse client's
@@ -213,6 +249,12 @@ class JobMaster:
         self.ps_manager.stop_liveness_monitor()
         self.task_manager.stop()
         self.job_manager.stop()
+        # stop() joins the collector thread: after this returns no
+        # late snapshot can race the server teardown below.
+        self.metric_collector.stop()
+        if self.metrics_server is not None:
+            self.metrics_server.stop()
+            self.metrics_server = None
         self._server.stop(0)
 
 

@@ -16,6 +16,7 @@ import threading
 import time
 from typing import Dict, List, Optional
 
+from dlrover_tpu import obs
 from dlrover_tpu.common.constants import NodeStatus, NodeType
 from dlrover_tpu.common.log import get_logger
 
@@ -58,6 +59,50 @@ class JsonFileReporter(Reporter):
     def report(self, snapshot: JobSnapshot) -> None:
         with self._lock, open(self.path, "a") as f:
             f.write(json.dumps(snapshot.to_dict()) + "\n")
+
+
+class RegistryReporter(Reporter):
+    """Mirrors each snapshot into the obs metrics registry, which the
+    master exposes in Prometheus text format (HTTP /metrics and the
+    MetricsRequest RPC). Event-driven counters (relaunches, rendezvous
+    rounds) are incremented at their source; this reporter owns the
+    sampled job-level gauges."""
+
+    def __init__(self, registry=None):
+        registry = registry or obs.get_registry()
+        self._workers = registry.gauge(
+            "dlrover_job_workers",
+            "Worker nodes by state",
+            ("state",),
+        )
+        self._relaunch_total = registry.gauge(
+            "dlrover_job_worker_relaunches",
+            "Cumulative relaunch count across current worker nodes",
+        )
+        self._step = registry.gauge(
+            "dlrover_job_global_step", "Latest reported global step"
+        )
+        self._speed = registry.gauge(
+            "dlrover_job_steps_per_second",
+            "Training speed over the speed-monitor window",
+        )
+        self._tokens = registry.gauge(
+            "dlrover_job_tokens_per_second",
+            "Token throughput over the speed-monitor window",
+        )
+        self._runtime = registry.gauge(
+            "dlrover_job_runtime_seconds", "Master-observed job runtime"
+        )
+
+    def report(self, snapshot: JobSnapshot) -> None:
+        self._workers.set(snapshot.workers_alive, state="alive")
+        self._workers.set(snapshot.workers_pending, state="pending")
+        self._workers.set(snapshot.workers_failed, state="failed")
+        self._relaunch_total.set(snapshot.total_relaunches)
+        self._step.set(snapshot.global_step)
+        self._speed.set(snapshot.speed_steps_per_s)
+        self._tokens.set(snapshot.token_throughput)
+        self._runtime.set(snapshot.runtime_s)
 
 
 class JobMetricCollector:
@@ -124,7 +169,14 @@ class JobMetricCollector:
             self._thread.start()
 
     def stop(self) -> None:
+        """Stop and JOIN the collector thread so shutdown is
+        deterministic — the loop wakes from its interval wait
+        immediately on the stop event, so the join is prompt."""
         self._stop.set()
+        thread = self._thread
+        if thread is not None and thread.is_alive():
+            thread.join(timeout=10.0)
+        self._thread = None
 
     def _loop(self) -> None:
         while not self._stop.wait(self.interval):

@@ -26,10 +26,27 @@ import threading
 import time
 from typing import Dict, List, Optional, Set, Tuple
 
+from dlrover_tpu import obs
 from dlrover_tpu.common.constants import RendezvousName
 from dlrover_tpu.common.log import get_logger
 
 logger = get_logger("rendezvous")
+
+_RDZV_ROUNDS = obs.counter(
+    "dlrover_rendezvous_rounds_total",
+    "Completed rendezvous rounds",
+    ("name",),
+)
+_RDZV_WORLD = obs.gauge(
+    "dlrover_rendezvous_world_size",
+    "Node count of the most recently frozen world",
+    ("name",),
+)
+_RDZV_SECONDS = obs.histogram(
+    "dlrover_rendezvous_seconds",
+    "Wall time from first join to world freeze",
+    ("name",),
+)
 
 
 class RendezvousParameters:
@@ -101,6 +118,10 @@ class RendezvousManagerBase:
                     self.name,
                     self._rdzv_round,
                 )
+                obs.event(
+                    "rdzv.start",
+                    rdzv=self.name, round=self._rdzv_round,
+                )
             if node_rank not in self._waiting_nodes:
                 self._waiting_nodes[node_rank] = local_world_size
                 # Only a returning member of the frozen world invalidates
@@ -152,6 +173,15 @@ class RendezvousManagerBase:
                 len(self._rdzv_nodes),
                 elapsed,
                 self._waiting_nodes,
+            )
+            _RDZV_ROUNDS.inc(name=self.name)
+            _RDZV_WORLD.set(len(self._rdzv_nodes), name=self.name)
+            _RDZV_SECONDS.observe(elapsed, name=self.name)
+            obs.event(
+                "rdzv.complete",
+                rdzv=self.name, round=self._rdzv_round,
+                world_size=len(self._rdzv_nodes),
+                elapsed_s=round(elapsed, 3),
             )
         return completed
 

@@ -79,6 +79,7 @@ class MasterServicer:
         g(msg.ShardCheckpointRequest, self._get_shard_checkpoint)
         g(msg.JobNodesRequest, self._get_job_nodes)
         g(msg.ParallelConfigRequest, self._get_parallel_config)
+        g(msg.MetricsRequest, self._get_metrics)
 
         r(msg.KVStoreSetRequest, self._kv_set)
         r(msg.DatasetShardParams, self._create_dataset)
@@ -276,6 +277,11 @@ class MasterServicer:
 
     def _get_parallel_config(self, req: msg.ParallelConfigRequest):
         return self.parallel_config
+
+    def _get_metrics(self, req: msg.MetricsRequest):
+        from dlrover_tpu import obs
+
+        return msg.MetricsResponse(text=obs.get_registry().render())
 
     def set_parallel_config(self, config: msg.ParallelConfig) -> None:
         """Called by the auto-tuner; version bump tells agents to

@@ -1,0 +1,55 @@
+"""Cross-layer observability substrate: metrics + event tracing.
+
+Every layer of the stack (master node management, rendezvous,
+auto-scaling, flash checkpoint, elastic trainer) records what it is
+doing through this package, so "what is the job doing right now" and
+"where did the recovery time go" are answerable from one place:
+
+* :mod:`dlrover_tpu.obs.metrics` — a process-local registry of
+  counters/gauges/histograms with labels, rendered in Prometheus text
+  exposition format by ``registry.render()`` (no ``prometheus_client``
+  dependency — the whole package is stdlib-only by contract, enforced
+  by tests/test_obs.py::test_no_prometheus_or_otel_imports).
+* :mod:`dlrover_tpu.obs.tracer` — lightweight events/spans with
+  monotonic timestamps and process/role/rank tags, exported as JSON
+  lines when ``DLROVER_TPU_TRACE_FILE`` is set. Disabled (the
+  default) every hook is a None-check costing well under a
+  microsecond, so instrumented hot paths stay hot.
+* :mod:`dlrover_tpu.obs.timeline` — folds an event stream into the
+  canonical recovery breakdown ``failure-detect -> rendezvous ->
+  restore -> first-step -> 90%-throughput`` that the chaos drills
+  assert on.
+* :mod:`dlrover_tpu.obs.exposition` — a stdlib HTTP server giving the
+  master a ``GET /metrics`` Prometheus endpoint.
+
+The functions re-exported here are the instrumentation surface the
+rest of the codebase uses::
+
+    from dlrover_tpu import obs
+
+    _RELAUNCHES = obs.counter("dlrover_node_relaunch_total", "...")
+    _RELAUNCHES.inc(type="worker")
+    obs.event("node.relaunch", node_id=3)
+    with obs.span("ckpt.save"):
+        ...
+"""
+
+from dlrover_tpu.obs.metrics import (  # noqa: F401
+    Counter,
+    Gauge,
+    Histogram,
+    MetricsRegistry,
+    counter,
+    gauge,
+    get_registry,
+    histogram,
+)
+from dlrover_tpu.obs.tracer import (  # noqa: F401
+    EventTracer,
+    configure_tracer,
+    disable_tracer,
+    event,
+    get_tracer,
+    span,
+    tracing_enabled,
+)

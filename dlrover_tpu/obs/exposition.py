@@ -1,0 +1,94 @@
+"""Prometheus text-format exposition over HTTP (stdlib only).
+
+The master opts in with ``--metrics_port`` (or
+``DLROVER_TPU_METRICS_PORT``); scraping is then::
+
+    curl http://<master-host>:<port>/metrics
+
+Built on ``http.server.ThreadingHTTPServer`` — no ``prometheus_client``
+``start_http_server``, keeping the zero-dependency contract. Tests that
+only need the payload call ``registry.render()`` directly and never
+bind a socket.
+"""
+
+from __future__ import annotations
+
+import threading
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+from typing import Optional
+
+from dlrover_tpu.common.log import get_logger
+from dlrover_tpu.obs.metrics import MetricsRegistry, get_registry
+
+logger = get_logger("obs.http")
+
+CONTENT_TYPE = "text/plain; version=0.0.4; charset=utf-8"
+
+
+def _make_handler(registry: MetricsRegistry):
+    class Handler(BaseHTTPRequestHandler):
+        def do_GET(self):  # noqa: N802 - BaseHTTPRequestHandler API
+            path = self.path.split("?", 1)[0]
+            if path == "/metrics":
+                body = registry.render().encode()
+                self.send_response(200)
+                self.send_header("Content-Type", CONTENT_TYPE)
+                self.send_header("Content-Length", str(len(body)))
+                self.end_headers()
+                self.wfile.write(body)
+            elif path in ("/", "/healthz"):
+                body = b"ok\n"
+                self.send_response(200)
+                self.send_header("Content-Type", "text/plain")
+                self.send_header("Content-Length", str(len(body)))
+                self.end_headers()
+                self.wfile.write(body)
+            else:
+                self.send_error(404)
+
+        def log_message(self, fmt, *args):
+            # Scrapes land every few seconds; keep them out of stderr.
+            logger.debug("http: " + fmt, *args)
+
+    return Handler
+
+
+class MetricsHTTPServer:
+    """Serves ``GET /metrics`` for a registry on a daemon thread."""
+
+    def __init__(
+        self,
+        registry: Optional[MetricsRegistry] = None,
+        port: int = 0,
+        host: str = "0.0.0.0",
+    ):
+        self.registry = registry or get_registry()
+        self._server = ThreadingHTTPServer(
+            (host, port), _make_handler(self.registry)
+        )
+        self._server.daemon_threads = True
+        self._thread: Optional[threading.Thread] = None
+
+    @property
+    def port(self) -> int:
+        return self._server.server_address[1]
+
+    def start(self) -> None:
+        if self._thread is None:
+            self._thread = threading.Thread(
+                target=self._server.serve_forever,
+                name="metrics-http",
+                daemon=True,
+            )
+            self._thread.start()
+            logger.info(
+                "metrics endpoint on http://127.0.0.1:%d/metrics",
+                self.port,
+            )
+
+    def stop(self) -> None:
+        self._server.shutdown()
+        self._server.server_close()
+        if self._thread is not None:
+            self._thread.join(timeout=5.0)
+            self._thread = None

@@ -1,0 +1,216 @@
+"""Recovery-timeline reconstruction from an obs event stream.
+
+Folds the JSONL events the tracer exports into the canonical recovery
+breakdown the chaos drills and the BASELINE contract reason about::
+
+    failure-detect -> rendezvous -> build -> restore -> first-step
+                                                   [-> throughput-90]
+
+The trainer-side marks are the ``trainer.*`` events mirrored from
+``TrainingMonitor.mark_phase`` (agent/monitor.py): ``proc_start``,
+``dist_ready``, ``built``, ``restore_done``, ``first_step_done``.
+``failure-detect`` runs from the failure instant (a master-side
+``node.fail``/``node.gone``/``node.heartbeat_timeout`` event, or an
+externally observed kill time) to the relaunched trainer's
+``proc_start`` — i.e. it includes the watchdog detection AND the agent
+respawn, matching the drills' ``detect_respawn_s`` segment.
+
+Reconstruction is resilient to multi-attempt logs: the sink file
+appends across trainer restarts, so the reconstructor picks the FIRST
+``trainer.proc_start`` at or after the failure instant and then walks
+the remaining marks forward in time from there.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import json
+from typing import Dict, Iterable, List, Optional
+
+# Trainer phase marks, in causal order (names as emitted by the
+# mark_phase mirror: "trainer." + mark).
+TRAINER_MARKS = (
+    "trainer.proc_start",
+    "trainer.dist_ready",
+    "trainer.built",
+    "trainer.restore_done",
+    "trainer.first_step_done",
+)
+
+# Master-side events that pin the failure instant when the caller does
+# not supply one.
+FAILURE_EVENTS = (
+    "node.fail",
+    "node.gone",
+    "node.heartbeat_timeout",
+)
+
+# Canonical phase names, in order. "build" (strategy build + sharded
+# init, the first cold compile) sits between rendezvous and restore so
+# restore time is not blamed on compilation.
+PHASE_ORDER = (
+    "failure-detect",
+    "rendezvous",
+    "build",
+    "restore",
+    "first-step",
+    "throughput-90",
+)
+
+REQUIRED_PHASES = (
+    "failure-detect", "rendezvous", "restore", "first-step",
+)
+
+
+@dataclasses.dataclass
+class RecoveryTimeline:
+    """Structured recovery report: absolute marks plus per-phase
+    durations. ``complete`` is True when every required phase is
+    present; ``throughput-90`` stays None unless a recovery signal was
+    observed (it needs a pre-failure throughput baseline)."""
+
+    t_failure: float
+    marks: Dict[str, float]
+    phases: Dict[str, Optional[float]]
+    total_s: float
+    complete: bool
+
+    def to_dict(self) -> dict:
+        return {
+            "t_failure": self.t_failure,
+            "marks": {k: round(v, 3) for k, v in self.marks.items()},
+            "phases": {
+                k: (round(v, 3) if v is not None else None)
+                for k, v in self.phases.items()
+            },
+            "total_s": round(self.total_s, 3),
+            "complete": self.complete,
+        }
+
+
+def load_events(path: str) -> List[dict]:
+    """Read a tracer JSONL file; skips unparsable lines (a crashed
+    writer may leave a torn final line)."""
+    events: List[dict] = []
+    try:
+        with open(path) as f:
+            for line in f:
+                line = line.strip()
+                if not line:
+                    continue
+                try:
+                    rec = json.loads(line)
+                except ValueError:
+                    continue
+                if isinstance(rec, dict) and "name" in rec:
+                    events.append(rec)
+    except OSError:
+        return []
+    return events
+
+
+def _first_at_or_after(
+    events: List[dict], name: str, not_before: float
+) -> Optional[dict]:
+    for ev in events:
+        if ev.get("name") == name and ev.get("ts", 0.0) >= not_before:
+            return ev
+    return None
+
+
+def reconstruct_recovery_timeline(
+    events: Iterable[dict],
+    t_failure: Optional[float] = None,
+    throughput_recovered_ts: Optional[float] = None,
+) -> Optional[RecoveryTimeline]:
+    """Fold ``events`` into a :class:`RecoveryTimeline`.
+
+    ``t_failure``: the failure instant; derived from the first
+    master-side failure event when omitted. Returns None when neither
+    is available (nothing to anchor the timeline on).
+    ``throughput_recovered_ts``: wall time the job regained >=90% of
+    pre-failure throughput, when the caller measured it (the master's
+    ``SpeedMonitor.recovery_seconds`` or a drill's metrics poll).
+    """
+    evs = sorted(
+        (e for e in events if "ts" in e and "name" in e),
+        key=lambda e: e["ts"],
+    )
+    if t_failure is None:
+        for ev in evs:
+            if ev["name"] in FAILURE_EVENTS:
+                t_failure = float(ev["ts"])
+                break
+    if t_failure is None:
+        return None
+
+    marks: Dict[str, float] = {}
+    cursor = t_failure
+    for name in TRAINER_MARKS:
+        ev = _first_at_or_after(evs, name, cursor)
+        if ev is None:
+            break
+        marks[name] = float(ev["ts"])
+        cursor = marks[name]
+
+    def seg(a: str, b: str) -> Optional[float]:
+        if a in marks and b in marks:
+            return marks[b] - marks[a]
+        return None
+
+    phases: Dict[str, Optional[float]] = {
+        "failure-detect": (
+            marks["trainer.proc_start"] - t_failure
+            if "trainer.proc_start" in marks else None
+        ),
+        "rendezvous": seg("trainer.proc_start", "trainer.dist_ready"),
+        "build": seg("trainer.dist_ready", "trainer.built"),
+        "restore": seg("trainer.built", "trainer.restore_done"),
+        "first-step": seg(
+            "trainer.restore_done", "trainer.first_step_done"
+        ),
+        "throughput-90": None,
+    }
+    if throughput_recovered_ts is None:
+        ev = _first_at_or_after(evs, "trainer.throughput_recovered",
+                                t_failure)
+        if ev is not None:
+            throughput_recovered_ts = float(ev["ts"])
+    last = max(marks.values()) if marks else t_failure
+    if (
+        throughput_recovered_ts is not None
+        and "trainer.first_step_done" in marks
+    ):
+        phases["throughput-90"] = (
+            throughput_recovered_ts - marks["trainer.first_step_done"]
+        )
+        last = max(last, throughput_recovered_ts)
+
+    complete = all(phases[p] is not None for p in REQUIRED_PHASES)
+    return RecoveryTimeline(
+        t_failure=t_failure,
+        marks=marks,
+        phases=phases,
+        total_s=last - t_failure,
+        complete=complete,
+    )
+
+
+def render_timeline(tl: RecoveryTimeline) -> str:
+    """Human-readable one-timeline report (tools/obs_report.py)."""
+    lines = [
+        f"recovery timeline (t_failure={tl.t_failure:.3f}, "
+        f"total {tl.total_s:.2f}s, "
+        f"{'complete' if tl.complete else 'INCOMPLETE'})",
+    ]
+    offset = 0.0
+    for name in PHASE_ORDER:
+        dur = tl.phases.get(name)
+        if dur is None:
+            lines.append(f"  {name:<16} -")
+            continue
+        lines.append(
+            f"  {name:<16} {dur:8.2f}s  (t+{offset:.2f}s)"
+        )
+        offset += dur
+    return "\n".join(lines)

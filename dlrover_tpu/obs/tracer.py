@@ -1,0 +1,237 @@
+"""Lightweight event tracer: spans/events with process/role/rank tags.
+
+An event is one dict — ``{"name", "ts" (wall), "mono" (monotonic),
+"pid", "role", "rank", ...tags}`` — appended to an in-memory ring and,
+when a JSONL sink is configured, written as one line per event (flushed
+immediately, so a SIGKILLed process loses at most the event in flight).
+Spans are paired events: entering emits nothing, exiting emits
+``name`` with ``dur_s`` and the span's start timestamps; nesting is
+tracked per-thread and recorded as a ``parent`` tag.
+
+Tracing is OFF by default. It turns on when ``DLROVER_TPU_TRACE_FILE``
+(JSONL export path) or ``DLROVER_TPU_TRACE=1`` (in-memory only) is set
+in the environment at first use, or explicitly via
+:func:`configure_tracer`. Disabled, the module-level :func:`event` is
+a single None-check and :func:`span` returns a shared no-op context
+manager — well under a microsecond either way, cheap enough for
+per-step hot paths.
+
+Role/rank tags come from the environment: ``DLROVER_TPU_ROLE`` (set by
+the elastic launcher) and ``JAX_PROCESS_INDEX`` /
+``DLROVER_TPU_NODE_RANK``.
+"""
+
+from __future__ import annotations
+
+import collections
+import json
+import os
+import threading
+import time
+from typing import Dict, List, Optional
+
+TRACE_FILE_ENV = "DLROVER_TPU_TRACE_FILE"
+TRACE_ENV = "DLROVER_TPU_TRACE"
+
+_RING_SIZE = 4096
+
+
+def _process_tags() -> Dict[str, object]:
+    # Shared role/rank env contract (one definition for logs + traces).
+    from dlrover_tpu.common.log import role_and_rank
+
+    role, rank = role_and_rank()
+    return {
+        "pid": os.getpid(),
+        "role": role or "unknown",
+        "rank": rank,
+    }
+
+
+class Span:
+    """Context manager produced by :meth:`EventTracer.span`."""
+
+    __slots__ = ("_tracer", "name", "tags", "_t0_wall", "_t0_mono")
+
+    def __init__(self, tracer: "EventTracer", name: str, tags: dict):
+        self._tracer = tracer
+        self.name = name
+        self.tags = tags
+        self._t0_wall = 0.0
+        self._t0_mono = 0.0
+
+    def __enter__(self) -> "Span":
+        self._t0_wall = time.time()
+        self._t0_mono = time.monotonic()
+        self._tracer._span_stack().append(self.name)
+        return self
+
+    def __exit__(self, exc_type, exc, tb) -> None:
+        stack = self._tracer._span_stack()
+        if stack and stack[-1] == self.name:
+            stack.pop()
+        parent = stack[-1] if stack else ""
+        dur = time.monotonic() - self._t0_mono
+        extra = dict(self.tags)
+        if parent:
+            extra["parent"] = parent
+        if exc_type is not None:
+            extra["error"] = exc_type.__name__
+        self._tracer._emit(
+            self.name,
+            ts=self._t0_wall,
+            mono=self._t0_mono,
+            dur_s=round(dur, 6),
+            **extra,
+        )
+
+
+class _NoopSpan:
+    __slots__ = ()
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, *exc):
+        return None
+
+
+_NOOP_SPAN = _NoopSpan()
+
+
+class EventTracer:
+    def __init__(
+        self,
+        sink_path: Optional[str] = None,
+        ring_size: int = _RING_SIZE,
+    ):
+        self.sink_path = sink_path
+        self._lock = threading.Lock()
+        self._ring: collections.deque = collections.deque(
+            maxlen=ring_size
+        )
+        self._file = None
+        self._local = threading.local()
+        if sink_path:
+            # Line-buffered append; O_APPEND keeps concurrent
+            # single-line writes from interleaving mid-line.
+            self._file = open(sink_path, "a", buffering=1)
+
+    def _span_stack(self) -> list:
+        stack = getattr(self._local, "stack", None)
+        if stack is None:
+            stack = []
+            self._local.stack = stack
+        return stack
+
+    # -- emission --------------------------------------------------------
+
+    def _emit(self, name: str, ts: Optional[float] = None,
+              mono: Optional[float] = None, **tags) -> dict:
+        record = {
+            "name": name,
+            "ts": ts if ts is not None else time.time(),
+            "mono": mono if mono is not None else time.monotonic(),
+            **_process_tags(),
+            **tags,
+        }
+        with self._lock:
+            self._ring.append(record)
+            if self._file is not None:
+                try:
+                    self._file.write(
+                        json.dumps(record, default=str) + "\n"
+                    )
+                except (OSError, ValueError):
+                    # A dead sink must never take training down.
+                    self._file = None
+        return record
+
+    def event(self, name: str, **tags) -> dict:
+        return self._emit(name, **tags)
+
+    def span(self, name: str, **tags) -> Span:
+        return Span(self, name, tags)
+
+    def events(self) -> List[dict]:
+        with self._lock:
+            return list(self._ring)
+
+    def close(self) -> None:
+        with self._lock:
+            if self._file is not None:
+                try:
+                    self._file.close()
+                except OSError:
+                    pass
+                self._file = None
+
+
+# -- module-level fast path -------------------------------------------------
+
+_tracer: Optional[EventTracer] = None
+_init_done = False
+_init_lock = threading.Lock()
+
+
+def _lazy_init() -> Optional[EventTracer]:
+    global _tracer, _init_done
+    with _init_lock:
+        if _init_done:
+            return _tracer
+        path = os.getenv(TRACE_FILE_ENV, "")
+        if path:
+            _tracer = EventTracer(sink_path=path)
+        elif os.getenv(TRACE_ENV, "") == "1":
+            _tracer = EventTracer()
+        _init_done = True
+        return _tracer
+
+
+def configure_tracer(
+    sink_path: Optional[str] = None, ring_size: int = _RING_SIZE
+) -> EventTracer:
+    """Explicitly enable tracing (tests, notebooks). Replaces any
+    active tracer."""
+    global _tracer, _init_done
+    with _init_lock:
+        if _tracer is not None:
+            _tracer.close()
+        _tracer = EventTracer(sink_path=sink_path, ring_size=ring_size)
+        _init_done = True
+        return _tracer
+
+
+def disable_tracer() -> None:
+    global _tracer, _init_done
+    with _init_lock:
+        if _tracer is not None:
+            _tracer.close()
+        _tracer = None
+        _init_done = True
+
+
+def get_tracer() -> Optional[EventTracer]:
+    if not _init_done:
+        return _lazy_init()
+    return _tracer
+
+
+def tracing_enabled() -> bool:
+    return get_tracer() is not None
+
+
+def event(name: str, **tags) -> Optional[dict]:
+    """Record an event; a no-op None-check when tracing is disabled."""
+    tr = _tracer if _init_done else _lazy_init()
+    if tr is None:
+        return None
+    return tr.event(name, **tags)
+
+
+def span(name: str, **tags):
+    """Span context manager; a shared no-op when tracing is disabled."""
+    tr = _tracer if _init_done else _lazy_init()
+    if tr is None:
+        return _NOOP_SPAN
+    return tr.span(name, **tags)

@@ -215,6 +215,9 @@ def run(args) -> int:
     os.environ[NodeEnv.MASTER_ADDR] = master_addr
     os.environ[NodeEnv.NODE_ID] = str(node_id)
     os.environ[NodeEnv.NODE_RANK] = str(node_rank)
+    # Role/rank tag for logs (common/log.py) and obs trace events —
+    # inherited by the agent's trainer subprocesses.
+    os.environ["DLROVER_TPU_ROLE"] = args.role
     MasterClient.reset()
 
     if args.module:

@@ -24,6 +24,7 @@ Design differences from the torch original, on purpose:
 from __future__ import annotations
 
 import dataclasses
+import time
 from typing import Any, Callable, Optional, Tuple
 
 import jax
@@ -31,11 +32,21 @@ import jax.numpy as jnp
 import optax
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
+from dlrover_tpu import obs
 from dlrover_tpu.common.log import get_logger
 from dlrover_tpu.parallel.sharding import prune_specs_to_mesh
 from dlrover_tpu.trainer.step import batch_spec
 
 logger = get_logger("elastic_trainer")
+
+_STEPS_TOTAL = obs.counter(
+    "dlrover_train_steps_total", "Optimizer steps taken this process"
+)
+_STEP_SECONDS = obs.histogram(
+    "dlrover_train_step_seconds",
+    "Wall time between consecutive train_step completions "
+    "(first sample per trainer covers the XLA compile)",
+)
 
 
 def data_shards(mesh: Mesh) -> int:
@@ -113,6 +124,11 @@ class ElasticTrainer:
         self.accum_dtype = accum_dtype
         self.num_shards = data_shards(mesh)
         self.step_num = 0
+        # perf_counter of the last train_step completion; None until
+        # the first step of THIS trainer instance (each elastic
+        # restart builds a new trainer, so the first sample after any
+        # world change covers that world's compile).
+        self._last_step_t: Optional[float] = None
         if step_fn is not None:
             if loss_fn is not None:
                 raise ValueError(
@@ -318,9 +334,24 @@ class ElasticTrainer:
         """
         if tokens.ndim == 2:  # unsharded [N, T] host batch
             tokens, targets = self.shard_microbatches(tokens, targets)
+        t0 = time.perf_counter()
         params, opt_state, loss = self._compiled(
             params, opt_state, tokens, targets
         )
+        now = time.perf_counter()
+        if self._last_step_t is None:
+            # Dispatch of the first call traces + compiles
+            # synchronously: this sample is the compile boundary.
+            _STEP_SECONDS.observe(now - t0)
+            obs.event(
+                "trainer.compile_done",
+                dur_s=round(now - t0, 3),
+                world_shards=self.num_shards,
+            )
+        else:
+            _STEP_SECONDS.observe(now - self._last_step_t)
+        self._last_step_t = now
+        _STEPS_TOTAL.inc()
         self.step_num += 1
         if self.report_fn is not None:
             self.report_fn(

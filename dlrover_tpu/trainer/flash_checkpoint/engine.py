@@ -26,6 +26,7 @@ from typing import Any, Dict, List, Optional, Tuple
 
 import numpy as np
 
+from dlrover_tpu import obs
 from dlrover_tpu.common import ckpt_shm
 from dlrover_tpu.common.ckpt_shm import (
     SharedMemoryHandler,
@@ -40,6 +41,20 @@ from dlrover_tpu.common.multi_process import (
 )
 
 logger = get_logger("flash_ckpt")
+
+_CKPT_OPS = obs.counter(
+    "dlrover_ckpt_ops_total",
+    "Flash-checkpoint operations",
+    ("op", "result"),
+)
+_CKPT_STAGE_SECONDS = obs.histogram(
+    "dlrover_ckpt_stage_seconds",
+    "Device-to-shm staging time of save_to_memory",
+)
+_CKPT_RESTORE_SECONDS = obs.histogram(
+    "dlrover_ckpt_restore_seconds",
+    "End-to-end restore time of CheckpointEngine.load",
+)
 
 CKPT_EVENT_QUEUE = "ckpt_events"
 CKPT_STATUS_DICT = "ckpt_status"
@@ -198,14 +213,24 @@ class CheckpointEngine:
             logger.warning(
                 "step %s: shm busy (agent persisting); skip staging",
                 step)
+            _CKPT_OPS.inc(op="save_memory", result="skipped")
             return False
+        t0 = time.monotonic()
         try:
-            arrays, _ = self._stage(state)
-            self._shm.save(step, arrays, extra)
+            with obs.span("ckpt.save_memory", step=step):
+                arrays, _ = self._stage(state)
+                self._shm.save(step, arrays, extra)
             self._cached_step = step
+        except Exception:
+            # Staging failures must be countable from /metrics, not
+            # only visible as exceptions in one process's stderr.
+            _CKPT_OPS.inc(op="save_memory", result="error")
+            raise
         finally:
             if self._lock is not None:
                 self._lock.release()
+        _CKPT_STAGE_SECONDS.observe(time.monotonic() - t0)
+        _CKPT_OPS.inc(op="save_memory", result="ok")
         return True
 
     def save_to_storage(self, step: int, state,
@@ -223,6 +248,8 @@ class CheckpointEngine:
                     "dir": self.checkpoint_dir,
                 }
             )
+        _CKPT_OPS.inc(op="persist_request", result="ok")
+        obs.event("ckpt.persist_requested", step=step)
         return True
 
     def wait_persisted(self, step: int, timeout: float = 60.0) -> bool:
@@ -451,6 +478,18 @@ class CheckpointEngine:
 
         Returns (step, state, extra) or None when no checkpoint exists.
         """
+        t0 = time.monotonic()
+        with obs.span("ckpt.restore"):
+            res = self._load(like, shardings, step)
+        if res is None:
+            _CKPT_OPS.inc(op="restore", result="none")
+        else:
+            _CKPT_RESTORE_SECONDS.observe(time.monotonic() - t0)
+            _CKPT_OPS.inc(op="restore", result="ok")
+        return res
+
+    def _load(self, like, shardings=None,
+              step: Optional[int] = None):
         import jax
 
         # Streaming needs real ranged reads; on a backend whose

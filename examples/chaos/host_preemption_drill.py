@@ -37,6 +37,12 @@ import time
 REPO = os.path.dirname(
     os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 )
+sys.path.insert(0, REPO)
+
+from dlrover_tpu.obs.timeline import (  # noqa: E402
+    load_events,
+    reconstruct_recovery_timeline,
+)
 
 
 def read_step(path: str):
@@ -125,6 +131,11 @@ def start_agent(
         ),
         "DLROVER_TPU_PHASES_FILE": os.path.join(
             tmp, f"phases_n{rank}.json"
+        ),
+        # Obs event trace (appended across restarts): the recovery
+        # timeline is reconstructed from these trainer.* marks.
+        "DLROVER_TPU_TRACE_FILE": os.path.join(
+            tmp, f"trace_n{rank}.jsonl"
         ),
         "JAX_COMPILATION_CACHE_DIR": os.path.join(tmp, "jaxcache"),
         "JAX_PERSISTENT_CACHE_MIN_ENTRY_SIZE_BYTES": "0",
@@ -248,6 +259,25 @@ def main() -> int:
             c_phases = recovery_phases(
                 os.path.join(tmp, f"phases_n{survivor}.json"), t_kill
             )
+            # Canonical recovery timeline from the survivor's obs
+            # event trace (failure-detect -> rendezvous -> build ->
+            # restore -> first-step). Snapshot for the same reason:
+            # the regrow appends another attempt's marks.
+            # throughput_recovered_ts is deliberately NOT supplied:
+            # the drill observes "stepping again" through a 1 s
+            # metrics poll, which is not a 90%-of-baseline throughput
+            # measurement — the throughput-90 phase stays None rather
+            # than carrying a mislabeled number.
+            tl = reconstruct_recovery_timeline(
+                load_events(
+                    os.path.join(tmp, f"trace_n{survivor}.jsonl")
+                ),
+                t_failure=t_kill,
+            )
+            c_timeline = (
+                tl.to_dict() if tl is not None and tl.complete
+                else None
+            )
 
             # The victim comes back and the world re-grows.
             t_rejoin = time.time()
@@ -295,6 +325,7 @@ def main() -> int:
                 "victim": victim,
                 "shrink_recovery_s": round(c_shrink, 1),
                 "shrink_phases": c_phases,
+                "recovery_timeline": c_timeline,
                 "rejoin_recovery_s": (
                     round(c_rejoin, 1) if regrown else None
                 ),
@@ -316,6 +347,7 @@ def main() -> int:
             # the per-cycle records carry the rest of a soak.
             "shrink_recovery_s": first["shrink_recovery_s"],
             "shrink_phases": first["shrink_phases"],
+            "recovery_timeline": first["recovery_timeline"],
             "rejoin_recovery_s": first["rejoin_recovery_s"],
             "rejoin_phases": first["rejoin_phases"],
             "pre_kill_step": pre_kill_step,

@@ -51,6 +51,7 @@ jax.config.update("jax_platforms", "cpu")
 import jax.numpy as jnp  # noqa: E402
 import optax  # noqa: E402
 
+from dlrover_tpu import obs  # noqa: E402
 from dlrover_tpu.master.ps_manager import PsManager  # noqa: E402
 from dlrover_tpu.sparse.ps_client import DistributedKvClient  # noqa: E402
 from dlrover_tpu.sparse.ps_server import PsServer  # noqa: E402
@@ -253,6 +254,14 @@ def main(argv=None) -> int:
                         t_unblocked - fo["t_map_published"], 3
                     ),
                 }
+            # PS failover into the obs event stream too (no-op unless
+            # DLROVER_TPU_TRACE_FILE/DLROVER_TPU_TRACE is set): the
+            # same trace file then explains worker AND PS recoveries.
+            obs.event(
+                "ps.failover_recovered",
+                recovery_s=drill_stats["recovery_s"],
+                **(drill_stats.get("phases") or {}),
+            )
             print(
                 f"DRILL: recovered in {drill_stats['recovery_s']}s "
                 f"(map v{drill_stats['map_version_before']} -> "
@@ -275,6 +284,10 @@ def main(argv=None) -> int:
                 "map_version_before": mgr.partition_map.version,
                 "_kill_time": time.time(),
             }
+            obs.event(
+                "ps.kill", ps=vid, step=step, mode=args.drill,
+                victim_rows=rows,
+            )
             if args.drill == "graceful":
                 flushed = mgr.flush_all(step)
                 drill_stats["rows_at_last_flush"] = flushed

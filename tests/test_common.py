@@ -137,3 +137,71 @@ class TestRpc:
             client.close()
         finally:
             server.stop(0)
+
+
+class TestLogging:
+    """Role/rank-aware log format + opt-in JSON-lines mode."""
+
+    def _record(self, msg="hello"):
+        import logging
+
+        return logging.LogRecord(
+            "dlrover_tpu.test", logging.INFO, "f.py", 42, msg, (), None
+        )
+
+    def test_text_format_carries_role_and_rank(self, monkeypatch):
+        from dlrover_tpu.common import log as log_mod
+
+        monkeypatch.setenv("DLROVER_TPU_ROLE", "worker")
+        monkeypatch.setenv("JAX_PROCESS_INDEX", "3")
+        out = log_mod._make_formatter().format(self._record())
+        assert "[worker/3]" in out
+        assert "hello" in out
+
+    def test_text_format_without_env_uses_placeholder(self, monkeypatch):
+        from dlrover_tpu.common import log as log_mod
+
+        for var in ("DLROVER_TPU_ROLE", "JAX_PROCESS_INDEX",
+                    "DLROVER_TPU_NODE_RANK", "DLROVER_TPU_LOG_JSON"):
+            monkeypatch.delenv(var, raising=False)
+        out = log_mod._make_formatter().format(self._record())
+        assert "[-]" in out
+
+    def test_json_mode_emits_machine_readable_lines(self, monkeypatch):
+        import json as json_mod
+
+        from dlrover_tpu.common import log as log_mod
+
+        monkeypatch.setenv("DLROVER_TPU_LOG_JSON", "1")
+        monkeypatch.setenv("DLROVER_TPU_ROLE", "evaluator")
+        monkeypatch.setenv("DLROVER_TPU_NODE_RANK", "1")
+        monkeypatch.delenv("JAX_PROCESS_INDEX", raising=False)
+        rec = json_mod.loads(
+            log_mod._make_formatter().format(self._record("json msg"))
+        )
+        assert rec["msg"] == "json msg"
+        assert rec["role"] == "evaluator"
+        assert rec["rank"] == 1
+        assert rec["level"] == "INFO"
+        assert rec["logger"] == "dlrover_tpu.test"
+        assert rec["line"] == 42
+
+    def test_reconfigure_switches_live_handlers(self, monkeypatch):
+        from dlrover_tpu.common import log as log_mod
+
+        monkeypatch.setenv("DLROVER_TPU_LOG_JSON", "1")
+        log_mod.reconfigure()
+        try:
+            fmts = [
+                type(h.formatter).__name__
+                for h in log_mod.default_logger.handlers
+            ]
+            assert fmts == ["_JsonFormatter"]
+        finally:
+            monkeypatch.delenv("DLROVER_TPU_LOG_JSON")
+            log_mod.reconfigure()
+        fmts = [
+            type(h.formatter).__name__
+            for h in log_mod.default_logger.handlers
+        ]
+        assert fmts == ["_TextFormatter"]

@@ -123,3 +123,49 @@ def test_training_monitor_reports_token_deltas_and_restarts(tmp_path):
     TrainingMonitor.write_metrics(1, tokens=800, path=path)
     assert mon.report_once() == 1
     assert client.steps[-1] == (1, 800)
+
+
+def test_json_file_reporter_appends_and_failure_is_contained(tmp_path):
+    """A JsonFileReporter writing to a dead path raises from report();
+    collect_once must contain it (warn + keep going) and still feed
+    every other reporter."""
+    good_path = str(tmp_path / "metrics.jsonl")
+    bad = JsonFileReporter(str(tmp_path / "no_such_dir" / "m.jsonl"))
+    good = JsonFileReporter(good_path)
+    jm = JobManager()
+    jm.register_node(node_id=0)
+    coll = JobMetricCollector(
+        "jobF", jm, SpeedMonitor(),
+        reporters=[bad, good], interval=999,
+    )
+    with pytest.raises(OSError):
+        bad.report(coll.snapshot())  # the reporter itself raises...
+    snap = coll.collect_once()  # ...but the collector survives it
+    assert snap.workers_alive == 1
+    # and the healthy reporter appended one line per collect
+    coll.collect_once()
+    with open(good_path) as f:
+        lines = [json.loads(line) for line in f]
+    assert len(lines) == 2
+    assert all(rec["job_name"] == "jobF" for rec in lines)
+
+
+def test_mark_phase_mirrors_to_obs_tracer(tmp_path, monkeypatch):
+    """Phase marks feed the recovery-timeline reconstructor through
+    the obs tracer, independent of the phases file."""
+    from dlrover_tpu import obs
+    from dlrover_tpu.obs.timeline import reconstruct_recovery_timeline
+
+    monkeypatch.delenv("DLROVER_TPU_PHASES_FILE", raising=False)
+    tracer = obs.configure_tracer()
+    try:
+        for mark in ("proc_start", "dist_ready", "built",
+                     "restore_done", "first_step_done"):
+            TrainingMonitor.mark_phase(mark)
+        events = tracer.events()
+        t_fail = events[0]["ts"] - 1.0
+        tl = reconstruct_recovery_timeline(events, t_failure=t_fail)
+        assert tl is not None and tl.complete
+        assert tl.phases["failure-detect"] >= 1.0
+    finally:
+        obs.disable_tracer()

@@ -1,0 +1,423 @@
+"""Observability subsystem: registry, tracer, timeline, exposition.
+
+Covers the hermetic pieces (render without any server, span nesting,
+timeline reconstruction from a synthetic event log), the master's
+Prometheus surface (HTTP /metrics + MetricsRequest RPC on a real
+in-process JobMaster), the obs_report CLI selftest, and the
+stdlib-only contract (no prometheus_client / opentelemetry imports
+anywhere in the package).
+"""
+
+import json
+import os
+import subprocess
+import sys
+import threading
+import time
+import urllib.error
+import urllib.request
+
+import pytest
+
+from dlrover_tpu import obs
+from dlrover_tpu.common import messages as msg
+from dlrover_tpu.common.comm import RpcClient
+from dlrover_tpu.common.constants import RendezvousName
+from dlrover_tpu.master.master import JobMaster
+from dlrover_tpu.obs.metrics import MetricsRegistry
+from dlrover_tpu.obs.timeline import (
+    load_events,
+    reconstruct_recovery_timeline,
+)
+from dlrover_tpu.obs.tracer import EventTracer
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+@pytest.fixture()
+def tracer():
+    """Fresh module-level tracer; restores the disabled default."""
+    tr = obs.configure_tracer()
+    yield tr
+    obs.disable_tracer()
+
+
+class TestMetricsRegistry:
+    def test_counter_labels_and_render(self):
+        reg = MetricsRegistry()
+        c = reg.counter("events_total", "Things seen", ("kind",))
+        c.inc(kind="a")
+        c.inc(2, kind="b")
+        assert c.value(kind="a") == 1
+        assert c.value(kind="b") == 2
+        out = reg.render()
+        assert "# HELP events_total Things seen" in out
+        assert "# TYPE events_total counter" in out
+        assert 'events_total{kind="a"} 1' in out
+        assert 'events_total{kind="b"} 2' in out
+
+    def test_counter_rejects_negative_and_bad_labels(self):
+        reg = MetricsRegistry()
+        c = reg.counter("c_total", labelnames=("k",))
+        with pytest.raises(ValueError):
+            c.inc(-1, k="x")
+        with pytest.raises(ValueError):
+            c.inc(wrong="x")
+
+    def test_gauge_set_inc_dec(self):
+        reg = MetricsRegistry()
+        g = reg.gauge("g")
+        g.set(5)
+        g.inc()
+        g.dec(2)
+        assert g.value() == 4
+        assert "g 4" in reg.render()
+
+    def test_histogram_buckets_cumulative(self):
+        reg = MetricsRegistry()
+        h = reg.histogram("h_seconds", buckets=(0.1, 1.0))
+        for v in (0.05, 0.5, 5.0):
+            h.observe(v)
+        out = reg.render()
+        assert 'h_seconds_bucket{le="0.1"} 1' in out
+        assert 'h_seconds_bucket{le="1"} 2' in out
+        assert 'h_seconds_bucket{le="+Inf"} 3' in out
+        assert "h_seconds_count 3" in out
+        assert h.sum() == pytest.approx(5.55)
+
+    def test_registration_idempotent_but_type_safe(self):
+        reg = MetricsRegistry()
+        a = reg.counter("x_total", labelnames=("k",))
+        assert reg.counter("x_total", labelnames=("k",)) is a
+        with pytest.raises(ValueError):
+            reg.gauge("x_total")
+        with pytest.raises(ValueError):
+            reg.counter("x_total", labelnames=("other",))
+        h = reg.histogram("h_seconds", buckets=(0.1, 1.0))
+        assert reg.histogram("h_seconds", buckets=(0.1, 1.0)) is h
+        # +Inf is implied, so an explicit one is the same registration
+        assert (
+            reg.histogram("h_seconds", buckets=(0.1, 1.0, float("inf")))
+            is h
+        )
+        with pytest.raises(ValueError):
+            reg.histogram("h_seconds", buckets=(0.001, 0.002))
+
+    def test_label_value_escaping(self):
+        reg = MetricsRegistry()
+        c = reg.counter("esc_total", labelnames=("v",))
+        c.inc(v='say "hi"\nback\\slash')
+        out = reg.render()
+        assert r'esc_total{v="say \"hi\"\nback\\slash"} 1' in out
+
+
+class TestTracer:
+    def test_event_tags_and_ring(self, tracer):
+        obs.event("unit.test", step=3)
+        ev = tracer.events()[-1]
+        assert ev["name"] == "unit.test"
+        assert ev["step"] == 3
+        assert ev["pid"] == os.getpid()
+        assert "ts" in ev and "mono" in ev
+
+    def test_span_nesting_records_parent(self, tracer):
+        with obs.span("outer"):
+            with obs.span("inner"):
+                time.sleep(0.01)
+        names = {e["name"]: e for e in tracer.events()}
+        assert names["inner"]["parent"] == "outer"
+        assert "parent" not in names["outer"]
+        assert names["inner"]["dur_s"] >= 0.01
+        # outer wraps inner entirely
+        assert names["outer"]["dur_s"] >= names["inner"]["dur_s"]
+
+    def test_span_records_exception(self, tracer):
+        with pytest.raises(RuntimeError):
+            with obs.span("boom"):
+                raise RuntimeError("x")
+        ev = tracer.events()[-1]
+        assert ev["name"] == "boom"
+        assert ev["error"] == "RuntimeError"
+
+    def test_disabled_is_noop(self):
+        obs.disable_tracer()
+        assert obs.event("nope") is None
+        with obs.span("nope"):
+            pass
+        assert not obs.tracing_enabled()
+
+    def test_jsonl_export_roundtrip(self, tmp_path):
+        path = str(tmp_path / "trace.jsonl")
+        tr = EventTracer(sink_path=path)
+        tr.event("a", k=1)
+        with tr.span("b"):
+            pass
+        tr.close()
+        events = load_events(path)
+        assert [e["name"] for e in events] == ["a", "b"]
+        assert events[1]["dur_s"] >= 0
+
+    def test_load_events_skips_torn_lines(self, tmp_path):
+        path = tmp_path / "trace.jsonl"
+        path.write_text(
+            json.dumps({"name": "ok", "ts": 1.0})
+            + "\n{\"name\": \"torn"
+        )
+        events = load_events(str(path))
+        assert [e["name"] for e in events] == ["ok"]
+
+
+class TestTimeline:
+    MARKS = (
+        ("node.fail", 100.0),
+        ("trainer.proc_start", 104.0),
+        ("trainer.dist_ready", 110.0),
+        ("trainer.built", 125.0),
+        ("trainer.restore_done", 127.5),
+        ("trainer.first_step_done", 140.0),
+    )
+
+    def events(self):
+        return [{"name": n, "ts": t} for n, t in self.MARKS]
+
+    def test_full_reconstruction(self):
+        tl = reconstruct_recovery_timeline(self.events())
+        assert tl is not None and tl.complete
+        assert tl.phases["failure-detect"] == pytest.approx(4.0)
+        assert tl.phases["rendezvous"] == pytest.approx(6.0)
+        assert tl.phases["build"] == pytest.approx(15.0)
+        assert tl.phases["restore"] == pytest.approx(2.5)
+        assert tl.phases["first-step"] == pytest.approx(12.5)
+        assert tl.phases["throughput-90"] is None
+        assert tl.total_s == pytest.approx(40.0)
+
+    def test_explicit_failure_time_and_recovery_ts(self):
+        tl = reconstruct_recovery_timeline(
+            self.events()[1:],  # no master-side failure event
+            t_failure=101.0,
+            throughput_recovered_ts=150.0,
+        )
+        assert tl.complete
+        assert tl.phases["failure-detect"] == pytest.approx(3.0)
+        assert tl.phases["throughput-90"] == pytest.approx(10.0)
+        assert tl.total_s == pytest.approx(49.0)
+
+    def test_multi_attempt_log_picks_first_after_failure(self):
+        # A pre-failure attempt's marks must be ignored.
+        stale = [
+            {"name": n, "ts": t - 50.0}
+            for n, t in self.MARKS[1:]
+        ]
+        tl = reconstruct_recovery_timeline(
+            stale + self.events(), t_failure=100.0
+        )
+        assert tl.complete
+        assert tl.marks["trainer.proc_start"] == 104.0
+
+    def test_incomplete_when_marks_missing(self):
+        tl = reconstruct_recovery_timeline(self.events()[:3])
+        assert tl is not None and not tl.complete
+        assert tl.phases["restore"] is None
+
+    def test_no_anchor_returns_none(self):
+        assert (
+            reconstruct_recovery_timeline(self.events()[1:]) is None
+        )
+
+    def test_to_dict_round(self):
+        d = reconstruct_recovery_timeline(self.events()).to_dict()
+        assert d["complete"] is True
+        assert d["phases"]["rendezvous"] == 6.0
+
+
+class TestMasterExposition:
+    """Acceptance: the master exposes Prometheus text metrics (node
+    states, relaunch counts, rendezvous rounds, step throughput) over
+    HTTP and the MetricsRequest RPC."""
+
+    @pytest.fixture()
+    def master(self):
+        m = JobMaster(
+            port=0, node_num=2, rdzv_timeout=1.0, metrics_port=0,
+            collect_interval=999.0,
+        )
+        m.prepare()
+        yield m
+        m.stop()
+
+    def test_metrics_http_and_rpc(self, master):
+        client = RpcClient(master.addr)
+        client.report(msg.NodeAddressRequest(node_id=0, node_ip="h0"))
+        client.report(msg.NodeAddressRequest(node_id=1, node_ip="h1"))
+        for rank in (0, 1):
+            client.get(
+                msg.JoinRendezvousRequest(
+                    node_id=rank, node_rank=rank, local_world_size=4,
+                    rdzv_name=RendezvousName.TRAINING,
+                )
+            )
+        world = client.get(
+            msg.CommWorldRequest(
+                node_id=0, rdzv_name=RendezvousName.TRAINING
+            )
+        )
+        assert world.world  # round froze -> rdzv metrics recorded
+        client.report(msg.StepReport(node_id=0, step=1, tokens=512))
+        time.sleep(0.05)
+        client.report(msg.StepReport(node_id=0, step=3, tokens=1024))
+        # a worker dies and is relaunched -> relaunch counter moves
+        client.report(
+            msg.NodeFailureReport(
+                node_id=1, error_data="out of memory",
+                level="process_error", restart_count=0,
+            )
+        )
+        # Push one snapshot through the registry reporter (the
+        # periodic loop is parked at collect_interval=999).
+        master.metric_collector.collect_once()
+
+        url = f"http://127.0.0.1:{master.metrics_server.port}/metrics"
+        body = urllib.request.urlopen(url, timeout=5).read().decode()
+        assert 'dlrover_job_workers{state="alive"} 1' in body
+        assert 'dlrover_job_workers{state="pending"} 1' in body
+        assert "dlrover_node_relaunch_total" in body
+        assert 'reason="oom"' in body
+        assert (
+            'dlrover_rendezvous_rounds_total{name="elastic-training"}'
+            in body
+        )
+        assert "dlrover_job_steps_per_second" in body
+        assert "dlrover_job_tokens_per_second" in body
+        # Same payload over the control-plane RPC.
+        rpc_body = client.get(msg.MetricsRequest()).text
+        assert "dlrover_node_events_total" in rpc_body
+        # healthz + 404
+        health = urllib.request.urlopen(
+            url.replace("/metrics", "/healthz"), timeout=5
+        )
+        assert health.status == 200
+        with pytest.raises(urllib.error.HTTPError):
+            urllib.request.urlopen(
+                url.replace("/metrics", "/nope"), timeout=5
+            )
+
+    def test_collector_stop_joins_thread(self, master):
+        thread = master.metric_collector._thread
+        assert thread is not None and thread.is_alive()
+        master.stop()
+        assert master.metric_collector._thread is None
+        assert not thread.is_alive()
+        assert master.metrics_server is None
+
+
+class TestCollectorFailurePaths:
+    def test_collect_once_survives_raising_reporter(self):
+        from dlrover_tpu.master.job_manager import JobManager
+        from dlrover_tpu.master.metrics import (
+            JobMetricCollector,
+            Reporter,
+        )
+        from dlrover_tpu.master.speed_monitor import SpeedMonitor
+
+        calls = []
+
+        class Boom(Reporter):
+            def report(self, snapshot):
+                raise OSError("disk full")
+
+        class Records(Reporter):
+            def report(self, snapshot):
+                calls.append(snapshot)
+
+        coll = JobMetricCollector(
+            "j", JobManager(), SpeedMonitor(),
+            reporters=[Boom(), Records()], interval=999,
+        )
+        snap = coll.collect_once()  # must not raise
+        # the healthy reporter still ran, after the broken one
+        assert calls == [snap]
+
+    def test_collector_loop_survives_reporter_failure(self):
+        from dlrover_tpu.master.job_manager import JobManager
+        from dlrover_tpu.master.metrics import (
+            JobMetricCollector,
+            Reporter,
+        )
+        from dlrover_tpu.master.speed_monitor import SpeedMonitor
+
+        seen = threading.Event()
+
+        class Boom(Reporter):
+            def report(self, snapshot):
+                seen.set()
+                raise RuntimeError("reporter died")
+
+        coll = JobMetricCollector(
+            "j", JobManager(), SpeedMonitor(),
+            reporters=[Boom()], interval=0.01,
+        )
+        coll.start()
+        try:
+            assert seen.wait(5.0)
+            seen.clear()
+            assert seen.wait(5.0), (
+                "loop died after a reporter exception"
+            )
+        finally:
+            coll.stop()
+        assert coll._thread is None
+
+
+class TestTooling:
+    def test_obs_report_selftest(self):
+        proc = subprocess.run(
+            [sys.executable, os.path.join(REPO, "tools",
+                                          "obs_report.py"),
+             "--selftest"],
+            capture_output=True, text=True, timeout=120, cwd=REPO,
+        )
+        assert proc.returncode == 0, proc.stdout + proc.stderr
+        assert "obs selftest ok" in proc.stdout
+
+    def test_obs_report_renders_trace_file(self, tmp_path):
+        path = tmp_path / "trace.jsonl"
+        events = [
+            {"name": n, "ts": t}
+            for n, t in TestTimeline.MARKS
+        ] + [{"name": "ckpt.save_memory", "ts": 141.0, "dur_s": 0.4}]
+        path.write_text(
+            "".join(json.dumps(e) + "\n" for e in events)
+        )
+        proc = subprocess.run(
+            [sys.executable, os.path.join(REPO, "tools",
+                                          "obs_report.py"),
+             str(path)],
+            capture_output=True, text=True, timeout=120, cwd=REPO,
+        )
+        assert proc.returncode == 0, proc.stdout + proc.stderr
+        assert "recovery timeline" in proc.stdout
+        assert "failure-detect" in proc.stdout
+        assert "ckpt.save_memory" in proc.stdout
+
+    def test_no_prometheus_or_otel_imports(self):
+        """The stdlib-only contract: nothing in the framework, tools,
+        or examples may import prometheus_client or opentelemetry."""
+        banned = ("prometheus_client", "opentelemetry")
+        offenders = []
+        for root in ("dlrover_tpu", "tools", "examples"):
+            for dirpath, _, files in os.walk(os.path.join(REPO, root)):
+                for fname in files:
+                    if not fname.endswith(".py"):
+                        continue
+                    fpath = os.path.join(dirpath, fname)
+                    with open(fpath, encoding="utf-8") as f:
+                        src = f.read()
+                    for mod in banned:
+                        if (
+                            f"import {mod}" in src
+                            or f"from {mod}" in src
+                        ):
+                            offenders.append((fpath, mod))
+        assert not offenders, (
+            f"stdlib-only observability contract broken: {offenders}"
+        )

@@ -15,6 +15,7 @@ REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 
 def test_ps_abrupt_kill_drill_phase_budgets(tmp_path):
     out = tmp_path / "recovery_ps.json"
+    trace = tmp_path / "trace.jsonl"
     proc = subprocess.run(
         [
             sys.executable,
@@ -28,7 +29,11 @@ def test_ps_abrupt_kill_drill_phase_budgets(tmp_path):
         text=True,
         timeout=600,
         cwd=REPO,
-        env={**os.environ, "JAX_PLATFORMS": "cpu"},
+        env={
+            **os.environ,
+            "JAX_PLATFORMS": "cpu",
+            "DLROVER_TPU_TRACE_FILE": str(trace),
+        },
     )
     assert proc.returncode == 0, (
         f"drill failed:\n{proc.stdout[-3000:]}\n{proc.stderr[-2000:]}"
@@ -52,3 +57,17 @@ def test_ps_abrupt_kill_drill_phase_budgets(tmp_path):
     assert (
         abs(sum(phases.values()) - result["recovery_s"]) < 1.0
     ), f"phases {phases} do not explain {result['recovery_s']}s"
+
+    # The failover also lands in the obs event stream: the kill and
+    # the recovered event (with the same phase breakdown) must both be
+    # there, ordered, so obs_report can explain PS recoveries too.
+    from dlrover_tpu.obs.timeline import load_events
+
+    events = {e["name"]: e for e in load_events(str(trace))}
+    assert "ps.kill" in events, "ps.kill event missing from trace"
+    recovered = events.get("ps.failover_recovered")
+    assert recovered is not None
+    assert recovered["ts"] > events["ps.kill"]["ts"]
+    assert recovered["recovery_s"] == result["recovery_s"]
+    for name in ("detect_s", "rebalance_restore_s", "client_resume_s"):
+        assert recovered[name] == phases[name]

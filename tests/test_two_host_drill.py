@@ -73,3 +73,23 @@ def test_two_host_preemption_drill(tmp_path):
     assert (
         abs(sum(phases.values()) - result["shrink_recovery_s"]) < 10.0
     ), f"phases {phases} do not explain {result['shrink_recovery_s']}s"
+
+    # Recovery timeline reconstructed from the obs event trace
+    # (dlrover_tpu/obs/timeline.py over the survivor's
+    # DLROVER_TPU_TRACE_FILE): the canonical breakdown must be
+    # complete, with every required phase present and positive.
+    timeline = result["recovery_timeline"]
+    assert timeline is not None, "recovery timeline missing"
+    assert timeline["complete"]
+    for phase in ("failure-detect", "rendezvous", "restore",
+                  "first-step"):
+        dur = timeline["phases"][phase]
+        assert dur is not None and dur > 0.0, (
+            f"timeline phase {phase} not positive: {dur} "
+            f"(timeline={timeline})"
+        )
+    # The event-derived timeline and the phases-file segments measure
+    # the same recovery: totals must agree (same marks, same clock).
+    assert (
+        abs(timeline["total_s"] - result["shrink_recovery_s"]) < 10.0
+    ), f"timeline {timeline} vs shrink {result['shrink_recovery_s']}s"

@@ -1,0 +1,147 @@
+"""Render a recovery timeline + metrics table from an obs JSONL trace.
+
+Reads the event file the tracer exports (DLROVER_TPU_TRACE_FILE) —
+e.g. the per-host traces the chaos drills leave behind — and prints:
+
+* the reconstructed recovery timeline (obs/timeline.py), when the
+  canonical trainer marks are present;
+* a per-event-name table: count, and for span events total/mean
+  duration, sorted by total time.
+
+Usage:
+    python tools/obs_report.py TRACE.jsonl [--failure-ts T] [--top N]
+    python tools/obs_report.py --selftest
+
+``--selftest`` runs the reconstruction pipeline on a synthetic event
+log and exits nonzero on any inconsistency — a fast CI smoke with no
+inputs (invoked by tests/test_obs.py).
+"""
+
+from __future__ import annotations
+
+import argparse
+import sys
+
+import _repo_path  # noqa: F401
+
+from dlrover_tpu.obs.timeline import (
+    REQUIRED_PHASES,
+    load_events,
+    reconstruct_recovery_timeline,
+    render_timeline,
+)
+
+
+def metrics_table(events, top: int = 15) -> str:
+    stats = {}
+    for ev in events:
+        name = ev.get("name", "?")
+        count, total = stats.get(name, (0, 0.0))
+        stats[name] = (count + 1, total + float(ev.get("dur_s", 0.0)))
+    rows = sorted(
+        stats.items(), key=lambda kv: (-kv[1][1], -kv[1][0])
+    )[:top]
+    lines = [
+        f"top {len(rows)} event names (of {len(stats)}):",
+        f"  {'event':<32} {'count':>7} {'total_s':>9} {'mean_s':>9}",
+    ]
+    for name, (count, total) in rows:
+        mean = total / count if count else 0.0
+        lines.append(
+            f"  {name:<32} {count:>7} {total:>9.3f} {mean:>9.4f}"
+        )
+    return "\n".join(lines)
+
+
+def report(path: str, failure_ts=None, top: int = 15) -> int:
+    events = [e for e in load_events(path) if "ts" in e]
+    if not events:
+        print(f"no events in {path}")
+        return 1
+    t0 = min(e["ts"] for e in events)
+    t1 = max(e["ts"] for e in events)
+    print(
+        f"{len(events)} events over {t1 - t0:.1f}s from {path}"
+    )
+    tl = reconstruct_recovery_timeline(events, t_failure=failure_ts)
+    if tl is not None:
+        print()
+        print(render_timeline(tl))
+    print()
+    print(metrics_table(events, top=top))
+    return 0
+
+
+def selftest() -> int:
+    """Hermetic check of the reconstruction pipeline on synthetic
+    events shaped like a real drill trace."""
+    t = 1000.0
+    events = [
+        {"name": "node.heartbeat_timeout", "ts": t, "node_id": 1},
+        {"name": "trainer.proc_start", "ts": t + 4.0},
+        {"name": "trainer.dist_ready", "ts": t + 10.0},
+        {"name": "trainer.built", "ts": t + 25.0},
+        {"name": "trainer.restore_done", "ts": t + 27.5},
+        {"name": "trainer.first_step_done", "ts": t + 40.0},
+        {"name": "trainer.step", "ts": t + 41.0, "step": 11},
+        {"name": "trainer.throughput_recovered", "ts": t + 45.0},
+    ]
+    tl = reconstruct_recovery_timeline(events)
+    errors = []
+    if tl is None:
+        errors.append("reconstruction returned None")
+    else:
+        if not tl.complete:
+            errors.append(f"timeline incomplete: {tl.phases}")
+        for name in REQUIRED_PHASES:
+            dur = tl.phases.get(name)
+            if dur is None or dur <= 0:
+                errors.append(f"phase {name} not positive: {dur}")
+        expect = {
+            "failure-detect": 4.0,
+            "rendezvous": 6.0,
+            "build": 15.0,
+            "restore": 2.5,
+            "first-step": 12.5,
+            "throughput-90": 5.0,
+        }
+        for name, want in expect.items():
+            got = tl.phases.get(name)
+            if got is None or abs(got - want) > 1e-6:
+                errors.append(f"phase {name}: want {want}, got {got}")
+        if abs(tl.total_s - 45.0) > 1e-6:
+            errors.append(f"total_s: want 45.0, got {tl.total_s}")
+        render_timeline(tl)  # must not raise
+        metrics_table(events)
+    if errors:
+        print("obs selftest FAILED:")
+        for e in errors:
+            print(f"  - {e}")
+        return 1
+    print("obs selftest ok")
+    return 0
+
+
+def main(argv=None) -> int:
+    p = argparse.ArgumentParser("obs_report")
+    p.add_argument("event_file", nargs="?", default="")
+    p.add_argument(
+        "--failure-ts", type=float, default=None,
+        help="failure instant (unix time); derived from master-side "
+        "node.fail/node.gone events when omitted",
+    )
+    p.add_argument("--top", type=int, default=15)
+    p.add_argument(
+        "--selftest", action="store_true",
+        help="run the reconstruction pipeline on synthetic events",
+    )
+    args = p.parse_args(argv)
+    if args.selftest:
+        return selftest()
+    if not args.event_file:
+        p.error("event_file is required (or pass --selftest)")
+    return report(args.event_file, args.failure_ts, args.top)
+
+
+if __name__ == "__main__":
+    sys.exit(main())
