@@ -1,4 +1,19 @@
 from dlrover_tpu.data.coworker import CoworkerDataLoader
+from dlrover_tpu.data.prefetch import (
+    Prefetcher,
+    SyncPipeline,
+    make_input_pipeline,
+    prefetch_depth,
+    prefetch_enabled,
+)
 from dlrover_tpu.data.shm_ring import ShmBatchRing
 
-__all__ = ["CoworkerDataLoader", "ShmBatchRing"]
+__all__ = [
+    "CoworkerDataLoader",
+    "Prefetcher",
+    "ShmBatchRing",
+    "SyncPipeline",
+    "make_input_pipeline",
+    "prefetch_depth",
+    "prefetch_enabled",
+]

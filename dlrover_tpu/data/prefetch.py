@@ -1,0 +1,432 @@
+"""Pipelined input prefetch: stage batch N+1 while step N computes.
+
+The steady-state training loop must never wait on the input pipeline:
+Python collate and host->device staging (``device_put`` /
+``make_array_from_process_local_data``) for the NEXT batch should run
+while XLA executes the CURRENT step. :class:`Prefetcher` is that
+overlap: a single background thread pulls items from a source
+iterable (typically an ``ElasticDataLoader``), applies ``stage_fn``
+(collate + ``ElasticTrainer.shard_microbatches``), and parks the
+staged result in a bounded queue — double-buffered by default — that
+the train loop pops with near-zero wait.
+
+Elasticity contract: a checkpoint taken mid-stream must not count an
+in-flight batch (pulled from the sampler but not yet trained on) as
+consumed. The worker snapshots ``sampler.state_dict()`` immediately
+after pulling each item; :meth:`Prefetcher.sampler_state_dict`
+returns the snapshot of the last batch actually DELIVERED to the
+consumer, so an elastic restart resumes exactly after the last
+trained-on batch and the queued-but-untrained ones are replayed.
+
+Knobs (see docs/PERFORMANCE.md):
+
+* ``DLROVER_TPU_PREFETCH=0`` — disable switch consulted by the
+  high-level ``Trainer`` (:func:`prefetch_enabled`); the loop then
+  stages synchronously, exactly the pre-prefetch behavior.
+* ``DLROVER_TPU_PREFETCH_DEPTH`` — queue depth (staged batches held
+  ahead), default 2.
+
+Observability: every consumer wait lands in the
+``dlrover_train_data_wait_seconds`` histogram; with tracing on, the
+worker emits ``trainer.prefetch_stage`` spans per staged batch and
+the consumer emits ``trainer.prefetch_wait`` events, so
+``tools/obs_report.py`` can show data-wait vs step time.
+"""
+
+from __future__ import annotations
+
+import os
+import queue
+import threading
+import time
+from typing import Any, Callable, Iterable, Optional
+
+from dlrover_tpu import obs
+from dlrover_tpu.common.log import get_logger
+
+logger = get_logger("prefetch")
+
+PREFETCH_ENV = "DLROVER_TPU_PREFETCH"
+PREFETCH_DEPTH_ENV = "DLROVER_TPU_PREFETCH_DEPTH"
+DEFAULT_DEPTH = 2
+
+_DATA_WAIT = obs.histogram(
+    "dlrover_train_data_wait_seconds",
+    "Time the train loop waited on the input pipeline per batch "
+    "(near zero when prefetch keeps up)",
+)
+_BATCHES = obs.counter(
+    "dlrover_prefetch_batches_total",
+    "Prefetcher batches by outcome",
+    ("outcome",),  # staged | delivered | dropped
+)
+
+
+def prefetch_enabled() -> bool:
+    """The DLROVER_TPU_PREFETCH=0 disable switch (default: on)."""
+    return os.getenv(PREFETCH_ENV, "1") != "0"
+
+
+def prefetch_depth(default: int = DEFAULT_DEPTH) -> int:
+    try:
+        depth = int(os.getenv(PREFETCH_DEPTH_ENV, str(default)))
+    except ValueError:
+        return default
+    return max(1, depth)
+
+
+def _epoch_stream(source, sampler, auto_epoch: bool, name: str):
+    """Items from ``source``; on exhaustion with ``auto_epoch``, bump
+    the sampler epoch and re-iterate. The single shared rollover
+    implementation for both pipeline flavors.
+
+    A resumed sampler's FIRST pass may legitimately yield nothing
+    (checkpoint taken near the epoch boundary with a drop_last tail),
+    so one empty pass just rolls the epoch; two CONSECUTIVE empty
+    passes mean the dataset cannot fill a single batch — raise
+    loudly instead of spinning forever with the consumer blocked.
+    """
+    empty_passes = 0
+    while True:
+        yielded = False
+        for item in source:
+            yielded = True
+            empty_passes = 0
+            yield item
+        if not auto_epoch:
+            return
+        if not yielded:
+            empty_passes += 1
+            if empty_passes >= 2:
+                raise RuntimeError(
+                    f"input source {name!r} yielded no batches for a "
+                    "whole epoch (dataset smaller than one batch "
+                    "with drop_last?)"
+                )
+        sampler.set_epoch(sampler.epoch + 1)
+
+
+class _End:
+    """Queue sentinel: source exhausted (and auto_epoch is off)."""
+
+
+class _Error:
+    __slots__ = ("exc",)
+
+    def __init__(self, exc: BaseException):
+        self.exc = exc
+
+
+class Prefetcher:
+    """Background staging pipeline over a batch source.
+
+    Parameters
+    ----------
+    source: an iterable of raw batches (an ``ElasticDataLoader``, a
+        generator, ...). With ``auto_epoch`` it must be RE-iterable —
+        ``iter(source)`` is called again after each exhaustion.
+    stage_fn: optional ``raw_batch -> staged_batch`` run in the
+        worker thread (collate + device placement). None = identity.
+    depth: staged batches held ahead of the consumer (bounded queue;
+        the worker blocks when full). None = DLROVER_TPU_PREFETCH_DEPTH
+        or 2 (double buffering).
+    sampler: optional object with ``state_dict()`` / ``set_epoch()``
+        (an ``ElasticDistributedSampler``). Enables the
+        delivered-batch state snapshots and auto_epoch.
+    auto_epoch: when the source exhausts, bump ``sampler.set_epoch
+        (epoch + 1)`` and re-iterate instead of ending the stream —
+        the shape of the high-level Trainer's epoch loop.
+    """
+
+    def __init__(
+        self,
+        source: Iterable,
+        stage_fn: Optional[Callable[[Any], Any]] = None,
+        depth: Optional[int] = None,
+        sampler=None,
+        auto_epoch: bool = False,
+        name: str = "train",
+    ):
+        if auto_epoch and sampler is None:
+            raise ValueError("auto_epoch requires a sampler")
+        self._source = source
+        self._stage_fn = stage_fn
+        self.depth = depth if depth is not None else prefetch_depth()
+        if self.depth < 1:
+            raise ValueError(f"depth must be >= 1, got {self.depth}")
+        self._sampler = sampler
+        self._auto_epoch = auto_epoch
+        self.name = name
+        self._queue: queue.Queue = queue.Queue(maxsize=self.depth)
+        self._stop = threading.Event()
+        self._exhausted = False
+        self._closed = False
+        # State as of the last DELIVERED batch — what a checkpoint
+        # must record so in-flight batches are replayed, not skipped.
+        self._delivered_state = (
+            dict(sampler.state_dict()) if sampler is not None else None
+        )
+        self.staged = 0
+        self.delivered = 0
+        self.dropped = 0
+        self.wait_s_total = 0.0
+        obs.event(
+            "trainer.prefetch_start", pipeline=name, depth=self.depth
+        )
+        self._thread = threading.Thread(
+            target=self._run, name=f"prefetch-{name}", daemon=True
+        )
+        self._thread.start()
+
+    # -- worker --------------------------------------------------------------
+
+    def _put(self, item) -> bool:
+        """Bounded put that stays responsive to close()."""
+        while not self._stop.is_set():
+            try:
+                self._queue.put(item, timeout=0.1)
+                return True
+            except queue.Full:
+                continue
+        return False
+
+    def _run(self) -> None:
+        try:
+            it = _epoch_stream(
+                self._source, self._sampler, self._auto_epoch,
+                self.name,
+            )
+            while not self._stop.is_set():
+                try:
+                    raw = next(it)
+                except StopIteration:
+                    self._put(_End)
+                    return
+                # Snapshot AFTER the pull: the state in which this
+                # batch (and everything before it) counts as consumed.
+                state = (
+                    dict(self._sampler.state_dict())
+                    if self._sampler is not None
+                    else None
+                )
+                with obs.span(
+                    "trainer.prefetch_stage", pipeline=self.name
+                ):
+                    staged = (
+                        self._stage_fn(raw)
+                        if self._stage_fn is not None
+                        else raw
+                    )
+                # Count BEFORE the put: a concurrent close() may
+                # drain (and count dropped) the entry immediately,
+                # and staged == delivered + dropped must hold at
+                # prefetch_stop.
+                self.staged += 1
+                _BATCHES.inc(outcome="staged")
+                if not self._put((staged, state)):
+                    # Stopped while blocked on a full queue: the
+                    # batch never reached the consumer.
+                    self.dropped += 1
+                    _BATCHES.inc(outcome="dropped")
+                    return
+        except BaseException as exc:  # noqa: BLE001 — relayed to consumer
+            self._put(_Error(exc))
+
+    # -- consumer ------------------------------------------------------------
+
+    def __iter__(self) -> "Prefetcher":
+        return self
+
+    def __next__(self):
+        if self._closed:
+            raise RuntimeError("Prefetcher is closed")
+        if self._exhausted:
+            raise StopIteration
+        t0 = time.perf_counter()
+        while True:
+            # Bounded get so a close() from ANOTHER thread (elastic
+            # restart, watchdog) unblocks a consumer waiting on an
+            # empty queue instead of deadlocking it forever; a batch
+            # landing mid-wait still wakes the get immediately.
+            try:
+                entry = self._queue.get(timeout=0.1)
+                break
+            except queue.Empty:
+                if self._closed:
+                    raise RuntimeError(
+                        "Prefetcher closed while waiting for a batch"
+                    ) from None
+        wait = time.perf_counter() - t0
+        if entry is _End:
+            self._exhausted = True
+            raise StopIteration
+        if isinstance(entry, _Error):
+            self._exhausted = True
+            raise entry.exc
+        # Record the wait only for REAL batches — the terminal
+        # sentinel fetch must not add a phantom sample to the
+        # data-wait histogram / trainer.prefetch_wait stream.
+        self.wait_s_total += wait
+        _DATA_WAIT.observe(wait)
+        obs.event(
+            "trainer.prefetch_wait",
+            pipeline=self.name,
+            dur_s=round(wait, 6),
+        )
+        batch, state = entry
+        if state is not None:
+            self._delivered_state = state
+        self.delivered += 1
+        _BATCHES.inc(outcome="delivered")
+        return batch
+
+    def sampler_state_dict(self) -> Optional[dict]:
+        """Sampler state as of the last batch the CONSUMER received.
+
+        Batches staged ahead in the queue (or mid-stage in the
+        worker) are NOT counted — checkpointing this dict makes an
+        elastic restart replay them instead of skipping data.
+        """
+        state = self._delivered_state
+        return dict(state) if state is not None else None
+
+    # -- shutdown ------------------------------------------------------------
+
+    def close(self) -> None:
+        """Stop the worker and drop staged-but-undelivered batches.
+
+        Idempotent; called on elastic restart and normal shutdown.
+        The dropped batches were never delivered, so
+        :meth:`sampler_state_dict` has never counted them — the next
+        incarnation's sampler replays them.
+        """
+        if self._closed:
+            return
+        self._closed = True
+        self._stop.set()
+        # Drain so a worker blocked on a full queue can observe the
+        # stop event and exit.
+        while True:
+            try:
+                entry = self._queue.get_nowait()
+            except queue.Empty:
+                break
+            if entry is not _End and not isinstance(entry, _Error):
+                self.dropped += 1
+                _BATCHES.inc(outcome="dropped")
+        self._thread.join(timeout=5.0)
+        if self._thread.is_alive():  # pragma: no cover — stage_fn hang
+            logger.warning(
+                "prefetch worker %r did not stop within 5s", self.name
+            )
+        # A put already in flight when stop was set may have landed
+        # after the first drain; sweep again now the worker is done.
+        while True:
+            try:
+                entry = self._queue.get_nowait()
+            except queue.Empty:
+                break
+            if entry is not _End and not isinstance(entry, _Error):
+                self.dropped += 1
+                _BATCHES.inc(outcome="dropped")
+        obs.event(
+            "trainer.prefetch_stop",
+            pipeline=self.name,
+            staged=self.staged,
+            delivered=self.delivered,
+            dropped=self.dropped,
+            wait_s_total=round(self.wait_s_total, 6),
+        )
+
+    def __enter__(self) -> "Prefetcher":
+        return self
+
+    def __exit__(self, *exc) -> None:
+        self.close()
+
+
+class SyncPipeline:
+    """The DLROVER_TPU_PREFETCH=0 fallback: stages in the CONSUMER
+    thread (data-wait == full staging cost, honestly recorded in the
+    same ``dlrover_train_data_wait_seconds`` histogram) with the
+    Prefetcher's interface — epoch rollover, zero-batch-epoch guard,
+    ``sampler_state_dict()`` (trivially exact: nothing is ever in
+    flight) and an idempotent no-op ``close()``."""
+
+    def __init__(
+        self,
+        source: Iterable,
+        stage_fn: Optional[Callable[[Any], Any]] = None,
+        sampler=None,
+        auto_epoch: bool = False,
+        name: str = "train",
+    ):
+        if auto_epoch and sampler is None:
+            raise ValueError("auto_epoch requires a sampler")
+        self._stage_fn = stage_fn
+        self._sampler = sampler
+        self.name = name
+        self._it = _epoch_stream(source, sampler, auto_epoch, name)
+        self.delivered = 0
+        self.wait_s_total = 0.0
+
+    def __iter__(self) -> "SyncPipeline":
+        return self
+
+    def __next__(self):
+        t0 = time.perf_counter()
+        raw = next(self._it)  # StopIteration ends the stream
+        staged = (
+            self._stage_fn(raw) if self._stage_fn is not None else raw
+        )
+        wait = time.perf_counter() - t0
+        self.wait_s_total += wait
+        _DATA_WAIT.observe(wait)
+        self.delivered += 1
+        _BATCHES.inc(outcome="delivered")
+        return staged
+
+    def sampler_state_dict(self) -> Optional[dict]:
+        if self._sampler is None:
+            return None
+        return dict(self._sampler.state_dict())
+
+    def close(self) -> None:
+        return None
+
+    def __enter__(self) -> "SyncPipeline":
+        return self
+
+    def __exit__(self, *exc) -> None:
+        self.close()
+
+
+def make_input_pipeline(
+    source: Iterable,
+    stage_fn: Optional[Callable[[Any], Any]] = None,
+    depth: Optional[int] = None,
+    sampler=None,
+    auto_epoch: bool = False,
+    name: str = "train",
+):
+    """The one switch every train loop uses: a background
+    :class:`Prefetcher` normally, or the synchronous
+    :class:`SyncPipeline` under ``DLROVER_TPU_PREFETCH=0`` — same
+    interface either way (iterate, ``sampler_state_dict()``,
+    ``close()``)."""
+    if prefetch_enabled():
+        return Prefetcher(
+            source,
+            stage_fn=stage_fn,
+            depth=depth,
+            sampler=sampler,
+            auto_epoch=auto_epoch,
+            name=name,
+        )
+    return SyncPipeline(
+        source,
+        stage_fn=stage_fn,
+        sampler=sampler,
+        auto_epoch=auto_epoch,
+        name=name,
+    )

@@ -1,0 +1,125 @@
+"""Asynchronous scalar reporting: keep the hot loop free of host syncs.
+
+``float(loss)`` on a freshly dispatched step is a device->host
+round-trip that serializes the Python loop with the accelerator —
+the single biggest per-step stall after input staging. The trainer
+therefore never materializes metrics inline; it hands the DEVICE
+scalar to an :class:`AsyncScalarReporter`, which keeps a bounded
+deque of ``(step, device_scalar)`` and drains entries to the emit
+callback only once the value is already on host (``Array.is_ready``)
+— in practice one step late, because step N's loss has finished
+computing by the time step N+1 is dispatched. The loop never blocks;
+an explicit :meth:`flush` at checkpoint/shutdown delivers the tail,
+so every offered step is emitted exactly once, in order.
+
+Every intentional materialization increments
+``dlrover_train_host_syncs_total{reason}`` — the budget is visible in
+/metrics, and the steady-state hot loop must not grow it (enforced by
+the ``jax.transfer_guard`` tripwire test in
+tests/test_elastic_trainer.py; contract in docs/PERFORMANCE.md).
+"""
+
+from __future__ import annotations
+
+import collections
+from typing import Callable, Optional
+
+from dlrover_tpu import obs
+
+HOST_SYNCS = obs.counter(
+    "dlrover_train_host_syncs_total",
+    "Intentional device->host scalar materializations",
+    ("reason",),
+)
+
+DEFAULT_MAX_PENDING = 8
+
+
+def scalar_ready(value) -> bool:
+    """True when materializing ``value`` cannot block: plain Python
+    numbers, or a jax.Array whose computation already finished."""
+    is_ready = getattr(value, "is_ready", None)
+    if is_ready is None:
+        return True
+    try:
+        return bool(is_ready())
+    except Exception:  # noqa: BLE001 — deleted/donated array etc.
+        return True
+
+
+def materialize(value, reason: str = "metrics") -> float:
+    """Device scalar -> float via the EXPLICIT transfer API
+    (``jax.device_get``), counted in dlrover_train_host_syncs_total.
+
+    Explicit matters: hot-loop code runs under
+    ``jax.transfer_guard("disallow")`` on real accelerators, which
+    forbids implicit transfers (``float(arr)``, ``np.asarray(arr)``)
+    but allows this path.
+    """
+    HOST_SYNCS.inc(reason=reason)
+    if isinstance(value, (int, float)):
+        return float(value)
+    import jax
+
+    return float(jax.device_get(value))
+
+
+class AsyncScalarReporter:
+    """Bounded, ordered, exactly-once scalar drain.
+
+    ``emit_fn(step, value_float, **tags)`` is called for every offered
+    entry, oldest first. :meth:`offer` never blocks on a transfer
+    unless the deque exceeds ``max_pending`` (backpressure: the
+    oldest entry is then force-materialized so memory stays bounded
+    even if the device falls far behind).
+    """
+
+    def __init__(
+        self,
+        emit_fn: Callable,
+        max_pending: int = DEFAULT_MAX_PENDING,
+        reason: str = "metrics",
+    ):
+        if max_pending < 1:
+            raise ValueError("max_pending must be >= 1")
+        self.emit_fn = emit_fn
+        self.max_pending = max_pending
+        self.reason = reason
+        self._pending: collections.deque = collections.deque()
+        self.emitted = 0
+
+    def __len__(self) -> int:
+        return len(self._pending)
+
+    def offer(self, step: int, value, **tags) -> None:
+        """Queue a (step, device-scalar) and drain whatever is ready."""
+        self._pending.append((step, value, tags))
+        self.drain_ready()
+        while len(self._pending) > self.max_pending:
+            self._emit_oldest()
+
+    def drain_ready(self) -> int:
+        """Emit leading entries whose values are already on host —
+        never blocks. Returns how many were emitted."""
+        n = 0
+        while self._pending and scalar_ready(self._pending[0][1]):
+            self._emit_oldest()
+            n += 1
+        return n
+
+    def flush(self) -> int:
+        """Materialize and emit EVERYTHING pending (blocking). Call at
+        checkpoint boundaries and shutdown so no step's metrics are
+        lost. Returns how many entries were emitted."""
+        n = 0
+        while self._pending:
+            self._emit_oldest()
+            n += 1
+        return n
+
+    def _emit_oldest(self) -> None:
+        step, value, tags = self._pending.popleft()
+        self.emit_fn(
+            step, materialize(value, reason=self.reason), **tags
+        )
+        self.emitted += 1

@@ -29,12 +29,14 @@ from typing import Any, Callable, Optional, Tuple
 
 import jax
 import jax.numpy as jnp
+import numpy as np
 import optax
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
 from dlrover_tpu import obs
 from dlrover_tpu.common.log import get_logger
 from dlrover_tpu.parallel.sharding import prune_specs_to_mesh
+from dlrover_tpu.trainer.async_metrics import AsyncScalarReporter
 from dlrover_tpu.trainer.step import batch_spec
 
 logger = get_logger("elastic_trainer")
@@ -44,8 +46,13 @@ _STEPS_TOTAL = obs.counter(
 )
 _STEP_SECONDS = obs.histogram(
     "dlrover_train_step_seconds",
-    "Wall time between consecutive train_step completions "
-    "(first sample per trainer covers the XLA compile)",
+    "Wall time between consecutive train_step DISPATCHES (first "
+    "sample per trainer covers the XLA compile). The zero-sync hot "
+    "loop no longer blocks per step on async backends, so individual "
+    "samples measure host-side pacing, small until the loop hits a "
+    "sync point (log interval, reporter backpressure, checkpoint); "
+    "the MEAN over a window still equals true step time, because the "
+    "samples' sum is wall time",
 )
 
 
@@ -100,6 +107,8 @@ class ElasticTrainer:
         report_fn: Optional[Callable[[TrainerReport], None]] = None,
         accum_dtype=None,
         step_fn: Optional[Callable] = None,
+        donate_state: bool = True,
+        report_max_pending: int = 8,
     ):
         """``step_fn``: a prebuilt full-batch training step —
         ``step_fn(params, opt_state, tokens[B, ...], targets) ->
@@ -108,7 +117,22 @@ class ElasticTrainer:
         the elastic loop: pass a models/pipeline_lm step (its internal
         1F1B microbatching takes over the role of grad accumulation;
         the fixed-global-batch contract and per-process batch
-        assembly are unchanged). ``loss_fn`` may be None then."""
+        assembly are unchanged). ``loss_fn`` may be None then.
+
+        ``donate_state``: build the jitted step with
+        ``donate_argnums`` for (params, opt_state) so XLA updates the
+        training state IN PLACE — halves peak HBM and removes the
+        copy-on-update. The returned (params, opt_state) must replace
+        the caller's references (the inputs' buffers are deleted).
+        The escape hatch for callers that ALIAS state — keep a handle
+        to the pre-step params for comparison, feed the same pytree to
+        two trainers, hold a reference from an in-flight async
+        consumer — is ``donate_state=False``; see
+        docs/PERFORMANCE.md for the caveats.
+
+        ``report_max_pending``: bound of the async reporter's deque of
+        un-materialized (step, device-loss) entries; above it the
+        oldest entry is force-fetched so memory stays bounded."""
         self.mesh = mesh
         self.loss_fn = loss_fn
         self.optimizer = optimizer
@@ -122,8 +146,20 @@ class ElasticTrainer:
         # microbatches are pre-scaled by 1/accum so the range is fine;
         # the tradeoff is bf16's ~8-bit mantissa on the running sum.
         self.accum_dtype = accum_dtype
+        self.donate_state = donate_state
         self.num_shards = data_shards(mesh)
         self.step_num = 0
+        # Loss scalars reach report_fn via the async drain: the hot
+        # loop hands the DEVICE scalar over and never blocks on a
+        # device->host transfer; values arrive (in order, exactly
+        # once) one step late, plus a flush() at checkpoint/shutdown.
+        self._reporter: Optional[AsyncScalarReporter] = None
+        if report_fn is not None:
+            self._reporter = AsyncScalarReporter(
+                self._emit_report,
+                max_pending=report_max_pending,
+                reason="speed_report",
+            )
         # perf_counter of the last train_step completion; None until
         # the first step of THIS trainer instance (each elastic
         # restart builds a new trainer, so the first sample after any
@@ -188,7 +224,6 @@ class ElasticTrainer:
             else jnp.float32
         )
 
-        @jax.jit
         def train_step(params, opt_state, tokens, targets):
 
             def micro(carry, batch):
@@ -220,7 +255,7 @@ class ElasticTrainer:
             return params, opt_state, loss_sum / accum
 
         self._mb_spec = mb_spec
-        return train_step
+        return jax.jit(train_step, donate_argnums=self._donate_argnums())
 
     def _wrap_flat_step(self, step_fn):
         """Adapt an external full-batch step to the trainer's
@@ -231,7 +266,6 @@ class ElasticTrainer:
         bspec = batch_spec(self.mesh)
         self._mb_spec = P(None, *bspec)
 
-        @jax.jit
         def train_step(params, opt_state, tokens, targets):
             # accum is pinned to 1 in step_fn mode, so this flatten
             # just drops the leading singleton — the batch dim keeps
@@ -248,7 +282,11 @@ class ElasticTrainer:
             )
             return params, opt_state, loss
 
-        return train_step
+        return jax.jit(train_step, donate_argnums=self._donate_argnums())
+
+    def _donate_argnums(self) -> Tuple[int, ...]:
+        """(params, opt_state) positions when in-place update is on."""
+        return (0, 1) if self.donate_state else ()
 
     def shard_microbatches(
         self, tokens, targets
@@ -278,8 +316,6 @@ class ElasticTrainer:
                 jax.device_put(tokens, sharding),
                 jax.device_put(targets, sharding),
             )
-        import numpy as np
-
         n = self.local_samples_per_step
         global_mb = self.micro_batch_size * self.num_shards
         local = np.asarray(tokens[:n]).reshape(
@@ -329,11 +365,46 @@ class ElasticTrainer:
     def train_step(self, params, opt_state, tokens, targets):
         """One optimizer update over ``accum`` microbatches.
 
-        tokens/targets: [accum, micro*shards, ...] already sharded (use
-        shard_microbatches) or host arrays to be sharded here.
+        tokens/targets: numpy host arrays to be sharded here, or
+        [accum, micro*shards, ...] device arrays already staged (use
+        shard_microbatches, ideally off-thread via
+        ``dlrover_tpu.data.prefetch.Prefetcher``).
+
+        Zero-sync contract: with pre-staged inputs this neither reads
+        nor writes host memory — the returned ``loss`` is a DEVICE
+        scalar (materialize it with
+        ``async_metrics.materialize(loss)``, never ``float(loss)``,
+        in guarded hot loops) and the speed report drains
+        asynchronously one step late. ``flush_metrics()`` delivers
+        the tail at checkpoint/shutdown.
+
+        With ``donate_state`` (default) params/opt_state buffers are
+        donated to XLA: rebind them from the return value and never
+        touch the inputs again.
         """
-        if tokens.ndim == 2:  # unsharded [N, T] host batch
+        if isinstance(tokens, np.ndarray):
+            # Host batch of ANY rank gets staged; device arrays are
+            # assumed already sharded and are never re-staged.
             tokens, targets = self.shard_microbatches(tokens, targets)
+        else:
+            # Loud contract check for the passthrough path: a caller
+            # still feeding flat [N, ...] jnp host batches (the
+            # pre-donation calling convention) must hear "stage it"
+            # here, not a shape error deep inside lax.scan — or
+            # worse, a silently wrong update when N == accum.
+            expect = (
+                self.accum_steps,
+                self.micro_batch_size * self.num_shards,
+            )
+            if tokens.ndim < 2 or tuple(tokens.shape[:2]) != expect:
+                raise ValueError(
+                    f"device-array batch must be pre-staged as "
+                    f"[accum={expect[0]}, micro*shards={expect[1]}, "
+                    f"...]; got shape {tuple(tokens.shape)} — pass a "
+                    "numpy host batch or stage with "
+                    "shard_microbatches() (ideally via "
+                    "data.prefetch.make_input_pipeline)"
+                )
         t0 = time.perf_counter()
         params, opt_state, loss = self._compiled(
             params, opt_state, tokens, targets
@@ -353,16 +424,26 @@ class ElasticTrainer:
         self._last_step_t = now
         _STEPS_TOTAL.inc()
         self.step_num += 1
-        if self.report_fn is not None:
-            self.report_fn(
-                TrainerReport(
-                    step=self.step_num,
-                    loss=float(loss),
-                    global_batch_size=self.samples_per_step,
-                    accum_steps=self.accum_steps,
-                )
-            )
+        if self._reporter is not None:
+            self._reporter.offer(self.step_num, loss)
         return params, opt_state, loss
+
+    def _emit_report(self, step: int, loss: float) -> None:
+        self.report_fn(
+            TrainerReport(
+                step=step,
+                loss=loss,
+                global_batch_size=self.samples_per_step,
+                accum_steps=self.accum_steps,
+            )
+        )
+
+    def flush_metrics(self) -> None:
+        """Deliver every pending async loss report (blocking). Call
+        before checkpointing trainer state and at shutdown so the
+        master's speed monitor sees every step exactly once."""
+        if self._reporter is not None:
+            self._reporter.flush()
 
     # -- state for flash checkpoint -----------------------------------------
 

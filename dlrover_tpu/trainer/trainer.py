@@ -121,7 +121,9 @@ class Trainer:
 
         from dlrover_tpu.accelerate import auto_accelerate
         from dlrover_tpu.agent.monitor import TrainingMonitor
+        from dlrover_tpu.data.prefetch import make_input_pipeline
         from dlrover_tpu.trainer import jax_env
+        from dlrover_tpu.trainer.async_metrics import materialize
         from dlrover_tpu.trainer.elastic_trainer import (
             ElasticDataLoader,
             ElasticDistributedSampler,
@@ -200,66 +202,93 @@ class Trainer:
             sampler=sampler,
             collate_fn=self.collate_fn,
         )
-        it = iter(loader)
 
-        losses = []
+        def _stage(batch):
+            # Collate output -> device arrays laid out on the mesh;
+            # runs in the prefetch worker so H2D staging for step N+1
+            # overlaps step N's compute.
+            tokens, targets = batch
+            return trainer.shard_microbatches(
+                np.asarray(tokens), np.asarray(targets)
+            )
+
+        # Background Prefetcher normally; the synchronous fallback
+        # under DLROVER_TPU_PREFETCH=0 — same interface either way.
+        batches = make_input_pipeline(
+            loader,
+            stage_fn=_stage,
+            sampler=sampler,
+            auto_epoch=True,
+            name="trainer",
+        )
+
+        def _sampler_state() -> dict:
+            # The pipeline's snapshot counts only DELIVERED batches,
+            # so a restart replays staged-but-untrained ones. Never
+            # fall back to the live sampler here: the worker has
+            # already advanced it past the in-flight batches.
+            return batches.sampler_state_dict()
+
+        # Device scalars only in the hot loop: the loss is fetched to
+        # host ON the logging interval and once at the end, never per
+        # step (async_metrics.materialize = explicit, counted sync).
+        last_loss = None
         last_eval, last_eval_step = None, -1
         t0 = time.time()
         step = start_step
-        for step in range(start_step + 1, args.max_steps + 1):
-            try:
-                tokens, targets = next(it)
-            except StopIteration:
-                sampler.set_epoch(sampler.epoch + 1)
-                it = iter(loader)
-                tokens, targets = next(it)
-            params, opt_state, loss = trainer.train_step(
-                params, opt_state, jnp.asarray(tokens),
-                jnp.asarray(targets),
-            )
-            losses.append(float(loss))
-            TrainingMonitor.write_metrics(
-                step,
-                tokens=step
-                * args.global_batch_size
-                * np.asarray(tokens).shape[-1],
-            )
-            if step % args.log_steps == 0:
-                logger.info(
-                    "step %d: loss %.4f (%.1f steps/s)",
+        try:
+            for step in range(start_step + 1, args.max_steps + 1):
+                tokens, targets = next(batches)
+                params, opt_state, last_loss = trainer.train_step(
+                    params, opt_state, tokens, targets
+                )
+                TrainingMonitor.write_metrics(
                     step,
-                    losses[-1],
-                    args.log_steps / max(time.time() - t0, 1e-9),
+                    tokens=step
+                    * args.global_batch_size
+                    * tokens.shape[-1],
                 )
-                t0 = time.time()
-            if (
-                self.eval_dataset is not None
-                and args.eval_steps
-                and step % args.eval_steps == 0
-            ):
-                last_eval = self._run_eval(res.mesh, params)
-                last_eval_step = step
-                logger.info(
-                    "step %d: eval_loss %.4f ppl %.2f (%d batches)",
-                    step, last_eval["eval_loss"],
-                    last_eval["perplexity"], last_eval["batches"],
-                )
-            if args.save_steps and step % args.save_steps == 0:
-                ckpt.save_checkpoint(
-                    step, (params, opt_state),
-                    storage_type=StorageType.DISK,
-                    extra={
-                        "sampler": sampler.state_dict(),
-                        "strategy": res.strategy.to_json(),
-                    },
-                )
-        ckpt.save_checkpoint(
-            step, (params, opt_state), storage_type=StorageType.DISK,
-            extra={
-                "sampler": sampler.state_dict(),
-                "strategy": res.strategy.to_json(),
-            },
-        )
+                if step % args.log_steps == 0:
+                    logger.info(
+                        "step %d: loss %.4f (%.1f steps/s)",
+                        step,
+                        materialize(last_loss, reason="log"),
+                        args.log_steps / max(time.time() - t0, 1e-9),
+                    )
+                    t0 = time.time()
+                if (
+                    self.eval_dataset is not None
+                    and args.eval_steps
+                    and step % args.eval_steps == 0
+                ):
+                    last_eval = self._run_eval(res.mesh, params)
+                    last_eval_step = step
+                    logger.info(
+                        "step %d: eval_loss %.4f ppl %.2f (%d batches)",
+                        step, last_eval["eval_loss"],
+                        last_eval["perplexity"], last_eval["batches"],
+                    )
+                if args.save_steps and step % args.save_steps == 0:
+                    trainer.flush_metrics()
+                    ckpt.save_checkpoint(
+                        step, (params, opt_state),
+                        storage_type=StorageType.DISK,
+                        extra={
+                            "sampler": _sampler_state(),
+                            "strategy": res.strategy.to_json(),
+                        },
+                    )
+            trainer.flush_metrics()
+            ckpt.save_checkpoint(
+                step, (params, opt_state),
+                storage_type=StorageType.DISK,
+                extra={
+                    "sampler": _sampler_state(),
+                    "strategy": res.strategy.to_json(),
+                },
+            )
+        finally:
+            batches.close()
         final_eval = None
         if self.eval_dataset is not None:
             # reuse the in-loop result when the last step already ran it
@@ -272,7 +301,11 @@ class Trainer:
         ckpt.close()
         return {
             "final_step": step,
-            "final_loss": losses[-1] if losses else None,
+            "final_loss": (
+                materialize(last_loss, reason="final")
+                if last_loss is not None
+                else None
+            ),
             "eval": final_eval,
             "params": params,
             "opt_state": opt_state,

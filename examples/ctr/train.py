@@ -52,6 +52,9 @@ import jax.numpy as jnp  # noqa: E402
 import optax  # noqa: E402
 
 from dlrover_tpu import obs  # noqa: E402
+from dlrover_tpu.data.prefetch import (  # noqa: E402
+    make_input_pipeline,
+)
 from dlrover_tpu.master.ps_manager import PsManager  # noqa: E402
 from dlrover_tpu.sparse.ps_client import DistributedKvClient  # noqa: E402
 from dlrover_tpu.sparse.ps_server import PsServer  # noqa: E402
@@ -215,107 +218,125 @@ def main(argv=None) -> int:
                     "recovery"
                 )
             kill_steps.append(ks)
+    # Batch synthesis (the host-side "collate" of this example) runs
+    # in a prefetch worker, double-buffered ahead of the train loop —
+    # the PS lookup/apply path never waits on input assembly.
+    def batch_stream():
+        while True:
+            yield synthetic_batch(rng, args.batch)
+
+    def stage(batch):
+        keys, labels = batch
+        return keys.ravel(), jnp.asarray(labels)
+
+    batches = make_input_pipeline(
+        batch_stream(), stage_fn=stage, name="ctr"
+    )
+
     losses = []
     drill_stats = {}
     kills_done = []
     t0 = time.time()
-    for step in range(1, args.steps + 1):
-        step_start = time.time()
-        keys, labels = synthetic_batch(rng, args.batch)
-        # One high-level step: lookup -> grads -> dense update +
-        # fused sparse apply + periodic flush, surviving PS failover
-        # inside (trainer/sparse_trainer.py).
-        loss = trainer.train_step(keys.ravel(), jnp.asarray(labels))
-        losses.append(loss)
+    try:
+        for step in range(1, args.steps + 1):
+            step_start = time.time()
+            keys_flat, labels = next(batches)
+            # One high-level step: lookup -> grads -> dense update +
+            # fused sparse apply + periodic flush, surviving PS failover
+            # inside (trainer/sparse_trainer.py).
+            loss = trainer.train_step(keys_flat, labels)
+            losses.append(loss)
 
-        if drill_stats.get("kill_step") == step - 1:
-            # First full step after the kill: everything blocked in it
-            # (stale-map retries + rebalance) is the recovery cost.
-            t_unblocked = time.time()
-            t_kill = drill_stats.pop("_kill_time")
-            drill_stats["recovery_s"] = round(t_unblocked - t_kill, 3)
-            drill_stats["map_version_after"] = (
-                mgr.partition_map.version
-            )
-            drill_stats["rows_after_recovery"] = client.table_size(
-                "emb"
-            )
-            fo = mgr.last_failover
-            if args.drill == "abrupt" and fo is not None:
-                # Phase breakdown: liveness detection latency, the
-                # rebalance+restore inside remove_ps, and the blocked
-                # client's unblock-to-step-complete time.
-                drill_stats["phases"] = {
-                    "detect_s": round(fo["t_detected"] - t_kill, 3),
-                    "rebalance_restore_s": round(
-                        fo["t_map_published"] - fo["t_detected"], 3
-                    ),
-                    "client_resume_s": round(
-                        t_unblocked - fo["t_map_published"], 3
-                    ),
+            if drill_stats.get("kill_step") == step - 1:
+                # First full step after the kill: everything blocked in it
+                # (stale-map retries + rebalance) is the recovery cost.
+                t_unblocked = time.time()
+                t_kill = drill_stats.pop("_kill_time")
+                drill_stats["recovery_s"] = round(t_unblocked - t_kill, 3)
+                drill_stats["map_version_after"] = (
+                    mgr.partition_map.version
+                )
+                drill_stats["rows_after_recovery"] = client.table_size(
+                    "emb"
+                )
+                fo = mgr.last_failover
+                if args.drill == "abrupt" and fo is not None:
+                    # Phase breakdown: liveness detection latency, the
+                    # rebalance+restore inside remove_ps, and the blocked
+                    # client's unblock-to-step-complete time.
+                    drill_stats["phases"] = {
+                        "detect_s": round(fo["t_detected"] - t_kill, 3),
+                        "rebalance_restore_s": round(
+                            fo["t_map_published"] - fo["t_detected"], 3
+                        ),
+                        "client_resume_s": round(
+                            t_unblocked - fo["t_map_published"], 3
+                        ),
+                    }
+                # PS failover into the obs event stream too (no-op unless
+                # DLROVER_TPU_TRACE_FILE/DLROVER_TPU_TRACE is set): the
+                # same trace file then explains worker AND PS recoveries.
+                obs.event(
+                    "ps.failover_recovered",
+                    recovery_s=drill_stats["recovery_s"],
+                    **(drill_stats.get("phases") or {}),
+                )
+                print(
+                    f"DRILL: recovered in {drill_stats['recovery_s']}s "
+                    f"(map v{drill_stats['map_version_before']} -> "
+                    f"v{drill_stats['map_version_after']}, rows "
+                    f"{drill_stats['rows_after_recovery']}, phases "
+                    f"{drill_stats.get('phases')})"
+                )
+                kills_done.append(dict(drill_stats))
+
+            if args.drill and step in kill_steps:
+                vid = max(servers)
+                victim = servers.pop(vid)
+                rows = len(victim.table("emb"))
+                drill_stats = {
+                    "drill": f"ps_{args.drill}_kill",
+                    "killed_ps": vid,
+                    "kill_step": step,
+                    "victim_rows": rows,
+                    "rows_at_last_flush": trainer.last_flush_rows,
+                    "map_version_before": mgr.partition_map.version,
+                    "_kill_time": time.time(),
                 }
-            # PS failover into the obs event stream too (no-op unless
-            # DLROVER_TPU_TRACE_FILE/DLROVER_TPU_TRACE is set): the
-            # same trace file then explains worker AND PS recoveries.
-            obs.event(
-                "ps.failover_recovered",
-                recovery_s=drill_stats["recovery_s"],
-                **(drill_stats.get("phases") or {}),
-            )
-            print(
-                f"DRILL: recovered in {drill_stats['recovery_s']}s "
-                f"(map v{drill_stats['map_version_before']} -> "
-                f"v{drill_stats['map_version_after']}, rows "
-                f"{drill_stats['rows_after_recovery']}, phases "
-                f"{drill_stats.get('phases')})"
-            )
-            kills_done.append(dict(drill_stats))
-
-        if args.drill and step in kill_steps:
-            vid = max(servers)
-            victim = servers.pop(vid)
-            rows = len(victim.table("emb"))
-            drill_stats = {
-                "drill": f"ps_{args.drill}_kill",
-                "killed_ps": vid,
-                "kill_step": step,
-                "victim_rows": rows,
-                "rows_at_last_flush": trainer.last_flush_rows,
-                "map_version_before": mgr.partition_map.version,
-                "_kill_time": time.time(),
-            }
-            obs.event(
-                "ps.kill", ps=vid, step=step, mode=args.drill,
-                victim_rows=rows,
-            )
-            if args.drill == "graceful":
-                flushed = mgr.flush_all(step)
-                drill_stats["rows_at_last_flush"] = flushed
-                victim.stop()
-                mgr.remove_ps(vid)
-                print(
-                    f"DRILL: flushed {flushed} rows, killed PS with "
-                    f"{rows} rows at step {step}; survivors restore "
-                    "from delta files"
+                obs.event(
+                    "ps.kill", ps=vid, step=step, mode=args.drill,
+                    victim_rows=rows,
                 )
-            else:
-                # Abrupt: no flush, no notification. The next sparse
-                # op blocks until the liveness monitor fails it over.
-                victim.stop()
-                print(
-                    f"DRILL: PS {vid} died abruptly at step {step} "
-                    f"({rows} rows in memory, last flush "
-                    f"{trainer.last_flush_rows}); waiting for liveness "
-                    "failover"
-                )
+                if args.drill == "graceful":
+                    flushed = mgr.flush_all(step)
+                    drill_stats["rows_at_last_flush"] = flushed
+                    victim.stop()
+                    mgr.remove_ps(vid)
+                    print(
+                        f"DRILL: flushed {flushed} rows, killed PS with "
+                        f"{rows} rows at step {step}; survivors restore "
+                        "from delta files"
+                    )
+                else:
+                    # Abrupt: no flush, no notification. The next sparse
+                    # op blocks until the liveness monitor fails it over.
+                    victim.stop()
+                    print(
+                        f"DRILL: PS {vid} died abruptly at step {step} "
+                        f"({rows} rows in memory, last flush "
+                        f"{trainer.last_flush_rows}); waiting for liveness "
+                        "failover"
+                    )
 
-        if step % 20 == 0 or step == 1:
-            print(
-                f"step {step}: loss {loss:.4f} "
-                f"rows={client.table_size('emb')} "
-                f"({time.time() - step_start:.2f}s)",
-                flush=True,
-            )
+            if step % 20 == 0 or step == 1:
+                print(
+                    f"step {step}: loss {loss:.4f} "
+                    f"rows={client.table_size('emb')} "
+                    f"({time.time() - step_start:.2f}s)",
+                    flush=True,
+                )
+    finally:
+        batches.close()
 
     head = float(np.mean(losses[:10]))
     tail = float(np.mean(losses[-10:]))

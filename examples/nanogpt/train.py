@@ -72,8 +72,10 @@ def main(argv=None) -> int:
 
     from dlrover_tpu.accelerate import Strategy, auto_accelerate
     from dlrover_tpu.agent.monitor import TrainingMonitor
+    from dlrover_tpu.data.prefetch import make_input_pipeline
     from dlrover_tpu.models import gpt
     from dlrover_tpu.trainer import jax_env
+    from dlrover_tpu.trainer.async_metrics import materialize
     from dlrover_tpu.trainer.elastic_trainer import (
         ElasticDistributedSampler,
         ElasticTrainer,
@@ -173,41 +175,63 @@ def main(argv=None) -> int:
         )
         return tok, tgt
 
+    # Each process feeds its own shard of the global batch (the
+    # sampler is sharded by process); shard_microbatches assembles the
+    # global device array from the per-process portions. The prefetch
+    # worker gathers + stages batch N+1 while step N computes, so the
+    # hot loop below touches host memory only on the logging interval.
+    def batch_stream():
+        while True:
+            yield next_batch(trainer.local_samples_per_step)
+
+    def stage(batch):
+        return trainer.shard_microbatches(*batch)
+
+    batches = make_input_pipeline(
+        batch_stream(), stage_fn=stage, name="nanogpt"
+    )
+
     t0 = time.time()
     tokens_seen = 0
-    loss = float("nan")  # stays NaN when fully resumed (no steps left)
-    for step in range(start_step + 1, args.steps + 1):
-        # Each process feeds its own shard of the global batch (the
-        # sampler is sharded by process); the trainer assembles the
-        # global device array from the per-process portions.
-        tok, tgt = next_batch(trainer.local_samples_per_step)
-        params, opt_state, loss = trainer.train_step(
-            params, opt_state, jnp.asarray(tok), jnp.asarray(tgt)
-        )
-        tokens_seen += trainer.samples_per_step * cfg.block_size
-        if step == start_step + 1:
-            # First step covers the train-step compile.
-            TrainingMonitor.mark_phase("first_step_done")
-        TrainingMonitor.write_metrics(step, tokens=tokens_seen)
-        if step % 10 == 0 or step == args.steps:
-            dt = time.time() - t0
-            print(
-                f"step {step}: loss {float(loss):.4f} "
-                f"({tokens_seen / max(dt, 1e-9):.0f} tok/s)",
-                flush=True,
+    loss_val = float("nan")  # NaN when fully resumed (no steps left)
+    try:
+        for step in range(start_step + 1, args.steps + 1):
+            tok, tgt = next(batches)
+            params, opt_state, loss = trainer.train_step(
+                params, opt_state, tok, tgt
             )
-        if args.checkpoint_every and step % args.checkpoint_every == 0:
-            ckpt.save_checkpoint(
-                step, (params, opt_state),
-                storage_type=StorageType.DISK,
-            )
+            tokens_seen += trainer.samples_per_step * cfg.block_size
+            if step == start_step + 1:
+                # First step covers the train-step compile.
+                TrainingMonitor.mark_phase("first_step_done")
+            TrainingMonitor.write_metrics(step, tokens=tokens_seen)
+            if step % 10 == 0 or step == args.steps:
+                # The ONLY per-interval device->host fetch: the loss
+                # lands on the log line, not in every step.
+                loss_val = materialize(loss, reason="log")
+                dt = time.time() - t0
+                print(
+                    f"step {step}: loss {loss_val:.4f} "
+                    f"({tokens_seen / max(dt, 1e-9):.0f} tok/s)",
+                    flush=True,
+                )
+            if (
+                args.checkpoint_every
+                and step % args.checkpoint_every == 0
+            ):
+                ckpt.save_checkpoint(
+                    step, (params, opt_state),
+                    storage_type=StorageType.DISK,
+                )
+    finally:
+        batches.close()
     # final checkpoint so a restart resumes cleanly
     ckpt.save_checkpoint(
         args.steps, (params, opt_state), storage_type=StorageType.DISK
     )
     ckpt.wait_latest_checkpoint()
     ckpt.close()
-    print(f"done: {args.steps} steps, final loss {float(loss):.4f}")
+    print(f"done: {args.steps} steps, final loss {loss_val:.4f}")
     return 0
 
 

@@ -50,13 +50,14 @@ def test_accumulated_step_equals_big_batch_step():
     opt = optax.sgd(0.1)
 
     mesh = _mesh(2)
+    # donate_state=False: ``params`` is aliased below to compute the
+    # big-batch reference (the documented donation escape hatch).
     tr = ElasticTrainer(
-        mesh, _linear_loss, opt, global_batch_size=32, micro_batch_size=4
+        mesh, _linear_loss, opt, global_batch_size=32,
+        micro_batch_size=4, donate_state=False,
     )
     assert tr.accum_steps == 4
-    p1, _, loss1 = tr.train_step(
-        params, opt.init(params), jnp.asarray(x), jnp.asarray(y)
-    )
+    p1, _, loss1 = tr.train_step(params, opt.init(params), x, y)
 
     # one big-batch step on the same data
     loss_big, grads = jax.value_and_grad(_linear_loss)(
@@ -85,11 +86,10 @@ def test_world_shrink_same_global_batch():
             opt,
             global_batch_size=32,
             micro_batch_size=4,
+            donate_state=False,  # params fed to BOTH trainers
         )
         assert tr.samples_per_step == 32
-        p, _, _ = tr.train_step(
-            params, opt.init(params), jnp.asarray(x), jnp.asarray(y)
-        )
+        p, _, _ = tr.train_step(params, opt.init(params), x, y)
         results.append(p)
     for a, b in zip(jax.tree.leaves(results[0]), jax.tree.leaves(results[1])):
         np.testing.assert_allclose(a, b, rtol=1e-5, atol=1e-6)
@@ -106,8 +106,10 @@ def test_training_converges():
     opt_state = opt.init(params)
     losses = []
     for _ in range(30):
+        # np host batch -> staged in train_step; state donated and
+        # rebound each iteration (the intended steady-state shape).
         params, opt_state, loss = tr.train_step(
-            params, opt_state, jnp.asarray(x), jnp.asarray(y)
+            params, opt_state, x, y
         )
         losses.append(float(loss))
     assert losses[-1] < losses[0] * 0.1
@@ -159,6 +161,211 @@ def test_sampler_reshuffles_by_epoch():
     e1 = list(s)
     assert e0 != e1
     assert sorted(e0) == sorted(e1)
+
+
+# -- donation --------------------------------------------------------------
+
+
+def _run_trajectory(donate: bool, steps: int = 6):
+    x, y = _toy_data(32, seed=5)
+    params = {"w": jnp.zeros((8, 1)), "b": jnp.zeros((1,))}
+    opt = optax.adam(0.05)
+    tr = ElasticTrainer(
+        _mesh(2), _linear_loss, opt, global_batch_size=32,
+        micro_batch_size=4, donate_state=donate,
+    )
+    opt_state = opt.init(params)
+    losses = []
+    for _ in range(steps):
+        params, opt_state, loss = tr.train_step(
+            params, opt_state, x, y
+        )
+        losses.append(jax.device_get(loss))
+    return np.asarray(losses), jax.device_get(params["w"])
+
+
+def test_donation_numerics_parity():
+    """The in-place (donated) step must be BITWISE identical to the
+    copying step: donation changes buffer lifetime, not math."""
+    losses_d, w_d = _run_trajectory(donate=True)
+    losses_c, w_c = _run_trajectory(donate=False)
+    np.testing.assert_array_equal(losses_d, losses_c)
+    np.testing.assert_array_equal(w_d, w_c)
+
+
+def test_donation_deletes_inputs_and_escape_hatch():
+    x, y = _toy_data(32)
+    opt = optax.sgd(0.1)
+
+    def fresh():
+        p = {"w": jnp.ones((8, 1)), "b": jnp.zeros((1,))}
+        return p, opt.init(p)
+
+    tr = ElasticTrainer(
+        _mesh(2), _linear_loss, opt, global_batch_size=32,
+        micro_batch_size=4,
+    )
+    assert tr.donate_state  # in-place update is the default
+    params, opt_state = fresh()
+    old_w = params["w"]
+    tr.train_step(params, opt_state, x, y)
+    assert old_w.is_deleted()  # XLA really updated in place
+
+    tr2 = ElasticTrainer(
+        _mesh(2), _linear_loss, opt, global_batch_size=32,
+        micro_batch_size=4, donate_state=False,
+    )
+    params, opt_state = fresh()
+    tr2.train_step(params, opt_state, x, y)
+    assert not params["w"].is_deleted()  # escape hatch: alias freely
+    _ = params["w"] + 1
+
+
+# -- host-batch dispatch ---------------------------------------------------
+
+
+def test_host_batch_dispatch_by_type_not_rank():
+    """np.ndarray batches of ANY rank get staged; device arrays from
+    shard_microbatches are fed through untouched (no re-staging)."""
+
+    def loss3(params, x, y):  # x: [B, 4, 2] host batch (rank 3)
+        flat = x.reshape((x.shape[0], -1))
+        pred = flat @ params["w"] + params["b"]
+        return jnp.mean((pred - y) ** 2)
+
+    rng = np.random.default_rng(2)
+    x = rng.normal(size=(16, 4, 2)).astype(np.float32)
+    y = rng.normal(size=(16, 1)).astype(np.float32)
+    params = {"w": jnp.zeros((8, 1)), "b": jnp.zeros((1,))}
+    opt = optax.sgd(0.1)
+    tr = ElasticTrainer(
+        _mesh(2), loss3, opt, global_batch_size=16,
+        micro_batch_size=2, donate_state=False,
+    )
+    # rank-3 host batch: the old ndim==2 heuristic skipped staging
+    p1, _, l1 = tr.train_step(params, opt.init(params), x, y)
+
+    # pre-staged device arrays take the no-restage path, same result
+    tok, tgt = tr.shard_microbatches(x, y)
+    assert isinstance(tok, jax.Array)
+    p2, _, l2 = tr.train_step(params, opt.init(params), tok, tgt)
+    np.testing.assert_array_equal(
+        jax.device_get(l1), jax.device_get(l2)
+    )
+
+    # a flat [N, ...] DEVICE batch (the pre-change jnp.asarray calling
+    # convention) must fail loudly, pointing at shard_microbatches —
+    # not error deep in lax.scan or silently mis-microbatch
+    with pytest.raises(ValueError, match="pre-staged"):
+        tr.train_step(
+            params, opt.init(params), jnp.asarray(x), jnp.asarray(y)
+        )
+
+
+# -- async reporting -------------------------------------------------------
+
+
+def test_async_reporter_exactly_once_in_order():
+    from dlrover_tpu.trainer.async_metrics import AsyncScalarReporter
+
+    class Lazy:
+        """Device-scalar stand-in whose readiness we control."""
+
+        def __init__(self, v):
+            self.v = v
+            self.ready = False
+
+        def is_ready(self):
+            return self.ready
+
+        def __array__(self, dtype=None):  # jax.device_get fallback
+            return np.asarray(self.v, dtype=dtype)
+
+    got = []
+    rep = AsyncScalarReporter(
+        lambda step, v: got.append((step, v)), max_pending=3
+    )
+    vals = [Lazy(float(i)) for i in range(1, 7)]
+    for i, v in enumerate(vals, start=1):
+        rep.offer(i, v)
+    # nothing ready, deque bounded at 3: the oldest were force-drained
+    assert len(rep) == 3
+    assert [s for s, _ in got] == [1, 2, 3]
+    vals[3].ready = True  # step 4 finishes "on device"
+    rep.drain_ready()
+    assert [s for s, _ in got] == [1, 2, 3, 4]
+    assert rep.flush() == 2  # tail delivered at checkpoint/shutdown
+    assert [s for s, _ in got] == [1, 2, 3, 4, 5, 6]
+    assert [v for _, v in got] == [1.0, 2.0, 3.0, 4.0, 5.0, 6.0]
+    assert rep.flush() == 0  # idempotent: nothing re-emitted
+
+
+def test_trainer_reports_every_step_one_late_then_flush():
+    x, y = _toy_data(32)
+    params = {"w": jnp.zeros((8, 1)), "b": jnp.zeros((1,))}
+    opt = optax.sgd(0.1)
+    reports = []
+    tr = ElasticTrainer(
+        _mesh(2), _linear_loss, opt, global_batch_size=32,
+        micro_batch_size=4, report_fn=reports.append,
+    )
+    opt_state = opt.init(params)
+    for _ in range(5):
+        params, opt_state, _ = tr.train_step(params, opt_state, x, y)
+    tr.flush_metrics()
+    assert [r.step for r in reports] == [1, 2, 3, 4, 5]
+    assert all(np.isfinite(r.loss) for r in reports)
+    assert all(r.global_batch_size == 32 for r in reports)
+    tr.flush_metrics()  # no duplicates on a second flush
+    assert [r.step for r in reports] == [1, 2, 3, 4, 5]
+
+
+# -- zero-sync hot loop ----------------------------------------------------
+
+
+def test_hot_loop_no_host_sync_under_transfer_guard():
+    """Steady-state tripwire: with pre-staged inputs, train_step plus
+    async reporting performs NO device<->host transfer. Enforced two
+    ways — jax.transfer_guard("disallow") (live on real accelerators;
+    the CPU backend exempts same-memory transfers) and a patched
+    Array.__float__ that turns any implicit scalar fetch into an
+    error on every backend."""
+    from jax._src import array as jax_array
+
+    x, y = _toy_data(32)
+    params = {"w": jnp.zeros((8, 1)), "b": jnp.zeros((1,))}
+    opt = optax.adam(0.05)
+    reports = []
+    tr = ElasticTrainer(
+        _mesh(2), _linear_loss, opt, global_batch_size=32,
+        micro_batch_size=4, report_fn=reports.append,
+    )
+    opt_state = opt.init(params)
+    batches = [tr.shard_microbatches(x, y) for _ in range(4)]
+    # step 1 pays the compile; the guard covers steady state only
+    params, opt_state, _ = tr.train_step(params, opt_state, *batches[0])
+
+    def _boom(self):
+        raise AssertionError(
+            "implicit device->host sync (float(arr)) in the hot loop"
+        )
+
+    orig = jax_array.ArrayImpl.__float__
+    jax_array.ArrayImpl.__float__ = _boom
+    try:
+        with jax.transfer_guard("disallow"):
+            for tok, tgt in batches[1:]:
+                params, opt_state, loss = tr.train_step(
+                    params, opt_state, tok, tgt
+                )
+                assert isinstance(loss, jax.Array)
+            # the tripwire itself is live:
+            with pytest.raises(AssertionError, match="hot loop"):
+                float(loss)
+    finally:
+        jax_array.ArrayImpl.__float__ = orig
+    tr.flush_metrics()
+    assert [r.step for r in reports] == [1, 2, 3, 4]
 
 
 def test_dataloader_batches():

@@ -5,6 +5,10 @@ e.g. the per-host traces the chaos drills leave behind — and prints:
 
 * the reconstructed recovery timeline (obs/timeline.py), when the
   canonical trainer marks are present;
+* an input-pipeline summary (data-wait vs staging vs train wall
+  time) when trainer.prefetch_* events are present — the quick "is
+  the prefetch pipeline hiding input staging" check
+  (docs/PERFORMANCE.md);
 * a per-event-name table: count, and for span events total/mean
   duration, sorted by total time.
 
@@ -53,6 +57,63 @@ def metrics_table(events, top: int = 15) -> str:
     return "\n".join(lines)
 
 
+def input_pipeline_summary(events) -> str:
+    """Data-wait vs step time from the trainer.prefetch_* events.
+
+    ``trainer.prefetch_wait`` carries how long the train loop blocked
+    on the input queue per batch; ``trainer.prefetch_stage`` carries
+    the worker-side collate+H2D staging cost the pipeline is hiding.
+    With ``trainer.step`` events present, the wait is also put in
+    proportion to the training wall time. Returns "" when the trace
+    has no prefetch events (prefetch off or pre-pipeline trace).
+    """
+    waits = [
+        float(e.get("dur_s", 0.0))
+        for e in events
+        if e.get("name") == "trainer.prefetch_wait"
+    ]
+    stages = [
+        float(e.get("dur_s", 0.0))
+        for e in events
+        if e.get("name") == "trainer.prefetch_stage"
+    ]
+    if not waits and not stages:
+        return ""
+    lines = ["input pipeline (trainer.prefetch_*):"]
+    wait_total = sum(waits)
+    stage_total = sum(stages)
+    if waits:
+        lines.append(
+            f"  data-wait : {wait_total:9.3f}s total over "
+            f"{len(waits)} batches (mean {wait_total / len(waits):.4f}s)"
+        )
+    if stages:
+        lines.append(
+            f"  staging   : {stage_total:9.3f}s total over "
+            f"{len(stages)} batches (mean "
+            f"{stage_total / len(stages):.4f}s, overlapped with compute)"
+        )
+    if waits and stages:
+        lines.append(
+            f"  hidden    : {max(stage_total - wait_total, 0.0):9.3f}s "
+            "of staging overlapped behind compute"
+        )
+    step_ts = sorted(
+        e["ts"]
+        for e in events
+        if e.get("name") == "trainer.step" and "ts" in e
+    )
+    if waits and len(step_ts) >= 2:
+        span = step_ts[-1] - step_ts[0]
+        if span > 0:
+            lines.append(
+                f"  train span: {span:9.3f}s across "
+                f"{len(step_ts)} steps -> data-wait is "
+                f"{100.0 * wait_total / span:.1f}% of wall time"
+            )
+    return "\n".join(lines)
+
+
 def report(path: str, failure_ts=None, top: int = 15) -> int:
     events = [e for e in load_events(path) if "ts" in e]
     if not events:
@@ -67,6 +128,10 @@ def report(path: str, failure_ts=None, top: int = 15) -> int:
     if tl is not None:
         print()
         print(render_timeline(tl))
+    pipeline = input_pipeline_summary(events)
+    if pipeline:
+        print()
+        print(pipeline)
     print()
     print(metrics_table(events, top=top))
     return 0
@@ -85,6 +150,20 @@ def selftest() -> int:
         {"name": "trainer.first_step_done", "ts": t + 40.0},
         {"name": "trainer.step", "ts": t + 41.0, "step": 11},
         {"name": "trainer.throughput_recovered", "ts": t + 45.0},
+        # input pipeline shaped like a healthy prefetch: staging cost
+        # per batch is high, consumer wait is near zero
+        {"name": "trainer.prefetch_start", "ts": t + 40.0, "depth": 2},
+        {"name": "trainer.prefetch_stage", "ts": t + 40.1,
+         "dur_s": 0.5},
+        {"name": "trainer.prefetch_stage", "ts": t + 40.7,
+         "dur_s": 0.5},
+        {"name": "trainer.prefetch_wait", "ts": t + 41.0,
+         "dur_s": 0.01},
+        {"name": "trainer.prefetch_wait", "ts": t + 42.0,
+         "dur_s": 0.03},
+        {"name": "trainer.step", "ts": t + 43.0, "step": 12},
+        {"name": "trainer.prefetch_stop", "ts": t + 45.0,
+         "delivered": 2, "dropped": 0},
     ]
     tl = reconstruct_recovery_timeline(events)
     errors = []
@@ -113,6 +192,21 @@ def selftest() -> int:
             errors.append(f"total_s: want 45.0, got {tl.total_s}")
         render_timeline(tl)  # must not raise
         metrics_table(events)
+        pipeline = input_pipeline_summary(events)
+        if "data-wait" not in pipeline:
+            errors.append(f"no data-wait line in: {pipeline!r}")
+        if "0.040s total over 2 batches" not in pipeline:
+            errors.append(f"wrong wait total in: {pipeline!r}")
+        if "1.000s total over 2 batches" not in pipeline:
+            errors.append(f"wrong stage total in: {pipeline!r}")
+        if "0.960s" not in pipeline:  # hidden = stage - wait
+            errors.append(f"wrong hidden time in: {pipeline!r}")
+        if "data-wait is 2.0% of wall time" not in pipeline:
+            errors.append(f"wrong wall fraction in: {pipeline!r}")
+        if input_pipeline_summary(
+            [e for e in events if "prefetch" not in e["name"]]
+        ):
+            errors.append("pipeline summary not empty without events")
     if errors:
         print("obs selftest FAILED:")
         for e in errors:
