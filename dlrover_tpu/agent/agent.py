@@ -521,7 +521,7 @@ class ElasticAgent:
                     self._kill_proc()
                     return 1
                 self._restart_count += 1
-                self._restart_workers()
+                self._restart_workers(reason="hang")
                 hang.reset()
                 continue
             code = self._proc.poll() if self._proc else None
@@ -542,7 +542,7 @@ class ElasticAgent:
             if self._restart_requested.is_set():
                 self._restart_requested.clear()
                 logger.info("master requested restart")
-                self._restart_workers()
+                self._restart_workers(reason="master_request")
             elif self._membership_changed():
                 logger.info(
                     "membership changed; restarting training process "
@@ -587,10 +587,18 @@ class ElasticAgent:
             )
             return False
         self._restart_count += 1
-        self._restart_workers()
+        self._restart_workers(reason="process_exit")
         return True
 
-    def _restart_workers(self) -> None:
+    def _restart_workers(self, reason: str = "membership") -> None:
+        from dlrover_tpu import obs
+
+        obs.event(
+            "agent.worker_restart",
+            reason=reason,
+            restart_count=self._restart_count,
+            node_id=self.config.node_id,
+        )
         self._flush_ckpt_shm()
         self._kill_proc()
         self._spec = (

@@ -22,6 +22,7 @@ import os
 import time
 from typing import Optional
 
+from dlrover_tpu import obs
 from dlrover_tpu.agent.monitor import (
     default_metrics_file,
     METRICS_FILE_ENV,
@@ -29,6 +30,12 @@ from dlrover_tpu.agent.monitor import (
 from dlrover_tpu.common.log import get_logger
 
 logger = get_logger("hang_detector")
+
+_HANGS_TOTAL = obs.counter(
+    "dlrover_hang_detect_total",
+    "Training-process hangs detected by the agent (no step progress "
+    "within hang_timeout)",
+)
 
 
 class HangDetector:
@@ -56,6 +63,7 @@ class HangDetector:
         self._started_at = time.time()
         self._last_step = -1
         self._last_progress = time.time()
+        self._hang_reported = False
 
     def _read_step(self) -> Optional[int]:
         try:
@@ -74,11 +82,27 @@ class HangDetector:
         if step is not None and step != self._last_step:
             self._last_step = step
             self._last_progress = now
+            self._hang_reported = False
             return False
         if self._last_step < 0:
             # still compiling / warming up
-            return now - self._started_at > self.startup_grace
-        return now - self._last_progress > self.hang_timeout
+            hung = now - self._started_at > self.startup_grace
+        else:
+            hung = now - self._last_progress > self.hang_timeout
+        if hung and not self._hang_reported:
+            # Once per hang (reset()/progress re-arms): the fleet view
+            # and recovery timelines must see the hang, not just the
+            # restart it triggers.
+            self._hang_reported = True
+            _HANGS_TOTAL.inc()
+            obs.event(
+                "agent.hang_detected",
+                seconds_since_progress=round(
+                    self.seconds_since_progress(), 3
+                ),
+                last_step=self._last_step,
+            )
+        return hung
 
     def seconds_since_progress(self) -> float:
         return time.time() - self._last_progress

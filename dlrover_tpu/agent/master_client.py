@@ -305,6 +305,35 @@ class MasterClient:
         except Exception:  # noqa: BLE001
             pass
 
+    def report_metrics_snapshot(
+        self,
+        host: str,
+        registry: Optional[dict] = None,
+        resource: Optional[dict] = None,
+        step_times: Optional[list] = None,
+        events: Optional[list] = None,
+        timestamp: Optional[float] = None,
+    ):
+        """Ship this host's telemetry snapshot to the master's
+        FleetAggregator (ResourceMonitor cadence). Best-effort like
+        every other telemetry report."""
+        try:
+            self._client.report(
+                msg.MetricsSnapshotReport(
+                    node_id=self.node_id,
+                    host=host,
+                    timestamp=(
+                        timestamp if timestamp is not None else time.time()
+                    ),
+                    registry=registry or {},
+                    resource=resource or {},
+                    step_times=list(step_times or []),
+                    events=list(events or []),
+                )
+            )
+        except Exception:  # noqa: BLE001 - telemetry is best-effort
+            pass
+
     # -- PS-elastic sparse path ------------------------------------------
 
     @retry()

@@ -13,16 +13,22 @@ ConfigPath.RUNTIME_METRICS).
 
 from __future__ import annotations
 
+import collections
 import json
 import os
+import socket
 import threading
 import time
-from typing import Optional
+from typing import Dict, Optional
 
 from dlrover_tpu import obs
 from dlrover_tpu.common.log import get_logger
 
 logger = get_logger("agent_monitor")
+
+# How many of the trainer's most recent per-step wall times ride the
+# metrics file (and from there the master's fleet snapshot).
+RECENT_STEP_TIMES = 32
 
 METRICS_FILE_ENV = "DLROVER_TPU_METRICS_FILE"
 PHASES_FILE_ENV = "DLROVER_TPU_PHASES_FILE"
@@ -67,13 +73,54 @@ def current_resource_stats() -> dict:
 
 
 class ResourceMonitor:
-    """Samples resources and reports them to the master."""
+    """Samples resources and reports them to the master.
 
-    def __init__(self, client, interval: float = 30.0):
+    Each report also ships a fleet-telemetry snapshot: this process's
+    obs registry dump, the trainer's recent per-step wall times (read
+    from the step-metrics file the training process writes), a derived
+    tokens/s, and any tracer events new since the previous snapshot —
+    the agent half of the master's FleetAggregator."""
+
+    def __init__(
+        self,
+        client,
+        interval: float = 30.0,
+        metrics_file: Optional[str] = None,
+    ):
         self.client = client
         self.interval = interval
+        self.metrics_file = metrics_file or os.getenv(
+            METRICS_FILE_ENV, default_metrics_file()
+        )
+        self.host = (
+            os.getenv("DLROVER_TPU_HOST_IP", "")
+            or socket.gethostname()
+            or f"node{getattr(client, 'node_id', -1)}"
+        )
         self._stop = threading.Event()
         self._thread: Optional[threading.Thread] = None
+        # snapshot bookkeeping: send each step time / event only once
+        self._last_snapshot_step = -1
+        self._event_tracer = None
+        self._event_cursor = 0
+        # When the host traces to a file, EVERY process on the host
+        # (this agent AND the training process it supervises) appends
+        # to that one file — tailing it is how trainer-side spans
+        # (steps, ckpt stages, prefetch waits, compile marks) reach
+        # the master's goodput accountant.
+        from dlrover_tpu.obs.tracer import TRACE_FILE_ENV
+
+        self._trace_path = os.getenv(TRACE_FILE_ENV, "")
+        # Start at the file's CURRENT end: the sink appends across
+        # agent restarts, and the previous incarnation already shipped
+        # the history — replaying it would double-count goodput.
+        self._trace_offset = 0
+        if self._trace_path:
+            try:
+                self._trace_offset = os.path.getsize(self._trace_path)
+            except OSError:
+                pass
+        self._last_tokens: Optional[tuple] = None  # (ts, tokens)
 
     def start(self) -> None:
         if self._thread is None:
@@ -85,12 +132,142 @@ class ResourceMonitor:
     def stop(self) -> None:
         self._stop.set()
 
+    def _read_trainer_metrics(self) -> dict:
+        try:
+            with open(self.metrics_file) as f:
+                data = json.load(f)
+        except (OSError, ValueError):
+            return {}
+        return data if isinstance(data, dict) else {}
+
+    def _new_step_times(self, data: dict) -> list:
+        step = int(data.get("step", -1))
+        recent = [
+            float(t)
+            for t in data.get("recent_step_times", [])
+            if isinstance(t, (int, float)) and t > 0
+        ]
+        if step < 0:
+            return []
+        if step <= self._last_snapshot_step:
+            # Trainer restarted at a lower step: re-baseline.
+            if step < self._last_snapshot_step:
+                self._last_snapshot_step = step
+            return []
+        new = min(step - self._last_snapshot_step, len(recent))
+        self._last_snapshot_step = step
+        return recent[-new:] if new > 0 else []
+
+    def _tokens_per_s(self, data: dict) -> Optional[float]:
+        ts = data.get("ts")
+        tokens = data.get("tokens")
+        if ts is None or tokens is None:
+            return None
+        prev, self._last_tokens = self._last_tokens, (ts, tokens)
+        if prev is None:
+            return None
+        dt = float(ts) - float(prev[0])
+        dtok = float(tokens) - float(prev[1])
+        if dt <= 0 or dtok < 0:
+            return None
+        return dtok / dt
+
+    # Per-snapshot bound on tailed trace bytes / parsed events, so a
+    # chatty trainer cannot balloon one RPC.
+    MAX_TRACE_TAIL_BYTES = 1 << 20
+    MAX_EVENTS_PER_SNAPSHOT = 5000
+
+    def _tail_trace_events(self) -> list:
+        """New complete JSONL lines of the shared trace file since the
+        last snapshot (byte-offset cursor; resets on truncation)."""
+        try:
+            size = os.path.getsize(self._trace_path)
+        except OSError:
+            return []
+        if size < self._trace_offset:
+            self._trace_offset = 0  # file truncated/recreated
+        if size <= self._trace_offset:
+            return []
+        try:
+            with open(self._trace_path, "rb") as f:
+                f.seek(self._trace_offset)
+                chunk = f.read(self.MAX_TRACE_TAIL_BYTES)
+        except OSError:
+            return []
+        last_nl = chunk.rfind(b"\n")
+        if last_nl < 0:
+            return []  # torn line in flight; retry next snapshot
+        # Consume only as far as the event cap: the cursor must not
+        # skip lines this snapshot didn't ship — the surplus waits
+        # for the next snapshot instead of being dropped.
+        data = chunk[: last_nl + 1]
+        events = []
+        consumed = 0
+        while (
+            consumed < len(data)
+            and len(events) < self.MAX_EVENTS_PER_SNAPSHOT
+        ):
+            nl = data.index(b"\n", consumed)
+            line = data[consumed:nl]
+            consumed = nl + 1
+            if not line:
+                continue
+            try:
+                rec = json.loads(line)
+            except ValueError:
+                continue
+            if isinstance(rec, dict) and "name" in rec and "ts" in rec:
+                events.append(rec)
+        self._trace_offset += consumed
+        return events
+
+    def _new_events(self) -> list:
+        if self._trace_path:
+            # The in-memory ring would only cover this agent process;
+            # the file covers every process on the host (no dupes:
+            # agent events are in the file too, so the ring is
+            # skipped entirely).
+            return self._tail_trace_events()
+        tracer = obs.get_tracer()
+        if tracer is None:
+            return []
+        if tracer is not self._event_tracer:
+            # configure_tracer replaced the instance: restart the
+            # arrival cursor.
+            self._event_tracer = tracer
+            self._event_cursor = 0
+        events, self._event_cursor = tracer.events_since(
+            self._event_cursor
+        )
+        return events[-self.MAX_EVENTS_PER_SNAPSHOT:]
+
+    def build_snapshot(self, stats: Optional[dict] = None) -> dict:
+        """The MetricsSnapshotReport payload (sans node_id), exposed
+        for tests and for trainers that report their own registry."""
+        resource = dict(stats or current_resource_stats())
+        data = self._read_trainer_metrics()
+        tps = self._tokens_per_s(data)
+        if tps is not None:
+            resource["tokens_per_s"] = tps
+        return {
+            "host": self.host,
+            "registry": obs.get_registry().dump(),
+            "resource": resource,
+            "step_times": self._new_step_times(data),
+            "events": self._new_events(),
+        }
+
     def report_once(self) -> dict:
         stats = current_resource_stats()
         try:
             self.client.report_resource(**stats)
         except Exception:  # noqa: BLE001
             logger.debug("resource report failed", exc_info=True)
+        try:
+            self.client.report_metrics_snapshot(**self.build_snapshot(stats))
+        except Exception:  # noqa: BLE001 — fleet telemetry is
+            # best-effort (and test fakes may lack the method)
+            logger.debug("metrics snapshot failed", exc_info=True)
         return stats
 
     def _loop(self) -> None:
@@ -119,18 +296,40 @@ class TrainingMonitor:
         self._stop = threading.Event()
         self._thread: Optional[threading.Thread] = None
 
+    # Per-process rolling window of recent step wall times, keyed by
+    # metrics-file path (write_metrics is a staticmethod; the trainer
+    # process owns exactly one window per file).
+    _recent_step_times: Dict[str, "collections.deque"] = {}
+
     @staticmethod
     def write_metrics(
-        step: int, tokens: int = 0, path: Optional[str] = None
+        step: int,
+        tokens: int = 0,
+        path: Optional[str] = None,
+        step_time: Optional[float] = None,
     ) -> None:
         """Called from the TRAINING process each step (cheap: one
-        tmp-file rename)."""
+        tmp-file rename). ``step_time`` — this step's wall time, when
+        the loop measures it — accumulates into a rolling
+        ``recent_step_times`` window the agent forwards to the
+        master's straggler scorer."""
         obs.event("trainer.step", step=step, tokens=tokens)
         path = path or os.getenv(METRICS_FILE_ENV, default_metrics_file())
+        recent = TrainingMonitor._recent_step_times.setdefault(
+            path, collections.deque(maxlen=RECENT_STEP_TIMES)
+        )
+        if step_time is not None and step_time > 0:
+            recent.append(round(float(step_time), 6))
         tmp = f"{path}.tmp"
         with open(tmp, "w") as f:
             json.dump(
-                {"step": step, "tokens": tokens, "ts": time.time()}, f
+                {
+                    "step": step,
+                    "tokens": tokens,
+                    "ts": time.time(),
+                    "recent_step_times": list(recent),
+                },
+                f,
             )
         os.replace(tmp, path)
 

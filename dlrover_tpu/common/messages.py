@@ -287,6 +287,28 @@ class ResourceStats:
 
 
 @message
+class MetricsSnapshotReport:
+    """Agent -> master: one host's telemetry snapshot, shipped on the
+    ResourceMonitor cadence. ``registry`` is the host process's
+    ``MetricsRegistry.dump()``; ``step_times`` are the trainer's most
+    recent per-step wall times (from the step-metrics file);
+    ``events`` are tracer events new since the previous snapshot (how
+    trainer-side spans reach the master's goodput accountant). The
+    master's FleetAggregator merges these into host-labeled series.
+    """
+
+    node_id: int = -1
+    host: str = ""
+    timestamp: float = 0.0
+    registry: Dict[str, Any] = dataclasses.field(default_factory=dict)
+    resource: Dict[str, float] = dataclasses.field(default_factory=dict)
+    step_times: List[float] = dataclasses.field(default_factory=list)
+    events: List[Dict[str, Any]] = dataclasses.field(
+        default_factory=list
+    )
+
+
+@message
 class NodeFailureReport:
     node_id: int = -1
     error_data: str = ""

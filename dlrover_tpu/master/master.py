@@ -75,6 +75,16 @@ class JobMaster:
         from dlrover_tpu.master.ps_manager import PsManager
 
         self.ps_manager = PsManager()
+        # Fleet telemetry: goodput accountant + per-host snapshot
+        # aggregator, rendered into the same registry the /metrics
+        # endpoint and MetricsRequest RPC serve.
+        from dlrover_tpu.obs.fleet import FleetAggregator
+        from dlrover_tpu.obs.goodput import GoodputAccountant
+
+        self.goodput = GoodputAccountant()
+        self.fleet = FleetAggregator(
+            speed_monitor=self.speed_monitor, goodput=self.goodput
+        )
         self.elastic_rdzv = ElasticRendezvous()
         self.check_rdzv = NetworkCheckRendezvous()
         for rdzv in (self.elastic_rdzv, self.check_rdzv):
@@ -92,6 +102,7 @@ class JobMaster:
             kv_store=self.kv_store,
             speed_monitor=self.speed_monitor,
             ps_manager=self.ps_manager,
+            fleet=self.fleet,
         )
         # PS-strategy auto-scaling starts on demand (sparse/CTR jobs):
         # master.start_ps_autoscaler() wires the hot-PS optimizer to
@@ -130,6 +141,13 @@ class JobMaster:
             return
         self.task_manager.recover_node_tasks(node.id)
         self.speed_monitor.remove_running_node(node.id)
+        # Departed node: its metric snapshot must leave the fleet view
+        # now, not after the TTL; its loss is badput until the fleet
+        # steps again.
+        self.fleet.remove_node(node.id)
+        self.goodput.add_events(
+            [{"name": "node.gone", "ts": time.time(), "node_id": node.id}]
+        )
         # Only training-world roles ever entered the rendezvous (the
         # register path skips evaluators and data workers, and PS
         # hosts register via their own RPC): removing one here would
@@ -255,6 +273,9 @@ class JobMaster:
         if self.metrics_server is not None:
             self.metrics_server.stop()
             self.metrics_server = None
+        # Unhook the fleet collector from the (process-global)
+        # registry so a stopped master stops contributing lines.
+        self.fleet.close()
         self._server.stop(0)
 
 

@@ -35,6 +35,7 @@ class MasterServicer:
         kv_store: Optional[KVStoreService] = None,
         speed_monitor: Optional[SpeedMonitor] = None,
         ps_manager=None,
+        fleet=None,
     ):
         self.job_manager = job_manager
         self.task_manager = task_manager
@@ -51,6 +52,20 @@ class MasterServicer:
 
             ps_manager = PsManager()
         self.ps_manager = ps_manager
+        # Fleet telemetry: merges per-host metric snapshots into the
+        # master registry (host labels + cross-host aggregates). The
+        # JobMaster passes its (render-attached) aggregator and closes
+        # it on stop; a bare servicer (tests, embedded use) has no
+        # stop hook, so its default stays DETACHED from the global
+        # registry's render — snapshots still feed the speed monitor
+        # and straggler verdicts.
+        if fleet is None:
+            from dlrover_tpu.obs.fleet import FleetAggregator
+
+            fleet = FleetAggregator(
+                speed_monitor=self.speed_monitor, attach=False
+            )
+        self.fleet = fleet
         # actions queued for agents, popped on heartbeat
         self._pending_actions: dict[int, str] = {}
         # auto-tuner output pulled by agents (ref: master-pushed
@@ -87,6 +102,7 @@ class MasterServicer:
         r(msg.NetworkCheckResultRequest, self._report_network_result)
         r(msg.StepReport, self._report_step)
         r(msg.ResourceStats, self._report_resource)
+        r(msg.MetricsSnapshotReport, self._report_metrics_snapshot)
         r(msg.NodeFailureReport, self._report_failure)
         r(msg.NodeSucceededReport, self._report_succeeded)
         r(msg.HeartbeatRequest, self._heartbeat)
@@ -128,6 +144,25 @@ class MasterServicer:
         mgr = self.rdzv_managers[RendezvousName.NETWORK_CHECK]
         if req.kind == "straggler":
             nodes, reason = mgr.get_stragglers()
+            # The check-time verdict only sees the pre-training
+            # benchmark; the speed monitor scores live step times, so
+            # a node that slowed down mid-run still surfaces here.
+            # The check rendezvous speaks RANKS while the speed
+            # monitor is keyed by node id — translate before the
+            # union, or a relaunched node's id could flag whichever
+            # healthy agent happens to hold that rank.
+            slow = []
+            for nid in self.speed_monitor.stragglers():
+                node = self.job_manager.get_node(nid)
+                slow.append(
+                    node.rank
+                    if node is not None and node.rank >= 0
+                    else nid
+                )
+            if slow:
+                nodes = sorted(set(nodes) | set(slow))
+                if reason == "waiting":
+                    reason = ""
         else:
             nodes, reason = mgr.check_fault_nodes()
         return msg.NetworkCheckQueryResponse(nodes=nodes, reason=reason)
@@ -200,7 +235,21 @@ class MasterServicer:
         ts = req.timestamp or time.time()
         self.speed_monitor.collect_global_step(req.step, ts, req.tokens)
         if req.node_id >= 0:
-            self.speed_monitor.collect_node_step(req.node_id, req.step)
+            self.speed_monitor.collect_node_step(
+                req.node_id, req.step, timestamp=ts
+            )
+        # Mirror the step into the goodput stream: this is how
+        # productive time (and recovery closure) is accounted even
+        # when host-side tracing is off and snapshots carry no events.
+        if self.fleet.goodput is not None:
+            self.fleet.goodput.add_events(
+                [{"name": "trainer.step", "ts": ts,
+                  "step": req.step, "node_id": req.node_id}]
+            )
+        return None
+
+    def _report_metrics_snapshot(self, req: msg.MetricsSnapshotReport):
+        self.fleet.ingest(req)
         return None
 
     def _report_resource(self, req: msg.ResourceStats):
@@ -226,6 +275,17 @@ class MasterServicer:
         self.speed_monitor.remove_running_node(req.node_id)
         for mgr in self.rdzv_managers.values():
             mgr.remove_alive_node(req.node_id, node_rank=rank)
+        # The failure opens a recovery interval in the goodput
+        # accounting; the matching trainer.first_step_done arrives in
+        # a later agent snapshot's event payload.
+        if self.fleet.goodput is not None:
+            self.fleet.goodput.add_events(
+                [{
+                    "name": "node.fail",
+                    "ts": time.time(),
+                    "node_id": req.node_id,
+                }]
+            )
         return msg.NodeFailureResponse(action=action)
 
     def _report_succeeded(self, req: msg.NodeSucceededReport):

@@ -21,6 +21,13 @@ doing through this package, so "what is the job doing right now" and
   assert on.
 * :mod:`dlrover_tpu.obs.exposition` — a stdlib HTTP server giving the
   master a ``GET /metrics`` Prometheus endpoint.
+* :mod:`dlrover_tpu.obs.fleet` — the master-side
+  :class:`FleetAggregator` merging per-host registry snapshots
+  (shipped by agents over the control plane) into host-labeled series
+  and cross-host aggregates, with TTL age-out for departed nodes.
+* :mod:`dlrover_tpu.obs.goodput` — exhaustive goodput/badput wall-time
+  attribution (productive / compile / data_wait / checkpoint /
+  recovery / idle_unknown) over the job's event stream.
 
 The functions re-exported here are the instrumentation surface the
 rest of the codebase uses::
@@ -52,4 +59,11 @@ from dlrover_tpu.obs.tracer import (  # noqa: F401
     get_tracer,
     span,
     tracing_enabled,
+)
+from dlrover_tpu.obs.fleet import FleetAggregator  # noqa: F401
+from dlrover_tpu.obs.goodput import (  # noqa: F401
+    GoodputAccountant,
+    GoodputReport,
+    attribute_goodput,
+    render_goodput,
 )

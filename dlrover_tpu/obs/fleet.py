@@ -1,0 +1,342 @@
+"""Fleet-level metric aggregation on the master.
+
+Each agent periodically ships a ``MetricsSnapshotReport`` — a
+serialized dump of its process-local obs registry
+(``MetricsRegistry.dump()``) plus resource stats, recent per-step
+timings, and new tracer events — over the existing control-plane
+channel (``MasterClient.report_metrics_snapshot``, on the
+ResourceMonitor cadence). The :class:`FleetAggregator` here merges
+those snapshots into the master's registry so the master's
+``/metrics`` endpoint and the ``MetricsRequest`` RPC answer for the
+*job*, not one process:
+
+* every host series is re-rendered with a ``host`` label
+  (``dlrover_train_steps_total{host="w0"} ...``);
+* cross-host aggregates (sum/min/max/p50/p90) are computed for the
+  key series — step time, tokens/s, data-wait, host-syncs — as
+  ``dlrover_fleet_series{series,stat}``;
+* snapshots from departed nodes age out after ``ttl`` seconds (and
+  are dropped immediately when the master sees the node die), so a
+  scrape never shows ghosts.
+
+The aggregator renders through a registry *collector* (see
+``MetricsRegistry.add_collector``) instead of writing into typed
+metric objects: counters cannot be set backwards, and collector
+rendering makes age-out trivially correct — a pruned host simply
+stops producing lines.
+
+Event payloads are forwarded to the goodput accountant
+(:mod:`dlrover_tpu.obs.goodput`) and per-step timings to the speed
+monitor's straggler scorer, which is how trainer-side spans reach the
+master's job-level accounting.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import threading
+import time
+from typing import Dict, List, Optional
+
+from dlrover_tpu.obs.metrics import (
+    MetricsRegistry,
+    _escape_label_value,
+    _format_value,
+    get_registry,
+)
+
+# Key series the fleet view aggregates across hosts, and the stats
+# computed for each. Values are per-host scalars extracted from the
+# snapshot (see _host_scalar).
+KEY_SERIES = (
+    "step_time_s",
+    "tokens_per_s",
+    "data_wait_s_total",
+    "host_syncs_total",
+)
+STATS = ("sum", "min", "max", "p50", "p90")
+
+DEFAULT_TTL = 90.0  # 3x the default ResourceMonitor cadence
+
+
+def _percentile(values: List[float], q: float) -> float:
+    """Nearest-rank percentile (q in [0, 100]) on a sorted copy."""
+    if not values:
+        return 0.0
+    ordered = sorted(values)
+    rank = max(
+        0, min(len(ordered) - 1, int(round(q / 100.0 * (len(ordered) - 1))))
+    )
+    return ordered[rank]
+
+
+@dataclasses.dataclass
+class HostSnapshot:
+    host: str
+    node_id: int
+    wall_ts: float
+    received_mono: float
+    registry: Dict[str, dict]
+    resource: Dict[str, float]
+    step_times: List[float]
+
+
+class FleetAggregator:
+    """Merges per-host registry snapshots into one fleet view."""
+
+    def __init__(
+        self,
+        registry: Optional[MetricsRegistry] = None,
+        speed_monitor=None,
+        goodput=None,
+        ttl: float = DEFAULT_TTL,
+        attach: bool = True,
+    ):
+        """``attach=False`` skips hooking :meth:`collect` into the
+        registry's render — for owners that cannot guarantee a
+        matching :meth:`close` (a collector left on the process-global
+        registry would render forever)."""
+        self.registry = registry or get_registry()
+        self.speed_monitor = speed_monitor
+        self.goodput = goodput
+        self.ttl = ttl
+        self._lock = threading.Lock()
+        self._hosts: Dict[str, HostSnapshot] = {}
+        self._node_to_host: Dict[int, str] = {}
+        if attach:
+            self.registry.add_collector(self.collect)
+
+    def close(self) -> None:
+        self.registry.remove_collector(self.collect)
+
+    # -- ingest -----------------------------------------------------------
+
+    def ingest(self, report) -> HostSnapshot:
+        """Absorb one ``MetricsSnapshotReport`` (duck-typed: anything
+        with host/node_id/timestamp/registry/resource/step_times[/
+        events] attributes)."""
+        host = str(getattr(report, "host", "") or "")
+        node_id = int(getattr(report, "node_id", -1))
+        if not host:
+            host = f"node{node_id}"
+        snap = HostSnapshot(
+            host=host,
+            node_id=node_id,
+            wall_ts=float(getattr(report, "timestamp", 0.0) or time.time()),
+            received_mono=time.monotonic(),
+            registry=dict(getattr(report, "registry", None) or {}),
+            resource=dict(getattr(report, "resource", None) or {}),
+            step_times=[
+                float(t)
+                for t in (getattr(report, "step_times", None) or [])
+            ],
+        )
+        with self._lock:
+            self._hosts[host] = snap
+            if node_id >= 0:
+                self._node_to_host[node_id] = host
+        if self.speed_monitor is not None and snap.step_times:
+            for t in snap.step_times:
+                self.speed_monitor.observe_host_step_time(node_id, t)
+        events = getattr(report, "events", None) or []
+        if self.goodput is not None:
+            if events:
+                self.goodput.add_events(events)
+            # Refresh the goodput gauges even for event-less
+            # snapshots: with host tracing off, the accountant's
+            # stream is fed by the servicer (step reports, failures)
+            # and this is its recompute tick (debounced internally).
+            self.goodput.account()
+        return snap
+
+    def remove_node(self, node_id: int) -> None:
+        """Drop a departed node's snapshot immediately (the TTL is
+        only the backstop for nodes that die without a master event)."""
+        with self._lock:
+            host = self._node_to_host.pop(node_id, None)
+            if host is not None:
+                self._hosts.pop(host, None)
+
+    def remove_host(self, host: str) -> None:
+        with self._lock:
+            snap = self._hosts.pop(host, None)
+            if snap is not None:
+                self._node_to_host.pop(snap.node_id, None)
+
+    def _live_locked(self) -> List[HostSnapshot]:
+        now = time.monotonic()
+        stale = [
+            h
+            for h, s in self._hosts.items()
+            if now - s.received_mono > self.ttl
+        ]
+        for h in stale:
+            snap = self._hosts.pop(h)
+            self._node_to_host.pop(snap.node_id, None)
+        return list(self._hosts.values())
+
+    def live_snapshots(self) -> List[HostSnapshot]:
+        with self._lock:
+            return self._live_locked()
+
+    def hosts(self) -> List[str]:
+        return sorted(s.host for s in self.live_snapshots())
+
+    # -- aggregation ------------------------------------------------------
+
+    @staticmethod
+    def _host_scalar(snap: HostSnapshot, series: str) -> Optional[float]:
+        def hist(name):
+            md = snap.registry.get(name)
+            if not md or md.get("type") != "histogram":
+                return None
+            total = sum(row[2] for row in md.get("series", []))
+            count = sum(row[3] for row in md.get("series", []))
+            return total, count
+
+        if series == "step_time_s":
+            if snap.step_times:
+                return sum(snap.step_times) / len(snap.step_times)
+            h = hist("dlrover_train_step_seconds")
+            if h and h[1] > 0:
+                return h[0] / h[1]
+            return None
+        if series == "tokens_per_s":
+            v = snap.resource.get("tokens_per_s")
+            return float(v) if v is not None else None
+        if series == "data_wait_s_total":
+            h = hist("dlrover_train_data_wait_seconds")
+            return h[0] if h else None
+        if series == "host_syncs_total":
+            md = snap.registry.get("dlrover_train_host_syncs_total")
+            if not md or md.get("type") != "counter":
+                return None
+            return float(
+                sum(row[1] for row in md.get("series", []))
+            )
+        return None
+
+    def aggregates(self) -> Dict[str, Dict[str, float]]:
+        """``{series: {stat: value}}`` over live hosts; a series with
+        no reporting host is omitted."""
+        snaps = self.live_snapshots()
+        out: Dict[str, Dict[str, float]] = {}
+        for series in KEY_SERIES:
+            values = [
+                v
+                for v in (
+                    self._host_scalar(s, series) for s in snaps
+                )
+                if v is not None
+            ]
+            if not values:
+                continue
+            out[series] = {
+                "sum": sum(values),
+                "min": min(values),
+                "max": max(values),
+                "p50": _percentile(values, 50.0),
+                "p90": _percentile(values, 90.0),
+            }
+        return out
+
+    # -- exposition -------------------------------------------------------
+
+    def _series_line(
+        self,
+        name: str,
+        key: List[str],
+        labelnames: List[str],
+        value: float,
+        host: str,
+        suffix: str = "",
+        extra: str = "",
+    ) -> str:
+        pairs = [
+            f'{ln}="{_escape_label_value(lv)}"'
+            for ln, lv in zip(labelnames, key)
+        ]
+        pairs.append(f'host="{_escape_label_value(host)}"')
+        if extra:
+            pairs.append(extra)
+        return (
+            f"{name}{suffix}{{{','.join(pairs)}}} "
+            f"{_format_value(value)}"
+        )
+
+    def _metric_lines(
+        self, name: str, md: dict, host: str
+    ) -> List[str]:
+        labelnames = list(md.get("labelnames", []))
+        mtype = md.get("type", "gauge")
+        lines: List[str] = []
+        if mtype in ("counter", "gauge"):
+            for key, value in md.get("series", []):
+                lines.append(
+                    self._series_line(
+                        name, list(key), labelnames, float(value), host
+                    )
+                )
+            return lines
+        if mtype == "histogram":
+            bounds = [float(b) for b in md.get("buckets", [])]
+            bounds.append(float("inf"))
+            for key, counts, total, count in md.get("series", []):
+                for bound, c in zip(bounds, counts):
+                    lines.append(
+                        self._series_line(
+                            name, list(key), labelnames, float(c),
+                            host, suffix="_bucket",
+                            extra=f'le="{_format_value(bound)}"',
+                        )
+                    )
+                lines.append(
+                    self._series_line(
+                        name, list(key), labelnames, float(total),
+                        host, suffix="_sum",
+                    )
+                )
+                lines.append(
+                    self._series_line(
+                        name, list(key), labelnames, float(count),
+                        host, suffix="_count",
+                    )
+                )
+        return lines
+
+    def collect(self) -> List[str]:
+        """Registry collector: host-labeled series + fleet aggregates.
+        Runs inside ``registry.render()`` for every scrape."""
+        snaps = self.live_snapshots()
+        lines: List[str] = []
+        # TYPE headers only for names the master registry does not
+        # already expose (those already got their header above us).
+        known = set(self.registry.names())
+        typed: set = set()
+        for snap in sorted(snaps, key=lambda s: s.host):
+            for name in sorted(snap.registry):
+                md = snap.registry[name]
+                if name not in known and name not in typed:
+                    help_ = str(md.get("help", "") or "")
+                    if help_:
+                        lines.append(f"# HELP {name} {help_}")
+                    lines.append(
+                        f"# TYPE {name} {md.get('type', 'gauge')}"
+                    )
+                    typed.add(name)
+                lines.extend(self._metric_lines(name, md, snap.host))
+        lines.append(
+            "# TYPE dlrover_fleet_hosts gauge"
+        )
+        lines.append(f"dlrover_fleet_hosts {len(snaps)}")
+        aggs = self.aggregates()
+        if aggs:
+            lines.append("# TYPE dlrover_fleet_series gauge")
+            for series in sorted(aggs):
+                for stat in STATS:
+                    lines.append(
+                        f'dlrover_fleet_series{{series="{series}",'
+                        f'stat="{stat}"}} '
+                        f"{_format_value(aggs[series][stat])}"
+                    )
+        return lines

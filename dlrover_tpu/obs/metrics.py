@@ -83,6 +83,18 @@ class _Metric:
     def render(self) -> List[str]:
         raise NotImplementedError
 
+    def dump(self) -> dict:
+        raise NotImplementedError
+
+    def remove(self, **labels) -> None:
+        """Drop one labeled series (departed host, retired node)."""
+        key = self._key(labels)
+        store = getattr(self, "_values", None)
+        if store is None:
+            store = getattr(self, "_series")
+        with self._lock:
+            store.pop(key, None)
+
 
 class Counter(_Metric):
     type_name = "counter"
@@ -111,6 +123,16 @@ class Counter(_Metric):
             f"{self._series_name(k)} {_format_value(v)}"
             for k, v in items
         ]
+
+    def dump(self) -> dict:
+        with self._lock:
+            series = [[list(k), v] for k, v in self._values.items()]
+        return {
+            "type": self.type_name,
+            "help": self.help,
+            "labelnames": list(self.labelnames),
+            "series": series,
+        }
 
 
 class Gauge(_Metric):
@@ -146,6 +168,8 @@ class Gauge(_Metric):
             f"{self._series_name(k)} {_format_value(v)}"
             for k, v in items
         ]
+
+    dump = Counter.dump
 
 
 class Histogram(_Metric):
@@ -206,6 +230,22 @@ class Histogram(_Metric):
             lines.append(f"{self._series_name(key, '_count')} {n}")
         return lines
 
+    def dump(self) -> dict:
+        with self._lock:
+            series = [
+                [list(k), list(c), s, n]
+                for k, (c, s, n) in self._series.items()
+            ]
+        return {
+            "type": self.type_name,
+            "help": self.help,
+            "labelnames": list(self.labelnames),
+            # +Inf is implied by the renderer; keep the dump msgpack-
+            # friendly (inf is not representable in JSON either).
+            "buckets": [b for b in self.buckets if b != math.inf],
+            "series": series,
+        }
+
 
 class MetricsRegistry:
     """Holds named metrics; the factory methods are idempotent."""
@@ -213,6 +253,11 @@ class MetricsRegistry:
     def __init__(self):
         self._lock = threading.Lock()
         self._metrics: Dict[str, _Metric] = {}
+        # Collectors append extra exposition lines at render time
+        # (e.g. the master's FleetAggregator rendering host-labeled
+        # series from agent snapshots). A collector returns a list of
+        # text lines; a raising collector is skipped, never fatal.
+        self._collectors: List = []
 
     def _get_or_create(self, cls, name, help, labelnames, **kw):
         with self._lock:
@@ -261,18 +306,48 @@ class MetricsRegistry:
         with self._lock:
             self._metrics.pop(name, None)
 
+    def add_collector(self, fn) -> None:
+        """Register a callable returning extra exposition lines."""
+        with self._lock:
+            if fn not in self._collectors:
+                self._collectors.append(fn)
+
+    def remove_collector(self, fn) -> None:
+        with self._lock:
+            if fn in self._collectors:
+                self._collectors.remove(fn)
+
+    def names(self) -> List[str]:
+        with self._lock:
+            return sorted(self._metrics)
+
+    def dump(self) -> Dict[str, dict]:
+        """Serializable snapshot of every metric (msgpack/JSON-able):
+        ``{name: {type, help, labelnames, series, [buckets]}}`` — the
+        payload an agent ships to the master's FleetAggregator."""
+        with self._lock:
+            metrics = list(self._metrics.values())
+        return {m.name: m.dump() for m in metrics}
+
     def render(self) -> str:
         """Prometheus text exposition format (0.0.4)."""
         with self._lock:
             metrics = sorted(
                 self._metrics.values(), key=lambda m: m.name
             )
+            collectors = list(self._collectors)
         lines: List[str] = []
         for m in metrics:
             if m.help:
                 lines.append(f"# HELP {m.name} {m.help}")
             lines.append(f"# TYPE {m.name} {m.type_name}")
             lines.extend(m.render())
+        for fn in collectors:
+            try:
+                lines.extend(fn())
+            except Exception:  # noqa: BLE001 — a broken collector
+                # must never take the /metrics endpoint down.
+                pass
         return "\n".join(lines) + "\n"
 
 

@@ -180,6 +180,11 @@ def reconstruct_recovery_timeline(
     if (
         throughput_recovered_ts is not None
         and "trainer.first_step_done" in marks
+        # A recovery stamp that predates the first step is from a
+        # previous attempt (or a caller bug): a negative phase would
+        # poison budget checks, so the phase stays unknown instead.
+        and throughput_recovered_ts
+        >= marks["trainer.first_step_done"]
     ):
         phases["throughput-90"] = (
             throughput_recovered_ts - marks["trainer.first_step_done"]

@@ -110,6 +110,11 @@ class EventTracer:
         self._ring: collections.deque = collections.deque(
             maxlen=ring_size
         )
+        # Total events ever emitted: the arrival-order cursor for
+        # events_since (a mono-timestamp watermark would silently drop
+        # spans, which are emitted at exit but stamped with their
+        # START mono).
+        self._count = 0
         self._file = None
         self._local = threading.local()
         if sink_path:
@@ -137,6 +142,7 @@ class EventTracer:
         }
         with self._lock:
             self._ring.append(record)
+            self._count += 1
             if self._file is not None:
                 try:
                     self._file.write(
@@ -156,6 +162,19 @@ class EventTracer:
     def events(self) -> List[dict]:
         with self._lock:
             return list(self._ring)
+
+    def events_since(self, cursor: int):
+        """``(new_events, next_cursor)`` in ARRIVAL order. ``cursor``
+        is the value returned by the previous call (0 to start).
+        Events that fell off the bounded ring before being read are
+        lost; a cursor from a replaced tracer (> count) resets."""
+        with self._lock:
+            count = self._count
+            if cursor < 0 or cursor > count:
+                cursor = max(0, count - len(self._ring))
+            new = count - max(cursor, count - len(self._ring))
+            events = list(self._ring)[-new:] if new > 0 else []
+            return events, count
 
     def close(self) -> None:
         with self._lock:

@@ -236,17 +236,25 @@ class Trainer:
         last_eval, last_eval_step = None, -1
         t0 = time.time()
         step = start_step
+        prev_step_t = time.time()
         try:
             for step in range(start_step + 1, args.max_steps + 1):
                 tokens, targets = next(batches)
                 params, opt_state, last_loss = trainer.train_step(
                     params, opt_state, tokens, targets
                 )
+                # Per-step wall time (dispatch pacing, same caveat as
+                # dlrover_train_step_seconds): rides the metrics file
+                # to the agent and on to the master's straggler
+                # scorer, so relative slowness is comparable fleetwide.
+                now_t = time.time()
+                step_wall, prev_step_t = now_t - prev_step_t, now_t
                 TrainingMonitor.write_metrics(
                     step,
                     tokens=step
                     * args.global_batch_size
                     * tokens.shape[-1],
+                    step_time=step_wall,
                 )
                 if step % args.log_steps == 0:
                     logger.info(

@@ -1,0 +1,524 @@
+"""Fleet telemetry: snapshot aggregation, goodput accounting,
+straggler scoring.
+
+Covers the acceptance criteria of the fleet-telemetry PR:
+
+* the goodput accountant is exhaustive and exclusive — on synthetic
+  traces with known phase durations the bucket sums equal total wall
+  time (property-tested over randomized streams);
+* the master's /metrics endpoint and MetricsRequest RPC serve
+  host-labeled aggregated series from >= 2 simulated agent snapshots,
+  with departed hosts aged out;
+* query_stragglers returns a host that is artificially slowed, and
+  ``node.straggler`` appears in the event stream.
+"""
+
+import random
+import time
+from types import SimpleNamespace
+
+import pytest
+
+from dlrover_tpu import obs
+from dlrover_tpu.common import messages as msg
+from dlrover_tpu.common.comm import RpcClient
+from dlrover_tpu.master.master import JobMaster
+from dlrover_tpu.master.speed_monitor import SpeedMonitor
+from dlrover_tpu.obs.fleet import FleetAggregator, _percentile
+from dlrover_tpu.obs.goodput import (
+    CATEGORIES,
+    GoodputAccountant,
+    attribute_goodput,
+    render_goodput,
+)
+from dlrover_tpu.obs.metrics import MetricsRegistry
+
+
+def make_snapshot(node_id, host, step_times=(), syncs=0.0,
+                  tokens_per_s=None, events=(), registry=None):
+    if registry is None:
+        registry = {
+            "dlrover_train_steps_total": {
+                "type": "counter", "help": "steps this process",
+                "labelnames": [], "series": [[[], 100 + node_id]],
+            },
+            "dlrover_train_host_syncs_total": {
+                "type": "counter", "help": "host syncs",
+                "labelnames": ["reason"],
+                "series": [[["log"], syncs]],
+            },
+            "dlrover_train_step_seconds": {
+                "type": "histogram", "help": "step seconds",
+                "labelnames": [], "buckets": [0.1, 1.0],
+                "series": [[[], [1, 2, 2], 0.6, 2]],
+            },
+        }
+    resource = {"cpu_percent": 10.0 + node_id}
+    if tokens_per_s is not None:
+        resource["tokens_per_s"] = tokens_per_s
+    return SimpleNamespace(
+        node_id=node_id,
+        host=host,
+        timestamp=time.time(),
+        registry=registry,
+        resource=resource,
+        step_times=list(step_times),
+        events=list(events),
+    )
+
+
+class TestFleetAggregator:
+    def test_host_labeled_series_and_aggregates(self):
+        reg = MetricsRegistry()
+        fleet = FleetAggregator(registry=reg, ttl=3600.0)
+        fleet.ingest(make_snapshot(0, "h0", step_times=[0.1, 0.1],
+                                   syncs=3, tokens_per_s=1200.0))
+        fleet.ingest(make_snapshot(1, "h1", step_times=[0.4, 0.4],
+                                   syncs=5, tokens_per_s=800.0))
+        body = reg.render()
+        assert 'dlrover_train_steps_total{host="h0"} 100' in body
+        assert 'dlrover_train_steps_total{host="h1"} 101' in body
+        # histogram series re-rendered with the host label
+        assert (
+            'dlrover_train_step_seconds_bucket{host="h0",le="0.1"} 1'
+            in body
+        )
+        assert 'dlrover_train_step_seconds_sum{host="h1"} 0.6' in body
+        assert "dlrover_fleet_hosts 2" in body
+        aggs = fleet.aggregates()
+        assert aggs["step_time_s"]["min"] == pytest.approx(0.1)
+        assert aggs["step_time_s"]["max"] == pytest.approx(0.4)
+        assert aggs["host_syncs_total"]["sum"] == pytest.approx(8.0)
+        assert aggs["tokens_per_s"]["sum"] == pytest.approx(2000.0)
+        fleet.close()
+
+    def test_reingest_replaces_not_accumulates(self):
+        reg = MetricsRegistry()
+        fleet = FleetAggregator(registry=reg, ttl=3600.0)
+        fleet.ingest(make_snapshot(0, "h0", syncs=3))
+        fleet.ingest(make_snapshot(0, "h0", syncs=9))
+        body = reg.render()
+        assert (
+            'dlrover_train_host_syncs_total{reason="log",host="h0"} 9'
+            in body
+        )
+        assert (
+            'dlrover_train_host_syncs_total{reason="log",host="h0"} 3'
+            not in body
+        )
+        assert "dlrover_fleet_hosts 1" in body
+        fleet.close()
+
+    def test_departed_hosts_age_out_by_ttl(self):
+        reg = MetricsRegistry()
+        fleet = FleetAggregator(registry=reg, ttl=0.05)
+        fleet.ingest(make_snapshot(0, "h0"))
+        assert fleet.hosts() == ["h0"]
+        time.sleep(0.1)
+        assert fleet.hosts() == []
+        assert 'host="h0"' not in reg.render()
+        fleet.close()
+
+    def test_remove_node_drops_immediately(self):
+        reg = MetricsRegistry()
+        fleet = FleetAggregator(registry=reg, ttl=3600.0)
+        fleet.ingest(make_snapshot(0, "h0"))
+        fleet.ingest(make_snapshot(1, "h1"))
+        fleet.remove_node(0)
+        assert fleet.hosts() == ["h1"]
+        assert 'host="h0"' not in reg.render()
+        fleet.close()
+
+    def test_step_times_feed_speed_monitor(self):
+        sm = SpeedMonitor(min_straggler_hosts=2)
+        fleet = FleetAggregator(
+            registry=MetricsRegistry(), speed_monitor=sm, ttl=3600.0
+        )
+        fleet.ingest(make_snapshot(0, "h0", step_times=[0.2, 0.2]))
+        assert sm.host_step_ewma()[0] == pytest.approx(0.2)
+        fleet.close()
+
+    def test_events_feed_goodput(self):
+        reg = MetricsRegistry()
+        gp = GoodputAccountant(registry=reg)
+        fleet = FleetAggregator(registry=reg, goodput=gp, ttl=3600.0)
+        t = 1000.0
+        fleet.ingest(make_snapshot(0, "h0", events=[
+            {"name": "trainer.step", "ts": t},
+            {"name": "trainer.step", "ts": t + 2.0},
+        ]))
+        body = reg.render()
+        assert (
+            'dlrover_goodput_seconds_total{category="productive"} 2'
+            in body
+        )
+        assert "dlrover_goodput_ratio 1" in body
+        fleet.close()
+
+    def test_percentile_nearest_rank(self):
+        assert _percentile([1.0, 2.0, 3.0], 50.0) == 2.0
+        assert _percentile([1.0], 90.0) == 1.0
+        assert _percentile([], 50.0) == 0.0
+
+
+class TestGoodput:
+    def test_known_trace_buckets(self):
+        t = 0.0
+        events = [
+            {"name": "node.fail", "ts": t + 10.0},
+            {"name": "trainer.first_step_done", "ts": t + 30.0},
+            # compile: END-stamped span of 5s -> [30, 35]... emitted
+            # at 35 with dur 5
+            {"name": "trainer.compile_done", "ts": t + 35.0,
+             "dur_s": 5.0},
+            {"name": "trainer.step", "ts": t + 35.0},
+            {"name": "trainer.step", "ts": t + 45.0},
+            # data wait inside the step interval: carved out of
+            # productive
+            {"name": "trainer.prefetch_wait", "ts": t + 40.0,
+             "dur_s": 2.0},
+            # checkpoint span [45, 49]
+            {"name": "ckpt.save_memory", "ts": t + 45.0, "dur_s": 4.0},
+            {"name": "trainer.step", "ts": t + 50.0},
+        ]
+        gp = attribute_goodput(events, t0=0.0, t1=50.0)
+        assert gp.seconds["recovery"] == pytest.approx(20.0)
+        assert gp.seconds["compile"] == pytest.approx(5.0)
+        assert gp.seconds["data_wait"] == pytest.approx(2.0)
+        assert gp.seconds["checkpoint"] == pytest.approx(4.0)
+        # steps span [35,50] minus wait(2) minus ckpt(4) minus 0
+        assert gp.seconds["productive"] == pytest.approx(9.0)
+        assert gp.seconds["idle_unknown"] == pytest.approx(10.0)
+        assert sum(gp.seconds.values()) == pytest.approx(50.0)
+        assert gp.goodput_ratio == pytest.approx(9.0 / 50.0)
+        out = render_goodput(gp)
+        assert "recovery" in out and "idle_unknown" in out
+
+    def test_unrecovered_failure_is_badput_to_window_end(self):
+        events = [
+            {"name": "trainer.step", "ts": 0.0},
+            {"name": "trainer.step", "ts": 5.0},
+            {"name": "node.gone", "ts": 6.0},
+        ]
+        gp = attribute_goodput(events, t0=0.0, t1=20.0)
+        assert gp.seconds["recovery"] == pytest.approx(14.0)
+        assert gp.seconds["productive"] == pytest.approx(5.0)
+        assert gp.seconds["idle_unknown"] == pytest.approx(1.0)
+
+    def test_recovery_closes_on_first_step_without_phase_mark(self):
+        """With tracing off on the hosts, the master only sees its own
+        failure events and the steps it synthesizes from StepReports —
+        a landed step must close the recovery interval."""
+        events = [
+            {"name": "node.fail", "ts": 10.0},
+            {"name": "trainer.step", "ts": 25.0},
+            {"name": "trainer.step", "ts": 30.0},
+        ]
+        gp = attribute_goodput(events, t0=0.0, t1=30.0)
+        assert gp.seconds["recovery"] == pytest.approx(15.0)
+        assert gp.seconds["productive"] == pytest.approx(5.0)
+        assert gp.seconds["idle_unknown"] == pytest.approx(10.0)
+
+    def test_default_window_covers_trailing_span(self):
+        """A start-stamped span at the stream tail extends past its
+        ts; the default window must include it, not clip it to zero."""
+        events = [
+            {"name": "trainer.step", "ts": 0.0},
+            {"name": "trainer.step", "ts": 5.0},
+            {"name": "ckpt.save_memory", "ts": 5.0, "dur_s": 3.0},
+        ]
+        gp = attribute_goodput(events)
+        assert gp.t1 == pytest.approx(8.0)
+        assert gp.seconds["checkpoint"] == pytest.approx(3.0)
+
+    @pytest.mark.parametrize("seed", range(8))
+    def test_property_exhaustive_and_exclusive(self, seed):
+        """Random event soup: every second of the window lands in
+        exactly one bucket — sums match the window length exactly and
+        no bucket is negative."""
+        rng = random.Random(seed)
+        t = 0.0
+        events = []
+        for _ in range(rng.randint(20, 120)):
+            t += rng.uniform(0.0, 3.0)
+            kind = rng.random()
+            if kind < 0.35:
+                events.append({"name": "trainer.step", "ts": t})
+            elif kind < 0.5:
+                events.append({
+                    "name": "trainer.prefetch_wait", "ts": t,
+                    "dur_s": rng.uniform(0.0, 2.0),
+                })
+            elif kind < 0.65:
+                events.append({
+                    "name": "ckpt.save_memory", "ts": t,
+                    "dur_s": rng.uniform(0.0, 4.0),
+                })
+            elif kind < 0.75:
+                events.append({
+                    "name": "trainer.compile_done", "ts": t,
+                    "dur_s": rng.uniform(0.0, 5.0),
+                })
+            elif kind < 0.85:
+                events.append({
+                    "name": rng.choice(
+                        ["node.fail", "node.gone",
+                         "node.heartbeat_timeout"]
+                    ),
+                    "ts": t,
+                })
+            else:
+                events.append(
+                    {"name": "trainer.first_step_done", "ts": t}
+                )
+        rng.shuffle(events)  # order of arrival must not matter
+        t0, t1 = -5.0, t + 5.0
+        gp = attribute_goodput(events, t0=t0, t1=t1)
+        assert gp is not None
+        assert set(gp.seconds) == set(CATEGORIES)
+        for cat, sec in gp.seconds.items():
+            assert sec >= 0.0, f"{cat} went negative: {sec}"
+        assert sum(gp.seconds.values()) == pytest.approx(
+            t1 - t0, abs=1e-6
+        )
+
+    def test_empty_stream(self):
+        assert attribute_goodput([]) is None
+        gp = attribute_goodput([], t0=0.0, t1=10.0)
+        assert gp.seconds["idle_unknown"] == pytest.approx(10.0)
+
+    def test_accountant_sets_gauges_and_bounds_events(self):
+        reg = MetricsRegistry()
+        acct = GoodputAccountant(registry=reg, max_events=10)
+        acct.add_events(
+            {"name": "trainer.step", "ts": float(i)} for i in range(50)
+        )
+        report = acct.account()
+        assert report.steps == 10  # bounded to max_events (newest)
+        body = reg.render()
+        assert 'dlrover_goodput_seconds_total{category="productive"}' \
+            in body
+        assert "dlrover_goodput_ratio 1" in body
+
+    def test_accountant_debounces_reaccounting(self):
+        acct = GoodputAccountant(
+            registry=MetricsRegistry(), min_account_interval=3600.0
+        )
+        acct.add_events([{"name": "trainer.step", "ts": float(i)}
+                         for i in range(3)])
+        first = acct.account()
+        acct.add_events([{"name": "trainer.step", "ts": 10.0}])
+        assert acct.account() is first      # inside the debounce
+        forced = acct.account(force=True)   # bypass recomputes
+        assert forced is not first and forced.steps == 4
+
+
+class TestStragglerScoring:
+    def setup_method(self):
+        self.tracer = obs.configure_tracer()
+
+    def teardown_method(self):
+        obs.disable_tracer()
+
+    def feed(self, sm, times):
+        for node_id, step_time in times:
+            sm.observe_host_step_time(node_id, step_time)
+
+    def test_slow_host_scored_and_event_emitted(self):
+        sm = SpeedMonitor()
+        before = obs.get_registry().counter(
+            "dlrover_straggler_total",
+            labelnames=("node",),
+        ).value(node="2")
+        for _ in range(5):
+            self.feed(sm, [(0, 0.10), (1, 0.11), (2, 0.50)])
+        assert sm.stragglers() == [2]
+        scores = sm.straggler_scores()
+        assert scores[2] > 2.0 > scores[0]
+        after = obs.get_registry().counter(
+            "dlrover_straggler_total",
+            labelnames=("node",),
+        ).value(node="2")
+        assert after == before + 1  # transition counted once
+        names = [e["name"] for e in self.tracer.events()]
+        assert "node.straggler" in names
+        ev = next(
+            e for e in self.tracer.events()
+            if e["name"] == "node.straggler"
+        )
+        assert ev["node_id"] == 2
+        assert ev["score"] > 2.0
+
+    def test_needs_minimum_hosts(self):
+        sm = SpeedMonitor()
+        for _ in range(5):
+            self.feed(sm, [(0, 0.1), (1, 0.9)])
+        assert sm.stragglers() == []  # 2 hosts cannot out-vote
+
+    def test_needs_minimum_samples(self):
+        sm = SpeedMonitor()
+        self.feed(sm, [(0, 0.1), (1, 0.1), (2, 0.9)])
+        assert sm.stragglers() == []  # 1 sample each
+
+    def test_recovered_straggler_leaves_the_set(self):
+        sm = SpeedMonitor()
+        for _ in range(5):
+            self.feed(sm, [(0, 0.1), (1, 0.1), (2, 0.8)])
+        assert sm.stragglers() == [2]
+        for _ in range(30):
+            self.feed(sm, [(0, 0.1), (1, 0.1), (2, 0.1)])
+        assert sm.stragglers() == []
+        names = [e["name"] for e in self.tracer.events()]
+        assert "node.straggler_recovered" in names
+
+    def test_removed_node_clears_scoring_state(self):
+        sm = SpeedMonitor()
+        for _ in range(5):
+            self.feed(sm, [(0, 0.1), (1, 0.1), (2, 0.9)])
+        sm.add_running_node(2)
+        sm.remove_running_node(2)
+        assert 2 not in sm.host_step_ewma()
+        assert sm.stragglers() == []
+
+    def test_step_report_cadence_derives_step_times(self):
+        sm = SpeedMonitor(min_straggler_hosts=1)
+        t = 1000.0
+        sm.collect_node_step(0, 10, timestamp=t)
+        sm.collect_node_step(0, 20, timestamp=t + 5.0)
+        assert sm.host_step_ewma()[0] == pytest.approx(0.5)
+
+
+class TestMasterFleetEndToEnd:
+    """Acceptance: host-labeled aggregated series over HTTP + RPC from
+    two simulated agents, departed-host removal, and a live
+    query_stragglers verdict."""
+
+    @pytest.fixture()
+    def master(self):
+        m = JobMaster(
+            port=0, node_num=3, rdzv_timeout=1.0, metrics_port=0,
+            collect_interval=999.0,
+        )
+        m.prepare()
+        yield m
+        m.stop()
+
+    def snapshot_msg(self, node_id, host, step_times):
+        return msg.MetricsSnapshotReport(
+            node_id=node_id,
+            host=host,
+            timestamp=time.time(),
+            registry={
+                "dlrover_train_steps_total": {
+                    "type": "counter", "help": "steps",
+                    "labelnames": [],
+                    "series": [[[], 40 + node_id]],
+                },
+            },
+            resource={"tokens_per_s": 500.0 + node_id},
+            step_times=list(step_times),
+            events=[],
+        )
+
+    def test_fleet_view_and_stragglers(self, master):
+        tracer = obs.configure_tracer()
+        try:
+            client = RpcClient(master.addr)
+            for nid in range(3):
+                client.report(msg.NodeAddressRequest(
+                    node_id=nid, node_ip=f"h{nid}"
+                ))
+            # Three agents snapshot; node 2 is artificially slowed.
+            for _ in range(4):
+                client.report(self.snapshot_msg(0, "h0", [0.1] * 3))
+                client.report(self.snapshot_msg(1, "h1", [0.11] * 3))
+                client.report(self.snapshot_msg(2, "h2", [0.55] * 3))
+
+            import urllib.request
+
+            url = (
+                f"http://127.0.0.1:{master.metrics_server.port}"
+                "/metrics"
+            )
+            body = urllib.request.urlopen(url, timeout=5).read().decode()
+            assert 'dlrover_train_steps_total{host="h0"} 40' in body
+            assert 'dlrover_train_steps_total{host="h1"} 41' in body
+            assert 'dlrover_train_steps_total{host="h2"} 42' in body
+            assert "dlrover_fleet_hosts 3" in body
+            assert 'dlrover_fleet_series{series="step_time_s"' in body
+            assert (
+                'dlrover_fleet_series{series="tokens_per_s",'
+                'stat="sum"} 1503' in body
+            )
+            # Same payload over the control-plane RPC.
+            rpc_body = client.get(msg.MetricsRequest()).text
+            assert 'dlrover_train_steps_total{host="h2"} 42' in rpc_body
+
+            # The slowed host is a straggler, from live step times.
+            resp = client.get(
+                msg.NetworkCheckQueryRequest(kind="straggler")
+            )
+            assert 2 in resp.nodes
+            names = [e["name"] for e in tracer.events()]
+            assert "node.straggler" in names
+
+            # Node 1 departs: its series leave the fleet view now.
+            master.job_manager.handle_node_gone(1, "pod deleted")
+            body = urllib.request.urlopen(url, timeout=5).read().decode()
+            assert 'host="h1"' not in body
+            assert "dlrover_fleet_hosts 2" in body
+        finally:
+            obs.disable_tracer()
+
+    def test_step_reports_close_recovery_in_goodput(self, master):
+        """No tracing anywhere: a failure report opens recovery and
+        the next StepReport closes it, from master-side signals only."""
+        client = RpcClient(master.addr)
+        client.report(msg.NodeAddressRequest(node_id=0, node_ip="h0"))
+        client.report(msg.NodeAddressRequest(node_id=1, node_ip="h1"))
+        t = time.time()
+        client.report(msg.StepReport(
+            node_id=0, timestamp=t - 30.0, step=10, tokens=100
+        ))
+        client.report(msg.NodeFailureReport(
+            node_id=1, error_data="oom", level="process_error",
+        ))
+        client.report(msg.StepReport(
+            node_id=0, timestamp=t + 20.0, step=11, tokens=100
+        ))
+        report = master.goodput.account(force=True)
+        assert report is not None
+        assert report.seconds["recovery"] > 0
+        # recovery CLOSED at the post-failure step: it must not run
+        # to the window end.
+        assert report.seconds["recovery"] < report.total_s
+        assert report.seconds["productive"] > 0
+
+    def test_resource_monitor_ships_snapshot_end_to_end(
+        self, master, tmp_path
+    ):
+        """A real ResourceMonitor against a real master: the snapshot
+        lands in the fleet aggregator."""
+        from dlrover_tpu.agent.master_client import MasterClient
+        from dlrover_tpu.agent.monitor import (
+            ResourceMonitor,
+            TrainingMonitor,
+        )
+
+        client = MasterClient(master.addr, node_id=0)
+        rpc = RpcClient(master.addr)
+        rpc.report(msg.NodeAddressRequest(node_id=0, node_ip="h0"))
+        metrics_file = str(tmp_path / "train_metrics.json")
+        TrainingMonitor.write_metrics(
+            5, tokens=1000, path=metrics_file, step_time=0.25
+        )
+        mon = ResourceMonitor(
+            client, interval=999.0, metrics_file=metrics_file
+        )
+        mon.report_once()
+        hosts = master.fleet.hosts()
+        assert hosts == [mon.host]
+        snap = master.fleet.live_snapshots()[0]
+        assert snap.step_times == [0.25]
+        assert snap.node_id == 0
+        client.close()

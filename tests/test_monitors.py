@@ -150,6 +150,269 @@ def test_json_file_reporter_appends_and_failure_is_contained(tmp_path):
     assert all(rec["job_name"] == "jobF" for rec in lines)
 
 
+def test_recovery_seconds_uses_crossing_time_not_poll_time():
+    """A late recovery_seconds() poll must report when the throughput
+    window first regained 90% of pre-failure speed (the crossing
+    sample's timestamp), not how long ago the poll happened."""
+    import time as _time
+
+    sm = SpeedMonitor(window=4)
+    sm.add_running_node(0)
+    sm.add_running_node(1)
+    t0 = _time.time()
+    for i in range(4):  # healthy: 100 tokens/s
+        sm.collect_global_step(i, t0 + i, tokens=100)
+    sm.remove_running_node(1)  # failure: snapshots 100 tok/s baseline
+    assert sm._pre_failure_tput == pytest.approx(100.0)
+    t_fail = sm._last_failure_time
+    # Recovery happens "in the future" relative to the poll: samples
+    # are stamped ~100s after the failure, crossing on the last one.
+    base = t_fail + 100.0
+    for i in range(4):  # limp along at 10 tokens/s
+        sm.collect_global_step(10 + i, base + i, tokens=10)
+    assert sm.recovery_seconds() is None  # not recovered yet
+    for i in range(4):  # back to full speed
+        sm.collect_global_step(20 + i, base + 4 + i, tokens=100)
+    rec = sm.recovery_seconds()
+    assert rec is not None
+    # The crossing was recorded at a sample timestamp ~104-108s after
+    # the failure; a poll-time answer would be ~0s here.
+    assert 100.0 <= rec <= 110.0
+    assert sm.recovery_seconds() == pytest.approx(rec)  # sticky
+
+
+def test_remove_running_node_snapshot_is_single_lock():
+    """The pre-failure throughput snapshot happens in the same lock
+    acquisition as the failure bookkeeping, so it reflects the window
+    at the failure instant (here: the healthy 100 tok/s window)."""
+    sm = SpeedMonitor(window=4)
+    sm.add_running_node(0)
+    t = 1000.0
+    for i in range(4):
+        sm.collect_global_step(i, t + i, tokens=100)
+    sm.remove_running_node(0)
+    assert sm._pre_failure_tput == pytest.approx(100.0)
+    # A node never marked running must not re-arm failure tracking.
+    sm.reset_failure_tracking()
+    sm.remove_running_node(99)
+    assert sm._pre_failure_tput is None
+
+
+def test_recovery_not_vouched_by_pre_failure_window():
+    """A window still dominated by healthy pre-failure samples must
+    not claim recovery the moment the first post-failure report
+    lands — only post-failure samples vouch for the crossing."""
+    import time as _time
+
+    sm = SpeedMonitor(window=6)
+    sm.add_running_node(0)
+    t0 = _time.time()
+    for i in range(6):  # full healthy window at 100 tok/s
+        sm.collect_global_step(i, t0 + i, tokens=100)
+    sm.remove_running_node(0)
+    fail_t = sm._last_failure_time
+    # One slow post-failure sample: the healthy samples still in the
+    # deque would put the full-window tput way above 90%.
+    sm.collect_global_step(20, fail_t + 30.0, tokens=10 * 30)
+    assert sm.recovery_seconds() is None
+    sm.collect_global_step(21, fail_t + 60.0, tokens=10 * 30)
+    assert sm.recovery_seconds() is None  # post tput = 10/s, not 90
+    # Ramp back up: not recovered until the post-failure window
+    # itself sustains >= 90 tok/s (the slow samples must age out).
+    for k, ts in enumerate((90.0, 120.0, 150.0, 180.0)):
+        sm.collect_global_step(22 + k, fail_t + ts, tokens=100 * 30)
+    assert sm.recovery_seconds() is None  # window still 82 tok/s
+    sm.collect_global_step(26, fail_t + 210.0, tokens=100 * 30)
+    rec = sm.recovery_seconds()
+    assert rec == pytest.approx(210.0, abs=1.0)
+
+
+def test_resource_monitor_trace_tail_defers_past_event_cap(
+    tmp_path, monkeypatch
+):
+    """A burst larger than the per-snapshot cap is split across
+    snapshots, never dropped."""
+    trace = tmp_path / "trace.jsonl"
+    monkeypatch.setenv("DLROVER_TPU_TRACE_FILE", str(trace))
+    client = SnapshotFakeClient()
+    mon = ResourceMonitor(
+        client, interval=999, metrics_file=str(tmp_path / "m.json")
+    )
+    mon.MAX_EVENTS_PER_SNAPSHOT = 3
+    with open(trace, "w") as f:
+        for i in range(5):
+            f.write(json.dumps({"name": f"e{i}", "ts": float(i)}) + "\n")
+    mon.report_once()
+    mon.report_once()
+    got = [
+        [e["name"] for e in s["events"]] for s in client.snapshots
+    ]
+    assert got == [["e0", "e1", "e2"], ["e3", "e4"]]
+
+
+def test_resource_monitor_skips_pre_restart_trace_history(
+    tmp_path, monkeypatch
+):
+    """A restarted agent must not re-ship (and double-count) the
+    trace lines its previous incarnation already sent."""
+    trace = tmp_path / "trace.jsonl"
+    trace.write_text(
+        json.dumps({"name": "old.event", "ts": 1.0}) + "\n"
+    )
+    monkeypatch.setenv("DLROVER_TPU_TRACE_FILE", str(trace))
+    client = SnapshotFakeClient()
+    mon = ResourceMonitor(
+        client, interval=999, metrics_file=str(tmp_path / "m.json")
+    )
+    with open(trace, "a") as f:
+        f.write(json.dumps({"name": "new.event", "ts": 2.0}) + "\n")
+    mon.report_once()
+    names = [e["name"] for e in client.snapshots[0]["events"]]
+    assert names == ["new.event"]
+
+
+def test_hang_detector_emits_obs(tmp_path):
+    from dlrover_tpu import obs
+    from dlrover_tpu.agent.hang_detector import HangDetector
+
+    tracer = obs.configure_tracer()
+    try:
+        path = str(tmp_path / "metrics.json")
+        det = HangDetector(
+            hang_timeout=0.01, startup_grace=999.0, metrics_file=path
+        )
+        TrainingMonitor.write_metrics(1, path=path)
+        assert det.check() is False  # first step = progress
+        counter = obs.get_registry().get("dlrover_hang_detect_total")
+        before = counter.value()
+        import time as _time
+
+        _time.sleep(0.05)
+        assert det.check() is True
+        assert det.check() is True  # still hung
+        assert counter.value() == before + 1  # one hang, one count
+        hangs = [
+            e for e in tracer.events()
+            if e["name"] == "agent.hang_detected"
+        ]
+        assert len(hangs) == 1
+        assert hangs[0]["seconds_since_progress"] >= 0.01
+        assert hangs[0]["last_step"] == 1
+        # Progress re-arms the detector for the next hang.
+        TrainingMonitor.write_metrics(2, path=path)
+        assert det.check() is False
+        _time.sleep(0.05)
+        assert det.check() is True
+        assert counter.value() == before + 2
+    finally:
+        obs.disable_tracer()
+
+
+def test_write_metrics_records_recent_step_times(tmp_path):
+    path = str(tmp_path / "metrics.json")
+    TrainingMonitor.write_metrics(1, tokens=100, path=path,
+                                  step_time=0.2)
+    TrainingMonitor.write_metrics(2, tokens=220, path=path,
+                                  step_time=0.3)
+    with open(path) as f:
+        data = json.load(f)
+    assert data["recent_step_times"] == [0.2, 0.3]
+
+
+class SnapshotFakeClient(FakeClient):
+    def __init__(self):
+        super().__init__()
+        self.snapshots = []
+
+    def report_metrics_snapshot(self, **kw):
+        self.snapshots.append(kw)
+
+
+def test_resource_monitor_ships_deduped_snapshots(tmp_path):
+    """Each step time is shipped exactly once across snapshots; the
+    tokens/s rate appears once two reads bracket a token delta."""
+    path = str(tmp_path / "metrics.json")
+    client = SnapshotFakeClient()
+    mon = ResourceMonitor(client, interval=999, metrics_file=path)
+    TrainingMonitor.write_metrics(1, tokens=100, path=path,
+                                  step_time=0.2)
+    TrainingMonitor.write_metrics(2, tokens=300, path=path,
+                                  step_time=0.3)
+    mon.report_once()
+    assert len(client.snapshots) == 1
+    snap = client.snapshots[0]
+    assert snap["step_times"] == [0.2, 0.3]
+    assert snap["host"] == mon.host
+    assert "dlrover_hang_detect_total" in snap["registry"]
+    assert "tokens_per_s" not in snap["resource"]  # no prior read
+    TrainingMonitor.write_metrics(3, tokens=500, path=path,
+                                  step_time=0.4)
+    mon.report_once()
+    snap = client.snapshots[1]
+    assert snap["step_times"] == [0.4]  # only the new one
+    assert snap["resource"]["tokens_per_s"] > 0
+    mon.report_once()  # no trainer progress
+    assert client.snapshots[2]["step_times"] == []
+
+
+def test_resource_monitor_snapshot_includes_ring_events_once(tmp_path):
+    from dlrover_tpu import obs
+
+    obs.configure_tracer()
+    try:
+        client = SnapshotFakeClient()
+        mon = ResourceMonitor(
+            client, interval=999,
+            metrics_file=str(tmp_path / "m.json"),
+        )
+        with obs.span("agent.some_span"):
+            obs.event("agent.some_event")
+        mon.report_once()
+        names = [
+            e["name"] for e in client.snapshots[0]["events"]
+        ]
+        # Arrival order delivers the span even though its mono stamp
+        # (span start) predates the inner event's.
+        assert "agent.some_span" in names
+        assert "agent.some_event" in names
+        mon.report_once()
+        assert client.snapshots[1]["events"] == []  # exactly once
+    finally:
+        obs.disable_tracer()
+
+
+def test_resource_monitor_tails_shared_trace_file(
+    tmp_path, monkeypatch
+):
+    """With DLROVER_TPU_TRACE_FILE set, the snapshot events come from
+    the host's shared trace file — the trainer process appends there
+    too, which is how its spans reach the master."""
+    trace = tmp_path / "trace.jsonl"
+    monkeypatch.setenv("DLROVER_TPU_TRACE_FILE", str(trace))
+    client = SnapshotFakeClient()
+    mon = ResourceMonitor(
+        client, interval=999, metrics_file=str(tmp_path / "m.json")
+    )
+    # "Trainer process" writes two events + one torn line.
+    with open(trace, "w") as f:
+        f.write(json.dumps({"name": "trainer.step", "ts": 1.0}) + "\n")
+        f.write(json.dumps(
+            {"name": "ckpt.save_memory", "ts": 2.0, "dur_s": 0.5}
+        ) + "\n")
+        f.write('{"name": "torn')
+    mon.report_once()
+    names = [e["name"] for e in client.snapshots[0]["events"]]
+    assert names == ["trainer.step", "ckpt.save_memory"]
+    # The torn line completes later and ships exactly once.
+    with open(trace, "a") as f:
+        f.write('_done", "ts": 3.0}\n')
+    mon.report_once()
+    names = [e["name"] for e in client.snapshots[1]["events"]]
+    assert names == ["torn_done"]
+    mon.report_once()
+    assert client.snapshots[2]["events"] == []
+
+
 def test_mark_phase_mirrors_to_obs_tracer(tmp_path, monkeypatch):
     """Phase marks feed the recovery-timeline reconstructor through
     the obs tracer, independent of the phases file."""

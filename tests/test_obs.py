@@ -157,6 +157,25 @@ class TestTracer:
         assert [e["name"] for e in events] == ["a", "b"]
         assert events[1]["dur_s"] >= 0
 
+    def test_events_since_arrival_order_keeps_late_spans(self, tracer):
+        """A span emitted AFTER a plain event carries an earlier mono
+        (its start); the arrival-order cursor must still deliver it."""
+        with obs.span("slow.span"):
+            obs.event("mid.event")  # later mono than the span's start
+        first, cursor = tracer.events_since(0)
+        assert [e["name"] for e in first] == ["mid.event", "slow.span"]
+        nothing, cursor2 = tracer.events_since(cursor)
+        assert nothing == [] and cursor2 == cursor
+        obs.event("after")
+        fresh, _ = tracer.events_since(cursor)
+        assert [e["name"] for e in fresh] == ["after"]
+
+    def test_events_since_stale_cursor_resets(self):
+        tr = EventTracer()
+        tr.event("a")
+        events, _ = tr.events_since(10_000)  # cursor from a dead tracer
+        assert [e["name"] for e in events] == ["a"]
+
     def test_load_events_skips_torn_lines(self, tmp_path):
         path = tmp_path / "trace.jsonl"
         path.write_text(
@@ -228,6 +247,192 @@ class TestTimeline:
         d = reconstruct_recovery_timeline(self.events()).to_dict()
         assert d["complete"] is True
         assert d["phases"]["rendezvous"] == 6.0
+
+
+class TestTimelineAdversarial:
+    """Reconstruction under hostile streams: out-of-order events,
+    duplicate marks, missing terminal phases. A damaged stream must
+    yield a partial timeline (or None), never a negative or silently
+    wrong duration."""
+
+    def _assert_no_negative(self, tl):
+        for name, dur in tl.phases.items():
+            assert dur is None or dur >= 0, (name, dur)
+        assert tl.total_s >= 0
+
+    def test_out_of_order_stream_reconstructs_identically(self):
+        import random
+
+        base = TestTimeline().events()
+        shuffled = list(base)
+        random.Random(7).shuffle(shuffled)
+        a = reconstruct_recovery_timeline(base)
+        b = reconstruct_recovery_timeline(shuffled)
+        assert a.phases == b.phases
+        assert a.marks == b.marks
+
+    def test_duplicate_marks_use_first_occurrence(self):
+        events = TestTimeline().events()
+        # A retried writer duplicates every mark a little later.
+        dupes = [
+            {"name": e["name"], "ts": e["ts"] + 0.5}
+            for e in events
+            if e["name"].startswith("trainer.")
+        ]
+        tl = reconstruct_recovery_timeline(events + dupes)
+        assert tl.complete
+        assert tl.marks["trainer.proc_start"] == 104.0
+        assert tl.phases["rendezvous"] == pytest.approx(6.0)
+        self._assert_no_negative(tl)
+
+    def test_missing_terminal_phase_is_partial_not_wrong(self):
+        events = [
+            e
+            for e in TestTimeline().events()
+            if e["name"] != "trainer.first_step_done"
+        ]
+        tl = reconstruct_recovery_timeline(events)
+        assert tl is not None and not tl.complete
+        assert tl.phases["first-step"] is None
+        self._assert_no_negative(tl)
+
+    def test_missing_middle_mark_never_misassigns(self):
+        # dist_ready lost: everything downstream of the gap must be
+        # unknown rather than silently merged into one phase.
+        events = [
+            e
+            for e in TestTimeline().events()
+            if e["name"] != "trainer.dist_ready"
+        ]
+        tl = reconstruct_recovery_timeline(events)
+        assert tl is not None and not tl.complete
+        assert tl.phases["rendezvous"] is None
+        assert tl.phases["build"] is None
+        self._assert_no_negative(tl)
+
+    def test_recovery_stamp_before_first_step_not_negative(self):
+        tl = reconstruct_recovery_timeline(
+            TestTimeline().events(),
+            throughput_recovered_ts=130.0,  # before first_step (140)
+        )
+        assert tl is not None
+        assert tl.phases["throughput-90"] is None
+        self._assert_no_negative(tl)
+
+    def test_marks_before_failure_only_yields_none_or_partial(self):
+        # Every trainer mark predates the failure instant: nothing to
+        # anchor on after t_failure.
+        events = TestTimeline().events()
+        tl = reconstruct_recovery_timeline(events, t_failure=999.0)
+        assert tl is None or not tl.complete
+        if tl is not None:
+            self._assert_no_negative(tl)
+
+    def test_equal_timestamps_yield_zero_not_negative(self):
+        events = [
+            {"name": "node.fail", "ts": 10.0},
+            {"name": "trainer.proc_start", "ts": 10.0},
+            {"name": "trainer.dist_ready", "ts": 10.0},
+            {"name": "trainer.built", "ts": 10.0},
+            {"name": "trainer.restore_done", "ts": 10.0},
+            {"name": "trainer.first_step_done", "ts": 10.0},
+        ]
+        tl = reconstruct_recovery_timeline(events)
+        assert tl.complete
+        for name in ("rendezvous", "build", "restore", "first-step"):
+            assert tl.phases[name] == 0.0
+        self._assert_no_negative(tl)
+
+
+class TestMetricNameHygiene:
+    """Audit every obs.counter/gauge/histogram registration in the
+    framework and tools: dlrover_-prefixed snake_case names, non-empty
+    help strings, and no name registered with conflicting types."""
+
+    METRIC_NAME_RE = r"^dlrover_[a-z0-9]+(_[a-z0-9]+)*$"
+
+    def _call_sites(self):
+        import ast
+
+        sites = []
+        for root in ("dlrover_tpu", "tools"):
+            for dirpath, _, files in os.walk(os.path.join(REPO, root)):
+                if "__pycache__" in dirpath:
+                    continue
+                for fname in files:
+                    if not fname.endswith(".py"):
+                        continue
+                    fpath = os.path.join(dirpath, fname)
+                    with open(fpath, encoding="utf-8") as f:
+                        tree = ast.parse(f.read(), filename=fpath)
+                    for node in ast.walk(tree):
+                        if not (
+                            isinstance(node, ast.Call)
+                            and isinstance(node.func, ast.Attribute)
+                            and node.func.attr
+                            in ("counter", "gauge", "histogram")
+                        ):
+                            continue
+                        args = node.args
+                        if not (
+                            args
+                            and isinstance(args[0], ast.Constant)
+                            and isinstance(args[0].value, str)
+                        ):
+                            continue  # dynamic name: not a literal
+                            # registration site
+                        name = args[0].value
+                        help_ = None
+                        if len(args) > 1 and isinstance(
+                            args[1], ast.Constant
+                        ):
+                            help_ = args[1].value
+                        for kw in node.keywords:
+                            if kw.arg == "help" and isinstance(
+                                kw.value, ast.Constant
+                            ):
+                                help_ = kw.value.value
+                        rel = os.path.relpath(fpath, REPO)
+                        sites.append(
+                            (rel, node.lineno, node.func.attr,
+                             name, help_)
+                        )
+        return sites
+
+    def test_all_registrations_are_hygienic(self):
+        import re
+
+        sites = self._call_sites()
+        # The framework registers plenty of metrics; an empty audit
+        # means the walker broke, not that the code is clean.
+        assert len(sites) >= 15, sites
+        problems = []
+        types_seen = {}
+        for rel, line, mtype, name, help_ in sites:
+            where = f"{rel}:{line}"
+            if not re.match(self.METRIC_NAME_RE, name):
+                problems.append(
+                    f"{where}: {name!r} is not dlrover_-prefixed "
+                    "snake_case"
+                )
+            if not (isinstance(help_, str) and help_.strip()):
+                problems.append(
+                    f"{where}: {name!r} registered without a help "
+                    "string"
+                )
+            prev = types_seen.setdefault(name, (mtype, where))
+            if prev[0] != mtype:
+                problems.append(
+                    f"{where}: {name!r} registered as {mtype} but "
+                    f"as {prev[0]} at {prev[1]}"
+                )
+        assert not problems, "\n".join(problems)
+
+    def test_registry_rejects_conflicting_reregistration_runtime(self):
+        reg = MetricsRegistry()
+        reg.counter("dlrover_x_total", "x")
+        with pytest.raises(ValueError):
+            reg.gauge("dlrover_x_total", "x")
 
 
 class TestMasterExposition:

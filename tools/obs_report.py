@@ -9,16 +9,21 @@ e.g. the per-host traces the chaos drills leave behind — and prints:
   time) when trainer.prefetch_* events are present — the quick "is
   the prefetch pipeline hiding input staging" check
   (docs/PERFORMANCE.md);
+* with ``--goodput``: the exhaustive wall-time attribution
+  (productive / compile / data_wait / checkpoint / recovery /
+  idle_unknown, obs/goodput.py) over the trace window;
 * a per-event-name table: count, and for span events total/mean
   duration, sorted by total time.
 
 Usage:
     python tools/obs_report.py TRACE.jsonl [--failure-ts T] [--top N]
+    python tools/obs_report.py TRACE.jsonl --goodput
     python tools/obs_report.py --selftest
 
-``--selftest`` runs the reconstruction pipeline on a synthetic event
-log and exits nonzero on any inconsistency — a fast CI smoke with no
-inputs (invoked by tests/test_obs.py).
+``--selftest`` runs the reconstruction + goodput + fleet-aggregation
+pipelines on synthetic events/snapshots and exits nonzero on any
+inconsistency — a fast CI smoke with no inputs (invoked by
+tests/test_obs.py).
 """
 
 from __future__ import annotations
@@ -28,6 +33,10 @@ import sys
 
 import _repo_path  # noqa: F401
 
+from dlrover_tpu.obs.goodput import (
+    attribute_goodput,
+    render_goodput,
+)
 from dlrover_tpu.obs.timeline import (
     REQUIRED_PHASES,
     load_events,
@@ -114,7 +123,9 @@ def input_pipeline_summary(events) -> str:
     return "\n".join(lines)
 
 
-def report(path: str, failure_ts=None, top: int = 15) -> int:
+def report(
+    path: str, failure_ts=None, top: int = 15, goodput: bool = False
+) -> int:
     events = [e for e in load_events(path) if "ts" in e]
     if not events:
         print(f"no events in {path}")
@@ -132,6 +143,11 @@ def report(path: str, failure_ts=None, top: int = 15) -> int:
     if pipeline:
         print()
         print(pipeline)
+    if goodput:
+        gp = attribute_goodput(events)
+        if gp is not None:
+            print()
+            print(render_goodput(gp))
     print()
     print(metrics_table(events, top=top))
     return 0
@@ -207,6 +223,8 @@ def selftest() -> int:
             [e for e in events if "prefetch" not in e["name"]]
         ):
             errors.append("pipeline summary not empty without events")
+        errors.extend(_selftest_goodput(events))
+    errors.extend(_selftest_fleet())
     if errors:
         print("obs selftest FAILED:")
         for e in errors:
@@ -214,6 +232,95 @@ def selftest() -> int:
         return 1
     print("obs selftest ok")
     return 0
+
+
+def _selftest_goodput(events) -> list:
+    """Goodput attribution on the same synthetic trace: buckets must
+    be exhaustive (sum == window) with the hand-computed values."""
+    errors = []
+    gp = attribute_goodput(events)
+    if gp is None:
+        return ["goodput attribution returned None"]
+    want = {
+        "recovery": 40.0,       # failure t .. first_step_done t+40
+        "data_wait": 0.04,      # two prefetch_wait events
+        "productive": 1.97,     # steps t+41..t+43 minus data-wait
+        "compile": 0.0,
+        "checkpoint": 0.0,
+        "idle_unknown": 2.99,   # the remainder
+    }
+    for cat, val in want.items():
+        got = gp.seconds.get(cat, 0.0)
+        if abs(got - val) > 1e-6:
+            errors.append(f"goodput[{cat}]: want {val}, got {got}")
+    if abs(sum(gp.seconds.values()) - gp.total_s) > 1e-6:
+        errors.append(
+            f"goodput buckets sum {sum(gp.seconds.values())} != "
+            f"window {gp.total_s}"
+        )
+    rendered = render_goodput(gp)
+    if "goodput" not in rendered or "recovery" not in rendered:
+        errors.append(f"goodput render incomplete: {rendered!r}")
+    return errors
+
+
+def _selftest_fleet() -> list:
+    """Fleet aggregation on two synthetic host snapshots: host-labeled
+    series, cross-host aggregates, and age-out on removal."""
+    from types import SimpleNamespace
+
+    from dlrover_tpu.obs.fleet import FleetAggregator
+    from dlrover_tpu.obs.metrics import MetricsRegistry
+
+    errors = []
+    reg = MetricsRegistry()
+    fleet = FleetAggregator(registry=reg, ttl=3600.0)
+
+    def snap(node_id, host, step_time, syncs):
+        return SimpleNamespace(
+            node_id=node_id,
+            host=host,
+            timestamp=1000.0,
+            registry={
+                "dlrover_train_steps_total": {
+                    "type": "counter", "help": "steps",
+                    "labelnames": [], "series": [[[], 10 + node_id]],
+                },
+                "dlrover_train_host_syncs_total": {
+                    "type": "counter", "help": "syncs",
+                    "labelnames": ["reason"],
+                    "series": [[["log"], syncs]],
+                },
+            },
+            resource={"tokens_per_s": 1000.0 * (node_id + 1)},
+            step_times=[step_time] * 3,
+            events=[],
+        )
+
+    fleet.ingest(snap(0, "w0", 0.10, 5))
+    fleet.ingest(snap(1, "w1", 0.30, 7))
+    body = reg.render()
+    for needle in (
+        'dlrover_train_steps_total{host="w0"} 10',
+        'dlrover_train_steps_total{host="w1"} 11',
+        'dlrover_train_host_syncs_total{reason="log",host="w1"} 7',
+        "dlrover_fleet_hosts 2",
+        'dlrover_fleet_series{series="host_syncs_total",stat="sum"} 12',
+        'dlrover_fleet_series{series="step_time_s",stat="max"} 0.3',
+        'dlrover_fleet_series{series="tokens_per_s",stat="min"} 1000',
+    ):
+        if needle not in body:
+            errors.append(f"fleet render missing {needle!r}")
+    fleet.remove_node(1)
+    body = reg.render()
+    if 'host="w1"' in body:
+        errors.append("departed host w1 still rendered after removal")
+    if "dlrover_fleet_hosts 1" not in body:
+        errors.append("fleet host count did not drop to 1")
+    fleet.close()
+    if "dlrover_fleet_hosts" in reg.render():
+        errors.append("fleet collector still rendering after close()")
+    return errors
 
 
 def main(argv=None) -> int:
@@ -226,15 +333,23 @@ def main(argv=None) -> int:
     )
     p.add_argument("--top", type=int, default=15)
     p.add_argument(
+        "--goodput", action="store_true",
+        help="print the goodput/badput wall-time attribution",
+    )
+    p.add_argument(
         "--selftest", action="store_true",
-        help="run the reconstruction pipeline on synthetic events",
+        help="run the reconstruction/goodput/fleet pipelines on "
+        "synthetic inputs",
     )
     args = p.parse_args(argv)
     if args.selftest:
         return selftest()
     if not args.event_file:
         p.error("event_file is required (or pass --selftest)")
-    return report(args.event_file, args.failure_ts, args.top)
+    return report(
+        args.event_file, args.failure_ts, args.top,
+        goodput=args.goodput,
+    )
 
 
 if __name__ == "__main__":
