@@ -26,10 +26,12 @@ import time
 from dataclasses import dataclass, field
 from typing import Deque, Dict, List, Optional
 
+from dlrover_tpu import obs
 from dlrover_tpu.agent.master_client import MasterClient
 from dlrover_tpu.common.comm import find_free_port
 from dlrover_tpu.common.config import ensure_framework_on_pythonpath
 from dlrover_tpu.common.constants import (
+    EventAction,
     NodeAction,
     NodeEnv,
     NodeType,
@@ -39,6 +41,12 @@ from dlrover_tpu.common.constants import (
 from dlrover_tpu.common.log import get_logger
 
 logger = get_logger("agent")
+
+_HEARTBEAT_FAILURES = obs.counter(
+    "dlrover_agent_heartbeat_failures_total",
+    "Agent->master heartbeat RPC failures (consecutive streaks are "
+    "logged once per power-of-two length, not per tick)",
+)
 
 
 class RendezvousTimeoutError(RuntimeError):
@@ -296,6 +304,99 @@ class ElasticAgent:
             self._stderr_thread.join(timeout=3.0)
         self._stderr_thread = None
 
+    # -- forensics ----------------------------------------------------------
+
+    def _snapshot_trainer_stacks(self, timeout: float = 3.0) -> str:
+        """The training process's Python stacks, as text.
+
+        Alive process: SIGUSR1 triggers its flight recorder's
+        C-level faulthandler dump (registered at install; works even
+        with the main thread wedged in a C call) and the growth of
+        its stacks file is returned. Dead process: the tail the crash
+        handlers already left behind."""
+        from dlrover_tpu.obs import flight_recorder as fr
+
+        proc = self._proc
+        if proc is None:
+            return ""
+        path = fr.stacks_file_path(proc.pid)
+        try:
+            before = os.path.getsize(path)
+        except OSError:
+            before = 0
+        if proc.poll() is not None:
+            return fr.read_stacks_tail(
+                path, since=max(before - 8192, 0)
+            )
+        if not hasattr(signal, "SIGUSR1"):
+            return ""
+        if not fr.sigusr1_ready(proc.pid):
+            # No registered handler (recorder disabled, still
+            # importing, or registration failed): the default
+            # disposition would KILL the process we are trying to
+            # diagnose. No signal, no stacks.
+            return ""
+        try:
+            proc.send_signal(signal.SIGUSR1)
+        except OSError:
+            return ""
+        deadline = time.monotonic() + timeout
+        while time.monotonic() < deadline:
+            try:
+                if os.path.getsize(path) > before:
+                    # Give the C handler a beat to finish the dump.
+                    time.sleep(0.2)
+                    break
+            except OSError:
+                pass
+            time.sleep(0.1)
+        return fr.read_stacks_tail(path, since=before)
+
+    def _collect_forensics(self, kind: str, **notes):
+        """(digest, bundle_path): snapshot the training process's
+        stacks, write this agent's black-box bundle (with the trainer
+        stacks embedded), and build the size-capped digest failure
+        reports and the master's history carry. Never raises."""
+        from dlrover_tpu.obs import flight_recorder as fr
+
+        stacks = ""
+        try:
+            stacks = self._snapshot_trainer_stacks()
+        except Exception:  # noqa: BLE001 — forensics must never
+            # break the recovery path it documents
+            logger.warning(
+                "trainer stack snapshot failed", exc_info=True
+            )
+        rec = fr.get_flight_recorder()
+        bundle_path = ""
+        if rec is not None:
+            # Incident facts ride THIS bundle only — merging them
+            # into the recorder's persistent notes would make every
+            # later diagnose/crash digest replay a stale hang.
+            bundle_path = (
+                rec.dump(
+                    kind,
+                    reason=f"agent {kind} forensics",
+                    extra={"trainer_stacks": stacks},
+                    incident=notes,
+                )
+                or ""
+            )
+        digest = fr.make_digest(
+            kind, stacks_text=stacks, recorder=rec, incident=notes
+        )
+        if bundle_path:
+            digest = f"bundle: {bundle_path}\n{digest}"
+        return digest, bundle_path
+
+    def _run_diagnose(self) -> None:
+        """Master-pushed `diagnose` action: on-demand stack-and-state
+        snapshot, shipped back as a DiagnosticsReport."""
+        digest, bundle_path = self._collect_forensics("diagnose")
+        self.client.report_diagnostics(
+            "diagnose", bundle_path=bundle_path, digest=digest
+        )
+
     # -- health check -------------------------------------------------------
 
     def run_network_check(self) -> bool:
@@ -498,6 +599,17 @@ class ElasticAgent:
                     hang.seconds_since_progress(),
                     "giving up" if exhausted else "restarting it",
                 )
+                # Forensics BEFORE any kill/restart: the SIGUSR1 stack
+                # snapshot needs the hung process still alive, and the
+                # digest must ride the failure report so the hang is
+                # diagnosable, not just counted.
+                digest, bundle_path = self._collect_forensics(
+                    "hang",
+                    hang_seconds=round(
+                        hang.seconds_since_progress(), 1
+                    ),
+                    last_step=hang.last_step,
+                )
                 action = NodeAction.RESTART_IN_PLACE
                 try:
                     action = self.client.report_failure(
@@ -505,9 +617,13 @@ class ElasticAgent:
                         TrainingExceptionLevel.PROCESS_ERROR,
                         restart_count=self._restart_count,
                         fatal=exhausted,
+                        diagnostics=digest,
                     )
                 except Exception:  # noqa: BLE001
                     logger.warning("could not report hang", exc_info=True)
+                self.client.report_diagnostics(
+                    "hang", bundle_path=bundle_path, digest=digest
+                )
                 if exhausted:
                     self._kill_proc()  # a hung proc still holds chips
                     return 1
@@ -560,6 +676,16 @@ class ElasticAgent:
             f"training process exit code {exitcode}\n"
             + self._stderr_text()
         )
+        # The dead trainer's crash hooks (excepthook bundle /
+        # faulthandler stacks) already wrote to the forensics dir;
+        # fold their tail + this agent's black box into a digest. It
+        # rides the failure report's `diagnostics` field, NOT
+        # error_data: stack frames must not perturb the master's
+        # stderr keyword classifier (a frame through
+        # preemption_drill.py is not a preemption).
+        digest, bundle_path = self._collect_forensics(
+            "crash", exit_code=exitcode
+        )
         action = NodeAction.RESTART_IN_PLACE
         try:
             action = self.client.report_failure(
@@ -567,6 +693,7 @@ class ElasticAgent:
                 TrainingExceptionLevel.PROCESS_ERROR,
                 restart_count=self._restart_count,
                 fatal=exhausted,
+                diagnostics=digest,
             )
         except Exception:  # noqa: BLE001
             # An unreachable master must not take the agent down with
@@ -574,6 +701,9 @@ class ElasticAgent:
             logger.warning(
                 "could not report failure to master", exc_info=True
             )
+        self.client.report_diagnostics(
+            "crash", bundle_path=bundle_path, digest=digest
+        )
         if exhausted:
             logger.error(
                 "exhausted %d restarts; giving up", self.config.max_restarts
@@ -617,15 +747,45 @@ class ElasticAgent:
         return self.client.num_nodes_waiting() > 0
 
     def _heartbeat_loop(self) -> None:
+        streak = 0
+        next_warn = 1
         while not self._stop.wait(self.config.heartbeat_interval):
             try:
                 action = self.client.heartbeat()
-                if action == "restart_training":
-                    self._restart_requested.set()
-                elif action == "stop_training":
-                    self._stop.set()
             except Exception:  # noqa: BLE001
-                logger.warning("heartbeat failed", exc_info=True)
+                # Repeated failures are counted, and warned once per
+                # power-of-two streak length — a master outage must
+                # show up in telemetry without a log line per tick.
+                streak += 1
+                _HEARTBEAT_FAILURES.inc()
+                if streak >= next_warn:
+                    logger.warning(
+                        "heartbeat failed (%d consecutive "
+                        "failure%s; next warning at %d)",
+                        streak,
+                        "" if streak == 1 else "s",
+                        next_warn * 2,
+                        exc_info=True,
+                    )
+                    next_warn *= 2
+                continue
+            if streak:
+                logger.info(
+                    "heartbeat recovered after %d failure%s",
+                    streak, "" if streak == 1 else "s",
+                )
+                streak = 0
+                next_warn = 1
+            if action == EventAction.RESTART_TRAINING.value:
+                self._restart_requested.set()
+            elif action == EventAction.STOP_TRAINING.value:
+                self._stop.set()
+            elif action == EventAction.DIAGNOSE.value:
+                try:
+                    self._run_diagnose()
+                except Exception:  # noqa: BLE001 — an on-demand
+                    # snapshot must never take the heartbeat down
+                    logger.warning("diagnose failed", exc_info=True)
 
     def stop(self) -> None:
         self._stop.set()

@@ -60,9 +60,12 @@ class HangDetector:
         self.reset()
 
     def reset(self) -> None:
-        self._started_at = time.time()
+        # Monotonic, NOT wall clock: an NTP step would otherwise fake
+        # a hang (clock jumps forward) or mask a real one (clock jumps
+        # back) — hang detection measures elapsed time, nothing else.
+        self._started_at = time.monotonic()
         self._last_step = -1
-        self._last_progress = time.time()
+        self._last_progress = time.monotonic()
         self._hang_reported = False
 
     def _read_step(self) -> Optional[int]:
@@ -74,7 +77,7 @@ class HangDetector:
 
     def check(self) -> bool:
         """True when the training process should be considered hung."""
-        now = time.time()
+        now = time.monotonic()
         step = self._read_step()
         # ANY step change counts as progress: a resume may restart at
         # a LOWER step than the previous incarnation's high-water mark
@@ -105,4 +108,9 @@ class HangDetector:
         return hung
 
     def seconds_since_progress(self) -> float:
-        return time.time() - self._last_progress
+        return time.monotonic() - self._last_progress
+
+    @property
+    def last_step(self) -> int:
+        """Last step observed before the stall (-1: never stepped)."""
+        return self._last_step

@@ -93,6 +93,7 @@ class MasterClient:
         level: str,
         restart_count: int = 0,
         fatal: bool = False,
+        diagnostics: str = "",
     ) -> str:
         resp = self._client.report(
             msg.NodeFailureReport(
@@ -101,6 +102,7 @@ class MasterClient:
                 level=level,
                 restart_count=restart_count,
                 fatal=fatal,
+                diagnostics=diagnostics,
             )
         )
         return resp.action if resp else NodeAction.RESTART_IN_PLACE
@@ -333,6 +335,37 @@ class MasterClient:
             )
         except Exception:  # noqa: BLE001 - telemetry is best-effort
             pass
+
+    # -- forensics ----------------------------------------------------------
+
+    def report_diagnostics(
+        self, kind: str, bundle_path: str = "", digest: str = ""
+    ):
+        """Ship a forensics digest (hang / crash / on-demand diagnose)
+        to the master's per-node history. Best-effort: forensics must
+        never block or fail the recovery path it documents."""
+        try:
+            self._client.report(
+                msg.DiagnosticsReport(
+                    node_id=self.node_id,
+                    kind=kind,
+                    bundle_path=bundle_path,
+                    digest=digest,
+                    timestamp=time.time(),
+                )
+            )
+        except Exception:  # noqa: BLE001 - telemetry is best-effort
+            logger.warning(
+                "could not ship %s diagnostics to master", kind,
+                exc_info=True,
+            )
+
+    def query_diagnostics(self, node_id: int = -1) -> List:
+        """The master's stored DiagnosticsReport history (tools)."""
+        resp = self._client.get(
+            msg.DiagnosticsQueryRequest(node_id=node_id)
+        )
+        return list(resp.reports)
 
     # -- PS-elastic sparse path ------------------------------------------
 

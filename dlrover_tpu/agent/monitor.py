@@ -314,6 +314,10 @@ class TrainingMonitor:
         ``recent_step_times`` window the agent forwards to the
         master's straggler scorer."""
         obs.event("trainer.step", step=step, tokens=tokens)
+        # Last-known-step into the black box: one dict update, so a
+        # crash bundle can say how far training got even when the
+        # metrics file is gone with the container.
+        obs.recorder_note(step=step, tokens=tokens)
         path = path or os.getenv(METRICS_FILE_ENV, default_metrics_file())
         recent = TrainingMonitor._recent_step_times.setdefault(
             path, collections.deque(maxlen=RECENT_STEP_TIMES)

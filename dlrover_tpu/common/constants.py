@@ -240,3 +240,7 @@ class EventAction(str, enum.Enum):
     RESTART_TRAINING = "restart_training"
     STOP_TRAINING = "stop_training"
     SAVE_CHECKPOINT = "save_checkpoint"
+    # Take an on-demand forensics snapshot: the agent SIGUSR1s its
+    # training process for a stack dump, writes its own recorder
+    # bundle, and ships a DiagnosticsReport back to the master.
+    DIAGNOSE = "diagnose"

@@ -317,11 +317,48 @@ class NodeFailureReport:
     # True when the reporting agent has exhausted its local restart
     # budget: the node is done, do not relaunch.
     fatal: bool = False
+    # Size-capped forensics digest (bundle path + top stack frames +
+    # last recorder events) attached by the agent on hangs/crashes.
+    # Deliberately separate from error_data: the exit classifier must
+    # key on the raw stderr only, never on stack-frame file names.
+    diagnostics: str = ""
 
 
 @message
 class NodeSucceededReport:
     node_id: int = -1
+
+
+@message
+class DiagnosticsReport:
+    """Agent -> master: one forensics digest (hang, crash, or an
+    on-demand ``diagnose`` snapshot). ``bundle_path`` points at the
+    full JSON black-box bundle on the reporting host's forensics dir;
+    ``digest`` is the size-capped summary (top stack frames, last
+    notes/log lines) safe to keep in master memory and render over
+    RPC. The master keeps a bounded per-node history
+    (``DiagnosticsQueryRequest``)."""
+
+    node_id: int = -1
+    kind: str = ""  # "hang" | "crash" | "diagnose" | ...
+    bundle_path: str = ""
+    digest: str = ""
+    timestamp: float = 0.0
+
+
+@message
+class DiagnosticsQueryRequest:
+    """Fetch the master's per-node diagnostics history; ``node_id``
+    -1 means every node."""
+
+    node_id: int = -1
+
+
+@message
+class DiagnosticsQueryResponse:
+    reports: List[DiagnosticsReport] = dataclasses.field(
+        default_factory=list
+    )
 
 
 @message

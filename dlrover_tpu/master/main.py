@@ -53,6 +53,13 @@ def parse_args(argv=None):
 
 def main(argv=None) -> int:
     args = parse_args(argv)
+    # Black box before anything can crash: the master process's
+    # flight recorder (crash bundles + WARNING+ log ring). Installed
+    # here, not in JobMaster.prepare(), so in-process test harnesses
+    # never get their excepthooks rewired implicitly.
+    from dlrover_tpu import obs
+
+    obs.install_flight_recorder("master")
     try:
         master = JobMaster(
             port=args.port,

@@ -104,6 +104,11 @@ class JobMaster:
             ps_manager=self.ps_manager,
             fleet=self.fleet,
         )
+        # A freshly-scored straggler gets a fleet `diagnose`: its
+        # agent SIGUSR1s the training process and ships the stack
+        # digest back while the host is still slow — verdicts become
+        # diagnosable, not just flagged.
+        self.speed_monitor.on_straggler = self.servicer.diagnose_node
         # PS-strategy auto-scaling starts on demand (sparse/CTR jobs):
         # master.start_ps_autoscaler() wires the hot-PS optimizer to
         # the registered PS fleet.

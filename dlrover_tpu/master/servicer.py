@@ -6,9 +6,12 @@ dispatch), rebuilt on the typed dispatcher of common/comm.py.
 
 from __future__ import annotations
 
+import collections
+import threading
 import time
-from typing import Optional
+from typing import Deque, Dict, Optional
 
+from dlrover_tpu import obs
 from dlrover_tpu.common import messages as msg
 from dlrover_tpu.common.comm import RpcDispatcher
 from dlrover_tpu.common.constants import EventAction, RendezvousName
@@ -23,6 +26,20 @@ from dlrover_tpu.master.speed_monitor import SpeedMonitor
 from dlrover_tpu.master.task_manager import TaskManager
 
 logger = get_logger("servicer")
+
+_FORENSICS_TOTAL = obs.counter(
+    "dlrover_forensics_bundles_total",
+    "Forensics bundles reported to the master, by node and kind "
+    "(hang / crash / diagnose)",
+    ("node", "kind"),
+)
+
+# Bounded per-node queues: how many pushed-but-undelivered actions a
+# node may accumulate, and how many diagnostics digests the master
+# retains per node.
+MAX_PENDING_ACTIONS = 16
+DIAGNOSTICS_HISTORY = 8
+MAX_STORED_DIGEST = 16384
 
 
 class MasterServicer:
@@ -66,8 +83,20 @@ class MasterServicer:
                 speed_monitor=self.speed_monitor, attach=False
             )
         self.fleet = fleet
-        # actions queued for agents, popped on heartbeat
-        self._pending_actions: dict[int, str] = {}
+        # Actions queued for agents: a bounded per-node FIFO drained
+        # one action per heartbeat. (A plain node_id -> action dict
+        # silently dropped the first action when a second was pushed
+        # before the next heartbeat — e.g. a restart_training
+        # overwritten by a diagnose.)
+        self._actions_lock = threading.Lock()
+        self._pending_actions: Dict[int, Deque[str]] = {}
+        # Per-node forensics history (DiagnosticsReport digests),
+        # bounded so a crash-looping node cannot grow master memory.
+        # Locked: report and query arrive on different RPC worker
+        # threads (iterating a deque while another thread appends
+        # raises RuntimeError).
+        self._diagnostics_lock = threading.Lock()
+        self._diagnostics: Dict[int, Deque[msg.DiagnosticsReport]] = {}
         # auto-tuner output pulled by agents (ref: master-pushed
         # ParallelConfig, elastic_agent/config/paral_config_tuner.py)
         self.parallel_config = msg.ParallelConfig()
@@ -95,6 +124,7 @@ class MasterServicer:
         g(msg.JobNodesRequest, self._get_job_nodes)
         g(msg.ParallelConfigRequest, self._get_parallel_config)
         g(msg.MetricsRequest, self._get_metrics)
+        g(msg.DiagnosticsQueryRequest, self._query_diagnostics)
 
         r(msg.KVStoreSetRequest, self._kv_set)
         r(msg.DatasetShardParams, self._create_dataset)
@@ -103,6 +133,7 @@ class MasterServicer:
         r(msg.StepReport, self._report_step)
         r(msg.ResourceStats, self._report_resource)
         r(msg.MetricsSnapshotReport, self._report_metrics_snapshot)
+        r(msg.DiagnosticsReport, self._report_diagnostics)
         r(msg.NodeFailureReport, self._report_failure)
         r(msg.NodeSucceededReport, self._report_succeeded)
         r(msg.HeartbeatRequest, self._heartbeat)
@@ -264,6 +295,21 @@ class MasterServicer:
     def _report_failure(self, req: msg.NodeFailureReport):
         node = self.job_manager.get_node(req.node_id)
         rank = node.rank if node is not None else req.node_id
+        if req.diagnostics:
+            # Attached forensics digest: surfaced in the master log +
+            # trace (the bounded history is fed by the agent's
+            # companion DiagnosticsReport), kept OUT of the exit
+            # classifier's error_data.
+            obs.event(
+                "node.failure_diagnostics",
+                node_id=req.node_id,
+                size=len(req.diagnostics),
+            )
+            logger.info(
+                "failure diagnostics from node %d:\n%s",
+                req.node_id,
+                req.diagnostics[:MAX_STORED_DIGEST],
+            )
         action = self.job_manager.handle_failure_report(
             req.node_id,
             req.error_data,
@@ -295,11 +341,93 @@ class MasterServicer:
 
     def _heartbeat(self, req: msg.HeartbeatRequest):
         self.job_manager.update_heartbeat(req.node_id)
-        action = self._pending_actions.pop(req.node_id, EventAction.NONE.value)
+        action = EventAction.NONE.value
+        with self._actions_lock:
+            queue = self._pending_actions.get(req.node_id)
+            if queue:
+                action = queue.popleft()
+                if not queue:
+                    del self._pending_actions[req.node_id]
         return msg.HeartbeatResponse(action=action)
 
     def push_action(self, node_id: int, action: str) -> None:
-        self._pending_actions[node_id] = action
+        """Queue an action for the node's next heartbeats (FIFO, one
+        per heartbeat). Control actions are idempotent, so an action
+        already queued is not queued again (two node deaths in one
+        monitor tick mean ONE restart_training per survivor, exactly
+        as the old last-write-wins dict behaved — without it being
+        able to silently swallow a DIFFERENT action). Bounded: when a
+        node stops heartbeating, the oldest action is dropped (with a
+        warning) rather than growing the queue forever."""
+        with self._actions_lock:
+            queue = self._pending_actions.setdefault(
+                node_id, collections.deque()
+            )
+            if action in queue:
+                return
+            if len(queue) >= MAX_PENDING_ACTIONS:
+                dropped = queue.popleft()
+                logger.warning(
+                    "node %d action queue full (%d); dropping oldest "
+                    "action %r to enqueue %r",
+                    node_id, MAX_PENDING_ACTIONS, dropped, action,
+                )
+            queue.append(action)
+
+    def pending_actions(self, node_id: int) -> list:
+        """Undelivered actions for a node (observability/tests)."""
+        with self._actions_lock:
+            return list(self._pending_actions.get(node_id, ()))
+
+    # -- forensics / diagnostics -------------------------------------------
+
+    def _report_diagnostics(self, req: msg.DiagnosticsReport):
+        record = msg.DiagnosticsReport(
+            node_id=req.node_id,
+            kind=req.kind or "unknown",
+            bundle_path=req.bundle_path,
+            digest=(req.digest or "")[:MAX_STORED_DIGEST],
+            timestamp=req.timestamp or time.time(),
+        )
+        with self._diagnostics_lock:
+            history = self._diagnostics.setdefault(
+                req.node_id,
+                collections.deque(maxlen=DIAGNOSTICS_HISTORY),
+            )
+            history.append(record)
+        _FORENSICS_TOTAL.inc(node=str(req.node_id), kind=record.kind)
+        obs.event(
+            "node.diagnostics",
+            node_id=req.node_id,
+            kind=record.kind,
+            bundle_path=record.bundle_path,
+        )
+        logger.info(
+            "forensics from node %d (%s): bundle=%s digest=%d bytes",
+            req.node_id, record.kind, record.bundle_path or "-",
+            len(record.digest),
+        )
+        return None
+
+    def _query_diagnostics(self, req: msg.DiagnosticsQueryRequest):
+        with self._diagnostics_lock:
+            if req.node_id >= 0:
+                reports = list(
+                    self._diagnostics.get(req.node_id, ())
+                )
+            else:
+                reports = [
+                    r
+                    for node_id in sorted(self._diagnostics)
+                    for r in self._diagnostics[node_id]
+                ]
+        return msg.DiagnosticsQueryResponse(reports=reports)
+
+    def diagnose_node(self, node_id: int) -> None:
+        """Queue an on-demand stack-and-state snapshot on the node
+        (operator trigger or the SpeedMonitor's straggler/hang
+        verdict); delivered via its next heartbeat."""
+        self.push_action(node_id, EventAction.DIAGNOSE.value)
 
     def _register_node(self, req: msg.NodeAddressRequest):
         node = self.job_manager.register_node(

@@ -84,6 +84,12 @@ class SpeedMonitor:
         self._host_step_ewma: Dict[int, float] = {}
         self._host_step_samples: Dict[int, int] = {}
         self._known_stragglers: Set[int] = set()
+        # Called with a node_id when it is NEWLY scored a straggler —
+        # the JobMaster wires this to push a `diagnose` action so a
+        # host that went slow gets a stack-and-state snapshot while
+        # it is still being slow. Exceptions are swallowed: a broken
+        # trigger must not poison step accounting.
+        self.on_straggler = None
 
     # -- throughput window ---------------------------------------------------
 
@@ -361,6 +367,11 @@ class SpeedMonitor:
                     self._host_step_ewma.get(node_id, 0.0), 6
                 ),
             )
+            if self.on_straggler is not None:
+                try:
+                    self.on_straggler(node_id)
+                except Exception:  # noqa: BLE001
+                    pass
         for node_id in sorted(recovered):
             obs.event(
                 "node.straggler_recovered",

@@ -28,6 +28,14 @@ doing through this package, so "what is the job doing right now" and
 * :mod:`dlrover_tpu.obs.goodput` — exhaustive goodput/badput wall-time
   attribution (productive / compile / data_wait / checkpoint /
   recovery / idle_unknown) over the job's event stream.
+* :mod:`dlrover_tpu.obs.flight_recorder` — the always-on black box:
+  a bounded in-memory ring (WARNING+ logs, last step/loss notes)
+  plus faulthandler / excepthook / SIGUSR1 crash hooks that dump a
+  JSON bundle with all-thread Python stacks to the per-run forensics
+  dir on any crash or hang.
+* :mod:`dlrover_tpu.obs.postmortem` — folds a forensics dir (bundles,
+  faulthandler stack dumps, traces) into the "last 60 seconds before
+  failure" report ``tools/obs_report.py --postmortem`` prints.
 
 The functions re-exported here are the instrumentation surface the
 rest of the codebase uses::
@@ -61,6 +69,14 @@ from dlrover_tpu.obs.tracer import (  # noqa: F401
     tracing_enabled,
 )
 from dlrover_tpu.obs.fleet import FleetAggregator  # noqa: F401
+from dlrover_tpu.obs.flight_recorder import (  # noqa: F401
+    FlightRecorder,
+    forensics_dir,
+    get_flight_recorder,
+    install_flight_recorder,
+    recorder_note,
+    uninstall_flight_recorder,
+)
 from dlrover_tpu.obs.goodput import (  # noqa: F401
     GoodputAccountant,
     GoodputReport,

@@ -1,0 +1,295 @@
+"""Postmortem assembly: fold a forensics dir into one failure report.
+
+A crashed or hung run leaves three kinds of artifacts in its
+forensics dir (obs/flight_recorder.py):
+
+* ``bundle_*.json`` — per-process black-box bundles (ring contents,
+  all-thread Python stacks, notes, env/process info);
+* ``stacks_*.txt`` — faulthandler text dumps (fatal signals and the
+  agent's SIGUSR1 while-hung snapshots);
+* optionally ``*.jsonl`` — tracer event exports, when the run traced
+  to a file inside the same dir.
+
+:func:`render_postmortem` merges them into a "last N seconds before
+failure" narrative: the failure instant, the recovery-timeline and
+goodput attribution over the trailing window, then each bundle's
+per-thread stacks and last log lines, then the final faulthandler
+dump of each stacks file. Pure functions over files — hermetically
+covered by ``tools/obs_report.py --selftest`` and
+tests/test_forensics.py.
+"""
+
+from __future__ import annotations
+
+import glob
+import json
+import os
+import re
+from typing import List, Optional, Tuple
+
+from dlrover_tpu.obs.goodput import attribute_goodput, render_goodput
+from dlrover_tpu.obs.timeline import (
+    load_events,
+    reconstruct_recovery_timeline,
+    render_timeline,
+)
+
+# Events that mark "the failure" (latest wins), in the order the
+# master/agent emit them around a death or hang.
+FAILURE_EVENT_NAMES = (
+    "node.fail",
+    "node.gone",
+    "node.heartbeat_timeout",
+    "agent.hang_detected",
+)
+
+_STACKS_TAIL_CAP = 16384
+_MAX_RENDER_FRAMES = 12
+_MAX_RENDER_LOGS = 8
+
+
+def load_bundles(dir_: str) -> List[dict]:
+    """Parse every ``bundle_*.json`` (unparseable files are skipped),
+    oldest first by bundle timestamp."""
+    bundles = []
+    for path in sorted(glob.glob(os.path.join(dir_, "bundle_*.json"))):
+        try:
+            with open(path) as f:
+                bundle = json.load(f)
+        except (OSError, ValueError):
+            continue
+        if isinstance(bundle, dict):
+            bundle["_path"] = path
+            bundles.append(bundle)
+    bundles.sort(key=lambda b: float(b.get("ts", 0.0)))
+    return bundles
+
+
+def last_fault_dump(text: str) -> str:
+    """The terminal faulthandler content of a stacks file.
+
+    A fatal crash writes one ``Fatal Python error:`` header followed
+    by per-thread sections — return from the LAST such header (the
+    thread markers inside it belong to it). Without a Fatal header
+    (SIGUSR1 while-hung snapshots have only thread sections), return
+    from the first thread marker, i.e. everything after the install
+    header comment — consecutive snapshots are indistinguishable
+    without timestamps and all of them are forensically relevant."""
+    fatals = [
+        m.start()
+        for m in re.finditer(
+            r"^Fatal Python error:", text, re.MULTILINE
+        )
+    ]
+    if fatals:
+        return text[fatals[-1]:].strip()
+    threads = [
+        m.start()
+        for m in re.finditer(
+            r"^(Current thread|Thread) 0x", text, re.MULTILINE
+        )
+    ]
+    if not threads:
+        return ""
+    return text[threads[0]:].strip()
+
+
+def load_stack_dumps(dir_: str) -> List[dict]:
+    """``stacks_*.txt`` files with their last dump pre-extracted."""
+    dumps = []
+    for path in sorted(glob.glob(os.path.join(dir_, "stacks_*.txt"))):
+        try:
+            with open(path, "rb") as f:
+                f.seek(0, os.SEEK_END)
+                size = f.tell()
+                f.seek(max(size - _STACKS_TAIL_CAP, 0))
+                text = f.read().decode("utf-8", "replace")
+        except OSError:
+            continue
+        m = re.search(r"stacks_(\d+)\.txt$", path)
+        dumps.append(
+            {
+                "path": path,
+                "pid": int(m.group(1)) if m else -1,
+                "text": text,
+                "last_dump": last_fault_dump(text),
+            }
+        )
+    return dumps
+
+
+def collect_events(dir_: str, bundles: List[dict]) -> List[dict]:
+    """Union of bundle event rings and any ``*.jsonl`` traces in the
+    dir, deduped on (name, ts) and time-ordered."""
+    events: List[dict] = []
+    for bundle in bundles:
+        events.extend(
+            e for e in bundle.get("events", []) if isinstance(e, dict)
+        )
+    for path in sorted(glob.glob(os.path.join(dir_, "*.jsonl"))):
+        try:
+            events.extend(load_events(path))
+        except OSError:
+            continue
+    seen = set()
+    unique = []
+    for e in events:
+        if "ts" not in e or "name" not in e:
+            continue
+        key = (e["name"], e["ts"], e.get("pid"))
+        if key in seen:
+            continue
+        seen.add(key)
+        unique.append(e)
+    unique.sort(key=lambda e: e["ts"])
+    return unique
+
+
+def failure_instant(
+    events: List[dict], bundles: List[dict]
+) -> Tuple[Optional[float], str]:
+    """(ts, source) of the failure: the latest failure-class event,
+    else the latest bundle, else the latest event."""
+    marks = [
+        e for e in events if e.get("name") in FAILURE_EVENT_NAMES
+    ]
+    if marks:
+        last = max(marks, key=lambda e: e["ts"])
+        return float(last["ts"]), str(last["name"])
+    if bundles:
+        last_b = max(bundles, key=lambda b: float(b.get("ts", 0.0)))
+        return (
+            float(last_b.get("ts", 0.0)),
+            f"bundle:{last_b.get('kind', '?')}",
+        )
+    if events:
+        return float(events[-1]["ts"]), "last_event"
+    return None, ""
+
+
+def _render_bundle(bundle: dict) -> List[str]:
+    lines = [
+        f"bundle {os.path.basename(bundle.get('_path', '?'))} "
+        f"[{bundle.get('kind', '?')}] role={bundle.get('role', '?')}"
+        f"/r{bundle.get('rank', '?')} pid={bundle.get('pid', '?')} "
+        f"ts={float(bundle.get('ts', 0.0)):.3f}"
+    ]
+    reason = str(bundle.get("reason", "") or "")
+    if reason:
+        lines.append(f"  reason: {reason[:300]}")
+    notes = bundle.get("notes") or {}
+    if notes:
+        rendered = ", ".join(
+            f"{k}={v}" for k, v in sorted(notes.items())
+        )
+        lines.append(f"  notes: {rendered[:300]}")
+    proc = bundle.get("proc") or {}
+    if proc:
+        lines.append(
+            f"  proc: python {proc.get('python', '?')}, "
+            f"jax={proc.get('jax_platform', '?')}"
+        )
+    tb = str(bundle.get("traceback", "") or "")
+    if tb:
+        lines.append("  traceback:")
+        for tb_line in tb.strip().splitlines():
+            lines.append(f"    {tb_line}")
+    trainer_stacks = str(bundle.get("trainer_stacks", "") or "")
+    if trainer_stacks:
+        lines.append("  trainer stacks (agent SIGUSR1 snapshot):")
+        for ts_line in trainer_stacks.strip().splitlines():
+            lines.append(f"    {ts_line}")
+    for stack in bundle.get("stacks", []):
+        flag = " (current)" if stack.get("current") else ""
+        daemon = " daemon" if stack.get("daemon") else ""
+        lines.append(
+            f"  thread {stack.get('thread', '?')}{daemon}{flag}:"
+        )
+        frames = stack.get("frames", [])
+        # Innermost frames carry the verdict: render the tail.
+        for frame in frames[-_MAX_RENDER_FRAMES:]:
+            lines.append(f"    {frame}")
+    logs = bundle.get("logs", [])
+    if logs:
+        lines.append("  last logs:")
+        for rec in logs[-_MAX_RENDER_LOGS:]:
+            lines.append(
+                f"    {rec.get('level', '?'):<8}"
+                f" {str(rec.get('msg', ''))[:160]}"
+            )
+    return lines
+
+
+def render_postmortem(dir_: str, window: float = 60.0) -> str:
+    """The merged report; raises nothing, returns a message when the
+    dir holds no forensics artifacts."""
+    bundles = load_bundles(dir_)
+    stack_dumps = load_stack_dumps(dir_)
+    if not bundles and not stack_dumps:
+        return f"no forensics artifacts (bundle_*.json / stacks_*.txt) in {dir_}"
+    events = collect_events(dir_, bundles)
+    t_fail, source = failure_instant(events, bundles)
+    kinds: dict = {}
+    for b in bundles:
+        kinds[b.get("kind", "?")] = kinds.get(b.get("kind", "?"), 0) + 1
+    kind_s = ", ".join(f"{k} x{n}" for k, n in sorted(kinds.items()))
+    lines = [
+        f"postmortem: {dir_}",
+        f"  {len(bundles)} bundle(s)"
+        + (f" ({kind_s})" if kind_s else "")
+        + f", {len(stack_dumps)} stack dump(s), {len(events)} event(s)",
+    ]
+    windowed = events
+    if t_fail is not None:
+        lines.append(
+            f"  failure instant: {t_fail:.3f} (from {source})"
+        )
+        windowed = [
+            e
+            for e in events
+            if t_fail - window <= e["ts"] <= t_fail + window
+        ]
+        lines.append(
+            f"\nlast {window:.0f}s before failure "
+            f"({len(windowed)} events):"
+        )
+        for e in windowed[-15:]:
+            extras = {
+                k: v
+                for k, v in e.items()
+                if k
+                not in ("name", "ts", "mono", "pid", "role", "rank")
+            }
+            extra_s = (
+                " " + json.dumps(extras, default=str)
+                if extras
+                else ""
+            )
+            lines.append(
+                f"  {e['ts'] - t_fail:+8.3f}s {e['name']}{extra_s}"
+            )
+    if windowed:
+        tl = reconstruct_recovery_timeline(windowed)
+        if tl is not None:
+            lines.append("")
+            lines.append(render_timeline(tl))
+        gp = attribute_goodput(windowed)
+        if gp is not None:
+            lines.append("")
+            lines.append(render_goodput(gp))
+    for bundle in bundles:
+        lines.append("")
+        lines.extend(_render_bundle(bundle))
+    for dump in stack_dumps:
+        lines.append("")
+        lines.append(
+            f"stack dump {os.path.basename(dump['path'])} "
+            f"(pid {dump['pid']}):"
+        )
+        body = dump["last_dump"] or dump["text"].strip()
+        if not body:
+            lines.append("  (empty)")
+            continue
+        for text_line in body.splitlines():
+            lines.append(f"  {text_line}")
+    return "\n".join(lines)

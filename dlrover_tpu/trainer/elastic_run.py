@@ -218,6 +218,12 @@ def run(args) -> int:
     # Role/rank tag for logs (common/log.py) and obs trace events —
     # inherited by the agent's trainer subprocesses.
     os.environ["DLROVER_TPU_ROLE"] = args.role
+    # The agent process's black box (crash bundles, hang forensics
+    # assembly). Installed at the CLI entry, not ElasticAgent.run(),
+    # so in-process test agents never rewire pytest's excepthooks.
+    from dlrover_tpu import obs
+
+    obs.install_flight_recorder("agent", rank=node_rank)
     MasterClient.reset()
 
     if args.module:

@@ -43,6 +43,22 @@ def setup_distributed() -> None:
     global _initialized
     if _initialized:
         return
+    # Black box before the backend: an agent-supervised training
+    # process gets its flight recorder (crash bundles + the SIGUSR1
+    # while-hung stack-dump contract the agent's hang forensics rely
+    # on) before jax.distributed can wedge or die. Standalone runs
+    # opt in with DLROVER_TPU_FLIGHT_RECORDER=1 or a direct
+    # obs.install_flight_recorder("trainer") call — in-process test
+    # harnesses must not have their excepthooks rewired implicitly.
+    if (
+        os.getenv("DLROVER_TPU_AGENT_PRESENT", "") == "1"
+        or os.getenv("DLROVER_TPU_FLIGHT_RECORDER", "") == "1"
+    ):
+        from dlrover_tpu import obs
+
+        obs.install_flight_recorder(
+            "trainer", rank=int(os.getenv(NodeEnv.NODE_RANK, "-1"))
+        )
     # Honor an explicit JAX_PLATFORMS=cpu even when a TPU plugin
     # preregistered itself (the env var alone loses to a registered
     # backend): CPU-mesh test runs set this to get the virtual

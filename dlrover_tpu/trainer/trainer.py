@@ -20,6 +20,7 @@ from typing import Any, Callable, Optional, Tuple
 
 import numpy as np
 
+from dlrover_tpu import obs
 from dlrover_tpu.common.log import get_logger
 
 logger = get_logger("trainer")
@@ -257,10 +258,14 @@ class Trainer:
                     step_time=step_wall,
                 )
                 if step % args.log_steps == 0:
+                    loss_val = materialize(last_loss, reason="log")
+                    # The already-paid host sync doubles as the black
+                    # box's last-known-loss (no extra fetch).
+                    obs.recorder_note(loss=float(loss_val))
                     logger.info(
                         "step %d: loss %.4f (%.1f steps/s)",
                         step,
-                        materialize(last_loss, reason="log"),
+                        loss_val,
                         args.log_steps / max(time.time() - t0, 1e-9),
                     )
                     t0 = time.time()

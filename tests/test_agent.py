@@ -239,6 +239,10 @@ class TestStandaloneCli:
 
         env_backup = dict(os.environ)
         try:
+            # main() runs IN-PROCESS here: keep the agent's flight
+            # recorder from rewiring pytest's excepthook/faulthandler
+            # (restored with the env below).
+            os.environ["DLROVER_TPU_FLIGHT_RECORDER"] = "0"
             MasterClient.reset()
             code = main(
                 [

@@ -1,0 +1,617 @@
+"""Crash & hang forensics: flight recorder, postmortem, diagnostics.
+
+Crash capture runs in REAL subprocesses (an excepthook or a C-level
+faulthandler dump can only be proven by actually dying); the agent's
+hang-forensics assembly and the master's diagnostics channel are
+exercised in-process. Everything is hermetic — no JAX distributed, no
+cluster.
+"""
+
+import collections
+import json
+import os
+import signal
+import subprocess
+import sys
+import threading
+import time
+
+import pytest
+
+from dlrover_tpu import obs
+from dlrover_tpu.agent.agent import AgentConfig, ElasticAgent
+from dlrover_tpu.common import messages as msg
+from dlrover_tpu.common.constants import EventAction
+from dlrover_tpu.master.servicer import (
+    DIAGNOSTICS_HISTORY,
+    MAX_PENDING_ACTIONS,
+)
+from dlrover_tpu.obs import flight_recorder as fr
+from dlrover_tpu.obs.postmortem import (
+    collect_events,
+    last_fault_dump,
+    load_bundles,
+    load_stack_dumps,
+    render_postmortem,
+)
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+OBS_REPORT = os.path.join(REPO, "tools", "obs_report.py")
+
+
+def _run_child(code: str, forensics_dir: str, timeout: float = 60.0):
+    env = dict(os.environ)
+    env["DLROVER_TPU_FORENSICS_DIR"] = forensics_dir
+    env["PYTHONPATH"] = REPO + os.pathsep + env.get("PYTHONPATH", "")
+    return subprocess.run(
+        [sys.executable, "-c", code],
+        env=env,
+        capture_output=True,
+        text=True,
+        timeout=timeout,
+    )
+
+
+class TestCrashBundles:
+    def test_unhandled_exception_writes_parseable_bundle(self, tmp_path):
+        d = str(tmp_path)
+        result = _run_child(
+            "from dlrover_tpu import obs\n"
+            "obs.install_flight_recorder('trainer', rank=3)\n"
+            "obs.recorder_note(step=17, loss=0.5)\n"
+            "def explode():\n"
+            "    raise RuntimeError('forensics boom')\n"
+            "explode()\n",
+            d,
+        )
+        assert result.returncode != 0
+        # The original traceback still reaches stderr (chained hook).
+        assert "forensics boom" in result.stderr
+        bundles = load_bundles(d)
+        assert len(bundles) == 1
+        bundle = bundles[0]
+        assert bundle["kind"] == "exception"
+        assert "RuntimeError" in bundle["reason"]
+        assert bundle["role"] == "trainer" and bundle["rank"] == 3
+        assert bundle["notes"] == {"step": 17, "loss": 0.5}
+        assert "explode" in bundle["traceback"]
+        # Non-empty all-thread Python stacks.
+        assert bundle["stacks"]
+        assert any(s["frames"] for s in bundle["stacks"])
+        assert any(s["thread"] == "MainThread" for s in bundle["stacks"])
+        # --postmortem renders it.
+        report = render_postmortem(d)
+        assert "forensics boom" in report
+        assert "notes: loss=0.5, step=17" in report
+
+    def test_fatal_signal_dumps_stacks_via_faulthandler(self, tmp_path):
+        d = str(tmp_path)
+        result = _run_child(
+            "import faulthandler\n"
+            "from dlrover_tpu import obs\n"
+            "obs.install_flight_recorder('trainer', rank=0)\n"
+            "def die_hard():\n"
+            "    faulthandler._sigsegv()\n"
+            "die_hard()\n",
+            d,
+        )
+        assert result.returncode == -signal.SIGSEGV
+        dumps = load_stack_dumps(d)
+        assert len(dumps) == 1
+        last = dumps[0]["last_dump"]
+        assert "Fatal Python error" in last
+        assert "die_hard" in last
+        report = render_postmortem(d)
+        assert "die_hard" in report
+        assert "Fatal Python error" in report
+
+    def test_bundle_retention_bounded(self, tmp_path):
+        d = str(tmp_path)
+        rec = fr.FlightRecorder("agent", rank=0, dir_=d, keep=3)
+        for i in range(7):
+            assert rec.dump("manual", reason=f"n{i}") is not None
+        assert len(load_bundles(d)) == 3
+        # Oldest gone, newest kept.
+        reasons = {b["reason"] for b in load_bundles(d)}
+        assert reasons == {"n4", "n5", "n6"}
+
+
+class _HungChild:
+    """Context manager: a subprocess with an installed flight
+    recorder, wedged inside ``stuck_collective`` (the single-process
+    hang drill)."""
+
+    CODE = (
+        "import os, time\n"
+        "from dlrover_tpu import obs\n"
+        "obs.install_flight_recorder('trainer', rank=0)\n"
+        "open(os.environ['READY_FILE'], 'w').write('1')\n"
+        "def stuck_collective():\n"
+        "    time.sleep(120)\n"
+        "stuck_collective()\n"
+    )
+
+    def __init__(self, forensics_dir: str):
+        self.dir = forensics_dir
+        self.proc = None
+
+    def __enter__(self):
+        ready = os.path.join(self.dir, "ready")
+        env = dict(os.environ)
+        env["DLROVER_TPU_FORENSICS_DIR"] = self.dir
+        env["READY_FILE"] = ready
+        env["PYTHONPATH"] = (
+            REPO + os.pathsep + env.get("PYTHONPATH", "")
+        )
+        self.proc = subprocess.Popen(
+            [sys.executable, "-c", self.CODE], env=env
+        )
+        deadline = time.monotonic() + 30
+        while not os.path.exists(ready):
+            assert self.proc.poll() is None, "hung child died early"
+            assert time.monotonic() < deadline, "child never ready"
+            time.sleep(0.05)
+        return self.proc
+
+    def __exit__(self, *exc):
+        if self.proc is not None and self.proc.poll() is None:
+            self.proc.kill()
+            self.proc.wait()
+
+
+class TestHangForensics:
+    """Acceptance: an induced hang produces a forensics bundle with
+    the hung thread's Python stack, and --postmortem renders it."""
+
+    def test_agent_collects_hung_trainer_stack(
+        self, tmp_path, monkeypatch
+    ):
+        d = str(tmp_path)
+        monkeypatch.setenv("DLROVER_TPU_FORENSICS_DIR", d)
+        agent = ElasticAgent(
+            AgentConfig(node_id=0), ["true"], client=object()
+        )
+        try:
+            fr.install_flight_recorder("agent", rank=0, dir_=d)
+            with _HungChild(d) as proc:
+                agent._proc = proc
+                digest, bundle_path = agent._collect_forensics(
+                    "hang", hang_seconds=5.0, last_step=9
+                )
+            # Digest carries the hung thread's stack and the bundle
+            # pointer — exactly what the failure report attaches.
+            assert "stuck_collective" in digest
+            assert digest.startswith(f"bundle: {bundle_path}")
+            assert len(digest) <= 4096 + len(f"bundle: {bundle_path}\n")
+            assert os.path.exists(bundle_path)
+            bundle = json.load(open(bundle_path))
+            assert bundle["kind"] == "hang"
+            assert "stuck_collective" in bundle["trainer_stacks"]
+            assert bundle["notes"]["last_step"] == 9
+        finally:
+            fr.uninstall_flight_recorder()
+        # The postmortem CLI renders the hung stack from the dir.
+        result = subprocess.run(
+            [sys.executable, OBS_REPORT, "--postmortem", d],
+            capture_output=True,
+            text=True,
+            timeout=120,
+        )
+        assert result.returncode == 0, result.stderr
+        assert "stuck_collective" in result.stdout
+        assert "[hang]" in result.stdout
+
+    def test_never_signals_a_trainer_without_a_handler(
+        self, tmp_path, monkeypatch
+    ):
+        """Default SIGUSR1 disposition KILLS the process: the agent
+        must not signal a trainer whose recorder never registered the
+        handler (disabled via env, still importing, or failed)."""
+        d = str(tmp_path)
+        monkeypatch.setenv("DLROVER_TPU_FORENSICS_DIR", d)
+        proc = subprocess.Popen(
+            [sys.executable, "-c", "import time; time.sleep(60)"]
+        )
+        try:
+            agent = ElasticAgent(
+                AgentConfig(node_id=0), ["true"], client=object()
+            )
+            agent._proc = proc
+            assert not fr.sigusr1_ready(proc.pid)
+            stacks = agent._snapshot_trainer_stacks(timeout=0.5)
+            assert stacks == ""
+            time.sleep(0.2)
+            # The recorder-less child is still alive — not killed by
+            # a blind SIGUSR1.
+            assert proc.poll() is None
+        finally:
+            proc.kill()
+            proc.wait()
+
+    def test_incident_notes_do_not_stick_to_recorder(self, tmp_path):
+        """A hang's facts (hang_seconds, last_step) must not replay
+        in later diagnose/crash bundles from the same agent."""
+        d = str(tmp_path)
+        agent = ElasticAgent(
+            AgentConfig(node_id=0), ["true"], client=object()
+        )
+        try:
+            fr.install_flight_recorder("agent", rank=0, dir_=d)
+            digest, bundle_path = agent._collect_forensics(
+                "hang", hang_seconds=62.0, last_step=41
+            )
+            assert "last_step" in digest
+            assert json.load(open(bundle_path))["notes"][
+                "last_step"
+            ] == 41
+            digest2, bundle2 = agent._collect_forensics("diagnose")
+            assert "last_step" not in digest2
+            assert "last_step" not in json.load(open(bundle2))[
+                "notes"
+            ]
+        finally:
+            fr.uninstall_flight_recorder()
+
+    def test_snapshot_of_dead_trainer_reads_crash_tail(self, tmp_path):
+        d = str(tmp_path)
+        result = _run_child(
+            "import faulthandler\n"
+            "from dlrover_tpu import obs\n"
+            "obs.install_flight_recorder('trainer', rank=0)\n"
+            "faulthandler._sigsegv()\n",
+            d,
+        )
+        assert result.returncode == -signal.SIGSEGV
+
+        class DeadProc:
+            def __init__(self, pid):
+                self.pid = pid
+
+            def poll(self):
+                return -11
+
+        agent = ElasticAgent(
+            AgentConfig(node_id=0), ["true"], client=object()
+        )
+        # The pid whose stacks file the crash left behind.
+        agent._proc = DeadProc(load_stack_dumps(d)[0]["pid"])
+        with pytest.MonkeyPatch.context() as mp:
+            mp.setenv("DLROVER_TPU_FORENSICS_DIR", d)
+            stacks = agent._snapshot_trainer_stacks()
+        assert "Fatal Python error" in stacks
+
+
+class TestDiagnosticsChannel:
+    def _servicer(self):
+        from dlrover_tpu.master.job_manager import JobManager
+        from dlrover_tpu.master.rendezvous import (
+            ElasticRendezvous,
+            NetworkCheckRendezvous,
+        )
+        from dlrover_tpu.master.servicer import MasterServicer
+        from dlrover_tpu.master.task_manager import TaskManager
+
+        return MasterServicer(
+            job_manager=JobManager(),
+            task_manager=TaskManager(),
+            elastic_rdzv=ElasticRendezvous(),
+            check_rdzv=NetworkCheckRendezvous(),
+        )
+
+    def test_history_bounded_and_queryable(self):
+        servicer = self._servicer()
+        counter = obs.get_registry().get(
+            "dlrover_forensics_bundles_total"
+        )
+        before = counter.value(node="5", kind="hang")
+        for i in range(DIAGNOSTICS_HISTORY + 4):
+            servicer._report_diagnostics(
+                msg.DiagnosticsReport(
+                    node_id=5,
+                    kind="hang",
+                    bundle_path=f"/f/b{i}.json",
+                    digest=f"digest {i}",
+                )
+            )
+        assert (
+            counter.value(node="5", kind="hang")
+            == before + DIAGNOSTICS_HISTORY + 4
+        )
+        resp = servicer._query_diagnostics(
+            msg.DiagnosticsQueryRequest(node_id=5)
+        )
+        assert len(resp.reports) == DIAGNOSTICS_HISTORY
+        # Bounded history keeps the NEWEST reports.
+        assert resp.reports[-1].digest == (
+            f"digest {DIAGNOSTICS_HISTORY + 3}"
+        )
+        assert all(r.timestamp > 0 for r in resp.reports)
+        # node_id=-1 returns everything.
+        servicer._report_diagnostics(
+            msg.DiagnosticsReport(node_id=2, kind="diagnose")
+        )
+        all_resp = servicer._query_diagnostics(
+            msg.DiagnosticsQueryRequest(node_id=-1)
+        )
+        assert len(all_resp.reports) == DIAGNOSTICS_HISTORY + 1
+
+    def test_digest_storage_size_capped(self):
+        servicer = self._servicer()
+        servicer._report_diagnostics(
+            msg.DiagnosticsReport(
+                node_id=1, kind="crash", digest="x" * 100_000
+            )
+        )
+        resp = servicer._query_diagnostics(
+            msg.DiagnosticsQueryRequest(node_id=1)
+        )
+        assert len(resp.reports[0].digest) == 16384
+
+    def test_pending_actions_fifo_drained_one_per_heartbeat(self):
+        """Regression: push_action used to be last-write-wins — a
+        restart_training queued before a diagnose silently vanished."""
+        servicer = self._servicer()
+        servicer.push_action(7, EventAction.RESTART_TRAINING.value)
+        servicer.diagnose_node(7)
+        # Idempotent dedupe: a second push of an already-queued
+        # action collapses (two node deaths in one tick = one
+        # restart per survivor), but never displaces a different one.
+        servicer.push_action(7, EventAction.RESTART_TRAINING.value)
+        assert servicer.pending_actions(7) == [
+            "restart_training", "diagnose",
+        ]
+        beats = [
+            servicer._heartbeat(msg.HeartbeatRequest(node_id=7)).action
+            for _ in range(3)
+        ]
+        # FIFO order, one per heartbeat, then drained.
+        assert beats == ["restart_training", "diagnose", "none"]
+        assert servicer.pending_actions(7) == []
+        # Other nodes see nothing.
+        assert (
+            servicer._heartbeat(
+                msg.HeartbeatRequest(node_id=8)
+            ).action
+            == "none"
+        )
+
+    def test_pending_actions_bounded_drops_oldest(self):
+        servicer = self._servicer()
+        for i in range(MAX_PENDING_ACTIONS + 3):
+            servicer.push_action(1, f"a{i}")
+        queued = servicer.pending_actions(1)
+        assert len(queued) == MAX_PENDING_ACTIONS
+        assert queued[0] == "a3"  # oldest three dropped
+        assert queued[-1] == f"a{MAX_PENDING_ACTIONS + 2}"
+
+    def test_diagnostics_roundtrip_msgpack(self):
+        report = msg.DiagnosticsReport(
+            node_id=3, kind="hang", bundle_path="/p",
+            digest="top frames", timestamp=12.5,
+        )
+        resp = msg.DiagnosticsQueryResponse(reports=[report])
+        decoded = msg.deserialize(msg.serialize(resp))
+        assert decoded.reports[0] == report
+
+
+class TestStragglerDiagnose:
+    def test_fresh_straggler_queues_diagnose_action(self):
+        """The SpeedMonitor's straggler verdict triggers a fleet
+        `diagnose` through the master wiring — delivered on the slow
+        node's next heartbeat."""
+        from dlrover_tpu.master.master import JobMaster
+
+        master = JobMaster(port=0, node_num=3, rdzv_timeout=1.0)
+        try:
+            sm = master.speed_monitor
+            assert sm.on_straggler is not None
+            for node_id in range(3):
+                for _ in range(3):
+                    sm.observe_host_step_time(
+                        node_id, 10.0 if node_id == 2 else 0.1
+                    )
+            assert sm.stragglers() == [2]
+            assert servicer_actions(master, 2) == ["diagnose"]
+            # Re-scoring the same straggler does not re-queue.
+            sm.observe_host_step_time(2, 10.0)
+            assert servicer_actions(master, 2) == ["diagnose"]
+            beat = master.servicer._heartbeat(
+                msg.HeartbeatRequest(node_id=2)
+            )
+            assert beat.action == EventAction.DIAGNOSE.value
+        finally:
+            master.stop()
+
+
+def servicer_actions(master, node_id):
+    return master.servicer.pending_actions(node_id)
+
+
+class _DiagnoseClient:
+    """Heartbeat returns a scripted action sequence; records
+    diagnostics reports."""
+
+    def __init__(self, actions):
+        self.actions = collections.deque(actions)
+        self.diagnostics = []
+
+    def heartbeat(self):
+        if not self.actions:
+            return "none"
+        action = self.actions.popleft()
+        if isinstance(action, Exception):
+            raise action
+        return action
+
+    def report_diagnostics(self, kind, bundle_path="", digest=""):
+        self.diagnostics.append((kind, bundle_path, digest))
+
+
+class TestAgentHeartbeat:
+    def _run_loop(self, client, ticks: int):
+        config = AgentConfig(node_id=0, heartbeat_interval=0.005)
+        agent = ElasticAgent(config, ["true"], client=client)
+        thread = threading.Thread(
+            target=agent._heartbeat_loop, daemon=True
+        )
+        thread.start()
+        deadline = time.monotonic() + 10
+        while (
+            len(client.actions) > 0 and time.monotonic() < deadline
+        ):
+            time.sleep(0.01)
+        agent._stop.set()
+        thread.join(timeout=5)
+        return agent
+
+    def test_diagnose_action_ships_report(self):
+        client = _DiagnoseClient(["none", "diagnose", "none"])
+        self._run_loop(client, ticks=3)
+        assert len(client.diagnostics) == 1
+        kind, bundle_path, digest = client.diagnostics[0]
+        assert kind == "diagnose"
+        # No recorder installed in this process: digest still renders
+        # (header only), bundle absent.
+        assert "forensics digest (diagnose)" in digest
+
+    def test_heartbeat_failures_counted_not_spammed(self, monkeypatch):
+        failures = [RuntimeError("down")] * 20
+        client = _DiagnoseClient(failures + ["none"])
+        counter = obs.get_registry().get(
+            "dlrover_agent_heartbeat_failures_total"
+        )
+        before = counter.value()
+        warnings = []
+        from dlrover_tpu.agent import agent as agent_mod
+
+        real_warning = agent_mod.logger.warning
+        monkeypatch.setattr(
+            agent_mod.logger,
+            "warning",
+            lambda *a, **k: warnings.append(a),
+        )
+        infos = []
+        monkeypatch.setattr(
+            agent_mod.logger,
+            "info",
+            lambda *a, **k: infos.append(a),
+        )
+        del real_warning
+        self._run_loop(client, ticks=21)
+        assert counter.value() == before + 20
+        # Escalating warn-once-per-streak: 1,2,4,8,16 -> 5 warnings
+        # for 20 consecutive failures, not 20.
+        assert 1 <= len(warnings) <= 6
+        # Recovery logged once.
+        assert any("recovered" in str(a[0]) for a in infos)
+
+
+class TestHangDetectorClock:
+    """Satellite regression: hang detection must measure ELAPSED time
+    (monotonic), so an NTP wall-clock step can neither fake nor mask
+    a hang."""
+
+    def _detector(self, tmp_path, hang_timeout=50.0):
+        from dlrover_tpu.agent.hang_detector import HangDetector
+        from dlrover_tpu.agent.monitor import TrainingMonitor
+
+        path = str(tmp_path / "metrics.json")
+        TrainingMonitor.write_metrics(1, path=path)
+        det = HangDetector(
+            hang_timeout=hang_timeout,
+            startup_grace=999.0,
+            metrics_file=path,
+        )
+        assert det.check() is False  # step 1 lands as progress
+        return det
+
+    def test_wall_clock_jump_does_not_fake_hang(
+        self, tmp_path, monkeypatch
+    ):
+        det = self._detector(tmp_path)
+        real_time = time.time
+        # NTP steps the wall clock forward a day: no step progressed,
+        # but no *elapsed* time passed either.
+        monkeypatch.setattr(
+            time, "time", lambda: real_time() + 86400.0
+        )
+        assert det.check() is False
+        assert det.seconds_since_progress() < 1.0
+
+    def test_wall_clock_jump_back_does_not_mask_hang(
+        self, tmp_path, monkeypatch
+    ):
+        det = self._detector(tmp_path)
+        real_time = time.time
+        real_mono = time.monotonic
+        # Wall clock steps BACK a day while real (monotonic) time
+        # exceeds the hang timeout: still a hang.
+        monkeypatch.setattr(
+            time, "time", lambda: real_time() - 86400.0
+        )
+        monkeypatch.setattr(
+            time,
+            "monotonic",
+            lambda: real_mono() + det.hang_timeout + 1.0,
+        )
+        assert det.check() is True
+
+    def test_progress_rearms_under_monotonic(self, tmp_path, monkeypatch):
+        from dlrover_tpu.agent.monitor import TrainingMonitor
+
+        det = self._detector(tmp_path)
+        real_mono = time.monotonic
+        monkeypatch.setattr(
+            time,
+            "monotonic",
+            lambda: real_mono() + det.hang_timeout + 1.0,
+        )
+        assert det.check() is True
+        # A new step re-arms even with the skewed clock.
+        TrainingMonitor.write_metrics(2, path=det.metrics_file)
+        assert det.check() is False
+
+
+class TestPostmortemHelpers:
+    def test_collect_events_merges_and_dedupes(self, tmp_path):
+        d = str(tmp_path)
+        bundle = {
+            "kind": "hang",
+            "ts": 100.0,
+            "events": [
+                {"name": "trainer.step", "ts": 99.0, "pid": 1},
+                {"name": "trainer.step", "ts": 99.5, "pid": 1},
+            ],
+        }
+        with open(os.path.join(d, "bundle_a_r0_1_001_hang.json"), "w") as f:
+            json.dump(bundle, f)
+        with open(os.path.join(d, "trace.jsonl"), "w") as f:
+            # One duplicate of a bundle event + one new event.
+            f.write(
+                json.dumps(
+                    {"name": "trainer.step", "ts": 99.5, "pid": 1}
+                )
+                + "\n"
+            )
+            f.write(
+                json.dumps({"name": "node.fail", "ts": 100.0}) + "\n"
+            )
+        events = collect_events(d, load_bundles(d))
+        assert [e["name"] for e in events] == [
+            "trainer.step", "trainer.step", "node.fail",
+        ]
+
+    def test_last_fault_dump_picks_final_section(self):
+        text = (
+            "# header\n"
+            "Current thread 0x01 (most recent call first):\n"
+            '  File "a.py", line 1 in old\n'
+            "\n"
+            "Fatal Python error: Segmentation fault\n"
+            "\n"
+            "Current thread 0x01 (most recent call first):\n"
+            '  File "a.py", line 2 in fresh\n'
+        )
+        dump = last_fault_dump(text)
+        assert dump.startswith("Fatal Python error")
+        assert "fresh" in dump and "old" not in dump

@@ -347,9 +347,13 @@ class TestTimelineAdversarial:
 class TestMetricNameHygiene:
     """Audit every obs.counter/gauge/histogram registration in the
     framework and tools: dlrover_-prefixed snake_case names, non-empty
-    help strings, and no name registered with conflicting types."""
+    help strings, no name registered with conflicting types, and
+    literal label names in snake_case (never the reserved Prometheus
+    names ``le`` / ``quantile`` / ``__``-prefixed)."""
 
     METRIC_NAME_RE = r"^dlrover_[a-z0-9]+(_[a-z0-9]+)*$"
+    LABEL_NAME_RE = r"^[a-z][a-z0-9_]*$"
+    RESERVED_LABELS = ("le", "quantile")
 
     def _call_sites(self):
         import ast
@@ -387,15 +391,31 @@ class TestMetricNameHygiene:
                             args[1], ast.Constant
                         ):
                             help_ = args[1].value
+                        labels_node = (
+                            args[2] if len(args) > 2 else None
+                        )
                         for kw in node.keywords:
                             if kw.arg == "help" and isinstance(
                                 kw.value, ast.Constant
                             ):
                                 help_ = kw.value.value
+                            if kw.arg == "labelnames":
+                                labels_node = kw.value
+                        labels = None  # None = not a literal tuple
+                        if isinstance(
+                            labels_node, (ast.Tuple, ast.List)
+                        ) and all(
+                            isinstance(e, ast.Constant)
+                            and isinstance(e.value, str)
+                            for e in labels_node.elts
+                        ):
+                            labels = [
+                                e.value for e in labels_node.elts
+                            ]
                         rel = os.path.relpath(fpath, REPO)
                         sites.append(
                             (rel, node.lineno, node.func.attr,
-                             name, help_)
+                             name, help_, labels)
                         )
         return sites
 
@@ -408,7 +428,8 @@ class TestMetricNameHygiene:
         assert len(sites) >= 15, sites
         problems = []
         types_seen = {}
-        for rel, line, mtype, name, help_ in sites:
+        labeled_sites = 0
+        for rel, line, mtype, name, help_, labels in sites:
             where = f"{rel}:{line}"
             if not re.match(self.METRIC_NAME_RE, name):
                 problems.append(
@@ -426,6 +447,26 @@ class TestMetricNameHygiene:
                     f"{where}: {name!r} registered as {mtype} but "
                     f"as {prev[0]} at {prev[1]}"
                 )
+            if labels:
+                labeled_sites += 1
+                for label in labels:
+                    if not re.match(self.LABEL_NAME_RE, label):
+                        problems.append(
+                            f"{where}: {name!r} label {label!r} is "
+                            "not snake_case"
+                        )
+                    if (
+                        label in self.RESERVED_LABELS
+                        or label.startswith("__")
+                    ):
+                        problems.append(
+                            f"{where}: {name!r} label {label!r} is "
+                            "reserved by Prometheus"
+                        )
+        # The walker must actually see labeled registrations (e.g.
+        # dlrover_forensics_bundles_total{node,kind}); zero means the
+        # label extraction broke, not that the code is clean.
+        assert labeled_sites >= 5, sites
         assert not problems, "\n".join(problems)
 
     def test_registry_rejects_conflicting_reregistration_runtime(self):

@@ -15,15 +15,24 @@ e.g. the per-host traces the chaos drills leave behind — and prints:
 * a per-event-name table: count, and for span events total/mean
   duration, sorted by total time.
 
+``--postmortem DIR`` instead renders a forensics dir (the flight
+recorder's ``bundle_*.json`` black-box bundles + ``stacks_*.txt``
+faulthandler dumps + any ``*.jsonl`` traces, obs/postmortem.py) into
+the "last 60 seconds before failure" report: failure instant,
+windowed event tail, recovery timeline + goodput over the window,
+then every bundle's per-thread Python stacks and each stacks file's
+final dump.
+
 Usage:
     python tools/obs_report.py TRACE.jsonl [--failure-ts T] [--top N]
     python tools/obs_report.py TRACE.jsonl --goodput
+    python tools/obs_report.py --postmortem /tmp/dlrover_tpu_forensics_job
     python tools/obs_report.py --selftest
 
 ``--selftest`` runs the reconstruction + goodput + fleet-aggregation
-pipelines on synthetic events/snapshots and exits nonzero on any
-inconsistency — a fast CI smoke with no inputs (invoked by
-tests/test_obs.py).
++ postmortem pipelines on synthetic events/snapshots/bundles and
+exits nonzero on any inconsistency — a fast CI smoke with no inputs
+(invoked by tests/test_obs.py).
 """
 
 from __future__ import annotations
@@ -225,6 +234,7 @@ def selftest() -> int:
             errors.append("pipeline summary not empty without events")
         errors.extend(_selftest_goodput(events))
     errors.extend(_selftest_fleet())
+    errors.extend(_selftest_postmortem())
     if errors:
         print("obs selftest FAILED:")
         for e in errors:
@@ -323,6 +333,101 @@ def _selftest_fleet() -> list:
     return errors
 
 
+def _selftest_postmortem() -> list:
+    """Postmortem rendering over a synthetic forensics dir: one hang
+    bundle with a wedged thread, one faulthandler stacks file, one
+    trace — the report must carry the failure instant, the hung
+    thread's stack, the fault dump, and the goodput attribution."""
+    import json as _json
+    import tempfile
+
+    from dlrover_tpu.obs.postmortem import (
+        collect_events,
+        failure_instant,
+        last_fault_dump,
+        load_bundles,
+        render_postmortem,
+    )
+
+    errors = []
+    t = 2000.0
+    with tempfile.TemporaryDirectory() as dir_:
+        bundle = {
+            "schema": 1,
+            "kind": "hang",
+            "reason": "no step progress for 62.0s",
+            "ts": t + 62.0,
+            "role": "agent",
+            "rank": 0,
+            "pid": 111,
+            "proc": {"python": "3.11.0", "jax_platform": "cpu"},
+            "env": {},
+            "notes": {"step": 41, "loss": 2.5},
+            "logs": [
+                {"ts": t + 61.0, "level": "WARNING",
+                 "logger": "agent", "msg": "no step progress"},
+            ],
+            "events": [
+                {"name": "trainer.step", "ts": t, "step": 40,
+                 "pid": 222},
+                {"name": "trainer.step", "ts": t + 1.0, "step": 41,
+                 "pid": 222},
+                {"name": "agent.hang_detected", "ts": t + 62.0,
+                 "pid": 111},
+            ],
+            "metrics": {},
+            "stacks": [
+                {"thread": "MainThread", "ident": 1, "daemon": False,
+                 "current": True,
+                 "frames": ["agent.py:500 in _invoke_run"]},
+            ],
+            "stacks_file": f"{dir_}/stacks_111.txt",
+        }
+        with open(f"{dir_}/bundle_agent_r0_111_001_hang.json", "w") as f:
+            _json.dump(bundle, f)
+        with open(f"{dir_}/stacks_222.txt", "w") as f:
+            f.write(
+                "# flight recorder role=trainer rank=0 pid=222\n"
+                "Current thread 0x00007f01 (most recent call first):\n"
+                '  File "train.py", line 12 in stuck_collective\n'
+                '  File "train.py", line 30 in main\n'
+            )
+        bundles = load_bundles(dir_)
+        if len(bundles) != 1:
+            errors.append(f"expected 1 bundle, loaded {len(bundles)}")
+        events = collect_events(dir_, bundles)
+        if len(events) != 3:
+            errors.append(f"expected 3 events, got {len(events)}")
+        t_fail, source = failure_instant(events, bundles)
+        if t_fail != t + 62.0 or source != "agent.hang_detected":
+            errors.append(
+                f"failure instant wrong: {t_fail} from {source!r}"
+            )
+        dump = last_fault_dump(
+            open(f"{dir_}/stacks_222.txt").read()
+        )
+        if not dump.startswith("Current thread"):
+            errors.append(f"last_fault_dump wrong: {dump!r}")
+        report = render_postmortem(dir_, window=90.0)
+        for needle in (
+            "failure instant: 2062.000 (from agent.hang_detected)",
+            "bundle_agent_r0_111_001_hang.json",
+            "notes: loss=2.5, step=41",
+            "thread MainThread (current):",
+            "agent.py:500 in _invoke_run",
+            "stack dump stacks_222.txt (pid 222):",
+            "stuck_collective",
+            "goodput",  # attribution over the window
+            "agent.hang_detected",
+        ):
+            if needle not in report:
+                errors.append(f"postmortem missing {needle!r}")
+        empty = render_postmortem(f"{dir_}/nope")
+        if "no forensics artifacts" not in empty:
+            errors.append(f"empty-dir message wrong: {empty!r}")
+    return errors
+
+
 def main(argv=None) -> int:
     p = argparse.ArgumentParser("obs_report")
     p.add_argument("event_file", nargs="?", default="")
@@ -337,15 +442,35 @@ def main(argv=None) -> int:
         help="print the goodput/badput wall-time attribution",
     )
     p.add_argument(
+        "--postmortem", type=str, default="",
+        metavar="DIR",
+        help="render a forensics dir (flight-recorder bundles + "
+        "faulthandler stack dumps + traces) into the last-N-seconds-"
+        "before-failure report",
+    )
+    p.add_argument(
+        "--window", type=float, default=60.0,
+        help="with --postmortem: seconds before the failure instant "
+        "to report on",
+    )
+    p.add_argument(
         "--selftest", action="store_true",
-        help="run the reconstruction/goodput/fleet pipelines on "
-        "synthetic inputs",
+        help="run the reconstruction/goodput/fleet/postmortem "
+        "pipelines on synthetic inputs",
     )
     args = p.parse_args(argv)
     if args.selftest:
         return selftest()
+    if args.postmortem:
+        from dlrover_tpu.obs.postmortem import render_postmortem
+
+        rendered = render_postmortem(
+            args.postmortem, window=args.window
+        )
+        print(rendered)
+        return 1 if rendered.startswith("no forensics artifacts") else 0
     if not args.event_file:
-        p.error("event_file is required (or pass --selftest)")
+        p.error("event_file is required (or pass --selftest/--postmortem)")
     return report(
         args.event_file, args.failure_ts, args.top,
         goodput=args.goodput,
