@@ -75,8 +75,8 @@ class MasterRendezvousHandler:
         round_ = self.client.join_rendezvous(
             self.local_world_size, rdzv_name=self.rdzv_name
         )
-        deadline = time.time() + self.timeout
-        while time.time() < deadline:
+        deadline = time.monotonic() + self.timeout
+        while time.monotonic() < deadline:
             rdzv_round, group, world = self.client.get_comm_world(
                 rdzv_name=self.rdzv_name
             )
@@ -284,8 +284,8 @@ class ElasticAgent:
             self._join_stderr_pump()
             return
         self._proc.send_signal(signal.SIGTERM)
-        deadline = time.time() + grace
-        while time.time() < deadline:
+        deadline = time.monotonic() + grace
+        while time.monotonic() < deadline:
             if self._proc.poll() is not None:
                 self._join_stderr_pump()
                 return
@@ -410,7 +410,7 @@ class ElasticAgent:
         )
         for _ in range(2):  # two grouping rounds localize the fault
             spec = handler.next_rendezvous()
-            start = time.time()
+            start = time.monotonic()
             result = subprocess.run(
                 [
                     sys.executable,
@@ -426,7 +426,7 @@ class ElasticAgent:
                 timeout=300,
                 check=False,
             )
-            elapsed = time.time() - start
+            elapsed = time.monotonic() - start
             normal = result.returncode == 0
             self.client.report_network_check(normal, elapsed)
         return self.network_check_verdict()
@@ -436,10 +436,10 @@ class ElasticAgent:
         node after check results were reported. Split from
         run_network_check so the decision (incl. --exclude-straggler)
         is testable without live rendezvous timing."""
-        deadline = time.time() + self.config.rdzv_timeout
+        deadline = time.monotonic() + self.config.rdzv_timeout
         faults, reason = self.client.query_fault_nodes()
         while reason == "waiting":
-            if time.time() > deadline:
+            if time.monotonic() > deadline:
                 logger.error(
                     "network-check verdict not available within %ss "
                     "(peers never reported); treating as failure",
@@ -509,6 +509,12 @@ class ElasticAgent:
         res_mon = ResourceMonitor(self.client)
         train_mon = TrainingMonitor(self.client)
         tuner = ParalConfigTuner(self.client)
+        # After a master reconnect (possibly to a warm-restarted
+        # replacement), resend a full telemetry snapshot immediately:
+        # the new master's fleet view re-primes now, not a reporting
+        # cadence later. (Registration itself is already resent by
+        # the client's supervisor.)
+        self.client.add_reconnect_callback(res_mon.report_once)
         res_mon.start()
         train_mon.start()
         tuner.start()
@@ -776,6 +782,16 @@ class ElasticAgent:
                 )
                 streak = 0
                 next_warn = 1
+                # The master may be a warm-restarted replacement (or
+                # a cold one that lost the node table): re-announce
+                # this node and let subscribers resend snapshots.
+                try:
+                    self.client.notify_master_recovered()
+                except Exception:  # noqa: BLE001
+                    logger.warning(
+                        "post-recovery re-registration failed",
+                        exc_info=True,
+                    )
             if action == EventAction.RESTART_TRAINING.value:
                 self._restart_requested.set()
             elif action == EventAction.STOP_TRAINING.value:

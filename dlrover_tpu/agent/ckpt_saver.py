@@ -271,8 +271,8 @@ class AsyncCheckpointSaver:
         wdir = writing_dir(self.checkpoint_dir, step)
         sdir = step_dir(self.checkpoint_dir, step)
         ddir = done_dir(self.checkpoint_dir, step)
-        deadline = time.time() + self.commit_timeout
-        while time.time() < deadline:
+        deadline = time.monotonic() + self.commit_timeout
+        while time.monotonic() < deadline:
             if self.storage.exists(sdir):
                 break  # rename already happened (this run or a prior one)
             done = [f for f in self.storage.listdir(ddir)
@@ -315,8 +315,8 @@ class AsyncCheckpointSaver:
     def _wait_commit(self, step: int) -> bool:
         """Non-owner agents wait for the owner's rename to land."""
         sdir = step_dir(self.checkpoint_dir, step)
-        deadline = time.time() + self.commit_timeout
-        while time.time() < deadline:
+        deadline = time.monotonic() + self.commit_timeout
+        while time.monotonic() < deadline:
             if self.storage.exists(sdir):
                 return True
             time.sleep(0.1)

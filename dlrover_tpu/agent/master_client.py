@@ -1,18 +1,38 @@
 """Typed client to the job master, used by agents and trainers.
 
 Parity: dlrover/python/elastic_agent/master_client.py:49 (MasterClient
-with the retry decorator at :26), re-typed onto the msgpack schema.
+with the retry decorator at :26), re-typed onto the msgpack schema —
+plus a connection supervisor so a master outage (pod reschedule, OOM,
+network partition) is ridden out instead of killing the fleet.
+
+Two retry layers with distinct jobs:
+
+* :class:`ConnectionSupervisor` — *transient* transport failures
+  (master unreachable) are retried with exponential backoff and
+  decorrelated jitter under a total outage budget
+  (``DLROVER_TPU_MASTER_RECONNECT_SECONDS``, default 300 s). On the
+  first success after an outage it re-registers this node (the master
+  may be a warm-restarted replacement) and fires reconnect callbacks.
+  Budget exhaustion raises :class:`MasterOutageError`.
+* :func:`retry` — brief *application-level* hiccups (a server handler
+  momentarily failing) get a couple of jittered retries. It never
+  re-retries a :class:`MasterOutageError`: the supervisor already
+  spent the whole outage budget.
 """
 
 from __future__ import annotations
 
 import functools
 import os
+import random
+import threading
 import time
-from typing import Dict, List, Optional, Tuple
+from typing import Callable, Dict, List, Optional, Tuple
+
+import grpc
 
 from dlrover_tpu.common import messages as msg
-from dlrover_tpu.common.comm import RpcClient
+from dlrover_tpu.common.comm import RpcClient, RpcError
 from dlrover_tpu.common.constants import (
     NodeAction,
     NodeEnv,
@@ -22,8 +42,184 @@ from dlrover_tpu.common.log import get_logger
 
 logger = get_logger("master_client")
 
+RECONNECT_SECONDS_ENV = "DLROVER_TPU_MASTER_RECONNECT_SECONDS"
+RECONNECT_BASE_ENV = "DLROVER_TPU_MASTER_RECONNECT_BASE"
+
+# gRPC status codes that mean "the master may be down / unreachable"
+# rather than "this request is wrong". Everything else is fatal for
+# the call (retrying an INVALID_ARGUMENT forever helps nobody).
+_TRANSIENT_GRPC_CODES = frozenset(
+    (
+        grpc.StatusCode.UNAVAILABLE,
+        grpc.StatusCode.DEADLINE_EXCEEDED,
+        grpc.StatusCode.CANCELLED,
+        grpc.StatusCode.UNKNOWN,
+    )
+)
+
+
+class MasterOutageError(RuntimeError):
+    """The master stayed unreachable past the reconnect budget."""
+
+
+def is_transient_rpc_error(exc: BaseException) -> bool:
+    """Transport-level failures worth riding out: a dead/restarting
+    master, a partition, or an injected chaos fault. Server-side
+    handler failures (our :class:`RpcError`) are NOT transient — the
+    master answered, retrying blind would loop on a real bug."""
+    if isinstance(exc, MasterOutageError):
+        return False
+    if isinstance(exc, RpcError):
+        return False
+    if isinstance(exc, grpc.RpcError):
+        code = exc.code() if callable(getattr(exc, "code", None)) else None
+        return code in _TRANSIENT_GRPC_CODES
+    # ChaosDropError subclasses ConnectionError on purpose.
+    return isinstance(exc, (ConnectionError, ConnectionResetError, OSError))
+
+
+class ConnectionSupervisor:
+    """Retries transient failures under one shared outage budget.
+
+    Thread-safe: all of a process's RPC paths (heartbeat thread,
+    resource monitor, sharding client, rendezvous poll) share the
+    outage clock, which starts at the first observed failure and
+    clears on any success. ``on_reconnect`` callbacks fire exactly
+    once per outage, from the thread whose call first succeeded.
+    """
+
+    def __init__(
+        self,
+        outage_budget: Optional[float] = None,
+        backoff_base: Optional[float] = None,
+        backoff_cap: float = 15.0,
+        rng: Optional[random.Random] = None,
+        sleep: Callable[[float], None] = time.sleep,
+    ):
+        if outage_budget is None:
+            outage_budget = float(
+                os.getenv(RECONNECT_SECONDS_ENV, "") or 300.0
+            )
+        if backoff_base is None:
+            backoff_base = float(
+                os.getenv(RECONNECT_BASE_ENV, "") or 0.5
+            )
+        self.outage_budget = outage_budget
+        self.backoff_base = backoff_base
+        self.backoff_cap = backoff_cap
+        self._rng = rng or random.Random()
+        self._sleep = sleep
+        self._lock = threading.Lock()
+        self._outage_since: Optional[float] = None  # monotonic
+        self.on_reconnect: List[Callable[[], None]] = []
+        self.outages = 0
+        self.reconnects = 0
+
+    def outage_elapsed(self) -> Optional[float]:
+        with self._lock:
+            if self._outage_since is None:
+                return None
+            return time.monotonic() - self._outage_since
+
+    def _note_failure(self) -> float:
+        """Record a transient failure; returns seconds into the
+        outage."""
+        now = time.monotonic()
+        with self._lock:
+            if self._outage_since is None:
+                self._outage_since = now
+                self.outages += 1
+            return now - self._outage_since
+
+    def _note_success(self) -> bool:
+        """Clear any outage; True when this call ended one."""
+        with self._lock:
+            was_out = self._outage_since is not None
+            self._outage_since = None
+            if was_out:
+                self.reconnects += 1
+            return was_out
+
+    def call(
+        self,
+        fn: Callable[[], object],
+        what: str = "rpc",
+        max_wait: Optional[float] = None,
+    ):
+        """Run ``fn``, riding out transient failures.
+
+        ``max_wait`` caps how long THIS call may retry, independent of
+        the shared outage budget — for callers that have something
+        better to do locally than wait out a whole outage (e.g. a
+        failure report whose caller will restart the dead trainer
+        anyway)."""
+        sleep_s = self.backoff_base
+        warned = 1.0
+        started = time.monotonic()
+        while True:
+            try:
+                result = fn()
+            except Exception as e:  # noqa: BLE001 — classified below
+                if not is_transient_rpc_error(e):
+                    raise
+                elapsed = self._note_failure()
+                waited = time.monotonic() - started
+                if elapsed >= self.outage_budget or (
+                    max_wait is not None and waited >= max_wait
+                ):
+                    raise MasterOutageError(
+                        f"master unreachable for {elapsed:.1f}s "
+                        f"(budget {self.outage_budget:.0f}s, call "
+                        f"waited {waited:.1f}s"
+                        + (
+                            f" of max {max_wait:.0f}s"
+                            if max_wait is not None else ""
+                        )
+                        + f") during {what}: {e}"
+                    ) from e
+                # Decorrelated jitter (never fleet-synchronized
+                # thundering herd), capped and clipped to the budget.
+                sleep_s = min(
+                    self.backoff_cap,
+                    self._rng.uniform(self.backoff_base, sleep_s * 3),
+                )
+                sleep_s = min(
+                    sleep_s, max(self.outage_budget - elapsed, 0.05)
+                )
+                if max_wait is not None:
+                    sleep_s = min(
+                        sleep_s, max(max_wait - waited, 0.05)
+                    )
+                if elapsed >= warned:
+                    logger.warning(
+                        "master unreachable %.1fs into outage "
+                        "(budget %.0fs) during %s; retrying in %.2fs",
+                        elapsed, self.outage_budget, what, sleep_s,
+                    )
+                    warned = max(warned * 2, elapsed + sleep_s)
+                self._sleep(sleep_s)
+                continue
+            if self._note_success():
+                logger.info(
+                    "master connection recovered (during %s)", what
+                )
+                for cb in list(self.on_reconnect):
+                    try:
+                        cb()
+                    except Exception:  # noqa: BLE001 — a broken
+                        # callback must not fail the recovered call
+                        logger.warning(
+                            "reconnect callback failed", exc_info=True
+                        )
+            return result
+
 
 def retry(times: int = 3, interval: float = 1.0):
+    """Brief application-level retries with jitter. Does not sleep
+    after the final failed attempt (the old version wasted up to
+    ``times * interval`` seconds on the error path before raising),
+    and never re-retries an exhausted reconnect budget."""
+
     def decorator(fn):
         @functools.wraps(fn)
         def wrapped(*args, **kwargs):
@@ -31,6 +227,10 @@ def retry(times: int = 3, interval: float = 1.0):
             for attempt in range(times):
                 try:
                     return fn(*args, **kwargs)
+                except MasterOutageError:
+                    # The supervisor already spent the whole outage
+                    # budget; times x that again helps nobody.
+                    raise
                 except Exception as e:  # noqa: BLE001
                     last_exc = e
                     logger.warning(
@@ -40,7 +240,12 @@ def retry(times: int = 3, interval: float = 1.0):
                         times,
                         e,
                     )
-                    time.sleep(interval * (attempt + 1))
+                    if attempt + 1 < times:
+                        time.sleep(
+                            interval
+                            * (attempt + 1)
+                            * random.uniform(0.5, 1.5)
+                        )
             raise last_exc  # type: ignore[misc]
 
         return wrapped
@@ -54,9 +259,77 @@ class MasterClient:
     _singleton: Optional["MasterClient"] = None
 
     def __init__(self, addr: str, node_id: int = 0, node_rank: int = -1):
-        self._client = RpcClient(addr)
+        # wait_for_ready: during a master outage the channel sits in
+        # TRANSIENT_FAILURE; queued-until-connected calls recover the
+        # instant the replacement master serves, instead of failing
+        # fast until gRPC's backoff deigns to redial.
+        self._client = RpcClient(addr, wait_for_ready=True)
         self.node_id = node_id
         self.node_rank = node_rank if node_rank >= 0 else node_id
+        # Rides out master outages (reschedule, partition) on every
+        # critical RPC path. Best-effort telemetry deliberately stays
+        # OFF the supervisor: a trainer's step report must drop fast
+        # during an outage, not block a hot loop for minutes.
+        self.supervisor = ConnectionSupervisor()
+        self.supervisor.on_reconnect.append(self._on_reconnected)
+        # Remembered registration facts for idempotent re-register
+        # after a reconnect (the master may be a warm-restarted
+        # replacement that needs this node announced again; the
+        # job-manager register path is re-register-safe).
+        self._registration: Optional[Tuple[str, str]] = None
+        # User hooks fired after re-registration on every reconnect
+        # (e.g. resend a sharding snapshot / metrics snapshot).
+        self._reconnect_callbacks: List[Callable[[], None]] = []
+
+    # -- reconnect handling --------------------------------------------------
+
+    def add_reconnect_callback(self, fn: Callable[[], None]) -> None:
+        """Run ``fn`` after each reconnect (post re-registration)."""
+        self._reconnect_callbacks.append(fn)
+
+    def _on_reconnected(self) -> None:
+        """First successful RPC after an outage: re-announce this node
+        (idempotent on the master), then let subscribers resend their
+        snapshots. Uses the RAW client — the supervisor is mid-call,
+        and a failure here will be healed by the next outage cycle."""
+        if self._registration is not None:
+            node_type, node_ip = self._registration
+            try:
+                self._client.report(
+                    msg.NodeAddressRequest(
+                        node_id=self.node_id,
+                        node_type=node_type,
+                        node_ip=node_ip,
+                    )
+                )
+                logger.info(
+                    "re-registered node %d (%s) after reconnect",
+                    self.node_id, node_type,
+                )
+            except Exception:  # noqa: BLE001
+                logger.warning(
+                    "post-reconnect re-registration failed",
+                    exc_info=True,
+                )
+        for cb in list(self._reconnect_callbacks):
+            try:
+                cb()
+            except Exception:  # noqa: BLE001
+                logger.warning(
+                    "reconnect callback failed", exc_info=True
+                )
+
+    def _get(self, request, what: Optional[str] = None):
+        return self.supervisor.call(
+            lambda: self._client.get(request),
+            what=what or type(request).__name__,
+        )
+
+    def _report(self, request, what: Optional[str] = None):
+        return self.supervisor.call(
+            lambda: self._client.report(request),
+            what=what or type(request).__name__,
+        )
 
     @classmethod
     def singleton(cls) -> "MasterClient":
@@ -80,7 +353,10 @@ class MasterClient:
 
     @retry()
     def register_node(self, node_type: str = "worker", node_ip: str = ""):
-        self._client.report(
+        # Remember the facts FIRST: even if this attempt dies mid-
+        # outage, the supervisor's reconnect path can re-announce.
+        self._registration = (node_type, node_ip)
+        self._report(
             msg.NodeAddressRequest(
                 node_id=self.node_id, node_type=node_type, node_ip=node_ip
             )
@@ -95,29 +371,64 @@ class MasterClient:
         fatal: bool = False,
         diagnostics: str = "",
     ) -> str:
-        resp = self._client.report(
-            msg.NodeFailureReport(
-                node_id=self.node_id,
-                error_data=error_data,
-                level=level,
-                restart_count=restart_count,
-                fatal=fatal,
-                diagnostics=diagnostics,
-            )
+        # Bounded wait (not the full outage budget): the caller has a
+        # DEAD or HUNG trainer in hand and will restart it locally on
+        # failure — blocking the supervision loop for minutes to ask
+        # a dead master's opinion would hold chips hostage.
+        resp = self.supervisor.call(
+            lambda: self._client.report(
+                msg.NodeFailureReport(
+                    node_id=self.node_id,
+                    error_data=error_data,
+                    level=level,
+                    restart_count=restart_count,
+                    fatal=fatal,
+                    diagnostics=diagnostics,
+                )
+            ),
+            what="NodeFailureReport",
+            max_wait=30.0,
         )
         return resp.action if resp else NodeAction.RESTART_IN_PLACE
 
     @retry()
     def report_succeeded(self):
-        self._client.report(
-            msg.NodeSucceededReport(node_id=self.node_id)
+        # Bounded: worth waiting a bit (an unreported success decays
+        # into a heartbeat-timeout "failure" on the master), but not
+        # worth pinning a finished agent to the outage budget.
+        self.supervisor.call(
+            lambda: self._client.report(
+                msg.NodeSucceededReport(node_id=self.node_id)
+            ),
+            what="NodeSucceededReport",
+            max_wait=60.0,
         )
 
     def heartbeat(self) -> str:
+        """One beat. Deliberately NOT supervised: the heartbeat loop
+        owns per-tick failure accounting (its failure counter and
+        escalating warnings are how a master outage shows up in
+        telemetry — the supervisor retrying internally would flatline
+        them for any outage shorter than the whole budget) and must
+        stay responsive to stop/action delivery. The bounded
+        queue-until-ready timeout still heals the gRPC channel the
+        moment a replacement master serves, and the loop calls
+        :meth:`notify_master_recovered` on the first healthy beat
+        after a failure streak."""
         resp = self._client.report(
-            msg.HeartbeatRequest(node_id=self.node_id, timestamp=time.time())
+            msg.HeartbeatRequest(
+                node_id=self.node_id, timestamp=time.time()
+            ),
+            timeout=10.0,
         )
         return resp.action if resp else "none"
+
+    def notify_master_recovered(self) -> None:
+        """Re-register + fire resend hooks after an outage observed
+        OUTSIDE the supervisor (the heartbeat loop's streak
+        recovery). Idempotent — harmless if a supervised call already
+        reconnected."""
+        self._on_reconnected()
 
     # -- rendezvous ---------------------------------------------------------
 
@@ -127,7 +438,7 @@ class MasterClient:
         local_world_size: int,
         rdzv_name: str = RendezvousName.TRAINING,
     ) -> int:
-        resp = self._client.get(
+        resp = self._get(
             msg.JoinRendezvousRequest(
                 node_id=self.node_id,
                 node_rank=self.node_rank,
@@ -140,7 +451,7 @@ class MasterClient:
     def get_comm_world(
         self, rdzv_name: str = RendezvousName.TRAINING
     ) -> Tuple[int, int, Dict[int, int]]:
-        resp = self._client.get(
+        resp = self._get(
             msg.CommWorldRequest(
                 node_id=self.node_id,
                 node_rank=self.node_rank,
@@ -156,7 +467,8 @@ class MasterClient:
             resp = self._client.get(
                 msg.WaitingNodeNumRequest(
                     node_id=self.node_id, rdzv_name=rdzv_name
-                )
+                ),
+                wait_for_ready=False,
             )
             return resp.waiting_num
         except Exception:  # noqa: BLE001 - polling must not kill the agent
@@ -164,7 +476,7 @@ class MasterClient:
 
     @retry()
     def report_network_check(self, normal: bool, elapsed_time: float):
-        self._client.report(
+        self._report(
             msg.NetworkCheckResultRequest(
                 node_id=self.node_rank,
                 normal=normal,
@@ -173,11 +485,11 @@ class MasterClient:
         )
 
     def query_fault_nodes(self) -> Tuple[List[int], str]:
-        resp = self._client.get(msg.NetworkCheckQueryRequest(kind="fault"))
+        resp = self._get(msg.NetworkCheckQueryRequest(kind="fault"))
         return resp.nodes, resp.reason
 
     def query_stragglers(self) -> Tuple[List[int], str]:
-        resp = self._client.get(
+        resp = self._get(
             msg.NetworkCheckQueryRequest(kind="straggler")
         )
         return resp.nodes, resp.reason
@@ -186,21 +498,27 @@ class MasterClient:
 
     @retry()
     def kv_set(self, key: str, value: bytes):
-        self._client.report(msg.KVStoreSetRequest(key=key, value=value))
+        self._report(msg.KVStoreSetRequest(key=key, value=value))
 
     def kv_get(self, key: str) -> Optional[bytes]:
-        resp = self._client.get(msg.KVStoreGetRequest(key=key))
+        resp = self._get(msg.KVStoreGetRequest(key=key))
         return resp.value if resp.found else None
 
     def kv_add(self, key: str, amount: int) -> int:
+        # NOT supervised: the add is not idempotent — a retry after a
+        # lost response would double-apply the increment (callers use
+        # this for unique-id assignment). Single attempt, caller owns
+        # the ambiguity of a failure, exactly as before the
+        # supervisor existed.
         resp = self._client.get(
             msg.KVStoreAddRequest(key=key, amount=amount)
         )
         return resp.value
 
     def kv_wait(self, key: str, timeout: float = 120.0) -> bytes:
-        deadline = time.time() + timeout
-        while time.time() < deadline:
+        # Monotonic deadline: an NTP step must not fire or mask it.
+        deadline = time.monotonic() + timeout
+        while time.monotonic() < deadline:
             value = self.kv_get(key)
             if value is not None:
                 return value
@@ -221,7 +539,7 @@ class MasterClient:
         storage_type: str = "table",
         task_type: str = "training",
     ):
-        self._client.report(
+        self._report(
             msg.DatasetShardParams(
                 batch_size=batch_size,
                 num_epochs=num_epochs,
@@ -235,7 +553,7 @@ class MasterClient:
         )
 
     def get_task(self, dataset_name: str) -> msg.Task:
-        return self._client.get(
+        return self._get(
             msg.TaskRequest(node_id=self.node_id, dataset_name=dataset_name)
         )
 
@@ -243,7 +561,7 @@ class MasterClient:
     def report_task_result(
         self, dataset_name: str, task_id: int, success: bool = True
     ):
-        self._client.report(
+        self._report(
             msg.TaskResultRequest(
                 node_id=self.node_id,
                 dataset_name=dataset_name,
@@ -253,14 +571,14 @@ class MasterClient:
         )
 
     def get_shard_checkpoint(self, dataset_name: str) -> str:
-        resp = self._client.get(
+        resp = self._get(
             msg.ShardCheckpointRequest(dataset_name=dataset_name)
         )
         return resp.content
 
     @retry()
     def restore_shard_checkpoint(self, dataset_name: str, content: str):
-        self._client.report(
+        self._report(
             msg.RestoreShardRequest(dataset_name=dataset_name, content=content)
         )
 
@@ -268,7 +586,7 @@ class MasterClient:
 
     def get_parallel_config(self):
         """Master-pushed tuning config (ref ParalConfigTuner)."""
-        return self._client.get(
+        return self._get(
             msg.ParallelConfigRequest(node_id=self.node_id)
         )
 
@@ -282,7 +600,8 @@ class MasterClient:
                     timestamp=time.time(),
                     step=step,
                     tokens=tokens,
-                )
+                ),
+                wait_for_ready=False,
             )
         except Exception:  # noqa: BLE001 - telemetry is best-effort
             pass
@@ -302,7 +621,8 @@ class MasterClient:
                     memory_mb=memory_mb,
                     hbm_used_gb=hbm_used_gb,
                     duty_cycle=duty_cycle,
-                )
+                ),
+                wait_for_ready=False,
             )
         except Exception:  # noqa: BLE001
             pass
@@ -331,7 +651,8 @@ class MasterClient:
                     resource=resource or {},
                     step_times=list(step_times or []),
                     events=list(events or []),
-                )
+                ),
+                wait_for_ready=False,
             )
         except Exception:  # noqa: BLE001 - telemetry is best-effort
             pass
@@ -352,7 +673,8 @@ class MasterClient:
                     bundle_path=bundle_path,
                     digest=digest,
                     timestamp=time.time(),
-                )
+                ),
+                wait_for_ready=False,
             )
         except Exception:  # noqa: BLE001 - telemetry is best-effort
             logger.warning(
@@ -362,7 +684,7 @@ class MasterClient:
 
     def query_diagnostics(self, node_id: int = -1) -> List:
         """The master's stored DiagnosticsReport history (tools)."""
-        resp = self._client.get(
+        resp = self._get(
             msg.DiagnosticsQueryRequest(node_id=node_id)
         )
         return list(resp.reports)
@@ -374,7 +696,7 @@ class MasterClient:
         """Fetch the current embedding PartitionMap (sparse path)."""
         from dlrover_tpu.sparse.partition import PartitionMap
 
-        resp = self._client.get(msg.PartitionMapRequest())
+        resp = self._get(msg.PartitionMapRequest())
         return PartitionMap(
             version=resp.version,
             assignment=list(resp.assignment),
@@ -383,7 +705,7 @@ class MasterClient:
 
     @retry()
     def register_ps(self, ps_id: int, addr: str):
-        self._client.report(
+        self._report(
             msg.PsRegisterRequest(node_id=ps_id, addr=addr)
         )
 
@@ -393,7 +715,7 @@ class MasterClient:
             self._client.report(msg.PsStatsReport(
                 node_id=ps_id, qps=qps, cpu_percent=cpu_percent,
                 total_rows=total_rows,
-            ))
+            ), wait_for_ready=False)
         except Exception:  # noqa: BLE001 - telemetry is best-effort
             pass
 

@@ -64,13 +64,13 @@ class ShardingClient:
         itself instead of blocking/None, so callers holding
         deliverables (deferred-completion producers) can flush before
         the master's wait-for-doing-shards would deadlock them."""
-        deadline = time.time() + timeout
+        deadline = time.monotonic() + timeout
         while True:
             task = self._client.get_task(self.dataset_name)
             if task.task_type == TaskType.WAIT:
                 if return_wait:
                     return task
-                if not wait or time.time() > deadline:
+                if not wait or time.monotonic() > deadline:
                     return None
                 time.sleep(1.0)
                 continue

@@ -31,7 +31,28 @@ _REPORT = f"/{SERVICE_NAME}/report"
 _GRPC_OPTIONS = [
     ("grpc.max_send_message_length", GrpcEnv.MAX_MESSAGE_LENGTH),
     ("grpc.max_receive_message_length", GrpcEnv.MAX_MESSAGE_LENGTH),
+    # Reconnect fast after a master bounce: gRPC's default connect
+    # backoff grows toward 120s, which would leave a client failing
+    # instantly ("failed to connect to all addresses") long after the
+    # replacement master is up — the agent's outage budget would burn
+    # on channel backoff, not on the actual outage.
+    ("grpc.initial_reconnect_backoff_ms", 100),
+    ("grpc.min_reconnect_backoff_ms", 100),
+    ("grpc.max_reconnect_backoff_ms", 2000),
 ]
+
+
+def _chaos_injector():
+    """Env-gated chaos injector (common/chaos.py); None when off."""
+    from dlrover_tpu.common import chaos
+
+    return chaos.get_injector()
+
+
+def _chaos_server_hook(request) -> None:
+    inj = _chaos_injector()
+    if inj is not None:
+        inj.on_server_request(request)
 
 
 def find_free_port(host: str = "127.0.0.1") -> int:
@@ -87,6 +108,7 @@ class _GenericHandler(grpc.GenericRpcHandler):
         return None
 
     def _do_get(self, request, context):
+        _chaos_server_hook(request)
         try:
             result = self._dispatcher.handle_get(request)
             return messages.BaseResponse(success=True, data=result)
@@ -95,6 +117,7 @@ class _GenericHandler(grpc.GenericRpcHandler):
             return messages.BaseResponse(success=False, message=str(e))
 
     def _do_report(self, request, context):
+        _chaos_server_hook(request)
         try:
             result = self._dispatcher.handle_report(request)
             return messages.BaseResponse(success=True, data=result)
@@ -137,11 +160,26 @@ class RpcError(RuntimeError):
 
 
 class RpcClient:
-    """Client to the master service; thread-safe, lazily connected."""
+    """Client to the master service; thread-safe, lazily connected.
 
-    def __init__(self, addr: str, timeout: float = 30.0):
+    ``wait_for_ready`` is the per-client default for queue-until-
+    connected RPC semantics (overridable per call): True suits
+    clients of the (warm-restartable) master, whose supervisor wants
+    calls to wait out a channel in TRANSIENT_FAILURE; the default
+    False (fail fast) suits clients of peers that are REPLACED rather
+    than restarted in place (PS hosts, ingest workers) — their
+    callers own a refetch/retry loop and need dead-peer calls to
+    fail instantly, not block a step for the full RPC timeout."""
+
+    def __init__(
+        self,
+        addr: str,
+        timeout: float = 30.0,
+        wait_for_ready: bool = False,
+    ):
         self.addr = addr
         self.timeout = timeout
+        self.wait_for_ready = wait_for_ready
         self._lock = threading.Lock()
         self._channel: Optional[grpc.Channel] = None
         self._get: Optional[grpc.UnaryUnaryMultiCallable] = None
@@ -165,21 +203,56 @@ class RpcClient:
                 response_deserializer=messages.deserialize,
             )
 
-    def _call(self, stub_name: str, request: Any, timeout: Optional[float]):
+    def _call(
+        self,
+        stub_name: str,
+        request: Any,
+        timeout: Optional[float],
+        wait_for_ready: Optional[bool] = None,
+    ):
+        if wait_for_ready is None:
+            wait_for_ready = self.wait_for_ready
+        inj = _chaos_injector()
+        if inj is not None:
+            # May sleep (added latency) or raise ChaosDropError /
+            # ChaosPartitionError, which the reconnect supervisor
+            # classifies as transient — same path as a dead master.
+            inj.before_client_call(stub_name, request)
         self._connect()
         stub = self._get if stub_name == "get" else self._report
-        response = stub(request, timeout=timeout or self.timeout)
+        # wait_for_ready=True queues the RPC until the channel
+        # (re)connects instead of failing fast from TRANSIENT_FAILURE
+        # — without it a channel that ever saw the master down keeps
+        # failing instantly long after the master is back, burning
+        # the reconnect budget on channel state instead of the actual
+        # outage. Best-effort telemetry passes False: it must DROP
+        # fast during an outage, not block a reporting loop.
+        response = stub(
+            request,
+            timeout=timeout or self.timeout,
+            wait_for_ready=wait_for_ready,
+        )
         if not isinstance(response, messages.BaseResponse):
             raise RpcError(f"bad response type {type(response).__name__}")
         if not response.success:
             raise RpcError(response.message)
         return response.data
 
-    def get(self, request: Any, timeout: Optional[float] = None) -> Any:
-        return self._call("get", request, timeout)
+    def get(
+        self,
+        request: Any,
+        timeout: Optional[float] = None,
+        wait_for_ready: Optional[bool] = None,
+    ) -> Any:
+        return self._call("get", request, timeout, wait_for_ready)
 
-    def report(self, request: Any, timeout: Optional[float] = None) -> Any:
-        return self._call("report", request, timeout)
+    def report(
+        self,
+        request: Any,
+        timeout: Optional[float] = None,
+        wait_for_ready: Optional[bool] = None,
+    ) -> Any:
+        return self._call("report", request, timeout, wait_for_ready)
 
     def close(self) -> None:
         with self._lock:

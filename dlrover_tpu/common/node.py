@@ -121,7 +121,10 @@ class Node:
         if self.config_resource is None:
             self.config_resource = NodeResource()
         if self.create_time == 0.0:
-            self.create_time = time.time()
+            # Monotonic: only ever compared against other monotonic
+            # stamps on the same master (pending/heartbeat timeout
+            # sweeps) — an NTP step must not fire or mask a timeout.
+            self.create_time = time.monotonic()
 
     def update_status(self, new_status: str) -> bool:
         """Apply a status transition; returns True if state changed."""
@@ -155,7 +158,7 @@ class Node:
         return self.status in NodeStatus.ALIVE
 
     def update_heartbeat(self) -> None:
-        self.heartbeat_time = time.time()
+        self.heartbeat_time = time.monotonic()
 
     def to_dict(self) -> dict:
         d = dataclasses.asdict(self)

@@ -301,7 +301,7 @@ class JobManager:
             )
             if (
                 node.status == NodeStatus.PENDING
-                and time.time() - node.create_time < grace
+                and time.monotonic() - node.create_time < grace
             ):
                 return
             node.update_heartbeat()
@@ -547,8 +547,11 @@ class JobManager:
             self.check_nodes_once()
 
     def check_nodes_once(self) -> None:
-        """One watchdog pass: heartbeat + pending timeouts."""
-        now = time.time()
+        """One watchdog pass: heartbeat + pending timeouts. All
+        stamps involved (create_time / heartbeat_time) are monotonic,
+        set on this master — a wall-clock step cannot fire or mask a
+        timeout."""
+        now = time.monotonic()
         dead: List[Node] = []
         with self._lock:
             for node in self._nodes.values():
@@ -622,6 +625,55 @@ class JobManager:
 
     def stop(self) -> None:
         self._stop.set()
+
+    # -- warm-restart snapshot ----------------------------------------------
+
+    # Node fields that are process-local clocks: meaningless (and
+    # dangerous — instant heartbeat timeout) in a new master process.
+    _CLOCK_FIELDS = ("create_time", "heartbeat_time")
+
+    def to_snapshot(self) -> dict:
+        """JSON-safe recoverable state: the node table (minus
+        process-local monotonic clocks) + relaunch/failure facts."""
+        with self._lock:
+            nodes = []
+            for node in self._nodes.values():
+                d = node.to_dict()
+                for f in self._CLOCK_FIELDS:
+                    d.pop(f, None)
+                # start/finish are wall stamps but carry no decisions;
+                # drop them too so a restored node is visibly fresh.
+                d.pop("start_time", None)
+                d.pop("finish_time", None)
+                nodes.append(d)
+            return {
+                "nodes": nodes,
+                "next_node_id": self._next_node_id,
+                "job_failure": (
+                    list(self._job_failure)
+                    if self._job_failure is not None else None
+                ),
+            }
+
+    def restore_snapshot(self, state: dict) -> None:
+        """Rebuild the node table from a snapshot. Clocks restart from
+        'now': every restored alive node gets a fresh heartbeat stamp,
+        so agents have a full heartbeat_timeout to reconnect before
+        the watchdog declares them dead (the outage already cost them
+        their cadence — the old stamps would kill the whole fleet on
+        the first sweep)."""
+        with self._lock:
+            self._nodes = {}
+            for d in state.get("nodes", []):
+                node = Node.from_dict(d)
+                if node.is_alive():
+                    node.update_heartbeat()
+                self._nodes[node.id] = node
+            self._next_node_id = int(
+                state.get("next_node_id", len(self._nodes))
+            )
+            failure = state.get("job_failure")
+            self._job_failure = tuple(failure) if failure else None
 
     def all_workers_done(self) -> bool:
         """All training nodes (workers AND chiefs) reached a terminal

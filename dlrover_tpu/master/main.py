@@ -48,6 +48,11 @@ def parse_args(argv=None):
     # RPC either way).
     parser.add_argument("--metrics_port", type=int, default=None)
     parser.add_argument("--job_name", type=str, default="")
+    # Master warm restart: journal recoverable state (node table,
+    # rendezvous round/world, shard ledger, kv store, speed progress)
+    # into this directory and restore from the newest valid snapshot
+    # at startup. Also settable via DLROVER_TPU_STATE_DIR.
+    parser.add_argument("--state_dir", type=str, default=None)
     return parser.parse_args(argv)
 
 
@@ -73,6 +78,7 @@ def main(argv=None) -> int:
             monitor_interval=args.monitor_interval,
             job_name=args.job_name,
             metrics_port=args.metrics_port,
+            state_dir=args.state_dir,
         )
     except ValueError as exc:
         logger.error("invalid arguments: %s", exc)

@@ -52,6 +52,7 @@ class JobMaster:
         job_name: str = "",
         metrics_port: Optional[int] = None,
         collect_interval: float = 60.0,
+        state_dir: Optional[str] = None,
     ):
         """``node_num`` is the desired (max) world size; ``min_nodes``
         (default = node_num) is the smallest world the job may proceed
@@ -60,7 +61,11 @@ class JobMaster:
         workers whose permanent loss fails the job; ``evaluator_count``
         standalone evaluator nodes are scheduled at prepare().
         ``metrics_port`` (or DLROVER_TPU_METRICS_PORT; 0 = ephemeral)
-        serves Prometheus text metrics at GET /metrics."""
+        serves Prometheus text metrics at GET /metrics.
+        ``state_dir`` (or DLROVER_TPU_STATE_DIR) enables master warm
+        restart: recoverable state is journaled there as versioned
+        JSON snapshots, and prepare() restores from the newest valid
+        one so a master reschedule costs seconds, not the job."""
         self.node_num = node_num
         self.evaluator_count = evaluator_count
         self.job_manager = JobManager(
@@ -132,6 +137,30 @@ class JobMaster:
         self.servicer.register(dispatcher)
         self._server = RpcServer(dispatcher, port=port)
         self._stopped = threading.Event()
+        self._warm_restarted = False
+        # Warm-restart journal: recoverable master state -> versioned
+        # JSON snapshots under state_dir, written (debounced) on
+        # state-changing events plus a low-frequency timer.
+        from dlrover_tpu.master.state_store import (
+            STATE_DIR_ENV,
+            MasterStateStore,
+            StateJournal,
+        )
+
+        if state_dir is None:
+            state_dir = os.getenv(STATE_DIR_ENV, "") or None
+        self.state_dir = state_dir
+        self.state_journal: Optional[StateJournal] = None
+        if state_dir:
+            self.state_journal = StateJournal(
+                MasterStateStore(state_dir), self._collect_state
+            )
+            mark = self.state_journal.mark_dirty
+            self.job_manager.add_listener(mark)
+            self.task_manager.on_state_change = mark
+            self.kv_store.on_change = mark
+            self.elastic_rdzv.on_state_change = mark
+            self.check_rdzv.on_state_change = mark
         # Nodes can die without their agent ever reporting (pod
         # deleted, preemption, heartbeat timeout). The servicer's
         # failure-report path does this cleanup inline; DELETED events
@@ -190,6 +219,98 @@ class JobMaster:
 
             self.ps_manager.remove_ps(node_ps_id(node.id))
 
+    # -- warm restart --------------------------------------------------------
+
+    def _collect_state(self) -> dict:
+        """Everything a replacement master needs to carry the job on:
+        node table, rendezvous round/world + waiters, shard ledger,
+        kv-store contents (the JAX bootstrap keys), speed progress."""
+        return {
+            "job_manager": self.job_manager.to_snapshot(),
+            "elastic_rdzv": self.elastic_rdzv.to_snapshot(),
+            "check_rdzv": self.check_rdzv.to_snapshot(),
+            "task_manager": self.task_manager.to_snapshot(),
+            "kv_store": self.kv_store.to_snapshot(),
+            "speed_monitor": self.speed_monitor.to_snapshot(),
+        }
+
+    def _maybe_warm_restart(self) -> bool:
+        """Restore from the newest valid snapshot, if any. Called
+        from prepare() before any serving thread starts, so restore
+        never races live RPCs."""
+        if self.state_journal is None:
+            return False
+        doc = self.state_journal.store.load_latest()
+        if doc is None:
+            return False
+        state = doc["state"]
+        try:
+            self.job_manager.restore_snapshot(
+                state.get("job_manager", {})
+            )
+            self.elastic_rdzv.restore_snapshot(
+                state.get("elastic_rdzv", {})
+            )
+            self.check_rdzv.restore_snapshot(
+                state.get("check_rdzv", {})
+            )
+            self.task_manager.restore_snapshot(
+                state.get("task_manager", {})
+            )
+            self.kv_store.restore_snapshot(state.get("kv_store", {}))
+            self.speed_monitor.restore_snapshot(
+                state.get("speed_monitor", {})
+            )
+        except Exception:  # noqa: BLE001 — a corrupt-but-parseable
+            # snapshot must degrade to a cold start, not a crash loop
+            logger.exception(
+                "warm restart from %s failed; starting cold",
+                doc.get("path"),
+            )
+            # All-or-nothing: components restored before the failure
+            # must not survive into the "cold" start — a node table
+            # without its kv bootstrap keys (or rendezvous round
+            # without its ledger) is a state agents can't reason
+            # about. Empty snapshots reset each component.
+            self.job_manager.restore_snapshot({})
+            self.elastic_rdzv.restore_snapshot({})
+            self.check_rdzv.restore_snapshot({})
+            self.task_manager.reset()
+            self.kv_store.restore_snapshot({})
+            self.speed_monitor.restore_snapshot({})
+            return False
+        age_s = max(time.time() - float(doc.get("saved_at", 0.0)), 0.0)
+        alive = len(self.job_manager.alive_nodes())
+        datasets = len(state.get("task_manager", {}).get("datasets", {}))
+        logger.warning(
+            "master WARM RESTART from %s (snapshot age %.1fs): "
+            "%d alive nodes, %d datasets, rendezvous round %d",
+            doc.get("path"), age_s, alive, datasets,
+            self.elastic_rdzv.round,
+        )
+        import dlrover_tpu.obs as obs
+
+        # The recovery-timeline anchor for master-death drills: the
+        # outage's downtime is (this event's ts - kill time), and the
+        # goodput accountant books the gap as recovery via the same
+        # stream.
+        obs.event(
+            "master.warm_restart",
+            snapshot_age_s=round(age_s, 3),
+            snapshot_path=str(doc.get("path")),
+            alive_nodes=alive,
+            datasets=datasets,
+            rdzv_round=self.elastic_rdzv.round,
+        )
+        self.goodput.add_events(
+            [{"name": "master.warm_restart", "ts": time.time()}]
+        )
+        return True
+
+    @property
+    def warm_restarted(self) -> bool:
+        return self._warm_restarted
+
     @property
     def port(self) -> int:
         return self._server.port
@@ -199,10 +320,15 @@ class JobMaster:
         return self._server.addr
 
     def prepare(self) -> None:
+        # Restore BEFORE the server accepts its first RPC: agents
+        # must never observe a half-restored ledger.
+        self._warm_restarted = self._maybe_warm_restart()
         self._server.start()
         self.job_manager.start()
         self.task_manager.start()
         self.metric_collector.start()
+        if self.state_journal is not None:
+            self.state_journal.start()
         if self._metrics_port is not None:
             from dlrover_tpu.obs.exposition import MetricsHTTPServer
 
@@ -267,6 +393,10 @@ class JobMaster:
 
     def stop(self) -> None:
         self._stopped.set()
+        if self.state_journal is not None:
+            # Final flush first: a clean stop leaves the freshest
+            # possible snapshot for the next incarnation.
+            self.state_journal.stop(final_flush=True)
         if self.ps_auto_scaler is not None:
             self.ps_auto_scaler.stop()
         self.ps_manager.stop_liveness_monitor()

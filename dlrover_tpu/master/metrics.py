@@ -119,7 +119,8 @@ class JobMetricCollector:
         self.speed_monitor = speed_monitor
         self.reporters = reporters or [LogReporter()]
         self.interval = interval
-        self.start_time = time.time()
+        # Monotonic: only used for the runtime_s duration below.
+        self.start_time = time.monotonic()
         self._stop = threading.Event()
         self._thread: Optional[threading.Thread] = None
 
@@ -135,7 +136,7 @@ class JobMetricCollector:
         return JobSnapshot(
             timestamp=time.time(),
             job_name=self.job_name,
-            runtime_s=time.time() - self.start_time,
+            runtime_s=time.monotonic() - self.start_time,
             global_step=self.speed_monitor.global_step,
             speed_steps_per_s=self.speed_monitor.running_speed(),
             token_throughput=self.speed_monitor.token_throughput(),

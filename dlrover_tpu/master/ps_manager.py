@@ -356,7 +356,9 @@ class PsManager:
     def report_stats(self, report: msg.PsStatsReport) -> None:
         with self._lock:
             self._stats[report.node_id] = report
-            self._stats_time[report.node_id] = time.time()
+            # Monotonic arrival stamp: only compared against now() in
+            # the max_age staleness sweep below.
+            self._stats_time[report.node_id] = time.monotonic()
 
     def hot_ps(self, cpu_threshold: float = 80.0) -> List[int]:
         """PS nodes whose reported CPU exceeds the threshold (input to
@@ -373,7 +375,7 @@ class PsManager:
         """Latest report per PS; ``max_age`` (seconds) drops stale
         entries so a PS that stopped reporting can't keep steering
         the auto-scaler with its last value."""
-        now = time.time()
+        now = time.monotonic()
         with self._lock:
             return {
                 node_id: s

@@ -78,8 +78,21 @@ class RendezvousManagerBase:
         self._latest_rdzv_nodes: List[int] = []
         self._alive_nodes: Set[int] = set()
         self._rdzv_round = 0
+        # Monotonic stamps (waiting-timeout / elapsed arithmetic must
+        # not move when NTP steps the wall clock); 0.0 = unset.
         self._lastcall_time = 0.0
         self._start_rdzv_time = 0.0
+        # Fired outside the lock after membership/world changes; the
+        # JobMaster points this at the state journal.
+        self.on_state_change = None
+
+    def _changed(self) -> None:
+        cb = self.on_state_change
+        if cb is not None:
+            try:
+                cb()
+            except Exception:  # noqa: BLE001
+                pass
 
     def update_params(
         self,
@@ -110,9 +123,10 @@ class RendezvousManagerBase:
 
     def join(self, node_rank: int, local_world_size: int) -> int:
         """Add a node to the waiting list; returns the round index."""
+        joined = False
         with self._lock:
             if not self._waiting_nodes:
-                self._start_rdzv_time = time.time()
+                self._start_rdzv_time = time.monotonic()
                 logger.info(
                     "%s: start round %d rendezvous",
                     self.name,
@@ -131,8 +145,12 @@ class RendezvousManagerBase:
                 # enter once num_nodes_waiting() tells them to restart.
                 if node_rank in self._latest_rdzv_nodes:
                     self._rdzv_nodes = {}
-                self._lastcall_time = time.time()
-            return self._rdzv_round
+                self._lastcall_time = time.monotonic()
+                joined = True
+            round_ = self._rdzv_round
+        if joined:
+            self._changed()
+        return round_
 
     def _try_complete(self) -> bool:
         """Freeze the world when enough nodes joined. Caller holds lock."""
@@ -144,7 +162,7 @@ class RendezvousManagerBase:
             completed = True
         elif (
             waiting_num > 0
-            and time.time() - self._lastcall_time
+            and time.monotonic() - self._lastcall_time
             >= self._params.waiting_timeout
         ):
             # Round down to whole node_units (slices) FIRST, then check
@@ -164,7 +182,7 @@ class RendezvousManagerBase:
             for r in ranks:
                 self._waiting_nodes.pop(r, None)
             self._lastcall_time = 0.0
-            elapsed = time.time() - self._start_rdzv_time
+            elapsed = time.monotonic() - self._start_rdzv_time
             logger.info(
                 "%s: round %d completed with %d nodes in %.2fs; "
                 "left waiting: %s",
@@ -184,6 +202,49 @@ class RendezvousManagerBase:
                 elapsed_s=round(elapsed, 3),
             )
         return completed
+
+    # -- warm-restart snapshot ----------------------------------------------
+
+    def to_snapshot(self) -> dict:
+        """JSON-safe recoverable state: round, frozen world, pending
+        waiters, alive set. Timer stamps are deliberately NOT included
+        (monotonic clocks do not survive a process) — restore restarts
+        the waiting timeout from 'now'."""
+        with self._lock:
+            return {
+                "round": self._rdzv_round,
+                "waiting_nodes": {
+                    str(k): v for k, v in self._waiting_nodes.items()
+                },
+                "rdzv_nodes": {
+                    str(k): v for k, v in self._rdzv_nodes.items()
+                },
+                "latest_rdzv_nodes": list(self._latest_rdzv_nodes),
+                "alive_nodes": sorted(self._alive_nodes),
+            }
+
+    def restore_snapshot(self, state: dict) -> None:
+        with self._lock:
+            self._rdzv_round = int(state.get("round", 0))
+            self._waiting_nodes = {
+                int(k): int(v)
+                for k, v in state.get("waiting_nodes", {}).items()
+            }
+            self._rdzv_nodes = {
+                int(k): int(v)
+                for k, v in state.get("rdzv_nodes", {}).items()
+            }
+            self._latest_rdzv_nodes = [
+                int(r) for r in state.get("latest_rdzv_nodes", [])
+            ]
+            self._alive_nodes = {
+                int(n) for n in state.get("alive_nodes", [])
+            }
+            now = time.monotonic()
+            # Fresh clocks: the waiting timeout restarts from the
+            # warm restart, not from a dead process's monotonic era.
+            self._lastcall_time = now if self._waiting_nodes else 0.0
+            self._start_rdzv_time = now
 
     def num_nodes_waiting(self) -> int:
         """Nonzero return tells agents to restart for re-rendezvous.
@@ -208,11 +269,16 @@ class ElasticRendezvous(RendezvousManagerBase):
     def get_comm_world(
         self, node_rank: int
     ) -> Tuple[int, int, Dict[int, int]]:
+        completed = False
         with self._lock:
             if not self._rdzv_nodes:
                 if self._try_complete():
                     self._rdzv_round += 1
-            return self._rdzv_round, 0, dict(self._rdzv_nodes)
+                    completed = True
+            result = self._rdzv_round, 0, dict(self._rdzv_nodes)
+        if completed:
+            self._changed()
+        return result
 
 
 class NetworkCheckRendezvous(RendezvousManagerBase):

@@ -245,8 +245,12 @@ class MasterServicer:
         )
 
     def _report_task_result(self, req: msg.TaskResultRequest):
+        # node_id makes the report idempotent against replays: after
+        # an agent reconnect, a retried result for a shard the master
+        # already re-queued to another node must not act.
         self.task_manager.report_task_result(
-            req.dataset_name, req.task_id, req.success
+            req.dataset_name, req.task_id, req.success,
+            node_id=req.node_id,
         )
         return None
 

@@ -56,7 +56,6 @@ class SpeedMonitor:
         self._samples: Deque[Tuple[float, int, int]] = deque(maxlen=window)
         self._global_step = 0
         self._global_tokens = 0
-        self._start_time = time.time()
         # world size (chips) per sample window, to normalize per-chip
         self._alive_nodes: Set[int] = set()
         self._node_steps: Dict[int, int] = {}
@@ -275,6 +274,37 @@ class SpeedMonitor:
                 )
                 return max(last_ts - fail_t, 0.0)
         return None
+
+    # -- warm-restart snapshot ----------------------------------------------
+
+    def to_snapshot(self) -> dict:
+        """Progress facts worth surviving a master restart: the
+        global step/token high-water marks and per-node steps. Window
+        samples and EWMAs are deliberately dropped — throughput and
+        straggler scores re-warm from live traffic in seconds, and
+        stale samples would claim a throughput the restarted fleet
+        has not demonstrated."""
+        with self._lock:
+            return {
+                "global_step": self._global_step,
+                "global_tokens": self._global_tokens,
+                "node_steps": {
+                    str(k): v for k, v in self._node_steps.items()
+                },
+                "alive_nodes": sorted(self._alive_nodes),
+            }
+
+    def restore_snapshot(self, state: dict) -> None:
+        with self._lock:
+            self._global_step = int(state.get("global_step", 0))
+            self._global_tokens = int(state.get("global_tokens", 0))
+            self._node_steps = {
+                int(k): int(v)
+                for k, v in state.get("node_steps", {}).items()
+            }
+            self._alive_nodes = {
+                int(n) for n in state.get("alive_nodes", [])
+            }
 
     def reset_failure_tracking(self) -> None:
         with self._lock:

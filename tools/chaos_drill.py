@@ -1,0 +1,429 @@
+"""Chaos soak runner: kill-the-master drills under injected RPC faults.
+
+Each round spawns a REAL master subprocess (``dlrover_tpu.master.main``
+with ``--state_dir``), runs a simulated agent against it through the
+chaos injector (seeded drop/latency on every client RPC), SIGKILLs the
+master mid-sharded-run, restarts it on the same port, and verifies the
+control-plane survivability contract:
+
+* the agent's connection supervisor rides out the outage (no RPC-path
+  caller raises),
+* the replacement master warm-restarts from the newest snapshot and
+  emits ``master.warm_restart``,
+* every dataset record is processed exactly once — the shard a worker
+  held across the outage is neither lost nor re-dispatched.
+
+Usage::
+
+    python tools/chaos_drill.py --selftest          # seeded, <60s (CI)
+    python tools/chaos_drill.py --rounds 5 --seed 7 # soak
+    python tools/chaos_drill.py --json out.json
+
+The fault schedule is deterministic from ``--seed`` (same seed -> same
+injected faults), so a failing soak round is replayable.
+"""
+
+import _repo_path  # noqa: F401  (sys.path, must precede dlrover_tpu)
+
+import argparse
+import json
+import os
+import shutil
+import signal
+import subprocess
+import sys
+import tempfile
+import threading
+import time
+
+from dlrover_tpu.common import chaos
+from dlrover_tpu.common.comm import RpcClient, find_free_port
+from dlrover_tpu.common.config import ensure_framework_on_pythonpath
+from dlrover_tpu.common import messages as msg
+from dlrover_tpu.master.state_store import MasterStateStore
+from dlrover_tpu.obs.timeline import load_events
+
+
+class DrillError(AssertionError):
+    pass
+
+
+def start_master(
+    port: int,
+    state_dir: str,
+    trace_file: str,
+    extra_env=None,
+    ready_timeout: float = 30.0,
+) -> subprocess.Popen:
+    """Spawn the real master CLI and wait until it answers RPCs."""
+    env = ensure_framework_on_pythonpath(dict(os.environ))
+    env.update(
+        {
+            "JAX_PLATFORMS": "cpu",
+            "DLROVER_TPU_TRACE_FILE": trace_file,
+            # Journal eagerly: drills kill the master within seconds
+            # of the last ledger change.
+            "DLROVER_TPU_SNAPSHOT_MIN_INTERVAL": "0.05",
+            "DLROVER_TPU_SNAPSHOT_SECONDS": "1",
+            # The master must not inherit the drill's client-side
+            # chaos env (it would fault its own loopback use).
+            "DLROVER_TPU_CHAOS": "0",
+        }
+    )
+    env.update(extra_env or {})
+    proc = subprocess.Popen(
+        [
+            sys.executable,
+            "-m",
+            "dlrover_tpu.master.main",
+            "--port", str(port),
+            "--node_num", "1",
+            "--rdzv_timeout", "2",
+            "--heartbeat_timeout", "60",
+            "--monitor_interval", "1",
+            "--state_dir", state_dir,
+        ],
+        env=env,
+        stdout=subprocess.DEVNULL,
+        stderr=subprocess.DEVNULL,
+    )
+    probe = RpcClient(
+        f"127.0.0.1:{port}", timeout=1.0, wait_for_ready=True
+    )
+    deadline = time.monotonic() + ready_timeout
+    try:
+        while time.monotonic() < deadline:
+            if proc.poll() is not None:
+                raise DrillError(
+                    f"master exited rc={proc.returncode} before ready"
+                )
+            try:
+                probe.get(msg.KVStoreGetRequest(key="__ready_probe__"))
+                return proc
+            except Exception:  # noqa: BLE001 — not up yet
+                time.sleep(0.1)
+    finally:
+        probe.close()
+    proc.kill()
+    raise DrillError(f"master not ready within {ready_timeout}s")
+
+
+def wait_for_ledger(
+    state_dir: str,
+    dataset: str,
+    doing_task_id: int,
+    timeout: float = 15.0,
+) -> dict:
+    """Block until the newest valid snapshot records ``doing_task_id``
+    as in-flight — the drill must not kill the master before the
+    dispatch it asserts on is durable."""
+    store = MasterStateStore(state_dir)
+    deadline = time.monotonic() + timeout
+    while time.monotonic() < deadline:
+        doc = store.load_latest()
+        if doc is not None:
+            ds = (
+                doc["state"]
+                .get("task_manager", {})
+                .get("datasets", {})
+                .get(dataset)
+            )
+            if ds is not None and any(
+                t.get("task_id") == doing_task_id
+                for t in ds.get("state", {}).get("doing", [])
+            ):
+                return doc
+        time.sleep(0.05)
+    raise DrillError(
+        f"ledger snapshot never recorded task {doing_task_id} as "
+        f"doing within {timeout}s"
+    )
+
+
+def check_exactly_once(spans, total_records: int) -> None:
+    """Every record in [0, total_records) covered exactly once."""
+    seen = {}
+    for start, end in spans:
+        for r in range(start, end):
+            seen[r] = seen.get(r, 0) + 1
+    doubles = sorted(r for r, n in seen.items() if n > 1)
+    missing = sorted(r for r in range(total_records) if r not in seen)
+    if doubles:
+        raise DrillError(
+            f"records processed twice: {doubles[:10]} "
+            f"({len(doubles)} total)"
+        )
+    if missing:
+        raise DrillError(
+            f"records never processed: {missing[:10]} "
+            f"({len(missing)} total)"
+        )
+
+
+def run_drill(
+    seed: int = 0,
+    total_records: int = 64,
+    batch_size: int = 4,
+    kill_after_tasks: int = 3,
+    drop_rate: float = 0.05,
+    latency_ms: float = 2.0,
+    reconnect_budget: float = 60.0,
+    down_seconds: float = 0.0,
+    keep_dir: bool = False,
+) -> dict:
+    """One kill+restart drill; returns a JSON-able report, raises
+    :class:`DrillError` on any contract violation."""
+    # Late imports: MasterClient pulls the agent layer.
+    from dlrover_tpu.agent.master_client import MasterClient
+    from dlrover_tpu.agent.sharding_client import ShardingClient
+
+    tmpdir = tempfile.mkdtemp(prefix="chaos_drill_")
+    state_dir = os.path.join(tmpdir, "state")
+    trace_file = os.path.join(tmpdir, "trace.jsonl")
+    port = find_free_port()
+    t0 = time.monotonic()
+    master = start_master(port, state_dir, trace_file)
+    client = None
+    try:
+        client = MasterClient(f"127.0.0.1:{port}", node_id=0)
+        client.supervisor.outage_budget = reconnect_budget
+        client.supervisor.backoff_base = 0.1
+        client.register_node()
+        # Client-side chaos: every RPC from here on rides the seeded
+        # fault schedule (drops surface as transient ConnectionErrors
+        # the supervisor must absorb).
+        chaos.install_injector(
+            chaos.ChaosInjector(
+                seed=seed,
+                drop_rate=drop_rate,
+                latency_ms=latency_ms,
+                node_id=0,
+            )
+        )
+        sharding = ShardingClient("drill", client=client)
+        sharding.create_dataset(
+            dataset_size=total_records,
+            batch_size=batch_size,
+            num_minibatches_per_shard=1,
+        )
+        processed = []
+        killed = False
+        t_kill = None
+        restart_done = {}
+
+        def restart_later():
+            # Hold the outage open while the agent keeps issuing
+            # RPCs (report_task_done / get_task below block in the
+            # connection supervisor until we come back): with
+            # down_seconds > ~6s this proves agents outlive the
+            # legacy 3-fixed-retry window.
+            if down_seconds > 0:
+                time.sleep(down_seconds)
+            restart_done["proc"] = start_master(
+                port, state_dir, trace_file
+            )
+            restart_done["t_back"] = time.monotonic()
+
+        restarter = None
+        while True:
+            task = sharding.get_task(timeout=120)
+            if task is None:
+                break
+            if not killed and len(processed) + 1 >= kill_after_tasks:
+                # This shard is DOING on the master and unreported by
+                # us: the warm restart must keep it with node 0 —
+                # re-queueing it would double-process, dropping it
+                # would starve the epoch.
+                wait_for_ledger(state_dir, "drill", task.task_id)
+                master.kill()  # SIGKILL: no goodbye snapshot
+                master.wait()
+                t_kill = time.monotonic()
+                restarter = threading.Thread(
+                    target=restart_later, daemon=True
+                )
+                restarter.start()
+                killed = True
+            processed.append((task.shard.start, task.shard.end))
+            sharding.report_task_done(task.task_id)
+        if restarter is not None:
+            restarter.join(timeout=60)
+        if "proc" in restart_done:
+            master = restart_done["proc"]
+        t_back = restart_done.get("t_back")
+        if not killed:
+            raise DrillError(
+                "drill finished before the kill point — dataset too "
+                "small for kill_after_tasks"
+            )
+        check_exactly_once(processed, total_records)
+        # Give the daemon-thread result reports a beat, then verify
+        # the master agrees the dataset completed.
+        deadline = time.monotonic() + 10
+        probe = RpcClient(f"127.0.0.1:{port}", timeout=2.0)
+        try:
+            while time.monotonic() < deadline:
+                ck = probe.get(
+                    msg.ShardCheckpointRequest(dataset_name="drill")
+                )
+                state = json.loads(ck.content) if ck.content else {}
+                if not state.get("todo") and not state.get("doing"):
+                    break
+                time.sleep(0.2)
+            else:
+                raise DrillError(
+                    f"master still holds unfinished shards: {state}"
+                )
+        finally:
+            probe.close()
+        events = load_events(trace_file)
+        warm = [
+            e for e in events if e.get("name") == "master.warm_restart"
+        ]
+        if not warm:
+            raise DrillError(
+                "no master.warm_restart event in the trace — the "
+                "replacement master cold-started"
+            )
+        report = {
+            "seed": seed,
+            "total_records": total_records,
+            "shards_processed": len(processed),
+            "outage_s": round((t_back or 0) - (t_kill or 0), 3),
+            "reconnects": client.supervisor.reconnects,
+            "outages": client.supervisor.outages,
+            "warm_restart_events": len(warm),
+            "warm_restart_alive_nodes": warm[0].get("alive_nodes"),
+            "chaos_decisions": len(
+                chaos.get_injector().decisions
+                if chaos.get_injector() else ()
+            ),
+            "wall_s": round(time.monotonic() - t0, 3),
+            "dir": tmpdir if keep_dir else None,
+        }
+        return report
+    finally:
+        chaos.install_injector(None)
+        chaos.reset()
+        if client is not None:
+            client.close()
+        if master.poll() is None:
+            master.send_signal(signal.SIGTERM)
+            try:
+                master.wait(timeout=10)
+            except subprocess.TimeoutExpired:
+                master.kill()
+        if not keep_dir:
+            shutil.rmtree(tmpdir, ignore_errors=True)
+
+
+def check_schedule_reproducibility(seed: int = 1234, calls: int = 200):
+    """Same seed + same call sequence -> identical fault schedule."""
+    def schedule(s):
+        inj = chaos.ChaosInjector(
+            seed=s, drop_rate=0.2, error_rate=0.1, latency_ms=3.0,
+            node_id=0,
+        )
+        out = []
+        for i in range(calls):
+            try:
+                inj.before_client_call("get", object())
+                out.append("pass")
+            except chaos.ChaosDropError:
+                out.append("fault")
+        return out
+
+    a, b = schedule(seed), schedule(seed)
+    if a != b:
+        raise DrillError("same seed produced different fault schedules")
+    if a == schedule(seed + 1):
+        raise DrillError(
+            "different seeds produced identical fault schedules"
+        )
+    if "fault" not in a:
+        raise DrillError("no faults injected at drop_rate=0.2")
+    return {"calls": calls, "faults": a.count("fault")}
+
+
+def selftest() -> int:
+    """Seeded, hermetic, <60s: CI smoke for the chaos harness."""
+    t0 = time.monotonic()
+    repro = check_schedule_reproducibility()
+    print(
+        f"schedule reproducibility ok "
+        f"({repro['faults']}/{repro['calls']} faults)"
+    )
+    report = run_drill(
+        seed=7,
+        total_records=32,
+        batch_size=4,
+        kill_after_tasks=2,
+        drop_rate=0.05,
+        latency_ms=1.0,
+    )
+    print(
+        f"kill+restart drill ok: {report['shards_processed']} shards "
+        f"exactly-once, outage {report['outage_s']}s, "
+        f"{report['reconnects']} reconnect(s)"
+    )
+    print(f"chaos drill selftest ok ({time.monotonic() - t0:.1f}s)")
+    return 0
+
+
+def main(argv=None) -> int:
+    parser = argparse.ArgumentParser("chaos_drill")
+    parser.add_argument("--selftest", action="store_true",
+                        help="seeded quick mode (<60s) for CI")
+    parser.add_argument("--seed", type=int, default=0)
+    parser.add_argument("--rounds", type=int, default=1)
+    parser.add_argument("--records", type=int, default=64)
+    parser.add_argument("--batch", type=int, default=4)
+    parser.add_argument("--kill_after", type=int, default=3)
+    parser.add_argument("--drop_rate", type=float, default=0.05)
+    parser.add_argument("--latency_ms", type=float, default=2.0)
+    parser.add_argument(
+        "--down_seconds", type=float, default=0.0,
+        help="hold the master outage open this long before restart",
+    )
+    parser.add_argument("--json", type=str, default="",
+                        help="write the soak report to this path")
+    parser.add_argument("--keep_dir", action="store_true")
+    args = parser.parse_args(argv)
+    if args.selftest:
+        return selftest()
+    reports = []
+    failures = 0
+    for i in range(args.rounds):
+        seed = args.seed + i
+        try:
+            rep = run_drill(
+                seed=seed,
+                total_records=args.records,
+                batch_size=args.batch,
+                kill_after_tasks=args.kill_after,
+                drop_rate=args.drop_rate,
+                latency_ms=args.latency_ms,
+                down_seconds=args.down_seconds,
+                keep_dir=args.keep_dir,
+            )
+            rep["ok"] = True
+        except DrillError as e:
+            failures += 1
+            rep = {"seed": seed, "ok": False, "error": str(e)}
+        print(json.dumps(rep))
+        reports.append(rep)
+    summary = {
+        "rounds": args.rounds,
+        "failures": failures,
+        "reports": reports,
+    }
+    if args.json:
+        with open(args.json, "w") as f:
+            json.dump(summary, f, indent=2)
+    print(
+        f"chaos soak: {args.rounds - failures}/{args.rounds} rounds ok"
+    )
+    return 1 if failures else 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())
