@@ -258,6 +258,10 @@ def measure() -> int:
                 # Raw MFU vs nominal peak, so the tokens/s value and the
                 # HFU-normalized ratio can never be conflated downstream.
                 "mfu": round(mfu, 4),
+                # Only the child knows the real backend (the parent
+                # never imports jax); the parent's provenance stamp
+                # and the ledger record key on it.
+                "backend": jax.default_backend(),
                 **(
                     {"data_wait_s": round(data_wait_s, 4)}
                     if prefetch_input
@@ -356,6 +360,44 @@ def _classify(status: str, detail: str) -> str:
     return "tpu_unavailable"
 
 
+def _ledger_append(rec: dict) -> None:
+    """Append ``rec`` to BENCH_LEDGER.jsonl (BENCH_NO_LEDGER=1
+    skips). Never raises: a broken ledger must not fail (or fail to
+    report) a hard-won measurement."""
+    if os.getenv("BENCH_NO_LEDGER", "0") == "1":
+        return
+    try:
+        sys.path.insert(
+            0,
+            os.path.join(
+                os.path.dirname(os.path.abspath(__file__)), "tools"
+            ),
+        )
+        import bench_ledger
+
+        bench_ledger.append_record(rec)
+    except Exception as exc:  # noqa: BLE001
+        print(f"# ledger append failed: {exc!r}", file=sys.stderr)
+
+
+def _stamp_and_ledger(line: str) -> str:
+    """Provenance-stamp the child's JSON record (host/backend/jax
+    versions — the shared runmeta helper, so this artifact can never
+    be backend-ambiguous) and append it to the bench ledger. Any
+    failure returns the original line: the bench's one-JSON-line
+    contract outranks the bookkeeping."""
+    try:
+        rec = json.loads(line)
+        from dlrover_tpu.common.runmeta import run_metadata
+
+        rec["meta"] = run_metadata(backend=rec.get("backend"))
+        _ledger_append(rec)
+        return json.dumps(rec)
+    except Exception as exc:  # noqa: BLE001
+        print(f"# provenance stamp failed: {exc!r}", file=sys.stderr)
+        return line
+
+
 def _emit_failure(error_class: str, detail: str, attempts: int) -> None:
     rec = {
         "metric": "nanogpt_tokens_per_sec_per_chip",
@@ -366,6 +408,13 @@ def _emit_failure(error_class: str, detail: str, attempts: int) -> None:
         "detail": detail[:300],
         "attempts": attempts,
     }
+    try:
+        from dlrover_tpu.common.runmeta import run_metadata
+
+        rec["meta"] = run_metadata()
+    except Exception:  # noqa: BLE001 — the failure record must
+        # print even from a broken tree
+        pass
     # Cross-reference, NOT a substitute: if this round already landed
     # a live-chip measurement (tools/capture_perf.py appends every
     # success to PERF_r05.json with a timestamp), point at it so a
@@ -384,6 +433,10 @@ def _emit_failure(error_class: str, detail: str, attempts: int) -> None:
             }
     except Exception:  # noqa: BLE001 — no record, nothing to point at
         pass
+    # Failed captures are ledgered too (never as comparison
+    # endpoints): a dead capture window must be visible in the
+    # history, not silently absent.
+    _ledger_append(rec)
     print(json.dumps(rec))
 
 
@@ -422,10 +475,13 @@ def main() -> int:
                 min(run_timeout, remaining),
             )
             if status == "ok":
-                # Relay the child's JSON result line.
+                # Relay the child's JSON result line, stamped with
+                # the run's provenance and appended to the bench
+                # ledger (the regression-gated history a lost capture
+                # window can never erase).
                 for line in out.splitlines():
                     if line.startswith("{"):
-                        print(line)
+                        print(_stamp_and_ledger(line))
                         return 0
                 status, detail = "error", "child printed no JSON line"
         last_status, last_detail = status, detail

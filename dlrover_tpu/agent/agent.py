@@ -195,6 +195,8 @@ class ElasticAgent:
         # loop so process lifecycle has a single owner (no concurrent
         # kill/spawn races).
         self._restart_requested = threading.Event()
+        # In-flight PROFILE capture worker (one at a time).
+        self._profile_thread: Optional[threading.Thread] = None
 
     # -- process management -------------------------------------------------
 
@@ -395,6 +397,74 @@ class ElasticAgent:
         digest, bundle_path = self._collect_forensics("diagnose")
         self.client.report_diagnostics(
             "diagnose", bundle_path=bundle_path, digest=digest
+        )
+
+    def _run_profile(self) -> None:
+        """Master-pushed `profile` action: ask the co-hosted trainer
+        for an N-step step-phase/MFU capture and ship the digest back
+        as a DiagnosticsReport(kind="profile").
+
+        Runs in its own daemon thread: the capture spans N training
+        steps (seconds to minutes), and the heartbeat loop must keep
+        beating while the trainer gets there. One capture at a time —
+        a second PROFILE while one is in flight is dropped (the
+        running capture's digest answers it)."""
+        if (
+            self._profile_thread is not None
+            and self._profile_thread.is_alive()
+        ):
+            logger.info("profile capture already in flight; skipping")
+            return
+        self._profile_thread = threading.Thread(
+            target=self._profile_worker,
+            name="profile-capture",
+            daemon=True,
+        )
+        self._profile_thread.start()
+
+    def _profile_worker(self) -> None:
+        try:
+            self._profile_worker_inner()
+        except Exception:  # noqa: BLE001 — a failed capture must
+            # neither kill the agent nor masquerade as a crash (an
+            # uncaught thread exception would write a forensics
+            # bundle via threading.excepthook)
+            logger.warning("profile capture failed", exc_info=True)
+
+    def _profile_worker_inner(self) -> None:
+        import json as _json
+
+        from dlrover_tpu.obs import profiling
+
+        req_id = profiling.write_profile_request()
+        wait_s = float(os.getenv("DLROVER_TPU_PROFILE_WAIT_S", "120"))
+        deadline = time.monotonic() + wait_s
+        digest = None
+        while time.monotonic() < deadline:
+            digest = profiling.read_profile_digest(expect_id=req_id)
+            if digest is not None:
+                break
+            time.sleep(0.25)
+        if digest is None:
+            # The answer is itself diagnostic: no digest within the
+            # wait usually means no live trainer loop (hung, between
+            # restarts, or a loop without a step-phase profiler).
+            self.client.report_diagnostics(
+                "profile",
+                digest=_json.dumps(
+                    {
+                        "id": req_id,
+                        "error": f"no profile digest within {wait_s:.0f}s"
+                        " (trainer not stepping, or its loop has no"
+                        " StepPhaseProfiler)",
+                    }
+                ),
+            )
+            return
+        self.client.report_diagnostics(
+            "profile",
+            bundle_path=profiling.profile_digest_file(),
+            digest=_json.dumps(digest, indent=1, sort_keys=True),
         )
 
     # -- health check -------------------------------------------------------
@@ -802,6 +872,12 @@ class ElasticAgent:
                 except Exception:  # noqa: BLE001 — an on-demand
                     # snapshot must never take the heartbeat down
                     logger.warning("diagnose failed", exc_info=True)
+            elif action == EventAction.PROFILE.value:
+                try:
+                    self._run_profile()
+                except Exception:  # noqa: BLE001 — an on-demand
+                    # capture must never take the heartbeat down
+                    logger.warning("profile failed", exc_info=True)
 
     def stop(self) -> None:
         self._stop.set()

@@ -689,6 +689,12 @@ class MasterClient:
         )
         return list(resp.reports)
 
+    def request_profile(self, node_id: int) -> None:
+        """Operator trigger: ask the master to queue a PROFILE action
+        for ``node_id`` (its agent captures an N-step phase/MFU
+        digest into the diagnostics history)."""
+        self._report(msg.ProfileActionRequest(node_id=node_id))
+
     # -- PS-elastic sparse path ------------------------------------------
 
     @retry()

@@ -249,6 +249,9 @@ class ResourceMonitor:
         tps = self._tokens_per_s(data)
         if tps is not None:
             resource["tokens_per_s"] = tps
+        mfu = data.get("mfu")
+        if isinstance(mfu, (int, float)) and mfu > 0:
+            resource["mfu"] = float(mfu)
         return {
             "host": self.host,
             "registry": obs.get_registry().dump(),
@@ -307,12 +310,16 @@ class TrainingMonitor:
         tokens: int = 0,
         path: Optional[str] = None,
         step_time: Optional[float] = None,
+        mfu: Optional[float] = None,
     ) -> None:
         """Called from the TRAINING process each step (cheap: one
         tmp-file rename). ``step_time`` — this step's wall time, when
         the loop measures it — accumulates into a rolling
         ``recent_step_times`` window the agent forwards to the
-        master's straggler scorer."""
+        master's straggler scorer. ``mfu`` — the trainer's live
+        model-FLOPs-utilisation — rides the same file into the
+        agent's fleet snapshot (resource ``mfu``), so the master can
+        aggregate utilisation across hosts."""
         obs.event("trainer.step", step=step, tokens=tokens)
         # Last-known-step into the black box: one dict update, so a
         # crash bundle can say how far training got even when the
@@ -324,17 +331,17 @@ class TrainingMonitor:
         )
         if step_time is not None and step_time > 0:
             recent.append(round(float(step_time), 6))
+        data = {
+            "step": step,
+            "tokens": tokens,
+            "ts": time.time(),
+            "recent_step_times": list(recent),
+        }
+        if mfu is not None and mfu > 0:
+            data["mfu"] = round(float(mfu), 6)
         tmp = f"{path}.tmp"
         with open(tmp, "w") as f:
-            json.dump(
-                {
-                    "step": step,
-                    "tokens": tokens,
-                    "ts": time.time(),
-                    "recent_step_times": list(recent),
-                },
-                f,
-            )
+            json.dump(data, f)
         os.replace(tmp, path)
 
     @staticmethod

@@ -244,3 +244,8 @@ class EventAction(str, enum.Enum):
     # training process for a stack dump, writes its own recorder
     # bundle, and ships a DiagnosticsReport back to the master.
     DIAGNOSE = "diagnose"
+    # Capture an on-demand N-step performance profile: the agent
+    # drops a request file for its trainer's step-phase profiler,
+    # waits for the phase/MFU digest, and ships it back as a
+    # DiagnosticsReport(kind="profile").
+    PROFILE = "profile"

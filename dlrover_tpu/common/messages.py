@@ -347,6 +347,17 @@ class DiagnosticsReport:
 
 
 @message
+class ProfileActionRequest:
+    """Operator/tool -> master: queue a PROFILE heartbeat action for
+    ``node_id`` (its agent asks the trainer for an N-step phase/MFU
+    capture; the digest lands in the diagnostics history, queryable
+    via ``DiagnosticsQueryRequest``). The capture length is the
+    agent's ``DLROVER_TPU_PROFILE_STEPS``."""
+
+    node_id: int = -1
+
+
+@message
 class DiagnosticsQueryRequest:
     """Fetch the master's per-node diagnostics history; ``node_id``
     -1 means every node."""

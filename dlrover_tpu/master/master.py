@@ -109,11 +109,12 @@ class JobMaster:
             ps_manager=self.ps_manager,
             fleet=self.fleet,
         )
-        # A freshly-scored straggler gets a fleet `diagnose`: its
-        # agent SIGUSR1s the training process and ships the stack
-        # digest back while the host is still slow — verdicts become
-        # diagnosable, not just flagged.
-        self.speed_monitor.on_straggler = self.servicer.diagnose_node
+        # A freshly-scored straggler gets a fleet `diagnose` AND a
+        # `profile`: its agent SIGUSR1s the training process for a
+        # stack digest and asks the trainer for an N-step phase/MFU
+        # capture while the host is still slow — verdicts become
+        # diagnosable AND measurable, not just flagged.
+        self.speed_monitor.on_straggler = self._on_straggler
         # PS-strategy auto-scaling starts on demand (sparse/CTR jobs):
         # master.start_ps_autoscaler() wires the hot-PS optimizer to
         # the registered PS fleet.
@@ -318,6 +319,12 @@ class JobMaster:
     @property
     def addr(self) -> str:
         return self._server.addr
+
+    def _on_straggler(self, node_id: int) -> None:
+        """Fresh straggler verdict: snapshot its stacks (diagnose)
+        and measure where its step time goes (profile)."""
+        self.servicer.diagnose_node(node_id)
+        self.servicer.profile_node(node_id)
 
     def prepare(self) -> None:
         # Restore BEFORE the server accepts its first RPC: agents

@@ -134,6 +134,7 @@ class MasterServicer:
         r(msg.ResourceStats, self._report_resource)
         r(msg.MetricsSnapshotReport, self._report_metrics_snapshot)
         r(msg.DiagnosticsReport, self._report_diagnostics)
+        r(msg.ProfileActionRequest, self._profile_node_req)
         r(msg.NodeFailureReport, self._report_failure)
         r(msg.NodeSucceededReport, self._report_succeeded)
         r(msg.HeartbeatRequest, self._heartbeat)
@@ -432,6 +433,18 @@ class MasterServicer:
         (operator trigger or the SpeedMonitor's straggler/hang
         verdict); delivered via its next heartbeat."""
         self.push_action(node_id, EventAction.DIAGNOSE.value)
+
+    def profile_node(self, node_id: int) -> None:
+        """Queue an on-demand N-step performance capture on the node
+        (operator RPC or the SpeedMonitor's straggler verdict): its
+        agent asks the co-hosted trainer for a step-phase + MFU
+        digest, shipped back as DiagnosticsReport(kind="profile")."""
+        self.push_action(node_id, EventAction.PROFILE.value)
+
+    def _profile_node_req(self, req: msg.ProfileActionRequest):
+        self.profile_node(req.node_id)
+        obs.event("node.profile_requested", node_id=req.node_id)
+        return None
 
     def _register_node(self, req: msg.NodeAddressRequest):
         node = self.job_manager.register_node(

@@ -36,6 +36,12 @@ doing through this package, so "what is the job doing right now" and
 * :mod:`dlrover_tpu.obs.postmortem` — folds a forensics dir (bundles,
   faulthandler stack dumps, traces) into the "last 60 seconds before
   failure" report ``tools/obs_report.py --postmortem`` prints.
+* :mod:`dlrover_tpu.obs.profiling` — perf observability for the hot
+  path: per-step wall-time attribution (data_wait / compile /
+  dispatch / device_execute), recompile counters per jitted function,
+  a live MFU gauge from XLA cost analysis, and the on-demand PROFILE
+  capture protocol (master action -> agent request file -> trainer
+  digest -> diagnostics history).
 
 The functions re-exported here are the instrumentation surface the
 rest of the codebase uses::
@@ -82,4 +88,9 @@ from dlrover_tpu.obs.goodput import (  # noqa: F401
     GoodputReport,
     attribute_goodput,
     render_goodput,
+)
+from dlrover_tpu.obs.profiling import (  # noqa: F401
+    CompileTracker,
+    MfuMeter,
+    StepPhaseProfiler,
 )

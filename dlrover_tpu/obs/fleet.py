@@ -53,6 +53,7 @@ KEY_SERIES = (
     "tokens_per_s",
     "data_wait_s_total",
     "host_syncs_total",
+    "mfu",
 )
 STATS = ("sum", "min", "max", "p50", "p90")
 
@@ -203,6 +204,11 @@ class FleetAggregator:
             return None
         if series == "tokens_per_s":
             v = snap.resource.get("tokens_per_s")
+            return float(v) if v is not None else None
+        if series == "mfu":
+            # Shipped via the trainer's step-metrics file -> the
+            # agent snapshot's resource dict (monitor.build_snapshot).
+            v = snap.resource.get("mfu")
             return float(v) if v is not None else None
         if series == "data_wait_s_total":
             h = hist("dlrover_train_data_wait_seconds")

@@ -24,6 +24,7 @@ Design differences from the torch original, on purpose:
 from __future__ import annotations
 
 import dataclasses
+import os
 import time
 from typing import Any, Callable, Optional, Tuple
 
@@ -35,6 +36,13 @@ from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
 from dlrover_tpu import obs
 from dlrover_tpu.common.log import get_logger
+from dlrover_tpu.obs.profiling import (
+    MFU_ENV,
+    CompileTracker,
+    MfuMeter,
+    StepPhaseProfiler,
+    step_flops,
+)
 from dlrover_tpu.parallel.sharding import prune_specs_to_mesh
 from dlrover_tpu.trainer.async_metrics import AsyncScalarReporter
 from dlrover_tpu.trainer.step import batch_spec
@@ -165,6 +173,15 @@ class ElasticTrainer:
         # restart builds a new trainer, so the first sample after any
         # world change covers that world's compile).
         self._last_step_t: Optional[float] = None
+        # Perf observability: recompile accounting on the jitted step
+        # (every elastic restart builds a new trainer, so counter
+        # increments attribute to this world's function), a live MFU
+        # meter fed by cost-analysis FLOPs derived at the compile
+        # boundary (DLROVER_TPU_MFU=0 skips the extra trace+lower),
+        # and an optional step-phase profiler the owning loop attaches
+        # (attach_profiler) to get dispatch/compile phases noted.
+        self.mfu_meter = MfuMeter()
+        self.profiler: Optional[StepPhaseProfiler] = None
         if step_fn is not None:
             if loss_fn is not None:
                 raise ValueError(
@@ -197,6 +214,9 @@ class ElasticTrainer:
                 global_batch_size, micro_batch_size, self.num_shards
             )
             self._compiled = self._build_step()
+        self._compile_tracker = CompileTracker(
+            "train_step", jfn=self._compiled
+        )
         logger.info(
             "elastic trainer: %d shards x micro %d x accum %d >= "
             "global %d%s",
@@ -405,11 +425,27 @@ class ElasticTrainer:
                     "shard_microbatches() (ideally via "
                     "data.prefetch.make_input_pipeline)"
                 )
+        if (
+            self._last_step_t is None
+            and self.mfu_meter.flops_per_step is None
+            and os.getenv(MFU_ENV, "1") != "0"
+        ):
+            # Compile boundary: price the step with XLA's cost model
+            # BEFORE dispatch (donation deletes the input buffers
+            # after it). Trace+lower only — never a second compile.
+            self.mfu_meter.set_flops(
+                step_flops(
+                    self._compiled, params, opt_state, tokens, targets
+                )
+            )
         t0 = time.perf_counter()
         params, opt_state, loss = self._compiled(
             params, opt_state, tokens, targets
         )
         now = time.perf_counter()
+        compiled_now = self._compile_tracker.observe_call(now - t0)
+        if self.profiler is not None:
+            self.profiler.note_dispatch(now - t0, compiled=compiled_now)
         if self._last_step_t is None:
             # Dispatch of the first call traces + compiles
             # synchronously: this sample is the compile boundary.
@@ -421,12 +457,35 @@ class ElasticTrainer:
             )
         else:
             _STEP_SECONDS.observe(now - self._last_step_t)
+            # MFU rides the same between-dispatch cadence as
+            # _STEP_SECONDS (the window mean equals true step time);
+            # the compile-boundary sample is excluded so one slow
+            # first step cannot depress the gauge for a whole window.
+            # A loop with an attached profiler feeds the meter from
+            # end_step() instead (same wall, plus phase context).
+            if self.profiler is None:
+                self.mfu_meter.observe_step(now - self._last_step_t)
         self._last_step_t = now
         _STEPS_TOTAL.inc()
         self.step_num += 1
         if self._reporter is not None:
             self._reporter.offer(self.step_num, loss)
         return params, opt_state, loss
+
+    def attach_profiler(self, profiler: StepPhaseProfiler) -> None:
+        """Hook a step-phase profiler into the hot path: train_step
+        notes its dispatch (or compile) time on it, and the profiler's
+        shared meter/tracker give captures the live MFU and compile
+        counts. The owning loop still calls ``profiler.end_step()``
+        once per step (it alone knows the data-wait boundary)."""
+        profiler.mfu = self.mfu_meter
+        profiler.compile_tracker = self._compile_tracker
+        self.profiler = profiler
+
+    @property
+    def mfu(self) -> Optional[float]:
+        """Live windowed MFU, None until FLOPs+steps are known."""
+        return self.mfu_meter.mfu
 
     def _emit_report(self, step: int, loss: float) -> None:
         self.report_fn(

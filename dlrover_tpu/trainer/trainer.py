@@ -238,12 +238,25 @@ class Trainer:
         t0 = time.time()
         step = start_step
         prev_step_t = time.time()
+        # Step-phase attribution + on-demand PROFILE capture: this
+        # loop notes the data-wait boundary, the trainer notes
+        # dispatch/compile, end_step() books the residual as device
+        # time and polls for master-pushed profile requests.
+        from dlrover_tpu.obs.profiling import StepPhaseProfiler
+
+        profiler = StepPhaseProfiler()
+        trainer.attach_profiler(profiler)
         try:
             for step in range(start_step + 1, args.max_steps + 1):
+                t_fetch = time.perf_counter()
                 tokens, targets = next(batches)
+                profiler.note_data_wait(
+                    time.perf_counter() - t_fetch
+                )
                 params, opt_state, last_loss = trainer.train_step(
                     params, opt_state, tokens, targets
                 )
+                profiler.end_step()
                 # Per-step wall time (dispatch pacing, same caveat as
                 # dlrover_train_step_seconds): rides the metrics file
                 # to the agent and on to the master's straggler
@@ -256,6 +269,7 @@ class Trainer:
                     * args.global_batch_size
                     * tokens.shape[-1],
                     step_time=step_wall,
+                    mfu=trainer.mfu,
                 )
                 if step % args.log_steps == 0:
                     loss_val = materialize(last_loss, reason="log")

@@ -395,10 +395,10 @@ class TestDiagnosticsChannel:
 
 
 class TestStragglerDiagnose:
-    def test_fresh_straggler_queues_diagnose_action(self):
+    def test_fresh_straggler_queues_diagnose_and_profile(self):
         """The SpeedMonitor's straggler verdict triggers a fleet
-        `diagnose` through the master wiring — delivered on the slow
-        node's next heartbeat."""
+        `diagnose` AND a `profile` through the master wiring —
+        delivered on the slow node's next heartbeats (one per beat)."""
         from dlrover_tpu.master.master import JobMaster
 
         master = JobMaster(port=0, node_num=3, rdzv_timeout=1.0)
@@ -411,14 +411,22 @@ class TestStragglerDiagnose:
                         node_id, 10.0 if node_id == 2 else 0.1
                     )
             assert sm.stragglers() == [2]
-            assert servicer_actions(master, 2) == ["diagnose"]
+            assert servicer_actions(master, 2) == [
+                "diagnose", "profile",
+            ]
             # Re-scoring the same straggler does not re-queue.
             sm.observe_host_step_time(2, 10.0)
-            assert servicer_actions(master, 2) == ["diagnose"]
+            assert servicer_actions(master, 2) == [
+                "diagnose", "profile",
+            ]
             beat = master.servicer._heartbeat(
                 msg.HeartbeatRequest(node_id=2)
             )
             assert beat.action == EventAction.DIAGNOSE.value
+            beat = master.servicer._heartbeat(
+                msg.HeartbeatRequest(node_id=2)
+            )
+            assert beat.action == EventAction.PROFILE.value
         finally:
             master.stop()
 

@@ -34,10 +34,40 @@ def log(msg: str) -> None:
     print(f"[{time.strftime('%F %T')}] {msg}", flush=True)
 
 
+def decode_output(v) -> str:
+    """Normalize a ``TimeoutExpired`` capture attribute to text.
+
+    ``exc.stdout``/``exc.stderr`` are None — or BYTES, ``text=True``
+    notwithstanding — when the child is killed mid-pipe. Every
+    TimeoutExpired handler under tools/ that reads them must route
+    through here (regression-tested): the r5 autotune handler passed
+    raw bytes to ``parse_autotune`` and the whole sweep's results
+    were lost to a TypeError."""
+    if isinstance(v, bytes):
+        return v.decode("utf-8", "replace")
+    return v or ""
+
+
+def stamp_meta(rec: dict) -> dict:
+    """Provenance stamp (host/backend/jax versions) on a perf record;
+    the bench child usually pre-stamps, this backfills older shapes.
+    Best-effort: a record without a stamp still beats no record."""
+    if "meta" not in rec:
+        try:
+            import _repo_path  # noqa: F401
+            from dlrover_tpu.common.runmeta import run_metadata
+
+            rec["meta"] = run_metadata(backend=rec.get("backend"))
+        except Exception as exc:  # noqa: BLE001
+            log(f"meta stamp failed: {exc!r}")
+    return rec
+
+
 def append_perf(rec: dict) -> None:
     """Append atomically. A hard-won measurement must survive even a
     corrupt history file: the record is salvaged to a side file and
     the chain continues (the corrupt original is never overwritten)."""
+    rec = stamp_meta(rec)
     try:
         hist = []
         if os.path.exists(PERF):
@@ -74,14 +104,9 @@ def run_bench(extra_env: dict, timeout_s: float) -> dict | None:
             timeout=timeout_s,
         )
     except subprocess.TimeoutExpired as exc:
-        # exc.output/stderr are None (or bytes on older CPythons)
-        # when the child is killed mid-pipe; normalize defensively.
-        def _txt(v):
-            if isinstance(v, bytes):
-                return v.decode("utf-8", "replace")
-            return v or ""
-
-        tail = (_txt(exc.stderr) + _txt(exc.output))[-500:]
+        tail = (
+            decode_output(exc.stderr) + decode_output(exc.output)
+        )[-500:]
         log(
             f"bench.py timed out after {timeout_s:.0f}s"
             + (f"; tail: {tail}" if tail else " (no output captured)")
@@ -199,6 +224,25 @@ def persist_winner(pins: dict, tuned_rec: dict, spec: str) -> None:
     log(f"pinned winner to bench_tuned.json: {pins}")
 
 
+def run_autotune(timeout_s: float = 2700) -> str:
+    """One quick autotune sweep; returns its stdout as TEXT even on
+    timeout (the r5 regression: ``exc.stdout`` arrives as bytes when
+    the child dies mid-pipe, and feeding bytes to ``parse_autotune``
+    threw the partial sweep away)."""
+    try:
+        p = subprocess.run(
+            [sys.executable, "tools/autotune_bwd_blocks.py", "--quick"],
+            capture_output=True,
+            text=True,
+            cwd=REPO,
+            timeout=timeout_s,
+        )
+        return p.stdout or ""
+    except subprocess.TimeoutExpired as exc:
+        log("autotune timed out; using partial results")
+        return decode_output(exc.stdout)
+
+
 def parse_autotune(out: str) -> tuple | None:
     """Best (spec, tok_s) from perf_sweep result lines. Ranked by
     tokens/s, NOT step time — the sweep now varies batch size, and a
@@ -236,6 +280,9 @@ def main() -> int:
                     # baseline this stage records (the tuned gate
                     # compares against this number).
                     "BENCH_IGNORE_TUNED": "1",
+                    # Stage label for the bench ledger record the
+                    # child appends (tools/bench_ledger.py).
+                    "BENCH_LEDGER_STAGE": "baseline",
                 },
                 timeout_s=1800,
             )
@@ -257,19 +304,7 @@ def main() -> int:
 
     # Stage 2: autotune sweep (partial output still usable on timeout).
     log("autotune sweep starting")
-    out = ""
-    try:
-        p = subprocess.run(
-            [sys.executable, "tools/autotune_bwd_blocks.py", "--quick"],
-            capture_output=True,
-            text=True,
-            cwd=REPO,
-            timeout=2700,
-        )
-        out = p.stdout
-    except subprocess.TimeoutExpired as exc:
-        out = exc.stdout or ""
-        log("autotune timed out; using partial results")
+    out = run_autotune()
     best = parse_autotune(out)
     if best is None:
         log("no autotune results; stopping after baseline")
@@ -292,6 +327,7 @@ def main() -> int:
                 **pins,
                 "BENCH_MAX_WAIT_S": "600",
                 "BENCH_PROBE_TIMEOUT": "90",
+                "BENCH_LEDGER_STAGE": "tuned",
             },
             timeout_s=1800,
         )

@@ -132,8 +132,80 @@ def input_pipeline_summary(events) -> str:
     return "\n".join(lines)
 
 
+def perf_summary(events) -> str:
+    """Step-phase / compile / MFU summary from the profiler's trace
+    events (``trainer.step_phases`` per step, ``trainer.compile`` per
+    detected (re)compile, ``trainer.profile_done`` per on-demand
+    capture). Returns "" when the trace carries none — a pre-profiler
+    trace renders exactly as before."""
+    rows = [e for e in events if e.get("name") == "trainer.step_phases"]
+    compiles = [e for e in events if e.get("name") == "trainer.compile"]
+    captures = [
+        e for e in events if e.get("name") == "trainer.profile_done"
+    ]
+    if not rows and not compiles:
+        return ""
+    lines = ["step phases (trainer.step_phases):"]
+    if rows:
+        wall = sum(float(e.get("wall_s", 0.0)) for e in rows)
+        lines.append(
+            f"  {len(rows)} steps, {wall:.3f}s wall"
+            + (
+                f", mean step {wall / len(rows):.4f}s"
+                if rows
+                else ""
+            )
+        )
+        lines.append(
+            f"  {'phase':<16} {'total_s':>9} {'mean_s':>9} {'% wall':>7}"
+        )
+        for phase, key in (
+            ("data_wait", "data_wait_s"),
+            ("compile", "compile_s"),
+            ("dispatch", "dispatch_s"),
+            ("device_execute", "device_s"),
+        ):
+            total = sum(float(e.get(key, 0.0)) for e in rows)
+            pct = 100.0 * total / wall if wall > 0 else 0.0
+            lines.append(
+                f"  {phase:<16} {total:>9.3f} "
+                f"{total / len(rows):>9.4f} {pct:>6.1f}%"
+            )
+        mfus = [
+            float(e["mfu"]) for e in rows if e.get("mfu") is not None
+        ]
+        if mfus:
+            lines.append(
+                f"  mfu: last {mfus[-1]:.4f} over {len(mfus)} samples"
+            )
+    if compiles:
+        by_fn = {}
+        for e in compiles:
+            fn = str(e.get("fn", "?"))
+            count, total = by_fn.get(fn, (0, 0.0))
+            by_fn[fn] = (count + 1, total + float(e.get("dur_s", 0.0)))
+        parts = ", ".join(
+            f"{fn} x{c} ({t:.2f}s)"
+            for fn, (c, t) in sorted(by_fn.items())
+        )
+        lines.append(f"  compiles: {parts}")
+    for e in captures:
+        lines.append(
+            f"  profile capture: {e.get('steps')} steps"
+            f" (request {e.get('request_id') or '-'}"
+            + (
+                f", mfu {e['mfu']}"
+                if e.get("mfu") is not None
+                else ""
+            )
+            + ")"
+        )
+    return "\n".join(lines)
+
+
 def report(
-    path: str, failure_ts=None, top: int = 15, goodput: bool = False
+    path: str, failure_ts=None, top: int = 15, goodput: bool = False,
+    perf: bool = False,
 ) -> int:
     events = [e for e in load_events(path) if "ts" in e]
     if not events:
@@ -152,6 +224,10 @@ def report(
     if pipeline:
         print()
         print(pipeline)
+    if perf:
+        summary = perf_summary(events)
+        print()
+        print(summary or "no perf events (trainer.step_phases) in trace")
     if goodput:
         gp = attribute_goodput(events)
         if gp is not None:
@@ -235,6 +311,7 @@ def selftest() -> int:
         errors.extend(_selftest_goodput(events))
     errors.extend(_selftest_fleet())
     errors.extend(_selftest_postmortem())
+    errors.extend(_selftest_perf())
     if errors:
         print("obs selftest FAILED:")
         for e in errors:
@@ -428,6 +505,48 @@ def _selftest_postmortem() -> list:
     return errors
 
 
+def _selftest_perf() -> list:
+    """--perf section on synthetic profiler events: phase totals,
+    wall percentages, compile rollup, and capture line must all be
+    hand-verifiable."""
+    errors = []
+    t = 3000.0
+    events = [
+        {"name": "trainer.compile", "ts": t, "fn": "train_step",
+         "dur_s": 2.0, "total": 1},
+        {"name": "trainer.step_phases", "ts": t + 2.0, "step": 1,
+         "wall_s": 2.5, "data_wait_s": 0.25, "compile_s": 2.0,
+         "dispatch_s": 0.05, "device_s": 0.2},
+        {"name": "trainer.step_phases", "ts": t + 3.0, "step": 2,
+         "wall_s": 0.5, "data_wait_s": 0.05, "compile_s": 0.0,
+         "dispatch_s": 0.05, "device_s": 0.4, "mfu": 0.41},
+        {"name": "trainer.step_phases", "ts": t + 4.0, "step": 3,
+         "wall_s": 1.0, "data_wait_s": 0.2, "compile_s": 0.0,
+         "dispatch_s": 0.1, "device_s": 0.7, "mfu": 0.43},
+        {"name": "trainer.profile_done", "ts": t + 4.0, "steps": 3,
+         "request_id": "r1", "mfu": 0.43},
+    ]
+    summary = perf_summary(events)
+    for needle in (
+        "3 steps, 4.000s wall",
+        "data_wait            0.500",  # 0.25+0.05+0.2
+        "compile              2.000",
+        "device_execute       1.300",
+        "50.0%",   # compile = 2.0 / 4.0 wall
+        "mfu: last 0.4300 over 2 samples",
+        "compiles: train_step x1 (2.00s)",
+        "profile capture: 3 steps (request r1, mfu 0.43)",
+    ):
+        if needle not in summary:
+            errors.append(f"perf summary missing {needle!r}: {summary!r}")
+    if perf_summary(
+        [e for e in events if "step_phases" not in e["name"]
+         and "compile" not in e["name"]]
+    ):
+        errors.append("perf summary not empty without profiler events")
+    return errors
+
+
 def main(argv=None) -> int:
     p = argparse.ArgumentParser("obs_report")
     p.add_argument("event_file", nargs="?", default="")
@@ -440,6 +559,11 @@ def main(argv=None) -> int:
     p.add_argument(
         "--goodput", action="store_true",
         help="print the goodput/badput wall-time attribution",
+    )
+    p.add_argument(
+        "--perf", action="store_true",
+        help="print the step-phase / compile / MFU summary from the "
+        "profiler's trace events",
     )
     p.add_argument(
         "--postmortem", type=str, default="",
@@ -473,7 +597,7 @@ def main(argv=None) -> int:
         p.error("event_file is required (or pass --selftest/--postmortem)")
     return report(
         args.event_file, args.failure_ts, args.top,
-        goodput=args.goodput,
+        goodput=args.goodput, perf=args.perf,
     )
 
 
