@@ -262,7 +262,28 @@ def _build_for_strategy(
     loss = _maybe_bind_seq_attention(
         model_loss, mesh, strategy, seq_attention_kwargs
     )
-    step = make_train_step(mesh, loss, optimizer)
+    if strategy.overlap_reduce:
+        # Bucketed reduces issued as gradients finalize (the schedule
+        # ElasticTrainer's overlap_reduce uses inside its accumulation
+        # scan; here accum collapses to 1 but bucketing still replaces
+        # XLA's monolithic post-backward reduce). Only sound when
+        # params are replicated over everything but ``data``.
+        if not strategy.pure_data_parallel:
+            raise ValueError(
+                f"strategy {strategy.name()} sets overlap_reduce on a "
+                "non-pure-data mesh; overlapped reduction needs "
+                "replicated params"
+            )
+        from dlrover_tpu.parallel.compression import (
+            make_overlapped_train_step,
+        )
+
+        step = make_overlapped_train_step(
+            mesh, loss, optimizer,
+            bucket_mb=strategy.reduce_bucket_mb,
+        )
+    else:
+        step = make_train_step(mesh, loss, optimizer)
     return mesh, optimizer, init, step
 
 
@@ -399,6 +420,40 @@ def _dry_run(
     return n / dt, compile_s
 
 
+def _tune_cache_key(
+    analysis: ModelAnalysis, sample_batch, n_devices: int
+) -> str:
+    """The persistent-cache key for one search problem: model shape
+    dims, per-sample batch shape/dtype, device extent, backend and
+    toolchain versions (common/runmeta.trial_fingerprint). The
+    per-trial *strategy* (mesh axis sizes, remat, dtype, optimizer,
+    microbatch, overlap knobs) is the trial's config, not part of the
+    key — one key indexes the whole candidate space's observations."""
+    from dlrover_tpu.common.runmeta import (
+        package_version,
+        trial_fingerprint,
+    )
+
+    tok, tgt = sample_batch
+    return trial_fingerprint(
+        {
+            "kind": "auto_accelerate",
+            "n_params": analysis.n_params,
+            "largest_leaf": analysis.largest_leaf,
+            # Batch dim excluded: dry-runs tile the sample to each
+            # candidate's own micro batch anyway.
+            "sample": [
+                [list(tok.shape[1:]), str(tok.dtype)],
+                [list(tgt.shape[1:]), str(tgt.dtype)],
+            ],
+            "n_devices": n_devices,
+            "backend": jax.default_backend(),
+            "jax": package_version("jax"),
+            "jaxlib": package_version("jaxlib"),
+        }
+    )
+
+
 @dataclasses.dataclass
 class PlanEntry:
     """One viable strategy from plan-only analysis."""
@@ -486,6 +541,7 @@ def auto_accelerate(
     optimizer_kwargs: Optional[Dict] = None,
     seq_attention_kwargs: Optional[Dict] = None,
     pipeline_builder: Optional[Callable] = None,
+    tune_cache=None,
 ) -> AccelerateResult:
     """Pick (or apply) a strategy and return the compiled pieces.
 
@@ -493,6 +549,18 @@ def auto_accelerate(
     None it analyses, prunes by memory estimate, dry-runs the top
     candidates and keeps the fastest. ``optimizer_kwargs`` forwards
     schedule/clipping knobs to make_optimizer.
+
+    ``tune_cache``: the persistent trial cache
+    (``accelerate/tune_cache.py``). ``None`` uses the env-configured
+    default store (``DLROVER_TPU_TUNE_CACHE``; ``0``/``off`` disables),
+    ``False`` disables for this call, a path or ``TuneCache`` selects a
+    store. Matching cached observations warm-start the BO search
+    (failed trials included as zero-throughput points) so a warm cache
+    reaches the same winner with strictly fewer dry-runs — on TPU each
+    avoided dry-run is tens of seconds of compile time — and every
+    real dry-run (success or failure) is recorded back. Cache traffic
+    is observable via ``dlrover_tune_cache_{hits,misses}_total``;
+    replayed trials appear in ``search_log`` with ``"cached": true``.
     ``seq_attention_kwargs`` overrides the seq-parallel attention
     binding for seq-sharded strategies (e.g. ``{"causal": False}``
     for a non-causal model — the binding assumes a causal LM
@@ -598,44 +666,131 @@ def auto_accelerate(
 
     search = BayesStrategySearch(viable, cost_prior=cost_prior)
     log: List[Dict] = []
-    while search.should_continue(max_dry_runs):
-        cand = search.suggest()
-        try:
-            tput, compile_s = _dry_run(
-                cand, build(cand), sample_batch
+
+    # Persistent trial cache: replay matching observations before any
+    # dry-run is spent. Replayed points count against the budget, so
+    # a warm cache converts directly into fewer compiles.
+    from dlrover_tpu.accelerate import tune_cache as _tc
+
+    cache = _tc.resolve(tune_cache)
+    cache_key: Optional[str] = None
+    replayed = 0
+    if cache is not None:
+        cache_key = _tune_cache_key(
+            analysis, sample_batch, len(devices)
+        )
+        by_cfg: Dict[str, Dict] = {}
+        for t in cache.trials(cache_key):
+            if isinstance(t.get("config"), str):
+                by_cfg[t["config"]] = t  # append order: newest wins
+        pairs = []
+        for s in viable:
+            t = by_cfg.get(s.to_json())
+            if t is not None:
+                pairs.append(
+                    (
+                        s,
+                        None
+                        if t.get("failed")
+                        else t.get("throughput"),
+                    )
+                )
+        # A hit is a REPLAYABLE trial, not just a record for the key:
+        # a Strategy schema change leaves every stored config string
+        # unmatchable while the key stays identical, and that must
+        # read as a miss (no work avoided), not a 100% hit rate.
+        _tc.count_lookup(bool(pairs))
+        replayed = search.warm_start(pairs)
+        if replayed:
+            for s, tput in pairs:
+                entry: Dict = {"strategy": s.name(), "cached": True}
+                if tput is None:
+                    entry["error"] = "cached failed trial"
+                else:
+                    entry["samples_per_sec"] = tput
+                log.append(entry)
+
+    def run_dry_loop(search):
+        fresh = 0
+        while search.should_continue(max_dry_runs):
+            fresh += 1
+            cand = search.suggest()
+            try:
+                tput, compile_s = _dry_run(
+                    cand, build(cand), sample_batch
+                )
+            except Exception as exc:  # noqa: BLE001 — OOM/shape mismatch
+                logger.warning(
+                    "strategy %s failed: %s", cand.name(), exc
+                )
+                log.append({"strategy": cand.name(), "error": str(exc)})
+                search.observe(cand, None)
+                if cache is not None:
+                    # Failed trials are cached too: the next session's
+                    # GP steers away instead of re-paying the OOM.
+                    cache.record(
+                        cache_key,
+                        cand.to_json(),
+                        None,
+                        failed=True,
+                        extra={"error": str(exc)[:200]},
+                    )
+                # the failed candidate's executables must not stay
+                # resident either — they'd cascade the OOM into the
+                # next dry-run
+                build_cache.pop(cand.to_json(), None)
+                continue
+            log.append(
+                {
+                    "strategy": cand.name(),
+                    "samples_per_sec": tput,
+                    "compile_s": compile_s,
+                }
             )
-        except Exception as exc:  # noqa: BLE001 — OOM/shape mismatch
-            logger.warning("strategy %s failed: %s", cand.name(), exc)
-            log.append({"strategy": cand.name(), "error": str(exc)})
-            search.observe(cand, None)
-            # the failed candidate's executables must not stay
-            # resident either — they'd cascade the OOM into the next
-            # dry-run
-            build_cache.pop(cand.to_json(), None)
-            continue
-        log.append(
-            {
-                "strategy": cand.name(),
-                "samples_per_sec": tput,
-                "compile_s": compile_s,
-            }
-        )
-        logger.info(
-            "dry-run %s: %.1f samples/s (compile %.1fs)",
-            cand.name(),
-            tput,
-            compile_s,
-        )
-        search.observe(cand, tput)
-        # Evict losers' executables: keeping every dry-run program
-        # resident shrinks free HBM for later candidates and can
-        # fake an OOM on a strategy that fits in production.
-        keep = search.best_strategy()
-        keep_key = keep.to_json() if keep is not None else None
-        for key in list(build_cache):
-            if key != keep_key:
-                del build_cache[key]
+            logger.info(
+                "dry-run %s: %.1f samples/s (compile %.1fs)",
+                cand.name(),
+                tput,
+                compile_s,
+            )
+            search.observe(cand, tput)
+            if cache is not None:
+                cache.record(
+                    cache_key,
+                    cand.to_json(),
+                    tput,
+                    extra={"compile_s": round(compile_s, 3)},
+                )
+            # Evict losers' executables: keeping every dry-run program
+            # resident shrinks free HBM for later candidates and can
+            # fake an OOM on a strategy that fits in production.
+            keep = search.best_strategy()
+            keep_key = keep.to_json() if keep is not None else None
+            for key in list(build_cache):
+                if key != keep_key:
+                    del build_cache[key]
+        return fresh
+
+    fresh_runs = run_dry_loop(search)
     chosen = search.best_strategy()
+    if chosen is None and replayed and fresh_runs == 0:
+        # Every observation was a replayed cached FAILURE — the budget
+        # was consumed without a single fresh dry-run. Those failures
+        # may be stale (a transient OOM from another process holding
+        # HBM, a flaky compile), and without this retry the cache
+        # would pin the job to instant permanent failure: no success
+        # can ever land to clear them. Re-search from scratch with
+        # fresh dry-runs; their results (either way) re-write the
+        # cache.
+        logger.warning(
+            "warm-started search yielded no viable strategy (all %d "
+            "replayed trials were cached failures); retrying with "
+            "fresh dry-runs in case the failures are stale",
+            replayed,
+        )
+        search = BayesStrategySearch(viable, cost_prior=cost_prior)
+        run_dry_loop(search)
+        chosen = search.best_strategy()
     if chosen is None:
         raise RuntimeError(f"all dry-runs failed: {log}")
 

@@ -51,6 +51,16 @@ def encode_strategy(s: Strategy) -> np.ndarray:
         1.0 if s.optimizer == o else 0.0 for o in _OPTIMIZERS
     )
     feats.extend(1.0 if s.dtype == t else 0.0 for t in _DTYPES)
+    # Overlapped-reduction knobs: the flag plus log2 bucket size, so
+    # the GP can tune bucket granularity smoothly once overlap is on
+    # (bucket size is meaningless when it is off — zeroed so off
+    # candidates collapse to one coordinate there).
+    feats.append(1.0 if s.overlap_reduce else 0.0)
+    feats.append(
+        math.log2(max(s.reduce_bucket_mb, 0.25))
+        if s.overlap_reduce
+        else 0.0
+    )
     return np.asarray(feats, np.float64)
 
 
@@ -129,6 +139,16 @@ class BayesStrategySearch:
         if not candidates:
             raise ValueError("empty candidate set")
         self.candidates = list(candidates)
+        # Canonical index per candidate: identical strategies (callers
+        # can hand in duplicated grids, and cached trials re-observe
+        # points) must collapse to ONE GP observation — a duplicated
+        # point silently double-weights its neighborhood — and suggest
+        # must never re-propose an evaluated point via its twin.
+        first_idx: Dict[Strategy, int] = {}
+        self._canon: List[int] = []
+        for i, c in enumerate(self.candidates):
+            self._canon.append(first_idx.setdefault(c, i))
+        self._n_distinct = len(first_idx)
         self._X = np.stack(
             [encode_strategy(c) for c in self.candidates]
         )
@@ -157,22 +177,23 @@ class BayesStrategySearch:
     def should_continue(self, budget: int) -> bool:
         return (
             self.evaluated_count() < budget
-            and self.evaluated_count() < len(self.candidates)
+            and self.evaluated_count() < self._n_distinct
         )
 
     def suggest(self) -> Strategy:
         """Next candidate: cost-model seeds first, then max expected
-        improvement under the GP."""
+        improvement under the GP. Never re-proposes an evaluated point
+        (or a duplicate of one) while untried candidates remain."""
         remaining = [
             i
             for i in range(len(self.candidates))
-            if i not in self._observed
+            if self._canon[i] == i and i not in self._observed
         ]
         if not remaining:
             raise RuntimeError("all candidates evaluated")
         if self.evaluated_count() < self.n_init:
             for i in self._seed_order:
-                if i in self._observed:
+                if self._canon[i] in self._observed:
                     continue
                 return self.candidates[i]
         X_obs = self._X[list(self._observed)]
@@ -180,7 +201,7 @@ class BayesStrategySearch:
         if np.allclose(y_obs, y_obs[0]):
             # degenerate GP (all failures so far): fall back to prior
             for i in self._seed_order:
-                if i not in self._observed:
+                if self._canon[i] not in self._observed:
                     return self.candidates[i]
         self._gp.fit(X_obs, y_obs)
         mu, sigma = self._gp.predict(self._X[remaining])
@@ -199,12 +220,47 @@ class BayesStrategySearch:
         self, strategy: Strategy, throughput: Optional[float]
     ) -> None:
         """``throughput=None`` marks a failed dry-run (OOM etc.): the
-        point is kept as zero so the GP avoids its neighborhood."""
-        idx = self.candidates.index(strategy)
+        point is kept as zero so the GP avoids its neighborhood.
+
+        Deduped: re-observing an identical strategy (a replayed cached
+        trial, a duplicated candidate) updates the ONE point for it —
+        the GP never sees the same coordinates twice. A fresh success
+        clears a stale failure mark for the point (latest wins)."""
+        idx = self._canon[self.candidates.index(strategy)]
         if throughput is None:
             self._failed.add(idx)
             throughput = 0.0
+        else:
+            self._failed.discard(idx)
         self._observed[idx] = float(throughput)
+
+    def warm_start(
+        self,
+        observations,
+    ) -> int:
+        """Replay cached trials (``accelerate/tune_cache.py``) into the
+        search before any dry-run is spent: an iterable of
+        ``(strategy, throughput_or_None)`` pairs. Pairs whose strategy
+        is not in this search's candidate set are skipped (the cache
+        may hold points outside the currently-viable grid). Replayed
+        points count as evaluated — ``should_continue`` budgets and
+        ``suggest`` both see them — so a warm cache directly converts
+        into fewer dry-runs. Returns the number replayed."""
+        known = set(self.candidates)
+        n = 0
+        for strategy, throughput in observations:
+            if strategy not in known:
+                continue
+            self.observe(strategy, throughput)
+            n += 1
+        if n:
+            logger.info(
+                "warm start: replayed %d cached trial(s); "
+                "%d distinct candidates remain unevaluated",
+                n,
+                self._n_distinct - self.evaluated_count(),
+            )
+        return n
 
     def best_strategy(self) -> Optional[Strategy]:
         ok = {

@@ -1,0 +1,208 @@
+"""Persistent autotune/trial cache: never pay for the same dry-run twice.
+
+A TPU dry-run is dominated by XLA compile time (tens of seconds —
+the same argument DLRover's atorch BO engine makes for seeding HEBO,
+``bayes_opt_sg.py``, only stronger here), so a tuning observation is
+worth persisting across processes and sessions. This module is the
+append-only JSONL store those observations live in:
+
+* **Key**: a stable fingerprint of the *trial context* — model shape
+  dims, mesh/device extent, kernel/op id, dtype, backend, jax/jaxlib
+  versions — via :func:`dlrover_tpu.common.runmeta.trial_fingerprint`.
+  Two processes tuning the same problem compute the same key; any
+  drift in what is being tuned changes it.
+* **Trial**: one JSON line ``{"key", "config", "throughput", "failed",
+  "ts", "extra"}``. ``config`` is the candidate identity (a
+  ``Strategy.to_json()`` string for the search engine, a
+  ``{"pins": {...}}`` dict for bench knobs). Failed trials (OOM, bad
+  shapes) are kept with ``failed=true`` so a warm-started GP steers
+  away from their neighborhood instead of re-exploding on it.
+
+Consumers: ``accelerate/api.py`` warm-starts ``BayesStrategySearch``
+and records every real dry-run back; ``bench.py`` applies the best
+cached pins (superseding the write-once ``bench_tuned.json`` flow)
+and records each measurement; ``tools/capture_perf.py`` consults it
+before spending an autotune sweep.
+
+Deliberately jax-import-free (the bench parent and capture tooling
+load it from jax-free processes) and crash-tolerant: writes are single
+``O_APPEND`` lines, reads skip corrupt lines, and every mutator is
+best-effort — a broken cache must degrade to "no cache", never take
+the run down with it.
+
+Escape hatches: ``DLROVER_TPU_TUNE_CACHE=0`` (or ``off``) disables the
+cache process-wide; any other value is the store path (default
+``TUNE_CACHE.jsonl`` at the repo root). ``tools/capture_perf.py
+--no-cache`` sets it for the whole capture chain. Hits and misses are
+observable as ``dlrover_tune_cache_hits_total`` /
+``dlrover_tune_cache_misses_total``.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import sys
+import time
+from typing import Dict, List, Optional, Union
+
+from dlrover_tpu.common.runmeta import trial_fingerprint  # noqa: F401
+from dlrover_tpu.obs.metrics import counter
+
+ENV_PATH = "DLROVER_TPU_TUNE_CACHE"
+DEFAULT_FILENAME = "TUNE_CACHE.jsonl"
+
+_HITS = counter(
+    "dlrover_tune_cache_hits_total",
+    "Tune-cache lookups that found at least one usable trial",
+)
+_MISSES = counter(
+    "dlrover_tune_cache_misses_total",
+    "Tune-cache lookups that found nothing for the key",
+)
+
+
+def count_lookup(hit: bool) -> None:
+    """Tick the hit/miss counters. Consumers whose notion of "usable"
+    is stricter than "a record exists for the key" (e.g. the strategy
+    search, which matches cached configs against the current candidate
+    grid) call this themselves with the refined verdict — a schema
+    drift that leaves every record unmatchable must read as misses,
+    not a 100% hit rate that avoids nothing."""
+    (_HITS if hit else _MISSES).inc()
+
+
+def _repo_root() -> str:
+    return os.path.dirname(
+        os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    )
+
+
+def default_path() -> str:
+    return os.path.join(_repo_root(), DEFAULT_FILENAME)
+
+
+def cache_disabled(env: Optional[dict] = None) -> bool:
+    v = (env if env is not None else os.environ).get(ENV_PATH, "")
+    return v.strip().lower() in ("0", "off", "none", "disabled")
+
+
+def resolve(
+    cache: Union[None, bool, str, "TuneCache"] = None,
+) -> Optional["TuneCache"]:
+    """Normalize the ``tune_cache=`` argument convention shared by
+    consumers: ``False`` -> disabled, a path -> that store, a
+    ``TuneCache`` -> itself, ``None``/``True`` -> the env-configured
+    default (``DLROVER_TPU_TUNE_CACHE``; ``0``/``off`` disables)."""
+    if cache is False:
+        return None
+    if isinstance(cache, TuneCache):
+        return cache
+    if isinstance(cache, str) and cache:
+        return TuneCache(cache)
+    if cache_disabled():
+        return None
+    return TuneCache(os.getenv(ENV_PATH, "") or default_path())
+
+
+class TuneCache:
+    """Append-only JSONL trial store for one path on disk."""
+
+    def __init__(self, path: str):
+        self.path = path
+
+    # -- write ----------------------------------------------------------
+
+    def record(
+        self,
+        key: str,
+        config,
+        throughput: Optional[float] = None,
+        failed: bool = False,
+        extra: Optional[Dict] = None,
+    ) -> Optional[dict]:
+        """Append one trial. ``throughput=None`` with ``failed=True``
+        is a failed dry-run; ``config`` must be JSON-serializable.
+        Returns the stored record, or None when the write failed (a
+        read-only tree must not fail the measurement that produced
+        the number)."""
+        rec = {
+            "key": key,
+            "config": config,
+            "throughput": (
+                None if throughput is None else float(throughput)
+            ),
+            "failed": bool(failed or throughput is None),
+            "ts": time.strftime("%Y-%m-%dT%H:%M:%SZ", time.gmtime()),
+        }
+        if extra:
+            rec["extra"] = extra
+        try:
+            line = json.dumps(rec, sort_keys=True)
+            # Single O_APPEND write: concurrent writers interleave
+            # records but never tear one (same contract as the ledger).
+            fd = os.open(
+                self.path,
+                os.O_WRONLY | os.O_CREAT | os.O_APPEND,
+                0o644,
+            )
+            try:
+                os.write(fd, (line + "\n").encode())
+            finally:
+                os.close(fd)
+            return rec
+        except (OSError, TypeError, ValueError) as exc:
+            print(
+                f"[tune_cache] record failed ({exc!r}); continuing "
+                "uncached",
+                file=sys.stderr,
+            )
+            return None
+
+    # -- read -----------------------------------------------------------
+
+    def trials(self, key: Optional[str] = None) -> List[dict]:
+        """Parseable trials (for ``key`` when given), in append order.
+        Corrupt or alien lines are skipped — a torn write must not
+        make the whole history unreadable."""
+        out: List[dict] = []
+        try:
+            with open(self.path) as f:
+                for i, line in enumerate(f, 1):
+                    line = line.strip()
+                    if not line:
+                        continue
+                    try:
+                        rec = json.loads(line)
+                    except ValueError:
+                        print(
+                            f"[tune_cache] skipping corrupt line {i}",
+                            file=sys.stderr,
+                        )
+                        continue
+                    if not isinstance(rec, dict) or "key" not in rec:
+                        continue
+                    if key is None or rec.get("key") == key:
+                        out.append(rec)
+        except OSError:
+            pass
+        return out
+
+    def lookup(self, key: str) -> List[dict]:
+        """``trials(key)`` plus hit/miss accounting — the observable
+        entry point consumers use before spending a dry-run."""
+        found = self.trials(key)
+        count_lookup(bool(found))
+        return found
+
+    def best(self, key: str) -> Optional[dict]:
+        """Highest-throughput non-failed trial for ``key`` (newest
+        wins ties, so a re-measurement of the same config supersedes
+        the stale number)."""
+        best: Optional[dict] = None
+        for rec in self.trials(key):
+            if rec.get("failed") or rec.get("throughput") is None:
+                continue
+            if best is None or rec["throughput"] >= best["throughput"]:
+                best = rec
+        return best

@@ -78,6 +78,22 @@ def run_metadata(
     return meta
 
 
+def trial_fingerprint(parts: Dict) -> str:
+    """Stable short hash identifying an autotune *trial context*: the
+    things that, when any of them changes, invalidate a cached tuning
+    result — model shape dims, mesh/device extent, kernel/op id,
+    dtype, backend, and toolchain versions. Callers pass them as a
+    flat JSON-serializable dict; key order never matters. This is the
+    key of ``accelerate/tune_cache.py``'s trial store, kept here so
+    jax-free tooling (the bench parent, ``tools/capture_perf.py``)
+    can compute/compare keys without touching the accelerate package.
+    """
+    digest = hashlib.sha256(
+        json.dumps(parts, sort_keys=True, default=str).encode()
+    ).hexdigest()
+    return digest[:16]
+
+
 # BENCH_* variables that are bookkeeping, not measurement knobs: they
 # must not perturb the config fingerprint (a capture_perf-driven run
 # and an identically-knobbed manual run measured the same config).

@@ -50,10 +50,15 @@ def _use_interpret() -> bool:
 
 
 def _compiler_params(semantics):
+    # Newer pallas spells it CompilerParams; 0.4.x-era jaxlib (this
+    # container) still calls it TPUCompilerParams.
+    cls = getattr(pltpu, "CompilerParams", None) or getattr(
+        pltpu, "TPUCompilerParams"
+    )
     try:
-        return pltpu.CompilerParams(dimension_semantics=semantics)
+        return cls(dimension_semantics=semantics)
     except TypeError:  # older/newer API without dimension_semantics
-        return pltpu.CompilerParams()
+        return cls()
 
 
 def _block_mask(iq, jk, block_q, block_k, causal, seq_len, pad,

@@ -1,0 +1,38 @@
+"""shard_map across jax versions.
+
+Newer jax exports :func:`jax.shard_map` taking ``check_vma=``; on
+older toolchains (this container's 0.4.x jaxlib) the same transform
+lives at ``jax.experimental.shard_map.shard_map`` and the kwarg is
+spelled ``check_rep=``. Every shard_map user in this repo imports
+from here so the whole parallel/ stack (and the suites that exercise
+it) works on both — an ImportError at module scope was taking entire
+test modules down with it on the older toolchain.
+"""
+
+from __future__ import annotations
+
+try:  # jax >= 0.6: public API, check_vma kwarg
+    from jax import shard_map as _shard_map
+
+    _CHECK_KWARG = "check_vma"
+except ImportError:  # older jax: experimental API, check_rep kwarg
+    from jax.experimental.shard_map import shard_map as _shard_map
+
+    _CHECK_KWARG = "check_rep"
+
+
+def shard_map(
+    f=None, *, mesh, in_specs, out_specs, check_vma: bool = True
+):
+    """:func:`jax.shard_map` with the repo's calling convention
+    (keyword mesh/specs, ``check_vma=``), translated to whatever this
+    jax spells it."""
+    kwargs = {
+        "mesh": mesh,
+        "in_specs": in_specs,
+        "out_specs": out_specs,
+        _CHECK_KWARG: check_vma,
+    }
+    if f is None:
+        return lambda g: _shard_map(g, **kwargs)
+    return _shard_map(f, **kwargs)

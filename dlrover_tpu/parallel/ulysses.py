@@ -29,7 +29,7 @@ from typing import Optional
 
 import jax
 import jax.numpy as jnp
-from jax import shard_map
+from dlrover_tpu.parallel.shard_map_compat import shard_map
 from jax.sharding import Mesh, PartitionSpec as P
 
 

@@ -19,6 +19,12 @@ if "xla_force_host_platform_device_count" not in _flags:
         _flags + " --xla_force_host_platform_device_count=8"
     ).strip()
 
+# The persistent autotune cache defaults to TUNE_CACHE.jsonl at the
+# repo root; tests must never write there. Suites that exercise the
+# cache pass an explicit tmp path (which bypasses this) or monkeypatch
+# the env themselves.
+os.environ.setdefault("DLROVER_TPU_TUNE_CACHE", "0")
+
 import jax  # noqa: E402
 
 jax.config.update("jax_platforms", "cpu")

@@ -355,6 +355,194 @@ def test_llama2_7b_plan_for_v5p32_in_ci():
     assert all(shards(e) > 1 for e in entries)
 
 
+class TestTuneCacheWarmStart:
+    """Acceptance gate: a warm persistent cache reaches the same best
+    strategy with STRICTLY fewer dry-run evaluations, observably."""
+
+    CANDS = [
+        Strategy(mesh_shape=(("data", 4),), micro_batch_size=4,
+                 dtype="float32"),
+        Strategy(mesh_shape=(("data", 2), ("fsdp", 2)),
+                 micro_batch_size=4, dtype="float32"),
+    ]
+
+    def _run(self, tune_cache):
+        init, loss, axes = _model()
+        return auto_accelerate(
+            init, loss, axes, _sample_batch(),
+            devices=jax.devices()[:4],
+            candidates=list(self.CANDS),
+            hbm_bytes=1 << 30,
+            activation_bytes_per_sample=1 << 10,
+            tune_cache=tune_cache,
+        )
+
+    @staticmethod
+    def _dry_runs(res):
+        return [
+            e for e in res.search_log
+            if "samples_per_sec" in e and not e.get("cached")
+        ]
+
+    def test_warm_cache_skips_dry_runs(self, tmp_path):
+        from dlrover_tpu.obs.metrics import get_registry
+
+        cache_path = str(tmp_path / "tune.jsonl")
+        r1 = self._run(cache_path)
+        assert len(self._dry_runs(r1)) == 2
+        # every dry-run was recorded as a trial
+        import json as _json
+
+        with open(cache_path) as f:
+            trials = [_json.loads(line) for line in f]
+        assert len(trials) == 2
+        assert all(not t["failed"] for t in trials)
+
+        hits = get_registry().get("dlrover_tune_cache_hits_total")
+        h0 = hits.value()
+        r2 = self._run(cache_path)
+        assert len(self._dry_runs(r2)) == 0  # strictly fewer: zero
+        cached = [e for e in r2.search_log if e.get("cached")]
+        assert len(cached) == 2
+        assert r2.strategy == r1.strategy
+        assert hits.value() == h0 + 1
+
+    def test_cache_false_disables_read_and_write(self, tmp_path):
+        import os
+
+        r = self._run(False)
+        assert len(self._dry_runs(r)) == 2
+        # nothing written anywhere under the default resolution either
+        assert not os.path.exists(str(tmp_path / "tune.jsonl"))
+
+    def test_cached_failure_replayed_as_avoided_point(self, tmp_path):
+        from dlrover_tpu.accelerate import tune_cache as tc
+        from dlrover_tpu.accelerate.api import _tune_cache_key
+        from dlrover_tpu.accelerate.analyser import analyse_model
+
+        init, loss, axes = _model()
+        key = _tune_cache_key(
+            analyse_model(init), _sample_batch(), 4
+        )
+        cache = tc.TuneCache(str(tmp_path / "tune.jsonl"))
+        # pre-poison candidate 1 as a cached OOM
+        cache.record(key, self.CANDS[1].to_json(), None, failed=True)
+        r = self._run(cache)
+        # only the non-poisoned candidate was dry-run; the cached
+        # failure kept its twin out of the winner's seat
+        assert len(self._dry_runs(r)) == 1
+        assert r.strategy == self.CANDS[0]
+        errs = [e for e in r.search_log if e.get("cached")]
+        assert errs and errs[0]["error"] == "cached failed trial"
+
+    def test_fully_poisoned_cache_retries_fresh(self, tmp_path):
+        """A cache holding only failures for EVERY candidate must not
+        pin the job to instant permanent failure: the failures may be
+        a stale transient (another process holding HBM, a flaky
+        compile), and without fresh dry-runs no success could ever
+        land to clear them."""
+        from dlrover_tpu.accelerate import tune_cache as tc
+        from dlrover_tpu.accelerate.api import _tune_cache_key
+        from dlrover_tpu.accelerate.analyser import analyse_model
+
+        init, loss, axes = _model()
+        key = _tune_cache_key(
+            analyse_model(init), _sample_batch(), 4
+        )
+        cache = tc.TuneCache(str(tmp_path / "tune.jsonl"))
+        for s in self.CANDS:
+            cache.record(key, s.to_json(), None, failed=True)
+        r = self._run(cache)
+        assert len(self._dry_runs(r)) == 2  # fresh runs happened
+        assert r.strategy in self.CANDS
+
+    def test_unmatchable_records_count_as_miss(self, tmp_path):
+        """Records for the key whose configs match no current
+        candidate (a Strategy schema drift) replay nothing — that
+        must read as a MISS, not a 100% hit rate avoiding no work."""
+        from dlrover_tpu.accelerate import tune_cache as tc
+        from dlrover_tpu.accelerate.api import _tune_cache_key
+        from dlrover_tpu.accelerate.analyser import analyse_model
+        from dlrover_tpu.obs.metrics import get_registry
+
+        init, loss, axes = _model()
+        key = _tune_cache_key(
+            analyse_model(init), _sample_batch(), 4
+        )
+        cache = tc.TuneCache(str(tmp_path / "tune.jsonl"))
+        cache.record(key, '{"schema": "from-the-future"}', 99.0)
+        reg = get_registry()
+        h0 = reg.get("dlrover_tune_cache_hits_total").value()
+        m0 = reg.get("dlrover_tune_cache_misses_total").value()
+        r = self._run(cache)
+        assert len(self._dry_runs(r)) == 2  # nothing avoided
+        assert reg.get("dlrover_tune_cache_hits_total").value() == h0
+        assert (
+            reg.get("dlrover_tune_cache_misses_total").value() == m0 + 1
+        )
+
+
+class TestOverlapStrategy:
+    def test_grid_overlap_only_on_pure_data_factorizations(self):
+        cands = candidate_strategies(
+            8,
+            micro_batch_sizes=(4,),
+            remats=(False,),
+            overlap_reduces=(False, True),
+            reduce_bucket_mbs=(2.0, 8.0),
+        )
+        with_ov = [c for c in cands if c.overlap_reduce]
+        assert with_ov, "no overlap candidates generated"
+        assert all(c.pure_data_parallel for c in with_ov)
+        # bucket size only multiplies overlapped candidates
+        assert {c.reduce_bucket_mb for c in with_ov} == {2.0, 8.0}
+        assert all(
+            c.reduce_bucket_mb == 4.0
+            for c in cands
+            if not c.overlap_reduce
+        )
+        assert len({c.name() for c in cands}) == len(cands)
+        s = with_ov[0]
+        assert Strategy.from_json(s.to_json()) == s
+
+    def test_explicit_overlap_strategy_trains(self):
+        init, loss, axes = _model()
+        s = Strategy(
+            mesh_shape=(("data", 4),),
+            dtype="float32",
+            micro_batch_size=4,
+            overlap_reduce=True,
+            reduce_bucket_mb=0.5,
+        )
+        res = auto_accelerate(
+            init, loss, axes, _sample_batch(), strategy=s,
+            devices=jax.devices()[:4],
+        )
+        params, opt_state = res.init_fn(jax.random.PRNGKey(0))
+        tokens, targets = res.shard_batch_fn(*_sample_batch(4))
+        losses = []
+        for _ in range(5):
+            params, opt_state, metrics = res.step_fn(
+                params, opt_state, tokens, targets
+            )
+            losses.append(float(metrics["loss"]))
+        assert losses[-1] < losses[0]
+
+    def test_overlap_on_sharded_mesh_rejected(self):
+        init, loss, axes = _model()
+        s = Strategy(
+            mesh_shape=(("data", 2), ("fsdp", 2)),
+            dtype="float32",
+            micro_batch_size=4,
+            overlap_reduce=True,
+        )
+        with pytest.raises(ValueError, match="overlap_reduce"):
+            auto_accelerate(
+                init, loss, axes, _sample_batch(), strategy=s,
+                devices=jax.devices()[:4],
+            )
+
+
 def test_search_raises_when_nothing_fits():
     init, loss, axes = _model()
     with pytest.raises(RuntimeError, match="no strategy fits"):

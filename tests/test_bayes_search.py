@@ -119,3 +119,115 @@ class TestBayesSearch:
         mu, sigma = gp.predict(X)
         np.testing.assert_allclose(mu, y, atol=0.3)
         assert (sigma < 0.3).all()
+
+    def test_encoding_covers_overlap_knobs(self):
+        base = Strategy(mesh_shape=(("data", 8),))
+        ov = Strategy(mesh_shape=(("data", 8),), overlap_reduce=True)
+        ov_big = Strategy(
+            mesh_shape=(("data", 8),),
+            overlap_reduce=True,
+            reduce_bucket_mb=16.0,
+        )
+        assert not np.array_equal(
+            encode_strategy(base), encode_strategy(ov)
+        )
+        assert not np.array_equal(
+            encode_strategy(ov), encode_strategy(ov_big)
+        )
+
+
+class TestObserveDedupe:
+    """Re-observed cached trials and duplicated candidate grids must
+    not double-weight the GP, and suggest must never re-propose an
+    already-evaluated point while untried candidates remain."""
+
+    def _dup_space(self):
+        cands = _space()[:6]
+        # the same strategies again, at different indices
+        return cands + list(cands[:3])
+
+    def test_duplicate_candidates_collapse_to_one_observation(self):
+        cands = self._dup_space()
+        search = BayesStrategySearch(cands, seed=0)
+        search.observe(cands[0], 5.0)
+        search.observe(cands[6], 7.0)  # identical to cands[0]
+        assert search.evaluated_count() == 1
+        assert len(search._observed) == 1
+        assert search.best_throughput() == 7.0  # latest wins
+
+    def test_suggest_skips_duplicates_of_evaluated(self):
+        cands = self._dup_space()
+        search = BayesStrategySearch(cands, seed=1)
+        seen = []
+        while search.should_continue(len(cands)):
+            c = search.suggest()
+            assert c not in seen, "re-proposed an evaluated point"
+            seen.append(c)
+            search.observe(c, float(len(seen)))
+        # every DISTINCT candidate evaluated exactly once
+        assert len(seen) == 6
+
+    def test_reobserve_success_clears_stale_failure(self):
+        cands = _space()[:4]
+        search = BayesStrategySearch(cands, seed=2)
+        search.observe(cands[0], None)
+        assert search.best_strategy() is None
+        search.observe(cands[0], 3.0)  # a later real measurement
+        assert search.best_strategy() == cands[0]
+
+
+class TestWarmStart:
+    def test_replays_only_known_candidates(self):
+        cands = _space()[:8]
+        outside = _space()[10]
+        search = BayesStrategySearch(cands, seed=0)
+        n = search.warm_start(
+            [
+                (cands[1], 5.0),
+                (cands[2], None),  # cached OOM -> avoided point
+                (outside, 99.0),  # not in this grid: skipped
+            ]
+        )
+        assert n == 2
+        assert search.evaluated_count() == 2
+        assert search.best_strategy() == cands[1]
+        # the cached failure is a zero point, not a winner
+        assert search.best_throughput() == 5.0
+
+    def test_warm_cache_reaches_same_best_with_fewer_evals(self):
+        """The counting-evaluator contract: a search warm-started from
+        a previous run's observations reaches the same best strategy
+        with STRICTLY fewer fresh evaluations."""
+        cands = _space()
+        budget = len(cands) // 3
+
+        def run(warm_obs):
+            search = BayesStrategySearch(cands, seed=3)
+            search.warm_start(warm_obs)
+            evals = 0
+            while search.should_continue(budget):
+                c = search.suggest()
+                search.observe(c, _true_throughput(c))
+                evals += 1
+            return search, evals
+
+        cold, cold_evals = run([])
+        warm_obs = [
+            (cands[i], t) for i, t in cold._observed.items()
+        ]
+        warm, warm_evals = run(warm_obs)
+        assert warm_evals < cold_evals
+        assert warm_evals == 0  # fully warm: zero fresh dry-runs
+        assert warm.best_strategy() == cold.best_strategy()
+
+    def test_partial_warm_start_still_counts_against_budget(self):
+        cands = _space()[:10]
+        search = BayesStrategySearch(cands, seed=4)
+        search.warm_start([(cands[0], 1.0), (cands[1], 2.0)])
+        evals = 0
+        while search.should_continue(5):
+            c = search.suggest()
+            assert c not in (cands[0], cands[1])
+            search.observe(c, 0.5)
+            evals += 1
+        assert evals == 3  # budget 5 minus 2 cached

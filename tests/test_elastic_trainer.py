@@ -368,6 +368,237 @@ def test_hot_loop_no_host_sync_under_transfer_guard():
     assert [r.step for r in reports] == [1, 2, 3, 4]
 
 
+# -- overlapped gradient reduction -----------------------------------------
+
+
+def test_overlap_reduce_matches_serial_step():
+    """The overlapped (bucketed per-microbatch reduce inside the
+    scan) step must produce the same update as the serial
+    accumulate-then-reduce step — the numerics-parity acceptance gate
+    on the 8-device CPU mesh."""
+    x, y = _toy_data(64)
+    params = {"w": jnp.zeros((8, 1)), "b": jnp.zeros((1,))}
+    opt = optax.adam(0.05)
+    mesh = _mesh(8)
+    kw = dict(
+        global_batch_size=64, micro_batch_size=4, donate_state=False
+    )
+    tr_serial = ElasticTrainer(mesh, _linear_loss, opt, **kw)
+    tr_overlap = ElasticTrainer(
+        mesh, _linear_loss, opt, overlap_reduce=True,
+        reduce_bucket_mb=0.0001, **kw
+    )
+    assert tr_serial.accum_steps == tr_overlap.accum_steps == 2
+    p_s, _, l_s = tr_serial.train_step(params, opt.init(params), x, y)
+    p_o, _, l_o = tr_overlap.train_step(params, opt.init(params), x, y)
+    np.testing.assert_allclose(
+        float(l_s), float(l_o), rtol=1e-5
+    )
+    for a, b in zip(jax.tree.leaves(p_s), jax.tree.leaves(p_o)):
+        np.testing.assert_allclose(
+            np.asarray(a), np.asarray(b), rtol=1e-5, atol=1e-6
+        )
+
+
+def test_overlap_reduce_with_int8_buckets_converges():
+    x, y = _toy_data(64)
+    params = {"w": jnp.zeros((8, 1)), "b": jnp.zeros((1,))}
+    opt = optax.adam(0.05)
+    tr = ElasticTrainer(
+        _mesh(4), _linear_loss, opt, global_batch_size=64,
+        micro_batch_size=8, overlap_reduce=True, reduce_bits=8,
+        reduce_bucket_mb=1.0,
+    )
+    opt_state = opt.init(params)
+    losses = []
+    for _ in range(30):
+        params, opt_state, loss = tr.train_step(
+            params, opt_state, x, y
+        )
+        losses.append(float(loss))
+    assert losses[-1] < losses[0] * 0.1
+
+
+def test_overlap_reduce_rejects_non_pure_data_mesh():
+    from dlrover_tpu.parallel.mesh import MeshConfig, build_mesh
+
+    mesh = build_mesh(
+        MeshConfig(data=2, fsdp=2), devices=jax.devices()[:4]
+    )
+    with pytest.raises(ValueError, match="pure data-parallel"):
+        ElasticTrainer(
+            mesh, _linear_loss, optax.sgd(0.1),
+            global_batch_size=32, micro_batch_size=4,
+            overlap_reduce=True,
+        )
+
+
+def test_overlap_reduce_rejects_external_step_fn():
+    def step_fn(p, s, tok, tgt):
+        return p, s, {"loss": jnp.float32(0)}
+
+    with pytest.raises(ValueError, match="step_fn"):
+        ElasticTrainer(
+            _mesh(4), None, optax.sgd(0.1), global_batch_size=16,
+            micro_batch_size=4, step_fn=step_fn, overlap_reduce=True,
+        )
+
+
+def test_overlap_env_knobs_resolve(monkeypatch):
+    monkeypatch.setenv("DLROVER_TPU_OVERLAP_REDUCE", "1")
+    monkeypatch.setenv("DLROVER_TPU_REDUCE_BUCKET_MB", "2")
+    monkeypatch.setenv("DLROVER_TPU_REDUCE_BITS", "8")
+    tr = ElasticTrainer(
+        _mesh(2), _linear_loss, optax.sgd(0.1),
+        global_batch_size=16, micro_batch_size=4,
+    )
+    assert tr.overlap_reduce and tr.reduce_bucket_mb == 2.0
+    assert tr.reduce_bits == 8
+    # explicit ctor args beat the env
+    tr2 = ElasticTrainer(
+        _mesh(2), _linear_loss, optax.sgd(0.1),
+        global_batch_size=16, micro_batch_size=4,
+        overlap_reduce=False,
+    )
+    assert not tr2.overlap_reduce
+
+
+def test_overlap_env_default_downgrades_where_inapplicable(monkeypatch):
+    """A fleet-wide DLROVER_TPU_OVERLAP_REDUCE=1 opt-in must not kill
+    jobs the schedule can't apply to — only an EXPLICIT ctor
+    overlap_reduce=True raises there (the two reject tests above)."""
+    from dlrover_tpu.parallel.mesh import MeshConfig, build_mesh
+
+    monkeypatch.setenv("DLROVER_TPU_OVERLAP_REDUCE", "1")
+    mesh = build_mesh(
+        MeshConfig(data=2, fsdp=2), devices=jax.devices()[:4]
+    )
+    tr = ElasticTrainer(
+        mesh, _linear_loss, optax.sgd(0.1),
+        global_batch_size=32, micro_batch_size=4,
+    )
+    assert not tr.overlap_reduce  # downgraded to the serial step
+
+    def step_fn(p, s, tok, tgt):
+        return p, s, {"loss": jnp.float32(0)}
+
+    tr2 = ElasticTrainer(
+        _mesh(4), None, optax.sgd(0.1), global_batch_size=16,
+        micro_batch_size=4, step_fn=step_fn,
+    )
+    assert not tr2.overlap_reduce
+
+
+def test_overlap_metrics_noted_at_compile():
+    from dlrover_tpu.obs.metrics import get_registry
+
+    x, y = _toy_data(32)
+    params = {"w": jnp.zeros((8, 1)), "b": jnp.zeros((1,))}
+    opt = optax.sgd(0.1)
+    tr = ElasticTrainer(
+        _mesh(2), _linear_loss, opt, global_batch_size=32,
+        micro_batch_size=4, overlap_reduce=True, reduce_bits=8,
+    )
+    tr.train_step(params, opt.init(params), x, y)
+    reg = get_registry()
+    assert reg.get("dlrover_train_reduce_buckets").value() >= 1
+    # accum=4 at int8: 4 * 3.0 B/el
+    assert (
+        reg.get("dlrover_train_sync_bytes_per_element").value()
+        == 12.0
+    )
+
+
+def test_overlap_hot_loop_no_host_sync_under_transfer_guard():
+    """The PR 2 zero-sync contract holds for the overlapped step too:
+    steady state performs no device<->host transfer (the satellite
+    guard that the new schedule introduced no hidden syncs)."""
+    from jax._src import array as jax_array
+
+    x, y = _toy_data(32)
+    params = {"w": jnp.zeros((8, 1)), "b": jnp.zeros((1,))}
+    opt = optax.adam(0.05)
+    reports = []
+    tr = ElasticTrainer(
+        _mesh(2), _linear_loss, opt, global_batch_size=32,
+        micro_batch_size=4, report_fn=reports.append,
+        overlap_reduce=True, reduce_bucket_mb=0.0001,
+    )
+    opt_state = opt.init(params)
+    batches = [tr.shard_microbatches(x, y) for _ in range(4)]
+    params, opt_state, _ = tr.train_step(params, opt_state, *batches[0])
+
+    def _boom(self):
+        raise AssertionError(
+            "implicit device->host sync (float(arr)) in the "
+            "overlapped hot loop"
+        )
+
+    orig = jax_array.ArrayImpl.__float__
+    jax_array.ArrayImpl.__float__ = _boom
+    try:
+        with jax.transfer_guard("disallow"):
+            for tok, tgt in batches[1:]:
+                params, opt_state, loss = tr.train_step(
+                    params, opt_state, tok, tgt
+                )
+                assert isinstance(loss, jax.Array)
+    finally:
+        jax_array.ArrayImpl.__float__ = orig
+    tr.flush_metrics()
+    assert [r.step for r in reports] == [1, 2, 3, 4]
+
+
+def test_overlapped_step_sources_free_of_host_syncs():
+    """AST tripwire (the CI satellite): the code that BUILDS the
+    jitted overlapped step must contain no host-sync calls — float(),
+    .item(), np.asarray, jax.device_get, block_until_ready. The
+    runtime transfer-guard test catches dynamic syncs; this catches
+    one added behind a rarely-hit branch."""
+    import ast
+    import inspect
+
+    from dlrover_tpu.parallel import compression
+    from dlrover_tpu.trainer.elastic_trainer import ElasticTrainer
+
+    # int() is allowed: static shape arithmetic (int(np.prod(shape)))
+    # never touches device buffers; float()/bool() on a traced value
+    # are the classic implicit-sync shapes.
+    FORBIDDEN_CALLS = {"float", "bool"}
+    FORBIDDEN_ATTRS = {
+        "item", "asarray", "device_get", "block_until_ready",
+        "tolist",
+    }
+
+    def audit(fn_source, where):
+        tree = ast.parse(fn_source)
+        for node in ast.walk(tree):
+            if not isinstance(node, ast.Call):
+                continue
+            f = node.func
+            if isinstance(f, ast.Name):
+                assert f.id not in FORBIDDEN_CALLS, (
+                    f"{where}:{node.lineno}: host sync {f.id}() in "
+                    "the jitted overlapped step path"
+                )
+            if isinstance(f, ast.Attribute):
+                assert f.attr not in FORBIDDEN_ATTRS, (
+                    f"{where}:{node.lineno}: host sync .{f.attr}() "
+                    "in the jitted overlapped step path"
+                )
+
+    import textwrap
+
+    for fn, where in (
+        (compression.bucketed_psum_mean, "bucketed_psum_mean"),
+        (compression.make_compressed_train_step,
+         "make_compressed_train_step"),
+        (ElasticTrainer._build_overlapped_step,
+         "_build_overlapped_step"),
+    ):
+        audit(textwrap.dedent(inspect.getsource(fn)), where)
+
+
 def test_dataloader_batches():
     data = np.arange(40, dtype=np.float32).reshape(20, 2)
     sampler = ElasticDistributedSampler(

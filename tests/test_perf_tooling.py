@@ -140,6 +140,187 @@ class TestPersistWinner:
         assert not (tmp_path / "bench_tuned.json").exists()
 
 
+class TestTuneCacheConsult:
+    """capture_perf consults the persistent trial cache before
+    spending the autotune sweep — and stays jax-free doing it."""
+
+    def test_cached_pins_best_trial_wins(self, tmp_path, monkeypatch):
+        monkeypatch.setenv(
+            "DLROVER_TPU_TUNE_CACHE", str(tmp_path / "tc.jsonl")
+        )
+        tc = capture_perf._load_tune_cache_mod()
+        cache = tc.resolve()
+        cache.record("k1", {"pins": {"BENCH_UNROLL": 4}}, 100.0)
+        cache.record("k1", {"pins": {"BENCH_UNROLL": 2}}, 120.0)
+        cache.record("k1", {"pins": {"BENCH_UNROLL": 8}}, None,
+                     failed=True)
+        assert capture_perf.cached_pins("k1") == {"BENCH_UNROLL": "2"}
+        assert capture_perf.cached_pins("other") is None
+        assert capture_perf.cached_pins(None) is None
+
+    def test_no_cache_hatch_and_empty_pins(self, tmp_path, monkeypatch):
+        monkeypatch.setenv("DLROVER_TPU_TUNE_CACHE", "0")
+        assert capture_perf.cached_pins("k1") is None
+        # a best trial with no pins (shipped defaults) is not a hit
+        monkeypatch.setenv(
+            "DLROVER_TPU_TUNE_CACHE", str(tmp_path / "tc2.jsonl")
+        )
+        tc = capture_perf._load_tune_cache_mod()
+        tc.resolve().record("k1", {"pins": {}}, 50.0)
+        assert capture_perf.cached_pins("k1") is None
+
+    def test_last_recorded_tune_key_newest_wins(
+        self, tmp_path, monkeypatch
+    ):
+        ledger = tmp_path / "ledger.jsonl"
+        ledger.write_text(
+            json.dumps({"metric": "m", "tune_key": "old"}) + "\n"
+            + "corrupt{\n"
+            + json.dumps({"metric": "m"}) + "\n"
+            + json.dumps({"metric": "m", "tune_key": "new"}) + "\n"
+        )
+        monkeypatch.setenv("DLROVER_TPU_BENCH_LEDGER", str(ledger))
+        assert capture_perf.last_recorded_tune_key() == "new"
+        monkeypatch.setenv(
+            "DLROVER_TPU_BENCH_LEDGER", str(tmp_path / "absent")
+        )
+        assert capture_perf.last_recorded_tune_key() is None
+
+    def test_last_recorded_tune_key_prefers_tpu_baseline(
+        self, tmp_path, monkeypatch
+    ):
+        """An ad-hoc CPU smoke bench appending the newest record must
+        not hand the TPU chain its key (the chain would skip the sweep
+        on pins tuned for another backend/model); CAPTURE_TUNE_KEY
+        pins the choice outright."""
+        ledger = tmp_path / "ledger.jsonl"
+        ledger.write_text(
+            json.dumps({"metric": "m", "tune_key": "tpu-base",
+                        "stage": "baseline", "backend": "axon"}) + "\n"
+            + json.dumps({"metric": "m", "tune_key": "cpu-base",
+                          "stage": "baseline", "backend": "cpu"}) + "\n"
+            + json.dumps({"metric": "m", "tune_key": "adhoc"}) + "\n"
+        )
+        monkeypatch.setenv("DLROVER_TPU_BENCH_LEDGER", str(ledger))
+        # newest overall is "adhoc", newest baseline is the cpu smoke
+        # — the TPU baseline wins both tiebreaks
+        assert capture_perf.last_recorded_tune_key() == "tpu-base"
+        monkeypatch.setenv("CAPTURE_TUNE_KEY", "pinned")
+        assert capture_perf.last_recorded_tune_key() == "pinned"
+
+    def test_parent_stays_jax_free(self, tmp_path):
+        """Loading + consulting the tune cache must not pull jax into
+        the capture parent (a wedged tunnel could then hang it).
+        Subprocess: this test process itself imports jax via
+        conftest."""
+        import subprocess
+
+        src = (
+            "import os, sys\n"
+            f"os.environ['DLROVER_TPU_TUNE_CACHE'] = {str(tmp_path / 'tc.jsonl')!r}\n"
+            f"sys.path.insert(0, {TOOLS!r})\n"
+            "import capture_perf\n"
+            "tc = capture_perf._load_tune_cache_mod()\n"
+            "tc.resolve().record('k', {'pins': {'A': 1}}, 1.0)\n"
+            "assert capture_perf.cached_pins('k') == {'A': '1'}\n"
+            "assert 'jax' not in sys.modules, 'jax leaked into parent'\n"
+        )
+        subprocess.run(
+            [sys.executable, "-c", src], check=True, timeout=60
+        )
+
+
+class TestLedgerPinDiff:
+    def test_compare_prints_pin_diff_on_config_mismatch(
+        self, tmp_path, monkeypatch
+    ):
+        bench_ledger = importlib.import_module("bench_ledger")
+        path = str(tmp_path / "ledger.jsonl")
+        base = {
+            "metric": "m", "value": 100.0, "unit": "u",
+            "config_hash": "aaa",
+            "pins": {"BENCH_UNROLL": "1", "BENCH_REMAT": "full"},
+        }
+        head = {
+            "metric": "m", "value": 100.0, "unit": "u",
+            "config_hash": "bbb",
+            "pins": {"BENCH_UNROLL": "4", "BENCH_REMAT": "full",
+                     "BENCH_OVERLAP_REDUCE": "1"},
+        }
+        bench_ledger.append_record(base, path=path)
+        bench_ledger.append_record(head, path=path)
+        rc, report = bench_ledger.compare("last", path=path)
+        assert rc == 0
+        assert "pin BENCH_UNROLL: head=4 baseline=1" in report
+        assert (
+            "pin BENCH_OVERLAP_REDUCE: head=1 baseline=<unset>"
+            in report
+        )
+        assert "BENCH_REMAT" not in report  # unchanged pins silent
+
+    def test_compare_same_config_no_pin_section(
+        self, tmp_path
+    ):
+        bench_ledger = importlib.import_module("bench_ledger")
+        path = str(tmp_path / "ledger.jsonl")
+        rec = {
+            "metric": "m", "value": 100.0, "unit": "u",
+            "config_hash": "aaa", "pins": {"BENCH_UNROLL": "1"},
+        }
+        bench_ledger.append_record(dict(rec), path=path)
+        bench_ledger.append_record(dict(rec), path=path)
+        rc, report = bench_ledger.compare("last", path=path)
+        assert rc == 0 and "pin " not in report
+
+
+class TestBenchPinsEmission:
+    def test_smoke_child_emits_pins_overlap_and_records_trial(
+        self, tmp_path
+    ):
+        """bench.py's measurement child (BENCH_SMOKE tiny model, CPU)
+        must emit the applied pins, the overlap config, and the
+        tune-cache key in its JSON record — the fields the ledger
+        carries so compare mismatches are debuggable — and record the
+        run as a cached trial."""
+        import subprocess
+
+        repo = os.path.dirname(TOOLS)
+        cache = tmp_path / "tc.jsonl"
+        env = {
+            **os.environ,
+            "JAX_PLATFORMS": "cpu",
+            "BENCH_SMOKE": "1",
+            "BENCH_STEPS": "2",
+            "BENCH_NO_LEDGER": "1",
+            "BENCH_OVERLAP_REDUCE": "1",
+            "BENCH_REDUCE_BUCKET_MB": "1",
+            "DLROVER_TPU_TUNE_CACHE": str(cache),
+        }
+        p = subprocess.run(
+            [sys.executable, os.path.join(repo, "bench.py"),
+             "--child"],
+            env=env, capture_output=True, text=True, timeout=300,
+            cwd=repo,
+        )
+        assert p.returncode == 0, p.stderr[-2000:]
+        rec = next(
+            json.loads(line)
+            for line in p.stdout.splitlines()
+            if line.startswith("{")
+        )
+        assert rec["pins"]["BENCH_OVERLAP_REDUCE"] == "1"
+        assert rec["overlap"] == {"bucket_mb": 1.0, "bits": None}
+        assert rec["tune_key"]
+        assert rec["value"] > 0
+        trials = [
+            json.loads(line) for line in cache.read_text().splitlines()
+        ]
+        assert len(trials) == 1
+        assert trials[0]["key"] == rec["tune_key"]
+        assert trials[0]["config"]["pins"] == rec["pins"]
+        assert not trials[0]["failed"]
+
+
 class TestAGDTraceSelection:
     def test_nan_trace_never_wins(self):
         """agd_convergence's best-trace guard: a diverged (NaN) final

@@ -1,0 +1,130 @@
+"""Persistent autotune/trial cache: round-trip, corruption tolerance,
+hit/miss accounting, and key stability (the tier-1 selftest the CI
+satellite of the tune-cache PR wires in — fast, jax-free)."""
+
+import json
+import os
+
+import pytest
+
+from dlrover_tpu.accelerate import tune_cache as tc
+from dlrover_tpu.common.runmeta import trial_fingerprint
+from dlrover_tpu.obs.metrics import get_registry
+
+
+@pytest.fixture()
+def cache(tmp_path):
+    return tc.TuneCache(str(tmp_path / "cache.jsonl"))
+
+
+class TestRoundTrip:
+    def test_record_and_trials(self, cache):
+        cache.record("k1", {"pins": {"A": 1}}, 100.0)
+        cache.record("k1", {"pins": {"A": 2}}, 120.0,
+                     extra={"compile_s": 3.2})
+        cache.record("k2", "other-key-config", 5.0)
+        t1 = cache.trials("k1")
+        assert [t["throughput"] for t in t1] == [100.0, 120.0]
+        assert t1[1]["extra"] == {"compile_s": 3.2}
+        assert [t["key"] for t in cache.trials()] == ["k1", "k1", "k2"]
+
+    def test_best_ignores_failed_and_newest_wins_ties(self, cache):
+        cache.record("k", {"pins": {}}, None, failed=True)
+        assert cache.best("k") is None  # only a failed trial
+        cache.record("k", {"pins": {"A": 1}}, 50.0)
+        cache.record("k", {"pins": {"A": 2}}, 50.0)  # tie, newer
+        cache.record("k", {"pins": {"A": 3}}, 10.0)
+        best = cache.best("k")
+        assert best["config"]["pins"] == {"A": 2}
+
+    def test_failed_marker_from_none_throughput(self, cache):
+        rec = cache.record("k", "cfg", None)
+        assert rec["failed"] is True and rec["throughput"] is None
+
+    def test_unwritable_path_degrades_without_raising(self, tmp_path):
+        bad = tc.TuneCache(str(tmp_path))  # a directory: open() fails
+        assert bad.record("k", "cfg", 1.0) is None
+
+    def test_unserializable_config_degrades(self, cache):
+        assert cache.record("k", object(), 1.0) is None
+        assert cache.trials("k") == []
+
+
+class TestCorruptionTolerance:
+    def test_corrupt_and_alien_lines_skipped(self, cache):
+        cache.record("k", "good1", 1.0)
+        with open(cache.path, "a") as f:
+            f.write('{"torn": \n')  # half-written line
+            f.write("[1, 2, 3]\n")  # not an object
+            f.write('{"no_key_field": true}\n')  # alien record
+            f.write("\n")
+        cache.record("k", "good2", 2.0)
+        assert [t["config"] for t in cache.trials("k")] == [
+            "good1", "good2",
+        ]
+
+    def test_missing_file_is_empty(self, tmp_path):
+        c = tc.TuneCache(str(tmp_path / "nope.jsonl"))
+        assert c.trials("k") == []
+        assert c.best("k") is None
+
+
+class TestResolveAndMetrics:
+    def test_resolve_semantics(self, tmp_path, monkeypatch):
+        p = str(tmp_path / "c.jsonl")
+        assert tc.resolve(False) is None
+        assert tc.resolve(p).path == p
+        c = tc.TuneCache(p)
+        assert tc.resolve(c) is c
+        monkeypatch.setenv(tc.ENV_PATH, p)
+        assert tc.resolve(None).path == p
+        for off in ("0", "off", "OFF", "none"):
+            monkeypatch.setenv(tc.ENV_PATH, off)
+            assert tc.resolve(None) is None
+        monkeypatch.delenv(tc.ENV_PATH)
+        assert tc.resolve(None).path == tc.default_path()
+
+    def test_lookup_counts_hits_and_misses(self, cache):
+        reg = get_registry()
+        hits = reg.get("dlrover_tune_cache_hits_total")
+        misses = reg.get("dlrover_tune_cache_misses_total")
+        h0, m0 = hits.value(), misses.value()
+        assert cache.lookup("k") == []
+        assert misses.value() == m0 + 1 and hits.value() == h0
+        cache.record("k", "cfg", 1.0)
+        assert len(cache.lookup("k")) == 1
+        assert hits.value() == h0 + 1 and misses.value() == m0 + 1
+
+
+class TestTrialFingerprint:
+    def test_order_insensitive_and_value_sensitive(self):
+        a = trial_fingerprint({"x": 1, "y": [2, 3], "z": "s"})
+        b = trial_fingerprint({"z": "s", "y": [2, 3], "x": 1})
+        assert a == b and len(a) == 16
+        assert a != trial_fingerprint({"x": 1, "y": [2, 4], "z": "s"})
+
+    def test_non_json_values_stringified_stably(self):
+        class Weird:
+            def __str__(self):
+                return "weird"
+
+        assert trial_fingerprint({"d": Weird()}) == trial_fingerprint(
+            {"d": "weird"}
+        )
+
+
+def test_records_are_single_lines_of_json(cache):
+    """The O_APPEND single-line contract concurrent writers rely on."""
+    cache.record("k", {"pins": {"A": "1"}}, 1.0)
+    cache.record("k2", "c", None, failed=True)
+    with open(cache.path) as f:
+        lines = f.read().splitlines()
+    assert len(lines) == 2
+    for line in lines:
+        json.loads(line)  # each line parses standalone
+
+
+def test_env_disable_is_honored_by_consumers(tmp_path, monkeypatch):
+    monkeypatch.setenv(tc.ENV_PATH, "0")
+    assert tc.cache_disabled()
+    assert tc.resolve() is None

@@ -244,6 +244,17 @@ def compare(
             "  note: config fingerprints differ (knobs/pins changed "
             "between the runs)"
         )
+        # Records carry the applied pins since the tune-cache PR, so
+        # a config mismatch is debuggable here instead of by
+        # re-running both sides.
+        hp = head_rec.get("pins") or {}
+        bp = base_rec.get("pins") or {}
+        for k in sorted(set(hp) | set(bp)):
+            if hp.get(k) != bp.get(k):
+                lines.append(
+                    f"    pin {k}: head={hp.get(k, '<unset>')} "
+                    f"baseline={bp.get(k, '<unset>')}"
+                )
     return (1 if regressed else 0), "\n".join(lines)
 
 

@@ -14,6 +14,15 @@ so a tunnel outage mid-chain never erases landed results; the tuned
 re-bench is retried a few times before giving up (the baseline record
 survives regardless).
 
+Cache-aware since the tune-cache PR: stage 1's bench record carries a
+``tune_key``, and before spending the (long, chip-hogging) autotune
+sweep stage 2 asks the persistent trial cache
+(``dlrover_tpu/accelerate/tune_cache.py``) for the best recorded pins
+under that key — a warm cache turns the whole sweep into a file read.
+``--no-cache`` (or ``CAPTURE_NO_CACHE=1``) disables the cache for the
+entire chain, children included, by exporting
+``DLROVER_TPU_TUNE_CACHE=0``.
+
 Run:  nohup python tools/capture_perf.py >/tmp/capture_perf.log 2>&1 &
 """
 
@@ -224,6 +233,98 @@ def persist_winner(pins: dict, tuned_rec: dict, spec: str) -> None:
     log(f"pinned winner to bench_tuned.json: {pins}")
 
 
+def _load_tune_cache_mod():
+    """Load accelerate/tune_cache.py WITHOUT importing the accelerate
+    package (whose ``__init__`` pulls jax; this parent must stay
+    jax-free so a wedged tunnel can never hang it). The module's own
+    imports only touch the jax-free common/ and obs/ packages."""
+    import importlib.util
+
+    import _repo_path  # noqa: F401 — repo root onto sys.path
+
+    path = os.path.join(
+        REPO, "dlrover_tpu", "accelerate", "tune_cache.py"
+    )
+    spec = importlib.util.spec_from_file_location(
+        "_capture_tune_cache", path
+    )
+    mod = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(mod)
+    return mod
+
+
+def cached_pins(tune_key: str | None) -> dict | None:
+    """Best cached BENCH_* pins for ``tune_key``, or None (no key, no
+    cache, cache disabled, nothing recorded). Consulted before the
+    autotune sweep so a warm cache skips it entirely."""
+    if not tune_key:
+        return None
+    try:
+        tc = _load_tune_cache_mod()
+        cache = tc.resolve()
+        if cache is None:
+            return None
+        best = cache.best(tune_key)
+        if best and isinstance(best.get("config"), dict):
+            pins = best["config"].get("pins") or {}
+            if pins:
+                return {k: str(v) for k, v in pins.items()}
+    except Exception as exc:  # noqa: BLE001 — a broken cache must
+        # degrade to "run the sweep", never kill the chain
+        log(f"tune cache consult failed: {exc!r}")
+    return None
+
+
+def last_recorded_tune_key() -> str | None:
+    """Best-effort ``tune_key`` from the bench ledger — the tune-only
+    mode's fallback when no baseline record from this process carries
+    one. ``CAPTURE_TUNE_KEY`` pins it explicitly.
+
+    Not simply "the newest record": baseline-stage records are
+    preferred (they carry the shipped-defaults key of the problem the
+    chain is measuring), and among equals a non-cpu backend wins — an
+    ad-hoc CPU smoke bench appending the newest record must not hand
+    the TPU chain a key whose cached pins were tuned for a different
+    backend/model (the chain would silently skip the ~45-min sweep on
+    their strength)."""
+    explicit = os.getenv("CAPTURE_TUNE_KEY")
+    if explicit:
+        return explicit
+    path = os.getenv(
+        "DLROVER_TPU_BENCH_LEDGER", ""
+    ) or os.path.join(REPO, "BENCH_LEDGER.jsonl")
+    recs = []
+    try:
+        with open(path) as f:
+            for line in f:
+                try:
+                    rec = json.loads(line)
+                except ValueError:
+                    continue
+                if isinstance(rec, dict) and rec.get("tune_key"):
+                    recs.append(rec)
+    except OSError:
+        pass
+    if not recs:
+        return None
+    # An absent backend field is unknown, not cpu — legacy records
+    # keep their old "newest wins" rank.
+    best = max(
+        enumerate(recs),
+        key=lambda ir: (
+            ir[1].get("stage") == "baseline",
+            ir[1].get("backend") != "cpu",
+            ir[0],
+        ),
+    )[1]
+    if best.get("backend") == "cpu":
+        log(
+            "warning: tune-key fallback found only cpu-backend ledger "
+            f"records; using key {best['tune_key']} from a cpu run"
+        )
+    return best["tune_key"]
+
+
 def run_autotune(timeout_s: float = 2700) -> str:
     """One quick autotune sweep; returns its stdout as TEXT even on
     timeout (the r5 regression: ``exc.stdout`` arrives as bytes when
@@ -266,6 +367,18 @@ def main() -> int:
     #   baseline — stage 1 only;  tune — stages 2-3 only;  all (default).
     stage_sel = os.environ.get("CAPTURE_STAGE", "all")
 
+    # --no-cache / CAPTURE_NO_CACHE=1: the escape hatch for a clean
+    # re-sweep. Exported so the bench children inherit it too (no pin
+    # application, no trial recording anywhere in the chain).
+    if (
+        "--no-cache" in sys.argv[1:]
+        or os.getenv("CAPTURE_NO_CACHE", "0") == "1"
+    ):
+        os.environ["DLROVER_TPU_TUNE_CACHE"] = "0"
+        log("tune cache disabled for this capture chain (--no-cache)")
+
+    baseline_rec = None
+
     # Stage 1: baseline, looped until the tunnel answers.
     if stage_sel in ("baseline", "all"):
         attempt = 0
@@ -293,6 +406,7 @@ def main() -> int:
                     ts=time.strftime("%Y-%m-%dT%H:%M:%SZ", time.gmtime()),
                 )
                 append_perf(rec)
+                baseline_rec = rec
                 break
             log(f"baseline attempt {attempt}: {rec}")
             if stage_sel == "baseline" and attempt >= 2:
@@ -302,23 +416,39 @@ def main() -> int:
     if stage_sel == "baseline":
         return 0
 
-    # Stage 2: autotune sweep (partial output still usable on timeout).
-    log("autotune sweep starting")
-    out = run_autotune()
-    best = parse_autotune(out)
-    if best is None:
-        log("no autotune results; stopping after baseline")
-        # In tune-only mode the job chain keys its done-marker on
-        # rc=0; an empty autotune usually means the tunnel died
-        # mid-sweep, so report retryable and let the next probe
-        # re-enter the stage.
-        return 2 if stage_sel == "tune" else 0
-    spec, tok_s = best
-    m = re.search(r"^n_devices:\s*(\d+)", out, re.M)
-    n_chips = int(m.group(1)) if m else 1
-    log(f"autotune winner: {spec} at {tok_s:.0f} tok/s "
-        f"(sweep mesh: {n_chips} chip(s))")
-    pins = winner_env(spec, n_chips)
+    # Stage 2: consult the persistent trial cache BEFORE spending the
+    # sweep — on TPU every avoided dry-run is tens of seconds of chip
+    # time, and the sweep is ~45 min of it. The baseline record (or,
+    # in tune-only mode, the newest ledger record) carries the key.
+    tune_key = (baseline_rec or {}).get(
+        "tune_key"
+    ) or last_recorded_tune_key()
+    pins = cached_pins(tune_key)
+    if pins is not None:
+        spec = "tune_cache"
+        log(
+            f"tune cache hit for key {tune_key}: skipping the "
+            f"autotune sweep; pins={pins}"
+        )
+    else:
+        # Cold cache: autotune sweep (partial output still usable on
+        # timeout).
+        log("autotune sweep starting")
+        out = run_autotune()
+        best = parse_autotune(out)
+        if best is None:
+            log("no autotune results; stopping after baseline")
+            # In tune-only mode the job chain keys its done-marker on
+            # rc=0; an empty autotune usually means the tunnel died
+            # mid-sweep, so report retryable and let the next probe
+            # re-enter the stage.
+            return 2 if stage_sel == "tune" else 0
+        spec, tok_s = best
+        m = re.search(r"^n_devices:\s*(\d+)", out, re.M)
+        n_chips = int(m.group(1)) if m else 1
+        log(f"autotune winner: {spec} at {tok_s:.0f} tok/s "
+            f"(sweep mesh: {n_chips} chip(s))")
+        pins = winner_env(spec, n_chips)
 
     # Stage 3: tuned re-bench with the winner pinned.
     for i in range(3):
