@@ -319,10 +319,16 @@ class MasterClient:
                     "reconnect callback failed", exc_info=True
                 )
 
-    def _get(self, request, what: Optional[str] = None):
+    def _get(
+        self,
+        request,
+        what: Optional[str] = None,
+        max_wait: Optional[float] = None,
+    ):
         return self.supervisor.call(
             lambda: self._client.get(request),
             what=what or type(request).__name__,
+            max_wait=max_wait,
         )
 
     def _report(self, request, what: Optional[str] = None):
@@ -688,6 +694,25 @@ class MasterClient:
             msg.DiagnosticsQueryRequest(node_id=node_id)
         )
         return list(resp.reports)
+
+    def query_health(
+        self,
+        node_id: int = -1,
+        include_history: bool = False,
+        max_wait: Optional[float] = None,
+    ) -> msg.HealthQueryResponse:
+        """The master's health plane: composite score + active
+        verdicts (optionally the transition history), filtered to one
+        node with ``node_id``. Tools and the operator use this as the
+        typed counterpart of the /healthz endpoint; probes pass
+        ``max_wait`` so a down master fails fast instead of riding
+        out the full reconnect budget."""
+        return self._get(
+            msg.HealthQueryRequest(
+                node_id=node_id, include_history=include_history
+            ),
+            max_wait=max_wait,
+        )
 
     def request_profile(self, node_id: int) -> None:
         """Operator trigger: ask the master to queue a PROFILE action

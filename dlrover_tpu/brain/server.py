@@ -85,15 +85,16 @@ class BrainRpcServer:
                     **self._known_fields(RuntimeSample, req.payload)
                 )
             )
-        elif req.kind == "ps_job":
+        elif req.kind in ("ps_job", "fleet", "health"):
             import inspect
 
-            params = set(
-                inspect.signature(
-                    self.brain.persist_ps_job
-                ).parameters
-            )
-            self.brain.persist_ps_job(
+            method = {
+                "ps_job": self.brain.persist_ps_job,
+                "fleet": self.brain.persist_fleet_sample,
+                "health": self.brain.persist_health_verdict,
+            }[req.kind]
+            params = set(inspect.signature(method).parameters)
+            method(
                 **{
                     k: v
                     for k, v in req.payload.items()
@@ -150,6 +151,16 @@ class RemoteBrain:
     def persist_ps_job(self, **kw) -> None:
         self._client.report(
             msg.BrainPersistRequest(kind="ps_job", payload=dict(kw))
+        )
+
+    def persist_fleet_sample(self, **kw) -> None:
+        self._client.report(
+            msg.BrainPersistRequest(kind="fleet", payload=dict(kw))
+        )
+
+    def persist_health_verdict(self, **kw) -> None:
+        self._client.report(
+            msg.BrainPersistRequest(kind="health", payload=dict(kw))
         )
 
     # -- algorithms ------------------------------------------------------

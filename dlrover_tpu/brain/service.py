@@ -111,6 +111,22 @@ class BrainService:
                 oom INT, completed INT, timestamp REAL
             )"""
         )
+        # Health plane (obs/health.py): fleet aggregate snapshots and
+        # detector verdicts on the evaluation cadence — the telemetry
+        # HISTORY the scaling policy engine plans over.
+        self._db.execute(
+            """CREATE TABLE IF NOT EXISTS fleet_samples (
+                job_name TEXT, aggregates TEXT, goodput_ratio REAL,
+                health_score REAL, timestamp REAL
+            )"""
+        )
+        self._db.execute(
+            """CREATE TABLE IF NOT EXISTS health_verdicts (
+                job_name TEXT, detector TEXT, severity TEXT,
+                node_id INT, message TEXT, action TEXT,
+                evidence TEXT, timestamp REAL
+            )"""
+        )
 
     def persist_metrics(self, rec: JobMetricsRecord) -> None:
         with self._lock:
@@ -196,6 +212,127 @@ class BrainService:
                 (s.job_name, s.node_type, self.SAMPLE_RETENTION),
             )
             self._db.commit()
+
+    def persist_fleet_sample(
+        self,
+        job_name: str,
+        aggregates: Optional[Dict] = None,
+        goodput_ratio: float = 0.0,
+        health_score: float = 1.0,
+        timestamp: float = 0.0,
+    ) -> None:
+        """One fleet-level telemetry snapshot per health-evaluation
+        tick: the FleetAggregator's cross-host aggregates (stored as
+        JSON) plus the goodput ratio and composite health score —
+        the windowed history the worker-count / replanning policies
+        consume."""
+        with self._lock:
+            self._db.execute(
+                "INSERT INTO fleet_samples VALUES (?,?,?,?,?)",
+                (
+                    job_name,
+                    json.dumps(aggregates or {}, sort_keys=True),
+                    float(goodput_ratio),
+                    float(health_score),
+                    timestamp or time.time(),
+                ),
+            )
+            self._db.execute(
+                "DELETE FROM fleet_samples WHERE rowid IN ("
+                "  SELECT rowid FROM fleet_samples"
+                "  WHERE job_name = ?"
+                "  ORDER BY timestamp DESC"
+                "  LIMIT -1 OFFSET ?)",
+                (job_name, self.SAMPLE_RETENTION),
+            )
+            self._db.commit()
+
+    def recent_fleet_samples(
+        self, job_name: str, limit: int = 100
+    ) -> List[Dict]:
+        """Newest-first fleet samples, aggregates decoded."""
+        with self._lock:
+            cur = self._db.execute(
+                "SELECT aggregates, goodput_ratio, health_score, "
+                "timestamp FROM fleet_samples WHERE job_name = ? "
+                "ORDER BY timestamp DESC LIMIT ?",
+                (job_name, limit),
+            )
+            rows = cur.fetchall()
+        out = []
+        for aggregates, ratio, score, ts in rows:
+            try:
+                decoded = json.loads(aggregates)
+            except ValueError:
+                decoded = {}
+            out.append(
+                {
+                    "aggregates": decoded,
+                    "goodput_ratio": ratio,
+                    "health_score": score,
+                    "timestamp": ts,
+                }
+            )
+        return out
+
+    def persist_health_verdict(
+        self,
+        job_name: str,
+        detector: str,
+        severity: str,
+        node_id: int = -1,
+        message: str = "",
+        action: str = "",
+        evidence: str = "",
+        timestamp: float = 0.0,
+    ) -> None:
+        """One detector verdict transition (new verdict, severity
+        change, or resolution). ``evidence`` is the JSON-encoded
+        evidence window the verdict shipped."""
+        with self._lock:
+            self._db.execute(
+                "INSERT INTO health_verdicts VALUES "
+                "(?,?,?,?,?,?,?,?)",
+                (
+                    job_name, detector, severity, int(node_id),
+                    message, action, evidence,
+                    timestamp or time.time(),
+                ),
+            )
+            self._db.execute(
+                "DELETE FROM health_verdicts WHERE rowid IN ("
+                "  SELECT rowid FROM health_verdicts"
+                "  WHERE job_name = ?"
+                "  ORDER BY timestamp DESC"
+                "  LIMIT -1 OFFSET ?)",
+                (job_name, self.SAMPLE_RETENTION),
+            )
+            self._db.commit()
+
+    def recent_health_verdicts(
+        self, job_name: str, limit: int = 100
+    ) -> List[Dict]:
+        with self._lock:
+            cur = self._db.execute(
+                "SELECT detector, severity, node_id, message, "
+                "action, evidence, timestamp FROM health_verdicts "
+                "WHERE job_name = ? ORDER BY timestamp DESC LIMIT ?",
+                (job_name, limit),
+            )
+            rows = cur.fetchall()
+        return [
+            {
+                "detector": detector,
+                "severity": severity,
+                "node_id": node_id,
+                "message": message,
+                "action": action,
+                "evidence": evidence,
+                "timestamp": ts,
+            }
+            for detector, severity, node_id, message, action,
+            evidence, ts in rows
+        ]
 
     def persist_ps_job(
         self,

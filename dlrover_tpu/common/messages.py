@@ -373,6 +373,50 @@ class DiagnosticsQueryResponse:
 
 
 @message
+class HealthVerdictMsg:
+    """One health-detector finding on the wire (the RPC mirror of
+    ``obs.health.HealthVerdict``). ``evidence`` is the convicting
+    window of ``[ts, value]`` samples; ``metrics`` the detector's
+    numeric facts (baseline mean, ratio, slope, ...)."""
+
+    detector: str = ""
+    severity: str = ""  # "info" | "warn" | "critical"
+    message: str = ""
+    node_id: int = -1
+    host: str = ""
+    suggested_action: str = ""  # an EventAction value, or ""
+    evidence_series: str = ""
+    evidence: List[List[float]] = dataclasses.field(
+        default_factory=list
+    )
+    metrics: Dict[str, float] = dataclasses.field(default_factory=dict)
+    timestamp: float = 0.0
+    resolved: bool = False
+
+
+@message
+class HealthQueryRequest:
+    """Fetch the master's health verdicts. ``node_id`` >= 0 filters
+    to one node's verdicts; ``include_history`` adds the bounded
+    transition history (new verdicts, severity changes, resolutions)
+    to the response."""
+
+    node_id: int = -1
+    include_history: bool = False
+
+
+@message
+class HealthQueryResponse:
+    score: float = 1.0
+    verdicts: List[HealthVerdictMsg] = dataclasses.field(
+        default_factory=list
+    )
+    history: List[HealthVerdictMsg] = dataclasses.field(
+        default_factory=list
+    )
+
+
+@message
 class NodeFailureResponse:
     # A NodeAction constant: who owns the restart after this failure.
     action: str = "restart_in_place"

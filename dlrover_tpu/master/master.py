@@ -53,6 +53,9 @@ class JobMaster:
         metrics_port: Optional[int] = None,
         collect_interval: float = 60.0,
         state_dir: Optional[str] = None,
+        brain=None,
+        brain_db: Optional[str] = None,
+        health_interval: Optional[float] = None,
     ):
         """``node_num`` is the desired (max) world size; ``min_nodes``
         (default = node_num) is the smallest world the job may proceed
@@ -65,7 +68,14 @@ class JobMaster:
         ``state_dir`` (or DLROVER_TPU_STATE_DIR) enables master warm
         restart: recoverable state is journaled there as versioned
         JSON snapshots, and prepare() restores from the newest valid
-        one so a master reschedule costs seconds, not the job."""
+        one so a master reschedule costs seconds, not the job.
+        ``brain`` (any object with the BrainService persistence
+        surface, e.g. a RemoteBrain) or ``brain_db`` (sqlite path, or
+        DLROVER_TPU_BRAIN_DB; default in-memory) is the datastore the
+        health plane persists runtime samples, fleet aggregates, and
+        verdicts into; ``health_interval`` (or
+        DLROVER_TPU_HEALTH_INTERVAL_S, default 15 s) is the detector
+        evaluation cadence."""
         self.node_num = node_num
         self.evaluator_count = evaluator_count
         self.job_manager = JobManager(
@@ -82,14 +92,21 @@ class JobMaster:
         self.ps_manager = PsManager()
         # Fleet telemetry: goodput accountant + per-host snapshot
         # aggregator, rendered into the same registry the /metrics
-        # endpoint and MetricsRequest RPC serve.
+        # endpoint and MetricsRequest RPC serve — and, new, recorded
+        # as bounded HISTORY in the time-series store the health
+        # detectors query windows over.
         from dlrover_tpu.obs.fleet import FleetAggregator
         from dlrover_tpu.obs.goodput import GoodputAccountant
+        from dlrover_tpu.obs.timeseries import TimeSeriesStore
 
-        self.goodput = GoodputAccountant()
+        self.timeseries = TimeSeriesStore()
+        self.goodput = GoodputAccountant(timeseries=self.timeseries)
         self.fleet = FleetAggregator(
-            speed_monitor=self.speed_monitor, goodput=self.goodput
+            speed_monitor=self.speed_monitor,
+            goodput=self.goodput,
+            timeseries=self.timeseries,
         )
+        self.speed_monitor.timeseries = self.timeseries
         self.elastic_rdzv = ElasticRendezvous()
         self.check_rdzv = NetworkCheckRendezvous()
         for rdzv in (self.elastic_rdzv, self.check_rdzv):
@@ -109,6 +126,42 @@ class JobMaster:
             ps_manager=self.ps_manager,
             fleet=self.fleet,
         )
+        # Brain datastore: where the health plane persists runtime
+        # samples, fleet aggregates + goodput ratio, and verdicts —
+        # the same channel ROADMAP item 2's policy engine reads. An
+        # injected `brain` (e.g. brain.server.RemoteBrain for a
+        # standalone deployment) wins; else a local sqlite store
+        # (DLROVER_TPU_BRAIN_DB path, default in-memory).
+        if brain is None:
+            from dlrover_tpu.brain.service import BrainService
+
+            if brain_db is None:
+                brain_db = (
+                    os.getenv("DLROVER_TPU_BRAIN_DB", "") or ":memory:"
+                )
+            brain = BrainService(brain_db)
+        self.brain = brain
+        # Health plane: detector engine over the time-series history,
+        # queueing PROFILE/DIAGNOSE on critical verdicts through the
+        # servicer's per-node action FIFO.
+        from dlrover_tpu.obs.health import HealthMonitor
+
+        self.health = HealthMonitor(
+            store=self.timeseries,
+            speed_monitor=self.speed_monitor,
+            job_manager=self.job_manager,
+            fleet=self.fleet,
+            goodput=self.goodput,
+            action_sink=self.servicer.push_action,
+            brain=self.brain,
+            job_name=(
+                job_name
+                or os.getenv("DLROVER_TPU_JOB_NAME", "default")
+            ),
+            heartbeat_timeout=heartbeat_timeout,
+            interval=health_interval,
+        )
+        self.servicer.health = self.health
         # A freshly-scored straggler gets a fleet `diagnose` AND a
         # `profile`: its agent SIGUSR1s the training process for a
         # stack digest and asks the trainer for an N-step phase/MFU
@@ -336,11 +389,13 @@ class JobMaster:
         self.metric_collector.start()
         if self.state_journal is not None:
             self.state_journal.start()
+        self.health.start()
         if self._metrics_port is not None:
             from dlrover_tpu.obs.exposition import MetricsHTTPServer
 
             self.metrics_server = MetricsHTTPServer(
-                port=self._metrics_port
+                port=self._metrics_port,
+                health=self.health.healthz_payload,
             )
             self.metrics_server.start()
         # Any job may register PS hosts (sparse path); their liveness
@@ -407,6 +462,7 @@ class JobMaster:
         if self.ps_auto_scaler is not None:
             self.ps_auto_scaler.stop()
         self.ps_manager.stop_liveness_monitor()
+        self.health.stop()
         self.task_manager.stop()
         self.job_manager.stop()
         # stop() joins the collector thread: after this returns no

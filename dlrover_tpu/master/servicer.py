@@ -53,6 +53,7 @@ class MasterServicer:
         speed_monitor: Optional[SpeedMonitor] = None,
         ps_manager=None,
         fleet=None,
+        health=None,
     ):
         self.job_manager = job_manager
         self.task_manager = task_manager
@@ -83,6 +84,11 @@ class MasterServicer:
                 speed_monitor=self.speed_monitor, attach=False
             )
         self.fleet = fleet
+        # Health plane: the detector engine whose verdict history the
+        # HealthQueryRequest RPC serves. None on a bare servicer
+        # (tests, embedded use) — queries then answer "healthy, no
+        # verdicts" rather than failing.
+        self.health = health
         # Actions queued for agents: a bounded per-node FIFO drained
         # one action per heartbeat. (A plain node_id -> action dict
         # silently dropped the first action when a second was pushed
@@ -125,6 +131,7 @@ class MasterServicer:
         g(msg.ParallelConfigRequest, self._get_parallel_config)
         g(msg.MetricsRequest, self._get_metrics)
         g(msg.DiagnosticsQueryRequest, self._query_diagnostics)
+        g(msg.HealthQueryRequest, self._query_health)
 
         r(msg.KVStoreSetRequest, self._kv_set)
         r(msg.DatasetShardParams, self._create_dataset)
@@ -427,6 +434,51 @@ class MasterServicer:
                     for r in self._diagnostics[node_id]
                 ]
         return msg.DiagnosticsQueryResponse(reports=reports)
+
+    @staticmethod
+    def _verdict_msg(v) -> msg.HealthVerdictMsg:
+        d = v.to_dict()
+        return msg.HealthVerdictMsg(
+            detector=d["detector"],
+            severity=d["severity"],
+            message=d["message"],
+            node_id=d["node_id"],
+            host=d["host"],
+            suggested_action=d["suggested_action"],
+            evidence_series=d["evidence_series"],
+            evidence=d["evidence"],
+            metrics=d["metrics"],
+            timestamp=d["timestamp"],
+            resolved=d["resolved"],
+        )
+
+    def _query_health(self, req: msg.HealthQueryRequest):
+        """The health plane's typed read channel: current score +
+        active verdicts (optionally the transition history), filtered
+        to one node when asked."""
+        if self.health is None:
+            return msg.HealthQueryResponse(score=1.0)
+
+        def keep(v) -> bool:
+            return req.node_id < 0 or v.node_id == req.node_id
+
+        verdicts = [
+            self._verdict_msg(v)
+            for v in self.health.active_verdicts()
+            if keep(v)
+        ]
+        history = []
+        if req.include_history:
+            history = [
+                self._verdict_msg(v)
+                for v in self.health.history()
+                if keep(v)
+            ]
+        return msg.HealthQueryResponse(
+            score=self.health.health_score(),
+            verdicts=verdicts,
+            history=history,
+        )
 
     def diagnose_node(self, node_id: int) -> None:
         """Queue an on-demand stack-and-state snapshot on the node

@@ -89,6 +89,10 @@ class SpeedMonitor:
         # it is still being slow. Exceptions are swallowed: a broken
         # trigger must not poison step accounting.
         self.on_straggler = None
+        # Optional TimeSeriesStore (set by the JobMaster): every EWMA
+        # update is also recorded as history, so the health plane's
+        # straggler-persistence detector has an evidence window.
+        self.timeseries = None
 
     # -- throughput window ---------------------------------------------------
 
@@ -222,6 +226,10 @@ class SpeedMonitor:
                     _HOST_STEP_EWMA.remove(node=str(node_id))
                 except ValueError:
                     pass
+                if self.timeseries is not None:
+                    self.timeseries.drop_series(
+                        "host.step_ewma", node=str(node_id)
+                    )
             self._node_last_report.pop(node_id, None)
 
     def recovery_seconds(
@@ -342,6 +350,10 @@ class SpeedMonitor:
                 self._host_step_samples.get(node_id, 0) + 1
             )
         _HOST_STEP_EWMA.set(ewma, node=str(node_id))
+        if self.timeseries is not None:
+            self.timeseries.record(
+                "host.step_ewma", ewma, node=str(node_id)
+            )
         self._refresh_stragglers()
 
     def host_step_ewma(self) -> Dict[int, float]:

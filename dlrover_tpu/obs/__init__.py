@@ -42,6 +42,16 @@ doing through this package, so "what is the job doing right now" and
   a live MFU gauge from XLA cost analysis, and the on-demand PROFILE
   capture protocol (master action -> agent request file -> trainer
   digest -> diagnostics history).
+* :mod:`dlrover_tpu.obs.timeseries` — the bounded in-memory
+  time-series store (labeled series, ring retention with coarse
+  downsampling, windowed mean/percentile/rate/robust-slope queries)
+  the measurement plane records history into.
+* :mod:`dlrover_tpu.obs.health` — the detector engine over that
+  history: throughput-degradation / goodput-SLO / data-starvation /
+  recompile-storm / RSS-growth / straggler-persistence /
+  heartbeat-gap verdicts with evidence windows, the composite
+  ``dlrover_job_health_score``, auto-queued PROFILE/DIAGNOSE actions,
+  and brain persistence.
 
 The functions re-exported here are the instrumentation surface the
 rest of the codebase uses::
@@ -93,4 +103,17 @@ from dlrover_tpu.obs.profiling import (  # noqa: F401
     CompileTracker,
     MfuMeter,
     StepPhaseProfiler,
+)
+from dlrover_tpu.obs.timeseries import (  # noqa: F401
+    TimeSeriesStore,
+    WindowStats,
+)
+
+# Imported last: health.py instruments through `dlrover_tpu.obs`
+# itself (obs.counter/obs.gauge are bound above by the time this
+# executes), mirroring how the master modules import the package.
+from dlrover_tpu.obs.health import (  # noqa: E402,F401
+    HealthMonitor,
+    HealthVerdict,
+    render_health,
 )

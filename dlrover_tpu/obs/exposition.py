@@ -13,9 +13,10 @@ bind a socket.
 
 from __future__ import annotations
 
+import json
 import threading
 from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
-from typing import Optional
+from typing import Callable, Optional
 
 from dlrover_tpu.common.log import get_logger
 from dlrover_tpu.obs.metrics import MetricsRegistry, get_registry
@@ -25,24 +26,51 @@ logger = get_logger("obs.http")
 CONTENT_TYPE = "text/plain; version=0.0.4; charset=utf-8"
 
 
-def _make_handler(registry: MetricsRegistry):
+def _make_handler(
+    registry: MetricsRegistry,
+    health: Optional[Callable[[], dict]] = None,
+):
     class Handler(BaseHTTPRequestHandler):
+        def _send(self, status: int, body: bytes, ctype: str) -> None:
+            self.send_response(status)
+            self.send_header("Content-Type", ctype)
+            self.send_header("Content-Length", str(len(body)))
+            self.end_headers()
+            self.wfile.write(body)
+
         def do_GET(self):  # noqa: N802 - BaseHTTPRequestHandler API
             path = self.path.split("?", 1)[0]
             if path == "/metrics":
-                body = registry.render().encode()
-                self.send_response(200)
-                self.send_header("Content-Type", CONTENT_TYPE)
-                self.send_header("Content-Length", str(len(body)))
-                self.end_headers()
-                self.wfile.write(body)
-            elif path in ("/", "/healthz"):
-                body = b"ok\n"
-                self.send_response(200)
-                self.send_header("Content-Type", "text/plain")
-                self.send_header("Content-Length", str(len(body)))
-                self.end_headers()
-                self.wfile.write(body)
+                self._send(200, registry.render().encode(), CONTENT_TYPE)
+            elif path == "/":
+                # Pure liveness, always 200: a critical verdict about
+                # a WORKER must not make the master process look dead
+                # to a probe pointed at the root path.
+                self._send(200, b"ok\n", "text/plain")
+            elif path == "/healthz":
+                if health is None:
+                    # No health plane attached (bare exposition
+                    # server): liveness-only answer, as before.
+                    self._send(200, b"ok\n", "text/plain")
+                    return
+                try:
+                    payload = health()
+                except Exception:  # noqa: BLE001 — a broken health
+                    # provider must not 500 the liveness probe
+                    logger.warning(
+                        "health provider failed", exc_info=True
+                    )
+                    payload = {"ok": True, "error": "health provider failed"}
+                # Readiness semantics for the deploy/ CRD probes: 200
+                # while no CRITICAL verdict is active, 503 otherwise —
+                # the JSON body carries the score either way so a
+                # smarter prober can apply its own floor.
+                status = 200 if payload.get("ok", True) else 503
+                self._send(
+                    status,
+                    (json.dumps(payload, sort_keys=True) + "\n").encode(),
+                    "application/json",
+                )
             else:
                 self.send_error(404)
 
@@ -61,10 +89,15 @@ class MetricsHTTPServer:
         registry: Optional[MetricsRegistry] = None,
         port: int = 0,
         host: str = "0.0.0.0",
+        health: Optional[Callable[[], dict]] = None,
     ):
+        """``health`` — a callable returning the /healthz JSON body
+        (``HealthMonitor.healthz_payload``); /healthz then answers
+        200 (healthy) / 503 (critical verdicts active) with the
+        score, instead of the bare liveness ``ok``."""
         self.registry = registry or get_registry()
         self._server = ThreadingHTTPServer(
-            (host, port), _make_handler(self.registry)
+            (host, port), _make_handler(self.registry, health=health)
         )
         self._server.daemon_threads = True
         self._thread: Optional[threading.Thread] = None

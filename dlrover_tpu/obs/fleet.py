@@ -44,6 +44,10 @@ from dlrover_tpu.obs.metrics import (
     _format_value,
     get_registry,
 )
+from dlrover_tpu.common.log import get_logger
+from dlrover_tpu.obs.timeseries import _percentile as _percentile_sorted
+
+logger = get_logger("obs.fleet")
 
 # Key series the fleet view aggregates across hosts, and the stats
 # computed for each. Values are per-host scalars extracted from the
@@ -62,13 +66,7 @@ DEFAULT_TTL = 90.0  # 3x the default ResourceMonitor cadence
 
 def _percentile(values: List[float], q: float) -> float:
     """Nearest-rank percentile (q in [0, 100]) on a sorted copy."""
-    if not values:
-        return 0.0
-    ordered = sorted(values)
-    rank = max(
-        0, min(len(ordered) - 1, int(round(q / 100.0 * (len(ordered) - 1))))
-    )
-    return ordered[rank]
+    return _percentile_sorted(sorted(values), q)
 
 
 @dataclasses.dataclass
@@ -92,18 +90,26 @@ class FleetAggregator:
         goodput=None,
         ttl: float = DEFAULT_TTL,
         attach: bool = True,
+        timeseries=None,
     ):
         """``attach=False`` skips hooking :meth:`collect` into the
         registry's render — for owners that cannot guarantee a
         matching :meth:`close` (a collector left on the process-global
-        registry would render forever)."""
+        registry would render forever). ``timeseries`` (a
+        :class:`~dlrover_tpu.obs.timeseries.TimeSeriesStore`) turns
+        every ingest into history: per-host scalars and fleet
+        aggregates are recorded so the health detectors can query
+        windows instead of instants."""
         self.registry = registry or get_registry()
         self.speed_monitor = speed_monitor
         self.goodput = goodput
+        self.timeseries = timeseries
         self.ttl = ttl
         self._lock = threading.Lock()
         self._hosts: Dict[str, HostSnapshot] = {}
         self._node_to_host: Dict[int, str] = {}
+        self._last_fleet_record_ts = -float("inf")
+        self._skew_warned: set = set()
         if attach:
             self.registry.add_collector(self.collect)
 
@@ -148,7 +154,116 @@ class FleetAggregator:
             # stream is fed by the servicer (step reports, failures)
             # and this is its recompute tick (debounced internally).
             self.goodput.account()
+        if self.timeseries is not None:
+            self._record_timeseries(snap)
         return snap
+
+    # Snapshot scalar -> time-series name, per host. The cumulative
+    # ones (data_wait seconds, host syncs, compiles) are recorded as
+    # counters the store's rate() differentiates.
+    _TS_SERIES = (
+        ("step_time_s", "host.step_time"),
+        ("tokens_per_s", "host.tokens_per_s"),
+        ("data_wait_s_total", "host.data_wait_s"),
+        ("host_syncs_total", "host.host_syncs"),
+        ("mfu", "host.mfu"),
+    )
+    _TS_RESOURCE = ("cpu_percent", "memory_mb", "hbm_used_gb")
+
+    # Minimum snapshot-time seconds between fleet-aggregate history
+    # records (per-host series are never debounced).
+    FLEET_RECORD_INTERVAL = 5.0
+
+    # Snapshot stamps this far past the master's clock are clamped
+    # (generous slack: RPC latency + modest NTP drift, never minutes).
+    MAX_FUTURE_SKEW = 30.0
+
+    def _record_timeseries(self, snap: HostSnapshot) -> None:
+        """Fold one snapshot into the history store: per-host scalars
+        (stamped with the snapshot's wall time, so fake-clock tests
+        and late-arriving snapshots land where they belong) plus the
+        fleet aggregates as of this ingest."""
+        store = self.timeseries
+        ts = snap.wall_ts
+        # A host clock running ahead of the master would stamp its
+        # samples past every detector's query window (anchored at the
+        # master's clock) — the host silently vanishes from the
+        # health plane, and the fleet-record debounce watermark jumps
+        # ahead, muting everyone else. Clamp future stamps to "now"
+        # (past stamps stay put: a late arrival and a backdated test
+        # snapshot are indistinguishable and both legitimate).
+        now = store.clock()
+        if ts > now + self.MAX_FUTURE_SKEW:
+            with self._lock:
+                warn = snap.host not in self._skew_warned
+                self._skew_warned.add(snap.host)
+            if warn:
+                logger.warning(
+                    "host %s snapshot stamped %.0fs in the master's "
+                    "future; clamping its history stamps (check NTP)",
+                    snap.host, ts - now,
+                )
+            ts = now
+        for series, name in self._TS_SERIES:
+            v = self._host_scalar(snap, series)
+            if v is not None:
+                store.record(name, v, ts=ts, host=snap.host)
+        for key in self._TS_RESOURCE:
+            v = snap.resource.get(key)
+            if v is not None:
+                store.record(
+                    f"host.{key}", float(v), ts=ts, host=snap.host
+                )
+        compiles = self._compile_total(snap)
+        if compiles is not None:
+            store.record(
+                "host.compiles", compiles, ts=ts, host=snap.host
+            )
+        # Fleet aggregates walk every live snapshot; recording them
+        # on every per-host ingest is O(hosts^2) per collect interval
+        # and floods the window with near-identical duplicates, so
+        # debounce to once per FLEET_RECORD_INTERVAL of snapshot time.
+        # Check-and-advance the watermark under the lock: concurrent
+        # ingest RPCs must elect exactly one recorder per interval
+        # (aggregates() takes the same lock, so it stays outside).
+        with self._lock:
+            record_fleet = (
+                ts - self._last_fleet_record_ts
+                >= self.FLEET_RECORD_INTERVAL
+            )
+            if record_fleet:
+                self._last_fleet_record_ts = ts
+        if record_fleet:
+            for series, stats in self.aggregates().items():
+                for stat, value in stats.items():
+                    store.record(
+                        f"fleet.{series}", value, ts=ts, stat=stat
+                    )
+
+    @staticmethod
+    def _compile_total(snap: HostSnapshot) -> Optional[float]:
+        """Total (re)compiles the host's CompileTracker counted, from
+        its shipped registry dump (sum over the per-fn series)."""
+        md = snap.registry.get("dlrover_compile_total")
+        if not md or md.get("type") != "counter":
+            return None
+        return float(sum(row[1] for row in md.get("series", [])))
+
+    def node_for_host(self, host: str) -> Optional[int]:
+        """The node id behind a host label, for detectors that queue
+        actions on the node's heartbeat FIFO."""
+        with self._lock:
+            for node_id, h in self._node_to_host.items():
+                if h == host:
+                    return node_id
+        return None
+
+    def host_node_map(self) -> Dict[str, int]:
+        """host label -> node id, inverted in one locked pass — for
+        callers (the health tick) that would otherwise pay an
+        O(hosts) :meth:`node_for_host` scan per host."""
+        with self._lock:
+            return {h: n for n, h in self._node_to_host.items()}
 
     def remove_node(self, node_id: int) -> None:
         """Drop a departed node's snapshot immediately (the TTL is
@@ -157,12 +272,18 @@ class FleetAggregator:
             host = self._node_to_host.pop(node_id, None)
             if host is not None:
                 self._hosts.pop(host, None)
+        if host is not None and self.timeseries is not None:
+            # Its history goes too: a dead host's stale series must
+            # not keep convicting (or acquitting) the live fleet.
+            self.timeseries.drop_label("host", host)
 
     def remove_host(self, host: str) -> None:
         with self._lock:
             snap = self._hosts.pop(host, None)
             if snap is not None:
                 self._node_to_host.pop(snap.node_id, None)
+        if snap is not None and self.timeseries is not None:
+            self.timeseries.drop_label("host", host)
 
     def _live_locked(self) -> List[HostSnapshot]:
         now = time.monotonic()

@@ -257,8 +257,14 @@ class GoodputAccountant:
         registry=None,
         max_events: int = 100_000,
         min_account_interval: float = 5.0,
+        timeseries=None,
     ):
+        """``timeseries`` (a TimeSeriesStore) additionally records
+        every recompute's ratio and per-category seconds as history,
+        so the goodput-SLO detector can judge a window instead of the
+        instantaneous gauge."""
         registry = registry or _metrics.get_registry()
+        self.timeseries = timeseries
         self._lock = threading.Lock()
         self._events: List[dict] = []
         self._max_events = max_events
@@ -313,6 +319,23 @@ class GoodputAccountant:
                     report.seconds.get(cat, 0.0), category=cat
                 )
             self._ratio.set(report.goodput_ratio)
+            if self.timeseries is not None and t0 is None and t1 is None:
+                # History for the health detectors: stamp at the
+                # store's "now", not report.t1 — when the event
+                # stream stalls, t1 freezes and frozen-stamped
+                # samples would age out of the SLO detector's query
+                # window during the exact episode it must see.
+                ts = max(report.t1, self.timeseries.clock())
+                self.timeseries.record(
+                    "goodput.ratio", report.goodput_ratio, ts=ts
+                )
+                for cat in CATEGORIES:
+                    self.timeseries.record(
+                        "goodput.seconds",
+                        report.seconds.get(cat, 0.0),
+                        ts=ts,
+                        category=cat,
+                    )
         with self._lock:
             if t0 is None and t1 is None:
                 self._last_account_mono = time.monotonic()

@@ -36,3 +36,15 @@ def shard_map(
     if f is None:
         return lambda g: _shard_map(g, **kwargs)
     return _shard_map(f, **kwargs)
+
+
+def use_mesh(mesh):
+    """Enter ``mesh`` as the ambient mesh: ``jax.set_mesh`` where it
+    exists (jax >= 0.5), else the :class:`~jax.sharding.Mesh` context
+    manager the 0.4.x toolchain provides."""
+    import jax
+
+    set_mesh = getattr(jax, "set_mesh", None)
+    if set_mesh is not None:
+        return set_mesh(mesh)
+    return mesh

@@ -74,6 +74,36 @@ class TestRemoteParity:
         grown = client.optimize_worker_oom("gpt2", 4096)
         assert grown >= 4096
 
+    def test_fleet_and_health_persist_over_rpc(self, remote):
+        """The health plane's channel (fleet samples + verdict
+        transitions) round-trips through the standalone brain's RPC
+        kinds exactly like local persistence."""
+        client, server = remote
+        client.persist_fleet_sample(
+            job_name="j1",
+            aggregates={"step_time_s": {"mean": 0.2}},
+            goodput_ratio=0.8,
+            health_score=0.9,
+            timestamp=1000.0,
+        )
+        client.persist_health_verdict(
+            job_name="j1",
+            detector="throughput_degradation",
+            severity="critical",
+            node_id=3,
+            message="host h1 2.5x baseline",
+            action="profile",
+            evidence="[[1000.0, 0.25]]",
+            timestamp=1000.0,
+        )
+        samples = server.brain.recent_fleet_samples("j1")
+        assert samples[0]["goodput_ratio"] == 0.8
+        assert samples[0]["aggregates"]["step_time_s"]["mean"] == 0.2
+        verdicts = server.brain.recent_health_verdicts("j1")
+        assert verdicts[0]["detector"] == "throughput_degradation"
+        assert verdicts[0]["node_id"] == 3
+        assert verdicts[0]["action"] == "profile"
+
     def test_unknown_algorithm_raises_remotely(self, remote):
         client, _ = remote
         with pytest.raises(RuntimeError, match="failed"):

@@ -1,0 +1,702 @@
+"""Fleet health plane: time-series store, detectors, SLO verdicts.
+
+Covers the acceptance criteria of the health-plane PR:
+
+* the bounded time-series store keeps full-resolution recent history,
+  downsamples older samples into coarse buckets, and answers windowed
+  mean/percentile/rate/robust-slope queries with an injectable clock;
+* every detector fires on its synthetic signature and stays silent on
+  a healthy control, hermetically (fake clock, no sleeps);
+* the simulated-fleet drill: a real in-process JobMaster fed fake
+  agent snapshots with one slow host and one data-starved host emits
+  the right verdicts with evidence windows, auto-queues PROFILE on
+  the slow host's heartbeat FIFO, serves them via ``query_health`` /
+  ``/healthz`` / ``dlrover_job_health_score`` / ``obs_report
+  --health``, persists the channel to the brain datastore — and
+  convicts nothing on the healthy control host.
+"""
+
+import json
+import os
+import subprocess
+import sys
+import time
+import urllib.error
+import urllib.request
+
+import pytest
+
+from dlrover_tpu.common import messages as msg
+from dlrover_tpu.common.comm import RpcClient
+from dlrover_tpu.common.constants import EventAction
+from dlrover_tpu.master.master import JobMaster
+from dlrover_tpu.obs.exposition import MetricsHTTPServer
+from dlrover_tpu.obs.health import (
+    SEVERITY_CRITICAL,
+    SEVERITY_WARN,
+    HealthMonitor,
+    render_health,
+)
+from dlrover_tpu.obs.timeseries import TimeSeriesStore
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+class FakeClock:
+    def __init__(self, t=1000.0):
+        self.t = t
+
+    def __call__(self):
+        return self.t
+
+
+class TestTimeSeriesStore:
+    def test_window_stats(self):
+        clk = FakeClock(100.0)
+        store = TimeSeriesStore(clock=clk)
+        for i in range(10):
+            store.record("s", float(i), ts=10.0 * i, host="a")
+        clk.t = 95.0
+        stats = store.query("s", 50.0, host="a")
+        # window [45, 95] -> samples 5..9
+        assert stats.count == 5
+        assert stats.mean == 7.0
+        assert stats.minimum == 5.0 and stats.maximum == 9.0
+        assert stats.p50 == 7.0
+        baseline = store.query("s", 50.0, end_offset_s=50.0, host="a")
+        # window [-5, 45] -> samples 0..4
+        assert baseline.count == 5 and baseline.mean == 2.0
+
+    def test_labels_separate_series(self):
+        store = TimeSeriesStore(clock=FakeClock())
+        store.record("s", 1.0, ts=10.0, host="a")
+        store.record("s", 9.0, ts=10.0, host="b")
+        assert store.query("s", host="a").mean == 1.0
+        assert store.query("s", host="b").mean == 9.0
+        assert store.series_labels("s") == [
+            {"host": "a"}, {"host": "b"}
+        ]
+
+    def test_ring_retention_downsamples_old_points(self):
+        store = TimeSeriesStore(
+            raw_points=4, coarse_points=8, coarse_resolution=10.0,
+            clock=FakeClock(1000.0),
+        )
+        # 2 samples per 10s bucket over 80s; the raw ring keeps the
+        # newest 4 full-resolution, older ones fold to bucket means.
+        for i in range(16):
+            store.record("s", float(i), ts=5.0 * i)
+        pts = store.points("s")
+        raw_tail = pts[-4:]
+        assert [v for _, v in raw_tail] == [12.0, 13.0, 14.0, 15.0]
+        # Folded region: bucket means of consecutive pairs
+        # ((0+1)/2, (2+3)/2, ...), one point per 10s bucket.
+        folded = pts[:-4]
+        assert [v for _, v in folded] == [0.5, 2.5, 4.5, 6.5, 8.5, 10.5]
+        # Windowed query still spans both regions seamlessly.
+        assert store.query("s").count == 10
+
+    def test_rate_and_counter_reset(self):
+        store = TimeSeriesStore(clock=FakeClock(100.0))
+        store.record("c", 10.0, ts=0.0)
+        store.record("c", 70.0, ts=60.0)
+        assert store.rate("c", 200.0) == pytest.approx(1.0)
+        # Counter reset (process restart) must not read negative.
+        store.record("c", 5.0, ts=80.0)
+        assert store.rate("c", 200.0) is None
+
+    def test_slope_is_outlier_robust(self):
+        store = TimeSeriesStore(clock=FakeClock(100.0))
+        for i in range(20):
+            v = 1.0 if i != 10 else 500.0  # one spike
+            store.record("flat", v, ts=5.0 * i)
+            store.record("ramp", 0.5 * i, ts=5.0 * i)
+        assert store.slope("flat", 200.0) == pytest.approx(0.0)
+        assert store.slope("ramp", 200.0) == pytest.approx(0.1)
+
+    def test_bad_samples_ignored_and_series_bounded(self):
+        store = TimeSeriesStore(max_series=2, clock=FakeClock())
+        store.record("s", float("nan"), ts=1.0, host="a")
+        store.record("s", "garbage", ts=1.0, host="a")
+        assert store.query("s", host="a") is None
+        store.record("s", 1.0, ts=1.0, host="a")
+        store.record("s", 1.0, ts=1.0, host="b")
+        store.record("s", 1.0, ts=1.0, host="c")  # over the bound
+        assert store.size() == 2
+        assert store.query("s", host="c") is None
+
+    def test_drop_label_forgets_departed_host(self):
+        store = TimeSeriesStore(clock=FakeClock())
+        store.record("s1", 1.0, ts=1.0, host="a")
+        store.record("s2", 2.0, ts=1.0, host="a")
+        store.record("s1", 3.0, ts=1.0, host="b")
+        store.drop_label("host", "a")
+        assert store.query("s1", host="a") is None
+        assert store.query("s2", host="a") is None
+        assert store.query("s1", host="b").mean == 3.0
+
+
+def make_monitor(clk, store, **kw):
+    config = {
+        "window_s": 60.0,
+        "min_points": 3.0,
+        "goodput_grace_s": 0.0,
+    }
+    config.update(kw.pop("config", {}))
+    return HealthMonitor(store, clock=clk, config=config, **kw)
+
+
+def feed_steps(store, host, fn, t0=900.0, n=40, dt=5.0):
+    for i in range(n):
+        t = t0 + i * dt
+        store.record("host.step_time", fn(t), ts=t, host=host)
+
+
+class TestDetectors:
+    def setup_method(self):
+        self.clk = FakeClock(1095.0)
+        self.store = TimeSeriesStore(clock=self.clk)
+
+    def test_degradation_fires_on_ramp_not_on_healthy(self):
+        feed_steps(
+            self.store, "slow",
+            lambda t: 0.1 if t < 1000 else 0.1 * (1 + (t - 1000) / 30.0),
+        )
+        feed_steps(self.store, "ok", lambda t: 0.1)
+        mon = make_monitor(self.clk, self.store)
+        verdicts = mon.evaluate_once()
+        assert [
+            (v.detector, v.host, v.severity) for v in verdicts
+        ] == [("throughput_degradation", "slow", SEVERITY_CRITICAL)]
+        v = verdicts[0]
+        assert v.suggested_action == EventAction.PROFILE.value
+        assert len(v.evidence) >= 3
+        assert v.metrics["ratio"] > 1.8
+
+    def test_degradation_needs_min_points(self):
+        feed_steps(
+            self.store, "slow", lambda t: 0.5, t0=1080.0, n=2
+        )
+        mon = make_monitor(self.clk, self.store)
+        assert mon.evaluate_once() == []
+
+    def test_goodput_slo_breach_and_grace(self):
+        for i in range(20):
+            self.store.record(
+                "goodput.ratio", 0.5, ts=1000.0 + 5 * i
+            )
+        mon = make_monitor(self.clk, self.store)
+        (v,) = mon.evaluate_once()
+        assert v.detector == "goodput_slo"
+        assert v.severity == SEVERITY_WARN
+        # Same data inside the startup grace period: no verdict.
+        fresh = make_monitor(
+            self.clk, TimeSeriesStore(clock=self.clk),
+            config={"goodput_grace_s": 1e9},
+        )
+        fresh.store.record("goodput.ratio", 0.5, ts=1090.0)
+        assert fresh.evaluate_once() == []
+
+    def test_goodput_critical_floor(self):
+        for i in range(20):
+            self.store.record(
+                "goodput.ratio", 0.3, ts=1000.0 + 5 * i
+            )
+        mon = make_monitor(self.clk, self.store)
+        (v,) = mon.evaluate_once()
+        assert (v.detector, v.severity) == (
+            "goodput_slo", SEVERITY_CRITICAL
+        )
+
+    def test_data_starvation_from_cumulative_counter(self):
+        feed_steps(self.store, "h", lambda t: 0.1)
+        total = 0.0
+        for i in range(20):
+            t = 1000.0 + 5 * i
+            total += 5 * 0.6  # blocked 60% of wall time
+            self.store.record("host.data_wait_s", total, ts=t, host="h")
+        mon = make_monitor(self.clk, self.store)
+        verdicts = [
+            v for v in mon.evaluate_once()
+            if v.detector == "data_starvation"
+        ]
+        assert [(v.host, v.severity) for v in verdicts] == [
+            ("h", SEVERITY_CRITICAL)
+        ]
+        assert verdicts[0].metrics["data_wait_frac"] == pytest.approx(
+            0.6
+        )
+
+    def test_recompile_storm(self):
+        feed_steps(self.store, "h", lambda t: 0.1)
+        for i in range(20):
+            # 1 compile per 5s tick = 12/min: storm-critical.
+            self.store.record(
+                "host.compiles", float(i), ts=1000.0 + 5 * i, host="h"
+            )
+        mon = make_monitor(self.clk, self.store)
+        verdicts = [
+            v for v in mon.evaluate_once()
+            if v.detector == "recompile_storm"
+        ]
+        assert [(v.host, v.severity) for v in verdicts] == [
+            ("h", SEVERITY_CRITICAL)
+        ]
+
+    def test_rss_growth_fires_on_ramp_not_on_step_jump(self):
+        for i in range(40):
+            t = 900.0 + 5 * i
+            self.store.record(
+                "host.memory_mb", 1000.0 + (t - 900.0) * 2.0,
+                ts=t, host="leak",
+            )
+            # One-off allocation jump then flat: NOT a leak.
+            self.store.record(
+                "host.memory_mb",
+                1000.0 if t < 1000.0 else 1400.0,
+                ts=t, host="jump",
+            )
+        mon = make_monitor(self.clk, self.store)
+        verdicts = [
+            v for v in mon.evaluate_once()
+            if v.detector == "rss_growth"
+        ]
+        assert [v.host for v in verdicts] == ["leak"]
+        assert verdicts[0].suggested_action == (
+            EventAction.DIAGNOSE.value
+        )
+
+    def test_straggler_persistence_needs_consecutive_ticks(self):
+        class FakeSpeed:
+            def __init__(self):
+                self.slow = [7]
+
+            def straggler_scores(self):
+                return {7: 2.5}
+
+            def stragglers(self):
+                return list(self.slow)
+
+        speed = FakeSpeed()
+        mon = make_monitor(self.clk, self.store, speed_monitor=speed)
+        assert mon.evaluate_once() == []  # tick 1
+        assert mon.evaluate_once() == []  # tick 2
+        (v,) = mon.evaluate_once()  # tick 3 = warn threshold
+        assert (v.detector, v.node_id, v.severity) == (
+            "straggler_persistence", 7, SEVERITY_WARN
+        )
+        for _ in range(3):
+            verdicts = mon.evaluate_once()
+        assert verdicts[0].severity == SEVERITY_CRITICAL  # tick 6
+        # Recovery resets the streak.
+        speed.slow = []
+        assert mon.evaluate_once() == []
+        speed.slow = [7]
+        assert mon.evaluate_once() == []  # back to tick 1
+
+    def test_heartbeat_gap_thresholds(self):
+        ages = {"value": {}}
+        mon = make_monitor(
+            self.clk, self.store,
+            heartbeat_timeout=180.0,
+            heartbeat_ages=lambda: dict(ages["value"]),
+        )
+        ages["value"] = {1: 10.0}
+        assert mon.evaluate_once() == []
+        ages["value"] = {1: 100.0}  # 55% of timeout
+        (v,) = mon.evaluate_once()
+        assert (v.detector, v.node_id, v.severity) == (
+            "heartbeat_gap", 1, SEVERITY_WARN
+        )
+        assert v.suggested_action == ""  # can't action a silent node
+        ages["value"] = {1: 160.0}  # 89%
+        (v,) = mon.evaluate_once()
+        assert v.severity == SEVERITY_CRITICAL
+
+    def test_lifecycle_resolution_score_and_history(self):
+        feed_steps(
+            self.store, "slow",
+            lambda t: 0.1 if t < 1000 else 0.1 * (1 + (t - 1000) / 30.0),
+        )
+        mon = make_monitor(self.clk, self.store)
+        mon.evaluate_once()
+        assert mon.health_score() == pytest.approx(0.7)
+        assert mon.critical_count() == 1
+        assert not mon.healthz_payload()["ok"]
+        # Same verdict again: active, but NOT a second transition.
+        mon.evaluate_once()
+        assert len(mon.history()) == 1
+        # The host heals: verdict resolves, score recovers.
+        feed_steps(self.store, "slow", lambda t: 0.1, t0=1100.0)
+        self.clk.t = 1295.0
+        assert mon.evaluate_once() == []
+        assert mon.health_score() == 1.0
+        assert mon.healthz_payload()["ok"]
+        history = mon.history()
+        assert len(history) == 2
+        assert history[-1].resolved
+        assert history[-1].severity == "info"
+
+    def test_action_cooldown(self):
+        feed_steps(
+            self.store, "slow",
+            lambda t: 0.1 if t < 1000 else 0.1 * (1 + (t - 1000) / 30.0),
+        )
+        actions = []
+        mon = make_monitor(
+            self.clk, self.store,
+            action_sink=lambda n, a: actions.append((n, a)),
+            config={"action_cooldown_s": 1000.0},
+            fleet=type(
+                "F", (),
+                {"node_for_host": staticmethod(lambda h: 3),
+                 "aggregates": staticmethod(dict)},
+            )(),
+        )
+        mon.evaluate_once()
+        assert actions == [(3, "profile")]
+        # Resolve (healthy data) then immediately re-convict: inside
+        # the cooldown the action must NOT be queued again.
+        feed_steps(self.store, "slow", lambda t: 0.1, t0=1100.0)
+        self.clk.t = 1295.0
+        mon.evaluate_once()
+        feed_steps(
+            self.store, "slow",
+            lambda t: 0.1 if t < 1400.0 else 0.1 * (1 + (t - 1400.0) / 30.0),
+            t0=1300.0,
+        )
+        self.clk.t = 1495.0
+        verdicts = mon.evaluate_once()
+        assert [v.severity for v in verdicts] == [SEVERITY_CRITICAL]
+        assert actions == [(3, "profile")]
+
+    def test_broken_detector_does_not_silence_the_rest(self):
+        feed_steps(
+            self.store, "slow",
+            lambda t: 0.1 if t < 1000 else 0.1 * (1 + (t - 1000) / 30.0),
+        )
+        mon = make_monitor(self.clk, self.store)
+
+        def boom():
+            raise RuntimeError("broken detector")
+
+        mon.detectors.insert(0, boom)
+        verdicts = mon.evaluate_once()
+        assert [v.detector for v in verdicts] == [
+            "throughput_degradation"
+        ]
+
+    def test_env_knob_override(self, monkeypatch):
+        monkeypatch.setenv(
+            "DLROVER_TPU_HEALTH_GOODPUT_SLO", "0.95"
+        )
+        mon = HealthMonitor(
+            self.store, clock=self.clk,
+            config={"min_points": 3.0, "goodput_grace_s": 0.0},
+        )
+        assert mon._cfg("goodput_slo") == 0.95
+        # config beats env
+        mon2 = HealthMonitor(
+            self.store, clock=self.clk, config={"goodput_slo": 0.5}
+        )
+        assert mon2._cfg("goodput_slo") == 0.5
+
+
+class TestHealthzEndpoint:
+    def test_bare_server_keeps_liveness_ok(self):
+        srv = MetricsHTTPServer(port=0)
+        srv.start()
+        try:
+            body = urllib.request.urlopen(
+                f"http://127.0.0.1:{srv.port}/healthz", timeout=5
+            ).read()
+            assert body == b"ok\n"
+        finally:
+            srv.stop()
+
+    def test_healthz_serves_payload_and_503_on_critical(self):
+        payload = {"ok": True, "health_score": 1.0}
+        srv = MetricsHTTPServer(port=0, health=lambda: dict(payload))
+        srv.start()
+        try:
+            url = f"http://127.0.0.1:{srv.port}/healthz"
+            got = json.loads(urllib.request.urlopen(url, timeout=5).read())
+            assert got["ok"] is True
+            payload.update(ok=False, health_score=0.4)
+            with pytest.raises(urllib.error.HTTPError) as e:
+                urllib.request.urlopen(url, timeout=5)
+            assert e.value.code == 503
+            got = json.loads(e.value.read())
+            assert got["health_score"] == 0.4
+        finally:
+            srv.stop()
+
+    def test_root_path_stays_pure_liveness(self):
+        """A critical verdict must flip /healthz readiness, never the
+        / liveness answer — a liveness probe restarting the master
+        over a WORKER's verdict would be a self-inflicted outage."""
+        srv = MetricsHTTPServer(
+            port=0, health=lambda: {"ok": False, "health_score": 0.1}
+        )
+        srv.start()
+        try:
+            body = urllib.request.urlopen(
+                f"http://127.0.0.1:{srv.port}/", timeout=5
+            ).read()
+            assert body == b"ok\n"
+            with pytest.raises(urllib.error.HTTPError):
+                urllib.request.urlopen(
+                    f"http://127.0.0.1:{srv.port}/healthz", timeout=5
+                )
+        finally:
+            srv.stop()
+
+    def test_broken_health_provider_stays_alive(self):
+        def boom():
+            raise RuntimeError("provider broke")
+
+        srv = MetricsHTTPServer(port=0, health=boom)
+        srv.start()
+        try:
+            body = urllib.request.urlopen(
+                f"http://127.0.0.1:{srv.port}/healthz", timeout=5
+            ).read()
+            assert json.loads(body)["ok"] is True
+        finally:
+            srv.stop()
+
+
+class TestSimulatedFleetDrill:
+    """The acceptance drill: one slow host + one data-starved host +
+    one healthy control against a REAL in-process master."""
+
+    @pytest.fixture()
+    def master(self):
+        m = JobMaster(
+            port=0, node_num=3, rdzv_timeout=1.0, metrics_port=0,
+            collect_interval=999.0, health_interval=9999.0,
+        )
+        m.prepare()
+        yield m
+        m.stop()
+
+    @staticmethod
+    def snapshot_msg(node_id, host, ts, step_time, data_wait_total):
+        registry = {
+            "dlrover_train_data_wait_seconds": {
+                "type": "histogram", "help": "data wait",
+                "labelnames": [], "buckets": [0.1, 1.0],
+                "series": [[[], [0, 0, 1], data_wait_total, 1]],
+            },
+        }
+        return msg.MetricsSnapshotReport(
+            node_id=node_id,
+            host=host,
+            timestamp=ts,
+            registry=registry,
+            resource={"tokens_per_s": 500.0},
+            step_times=[step_time],
+            events=[],
+        )
+
+    def feed_fleet(self, master):
+        """240s of backdated snapshot history on a 10s cadence:
+        h0 healthy, h1 ramping slow over the last 120s, h2 blocked
+        on input 60% of wall time throughout."""
+        now = time.time()
+        client = RpcClient(master.addr)
+        for node_id, host in ((0, "h0"), (1, "h1"), (2, "h2")):
+            client.report(
+                msg.NodeAddressRequest(node_id=node_id, node_ip=host)
+            )
+        for i in range(25):
+            ts = now - 240.0 + i * 10.0
+            age = max(0.0, ts - (now - 120.0))
+            slow_step = 0.1 * (1.0 + age / 40.0)  # up to 4x baseline
+            client.report(
+                self.snapshot_msg(0, "h0", ts, 0.1, 0.001 * i)
+            )
+            client.report(
+                self.snapshot_msg(1, "h1", ts, slow_step, 0.001 * i)
+            )
+            client.report(
+                self.snapshot_msg(2, "h2", ts, 0.1, 6.0 * i)
+            )
+        return client
+
+    def test_drill(self, master, tmp_path):
+        client = self.feed_fleet(master)
+        # Drain any straggler-triggered actions the snapshot feed
+        # already queued (SpeedMonitor's instantaneous path), so the
+        # action assertion below is attributable to the health plane.
+        for node_id in (0, 1, 2):
+            while (
+                client.report(
+                    msg.HeartbeatRequest(node_id=node_id)
+                ).action != "none"
+            ):
+                pass
+        master.health._last_action.clear()
+
+        verdicts = master.health.evaluate_once()
+        by_detector = {}
+        for v in verdicts:
+            by_detector.setdefault(v.detector, []).append(v)
+
+        # The slow host: critical degradation with evidence.
+        (deg,) = by_detector["throughput_degradation"]
+        assert (deg.host, deg.node_id, deg.severity) == (
+            "h1", 1, SEVERITY_CRITICAL
+        )
+        assert deg.suggested_action == EventAction.PROFILE.value
+        assert len(deg.evidence) >= 3
+        assert deg.metrics["ratio"] >= 1.8
+
+        # The starved host: critical data starvation.
+        (sta,) = by_detector["data_starvation"]
+        assert (sta.host, sta.node_id, sta.severity) == (
+            "h2", 2, SEVERITY_CRITICAL
+        )
+        assert sta.metrics["data_wait_frac"] == pytest.approx(
+            0.6, rel=0.05
+        )
+
+        # NO false positives on the healthy control host.
+        assert not any(
+            v.host == "h0" or v.node_id == 0 for v in verdicts
+        )
+
+        # The PROFILE action went onto the slow host's FIFO.
+        assert (
+            client.report(msg.HeartbeatRequest(node_id=1)).action
+            == EventAction.PROFILE.value
+        )
+        assert (
+            client.report(msg.HeartbeatRequest(node_id=0)).action
+            == "none"
+        )
+
+        # query_health RPC: typed verdicts + score, node filter.
+        from dlrover_tpu.agent.master_client import MasterClient
+
+        mc = MasterClient(master.addr, node_id=0)
+        resp = mc.query_health(include_history=True)
+        assert resp.score < 1.0
+        detectors = {v.detector for v in resp.verdicts}
+        assert {"throughput_degradation", "data_starvation"} <= detectors
+        wire_deg = next(
+            v for v in resp.verdicts
+            if v.detector == "throughput_degradation"
+        )
+        assert wire_deg.host == "h1"
+        assert wire_deg.evidence and len(wire_deg.evidence[0]) == 2
+        assert resp.history  # transitions recorded
+        only_h1 = mc.query_health(node_id=1)
+        assert {v.node_id for v in only_h1.verdicts} == {1}
+
+        # /healthz: 503 + JSON facts while critical verdicts active.
+        url = f"http://127.0.0.1:{master.metrics_server.port}"
+        with pytest.raises(urllib.error.HTTPError) as e:
+            urllib.request.urlopen(f"{url}/healthz", timeout=5)
+        assert e.value.code == 503
+        payload = json.loads(e.value.read())
+        assert payload["ok"] is False
+        assert payload["critical_verdicts"] >= 2
+        assert "throughput_degradation" in payload["detectors"]
+
+        # /metrics: score gauge + verdict counter.
+        body = urllib.request.urlopen(
+            f"{url}/metrics", timeout=5
+        ).read().decode()
+        assert "dlrover_job_health_score" in body
+        # (no exact count: the counter is process-global and other
+        # tests in the session legitimately increment it too)
+        assert (
+            'dlrover_health_verdicts_total{detector='
+            '"throughput_degradation",severity="critical"}' in body
+        )
+
+        # Brain persistence: the same channel the policy engine reads.
+        rows = master.brain.recent_health_verdicts("default")
+        assert {
+            (r["detector"], r["severity"]) for r in rows
+        } >= {
+            ("throughput_degradation", "critical"),
+            ("data_starvation", "critical"),
+        }
+        deg_row = next(
+            r for r in rows if r["detector"] == "throughput_degradation"
+        )
+        assert deg_row["node_id"] == 1
+        assert json.loads(deg_row["evidence"])  # decodable window
+        fleet_rows = master.brain.recent_fleet_samples("default")
+        assert fleet_rows and fleet_rows[0]["health_score"] < 1.0
+        assert fleet_rows[0]["aggregates"].get("step_time_s")
+        per_node = master.brain._recent_samples("default", "worker", 5)
+        assert set(per_node) == {0, 1, 2}
+
+        # obs_report --health against the LIVE master: renders the
+        # verdicts and exits 1 (critical active).
+        proc = subprocess.run(
+            [
+                sys.executable,
+                os.path.join(REPO, "tools", "obs_report.py"),
+                "--health", master.addr,
+            ],
+            capture_output=True, text=True, timeout=120, cwd=REPO,
+        )
+        assert proc.returncode == 1, proc.stdout + proc.stderr
+        assert "throughput_degradation" in proc.stdout
+        assert "data_starvation" in proc.stdout
+        assert "job health score" in proc.stdout
+
+        # render_health on the monitor's own snapshot agrees.
+        rendered = render_health(master.health.snapshot())
+        assert "h1" in rendered and "evidence" in rendered
+
+    def test_speed_monitor_feeds_ewma_history(self, master):
+        self.feed_fleet(master)
+        stats = master.timeseries.query("host.step_ewma", node="1")
+        assert stats is not None and stats.count >= 3
+        # EWMA history tracked the slowdown.
+        assert stats.last > stats.first
+
+    def test_departed_host_history_dropped(self, master):
+        self.feed_fleet(master)
+        assert master.timeseries.query("host.step_time", host="h1")
+        master.job_manager.handle_node_gone(1, "pod deleted")
+        assert (
+            master.timeseries.query("host.step_time", host="h1") is None
+        )
+        # Its verdicts resolve on the next evaluation (no series ->
+        # no subject), so a dead host cannot pin the health score.
+        verdicts = master.health.evaluate_once()
+        assert not any(v.host == "h1" for v in verdicts)
+
+
+class TestGoodputHistory:
+    def test_accountant_records_ratio_history(self):
+        from dlrover_tpu.obs.goodput import GoodputAccountant
+        from dlrover_tpu.obs.metrics import MetricsRegistry
+
+        # Wall clock: the accountant stamps history at the window
+        # end, which is wall time.
+        store = TimeSeriesStore()
+        acct = GoodputAccountant(
+            registry=MetricsRegistry(), timeseries=store
+        )
+        t = time.time()
+        acct.add_events([
+            {"name": "trainer.step", "ts": t - 30.0, "step": 1},
+            {"name": "trainer.step", "ts": t - 1.0, "step": 2},
+        ])
+        report = acct.account(force=True)
+        assert report is not None
+        latest = store.latest("goodput.ratio")
+        assert latest is not None
+        assert latest[1] == pytest.approx(report.goodput_ratio)
+        cats = {
+            ls["category"]
+            for ls in store.series_labels("goodput.seconds")
+        }
+        assert "productive" in cats

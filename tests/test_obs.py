@@ -475,6 +475,22 @@ class TestMetricNameHygiene:
         with pytest.raises(ValueError):
             reg.gauge("dlrover_x_total", "x")
 
+    def test_health_plane_metrics_are_audited(self):
+        """The health plane's registrations must be visible to the
+        walker with the contract names/types/labels — a rename or a
+        dynamic registration would silently drop them from the audit
+        (and from every dashboard keyed on them)."""
+        sites = {
+            name: (mtype, labels)
+            for _, _, mtype, name, _, labels in self._call_sites()
+        }
+        assert sites.get("dlrover_health_verdicts_total") == (
+            "counter",
+            ["detector", "severity"],
+        ), sites.get("dlrover_health_verdicts_total")
+        mtype, labels = sites.get("dlrover_job_health_score", (None, 0))
+        assert mtype == "gauge" and not labels, (mtype, labels)
+
 
 class TestMasterExposition:
     """Acceptance: the master exposes Prometheus text metrics (node

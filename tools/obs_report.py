@@ -15,6 +15,14 @@ e.g. the per-host traces the chaos drills leave behind — and prints:
 * a per-event-name table: count, and for span events total/mean
   duration, sorted by total time.
 
+``--health TARGET`` renders the master's fleet-health plane (score,
+active verdicts with their evidence windows, transition history —
+obs/health.py) from either a live master (``host:port``, via the
+``HealthQueryRequest`` RPC) or a JSON snapshot file
+(``HealthMonitor.snapshot()`` shaped). Exits 1 when a critical
+verdict is active, so scripts can gate on it like the /healthz
+probe.
+
 ``--postmortem DIR`` instead renders a forensics dir (the flight
 recorder's ``bundle_*.json`` black-box bundles + ``stacks_*.txt``
 faulthandler dumps + any ``*.jsonl`` traces, obs/postmortem.py) into
@@ -26,6 +34,8 @@ final dump.
 Usage:
     python tools/obs_report.py TRACE.jsonl [--failure-ts T] [--top N]
     python tools/obs_report.py TRACE.jsonl --goodput
+    python tools/obs_report.py --health 127.0.0.1:8001
+    python tools/obs_report.py --health health_snapshot.json
     python tools/obs_report.py --postmortem /tmp/dlrover_tpu_forensics_job
     python tools/obs_report.py --selftest
 
@@ -238,6 +248,158 @@ def report(
     return 0
 
 
+def health_report(target: str) -> int:
+    """Render the fleet-health plane from a live master (host:port,
+    HealthQueryRequest RPC) or a JSON snapshot file. Returns 1 when a
+    critical verdict is active (probe semantics), else 0."""
+    import dataclasses
+    import json
+    import os
+
+    from dlrover_tpu.obs.health import SEVERITY_CRITICAL, render_health
+
+    if os.path.isfile(target):
+        with open(target) as f:
+            payload = json.load(f)
+    elif (
+        target.endswith(".json")
+        or os.sep in target
+        or ":" not in target
+    ):
+        # Looks like a snapshot path, not host:port — a typo'd file
+        # name must fail fast, not hang in gRPC connect retries
+        # against a nonsense address.
+        print(f"health snapshot not found: {target}", file=sys.stderr)
+        return 2
+    else:
+        from dlrover_tpu.agent.master_client import MasterClient
+
+        client = MasterClient(target, node_id=-1)
+        try:
+            # Probe semantics: a down master must fail fast, not
+            # ride out the supervisor's full reconnect budget.
+            resp = client.query_health(
+                include_history=True, max_wait=15.0
+            )
+        except Exception as exc:  # noqa: BLE001
+            print(
+                f"health query to {target} failed: {exc}",
+                file=sys.stderr,
+            )
+            return 2
+        payload = {
+            "score": resp.score,
+            "active": [
+                dataclasses.asdict(v) for v in resp.verdicts
+            ],
+            "history": [
+                dataclasses.asdict(v) for v in resp.history
+            ],
+        }
+    print(render_health(payload))
+    critical = sum(
+        1
+        for v in payload.get("active", [])
+        if v.get("severity") == SEVERITY_CRITICAL
+    )
+    return 1 if critical else 0
+
+
+def _selftest_health() -> list:
+    """Health plane hermetically: a fake-clock monitor over a ramping
+    slow host + a healthy control host must convict exactly the slow
+    one, queue a PROFILE action, and render score + evidence via the
+    same path ``--health`` uses."""
+    import json as _json
+    import tempfile
+
+    from dlrover_tpu.obs.health import (
+        SEVERITY_CRITICAL,
+        HealthMonitor,
+        render_health,
+    )
+    from dlrover_tpu.obs.timeseries import TimeSeriesStore
+
+    errors = []
+    clk = [0.0]
+    store = TimeSeriesStore(clock=lambda: clk[0])
+    actions = []
+    monitor = HealthMonitor(
+        store,
+        action_sink=lambda node, action: actions.append((node, action)),
+        clock=lambda: clk[0],
+        config={"window_s": 60.0, "min_points": 3.0},
+    )
+    monitor.fleet = type(
+        "F",
+        (),
+        {
+            "node_for_host": staticmethod(
+                lambda host: {"slow": 3, "ok": 4}.get(host)
+            ),
+            "aggregates": staticmethod(dict),
+        },
+    )()
+    for i in range(40):
+        t = 900.0 + i * 5
+        slow = 0.1 if t < 1000 else 0.1 * (1 + (t - 1000) / 30.0)
+        store.record("host.step_time", slow, ts=t, host="slow")
+        store.record("host.step_time", 0.1, ts=t, host="ok")
+    clk[0] = 1095.0
+    verdicts = monitor.evaluate_once()
+    convicted = {(v.detector, v.host, v.severity) for v in verdicts}
+    if ("throughput_degradation", "slow", SEVERITY_CRITICAL) not in convicted:
+        errors.append(f"slow host not convicted: {convicted}")
+    if any(v.host == "ok" for v in verdicts):
+        errors.append(f"healthy control host convicted: {convicted}")
+    if actions != [(3, "profile")]:
+        errors.append(f"PROFILE not queued for node 3: {actions}")
+    if monitor.health_score() >= 1.0:
+        errors.append(f"score did not drop: {monitor.health_score()}")
+    payload = monitor.healthz_payload()
+    if payload["ok"] or payload["critical_verdicts"] != 1:
+        errors.append(f"healthz payload wrong: {payload}")
+    rendered = render_health(monitor.snapshot())
+    for needle in (
+        "job health score 0.70",
+        "throughput_degradation",
+        "action: profile",
+        "evidence",
+    ):
+        if needle not in rendered:
+            errors.append(f"health render missing {needle!r}")
+    # The --health file path end to end: snapshot -> JSON -> report,
+    # rc 1 because a critical verdict is active.
+    with tempfile.NamedTemporaryFile(
+        "w", suffix=".json", delete=False
+    ) as f:
+        _json.dump(monitor.snapshot(), f)
+        path = f.name
+    try:
+        if health_report(path) != 1:
+            errors.append("health_report rc != 1 with critical verdict")
+    finally:
+        import os as _os
+
+        _os.unlink(path)
+    # Recovery: the slow host heals, the verdict resolves, rc goes 0.
+    for i in range(40):
+        t = 1100.0 + i * 5
+        store.record("host.step_time", 0.1, ts=t, host="slow")
+        store.record("host.step_time", 0.1, ts=t, host="ok")
+    clk[0] = 1295.0
+    if monitor.evaluate_once():
+        errors.append("verdict did not resolve after recovery")
+    if monitor.health_score() != 1.0:
+        errors.append(
+            f"score did not recover: {monitor.health_score()}"
+        )
+    history = monitor.history()
+    if not any(v.resolved for v in history):
+        errors.append("no resolution transition in history")
+    return errors
+
+
 def selftest() -> int:
     """Hermetic check of the reconstruction pipeline on synthetic
     events shaped like a real drill trace."""
@@ -312,6 +474,7 @@ def selftest() -> int:
     errors.extend(_selftest_fleet())
     errors.extend(_selftest_postmortem())
     errors.extend(_selftest_perf())
+    errors.extend(_selftest_health())
     if errors:
         print("obs selftest FAILED:")
         for e in errors:
@@ -566,6 +729,13 @@ def main(argv=None) -> int:
         "profiler's trace events",
     )
     p.add_argument(
+        "--health", type=str, default="",
+        metavar="TARGET",
+        help="render the master's fleet-health verdicts from a live "
+        "master (host:port) or a HealthMonitor.snapshot() JSON file; "
+        "exits 1 when a critical verdict is active",
+    )
+    p.add_argument(
         "--postmortem", type=str, default="",
         metavar="DIR",
         help="render a forensics dir (flight-recorder bundles + "
@@ -585,6 +755,8 @@ def main(argv=None) -> int:
     args = p.parse_args(argv)
     if args.selftest:
         return selftest()
+    if args.health:
+        return health_report(args.health)
     if args.postmortem:
         from dlrover_tpu.obs.postmortem import render_postmortem
 
