@@ -195,6 +195,10 @@ class ElasticAgent:
         # loop so process lifecycle has a single owner (no concurrent
         # kill/spawn races).
         self._restart_requested = threading.Event()
+        # Set by the master's `cordon` heartbeat action (remediation):
+        # the agent parks its trainer and sits out rendezvous while
+        # still heartbeating; RESTART_TRAINING un-cordons.
+        self._cordon_requested = threading.Event()
         # In-flight PROFILE capture worker (one at a time).
         self._profile_thread: Optional[threading.Thread] = None
 
@@ -665,6 +669,28 @@ class ElasticAgent:
         self._spawn(self._spec)
         while not self._stop.is_set():
             time.sleep(self.config.monitor_interval)
+            if self._cordon_requested.is_set():
+                # Cordoned by the master's remediation engine: stop
+                # the trainer (it would otherwise wedge the fleet's
+                # collectives), skip rendezvous/membership handling so
+                # this node sits OUT of the next world, keep
+                # heartbeating so the master can un-cordon (rollback)
+                # or retire us. A pending restart request stays set —
+                # it fires the moment the cordon clears.
+                if self._proc is not None and self._proc.poll() is None:
+                    logger.warning(
+                        "cordoned by master; stopping training "
+                        "process and sitting out rendezvous"
+                    )
+                    obs.event(
+                        "agent.cordoned", node_id=self.config.node_id
+                    )
+                    self._flush_ckpt_shm()
+                    self._kill_proc()
+                self._proc = None
+                if hang is not None:
+                    hang.reset()
+                continue
             if hang is not None and hang.check():
                 exhausted = (
                     self._restart_count >= self.config.max_restarts
@@ -863,7 +889,23 @@ class ElasticAgent:
                         exc_info=True,
                     )
             if action == EventAction.RESTART_TRAINING.value:
+                if self._cordon_requested.is_set():
+                    # restart_training doubles as un-cordon (the
+                    # remediation rollback path): clear the cordon
+                    # FIRST so the supervision loop acts on the
+                    # restart instead of skipping it.
+                    self._cordon_requested.clear()
+                    logger.info(
+                        "master un-cordoned this node; rejoining at "
+                        "the next rendezvous"
+                    )
                 self._restart_requested.set()
+            elif action == EventAction.CORDON.value:
+                logger.warning(
+                    "master cordoned this node (remediation); parking "
+                    "the trainer"
+                )
+                self._cordon_requested.set()
             elif action == EventAction.STOP_TRAINING.value:
                 self._stop.set()
             elif action == EventAction.DIAGNOSE.value:

@@ -714,6 +714,21 @@ class MasterClient:
             max_wait=max_wait,
         )
 
+    def query_remediation(
+        self,
+        node_id: int = -1,
+        limit: int = 0,
+        max_wait: Optional[float] = None,
+    ) -> msg.RemediationQueryResponse:
+        """The master's remediation engine: mode (enabled/dry-run),
+        cordoned nodes, decision history with per-governor audit
+        trails, and whether a probation window is currently failing.
+        Probes pass ``max_wait`` so a down master fails fast."""
+        return self._get(
+            msg.RemediationQueryRequest(node_id=node_id, limit=limit),
+            max_wait=max_wait,
+        )
+
     def request_profile(self, node_id: int) -> None:
         """Operator trigger: ask the master to queue a PROFILE action
         for ``node_id`` (its agent captures an N-step phase/MFU

@@ -85,13 +85,15 @@ class BrainRpcServer:
                     **self._known_fields(RuntimeSample, req.payload)
                 )
             )
-        elif req.kind in ("ps_job", "fleet", "health"):
+        elif req.kind in ("ps_job", "fleet", "health", "remediation"):
             import inspect
 
             method = {
                 "ps_job": self.brain.persist_ps_job,
                 "fleet": self.brain.persist_fleet_sample,
                 "health": self.brain.persist_health_verdict,
+                "remediation":
+                    self.brain.persist_remediation_decision,
             }[req.kind]
             params = set(inspect.signature(method).parameters)
             method(
@@ -161,6 +163,13 @@ class RemoteBrain:
     def persist_health_verdict(self, **kw) -> None:
         self._client.report(
             msg.BrainPersistRequest(kind="health", payload=dict(kw))
+        )
+
+    def persist_remediation_decision(self, **kw) -> None:
+        self._client.report(
+            msg.BrainPersistRequest(
+                kind="remediation", payload=dict(kw)
+            )
         )
 
     # -- algorithms ------------------------------------------------------

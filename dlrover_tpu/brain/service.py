@@ -127,6 +127,18 @@ class BrainService:
                 evidence TEXT, timestamp REAL
             )"""
         )
+        # Remediation engine (master/remediation.py): every decision
+        # (acted, blocked, dry-run) and outcome transition, with the
+        # governor audit trail as JSON — the record of what the
+        # self-healing loop DID, next to what the detectors SAW.
+        self._db.execute(
+            """CREATE TABLE IF NOT EXISTS remediation_decisions (
+                job_name TEXT, decision_id INT, detector TEXT,
+                node_id INT, host TEXT, action TEXT, outcome TEXT,
+                dry_run INT, governors TEXT, message TEXT,
+                timestamp REAL
+            )"""
+        )
 
     def persist_metrics(self, rec: JobMetricsRecord) -> None:
         with self._lock:
@@ -333,6 +345,79 @@ class BrainService:
             for detector, severity, node_id, message, action,
             evidence, ts in rows
         ]
+
+    def persist_remediation_decision(
+        self,
+        job_name: str,
+        decision_id: int = 0,
+        detector: str = "",
+        node_id: int = -1,
+        host: str = "",
+        action: str = "",
+        outcome: str = "",
+        dry_run: int = 0,
+        governors: str = "",
+        message: str = "",
+        timestamp: float = 0.0,
+    ) -> None:
+        """One remediation decision or outcome transition (the same
+        decision_id appears once per outcome). ``governors`` is the
+        JSON-encoded governor-check map."""
+        with self._lock:
+            self._db.execute(
+                "INSERT INTO remediation_decisions VALUES "
+                "(?,?,?,?,?,?,?,?,?,?,?)",
+                (
+                    job_name, int(decision_id), detector,
+                    int(node_id), host, action, outcome,
+                    int(dry_run), governors, message,
+                    timestamp or time.time(),
+                ),
+            )
+            self._db.execute(
+                "DELETE FROM remediation_decisions WHERE rowid IN ("
+                "  SELECT rowid FROM remediation_decisions"
+                "  WHERE job_name = ?"
+                "  ORDER BY timestamp DESC"
+                "  LIMIT -1 OFFSET ?)",
+                (job_name, self.SAMPLE_RETENTION),
+            )
+            self._db.commit()
+
+    def recent_remediation_decisions(
+        self, job_name: str, limit: int = 100
+    ) -> List[Dict]:
+        with self._lock:
+            cur = self._db.execute(
+                "SELECT decision_id, detector, node_id, host, "
+                "action, outcome, dry_run, governors, message, "
+                "timestamp FROM remediation_decisions "
+                "WHERE job_name = ? ORDER BY timestamp DESC LIMIT ?",
+                (job_name, limit),
+            )
+            rows = cur.fetchall()
+        out = []
+        for (decision_id, detector, node_id, host, action, outcome,
+             dry_run, governors, message, ts) in rows:
+            try:
+                decoded = json.loads(governors) if governors else {}
+            except ValueError:
+                decoded = {}
+            out.append(
+                {
+                    "decision_id": decision_id,
+                    "detector": detector,
+                    "node_id": node_id,
+                    "host": host,
+                    "action": action,
+                    "outcome": outcome,
+                    "dry_run": bool(dry_run),
+                    "governors": decoded,
+                    "message": message,
+                    "timestamp": ts,
+                }
+            )
+        return out
 
     def persist_ps_job(
         self,

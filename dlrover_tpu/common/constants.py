@@ -249,3 +249,8 @@ class EventAction(str, enum.Enum):
     # waits for the phase/MFU digest, and ships it back as a
     # DiagnosticsReport(kind="profile").
     PROFILE = "profile"
+    # Remediation engine: the agent stops its training process and
+    # sits OUT of rendezvous (still heartbeating) while the master
+    # replaces or observes the host. A subsequent RESTART_TRAINING
+    # un-cordons it (the rollback path).
+    CORDON = "cordon"

@@ -417,6 +417,49 @@ class HealthQueryResponse:
 
 
 @message
+class RemediationDecisionMsg:
+    """One remediation-engine decision on the wire (the RPC mirror of
+    ``master.remediation.RemediationDecision``). ``governors`` maps
+    every safety-governor name to ``"ok"`` or a ``"blocked: ..."``
+    reason; ``trigger`` is the convicting verdict's message."""
+
+    decision_id: int = 0
+    detector: str = ""
+    severity: str = ""
+    node_id: int = -1
+    host: str = ""
+    action: str = ""  # restart_training | cordon_replace | shrink
+    outcome: str = ""  # acted | dry_run | blocked | recovered | ...
+    dry_run: bool = False
+    governors: Dict[str, str] = dataclasses.field(default_factory=dict)
+    trigger: str = ""
+    timestamp: float = 0.0
+    probation_deadline: float = 0.0
+    note: str = ""
+
+
+@message
+class RemediationQueryRequest:
+    """Fetch the master's remediation decision history. ``node_id``
+    >= 0 filters to one node's decisions; ``limit`` > 0 caps the
+    newest-last decision list."""
+
+    node_id: int = -1
+    limit: int = 0
+
+
+@message
+class RemediationQueryResponse:
+    enabled: bool = False
+    dry_run: bool = False
+    cordoned: List[int] = dataclasses.field(default_factory=list)
+    probation_failing: bool = False
+    decisions: List[RemediationDecisionMsg] = dataclasses.field(
+        default_factory=list
+    )
+
+
+@message
 class NodeFailureResponse:
     # A NodeAction constant: who owns the restart after this failure.
     action: str = "restart_in_place"

@@ -116,6 +116,11 @@ class Node:
     # Straggler / health flags set by the network-check rendezvous.
     is_straggler: bool = False
     is_unhealthy: bool = False
+    # Cordoned by the remediation engine: alive and heartbeating, but
+    # excluded from rendezvous and not counted toward the auto-scale
+    # target (its replacement is); retired once probation confirms
+    # recovery, un-cordoned on rollback.
+    cordoned: bool = False
 
     def __post_init__(self):
         if self.config_resource is None:

@@ -516,8 +516,12 @@ class AllreduceAutoScaler:
         nodes = self.job_manager.list_nodes(NodeType.WORKER)
         # ALIVE includes PENDING: replacements in flight count toward
         # the target (counting them twice would strand the job one
-        # worker short of the target forever).
-        alive = [n for n in nodes if n.is_alive()]
+        # worker short of the target forever). Cordoned nodes do NOT
+        # count (alive_workers excludes them): the remediation engine
+        # deliberately benched them, and "fixing" the deficit by
+        # counting the benched host would leave the job short a
+        # healthy worker.
+        alive = self.job_manager.alive_workers()
         target = self.optimizer.target_worker_count(
             self.target_workers, self.speed_monitor
         )

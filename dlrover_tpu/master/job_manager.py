@@ -181,6 +181,10 @@ class JobManager:
         itself over RPC once its agent starts)."""
         with self._lock:
             self._nodes[node.id] = node
+            # Keep the id allocator ahead of externally-minted ids:
+            # launch_replacement must never collide with (and silently
+            # overwrite) an in-flight auto-scaler node.
+            self._next_node_id = max(self._next_node_id, node.id + 1)
 
     def add_listener(self, fn: Callable[[Node, str], None]) -> None:
         self._listeners.append(fn)
@@ -219,6 +223,12 @@ class JobManager:
                     relaunch_count=node.relaunch_count,
                     max_relaunch_count=node.max_relaunch_count,
                     critical=node.critical,
+                    # The cordon outlives the incarnation: only the
+                    # remediation engine un-cordons. Dropping it here
+                    # would let a benched host whose agent was gone
+                    # past the heartbeat timeout rejoin the world on
+                    # re-register, next to its replacement.
+                    cordoned=node.cordoned,
                 )
                 self._nodes[node_id] = fresh
                 node = fresh
@@ -278,6 +288,24 @@ class JobManager:
     def alive_nodes(self) -> List[Node]:
         with self._lock:
             return [n for n in self._nodes.values() if n.is_alive()]
+
+    def alive_workers(self, include_chief: bool = False) -> List[Node]:
+        """Alive, NON-cordoned training workers. The cordon exclusion
+        is deliberate and the default everywhere: a benched host is
+        out of the training world — it must not count toward scaling
+        capacity or the elastic floor, nor receive fleet broadcasts
+        (its agent overloads RESTART_TRAINING as un-cordon)."""
+        types = (
+            (NodeType.WORKER, NodeType.CHIEF)
+            if include_chief
+            else (NodeType.WORKER,)
+        )
+        with self._lock:
+            return [
+                n
+                for n in self._nodes.values()
+                if n.type in types and n.is_alive() and not n.cordoned
+            ]
 
     # Beats landing on a PENDING replacement within this window after
     # the relaunch are treated as last-gasp traffic from the agent
@@ -461,6 +489,10 @@ class JobManager:
             max_relaunch_count=node.max_relaunch_count,
             relaunch_reason=node.exit_reason,
             critical=node.critical,
+            # The cordon outlives the incarnation (same contract as
+            # register_node): a benched host whose pod died must come
+            # back benched, not rejoin next to its replacement.
+            cordoned=node.cordoned,
         )
         # Track the new incarnation: the failed node is being replaced,
         # so the job is NOT done (all_workers_done must see PENDING).
@@ -507,6 +539,92 @@ class JobManager:
         self._notify(node, NodeEventType.DELETED)
         if relaunch:
             self._relaunch(node)
+
+    # -- remediation seams (cordon / replace) -------------------------------
+
+    def cordon_node(self, node_id: int, reason: str = "") -> bool:
+        """Mark a live node cordoned: it stays alive (heartbeating,
+        reversible) but leaves the rendezvous and stops counting
+        toward the auto-scale target, so a replacement can be launched
+        next to it. Returns False for unknown/dead/already-cordoned
+        nodes (idempotent for replays)."""
+        with self._lock:
+            node = self._nodes.get(node_id)
+            if node is None or not node.is_alive() or node.cordoned:
+                return False
+            node.cordoned = True
+        _NODE_EVENTS.inc(event="cordon")
+        obs.event(
+            "node.cordon",
+            node_id=node_id, type=node.type, reason=reason,
+        )
+        logger.warning(
+            "node %d cordoned (%s): excluded from rendezvous, "
+            "replacement pending", node_id, reason or "remediation",
+        )
+        self._notify(node, NodeEventType.MODIFIED)
+        return True
+
+    def uncordon_node(self, node_id: int) -> bool:
+        """Reverse a cordon (remediation rollback): the node counts
+        toward the target again and may rejoin the rendezvous."""
+        with self._lock:
+            node = self._nodes.get(node_id)
+            if node is None or not node.cordoned:
+                return False
+            node.cordoned = False
+        _NODE_EVENTS.inc(event="uncordon")
+        obs.event("node.uncordon", node_id=node_id, type=node.type)
+        logger.info("node %d un-cordoned", node_id)
+        self._notify(node, NodeEventType.MODIFIED)
+        return True
+
+    def launch_replacement(
+        self, node: Node, reason: str = ""
+    ) -> Optional[Node]:
+        """Launch a fresh worker (new id/rank, copied resources) to
+        stand in for ``node`` via a ScalePlan — the cordon-then-
+        replace half-step: the old node is NOT removed here, so a
+        failed probation can roll back by retiring the replacement
+        instead. Returns the PENDING replacement node."""
+        with self._lock:
+            new_id = self._next_node_id
+            self._next_node_id += 1
+            resource = (
+                NodeResource.from_dict(node.config_resource.to_dict())
+                if node.config_resource is not None
+                else NodeResource()
+            )
+            repl = Node(
+                type=node.type,
+                id=new_id,
+                rank=new_id,
+                status=NodeStatus.PENDING,
+                config_resource=resource,
+                max_relaunch_count=self._max_relaunch,
+                relaunch_reason=reason,
+            )
+            self._apply_role_policy(repl)
+            # The stand-in inherits the replaced worker's criticality:
+            # the rank-keyed critical_workers spec cannot see the new
+            # rank, and losing the replacement past its budget must
+            # fail the job exactly as losing the original would have.
+            repl.critical = repl.critical or node.critical
+            self._nodes[new_id] = repl
+        plan = ScalePlan()
+        plan.launch_nodes.append(repl)
+        self._scaler.scale(plan)
+        _NODE_EVENTS.inc(event="replace")
+        obs.event(
+            "node.replace",
+            node_id=node.id, replacement_id=new_id, reason=reason,
+        )
+        logger.info(
+            "launching replacement node %d for cordoned node %d (%s)",
+            new_id, node.id, reason or "remediation",
+        )
+        self._notify(repl, NodeEventType.CREATED)
+        return repl
 
     def retire_node(self, node_id: int) -> None:
         """Gracefully retire a node (drained PS, scale-in): DELETED

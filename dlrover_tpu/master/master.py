@@ -56,6 +56,8 @@ class JobMaster:
         brain=None,
         brain_db: Optional[str] = None,
         health_interval: Optional[float] = None,
+        remediation_config: Optional[dict] = None,
+        remediation_interval: Optional[float] = None,
     ):
         """``node_num`` is the desired (max) world size; ``min_nodes``
         (default = node_num) is the smallest world the job may proceed
@@ -75,7 +77,12 @@ class JobMaster:
         health plane persists runtime samples, fleet aggregates, and
         verdicts into; ``health_interval`` (or
         DLROVER_TPU_HEALTH_INTERVAL_S, default 15 s) is the detector
-        evaluation cadence."""
+        evaluation cadence. ``remediation_config`` /
+        ``remediation_interval`` parameterize the self-healing engine
+        that acts on critical verdicts (docs/FAULT_TOLERANCE.md
+        "Verdict-driven remediation"; DLROVER_TPU_REMEDIATION_* env
+        knobs, DLROVER_TPU_REMEDIATION_DRY_RUN=1 to observe without
+        acting)."""
         self.node_num = node_num
         self.evaluator_count = evaluator_count
         self.job_manager = JobManager(
@@ -162,6 +169,31 @@ class JobMaster:
             interval=health_interval,
         )
         self.servicer.health = self.health
+        # Remediation engine: acts on the health plane's critical
+        # verdicts through the master's own seams (cordon-then-replace
+        # via ScalePlan, restart_training via the heartbeat FIFO,
+        # elastic shrink at the next rendezvous boundary), governed by
+        # hysteresis / blast-radius / shared cooldowns / probation.
+        from dlrover_tpu.master.remediation import RemediationEngine
+
+        self.remediation = RemediationEngine(
+            health=self.health,
+            job_manager=self.job_manager,
+            servicer=self.servicer,
+            fleet=self.fleet,
+            store=self.timeseries,
+            speed_monitor=self.speed_monitor,
+            rdzv_managers=(self.elastic_rdzv, self.check_rdzv),
+            brain=self.brain,
+            min_nodes=min_nodes if min_nodes > 0 else node_num,
+            job_name=(
+                job_name
+                or os.getenv("DLROVER_TPU_JOB_NAME", "default")
+            ),
+            config=remediation_config,
+            interval=remediation_interval,
+        )
+        self.servicer.remediation = self.remediation
         # A freshly-scored straggler gets a fleet `diagnose` AND a
         # `profile`: its agent SIGUSR1s the training process for a
         # stack digest and asks the trainer for an N-step phase/MFU
@@ -215,6 +247,13 @@ class JobMaster:
             self.kv_store.on_change = mark
             self.elastic_rdzv.on_state_change = mark
             self.check_rdzv.on_state_change = mark
+            # Verdict transitions and remediation decisions are
+            # recoverable state too: without journaling them, a warm
+            # restart re-fires a sticky verdict's action immediately
+            # (the cooldown stamp died with the process) and forgets
+            # in-flight cordons/probations.
+            self.health.on_state_change = mark
+            self.remediation.on_state_change = mark
         # Nodes can die without their agent ever reporting (pod
         # deleted, preemption, heartbeat timeout). The servicer's
         # failure-report path does this cleanup inline; DELETED events
@@ -249,22 +288,19 @@ class JobMaster:
         ):
             for rdzv in (self.elastic_rdzv, self.check_rdzv):
                 rdzv.remove_alive_node(node.id, node_rank=node.rank)
+            # A cordoned node already LEFT the training world when the
+            # remediation engine benched it: retiring its pod now (the
+            # cordon-then-replace finalization) must not bounce the
+            # healthy fleet a second time.
+            if getattr(node, "cordoned", False):
+                return
             # Survivors must not block on collectives with the dead
             # peer until some long transport timeout: push a restart
             # so their next heartbeat sends them back to rendezvous,
             # which completes with the shrunken world (>= min_nodes).
             # (ref: torch elastic restarts the worker group on
             # membership change, elastic_agent/torch/training.py:564.)
-            from dlrover_tpu.common.constants import EventAction
-
-            for peer in self.job_manager.alive_nodes():
-                if peer.id != node.id and peer.type in (
-                    NodeType.WORKER,
-                    NodeType.CHIEF,
-                ):
-                    self.servicer.push_action(
-                        peer.id, EventAction.RESTART_TRAINING.value
-                    )
+            self.servicer.restart_peers(node.id)
         if node.type == NodeType.EMBEDDING:
             # A dead PS host (heartbeat timeout / cluster event): move
             # its partitions to the survivors now — clients are already
@@ -286,6 +322,8 @@ class JobMaster:
             "task_manager": self.task_manager.to_snapshot(),
             "kv_store": self.kv_store.to_snapshot(),
             "speed_monitor": self.speed_monitor.to_snapshot(),
+            "health": self.health.to_snapshot(),
+            "remediation": self.remediation.to_snapshot(),
         }
 
     def _maybe_warm_restart(self) -> bool:
@@ -315,6 +353,10 @@ class JobMaster:
             self.speed_monitor.restore_snapshot(
                 state.get("speed_monitor", {})
             )
+            self.health.restore_snapshot(state.get("health", {}))
+            self.remediation.restore_snapshot(
+                state.get("remediation", {})
+            )
         except Exception:  # noqa: BLE001 — a corrupt-but-parseable
             # snapshot must degrade to a cold start, not a crash loop
             logger.exception(
@@ -332,6 +374,8 @@ class JobMaster:
             self.task_manager.reset()
             self.kv_store.restore_snapshot({})
             self.speed_monitor.restore_snapshot({})
+            self.health.restore_snapshot({})
+            self.remediation.restore_snapshot({})
             return False
         age_s = max(time.time() - float(doc.get("saved_at", 0.0)), 0.0)
         alive = len(self.job_manager.alive_nodes())
@@ -390,6 +434,7 @@ class JobMaster:
         if self.state_journal is not None:
             self.state_journal.start()
         self.health.start()
+        self.remediation.start()
         if self._metrics_port is not None:
             from dlrover_tpu.obs.exposition import MetricsHTTPServer
 
@@ -462,6 +507,7 @@ class JobMaster:
         if self.ps_auto_scaler is not None:
             self.ps_auto_scaler.stop()
         self.ps_manager.stop_liveness_monitor()
+        self.remediation.stop()
         self.health.stop()
         self.task_manager.stop()
         self.job_manager.stop()

@@ -74,6 +74,13 @@ class FakeClusterClient(ClusterClient):
             self.create_errors -= 1
             raise RuntimeError("simulated pod create failure")
         name = spec["name"]
+        existing = self.pods.get(name)
+        if existing is not None and existing.get("phase") == "Running":
+            # Replayed plan (retried scale RPC, duplicate ScalePlan):
+            # the pod is already there — the real apiserver answers
+            # 409 AlreadyExists; emitting a second ADDED event here
+            # would double-register the node with the job manager.
+            return
         pod = dict(spec, phase="Running")
         self.pods[name] = pod
         self.events.put({"type": "ADDED", "pod": copy.deepcopy(pod)})

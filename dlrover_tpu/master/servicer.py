@@ -40,6 +40,12 @@ _FORENSICS_TOTAL = obs.counter(
 MAX_PENDING_ACTIONS = 16
 DIAGNOSTICS_HISTORY = 8
 MAX_STORED_DIGEST = 16384
+# How many consumed push_action dedupe keys the servicer remembers: a
+# replayed remediation push (RPC retry, engine re-fire after a warm
+# restart) carrying a key already consumed is a no-op even after the
+# original action was delivered — a replayed RESTART_TRAINING must
+# not double-bounce a trainer.
+MAX_DEDUPE_KEYS = 512
 
 
 class MasterServicer:
@@ -96,6 +102,17 @@ class MasterServicer:
         # overwritten by a diagnose.)
         self._actions_lock = threading.Lock()
         self._pending_actions: Dict[int, Deque[str]] = {}
+        # Consumed push dedupe keys (bounded FIFO of remembered keys):
+        # idempotence for remediation pushes per (action, node) —
+        # same contract PROFILE/DIAGNOSE get from the in-queue dedupe,
+        # extended past delivery.
+        self._dedupe_keys: Deque[str] = collections.deque(
+            maxlen=MAX_DEDUPE_KEYS
+        )
+        self._dedupe_key_set: set = set()
+        # Remediation engine (set by the JobMaster); None on a bare
+        # servicer — queries then answer "disabled, no decisions".
+        self.remediation = None
         # Per-node forensics history (DiagnosticsReport digests),
         # bounded so a crash-looping node cannot grow master memory.
         # Locked: report and query arrive on different RPC worker
@@ -132,6 +149,7 @@ class MasterServicer:
         g(msg.MetricsRequest, self._get_metrics)
         g(msg.DiagnosticsQueryRequest, self._query_diagnostics)
         g(msg.HealthQueryRequest, self._query_health)
+        g(msg.RemediationQueryRequest, self._query_remediation)
 
         r(msg.KVStoreSetRequest, self._kv_set)
         r(msg.DatasetShardParams, self._create_dataset)
@@ -158,6 +176,14 @@ class MasterServicer:
     # -- rendezvous ---------------------------------------------------------
 
     def _join_rendezvous(self, req: msg.JoinRendezvousRequest):
+        if self._cordoned_now(req.node_id):
+            # The benched agent raced its CORDON delivery into a
+            # rejoin (mirror of the restart_training TOCTOU in
+            # _heartbeat): admitting it would form a world around a
+            # host about to park its trainer mid-collective. Refuse
+            # the join and re-assert the cordon on its next heartbeat.
+            self.push_action(req.node_id, EventAction.CORDON.value)
+            return msg.JoinRendezvousResponse(round=-1)
         mgr = self._rdzv(req.rdzv_name)
         round_ = mgr.join(req.node_rank, req.local_world_size)
         return msg.JoinRendezvousResponse(round=round_)
@@ -356,13 +382,40 @@ class MasterServicer:
         action = EventAction.NONE.value
         with self._actions_lock:
             queue = self._pending_actions.get(req.node_id)
-            if queue:
+            while queue:
                 action = queue.popleft()
-                if not queue:
-                    del self._pending_actions[req.node_id]
+                if (
+                    action == EventAction.RESTART_TRAINING.value
+                    and self._cordoned_now(req.node_id)
+                ):
+                    # A restart that RACED the cordon (the peer
+                    # broadcast snapshots the worker list before the
+                    # remediation thread flips the flag): the agent
+                    # overloads RESTART_TRAINING as un-cordon, so
+                    # delivering it would silently put the benched
+                    # host back into the world. Re-checking at
+                    # delivery time closes the TOCTOU; the rollback
+                    # path clears the flag BEFORE pushing its un-park
+                    # restart, so a legitimate un-cordon is never
+                    # dropped here.
+                    logger.warning(
+                        "dropping stale restart_training for "
+                        "cordoned node %d", req.node_id,
+                    )
+                    action = EventAction.NONE.value
+                    continue
+                break
+            if queue is not None and not queue:
+                self._pending_actions.pop(req.node_id, None)
         return msg.HeartbeatResponse(action=action)
 
-    def push_action(self, node_id: int, action: str) -> None:
+    def _cordoned_now(self, node_id: int) -> bool:
+        node = self.job_manager.get_node(node_id)
+        return node is not None and getattr(node, "cordoned", False)
+
+    def push_action(
+        self, node_id: int, action: str, dedupe_key: Optional[str] = None
+    ) -> bool:
         """Queue an action for the node's next heartbeats (FIFO, one
         per heartbeat). Control actions are idempotent, so an action
         already queued is not queued again (two node deaths in one
@@ -370,13 +423,28 @@ class MasterServicer:
         as the old last-write-wins dict behaved — without it being
         able to silently swallow a DIFFERENT action). Bounded: when a
         node stops heartbeating, the oldest action is dropped (with a
-        warning) rather than growing the queue forever."""
+        warning) rather than growing the queue forever.
+
+        ``dedupe_key``: an idempotency token for pushes that may be
+        REPLAYED (remediation decisions, retried operator RPCs). The
+        first push consumes the key; any later push carrying the same
+        key is a no-op even after the original action was delivered,
+        so a replayed restart_training cannot double-bounce a trainer
+        the way the in-queue dedupe alone could not prevent. Returns
+        True when the action was actually enqueued."""
         with self._actions_lock:
+            if dedupe_key is not None:
+                if dedupe_key in self._dedupe_key_set:
+                    return False
+                if len(self._dedupe_keys) >= MAX_DEDUPE_KEYS:
+                    self._dedupe_key_set.discard(self._dedupe_keys[0])
+                self._dedupe_keys.append(dedupe_key)
+                self._dedupe_key_set.add(dedupe_key)
             queue = self._pending_actions.setdefault(
                 node_id, collections.deque()
             )
             if action in queue:
-                return
+                return False
             if len(queue) >= MAX_PENDING_ACTIONS:
                 dropped = queue.popleft()
                 logger.warning(
@@ -385,6 +453,32 @@ class MasterServicer:
                     node_id, MAX_PENDING_ACTIONS, dropped, action,
                 )
             queue.append(action)
+            return True
+
+    def restart_peers(
+        self,
+        exclude_id: int,
+        dedupe_prefix: Optional[str] = None,
+    ) -> None:
+        """Push RESTART_TRAINING to every alive training peer of a
+        departed/benched node so survivors re-rendezvous instead of
+        blocking on collectives with it. The ONE broadcast loop —
+        master node-death handling and the remediation engine both
+        route here. Cordoned peers are deliberately skipped: their
+        agents overload RESTART_TRAINING as the un-cordon signal, so
+        a broadcast reaching one would silently put the benched host
+        back into the world."""
+        for peer in self.job_manager.alive_workers(include_chief=True):
+            if peer.id != exclude_id:
+                self.push_action(
+                    peer.id,
+                    EventAction.RESTART_TRAINING.value,
+                    dedupe_key=(
+                        f"{dedupe_prefix}:peer{peer.id}"
+                        if dedupe_prefix
+                        else None
+                    ),
+                )
 
     def pending_actions(self, node_id: int) -> list:
         """Undelivered actions for a node (observability/tests)."""
@@ -480,6 +574,17 @@ class MasterServicer:
             history=history,
         )
 
+    def _query_remediation(self, req: msg.RemediationQueryRequest):
+        """The remediation engine's typed read channel: enabled/dry-
+        run mode, cordoned nodes, the decision history with governor
+        audit trails, and whether a probation window is currently
+        failing."""
+        if self.remediation is None:
+            return msg.RemediationQueryResponse(enabled=False)
+        return self.remediation.query_response(
+            node_id=req.node_id, limit=req.limit
+        )
+
     def diagnose_node(self, node_id: int) -> None:
         """Queue an on-demand stack-and-state snapshot on the node
         (operator trigger or the SpeedMonitor's straggler/hang
@@ -510,6 +615,14 @@ class MasterServicer:
         # the speed monitor's step accounting.
         from dlrover_tpu.common.constants import NodeType
 
+        if getattr(node, "cordoned", False):
+            # A restarted agent on a benched host knows nothing of
+            # its cordon (the flag lived in the old agent's memory):
+            # re-assert it — park the fresh trainer, keep the node
+            # out of the rendezvous alive-sets and speed accounting —
+            # until the remediation engine un-cordons or retires it.
+            self.push_action(node.id, EventAction.CORDON.value)
+            return None
         if node.type not in (
             NodeType.EVALUATOR, NodeType.DATA_WORKER
         ):

@@ -170,6 +170,31 @@ class HealthVerdict:
             "resolved": self.resolved,
         }
 
+    @classmethod
+    def from_dict(cls, d: dict) -> "HealthVerdict":
+        """Inverse of :meth:`to_dict` — warm-restart snapshots and
+        tools rebuild verdicts from the JSON shape."""
+        return cls(
+            detector=str(d.get("detector", "")),
+            severity=str(d.get("severity", SEVERITY_INFO)),
+            message=str(d.get("message", "")),
+            node_id=int(d.get("node_id", -1)),
+            host=str(d.get("host", "")),
+            suggested_action=str(d.get("suggested_action", "")),
+            evidence_series=str(d.get("evidence_series", "")),
+            evidence=[
+                (float(p[0]), float(p[1]))
+                for p in d.get("evidence", [])
+                if isinstance(p, (list, tuple)) and len(p) == 2
+            ],
+            metrics={
+                str(k): float(v)
+                for k, v in (d.get("metrics") or {}).items()
+            },
+            timestamp=float(d.get("timestamp", 0.0)),
+            resolved=bool(d.get("resolved", False)),
+        )
+
 
 def _verdict_sort_key(v: HealthVerdict):
     return (-SEVERITIES.index(v.severity), v.detector, v.host, v.node_id)
@@ -232,6 +257,11 @@ class HealthMonitor:
         self._tick_nodes: Optional[Dict[str, int]] = None
         self._stop = threading.Event()
         self._thread: Optional[threading.Thread] = None
+        # Fired after verdict transitions (new/severity-change/
+        # resolution) so the master's state journal can snapshot the
+        # active set + cooldown stamps — a warm restart must not
+        # re-fire a sticky verdict's action.
+        self.on_state_change = None
         self.detectors: List[Callable[[], List[HealthVerdict]]] = [
             self._detect_throughput_degradation,
             self._detect_goodput_slo,
@@ -737,6 +767,13 @@ class HealthMonitor:
             logger.info("health resolved: %s %s", v.detector, v.host)
         self._queue_actions(transitions, now)
         self._persist(transitions + resolved, score, now)
+        if transitions or resolved:
+            cb = self.on_state_change
+            if cb is not None:
+                try:
+                    cb()
+                except Exception:  # noqa: BLE001
+                    pass
         return sorted(
             self._active_list(), key=_verdict_sort_key
         )
@@ -759,10 +796,10 @@ class HealthMonitor:
             ):
                 continue
             key = v.key()
-            last = self._last_action.get(key)
+            last = self.action_stamp(key)
             if last is not None and now - last < cooldown:
                 continue
-            self._last_action[key] = now
+            self.stamp_action(key, now)
             try:
                 self.action_sink(v.node_id, v.suggested_action)
                 obs.event(
@@ -859,6 +896,70 @@ class HealthMonitor:
                     evidence=json.dumps(v.to_dict()["evidence"]),
                     timestamp=v.timestamp or now,
                 )
+
+    # -- shared action-cooldown stamps ------------------------------------
+
+    def action_stamp(
+        self, key: Tuple[str, str, int]
+    ) -> Optional[float]:
+        """Wall stamp of the last action taken for a (detector, host,
+        node_id) subject — shared between the capture path (PROFILE/
+        DIAGNOSE auto-queue) and the remediation engine so the two
+        never hammer the same subject independently."""
+        with self._lock:
+            return self._last_action.get(key)
+
+    def stamp_action(
+        self, key: Tuple[str, str, int], ts: float
+    ) -> None:
+        with self._lock:
+            self._last_action[key] = ts
+
+    # -- warm-restart snapshot ---------------------------------------------
+
+    def to_snapshot(self) -> dict:
+        """JSON-safe recoverable state: the ACTIVE verdict set, the
+        transition history, action-cooldown stamps, and straggler
+        streaks. Without this, a warm restart wipes the active set, so
+        a still-firing (sticky) verdict re-registers as a brand-new
+        transition and re-fires its action immediately — defeating the
+        cooldown every time the master bounces. All stamps are wall
+        clock, so they stay meaningful across processes."""
+        with self._lock:
+            return {
+                "active": [v.to_dict() for v in self._active.values()],
+                "history": [v.to_dict() for v in self._history],
+                "last_action": [
+                    [k[0], k[1], k[2], ts]
+                    for k, ts in self._last_action.items()
+                ],
+                "straggler_ticks": {
+                    str(k): v
+                    for k, v in self._straggler_ticks.items()
+                },
+            }
+
+    def restore_snapshot(self, state: dict) -> None:
+        with self._lock:
+            self._active = {}
+            for d in state.get("active", []):
+                v = HealthVerdict.from_dict(d)
+                self._active[v.key()] = v
+            self._history.clear()
+            for d in state.get("history", []):
+                self._history.append(HealthVerdict.from_dict(d))
+            self._last_action = {
+                (str(det), str(host), int(node_id)): float(ts)
+                for det, host, node_id, ts in state.get(
+                    "last_action", []
+                )
+            }
+            self._straggler_ticks = {
+                int(k): int(v)
+                for k, v in state.get("straggler_ticks", {}).items()
+            }
+            score = self._score_locked()
+        _HEALTH_SCORE.set(score)
 
     # -- read surface ------------------------------------------------------
 

@@ -25,6 +25,13 @@ if "xla_force_host_platform_device_count" not in _flags:
 # the env themselves.
 os.environ.setdefault("DLROVER_TPU_TUNE_CACHE", "0")
 
+# The remediation engine's background thread must never act mid-test
+# on a JobMaster a suite built for something else (its first tick at
+# the default 15 s cadence could cordon a deliberately-degraded drill
+# host and change later assertions). Suites that exercise remediation
+# pass an explicit config (which beats the env) and tick manually.
+os.environ.setdefault("DLROVER_TPU_REMEDIATION_INTERVAL_S", "9999")
+
 import jax  # noqa: E402
 
 jax.config.update("jax_platforms", "cpu")

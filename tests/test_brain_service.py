@@ -104,6 +104,43 @@ class TestRemoteParity:
         assert verdicts[0]["node_id"] == 3
         assert verdicts[0]["action"] == "profile"
 
+    def test_remediation_decisions_persist_over_rpc(self, remote):
+        """The remediation engine's audit channel round-trips through
+        the standalone brain's ``remediation`` persist kind."""
+        client, server = remote
+        client.persist_remediation_decision(
+            job_name="j1",
+            decision_id=7,
+            detector="throughput_degradation",
+            node_id=1,
+            host="h1",
+            action="cordon_replace",
+            outcome="acted",
+            dry_run=0,
+            governors='{"hysteresis": "ok", "cooldown": "ok"}',
+            message="host h1 2.5x baseline",
+            timestamp=1000.0,
+        )
+        client.persist_remediation_decision(
+            job_name="j1",
+            decision_id=7,
+            detector="throughput_degradation",
+            node_id=1,
+            host="h1",
+            action="cordon_replace",
+            outcome="recovered",
+            dry_run=0,
+            governors="{}",
+            message="host h1 2.5x baseline",
+            timestamp=1200.0,
+        )
+        rows = server.brain.recent_remediation_decisions("j1")
+        assert [r["outcome"] for r in rows] == ["recovered", "acted"]
+        assert rows[1]["governors"]["hysteresis"] == "ok"
+        assert rows[0]["decision_id"] == 7
+        assert rows[0]["host"] == "h1"
+        assert not rows[0]["dry_run"]
+
     def test_unknown_algorithm_raises_remotely(self, remote):
         client, _ = remote
         with pytest.raises(RuntimeError, match="failed"):

@@ -375,6 +375,49 @@ class TestDiagnosticsChannel:
             == "none"
         )
 
+    def test_replayed_push_with_dedupe_key_is_idempotent(self):
+        """A replayed remediation push (RPC retry, engine re-fire)
+        carrying the same dedupe key must be a no-op even AFTER the
+        original action was delivered — the in-queue dedupe alone
+        cannot stop a replayed restart_training from double-bouncing
+        a trainer."""
+        servicer = self._servicer()
+        assert servicer.push_action(
+            7, EventAction.RESTART_TRAINING.value, dedupe_key="rem:1"
+        )
+        # Replay while still queued: absorbed.
+        assert not servicer.push_action(
+            7, EventAction.RESTART_TRAINING.value, dedupe_key="rem:1"
+        )
+        assert (
+            servicer._heartbeat(msg.HeartbeatRequest(node_id=7)).action
+            == "restart_training"
+        )
+        # Replay AFTER delivery: the key was consumed — no second
+        # bounce.
+        assert not servicer.push_action(
+            7, EventAction.RESTART_TRAINING.value, dedupe_key="rem:1"
+        )
+        assert (
+            servicer._heartbeat(msg.HeartbeatRequest(node_id=7)).action
+            == "none"
+        )
+        # A genuinely new decision (fresh key) still delivers, and
+        # ordering with other actions stays FIFO.
+        assert servicer.push_action(
+            7, EventAction.RESTART_TRAINING.value, dedupe_key="rem:2"
+        )
+        servicer.diagnose_node(7)
+        beats = [
+            servicer._heartbeat(msg.HeartbeatRequest(node_id=7)).action
+            for _ in range(3)
+        ]
+        assert beats == ["restart_training", "diagnose", "none"]
+        # Keyless pushes keep the legacy semantics (in-queue dedupe
+        # only).
+        assert servicer.push_action(7, EventAction.PROFILE.value)
+        assert servicer.push_action(7, EventAction.PROFILE.value) is False
+
     def test_pending_actions_bounded_drops_oldest(self):
         servicer = self._servicer()
         for i in range(MAX_PENDING_ACTIONS + 3):

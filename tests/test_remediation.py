@@ -1,0 +1,1158 @@
+"""Self-healing control plane: verdict-driven remediation with
+safety governors.
+
+Covers the acceptance criteria of the remediation PR:
+
+* every governor (hysteresis, shared decorrelated cooldown, blast
+  radius, min-nodes floor, probation recovery/rollback/escalation,
+  dry-run) unit-tested hermetically with fake clocks;
+* verdict/cooldown/remediation state journals into master state
+  snapshots — a warm restart neither re-fires a sticky verdict's
+  action nor forgets an in-flight cordon;
+* the hermetic acceptance drill against a REAL in-process master: an
+  injected degrading host is cordoned and replaced via a ScalePlan,
+  goodput recovers, the flapping control host draws ZERO scale
+  actions, and every decision is queryable via RPC, ``obs_report``,
+  brain rows, and ``dlrover_remediation_*`` metrics; with dry_run the
+  same drill records decisions but mutates nothing.
+"""
+
+import json
+import os
+import subprocess
+import sys
+import time
+import urllib.request
+
+import pytest
+
+from dlrover_tpu.common import messages as msg
+from dlrover_tpu.common.comm import RpcClient
+from dlrover_tpu.common.constants import (
+    EventAction,
+    NodeStatus,
+    NodeType,
+)
+from dlrover_tpu.master.job_manager import JobManager, Scaler
+from dlrover_tpu.master.master import JobMaster
+from dlrover_tpu.master.remediation import (
+    ACTION_CORDON_REPLACE,
+    ACTION_RESTART_TRAINING,
+    ACTION_SHRINK,
+    OUTCOME_ACTED,
+    OUTCOME_BLOCKED,
+    OUTCOME_DRY_RUN,
+    OUTCOME_ESCALATED,
+    OUTCOME_FAILED,
+    OUTCOME_RECOVERED,
+    OUTCOME_ROLLED_BACK,
+    RemediationDecision,
+    RemediationEngine,
+    render_remediation,
+)
+from dlrover_tpu.obs.health import (
+    SEVERITY_CRITICAL,
+    HealthMonitor,
+    HealthVerdict,
+)
+from dlrover_tpu.obs.timeseries import TimeSeriesStore
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+class FakeClock:
+    def __init__(self, t=1000.0):
+        self.t = t
+
+    def __call__(self):
+        return self.t
+
+
+class FakeHealth:
+    """The engine's contract with the health plane: active verdicts +
+    the shared (detector, host, node) action-stamp map."""
+
+    def __init__(self):
+        self.verdicts = []
+        self.stamps = {}
+
+    def active_verdicts(self):
+        return list(self.verdicts)
+
+    def action_stamp(self, key):
+        return self.stamps.get(key)
+
+    def stamp_action(self, key, ts):
+        self.stamps[key] = ts
+
+
+class FakeServicer:
+    def __init__(self):
+        self.pushed = []
+        self.peer_restarts = []
+
+    def push_action(self, node_id, action, dedupe_key=None):
+        self.pushed.append((node_id, action))
+        return True
+
+    def restart_peers(self, exclude_id, dedupe_prefix=None):
+        self.peer_restarts.append(exclude_id)
+
+
+class FakeSpeedMonitor:
+    def __init__(self):
+        self.running = set()
+
+    def add_running_node(self, node_id):
+        self.running.add(node_id)
+
+    def remove_running_node(self, node_id):
+        self.running.discard(node_id)
+
+
+def verdict(
+    detector="throughput_degradation",
+    host="h1",
+    node_id=1,
+    severity=SEVERITY_CRITICAL,
+    baseline=0.1,
+):
+    return HealthVerdict(
+        detector=detector,
+        severity=severity,
+        message=f"host {host} degraded",
+        host=host,
+        node_id=node_id,
+        metrics={"baseline_mean_s": baseline},
+    )
+
+
+ENGINE_CONFIG = {
+    "interval_s": 9999.0,
+    "hysteresis_ticks": 2.0,
+    "recovery_ticks": 2.0,
+    "cooldown_s": 100.0,
+    "cooldown_jitter": 0.0,  # deterministic governor tests
+    "blast_window_s": 600.0,
+    "blast_max_actions": 1.0,
+    "probation_s": 300.0,
+    "recover_ratio": 1.25,
+}
+
+
+def make_engine(clk, workers=3, min_nodes=1, **overrides):
+    jm = JobManager(scaler=Scaler())
+    speed = FakeSpeedMonitor()
+    for i in range(workers):
+        jm.register_node(node_id=i, addr=f"h{i}")
+        speed.add_running_node(i)
+    health = FakeHealth()
+    servicer = FakeServicer()
+    config = dict(ENGINE_CONFIG)
+    config.update(overrides)
+    engine = RemediationEngine(
+        health=health,
+        job_manager=jm,
+        servicer=servicer,
+        speed_monitor=speed,
+        min_nodes=min_nodes,
+        clock=clk,
+        config=config,
+    )
+    return engine, health, servicer, jm
+
+
+class TestGovernors:
+    def setup_method(self):
+        self.clk = FakeClock(1000.0)
+
+    def test_hysteresis_requires_consecutive_sick_ticks(self):
+        engine, health, servicer, jm = make_engine(self.clk)
+        health.verdicts = [verdict()]
+        assert engine.tick_once() == []  # tick 1: warming up
+        out = engine.tick_once()  # tick 2: acts
+        assert [(d.action, d.outcome) for d in out] == [
+            (ACTION_CORDON_REPLACE, OUTCOME_ACTED)
+        ]
+        assert jm.get_node(1).cordoned
+
+    def test_flapping_subject_is_damped(self):
+        """A verdict that resolves between ticks resets the sick
+        streak: six flapping ticks, ZERO actions."""
+        engine, health, servicer, jm = make_engine(self.clk)
+        for _ in range(3):
+            health.verdicts = [verdict()]
+            assert engine.tick_once() == []
+            health.verdicts = []  # resolved — streak resets
+            assert engine.tick_once() == []
+        assert engine.decisions() == []
+        assert not jm.get_node(1).cordoned
+        assert servicer.pushed == []
+        assert jm.scaler.executed_plans == []
+
+    def test_cooldown_shared_with_health_action_stamps(self):
+        """A PROFILE the health plane queued 50s ago for the same
+        subject blocks remediation (one shared stamp map); past the
+        cooldown the action proceeds."""
+        engine, health, servicer, jm = make_engine(self.clk)
+        v = verdict()
+        health.stamps[v.key()] = 950.0  # the PR-8 capture path acted
+        health.verdicts = [v]
+        engine.tick_once()
+        out = engine.tick_once()
+        assert [d.outcome for d in out] == [OUTCOME_BLOCKED]
+        assert "cooldown" in out[0].governors
+        assert out[0].governors["cooldown"].startswith("blocked")
+        assert not jm.get_node(1).cordoned
+        # Only ONE blocked record while the situation is unchanged.
+        assert engine.tick_once() == []
+        # Past the cooldown: acts, and stamps the shared map back.
+        self.clk.t = 1100.0
+        out = engine.tick_once()
+        assert [d.outcome for d in out] == [OUTCOME_ACTED]
+        assert health.stamps[v.key()] == 1100.0
+
+    def test_blast_radius_caps_actions_per_window(self):
+        """Two hosts sick together: ONE action per window; the second
+        is vetoed and acts only after the window slides. Probation is
+        kept longer than the blast window so the first cordon is still
+        in flight when the second host's turn comes."""
+        engine, health, servicer, jm = make_engine(
+            self.clk, probation_s=10000.0
+        )
+        health.verdicts = [
+            verdict(host="h1", node_id=1),
+            verdict(host="h2", node_id=2),
+        ]
+        engine.tick_once()
+        out = engine.tick_once()
+        outcomes = {d.node_id: d.outcome for d in out}
+        assert sorted(outcomes.values()) == [
+            OUTCOME_ACTED, OUTCOME_BLOCKED
+        ]
+        blocked = next(
+            d for d in out if d.outcome == OUTCOME_BLOCKED
+        )
+        assert blocked.governors["blast_radius"].startswith("blocked")
+        assert len(engine.cordoned_nodes()) == 1
+        # Window slides -> the second host's turn (its own probation
+        # subject is free, cooldown untouched).
+        self.clk.t = 1000.0 + 601.0
+        out = engine.tick_once()
+        acted = [d for d in out if d.outcome == OUTCOME_ACTED]
+        assert len(acted) == 1
+        assert len(engine.cordoned_nodes()) == 2
+
+    def test_min_nodes_floor_blocks_cordon_and_shrink(self):
+        engine, health, servicer, jm = make_engine(
+            self.clk, workers=3, min_nodes=3
+        )
+        health.verdicts = [verdict()]
+        engine.tick_once()
+        out = engine.tick_once()
+        assert [d.outcome for d in out] == [OUTCOME_BLOCKED]
+        assert out[0].governors["min_nodes"].startswith("blocked")
+        assert not jm.get_node(1).cordoned
+        assert jm.scaler.executed_plans == []
+
+    def test_restart_action_and_probation_recovery(self):
+        engine, health, servicer, jm = make_engine(self.clk)
+        health.verdicts = [
+            verdict(detector="recompile_storm", host="h2", node_id=2)
+        ]
+        engine.tick_once()
+        (d,) = engine.tick_once()
+        assert (d.action, d.outcome) == (
+            ACTION_RESTART_TRAINING, OUTCOME_ACTED
+        )
+        assert servicer.pushed == [(2, "restart_training")]
+        # Verdict resolves; after recovery_ticks healthy ticks the
+        # probation finalizes as recovered.
+        health.verdicts = []
+        engine.tick_once()
+        engine.tick_once()
+        assert engine.decisions()[-1].outcome == OUTCOME_RECOVERED
+        assert not engine.probation_failing()
+
+    def test_probation_failure_escalates_restart_to_cordon(self):
+        engine, health, servicer, jm = make_engine(self.clk)
+        health.verdicts = [
+            verdict(detector="rss_growth", host="h2", node_id=2)
+        ]
+        engine.tick_once()
+        (d,) = engine.tick_once()
+        assert d.action == ACTION_RESTART_TRAINING
+        # Still sick at the probation deadline -> escalate.
+        self.clk.t = 1000.0 + 301.0
+        out = engine.tick_once()
+        assert d.outcome == OUTCOME_ESCALATED
+        # The escalated rung acts as cordon_replace once the blast
+        # window slides past the original restart (cooldown already
+        # passed; hysteresis is already satisfied).
+        self.clk.t = 1000.0 + 601.0
+        out = engine.tick_once()
+        assert [(x.action, x.outcome) for x in out] == [
+            (ACTION_CORDON_REPLACE, OUTCOME_ACTED)
+        ]
+        assert jm.get_node(2).cordoned
+
+    def test_probation_failure_rolls_back_cordon_then_shrinks(self):
+        """The reversibility contract: a cordon-replace that did not
+        restore health is rolled back (un-cordon, replacement
+        retired), and the next conviction — the host sick past budget
+        — shrinks the world instead."""
+        engine, health, servicer, jm = make_engine(self.clk, workers=4)
+        health.verdicts = [verdict()]
+        engine.tick_once()
+        (d,) = engine.tick_once()
+        assert d.action == ACTION_CORDON_REPLACE
+        repl_id = d.replacement_id
+        assert repl_id >= 0
+        assert jm.get_node(repl_id).status == NodeStatus.PENDING
+        # Probation deadline passes with the verdict still active.
+        self.clk.t = 1000.0 + 301.0
+        engine.tick_once()
+        assert d.outcome == OUTCOME_ROLLED_BACK
+        assert not jm.get_node(1).cordoned  # un-cordoned
+        assert jm.get_node(repl_id).status == NodeStatus.DELETED
+        assert (1, "restart_training") in servicer.pushed  # rejoins
+        assert engine.cordoned_nodes() == []
+        # Still sick past the cooldown: the ladder says SHRINK — the
+        # node is retired with NO replacement.
+        self.clk.t = 1000.0 + 700.0
+        out = engine.tick_once()
+        shrinks = [x for x in out if x.action == ACTION_SHRINK]
+        assert [x.outcome for x in shrinks] == [OUTCOME_ACTED]
+        assert jm.get_node(1).status == NodeStatus.DELETED
+        plans = jm.scaler.executed_plans
+        assert plans[-1].remove_nodes[0].id == 1
+        assert plans[-1].launch_nodes == []
+
+    def test_shrink_probation_failure_goes_alert_only(self):
+        engine, health, servicer, jm = make_engine(self.clk, workers=4)
+        health.verdicts = [verdict()]
+        # Walk the ladder to shrink quickly.
+        engine._ladder[("h1", 1)] = 2
+        engine.tick_once()
+        (d,) = engine.tick_once()
+        assert d.action == ACTION_SHRINK
+        self.clk.t = 1000.0 + 301.0
+        engine.tick_once()
+        assert d.outcome == OUTCOME_ESCALATED
+        assert engine.probation_failing()  # still convicted, no help
+        # Alert-only: no further decisions, ever.
+        self.clk.t = 1000.0 + 2000.0
+        assert engine.tick_once() == []
+
+    def test_dry_run_records_but_mutates_nothing(self):
+        engine, health, servicer, jm = make_engine(
+            self.clk, dry_run=1.0
+        )
+        health.verdicts = [verdict()]
+        engine.tick_once()
+        out = engine.tick_once()
+        assert [d.outcome for d in out] == [OUTCOME_DRY_RUN]
+        assert out[0].dry_run
+        # One record per episode, not one per tick.
+        assert engine.tick_once() == []
+        # Nothing mutated: no cordon, no plans, no pushes, no shared
+        # cooldown stamp (the real capture path must stay free to
+        # act), no probation.
+        assert not jm.get_node(1).cordoned
+        assert jm.scaler.executed_plans == []
+        assert servicer.pushed == []
+        assert health.stamps == {}
+        assert engine.cordoned_nodes() == []
+        assert not engine.probation_failing()
+
+    def test_disabled_engine_never_acts(self):
+        engine, health, servicer, jm = make_engine(
+            self.clk, enabled=0.0
+        )
+        health.verdicts = [verdict()]
+        for _ in range(4):
+            assert engine.tick_once() == []
+        assert servicer.pushed == []
+
+    def test_unmapped_detectors_stay_alert_only(self):
+        engine, health, servicer, jm = make_engine(self.clk)
+        health.verdicts = [
+            verdict(detector="goodput_slo", host="", node_id=-1),
+            verdict(detector="heartbeat_gap", host="", node_id=2),
+        ]
+        for _ in range(4):
+            assert engine.tick_once() == []
+        assert servicer.pushed == []
+
+    def test_decision_roundtrip_and_render(self):
+        d = RemediationDecision(
+            decision_id=3,
+            detector="throughput_degradation",
+            severity="critical",
+            node_id=1,
+            host="h1",
+            action=ACTION_CORDON_REPLACE,
+            trigger="host h1 degraded",
+            governors={"hysteresis": "ok"},
+            outcome=OUTCOME_ACTED,
+            timestamp=12.5,
+            probation_deadline=312.5,
+        )
+        assert RemediationDecision.from_dict(d.to_dict()) == d
+        rendered = render_remediation(
+            {
+                "enabled": True,
+                "dry_run": False,
+                "cordoned": [1],
+                "decisions": [d.to_dict()],
+            }
+        )
+        assert "cordon_replace" in rendered
+        assert "governors ok" in rendered
+
+    def test_cooldown_jitter_is_stable_per_subject(self):
+        """The decorrelating jitter is one deterministic draw per
+        subject — NOT re-rolled every tick (the min of repeated
+        uniforms walks to zero, collapsing the spread back into
+        lockstep at ~cooldown_s)."""
+        engine, health, servicer, jm = make_engine(
+            self.clk, cooldown_jitter=1.0
+        )
+        k1 = ("throughput_degradation", "h1", 1)
+        k2 = ("throughput_degradation", "h2", 2)
+        c1 = engine._cooldown_for(k1)
+        assert engine._cooldown_for(k1) == c1  # stable across ticks
+        assert engine._cooldown_for(k2) != c1  # spread apart
+        assert 100.0 <= c1 <= 200.0  # cooldown_s * (1 + jitter)
+
+    def test_cordon_purges_speed_monitor_and_rollback_restores(self):
+        """The benched host's frozen step-time EWMA must leave the
+        straggler accounting at cordon time (a stale slow window
+        would pin its verdict and guarantee a wrong rollback), and a
+        rollback puts the host back into step accounting."""
+        engine, health, servicer, jm = make_engine(self.clk)
+        health.verdicts = [verdict()]
+        engine.tick_once()
+        (d,) = engine.tick_once()
+        assert d.outcome == OUTCOME_ACTED
+        assert 1 not in engine.speed_monitor.running
+        # Peers re-rendezvous via the ONE shared broadcast helper.
+        assert servicer.peer_restarts == [1]
+        self.clk.t = 1000.0 + 301.0
+        engine.tick_once()  # probation fails -> rollback
+        assert d.outcome == OUTCOME_ROLLED_BACK
+        assert 1 in engine.speed_monitor.running
+
+    def test_failed_replacement_launch_still_owns_the_cordon(self):
+        """A replacement launch that raises must NOT strand the node:
+        the engine still records the cordon and runs probation, so
+        the benched pod is eventually rolled back (or retired), never
+        parked forever outside every bookkeeping structure."""
+        engine, health, servicer, jm = make_engine(self.clk)
+
+        def boom(node, reason=""):
+            raise RuntimeError("pod create failed")
+
+        jm.launch_replacement = boom
+        health.verdicts = [verdict()]
+        engine.tick_once()
+        (d,) = engine.tick_once()
+        assert d.outcome == OUTCOME_ACTED
+        assert d.replacement_id == -1
+        assert engine.cordoned_nodes() == [1]
+        assert jm.get_node(1).cordoned
+        # Probation still governs it: the failed replace rolls back.
+        self.clk.t = 1000.0 + 301.0
+        engine.tick_once()
+        assert d.outcome == OUTCOME_ROLLED_BACK
+        assert not jm.get_node(1).cordoned
+        assert engine.cordoned_nodes() == []
+
+    def test_missing_replacement_blocks_recovery(self):
+        """The cordon purged the sick host's telemetry, so its
+        verdict resolves and the shrunken fleet reads healthy — but
+        with NO replacement alive, probation must fail and roll the
+        cordon back (restoring capacity), never declare RECOVERED and
+        retire the pod, leaving the job permanently a worker short."""
+        engine, health, servicer, jm = make_engine(self.clk)
+        jm.launch_replacement = lambda node, reason="": None
+        health.verdicts = [verdict()]
+        engine.tick_once()
+        (d,) = engine.tick_once()
+        assert d.replacement_id == -1
+        health.verdicts = []  # resolved via the telemetry purge
+        for _ in range(3):
+            engine.tick_once()
+        assert d.outcome != OUTCOME_RECOVERED
+        self.clk.t = 1000.0 + 301.0
+        engine.tick_once()
+        assert d.outcome == OUTCOME_ROLLED_BACK
+        assert not jm.get_node(1).cordoned
+        assert jm.get_node(1).is_alive()  # NOT retired
+
+    def test_pending_replacement_blocks_recovery(self):
+        """An unschedulable replacement (stuck PENDING, never
+        registers) must hold probation open — recovery only counts
+        once the replacement is actually RUNNING."""
+        engine, health, servicer, jm = make_engine(self.clk)
+        health.verdicts = [verdict()]
+        engine.tick_once()
+        (d,) = engine.tick_once()
+        repl_id = d.replacement_id
+        assert jm.get_node(repl_id).status == NodeStatus.PENDING
+        health.verdicts = []  # resolved via the telemetry purge
+        for _ in range(3):
+            engine.tick_once()
+        assert d.outcome != OUTCOME_RECOVERED
+        # The replacement's agent registers (-> RUNNING): now the
+        # healthy ticks count.
+        jm.register_node(node_id=repl_id, addr="h1b")
+        engine.tick_once()
+        engine.tick_once()
+        assert d.outcome == OUTCOME_RECOVERED
+
+    def test_failed_action_backs_off_on_shared_cooldown(self):
+        """A persistently-failing action (cluster API down) must not
+        re-fire — and re-record a decision + brain row + metric —
+        every tick: the failure stamps the shared cooldown, the next
+        tick records ONE blocked decision, then the episode dedupes
+        until the cooldown passes."""
+        engine, health, servicer, jm = make_engine(self.clk)
+
+        def boom(node_id):
+            raise RuntimeError("cluster api down")
+
+        jm.retire_node = boom
+        engine._ladder[("h1", 1)] = 2  # sick past budget -> shrink
+        health.verdicts = [verdict()]
+        engine.tick_once()
+        (d,) = engine.tick_once()
+        assert d.outcome == OUTCOME_FAILED
+        assert health.stamps  # shared cooldown stamped
+        out = engine.tick_once()
+        assert [x.outcome for x in out] == [OUTCOME_BLOCKED]
+        assert out[0].governors["cooldown"].startswith("blocked")
+        assert engine.tick_once() == []  # episode deduped
+
+
+class TestCordonIntegrity:
+    """The cordon must survive the two paths that historically undid
+    it: the node-death peer-restart broadcast (RESTART_TRAINING
+    doubles as un-cordon on the agent) and a benched agent's own
+    restart re-registering from scratch."""
+
+    def _servicer(self):
+        from dlrover_tpu.master.rendezvous import (
+            ElasticRendezvous,
+            NetworkCheckRendezvous,
+        )
+        from dlrover_tpu.master.servicer import MasterServicer
+        from dlrover_tpu.master.task_manager import TaskManager
+
+        jm = JobManager(scaler=Scaler())
+        servicer = MasterServicer(
+            job_manager=jm,
+            task_manager=TaskManager(),
+            elastic_rdzv=ElasticRendezvous(),
+            check_rdzv=NetworkCheckRendezvous(),
+        )
+        for i in range(3):
+            servicer._register_node(
+                msg.NodeAddressRequest(node_id=i, node_ip=f"h{i}")
+            )
+        return servicer, jm
+
+    def test_restart_peers_skips_cordoned_nodes(self):
+        servicer, jm = self._servicer()
+        jm.cordon_node(1, reason="test")
+        servicer.restart_peers(0)
+        restarted = {
+            n for n in (0, 1, 2)
+            if EventAction.RESTART_TRAINING.value
+            in servicer.pending_actions(n)
+        }
+        assert restarted == {2}  # not the dead node, NOT the benched
+
+    def test_reregistering_cordoned_node_reasserts_cordon(self):
+        servicer, jm = self._servicer()
+        jm.cordon_node(1, reason="test")
+        for mgr in servicer.rdzv_managers.values():
+            mgr.remove_alive_node(1, node_rank=1)
+        servicer.speed_monitor.remove_running_node(1)
+        # The benched agent crashes and its supervisor restarts it:
+        # the fresh agent re-registers knowing nothing of the cordon.
+        servicer._register_node(
+            msg.NodeAddressRequest(node_id=1, node_ip="h1")
+        )
+        assert servicer.pending_actions(1) == [
+            EventAction.CORDON.value
+        ]
+        for mgr in servicer.rdzv_managers.values():
+            assert 1 not in mgr._alive_nodes
+        assert jm.get_node(1).cordoned
+
+    def test_terminal_reincarnation_keeps_cordon(self):
+        """An agent gone past the heartbeat timeout goes TERMINAL;
+        its re-register builds a fresh Node incarnation — which must
+        keep the cordon (only the remediation engine un-cordons), or
+        the benched host rejoins the world next to its replacement."""
+        servicer, jm = self._servicer()
+        jm.cordon_node(1, reason="test")
+        # The engine's cordon flow already pulled it from rendezvous.
+        for mgr in servicer.rdzv_managers.values():
+            mgr.remove_alive_node(1, node_rank=1)
+        jm.retire_node(1)  # terminal incarnation, cordon still owned
+        servicer._register_node(
+            msg.NodeAddressRequest(node_id=1, node_ip="h1")
+        )
+        node = jm.get_node(1)
+        assert node.is_alive() and node.cordoned
+        assert servicer.pending_actions(1) == [
+            EventAction.CORDON.value
+        ]
+        for mgr in servicer.rdzv_managers.values():
+            assert 1 not in mgr._alive_nodes
+
+    def test_heartbeat_drops_restart_that_raced_the_cordon(self):
+        """The peer broadcast snapshots the worker list before the
+        remediation thread flips the cordon flag (TOCTOU): a stale
+        RESTART_TRAINING already queued when the cordon lands must be
+        dropped at delivery — the agent overloads it as un-cordon."""
+        servicer, jm = self._servicer()
+        servicer.push_action(
+            1, EventAction.RESTART_TRAINING.value
+        )  # broadcast won the race
+        jm.cordon_node(1, reason="test")
+        servicer.push_action(1, EventAction.CORDON.value)
+        resp = servicer._heartbeat(msg.HeartbeatRequest(node_id=1))
+        assert resp.action == EventAction.CORDON.value  # restart gone
+        # The rollback's legitimate un-park clears the flag FIRST,
+        # so its restart is delivered.
+        jm.uncordon_node(1)
+        servicer.push_action(1, EventAction.RESTART_TRAINING.value)
+        resp = servicer._heartbeat(msg.HeartbeatRequest(node_id=1))
+        assert resp.action == EventAction.RESTART_TRAINING.value
+
+    def test_probation_survives_history_eviction(self):
+        """A mass-degradation storm can push the acted decision out
+        of the bounded history ring while its probation is open: the
+        journal carries probations FULLY, so a warm restore must not
+        drop one — stranding the cordoned node forever."""
+        clk = FakeClock(1000.0)
+        engine, health, servicer, jm = make_engine(clk, history=4.0)
+        health.verdicts = [verdict()]
+        engine.tick_once()
+        (d,) = engine.tick_once()
+        assert d.outcome == OUTCOME_ACTED
+        # Storm: the ring (4) evicts the acted decision.
+        for i in range(6):
+            engine._record(
+                RemediationDecision(
+                    decision_id=100 + i, detector="x", severity="c",
+                    node_id=9, host="hx", action=ACTION_SHRINK,
+                    trigger="storm", outcome=OUTCOME_BLOCKED,
+                )
+            )
+        assert d.decision_id not in {
+            x.decision_id for x in engine.decisions()
+        }
+        snap = engine.to_snapshot()
+
+        engine2, health2, servicer2, jm2 = make_engine(clk, history=4.0)
+        engine2.restore_snapshot(snap)
+        jm2.register_node(node_id=d.replacement_id, addr="h1b")
+        assert engine2.cordoned_nodes() == [1]
+        # The probation is live: it still finalizes (here: recovery
+        # retires the benched pod; failure would roll it back).
+        health2.verdicts = []
+        engine2.tick_once()
+        engine2.tick_once()
+        assert not engine2.cordoned_nodes()
+
+    def test_join_rendezvous_refused_while_cordoned(self):
+        """A benched agent that raced its CORDON delivery into a
+        rejoin must be refused — admitting it would form a world
+        around a host about to park its trainer mid-collective."""
+        servicer, jm = self._servicer()
+        jm.cordon_node(1, reason="test")
+        resp = servicer._join_rendezvous(
+            msg.JoinRendezvousRequest(node_id=1, node_rank=1)
+        )
+        assert resp.round == -1
+        assert EventAction.CORDON.value in servicer.pending_actions(1)
+
+    def test_adopt_node_advances_id_allocator(self):
+        """launch_replacement must never mint an id colliding with an
+        in-flight auto-scaler node tracked via adopt_node."""
+        from dlrover_tpu.common.node import Node
+
+        jm = JobManager(scaler=Scaler())
+        for i in range(2):
+            jm.register_node(node_id=i, addr=f"h{i}")
+        jm.adopt_node(Node(type=NodeType.WORKER, id=2, rank=2))
+        repl = jm.launch_replacement(jm.get_node(1), reason="test")
+        assert repl.id == 3
+
+    def test_relaunch_keeps_cordon(self):
+        """A benched host whose pod is preempted mid-probation is
+        relaunched by the failure path — the fresh incarnation must
+        come back benched, not rejoin next to its replacement."""
+        jm = JobManager(scaler=Scaler())
+        for i in range(3):
+            jm.register_node(node_id=i, addr=f"h{i}")
+        jm.cordon_node(1, reason="test")
+        jm.handle_node_gone(1, reason="preempted")
+        assert jm.get_node(1).cordoned
+
+    def test_replacement_inherits_criticality(self):
+        jm = JobManager(scaler=Scaler())
+        for i in range(3):
+            jm.register_node(node_id=i, addr=f"h{i}")
+        node = jm.get_node(1)
+        node.critical = True
+        repl = jm.launch_replacement(node, reason="test")
+        assert repl.critical
+
+    def test_rollback_keeps_replacement_when_node_died(self):
+        """Rolling back a cordon whose benched pod already DIED must
+        keep the live replacement — it IS the capacity now; retiring
+        it too would leave the world a worker short forever."""
+        clk = FakeClock(1000.0)
+        engine, health, servicer, jm = make_engine(clk, workers=4)
+        health.verdicts = [verdict()]
+        engine.tick_once()
+        (d,) = engine.tick_once()
+        repl_id = d.replacement_id
+        assert repl_id >= 0
+        # The benched pod dies during probation (budget exhausted —
+        # it stays terminal).
+        node = jm.get_node(1)
+        node.relaunchable = False
+        node.update_status(NodeStatus.FAILED)
+        # Probation fails with the verdict still active.
+        clk.t = 1000.0 + 301.0
+        engine.tick_once()
+        assert d.outcome == OUTCOME_ROLLED_BACK
+        assert jm.get_node(repl_id).is_alive()  # replacement KEPT
+        assert engine.cordoned_nodes() == []
+        # No undeliverable un-park push to the dead node.
+        assert (1, "restart_training") not in servicer.pushed
+
+    def test_shrink_lowers_auto_scaler_target(self):
+        """Elastic shrink must stick: the worker target drops with
+        the world, or an auto-scaler pass would immediately launch a
+        replacement and undo the shrink."""
+
+        class FakeAutoScaler:
+            target_workers = 3
+
+        clk = FakeClock(1000.0)
+        engine, health, servicer, jm = make_engine(clk, workers=4)
+        engine.auto_scaler = FakeAutoScaler()
+        engine._ladder[("h1", 1)] = 2  # sick past budget -> shrink
+        health.verdicts = [verdict()]
+        engine.tick_once()
+        (d,) = engine.tick_once()
+        assert d.action == ACTION_SHRINK
+        assert engine.auto_scaler.target_workers == 2
+
+
+class TestStateJournaling:
+    """Satellite: active verdicts + cooldown stamps + remediation
+    state survive a master warm restart — a sticky critical verdict
+    must NOT re-fire its action after every bounce."""
+
+    def _convict(self, monitor, store, clk):
+        for i in range(40):
+            t = 900.0 + i * 5
+            v = 0.1 if t < 1000 else 0.1 * (1 + (t - 1000) / 30.0)
+            store.record("host.step_time", v, ts=t, host="slow")
+        clk.t = 1095.0
+        return monitor.evaluate_once()
+
+    def test_sticky_verdict_does_not_refire_action_after_restore(self):
+        clk = FakeClock(1000.0)
+        store = TimeSeriesStore(clock=clk)
+        actions = []
+        config = {
+            "window_s": 60.0, "min_points": 3.0,
+            "goodput_grace_s": 0.0, "action_cooldown_s": 600.0,
+        }
+        monitor = HealthMonitor(
+            store, clock=clk, config=config,
+            action_sink=lambda n, a: actions.append((n, a)),
+            fleet=type(
+                "F", (),
+                {"node_for_host": staticmethod(lambda h: 3),
+                 "aggregates": staticmethod(dict)},
+            )(),
+        )
+        verdicts = self._convict(monitor, store, clk)
+        assert [v.severity for v in verdicts] == [SEVERITY_CRITICAL]
+        assert actions == [(3, "profile")]
+        snap = monitor.to_snapshot()
+        assert snap["active"] and snap["last_action"]
+
+        # The replacement master restores and re-evaluates the SAME
+        # still-sick fleet: the verdict is already active (no
+        # transition), so no second action fires.
+        actions2 = []
+        monitor2 = HealthMonitor(
+            store, clock=clk, config=config,
+            action_sink=lambda n, a: actions2.append((n, a)),
+            fleet=monitor.fleet,
+        )
+        monitor2.restore_snapshot(snap)
+        assert [
+            v.key() for v in monitor2.active_verdicts()
+        ] == [v.key() for v in monitor.active_verdicts()]
+        verdicts2 = monitor2.evaluate_once()
+        assert [v.severity for v in verdicts2] == [SEVERITY_CRITICAL]
+        assert actions2 == []  # the journaled state absorbed it
+        # Even a severity re-transition respects the restored stamp.
+        assert monitor2.action_stamp(verdicts2[0].key()) is not None
+
+        # WITHOUT the journal (the old bug): the same evaluation
+        # re-fires the action immediately.
+        actions3 = []
+        monitor3 = HealthMonitor(
+            store, clock=clk, config=config,
+            action_sink=lambda n, a: actions3.append((n, a)),
+            fleet=monitor.fleet,
+        )
+        monitor3.evaluate_once()
+        assert actions3 == [(3, "profile")]
+
+    def test_remediation_snapshot_roundtrip(self):
+        clk = FakeClock(1000.0)
+        engine, health, servicer, jm = make_engine(clk)
+        health.verdicts = [verdict()]
+        engine.tick_once()
+        (d,) = engine.tick_once()
+        assert d.outcome == OUTCOME_ACTED
+        snap = engine.to_snapshot()
+
+        engine2, health2, servicer2, jm2 = make_engine(clk)
+        engine2.restore_snapshot(snap)
+        # In production the journaled node table restores alongside:
+        # the replacement exists in the new master's JobManager too.
+        jm2.register_node(node_id=d.replacement_id, addr="h1b")
+        assert engine2.cordoned_nodes() == [1]
+        restored = engine2.decisions()
+        assert [x.decision_id for x in restored] == [d.decision_id]
+        # The wall-clock deadline keeps its meaning, but is extended
+        # when needed so the new master can re-earn recovery_ticks.
+        assert restored[0].probation_deadline >= d.probation_deadline
+        # The restored probation still finalizes (here: recovery).
+        health2.verdicts = []
+        engine2.tick_once()
+        engine2.tick_once()
+        assert engine2.decisions()[-1].outcome == OUTCOME_RECOVERED
+
+    def test_restore_near_deadline_still_allows_recovery(self):
+        """A master bounce that consumed most of the probation window
+        must not force a rollback: restore zeroes healthy_ticks, so
+        the deadline is extended to fit recovery_ticks fresh
+        observations — a recovered fleet finalizes as RECOVERED, not
+        rolled back at the stale deadline."""
+        clk = FakeClock(1000.0)
+        engine, health, servicer, jm = make_engine(
+            clk, interval_s=15.0
+        )
+        health.verdicts = [verdict()]
+        engine.tick_once()
+        (d,) = engine.tick_once()
+        assert d.probation_deadline == 1300.0
+        snap = engine.to_snapshot()
+
+        # Warm restart lands 10s before the original deadline; the
+        # fleet genuinely recovered while the master was down.
+        clk.t = 1290.0
+        engine2, health2, servicer2, jm2 = make_engine(
+            clk, interval_s=15.0
+        )
+        engine2.restore_snapshot(snap)
+        jm2.register_node(node_id=d.replacement_id, addr="h1b")
+        health2.verdicts = []
+        engine2.tick_once()  # healthy tick 1 (t=1290)
+        clk.t = 1305.0  # past the ORIGINAL deadline
+        engine2.tick_once()  # healthy tick 2 -> recovered
+        restored = engine2.decisions()[-1]
+        assert restored.outcome == OUTCOME_RECOVERED
+        assert not jm2.get_node(1).is_alive()  # retired, not rolled back
+
+    def test_master_collect_state_carries_health_and_remediation(self):
+        master = JobMaster(
+            port=0, node_num=2, rdzv_timeout=1.0,
+            collect_interval=999.0, health_interval=9999.0,
+            remediation_config={"interval_s": 9999.0},
+        )
+        try:
+            state = master._collect_state()
+            assert "health" in state and "remediation" in state
+            # Round-trips through JSON (the journal's on-disk form).
+            json.loads(json.dumps(state))
+            master.health.restore_snapshot(state["health"])
+            master.remediation.restore_snapshot(state["remediation"])
+        finally:
+            master.stop()
+
+
+@pytest.fixture()
+def drill_master():
+    scaler = Scaler()
+    m = JobMaster(
+        port=0, node_num=3, min_nodes=2, rdzv_timeout=1.0,
+        metrics_port=0, collect_interval=999.0,
+        health_interval=9999.0,
+        remediation_config={
+            "interval_s": 9999.0,
+            "hysteresis_ticks": 2.0,
+            "recovery_ticks": 2.0,
+            "cooldown_s": 0.0,
+            "blast_window_s": 600.0,
+            "blast_max_actions": 1.0,
+            "probation_s": 300.0,
+        },
+        scaler=scaler,
+    )
+    m.prepare()
+    yield m, scaler
+    m.stop()
+
+
+def snapshot_msg(node_id, host, ts, step_time):
+    return msg.MetricsSnapshotReport(
+        node_id=node_id,
+        host=host,
+        timestamp=ts,
+        registry={},
+        resource={"tokens_per_s": 500.0},
+        step_times=[step_time],
+        events=[],
+    )
+
+
+class TestAcceptanceDrill:
+    """The hermetic drill: a simulated fleet with one injected
+    degrading host heals itself — cordoned, replaced via a ScalePlan,
+    goodput recovered — while a flapping host is damped by hysteresis
+    and never ping-pongs the world size."""
+
+    def feed(self, client, host_steps, span=240.0, n=25):
+        now = time.time()
+        for i in range(n):
+            ts = now - span + i * (span / n)
+            for node_id, host, fn in host_steps:
+                client.report(
+                    snapshot_msg(node_id, host, ts, fn(ts, now))
+                )
+
+    @staticmethod
+    def ramp(ts, now):
+        return 0.1 * (1.0 + max(0.0, ts - (now - 120.0)) / 40.0)
+
+    @staticmethod
+    def flat(ts, now):
+        return 0.1
+
+    def register(self, master):
+        client = RpcClient(master.addr)
+        for node_id, host in ((0, "h0"), (1, "h1"), (2, "h2")):
+            client.report(
+                msg.NodeAddressRequest(node_id=node_id, node_ip=host)
+            )
+        return client
+
+    def run_flap_rounds(self, master, client, rounds=3):
+        """h1 stays sick every tick; h2 relapses but its history
+        clears between engine ticks (the flap)."""
+        for _ in range(rounds):
+            master.health.evaluate_once()
+            master.remediation.tick_once()
+            master.timeseries.drop_label("host", "h2")
+            master.health.evaluate_once()
+            master.remediation.tick_once()
+            self.feed(client, [(2, "h2", self.ramp)])
+
+    def test_drill(self, drill_master, tmp_path):
+        master, scaler = drill_master
+        client = self.register(master)
+        self.feed(client, [
+            (0, "h0", self.flat),
+            (1, "h1", self.ramp),
+            (2, "h2", self.ramp),
+        ])
+        self.run_flap_rounds(master, client)
+
+        # --- the degrading host was cordoned and replaced ---------
+        decisions = master.remediation.decisions()
+        acted = [
+            d for d in decisions
+            if d.action == ACTION_CORDON_REPLACE and d.node_id == 1
+        ]
+        assert len(acted) == 1, [
+            (d.action, d.node_id, d.outcome) for d in decisions
+        ]
+        d = acted[0]
+        assert d.governors == {
+            "hysteresis": "ok", "cooldown": "ok",
+            "blast_radius": "ok", "min_nodes": "ok",
+        }
+        assert d.trigger  # the convicting verdict's message rides it
+        repl_id = d.replacement_id
+        assert repl_id >= 0
+        launched = [
+            n.id for p in scaler.executed_plans for n in p.launch_nodes
+        ]
+        assert launched == [repl_id]  # exactly ONE ScalePlan launch
+
+        # --- the flapping host drew ZERO actions ------------------
+        assert not any(x.node_id == 2 for x in decisions)
+        assert not master.job_manager.get_node(2).cordoned
+
+        # --- the cordoned agent is parked, peers re-rendezvous ----
+        beats = []
+        while True:
+            a = client.report(msg.HeartbeatRequest(node_id=1)).action
+            if a == "none":
+                break
+            beats.append(a)
+        assert EventAction.CORDON.value in beats
+
+        # --- replacement reports healthy -> probation recovery ----
+        client.report(
+            msg.NodeAddressRequest(node_id=repl_id, node_ip="h1b")
+        )
+        self.feed(client, [
+            (0, "h0", self.flat),
+            (2, "h2", self.flat),
+            (repl_id, "h1b", self.flat),
+        ], span=140.0, n=15)
+        master.health.evaluate_once()
+        for _ in range(3):
+            master.remediation.tick_once()
+        assert d.outcome == OUTCOME_RECOVERED
+        assert not master.remediation.probation_failing()
+        # cordon-then-replace completed: the sick pod is retired.
+        assert (
+            master.job_manager.get_node(1).status == NodeStatus.DELETED
+        )
+        # ...and the stale flag is cleared, so a future incarnation
+        # of this node id would start un-benched.
+        assert not master.job_manager.get_node(1).cordoned
+        assert master.remediation.cordoned_nodes() == []
+        # goodput recovered: no active critical verdicts, score back.
+        assert master.health.critical_count() == 0
+        assert master.health.health_score() == 1.0
+        # the replacement world satisfies the elastic floor
+        alive = [
+            n for n in master.job_manager.list_nodes(NodeType.WORKER)
+            if n.is_alive() and not n.cordoned
+        ]
+        assert len(alive) >= 2
+
+        # --- queryable: RPC --------------------------------------
+        from dlrover_tpu.agent.master_client import MasterClient
+
+        mc = MasterClient(master.addr, node_id=0)
+        resp = mc.query_remediation()
+        assert resp.enabled and not resp.dry_run
+        assert not resp.probation_failing
+        wire = [
+            x for x in resp.decisions
+            if x.action == ACTION_CORDON_REPLACE
+        ]
+        assert wire and wire[-1].outcome == OUTCOME_RECOVERED
+        assert wire[-1].governors["blast_radius"] == "ok"
+        only_h1 = mc.query_remediation(node_id=1)
+        assert {x.node_id for x in only_h1.decisions} == {1}
+
+        # --- queryable: brain rows -------------------------------
+        rows = master.brain.recent_remediation_decisions("default")
+        assert {
+            (r["action"], r["outcome"]) for r in rows
+        } >= {
+            (ACTION_CORDON_REPLACE, OUTCOME_ACTED),
+            (ACTION_CORDON_REPLACE, OUTCOME_RECOVERED),
+        }
+        assert rows[0]["governors"]  # audit trail decoded
+
+        # --- queryable: metrics ----------------------------------
+        url = f"http://127.0.0.1:{master.metrics_server.port}"
+        body = urllib.request.urlopen(
+            f"{url}/metrics", timeout=5
+        ).read().decode()
+        assert (
+            'dlrover_remediation_decisions_total{'
+            'detector="throughput_degradation",'
+            'action="cordon_replace",outcome="recovered"}'
+        ) in body
+        assert "dlrover_remediation_cordoned_nodes" in body
+
+        # --- queryable: obs_report --health against the master ---
+        proc = subprocess.run(
+            [
+                sys.executable,
+                os.path.join(REPO, "tools", "obs_report.py"),
+                "--health", master.addr,
+            ],
+            capture_output=True, text=True, timeout=120, cwd=REPO,
+        )
+        assert proc.returncode == 0, proc.stdout + proc.stderr
+        assert "remediation" in proc.stdout
+        assert "cordon_replace" in proc.stdout
+        assert "recovered" in proc.stdout
+
+    def test_dry_run_records_but_mutates_nothing(self, tmp_path):
+        scaler = Scaler()
+        master = JobMaster(
+            port=0, node_num=3, min_nodes=2, rdzv_timeout=1.0,
+            collect_interval=999.0, health_interval=9999.0,
+            remediation_config={
+                "interval_s": 9999.0,
+                "hysteresis_ticks": 2.0,
+                "cooldown_s": 0.0,
+                "dry_run": 1.0,
+            },
+            scaler=scaler,
+        )
+        master.prepare()
+        try:
+            client = self.register(master)
+            self.feed(client, [
+                (0, "h0", self.flat),
+                (1, "h1", self.ramp),
+                (2, "h2", self.flat),
+            ])
+            for _ in range(3):
+                master.health.evaluate_once()
+                master.remediation.tick_once()
+            decisions = master.remediation.decisions()
+            assert [
+                (d.action, d.outcome, d.dry_run) for d in decisions
+            ] == [(ACTION_CORDON_REPLACE, OUTCOME_DRY_RUN, True)]
+            # ...and NOTHING moved: no plans, no cordon, the node
+            # table untouched, no probation, decision still persisted.
+            assert scaler.executed_plans == []
+            assert not master.job_manager.get_node(1).cordoned
+            assert master.job_manager.get_node(1).status == (
+                NodeStatus.RUNNING
+            )
+            assert master.remediation.cordoned_nodes() == []
+            rows = master.brain.recent_remediation_decisions("default")
+            assert rows and rows[0]["dry_run"]
+            # the drained heartbeat FIFO carries no remediation
+            # actions (PROFILE from the capture path is fine)
+            seen = []
+            while True:
+                a = client.report(
+                    msg.HeartbeatRequest(node_id=1)
+                ).action
+                if a == "none":
+                    break
+                seen.append(a)
+            assert EventAction.CORDON.value not in seen
+            assert EventAction.RESTART_TRAINING.value not in seen
+        finally:
+            master.stop()

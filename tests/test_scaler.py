@@ -226,6 +226,76 @@ def test_auto_scaler_pending_counts_once_toward_target():
     assert auto.adjust_once() is None
 
 
+def test_duplicate_scaleplan_is_noop_on_fake_cluster():
+    """A replayed/duplicate ScalePlan (retried scale RPC, engine
+    re-fire after a warm restart) applied twice must be a no-op: one
+    pod, one service, ONE ADDED event — a duplicate ADDED would
+    double-register the node with the job manager."""
+    client = FakeClusterClient()
+    scaler = TPUPodScaler("job1", client)
+    plan = ScalePlan()
+    plan.launch_nodes = [_node(0)]
+    scaler.scale(plan)
+    pods_once = {k: dict(v) for k, v in client.pods.items()}
+    events_once = client.events.qsize()
+    scaler.scale(plan)  # the replay
+    assert client.pods == pods_once
+    assert client.events.qsize() == events_once
+    assert len(client.services) == 1
+    # Remove-side replay: deleting an already-deleted pod no-ops too.
+    rm = ScalePlan()
+    rm.remove_nodes = [_node(0)]
+    scaler.scale(rm)
+    after_delete = client.events.qsize()
+    scaler.scale(rm)
+    assert client.events.qsize() == after_delete
+    assert not client.pods
+
+
+def test_adjust_once_idempotent_under_duplicate_plan():
+    """adjust_once -> replay its plan through the scaler -> another
+    adjust_once: the job manager's node table and the fake cluster
+    must be exactly as after the first pass."""
+    client = FakeClusterClient()
+    scaler = TPUPodScaler("job1", client)
+    jm = JobManager(scaler=scaler)
+    jm.register_node(node_id=0)
+    auto = AllreduceAutoScaler(
+        jm, SpeedMonitor(), target_workers=2, interval=999
+    )
+    plan = auto.adjust_once()
+    assert plan is not None and len(plan.launch_nodes) == 1
+    nodes_once = {n.id: n.status for n in jm.list_nodes()}
+    events_once = client.events.qsize()
+    scaler.scale(plan)  # duplicate delivery of the same plan
+    assert auto.adjust_once() is None
+    assert {n.id: n.status for n in jm.list_nodes()} == nodes_once
+    assert client.events.qsize() == events_once
+
+
+def test_auto_scaler_replaces_cordoned_worker():
+    """A cordoned host is deliberately benched by the remediation
+    engine: it must NOT count toward the target (the auto-scaler
+    launches a stand-in), and the PENDING stand-in keeps the pass
+    idempotent."""
+    client = FakeClusterClient()
+    jm = JobManager(scaler=TPUPodScaler("job1", client))
+    for i in range(2):
+        jm.register_node(node_id=i)
+    auto = AllreduceAutoScaler(
+        jm, SpeedMonitor(), target_workers=2, interval=999
+    )
+    assert auto.adjust_once() is None  # fleet at target
+    assert jm.cordon_node(1, reason="throughput_degradation")
+    plan = auto.adjust_once()
+    assert plan is not None and len(plan.launch_nodes) == 1
+    assert auto.adjust_once() is None  # replacement counts now
+    # Rollback path: un-cordon -> the fleet is one OVER target, which
+    # the replace-only scaler leaves alone (no thrash).
+    assert jm.uncordon_node(1)
+    assert auto.adjust_once() is None
+
+
 class _FakeRayActorHandle:
     def __init__(self, name, spec):
         self.name = name

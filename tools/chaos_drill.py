@@ -316,6 +316,177 @@ def run_drill(
             shutil.rmtree(tmpdir, ignore_errors=True)
 
 
+def run_remediation_drill(seed: int = 11) -> dict:
+    """Seeded slow-host remediation drill, fully in-process: a
+    simulated 3-host fleet feeds a REAL master's RPC server through
+    the chaos injector (seeded latency on every client call), one
+    host degrades persistently and one flaps. The self-healing
+    contract, asserted from the event trace:
+
+    * the degrading host is cordoned (``node.cordon`` +
+      ``remediation.cordon``) and replaced via a ScalePlan
+      (``node.replace``),
+    * after the replacement reports healthy, probation confirms
+      recovery (``remediation.recovered``) and the sick pod is
+      retired,
+    * the flapping host is damped by hysteresis: ZERO remediation
+      actions, zero scale plans touching it.
+    """
+    import dlrover_tpu.obs as obs
+    from dlrover_tpu.master.job_manager import Scaler
+    from dlrover_tpu.master.master import JobMaster
+
+    tracer = obs.configure_tracer()  # in-memory ring
+    cursor = 0
+    scaler = Scaler()  # records ScalePlans
+    master = JobMaster(
+        port=0, node_num=3, min_nodes=2, rdzv_timeout=1.0,
+        collect_interval=999.0, health_interval=9999.0,
+        remediation_config={
+            "interval_s": 9999.0,  # ticked manually, deterministically
+            "hysteresis_ticks": 2,
+            "recovery_ticks": 2,
+            "probation_s": 300.0,
+            "cooldown_s": 0.0,
+            "blast_window_s": 600.0,
+            "blast_max_actions": 1.0,
+        },
+        scaler=scaler,
+    )
+    master.prepare()
+    client = None
+    try:
+        # Client-side chaos: every feed RPC rides the seeded latency
+        # schedule (remediation must not depend on timely telemetry).
+        chaos.install_injector(
+            chaos.ChaosInjector(seed=seed, latency_ms=2.0, node_id=0)
+        )
+        client = RpcClient(master.addr)
+
+        def snap(node_id, host, ts, step_time):
+            return msg.MetricsSnapshotReport(
+                node_id=node_id, host=host, timestamp=ts,
+                registry={}, resource={"tokens_per_s": 500.0},
+                step_times=[step_time], events=[],
+            )
+
+        for node_id, host in ((0, "h0"), (1, "h1"), (2, "h2")):
+            client.report(
+                msg.NodeAddressRequest(node_id=node_id, node_ip=host)
+            )
+
+        def feed(host_steps, span=240.0, n=25):
+            now = time.time()
+            for i in range(n):
+                ts = now - span + i * (span / n)
+                for node_id, host, fn in host_steps:
+                    client.report(snap(node_id, host, ts, fn(ts, now)))
+
+        ramp = lambda ts, now: 0.1 * (  # noqa: E731
+            1.0 + max(0.0, ts - (now - 120.0)) / 40.0
+        )
+        flat = lambda ts, now: 0.1  # noqa: E731
+        feed([(0, "h0", flat), (1, "h1", ramp), (2, "h2", ramp)])
+
+        # Interleave: h1 stays sick every tick; h2 flaps (its history
+        # clears between ticks, so its verdict resolves and re-fires)
+        # — hysteresis (2 consecutive sick ticks) must damp h2 while
+        # convicting h1.
+        for round_ in range(3):
+            master.health.evaluate_once()
+            master.remediation.tick_once()
+            master.timeseries.drop_label("host", "h2")  # h2 "recovers"
+            master.health.evaluate_once()
+            master.remediation.tick_once()
+            feed([(2, "h2", ramp)])  # ...and relapses
+
+        decisions = master.remediation.decisions()
+        acted = [
+            d for d in decisions
+            if d.action == "cordon_replace" and d.node_id == 1
+            and d.outcome in ("acted", "recovered")
+        ]
+        if len(acted) != 1:
+            raise DrillError(
+                "expected exactly one cordon_replace of node 1, got "
+                f"{[(d.action, d.node_id, d.outcome) for d in decisions]}"
+            )
+        if any(d.node_id == 2 for d in decisions):
+            raise DrillError(
+                "flapping host h2 drew a remediation decision — "
+                "hysteresis failed to damp it"
+            )
+        plans = list(scaler.executed_plans)
+        launched = [n.id for p in plans for n in p.launch_nodes]
+        if len(launched) != 1:
+            raise DrillError(
+                f"expected one replacement launch, got plans {plans}"
+            )
+        repl_id = acted[0].replacement_id
+
+        # The replacement registers and reports healthy; h1 is gone
+        # (its telemetry purged at cordon), so the fleet recovers.
+        client.report(
+            msg.NodeAddressRequest(node_id=repl_id, node_ip="h1b")
+        )
+        feed(
+            [(0, "h0", flat), (2, "h2", flat), (repl_id, "h1b", flat)],
+            span=140.0, n=15,
+        )
+        master.health.evaluate_once()
+        for _ in range(3):
+            master.remediation.tick_once()
+        if acted[0].outcome != "recovered":
+            raise DrillError(
+                "probation did not confirm recovery: "
+                f"{[(d.action, d.outcome) for d in master.remediation.decisions()]}"
+            )
+        if master.remediation.probation_failing():
+            raise DrillError("probation_failing after recovery")
+        from dlrover_tpu.common.constants import NodeStatus
+
+        node1 = master.job_manager.get_node(1)
+        if node1 is None or node1.status != NodeStatus.DELETED:
+            raise DrillError(
+                "cordoned node 1 was not retired after recovery: "
+                f"{node1.status if node1 else None}"
+            )
+
+        events, cursor = tracer.events_since(cursor)
+        names = [e.get("name") for e in events]
+        for needle in (
+            "node.cordon", "remediation.cordon", "node.replace",
+            "remediation.decision", "remediation.recovered",
+        ):
+            if needle not in names:
+                raise DrillError(
+                    f"event {needle!r} missing from the drill trace"
+                )
+        cordons = [
+            e for e in events if e.get("name") == "node.cordon"
+        ]
+        if [e.get("node_id") for e in cordons] != [1]:
+            raise DrillError(
+                f"cordon events not exactly [node 1]: {cordons}"
+            )
+        injector = chaos.get_injector()
+        return {
+            "seed": seed,
+            "decisions": len(decisions),
+            "replacement_id": repl_id,
+            "chaos_decisions": len(
+                injector.decisions if injector else ()
+            ),
+            "events": len(events),
+        }
+    finally:
+        chaos.install_injector(None)
+        chaos.reset()
+        if client is not None:
+            client.close()
+        master.stop()
+
+
 def check_schedule_reproducibility(seed: int = 1234, calls: int = 200):
     """Same seed + same call sequence -> identical fault schedule."""
     def schedule(s):
@@ -364,6 +535,12 @@ def selftest() -> int:
         f"kill+restart drill ok: {report['shards_processed']} shards "
         f"exactly-once, outage {report['outage_s']}s, "
         f"{report['reconnects']} reconnect(s)"
+    )
+    rem = run_remediation_drill(seed=11)
+    print(
+        f"remediation drill ok: slow host cordoned+replaced "
+        f"(replacement {rem['replacement_id']}), flapper damped, "
+        f"{rem['chaos_decisions']} chaos decision(s)"
     )
     print(f"chaos drill selftest ok ({time.monotonic() - t0:.1f}s)")
     return 0

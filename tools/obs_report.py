@@ -249,15 +249,21 @@ def report(
 
 
 def health_report(target: str) -> int:
-    """Render the fleet-health plane from a live master (host:port,
-    HealthQueryRequest RPC) or a JSON snapshot file. Returns 1 when a
-    critical verdict is active (probe semantics), else 0."""
+    """Render the fleet-health plane (and the remediation engine's
+    decision history) from a live master (host:port,
+    HealthQueryRequest + RemediationQueryRequest RPCs) or a JSON
+    snapshot file (optionally carrying a ``remediation`` key).
+    Returns 1 when a critical verdict is active OR a remediation
+    probation window is currently failing (probe semantics), else
+    0."""
     import dataclasses
     import json
     import os
 
+    from dlrover_tpu.master.remediation import render_remediation
     from dlrover_tpu.obs.health import SEVERITY_CRITICAL, render_health
 
+    remediation_unknown = False
     if os.path.isfile(target):
         with open(target) as f:
             payload = json.load(f)
@@ -296,13 +302,58 @@ def health_report(target: str) -> int:
                 dataclasses.asdict(v) for v in resp.history
             ],
         }
+        try:
+            rem = client.query_remediation(max_wait=15.0)
+            payload["remediation"] = {
+                "enabled": rem.enabled,
+                "dry_run": rem.dry_run,
+                "cordoned": list(rem.cordoned),
+                "probation_failing": rem.probation_failing,
+                "decisions": [
+                    dataclasses.asdict(d) for d in rem.decisions
+                ],
+            }
+        except Exception as e:  # noqa: BLE001
+            if (
+                "no get handler" in str(e)
+                or "unknown message" in str(e)
+            ):
+                # Genuinely pre-remediation master (older wire
+                # schema): its health plane still renders and the
+                # probe follows the health verdicts alone.
+                print(
+                    "warning: master predates the remediation "
+                    f"RPC: {e}",
+                    file=sys.stderr,
+                )
+            else:
+                # A remediation-CAPABLE master failed the query
+                # (timeout, transient RPC error): the probe must NOT
+                # read healthy — a failing probation could be hiding
+                # behind the failure, and the documented exit-1
+                # contract would be silently broken.
+                print(
+                    f"error: remediation query failed: {e}",
+                    file=sys.stderr,
+                )
+                remediation_unknown = True
     print(render_health(payload))
+    remediation = payload.get("remediation")
+    if remediation is not None:
+        print()
+        print(render_remediation(remediation))
     critical = sum(
         1
         for v in payload.get("active", [])
         if v.get("severity") == SEVERITY_CRITICAL
     )
-    return 1 if critical else 0
+    probation_failing = bool(
+        (remediation or {}).get("probation_failing")
+    )
+    return (
+        1 if critical or probation_failing or remediation_unknown
+        else 0
+    )
 
 
 def _selftest_health() -> list:
@@ -400,6 +451,101 @@ def _selftest_health() -> list:
     return errors
 
 
+def _selftest_remediation() -> list:
+    """Remediation rendering + probe semantics: the --health body
+    must carry the decision history with its governor audit trail,
+    and a currently-failing probation window must exit 1 even with no
+    critical verdict active (the verdict resolved, but remediation
+    demonstrably did not restore health)."""
+    import json as _json
+    import tempfile
+
+    from dlrover_tpu.master.remediation import render_remediation
+
+    errors = []
+    payload = {
+        "enabled": True,
+        "dry_run": False,
+        "cordoned": [1],
+        "probation_failing": True,
+        "decisions": [
+            {
+                "decision_id": 1,
+                "detector": "throughput_degradation",
+                "node_id": 1,
+                "host": "h1",
+                "action": "cordon_replace",
+                "outcome": "acted",
+                "dry_run": False,
+                "governors": {
+                    "hysteresis": "ok", "cooldown": "ok",
+                    "blast_radius": "ok", "min_nodes": "ok",
+                },
+            },
+            {
+                "decision_id": 2,
+                "detector": "data_starvation",
+                "node_id": 2,
+                "host": "h2",
+                "action": "restart_training",
+                "outcome": "blocked",
+                "dry_run": False,
+                "governors": {
+                    "hysteresis": "ok",
+                    "blast_radius": (
+                        "blocked: 1 action(s) in the last 600s "
+                        "window (cap 1)"
+                    ),
+                    "cooldown": "ok",
+                },
+            },
+        ],
+    }
+    rendered = render_remediation(payload)
+    for needle in (
+        "remediation (active)",
+        "cordoned [1]",
+        "PROBATION FAILING",
+        "cordon_replace",
+        "governors ok:",
+        "governor blast_radius: blocked:",
+    ):
+        if needle not in rendered:
+            errors.append(
+                f"remediation render missing {needle!r}: {rendered!r}"
+            )
+    # Probe semantics end to end through the --health file path: no
+    # critical verdict, but a failing probation -> rc 1.
+    snapshot = {
+        "score": 1.0,
+        "active": [],
+        "history": [],
+        "remediation": payload,
+    }
+    with tempfile.NamedTemporaryFile(
+        "w", suffix=".json", delete=False
+    ) as f:
+        _json.dump(snapshot, f)
+        path = f.name
+    try:
+        if health_report(path) != 1:
+            errors.append(
+                "health_report rc != 1 with a failing probation"
+            )
+        snapshot["remediation"]["probation_failing"] = False
+        with open(path, "w") as f:
+            _json.dump(snapshot, f)
+        if health_report(path) != 0:
+            errors.append(
+                "health_report rc != 0 with healthy remediation"
+            )
+    finally:
+        import os as _os
+
+        _os.unlink(path)
+    return errors
+
+
 def selftest() -> int:
     """Hermetic check of the reconstruction pipeline on synthetic
     events shaped like a real drill trace."""
@@ -475,6 +621,7 @@ def selftest() -> int:
     errors.extend(_selftest_postmortem())
     errors.extend(_selftest_perf())
     errors.extend(_selftest_health())
+    errors.extend(_selftest_remediation())
     if errors:
         print("obs selftest FAILED:")
         for e in errors:
