@@ -2,6 +2,7 @@ from dlrover_tpu.data.coworker import CoworkerDataLoader
 from dlrover_tpu.data.prefetch import (
     Prefetcher,
     SyncPipeline,
+    device_prefetch_enabled,
     make_input_pipeline,
     prefetch_depth,
     prefetch_enabled,
@@ -13,6 +14,7 @@ __all__ = [
     "Prefetcher",
     "ShmBatchRing",
     "SyncPipeline",
+    "device_prefetch_enabled",
     "make_input_pipeline",
     "prefetch_depth",
     "prefetch_enabled",

@@ -1,22 +1,33 @@
 """Pipelined input prefetch: stage batch N+1 while step N computes.
 
 The steady-state training loop must never wait on the input pipeline:
-Python collate and host->device staging (``device_put`` /
-``make_array_from_process_local_data``) for the NEXT batch should run
-while XLA executes the CURRENT step. :class:`Prefetcher` is that
-overlap: a single background thread pulls items from a source
-iterable (typically an ``ElasticDataLoader``), applies ``stage_fn``
-(collate + ``ElasticTrainer.shard_microbatches``), and parks the
-staged result in a bounded queue — double-buffered by default — that
-the train loop pops with near-zero wait.
+Python collate AND host->device staging (``jax.device_put`` under the
+step's ``NamedSharding`` / ``make_array_from_process_local_data``) for
+the NEXT batch should run while XLA executes the CURRENT step.
+:class:`Prefetcher` is that overlap: a single background thread pulls
+items from a source iterable (typically an ``ElasticDataLoader``),
+applies ``stage_fn`` (host-side collate), then ``h2d_fn`` (device
+placement — the worker finishes with committed device arrays), and
+parks the staged result in a bounded queue — double-buffered by
+default — that the train loop pops with near-zero wait.
+
+The two stages are timed separately so the win is *attributable*:
+every batch's host cost (source pull + collate) and H2D cost land in
+``dlrover_prefetch_stage_seconds_total{phase="host"|"h2d"}``, and the
+consumer's wait splits the same way (``wait_breakdown()``), feeding
+the ``data_wait`` / ``h2d_stage`` step phases of
+``dlrover_step_phase_seconds_total`` (obs/profiling.py).
 
 Elasticity contract: a checkpoint taken mid-stream must not count an
 in-flight batch (pulled from the sampler but not yet trained on) as
-consumed. The worker snapshots ``sampler.state_dict()`` immediately
-after pulling each item; :meth:`Prefetcher.sampler_state_dict`
-returns the snapshot of the last batch actually DELIVERED to the
-consumer, so an elastic restart resumes exactly after the last
-trained-on batch and the queued-but-untrained ones are replayed.
+consumed — whether it is parked host-side or already device-resident.
+The worker snapshots ``sampler.state_dict()`` immediately after
+pulling each item; :meth:`Prefetcher.sampler_state_dict` returns the
+snapshot of the last batch actually DELIVERED to the consumer, so an
+elastic restart resumes exactly after the last trained-on batch and
+the queued-but-untrained ones are replayed. ``close()`` additionally
+frees the device buffers of staged-but-undelivered batches so dropped
+HBM slots return immediately instead of waiting for GC.
 
 Knobs (see docs/PERFORMANCE.md):
 
@@ -25,12 +36,19 @@ Knobs (see docs/PERFORMANCE.md):
   stages synchronously, exactly the pre-prefetch behavior.
 * ``DLROVER_TPU_PREFETCH_DEPTH`` — queue depth (staged batches held
   ahead), default 2.
+* ``DLROVER_TPU_DEVICE_PREFETCH=0`` — keep ``h2d_fn`` OUT of the
+  worker: batches are delivered host-staged and the consumer pays the
+  H2D transfer inline (honestly recorded as the ``h2d`` split). The
+  A/B switch that makes the device-resident win measurable.
 
 Observability: every consumer wait lands in the
-``dlrover_train_data_wait_seconds`` histogram; with tracing on, the
-worker emits ``trainer.prefetch_stage`` spans per staged batch and
-the consumer emits ``trainer.prefetch_wait`` events, so
-``tools/obs_report.py`` can show data-wait vs step time.
+``dlrover_train_data_wait_seconds`` histogram (total, host + inline
+H2D); with tracing on, the worker emits ``trainer.prefetch_stage``
+(host) and ``trainer.prefetch_h2d`` (device placement) spans per
+staged batch and the consumer emits ``trainer.prefetch_wait`` events
+carrying the split, so ``tools/obs_report.py`` can show data-wait vs
+host-staging vs H2D-staging vs step time — identically for the async
+:class:`Prefetcher` and the :class:`SyncPipeline` fallback.
 """
 
 from __future__ import annotations
@@ -39,7 +57,7 @@ import os
 import queue
 import threading
 import time
-from typing import Any, Callable, Iterable, Optional
+from typing import Any, Callable, Iterable, Optional, Tuple
 
 from dlrover_tpu import obs
 from dlrover_tpu.common.log import get_logger
@@ -48,17 +66,25 @@ logger = get_logger("prefetch")
 
 PREFETCH_ENV = "DLROVER_TPU_PREFETCH"
 PREFETCH_DEPTH_ENV = "DLROVER_TPU_PREFETCH_DEPTH"
+DEVICE_PREFETCH_ENV = "DLROVER_TPU_DEVICE_PREFETCH"
 DEFAULT_DEPTH = 2
 
 _DATA_WAIT = obs.histogram(
     "dlrover_train_data_wait_seconds",
     "Time the train loop waited on the input pipeline per batch "
-    "(near zero when prefetch keeps up)",
+    "(near zero when prefetch keeps up; includes inline H2D staging "
+    "when device prefetch is off)",
 )
 _BATCHES = obs.counter(
     "dlrover_prefetch_batches_total",
     "Prefetcher batches by outcome",
     ("outcome",),  # staged | delivered | dropped
+)
+_STAGE_SECONDS = obs.counter(
+    "dlrover_prefetch_stage_seconds_total",
+    "Input staging cost by phase: host (source pull + collate) vs "
+    "h2d (device placement), wherever it ran (worker or consumer)",
+    ("phase",),  # host | h2d
 )
 
 
@@ -67,12 +93,48 @@ def prefetch_enabled() -> bool:
     return os.getenv(PREFETCH_ENV, "1") != "0"
 
 
+def device_prefetch_enabled(default: bool = True) -> bool:
+    """DLROVER_TPU_DEVICE_PREFETCH: run ``h2d_fn`` in the worker so
+    batches arrive device-resident (default). ``0`` keeps H2D on the
+    consumer, the pre-device-prefetch behavior."""
+    val = os.getenv(DEVICE_PREFETCH_ENV, "")
+    if not val:
+        return default
+    return val != "0"
+
+
 def prefetch_depth(default: int = DEFAULT_DEPTH) -> int:
     try:
         depth = int(os.getenv(PREFETCH_DEPTH_ENV, str(default)))
     except ValueError:
         return default
     return max(1, depth)
+
+
+def free_device_buffers(batch) -> None:
+    """Best-effort eager free of a dropped batch's device buffers.
+
+    Walks tuples/lists/dicts and calls ``.delete()`` on any leaf that
+    has one (jax Arrays; duck-typed so this module never imports jax).
+    A dropped device-resident batch must hand its HBM slot back at
+    close() time, not whenever GC finds the queue entry."""
+    if isinstance(batch, (tuple, list)):
+        for item in batch:
+            free_device_buffers(item)
+        return
+    if isinstance(batch, dict):
+        for item in batch.values():
+            free_device_buffers(item)
+        return
+    delete = getattr(batch, "delete", None)
+    if callable(delete):
+        try:
+            deleted = getattr(batch, "is_deleted", None)
+            if callable(deleted) and deleted():
+                return
+            delete()
+        except Exception:  # noqa: BLE001 — freeing is best-effort
+            logger.debug("device buffer free failed", exc_info=True)
 
 
 def _epoch_stream(source, sampler, auto_epoch: bool, name: str):
@@ -117,6 +179,20 @@ class _Error:
         self.exc = exc
 
 
+class _Entry:
+    """One staged batch in flight: payload + sampler snapshot + the
+    per-stage costs the consumer uses to split its wait."""
+
+    __slots__ = ("batch", "state", "host_s", "h2d_s", "device_done")
+
+    def __init__(self, batch, state, host_s, h2d_s, device_done):
+        self.batch = batch
+        self.state = state
+        self.host_s = host_s
+        self.h2d_s = h2d_s
+        self.device_done = device_done
+
+
 class Prefetcher:
     """Background staging pipeline over a batch source.
 
@@ -126,7 +202,16 @@ class Prefetcher:
         generator, ...). With ``auto_epoch`` it must be RE-iterable —
         ``iter(source)`` is called again after each exhaustion.
     stage_fn: optional ``raw_batch -> staged_batch`` run in the
-        worker thread (collate + device placement). None = identity.
+        worker thread (host-side collate). None = identity.
+    h2d_fn: optional ``staged_batch -> device_batch`` — the
+        host->device placement step (``jax.device_put`` under the
+        step's ``NamedSharding``, e.g.
+        ``ElasticTrainer.shard_microbatches``). Runs in the worker
+        when ``device_prefetch`` (default), so the queue hands the
+        trainer committed device arrays; with ``device_prefetch``
+        off it runs in the consumer and its cost is recorded as the
+        h2d slice of the wait. A worker-side ``h2d_fn`` failure is
+        relayed to the consumer as a loud step error, never a hang.
     depth: staged batches held ahead of the consumer (bounded queue;
         the worker blocks when full). None = DLROVER_TPU_PREFETCH_DEPTH
         or 2 (double buffering).
@@ -136,6 +221,8 @@ class Prefetcher:
     auto_epoch: when the source exhausts, bump ``sampler.set_epoch
         (epoch + 1)`` and re-iterate instead of ending the stream —
         the shape of the high-level Trainer's epoch loop.
+    device_prefetch: where ``h2d_fn`` runs (see above). None reads
+        ``DLROVER_TPU_DEVICE_PREFETCH`` (default on).
     """
 
     def __init__(
@@ -146,11 +233,17 @@ class Prefetcher:
         sampler=None,
         auto_epoch: bool = False,
         name: str = "train",
+        h2d_fn: Optional[Callable[[Any], Any]] = None,
+        device_prefetch: Optional[bool] = None,
     ):
         if auto_epoch and sampler is None:
             raise ValueError("auto_epoch requires a sampler")
         self._source = source
         self._stage_fn = stage_fn
+        self._h2d_fn = h2d_fn
+        if device_prefetch is None:
+            device_prefetch = device_prefetch_enabled()
+        self.device_prefetch = bool(device_prefetch) and h2d_fn is not None
         self.depth = depth if depth is not None else prefetch_depth()
         if self.depth < 1:
             raise ValueError(f"depth must be >= 1, got {self.depth}")
@@ -170,8 +263,18 @@ class Prefetcher:
         self.delivered = 0
         self.dropped = 0
         self.wait_s_total = 0.0
+        # Wait split totals + last-batch split (wait_breakdown()).
+        self.host_wait_s_total = 0.0
+        self.h2d_wait_s_total = 0.0
+        self._last_split: Tuple[float, float] = (0.0, 0.0)
+        # Staging cost totals (worker- or consumer-side).
+        self.host_stage_s_total = 0.0
+        self.h2d_stage_s_total = 0.0
         obs.event(
-            "trainer.prefetch_start", pipeline=name, depth=self.depth
+            "trainer.prefetch_start",
+            pipeline=name,
+            depth=self.depth,
+            device_prefetch=int(self.device_prefetch),
         )
         self._thread = threading.Thread(
             target=self._run, name=f"prefetch-{name}", daemon=True
@@ -197,6 +300,7 @@ class Prefetcher:
                 self.name,
             )
             while not self._stop.is_set():
+                t_pull = time.perf_counter()
                 try:
                     raw = next(it)
                 except StopIteration:
@@ -217,15 +321,38 @@ class Prefetcher:
                         if self._stage_fn is not None
                         else raw
                     )
+                host_s = time.perf_counter() - t_pull
+                h2d_s = 0.0
+                device_done = False
+                if self.device_prefetch:
+                    # The worker finishes with committed device
+                    # arrays: a failing device_put lands in the
+                    # _Error relay below — a loud step error at the
+                    # consumer, never a silent hang on the queue.
+                    t_h2d = time.perf_counter()
+                    with obs.span(
+                        "trainer.prefetch_h2d", pipeline=self.name
+                    ):
+                        staged = self._h2d_fn(staged)
+                    h2d_s = time.perf_counter() - t_h2d
+                    device_done = True
+                self.host_stage_s_total += host_s
+                self.h2d_stage_s_total += h2d_s
+                _STAGE_SECONDS.inc(host_s, phase="host")
+                if device_done:
+                    _STAGE_SECONDS.inc(h2d_s, phase="h2d")
                 # Count BEFORE the put: a concurrent close() may
                 # drain (and count dropped) the entry immediately,
                 # and staged == delivered + dropped must hold at
                 # prefetch_stop.
                 self.staged += 1
                 _BATCHES.inc(outcome="staged")
-                if not self._put((staged, state)):
+                entry = _Entry(staged, state, host_s, h2d_s, device_done)
+                if not self._put(entry):
                     # Stopped while blocked on a full queue: the
-                    # batch never reached the consumer.
+                    # batch never reached the consumer — free any
+                    # device buffers it holds.
+                    free_device_buffers(entry.batch)
                     self.dropped += 1
                     _BATCHES.inc(outcome="dropped")
                     return
@@ -263,29 +390,74 @@ class Prefetcher:
         if isinstance(entry, _Error):
             self._exhausted = True
             raise entry.exc
+        batch = entry.batch
+        if entry.device_done or self._h2d_fn is None:
+            # Queue wait splits by what the worker was doing for this
+            # batch: a blocked consumer was waiting on host staging
+            # and H2D in that proportion (both ~0 on a queue hit).
+            stage_total = entry.host_s + entry.h2d_s
+            frac = (
+                entry.h2d_s / stage_total if stage_total > 0 else 0.0
+            )
+            host_wait, h2d_wait = wait * (1.0 - frac), wait * frac
+        else:
+            # Device prefetch off: the consumer pays H2D inline —
+            # measured directly, counted in the wait (it IS input
+            # latency on the critical path). A failing inline
+            # device_put still keeps the staged == delivered + dropped
+            # invariant (the batch was popped but never delivered) and
+            # frees any partially-created device buffers.
+            t_h2d = time.perf_counter()
+            try:
+                with obs.span(
+                    "trainer.prefetch_h2d", pipeline=self.name
+                ):
+                    batch = self._h2d_fn(batch)
+            except BaseException:
+                free_device_buffers(batch)
+                self.dropped += 1
+                _BATCHES.inc(outcome="dropped")
+                raise
+            h2d_wait = time.perf_counter() - t_h2d
+            host_wait = wait
+            wait += h2d_wait
+            self.h2d_stage_s_total += h2d_wait
+            _STAGE_SECONDS.inc(h2d_wait, phase="h2d")
         # Record the wait only for REAL batches — the terminal
         # sentinel fetch must not add a phantom sample to the
         # data-wait histogram / trainer.prefetch_wait stream.
         self.wait_s_total += wait
+        self.host_wait_s_total += host_wait
+        self.h2d_wait_s_total += h2d_wait
+        self._last_split = (host_wait, h2d_wait)
         _DATA_WAIT.observe(wait)
         obs.event(
             "trainer.prefetch_wait",
             pipeline=self.name,
             dur_s=round(wait, 6),
+            host_s=round(host_wait, 6),
+            h2d_s=round(h2d_wait, 6),
         )
-        batch, state = entry
-        if state is not None:
-            self._delivered_state = state
+        if entry.state is not None:
+            self._delivered_state = entry.state
         self.delivered += 1
         _BATCHES.inc(outcome="delivered")
         return batch
+
+    def wait_breakdown(self) -> Tuple[float, float]:
+        """(host_wait_s, h2d_wait_s) of the LAST delivered batch's
+        consumer wait — what the train loop feeds
+        ``StepPhaseProfiler.note_data_wait(host, h2d_seconds=h2d)``
+        so the ``data_wait`` phase splits attributably."""
+        return self._last_split
 
     def sampler_state_dict(self) -> Optional[dict]:
         """Sampler state as of the last batch the CONSUMER received.
 
         Batches staged ahead in the queue (or mid-stage in the
-        worker) are NOT counted — checkpointing this dict makes an
-        elastic restart replay them instead of skipping data.
+        worker) are NOT counted — host- or device-resident alike —
+        so checkpointing this dict makes an elastic restart replay
+        them instead of skipping data.
         """
         state = self._delivered_state
         return dict(state) if state is not None else None
@@ -293,7 +465,9 @@ class Prefetcher:
     # -- shutdown ------------------------------------------------------------
 
     def close(self) -> None:
-        """Stop the worker and drop staged-but-undelivered batches.
+        """Stop the worker and drop staged-but-undelivered batches,
+        eagerly freeing their device buffers (HBM slots return now,
+        not at GC time).
 
         Idempotent; called on elastic restart and normal shutdown.
         The dropped batches were never delivered, so
@@ -306,14 +480,7 @@ class Prefetcher:
         self._stop.set()
         # Drain so a worker blocked on a full queue can observe the
         # stop event and exit.
-        while True:
-            try:
-                entry = self._queue.get_nowait()
-            except queue.Empty:
-                break
-            if entry is not _End and not isinstance(entry, _Error):
-                self.dropped += 1
-                _BATCHES.inc(outcome="dropped")
+        self._drain_dropped()
         self._thread.join(timeout=5.0)
         if self._thread.is_alive():  # pragma: no cover — stage_fn hang
             logger.warning(
@@ -321,14 +488,7 @@ class Prefetcher:
             )
         # A put already in flight when stop was set may have landed
         # after the first drain; sweep again now the worker is done.
-        while True:
-            try:
-                entry = self._queue.get_nowait()
-            except queue.Empty:
-                break
-            if entry is not _End and not isinstance(entry, _Error):
-                self.dropped += 1
-                _BATCHES.inc(outcome="dropped")
+        self._drain_dropped()
         obs.event(
             "trainer.prefetch_stop",
             pipeline=self.name,
@@ -336,7 +496,20 @@ class Prefetcher:
             delivered=self.delivered,
             dropped=self.dropped,
             wait_s_total=round(self.wait_s_total, 6),
+            host_stage_s_total=round(self.host_stage_s_total, 6),
+            h2d_stage_s_total=round(self.h2d_stage_s_total, 6),
         )
+
+    def _drain_dropped(self) -> None:
+        while True:
+            try:
+                entry = self._queue.get_nowait()
+            except queue.Empty:
+                return
+            if entry is not _End and not isinstance(entry, _Error):
+                free_device_buffers(entry.batch)
+                self.dropped += 1
+                _BATCHES.inc(outcome="dropped")
 
     def __enter__(self) -> "Prefetcher":
         return self
@@ -351,7 +524,13 @@ class SyncPipeline:
     same ``dlrover_train_data_wait_seconds`` histogram) with the
     Prefetcher's interface — epoch rollover, zero-batch-epoch guard,
     ``sampler_state_dict()`` (trivially exact: nothing is ever in
-    flight) and an idempotent no-op ``close()``."""
+    flight), ``wait_breakdown()`` and an idempotent ``close()``.
+
+    Reports the SAME split host/h2d staging metrics and trace events
+    as the async path (``dlrover_prefetch_stage_seconds_total``,
+    ``trainer.prefetch_stage`` / ``trainer.prefetch_h2d`` /
+    ``trainer.prefetch_wait``), so ``obs_report`` input-pipeline
+    summaries stay comparable across modes."""
 
     def __init__(
         self,
@@ -360,15 +539,32 @@ class SyncPipeline:
         sampler=None,
         auto_epoch: bool = False,
         name: str = "train",
+        h2d_fn: Optional[Callable[[Any], Any]] = None,
+        device_prefetch: Optional[bool] = None,  # noqa: ARG002 — knob
+        # accepted for interface parity; there is no worker to move
+        # the H2D into, the consumer always pays it.
     ):
         if auto_epoch and sampler is None:
             raise ValueError("auto_epoch requires a sampler")
         self._stage_fn = stage_fn
+        self._h2d_fn = h2d_fn
         self._sampler = sampler
         self.name = name
         self._it = _epoch_stream(source, sampler, auto_epoch, name)
         self.delivered = 0
         self.wait_s_total = 0.0
+        self.host_wait_s_total = 0.0
+        self.h2d_wait_s_total = 0.0
+        self.host_stage_s_total = 0.0
+        self.h2d_stage_s_total = 0.0
+        self._last_split: Tuple[float, float] = (0.0, 0.0)
+        self._closed = False
+        obs.event(
+            "trainer.prefetch_start",
+            pipeline=name,
+            depth=0,
+            device_prefetch=0,
+        )
 
     def __iter__(self) -> "SyncPipeline":
         return self
@@ -376,15 +572,45 @@ class SyncPipeline:
     def __next__(self):
         t0 = time.perf_counter()
         raw = next(self._it)  # StopIteration ends the stream
-        staged = (
-            self._stage_fn(raw) if self._stage_fn is not None else raw
-        )
-        wait = time.perf_counter() - t0
+        with obs.span("trainer.prefetch_stage", pipeline=self.name):
+            staged = (
+                self._stage_fn(raw)
+                if self._stage_fn is not None
+                else raw
+            )
+        host_s = time.perf_counter() - t0
+        h2d_s = 0.0
+        if self._h2d_fn is not None:
+            t_h2d = time.perf_counter()
+            with obs.span("trainer.prefetch_h2d", pipeline=self.name):
+                staged = self._h2d_fn(staged)
+            h2d_s = time.perf_counter() - t_h2d
+        wait = host_s + h2d_s
         self.wait_s_total += wait
+        self.host_wait_s_total += host_s
+        self.h2d_wait_s_total += h2d_s
+        self.host_stage_s_total += host_s
+        self.h2d_stage_s_total += h2d_s
+        self._last_split = (host_s, h2d_s)
         _DATA_WAIT.observe(wait)
+        _STAGE_SECONDS.inc(host_s, phase="host")
+        if self._h2d_fn is not None:
+            _STAGE_SECONDS.inc(h2d_s, phase="h2d")
+        obs.event(
+            "trainer.prefetch_wait",
+            pipeline=self.name,
+            dur_s=round(wait, 6),
+            host_s=round(host_s, 6),
+            h2d_s=round(h2d_s, 6),
+        )
         self.delivered += 1
         _BATCHES.inc(outcome="delivered")
         return staged
+
+    def wait_breakdown(self) -> Tuple[float, float]:
+        """(host_s, h2d_s) of the last batch — exact in sync mode:
+        the consumer paid both inline."""
+        return self._last_split
 
     def sampler_state_dict(self) -> Optional[dict]:
         if self._sampler is None:
@@ -392,7 +618,22 @@ class SyncPipeline:
         return dict(self._sampler.state_dict())
 
     def close(self) -> None:
-        return None
+        # Idempotent like Prefetcher.close(): a defensive second
+        # close (context manager + finally, elastic restart) must not
+        # emit a duplicate prefetch_stop event with doubled counts.
+        if self._closed:
+            return
+        self._closed = True
+        obs.event(
+            "trainer.prefetch_stop",
+            pipeline=self.name,
+            staged=self.delivered,
+            delivered=self.delivered,
+            dropped=0,
+            wait_s_total=round(self.wait_s_total, 6),
+            host_stage_s_total=round(self.host_stage_s_total, 6),
+            h2d_stage_s_total=round(self.h2d_stage_s_total, 6),
+        )
 
     def __enter__(self) -> "SyncPipeline":
         return self
@@ -408,12 +649,18 @@ def make_input_pipeline(
     sampler=None,
     auto_epoch: bool = False,
     name: str = "train",
+    h2d_fn: Optional[Callable[[Any], Any]] = None,
+    device_prefetch: Optional[bool] = None,
 ):
     """The one switch every train loop uses: a background
     :class:`Prefetcher` normally, or the synchronous
     :class:`SyncPipeline` under ``DLROVER_TPU_PREFETCH=0`` — same
     interface either way (iterate, ``sampler_state_dict()``,
-    ``close()``)."""
+    ``wait_breakdown()``, ``close()``). ``h2d_fn`` is the
+    host->device staging step (device placement under the training
+    step's sharding); ``device_prefetch`` keeps it in the worker
+    (default, device-resident queue) or on the consumer
+    (``DLROVER_TPU_DEVICE_PREFETCH=0``)."""
     if prefetch_enabled():
         return Prefetcher(
             source,
@@ -422,6 +669,8 @@ def make_input_pipeline(
             sampler=sampler,
             auto_epoch=auto_epoch,
             name=name,
+            h2d_fn=h2d_fn,
+            device_prefetch=device_prefetch,
         )
     return SyncPipeline(
         source,
@@ -429,4 +678,6 @@ def make_input_pipeline(
         sampler=sampler,
         auto_epoch=auto_epoch,
         name=name,
+        h2d_fn=h2d_fn,
+        device_prefetch=device_prefetch,
     )

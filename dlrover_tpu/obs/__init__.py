@@ -37,8 +37,9 @@ doing through this package, so "what is the job doing right now" and
   faulthandler stack dumps, traces) into the "last 60 seconds before
   failure" report ``tools/obs_report.py --postmortem`` prints.
 * :mod:`dlrover_tpu.obs.profiling` — perf observability for the hot
-  path: per-step wall-time attribution (data_wait / compile /
-  dispatch / device_execute), recompile counters per jitted function,
+  path: per-step wall-time attribution (data_wait / h2d_stage /
+  compile / dispatch / device_execute), recompile counters per jitted
+  function,
   a live MFU gauge from XLA cost analysis, and the on-demand PROFILE
   capture protocol (master action -> agent request file -> trainer
   digest -> diagnostics history).

@@ -4,11 +4,13 @@ The control plane can observe everything about a job *except* where
 its time goes; this module closes that gap for the training hot path:
 
 * :class:`StepPhaseProfiler` attributes every step's wall time into
-  four exhaustive phases — ``data_wait`` (blocking on the input
-  pipeline), ``compile`` (dispatches that traced + XLA-compiled),
-  ``dispatch`` (host-side enqueue of an already-compiled step), and
-  ``device_execute`` (the residual: the device working while the host
-  runs ahead) — into ``dlrover_step_phase_seconds_total{phase}``.
+  five exhaustive phases — ``data_wait`` (blocking on the input
+  pipeline's host side), ``h2d_stage`` (the host->device staging
+  slice of the input wait), ``compile`` (dispatches that traced +
+  XLA-compiled), ``dispatch`` (host-side enqueue of an
+  already-compiled step), and ``device_execute`` (the residual: the
+  device working while the host runs ahead) — into
+  ``dlrover_step_phase_seconds_total{phase}``.
   The clock is injectable, so attribution is testable hermetically.
 * :class:`CompileTracker` counts (re)compilations per jitted function
   via its dispatch-cache size (``dlrover_compile_total{fn}`` /
@@ -46,7 +48,12 @@ from dlrover_tpu.obs.tracer import event as obs_event
 logger = get_logger("profiling")
 
 # The exhaustive per-step wall-time phases, in attribution precedence.
-PHASES = ("data_wait", "compile", "dispatch", "device_execute")
+# ``data_wait`` is host-side input wait (pulling/collating the next
+# batch); ``h2d_stage`` is the host->device staging slice of that wait
+# (the split makes a device-prefetch win attributable: a healthy
+# device-resident pipeline drives BOTH toward zero, while a hidden H2D
+# stall shows up as h2d_stage specifically).
+PHASES = ("data_wait", "h2d_stage", "compile", "dispatch", "device_execute")
 
 PROFILE_REQUEST_ENV = "DLROVER_TPU_PROFILE_REQUEST_FILE"
 PROFILE_DIGEST_ENV = "DLROVER_TPU_PROFILE_DIGEST_FILE"
@@ -60,8 +67,10 @@ DEFAULT_PROFILE_STEPS = 20
 _PHASE_SECONDS = counter(
     "dlrover_step_phase_seconds_total",
     "Training wall time attributed by step phase (data_wait / "
-    "compile / dispatch / device_execute); the four phases partition "
-    "each step's wall time exactly",
+    "h2d_stage / compile / dispatch / device_execute); the five "
+    "phases partition each step's wall time exactly — data_wait is "
+    "host-side input wait, h2d_stage the host->device staging slice "
+    "of it",
     ("phase",),
 )
 _COMPILE_TOTAL = counter(
@@ -309,7 +318,7 @@ class StepPhaseProfiler:
     (injectable) clock and books the residual — wall minus the noted
     phases — as ``device_execute``: in a zero-sync loop that residual
     is exactly the time the host spent ahead of (or waiting on) the
-    device. The four phases therefore partition wall time exactly.
+    device. The five phases therefore partition wall time exactly.
 
     Capture protocol: every ``end_step`` polls the request file
     (mtime-gated, so the steady-state cost is one ``stat``); a fresh
@@ -345,10 +354,21 @@ class StepPhaseProfiler:
 
     # -- per-step notes ---------------------------------------------------
 
-    def note_data_wait(self, seconds: float) -> None:
+    def note_data_wait(
+        self, seconds: float, h2d_seconds: float = 0.0
+    ) -> None:
+        """Input wait for this step: ``seconds`` of host-side wait
+        (pull/collate/queue) plus ``h2d_seconds`` of host->device
+        staging (the split an input pipeline reports via
+        ``wait_breakdown()``). Callers without the split pass the
+        whole wait as ``seconds`` — attribution stays exhaustive
+        either way."""
+        host = max(seconds, 0.0)
+        h2d = max(h2d_seconds, 0.0)
         if self._step_start is None:
-            self._step_start = self._clock() - max(seconds, 0.0)
-        self._noted["data_wait"] += max(seconds, 0.0)
+            self._step_start = self._clock() - (host + h2d)
+        self._noted["data_wait"] += host
+        self._noted["h2d_stage"] += h2d
 
     def note_dispatch(self, seconds: float, compiled: bool = False) -> None:
         if self._step_start is None:
@@ -370,7 +390,7 @@ class StepPhaseProfiler:
         # partition invariant (sum == wall) holds.
         if noted > wall > 0:
             scale = wall / noted
-            for k in ("data_wait", "compile", "dispatch"):
+            for k in ("data_wait", "h2d_stage", "compile", "dispatch"):
                 breakdown[k] *= scale
             breakdown["device_execute"] = 0.0
         for phase in PHASES:
@@ -397,6 +417,7 @@ class StepPhaseProfiler:
             step=self.steps,
             wall_s=round(wall, 6),
             data_wait_s=round(breakdown["data_wait"], 6),
+            h2d_s=round(breakdown["h2d_stage"], 6),
             compile_s=round(breakdown["compile"], 6),
             dispatch_s=round(breakdown["dispatch"], 6),
             device_s=round(breakdown["device_execute"], 6),

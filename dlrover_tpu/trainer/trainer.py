@@ -152,11 +152,13 @@ class Trainer:
             strategy=args.strategy,
             optimizer_kwargs=self._optimizer_kwargs(),
         )
-        # A strategy that selected overlapped gradient reduction (the
-        # search can tune it) forces the trainer onto that schedule;
-        # otherwise the env default (DLROVER_TPU_OVERLAP_REDUCE)
-        # decides.
+        # A strategy that selected overlapped gradient reduction /
+        # microbatch pipelining (the search can tune both) forces the
+        # trainer onto that schedule; otherwise the env defaults
+        # (DLROVER_TPU_OVERLAP_REDUCE / DLROVER_TPU_PIPELINE_DEPTH)
+        # decide.
         _overlap = getattr(res.strategy, "overlap_reduce", False)
+        _pipe_depth = getattr(res.strategy, "pipeline_depth", 0)
         trainer = ElasticTrainer(
             res.mesh,
             self.model_loss,
@@ -167,6 +169,7 @@ class Trainer:
             reduce_bucket_mb=(
                 res.strategy.reduce_bucket_mb if _overlap else None
             ),
+            pipeline_depth=_pipe_depth if _pipe_depth else None,
         )
         params, opt_state = res.init_fn(
             jax.random.PRNGKey(args.seed)
@@ -213,20 +216,42 @@ class Trainer:
             collate_fn=self.collate_fn,
         )
 
-        def _stage(batch):
-            # Collate output -> device arrays laid out on the mesh;
-            # runs in the prefetch worker so H2D staging for step N+1
-            # overlaps step N's compute.
+        def _collate(batch):
+            # Host-side stage: collate output normalized to numpy —
+            # runs in the prefetch worker, timed as the "host" half of
+            # the staging split.
             tokens, targets = batch
-            return trainer.shard_microbatches(
-                np.asarray(tokens), np.asarray(targets)
-            )
+            return np.asarray(tokens), np.asarray(targets)
+
+        def _h2d(batch):
+            # Device stage: H2D under the step's NamedSharding. With
+            # device_prefetch (default) this also runs in the worker,
+            # so the queue hands the loop committed device arrays and
+            # step N+1's transfer overlaps step N's compute.
+            return trainer.shard_microbatches(*batch)
+
+        # The strategy supplies the device_prefetch default (the
+        # search tunes it); an explicitly-set
+        # DLROVER_TPU_DEVICE_PREFETCH env wins, so a deployment can
+        # flip the schedule without re-searching. A pipelined trainer
+        # fed host batches stages per microbatch itself — don't ALSO
+        # full-batch-stage in the pipeline.
+        from dlrover_tpu.data.prefetch import device_prefetch_enabled
+
+        device_prefetch = device_prefetch_enabled(
+            default=getattr(res.strategy, "device_prefetch", True)
+        )
+        h2d_fn = _h2d
+        if trainer.pipeline_depth > 0 and not device_prefetch:
+            h2d_fn = None
 
         # Background Prefetcher normally; the synchronous fallback
         # under DLROVER_TPU_PREFETCH=0 — same interface either way.
         batches = make_input_pipeline(
             loader,
-            stage_fn=_stage,
+            stage_fn=_collate,
+            h2d_fn=h2d_fn,
+            device_prefetch=device_prefetch,
             sampler=sampler,
             auto_epoch=True,
             name="trainer",
@@ -257,11 +282,13 @@ class Trainer:
         trainer.attach_profiler(profiler)
         try:
             for step in range(start_step + 1, args.max_steps + 1):
-                t_fetch = time.perf_counter()
                 tokens, targets = next(batches)
-                profiler.note_data_wait(
-                    time.perf_counter() - t_fetch
-                )
+                # The pipeline measured this batch's wait itself and
+                # splits it host-side vs H2D staging — the attribution
+                # that makes a device-prefetch win visible in
+                # dlrover_step_phase_seconds_total.
+                host_w, h2d_w = batches.wait_breakdown()
+                profiler.note_data_wait(host_w, h2d_seconds=h2d_w)
                 params, opt_state, last_loss = trainer.train_step(
                     params, opt_state, tokens, targets
                 )

@@ -227,10 +227,17 @@ def main(argv=None) -> int:
 
     def stage(batch):
         keys, labels = batch
-        return keys.ravel(), jnp.asarray(labels)
+        return keys.ravel(), labels
+
+    def h2d(batch):
+        # Device placement split from the host collate so the staging
+        # metrics attribute host vs H2D cost separately (see
+        # docs/PERFORMANCE.md "Device-resident input pipeline").
+        keys_flat, labels = batch
+        return keys_flat, jnp.asarray(labels)
 
     batches = make_input_pipeline(
-        batch_stream(), stage_fn=stage, name="ctr"
+        batch_stream(), stage_fn=stage, h2d_fn=h2d, name="ctr"
     )
 
     losses = []

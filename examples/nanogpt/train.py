@@ -185,10 +185,14 @@ def main(argv=None) -> int:
             yield next_batch(trainer.local_samples_per_step)
 
     def stage(batch):
+        # Device placement under the step's sharding — registered as
+        # h2d_fn so the worker delivers committed device arrays and
+        # the host/H2D staging split lands in the metrics
+        # (DLROVER_TPU_DEVICE_PREFETCH=0 moves it to the consumer).
         return trainer.shard_microbatches(*batch)
 
     batches = make_input_pipeline(
-        batch_stream(), stage_fn=stage, name="nanogpt"
+        batch_stream(), h2d_fn=stage, name="nanogpt"
     )
 
     t0 = time.time()

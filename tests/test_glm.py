@@ -357,6 +357,7 @@ def test_glm_hf_config_reads_rope_ratio():
         glm_config_from_hf(hf_cfg(original_rope=False))
 
 
+@pytest.mark.slow
 def test_glm_pipelines_like_llama():
     """Family completeness through the stack: a GLM-flavored config
     (qkv bias + half-dim rotary + GQA) trains through the 1F1B

@@ -124,6 +124,7 @@ def test_fused_loss_matches_plain(tiny, tiny_params):
     np.testing.assert_allclose(fused_sl, plain, rtol=1e-5)
 
 
+@pytest.mark.slow
 def test_remat_policies_grad_parity(tiny, tiny_params):
     import dataclasses
 

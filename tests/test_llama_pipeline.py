@@ -7,6 +7,7 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 import optax
+import pytest
 
 from dlrover_tpu.models import llama
 from dlrover_tpu.models.llama_pipeline import (
@@ -77,12 +78,14 @@ def _pipeline_trajectory(batches, v_chunks=1, lr=1e-2):
 
 
 class TestLlamaPipelineParity:
+    @pytest.mark.slow
     def test_1f1b_matches_dense_trajectory(self):
         batches = _batches(4)
         dense = _dense_trajectory(batches)
         piped = _pipeline_trajectory(batches)
         np.testing.assert_allclose(piped, dense, rtol=2e-3, atol=2e-4)
 
+    @pytest.mark.slow
     def test_interleaved_chunks_match_dense(self):
         batches = _batches(3)
         dense = _dense_trajectory(batches)
@@ -110,6 +113,7 @@ class TestLlamaPipelineParity:
         )
         assert np.isfinite(float(m["loss"]))
 
+    @pytest.mark.slow
     def test_moe_rides_the_stage_aux_channel(self):
         """Pipelined MoE: the router load-balancing loss flows
         through the schedule's aux channel and is differentiated.
@@ -207,6 +211,7 @@ class TestLlamaPipelineParity:
         _, _, m = step(params, opt_state, tok, tgt)
         np.testing.assert_allclose(float(m["loss"]), want, rtol=2e-4)
 
+    @pytest.mark.slow
     def test_moe_aux_interleaved_and_batch_sharded(self):
         """The aux channel's other schedule paths: interleaved chunks
         (V>1 — per-chunk aux must not double-count on wrap waves) and

@@ -102,6 +102,7 @@ def test_moe_expert_parallel_on_mesh():
     np.testing.assert_allclose(aux, aux_ref, rtol=1e-5)
 
 
+@pytest.mark.slow
 def test_moe_expert_parallel_composes_with_seq_ring():
     """EP x SP co-activation (no prior test ran both at once): a
     Mixtral-shaped Llama-MoE trains one step on a data x seq x expert

@@ -119,7 +119,10 @@ class TestWindowedSeqParallel:
             np.asarray(got), np.asarray(want), atol=2e-5, rtol=2e-5
         )
 
-    @pytest.mark.parametrize("window", [5, 20])
+    @pytest.mark.parametrize(
+        "window",
+        [5, pytest.param(20, marks=pytest.mark.slow)],
+    )
     def test_ring_gradients_match_plain(self, window):
         mesh = build_mesh(MeshConfig(seq=4, data=2))
         q, k, v = self._qkv(b=2, t=32, h=2, d=8)
@@ -248,6 +251,7 @@ class TestGqaRing:
             np.asarray(got), np.asarray(want), atol=2e-5, rtol=2e-5
         )
 
+    @pytest.mark.slow
     def test_ring_gradients_match_expanded(self):
         mesh = build_mesh(MeshConfig(seq=4, data=2))
         q, k, v = self._qkv(t=32, d=8)
@@ -615,6 +619,7 @@ class TestGPT:
         assert logits.shape == (2, 32, cfg.vocab_size)
         assert bool(jnp.isfinite(logits).all())
 
+    @pytest.mark.slow
     def test_loss_decreases_single_device(self):
         cfg = _tiny_cfg()
         params = gpt.init_params(jax.random.PRNGKey(0), cfg)
@@ -656,9 +661,16 @@ class TestShardedTraining:
         "mesh_cfg",
         [
             MeshConfig(data=8),
-            MeshConfig(data=2, fsdp=4),
-            MeshConfig(fsdp=2, tensor=4),
-            MeshConfig(data=2, fsdp=2, tensor=2),
+            pytest.param(
+                MeshConfig(data=2, fsdp=4), marks=pytest.mark.slow
+            ),
+            pytest.param(
+                MeshConfig(fsdp=2, tensor=4), marks=pytest.mark.slow
+            ),
+            pytest.param(
+                MeshConfig(data=2, fsdp=2, tensor=2),
+                marks=pytest.mark.slow,
+            ),
         ],
         ids=["dp", "dp-fsdp", "fsdp-tp", "dp-fsdp-tp"],
     )
@@ -695,6 +707,7 @@ class TestShardedTraining:
             assert not wqkv.sharding.is_fully_replicated
         assert n_shards == 8  # placed on every device
 
+    @pytest.mark.slow
     def test_save_attn_remat_matches_full_when_sharded(self):
         """save_attn under GSPMD: same loss as full remat on a
         sharded mesh with the flash kernel forced — the checkpoint
@@ -735,6 +748,7 @@ class TestShardedTraining:
             losses["save_attn"], rel=1e-5
         )
 
+    @pytest.mark.slow
     def test_seq_parallel_with_ring_attention(self):
         mesh = build_mesh(MeshConfig(seq=4, data=2))
         cfg = _tiny_cfg()

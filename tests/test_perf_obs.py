@@ -127,6 +127,76 @@ class TestPhaseAttribution:
             before + 0.25
         )
 
+    def test_h2d_split_partitions_the_input_wait(self):
+        """note_data_wait(host, h2d_seconds=...) splits the input
+        wait into data_wait vs h2d_stage, backdates the step start by
+        the SUM, and keeps the five-phase partition exact."""
+        clock = FakeClock(100.0)
+        prof = self._profiler(clock)
+        prof.end_step()  # anchor at t=100
+        prof.note_data_wait(0.2, h2d_seconds=0.1)
+        prof.note_dispatch(0.05)
+        clock.t = 101.0
+        b = prof.end_step()
+        assert b["data_wait"] == pytest.approx(0.2)
+        assert b["h2d_stage"] == pytest.approx(0.1)
+        assert b["device_execute"] == pytest.approx(0.65)
+        assert sum(b[p] for p in profiling.PHASES) == pytest.approx(
+            b["wall_s"]
+        )
+
+    def test_h2d_backdates_first_step_start_by_full_wait(self):
+        clock = FakeClock(100.0)
+        prof = self._profiler(clock)
+        prof.note_data_wait(0.2, h2d_seconds=0.3)  # fetch began 99.5
+        clock.t = 101.0
+        b = prof.end_step()
+        assert b["wall_s"] == pytest.approx(1.5)
+        assert b["h2d_stage"] == pytest.approx(0.3)
+        assert b["device_execute"] == pytest.approx(1.0)
+
+    def test_h2d_counter_and_overshoot_clamp_cover_new_phase(self):
+        counter = obs.get_registry().get(
+            "dlrover_step_phase_seconds_total"
+        )
+        before = counter.value(phase="h2d_stage")
+        clock = FakeClock(0.0)
+        prof = self._profiler(clock)
+        prof.note_data_wait(0.1, h2d_seconds=0.15)
+        clock.t = 0.5
+        prof.end_step()
+        assert counter.value(phase="h2d_stage") == pytest.approx(
+            before + 0.15
+        )
+        # overshoot clamp scales h2d_stage down with the others
+        prof.end_step()  # re-anchor
+        prof.note_data_wait(0.8, h2d_seconds=0.4)
+        clock.t = 1.1
+        b = prof.end_step()
+        assert b["device_execute"] == 0.0
+        assert sum(b[p] for p in profiling.PHASES) == pytest.approx(
+            b["wall_s"]
+        )
+        assert b["h2d_stage"] < 0.4  # scaled, not dropped
+
+    def test_step_phases_event_carries_h2d_field(self):
+        from dlrover_tpu.obs import tracer as tracer_mod
+
+        tracer = tracer_mod.configure_tracer()
+        try:
+            clock = FakeClock(0.0)
+            prof = self._profiler(clock)
+            prof.note_data_wait(0.02, h2d_seconds=0.01)
+            clock.t = 0.1
+            prof.end_step()
+            rows = [
+                e for e in tracer.events()
+                if e["name"] == "trainer.step_phases"
+            ]
+            assert rows and rows[-1]["h2d_s"] == pytest.approx(0.01)
+        finally:
+            tracer_mod.disable_tracer()
+
 
 # ---------------------------------------------------------------------------
 # Compile tracking (real forced retrace) and MFU

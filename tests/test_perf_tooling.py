@@ -258,6 +258,45 @@ class TestLedgerPinDiff:
         )
         assert "BENCH_REMAT" not in report  # unchanged pins silent
 
+    def test_compare_prints_device_prefetch_and_pipeline_pins(
+        self, tmp_path
+    ):
+        """The device-resident input-pipeline knobs ride the same
+        pin-diff surface: a tuned run pinning BENCH_DEVICE_PREFETCH /
+        BENCH_PIPELINE_DEPTH against a baseline without them must
+        name both in the compare output."""
+        bench_ledger = importlib.import_module("bench_ledger")
+        path = str(tmp_path / "ledger.jsonl")
+        base = {
+            "metric": "m", "value": 100.0, "unit": "u",
+            "config_hash": "aaa", "pins": {},
+        }
+        head = {
+            "metric": "m", "value": 110.0, "unit": "u",
+            "config_hash": "bbb",
+            "pins": {
+                "BENCH_DEVICE_PREFETCH": "1",
+                "BENCH_PIPELINE_DEPTH": "2",
+                "BENCH_ACCUM_STEPS": "2",
+            },
+        }
+        bench_ledger.append_record(base, path=path)
+        bench_ledger.append_record(head, path=path)
+        rc, report = bench_ledger.compare("last", path=path)
+        assert rc == 0
+        assert (
+            "pin BENCH_DEVICE_PREFETCH: head=1 baseline=<unset>"
+            in report
+        )
+        assert (
+            "pin BENCH_PIPELINE_DEPTH: head=2 baseline=<unset>"
+            in report
+        )
+        assert (
+            "pin BENCH_ACCUM_STEPS: head=2 baseline=<unset>"
+            in report
+        )
+
     def test_compare_same_config_no_pin_section(
         self, tmp_path
     ):
@@ -319,6 +358,51 @@ class TestBenchPinsEmission:
         assert trials[0]["key"] == rec["tune_key"]
         assert trials[0]["config"]["pins"] == rec["pins"]
         assert not trials[0]["failed"]
+
+
+class TestBenchPipelinedSmoke:
+    def test_smoke_child_pipelined_device_prefetch_record(
+        self, tmp_path
+    ):
+        """The device-resident configuration end-to-end through
+        bench.py's child: prefetch + worker-side H2D + pipelined
+        accumulation. The record must carry the pipeline config, the
+        new pins, and a data_wait_s figure (the attributable input
+        wait)."""
+        import subprocess
+
+        repo = os.path.dirname(TOOLS)
+        env = {
+            **os.environ,
+            "JAX_PLATFORMS": "cpu",
+            "BENCH_SMOKE": "1",
+            "BENCH_STEPS": "2",
+            "BENCH_NO_LEDGER": "1",
+            "BENCH_PREFETCH": "1",
+            "BENCH_DEVICE_PREFETCH": "1",
+            "BENCH_PIPELINE_DEPTH": "1",
+            "BENCH_ACCUM_STEPS": "2",
+            "DLROVER_TPU_TUNE_CACHE": "0",
+        }
+        p = subprocess.run(
+            [sys.executable, os.path.join(repo, "bench.py"),
+             "--child"],
+            env=env, capture_output=True, text=True, timeout=300,
+            cwd=repo,
+        )
+        assert p.returncode == 0, p.stderr[-2000:]
+        rec = next(
+            json.loads(line)
+            for line in p.stdout.splitlines()
+            if line.startswith("{")
+        )
+        assert rec["value"] > 0
+        assert rec["pins"]["BENCH_PIPELINE_DEPTH"] == "1"
+        assert rec["pins"]["BENCH_DEVICE_PREFETCH"] == "1"
+        assert rec["pipeline"] == {
+            "depth": 1, "accum_steps": 2, "device_prefetch": 1,
+        }
+        assert "data_wait_s" in rec
 
 
 class TestAGDTraceSelection:

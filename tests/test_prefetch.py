@@ -1,5 +1,6 @@
 """Prefetch pipeline: ordering, backpressure, shutdown drain, sampler
-state with batches in flight, and the compute/staging overlap bench."""
+state with batches in flight, device staging (H2D in the worker under
+the step's sharding), and the compute/staging overlap bench."""
 
 import threading
 import time
@@ -10,6 +11,8 @@ import pytest
 from dlrover_tpu.data.prefetch import (
     Prefetcher,
     SyncPipeline,
+    device_prefetch_enabled,
+    free_device_buffers,
     make_input_pipeline,
     prefetch_depth,
     prefetch_enabled,
@@ -302,7 +305,374 @@ def test_prefetch_emits_trace_events_and_data_wait_metric():
     assert hist.count() >= 3  # every consumer wait was observed
 
 
+# -- device staging (the device-resident input pipeline) -------------------
+
+
+def _mesh8():
+    import jax
+    from jax.sharding import Mesh
+
+    return Mesh(np.array(jax.devices()[:8]), ("data",))
+
+
+def _batch_sharding(mesh):
+    from jax.sharding import NamedSharding, PartitionSpec as P
+
+    return NamedSharding(mesh, P("data"))
+
+
+def test_worker_h2d_delivers_committed_sharded_device_arrays():
+    """The tentpole: the worker finishes with jax.device_put under
+    the step's NamedSharding — the queue hands the consumer committed
+    device arrays, correctly laid out on the multi-device mesh."""
+    import jax
+
+    mesh = _mesh8()
+    sharding = _batch_sharding(mesh)
+
+    def source():
+        for i in range(4):
+            yield np.full((16, 2), i, dtype=np.float32)
+
+    with Prefetcher(
+        source(),
+        h2d_fn=lambda b: jax.device_put(b, sharding),
+        device_prefetch=True,
+        depth=2,
+    ) as pf:
+        got = list(pf)
+    assert len(got) == 4
+    for i, arr in enumerate(got):
+        assert isinstance(arr, jax.Array)
+        assert arr.sharding == sharding
+        assert arr.committed
+        np.testing.assert_array_equal(
+            np.asarray(arr), np.full((16, 2), i, dtype=np.float32)
+        )
+    # worker-side H2D was timed and attributed
+    assert pf.h2d_stage_s_total > 0.0
+
+
+def test_consumer_h2d_when_device_prefetch_off():
+    """DLROVER_TPU_DEVICE_PREFETCH=0 semantics: the worker stays
+    host-side, the consumer pays the H2D inline and the wait split
+    reports it as the h2d slice."""
+    import jax
+
+    mesh = _mesh8()
+    sharding = _batch_sharding(mesh)
+    h2d_threads = []
+
+    def h2d(b):
+        h2d_threads.append(threading.current_thread().name)
+        return jax.device_put(b, sharding)
+
+    def source():
+        for _ in range(3):
+            yield np.zeros((8, 2), dtype=np.float32)
+
+    pf = Prefetcher(
+        source(), h2d_fn=h2d, device_prefetch=False, depth=2
+    )
+    arr = next(pf)
+    assert isinstance(arr, jax.Array) and arr.sharding == sharding
+    # the h2d ran on THIS thread, not the prefetch worker
+    assert h2d_threads[0] == threading.current_thread().name
+    host_w, h2d_w = pf.wait_breakdown()
+    assert h2d_w > 0.0
+    assert pf.h2d_wait_s_total == pytest.approx(h2d_w)
+    pf.close()
+
+
+def test_sampler_state_excludes_in_flight_device_batches():
+    """Delivered-only sampler snapshots hold when the in-flight
+    batches are DEVICE arrays: a checkpoint must replay staged
+    device-resident batches too."""
+    import jax
+
+    mesh = _mesh8()
+    sharding = _batch_sharding(mesh)
+    loader, sampler = _loader(n=40, batch=8)
+    pf = Prefetcher(
+        loader,
+        h2d_fn=lambda b: jax.device_put(
+            b.astype(np.float32), sharding
+        ),
+        depth=3,
+        sampler=sampler,
+    )
+    first = next(pf)
+    assert isinstance(first, jax.Array)
+    deadline = time.time() + 2.0
+    while (
+        sampler.state_dict()["consumed"] <= 8
+        and time.time() < deadline
+    ):
+        time.sleep(0.01)
+    assert sampler.state_dict()["consumed"] > 8  # in-flight on device
+    assert pf.sampler_state_dict()["consumed"] == 8  # delivered only
+    pf.close()
+
+
+def test_close_frees_dropped_device_slots():
+    """Drain-on-close must hand dropped batches' HBM back eagerly:
+    staged-but-undelivered device arrays are delete()d."""
+    import jax
+
+    mesh = _mesh8()
+    sharding = _batch_sharding(mesh)
+    staged_arrays = []
+
+    def h2d(b):
+        arr = jax.device_put(b, sharding)
+        staged_arrays.append(arr)
+        return arr
+
+    def source():
+        for _ in range(10):
+            yield np.zeros((8, 2), dtype=np.float32)
+
+    pf = Prefetcher(source(), h2d_fn=h2d, depth=3)
+    delivered = next(pf)
+    # let the worker fill the queue
+    deadline = time.time() + 2.0
+    while pf.staged < 3 and time.time() < deadline:
+        time.sleep(0.01)
+    pf.close()
+    assert pf.dropped >= 1
+    assert not delivered.is_deleted()  # the consumer's batch is HIS
+    dropped = [a for a in staged_arrays if a is not delivered]
+    assert dropped and all(a.is_deleted() for a in dropped)
+    pf.close()  # idempotent under the device-staging path too
+
+
+def test_free_device_buffers_walks_containers():
+    import jax
+
+    a = jax.numpy.zeros((4,))
+    b = jax.numpy.zeros((2,))
+    free_device_buffers(({"x": a}, [b], "not-an-array", None))
+    assert a.is_deleted() and b.is_deleted()
+    free_device_buffers(({"x": a}, [b]))  # already-deleted: no raise
+
+
+def test_worker_h2d_failure_is_loud_not_a_hang():
+    """A device_put failure in the worker must surface as a step
+    error at the consumer (the _Error relay), never leave the
+    consumer blocked on the bounded queue."""
+
+    def bad_h2d(b):
+        raise RuntimeError("device_put exploded")
+
+    pf = Prefetcher(
+        CountingSource(5), h2d_fn=bad_h2d, device_prefetch=True,
+        depth=2,
+    )
+    with pytest.raises(RuntimeError, match="device_put exploded"):
+        next(pf)
+    pf.close()
+
+
+def test_zero_batch_epoch_guard_under_device_staging():
+    """The loud zero-batch-epoch failure still fires when the worker
+    ends with a device stage (the guard lives upstream of h2d_fn)."""
+    loader, sampler = _loader(n=3, batch=5)  # never fills a batch
+    pf = Prefetcher(
+        loader,
+        h2d_fn=lambda b: b,
+        depth=2,
+        sampler=sampler,
+        auto_epoch=True,
+    )
+    with pytest.raises(RuntimeError, match="no batches"):
+        next(pf)
+    pf.close()
+    pf.close()  # idempotent
+
+
+def test_wait_split_attribution_proportional():
+    """With device prefetch, a consumer wait is split by the worker's
+    host vs h2d staging proportion for that batch."""
+    gate = threading.Event()
+
+    def slow_source():
+        for i in range(3):
+            gate.wait(2.0)
+            yield i
+
+    def h2d(b):
+        time.sleep(0.03)
+        return b
+
+    pf = Prefetcher(
+        slow_source(), h2d_fn=h2d, device_prefetch=True, depth=1
+    )
+    time.sleep(0.05)
+    gate.set()
+    next(pf)
+    host_w, h2d_w = pf.wait_breakdown()
+    assert host_w > 0.0 and h2d_w > 0.0
+    assert pf.wait_s_total == pytest.approx(host_w + h2d_w, rel=1e-6)
+    pf.close()
+
+
+def test_sync_pipeline_reports_same_split_metrics_as_async():
+    """Satellite: SyncPipeline emits the SAME split host/h2d staging
+    events and counters as the async path so obs_report summaries
+    stay comparable across modes."""
+    from dlrover_tpu.obs import tracer as tracer_mod
+    from dlrover_tpu.obs.metrics import get_registry
+
+    counter = get_registry().get("dlrover_prefetch_stage_seconds_total")
+    host_before = counter.value(phase="host")
+    h2d_before = counter.value(phase="h2d")
+    tracer = tracer_mod.configure_tracer()
+    try:
+        sync = SyncPipeline(
+            CountingSource(2),
+            stage_fn=lambda x: x,
+            h2d_fn=lambda x: x * 10,
+        )
+        assert list(sync) == [0, 10]
+        sync.close()
+        names = [e["name"] for e in tracer.events()]
+        assert "trainer.prefetch_start" in names
+        assert names.count("trainer.prefetch_stage") == 2
+        assert names.count("trainer.prefetch_h2d") == 2
+        waits = [
+            e for e in tracer.events()
+            if e["name"] == "trainer.prefetch_wait"
+        ]
+        assert len(waits) == 2
+        assert all("host_s" in e and "h2d_s" in e for e in waits)
+        stop = [
+            e for e in tracer.events()
+            if e["name"] == "trainer.prefetch_stop"
+        ][-1]
+        assert stop["delivered"] == 2
+        assert "h2d_stage_s_total" in stop
+    finally:
+        tracer_mod.disable_tracer()
+    assert counter.value(phase="host") > host_before
+    assert counter.value(phase="h2d") >= h2d_before
+    host_w, h2d_w = sync.wait_breakdown()
+    assert host_w >= 0.0 and h2d_w >= 0.0
+
+
+def test_consumer_h2d_failure_keeps_batch_accounting_invariant():
+    """Review regression: an inline (device_prefetch=0) h2d_fn
+    failure must count the popped batch as dropped so
+    staged == delivered + dropped still holds at prefetch_stop."""
+    calls = []
+
+    def flaky_h2d(b):
+        calls.append(b)
+        if len(calls) == 2:
+            raise RuntimeError("transient device OOM")
+        return b
+
+    pf = Prefetcher(
+        CountingSource(3), h2d_fn=flaky_h2d, device_prefetch=False,
+        depth=2,
+    )
+    assert next(pf) == 0
+    with pytest.raises(RuntimeError, match="transient device OOM"):
+        next(pf)
+    pf.close()
+    assert pf.staged == pf.delivered + pf.dropped
+    assert pf.delivered == 1 and pf.dropped >= 1
+
+
+def test_sync_pipeline_close_idempotent_single_stop_event():
+    """Review regression: a defensive double close (context manager +
+    finally) must emit exactly ONE prefetch_stop event, like the
+    async pipeline's guarded close."""
+    from dlrover_tpu.obs import tracer as tracer_mod
+
+    tracer = tracer_mod.configure_tracer()
+    try:
+        with SyncPipeline(CountingSource(2)) as sync:
+            list(sync)
+            sync.close()
+        sync.close()
+        stops = [
+            e for e in tracer.events()
+            if e["name"] == "trainer.prefetch_stop"
+        ]
+        assert len(stops) == 1
+        assert stops[0]["delivered"] == 2
+    finally:
+        tracer_mod.disable_tracer()
+
+
+def test_device_prefetch_env_knob(monkeypatch):
+    monkeypatch.delenv("DLROVER_TPU_DEVICE_PREFETCH", raising=False)
+    assert device_prefetch_enabled()
+    assert not device_prefetch_enabled(default=False)
+    monkeypatch.setenv("DLROVER_TPU_DEVICE_PREFETCH", "0")
+    assert not device_prefetch_enabled()
+    assert not device_prefetch_enabled(default=True)
+    monkeypatch.setenv("DLROVER_TPU_DEVICE_PREFETCH", "1")
+    assert device_prefetch_enabled(default=False)
+
+    monkeypatch.setenv("DLROVER_TPU_DEVICE_PREFETCH", "0")
+    calls = []
+    pf = make_input_pipeline(
+        CountingSource(2), h2d_fn=lambda b: calls.append(b) or b
+    )
+    assert isinstance(pf, Prefetcher)
+    assert not pf.device_prefetch
+    assert list(pf) == [0, 1]
+    assert len(calls) == 2  # consumer-side h2d still applied
+    pf.close()
+
+
 # -- the point of it all: overlap ------------------------------------------
+
+
+def test_device_prefetch_hides_h2d_behind_compute():
+    """The acceptance fallback for CPU-only containers: with H2D cost
+    H per batch and compute cost C >= H per step, worker-side device
+    staging (device_prefetch on) must hide H2D almost entirely, while
+    the consumer-side flavor pays ~N*H on the critical path — and the
+    split attribution shows exactly that difference."""
+    h2d_s = 0.02
+    compute_s = 0.03
+    n_steps = 8
+
+    def slow_h2d(x):
+        time.sleep(h2d_s)
+        return x
+
+    def run(device_prefetch):
+        pf = Prefetcher(
+            CountingSource(n_steps + 2),
+            h2d_fn=slow_h2d,
+            device_prefetch=device_prefetch,
+            depth=2,
+        )
+        next(pf)  # warmup: pays the initial pipeline fill
+        pf.wait_s_total = 0.0
+        pf.h2d_wait_s_total = 0.0
+        for _ in range(n_steps):
+            time.sleep(compute_s)  # "the XLA step"
+            next(pf)
+        wait, h2d_wait = pf.wait_s_total, pf.h2d_wait_s_total
+        pf.close()
+        return wait, h2d_wait
+
+    hidden_wait, _ = run(device_prefetch=True)
+    inline_wait, inline_h2d = run(device_prefetch=False)
+    sequential = n_steps * h2d_s
+    # worker-side H2D: nearly all of it hides behind compute
+    assert hidden_wait < 0.5 * sequential, (
+        f"device prefetch hid only "
+        f"{sequential - hidden_wait:.3f}s of {sequential:.3f}s H2D"
+    )
+    # consumer-side H2D: the cost is ON the critical path and the
+    # split attributes it to the h2d slice specifically
+    assert inline_h2d >= 0.9 * sequential
+    assert inline_wait >= inline_h2d
 
 
 def test_prefetch_overlaps_staging_with_compute():

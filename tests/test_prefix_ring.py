@@ -61,6 +61,7 @@ def test_ring_prefix_composes_with_tensor_and_batch(qkv):
     )
 
 
+@pytest.mark.slow
 def test_ring_prefix_grads_flow(qkv):
     q, k, v = qkv
     mesh = build_mesh(MeshConfig(seq=4), devices=jax.devices()[:4])

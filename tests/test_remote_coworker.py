@@ -271,6 +271,7 @@ class TestRemoteIngest:
             ingest.stop()
             master.stop()
 
+    @pytest.mark.slow
     def test_chaos_killed_pod_shard_redispatched_by_master(self):
         """The elastic story end to end: two pods pull index shards
         from a REAL master's dynamic sharding service and stream over

@@ -95,35 +95,56 @@ def input_pipeline_summary(events) -> str:
     proportion to the training wall time. Returns "" when the trace
     has no prefetch events (prefetch off or pre-pipeline trace).
     """
-    waits = [
-        float(e.get("dur_s", 0.0))
-        for e in events
-        if e.get("name") == "trainer.prefetch_wait"
+    wait_events = [
+        e for e in events if e.get("name") == "trainer.prefetch_wait"
     ]
+    waits = [float(e.get("dur_s", 0.0)) for e in wait_events]
     stages = [
         float(e.get("dur_s", 0.0))
         for e in events
         if e.get("name") == "trainer.prefetch_stage"
     ]
-    if not waits and not stages:
+    h2ds = [
+        float(e.get("dur_s", 0.0))
+        for e in events
+        if e.get("name") == "trainer.prefetch_h2d"
+    ]
+    if not waits and not stages and not h2ds:
         return ""
     lines = ["input pipeline (trainer.prefetch_*):"]
     wait_total = sum(waits)
     stage_total = sum(stages)
+    h2d_total = sum(h2ds)
     if waits:
         lines.append(
             f"  data-wait : {wait_total:9.3f}s total over "
             f"{len(waits)} batches (mean {wait_total / len(waits):.4f}s)"
         )
+        # Host-wait vs H2D-stage split (carried per wait event since
+        # the device-resident pipeline): where the blocked time went.
+        host_w = sum(float(e.get("host_s", 0.0)) for e in wait_events)
+        h2d_w = sum(float(e.get("h2d_s", 0.0)) for e in wait_events)
+        if host_w or h2d_w:
+            lines.append(
+                f"  wait split: host {host_w:.3f}s / "
+                f"h2d {h2d_w:.3f}s"
+            )
     if stages:
         lines.append(
             f"  staging   : {stage_total:9.3f}s total over "
             f"{len(stages)} batches (mean "
             f"{stage_total / len(stages):.4f}s, overlapped with compute)"
         )
-    if waits and stages:
+    if h2ds:
         lines.append(
-            f"  hidden    : {max(stage_total - wait_total, 0.0):9.3f}s "
+            f"  h2d stage : {h2d_total:9.3f}s total over "
+            f"{len(h2ds)} batches (mean "
+            f"{h2d_total / len(h2ds):.4f}s)"
+        )
+    if waits and (stages or h2ds):
+        lines.append(
+            f"  hidden    : "
+            f"{max(stage_total + h2d_total - wait_total, 0.0):9.3f}s "
             "of staging overlapped behind compute"
         )
     step_ts = sorted(
@@ -171,6 +192,7 @@ def perf_summary(events) -> str:
         )
         for phase, key in (
             ("data_wait", "data_wait_s"),
+            ("h2d_stage", "h2d_s"),
             ("compile", "compile_s"),
             ("dispatch", "dispatch_s"),
             ("device_execute", "device_s"),
@@ -566,10 +588,14 @@ def selftest() -> int:
          "dur_s": 0.5},
         {"name": "trainer.prefetch_stage", "ts": t + 40.7,
          "dur_s": 0.5},
+        {"name": "trainer.prefetch_h2d", "ts": t + 40.6,
+         "dur_s": 0.2},
+        {"name": "trainer.prefetch_h2d", "ts": t + 41.2,
+         "dur_s": 0.2},
         {"name": "trainer.prefetch_wait", "ts": t + 41.0,
-         "dur_s": 0.01},
+         "dur_s": 0.01, "host_s": 0.008, "h2d_s": 0.002},
         {"name": "trainer.prefetch_wait", "ts": t + 42.0,
-         "dur_s": 0.03},
+         "dur_s": 0.03, "host_s": 0.02, "h2d_s": 0.01},
         {"name": "trainer.step", "ts": t + 43.0, "step": 12},
         {"name": "trainer.prefetch_stop", "ts": t + 45.0,
          "delivered": 2, "dropped": 0},
@@ -608,7 +634,11 @@ def selftest() -> int:
             errors.append(f"wrong wait total in: {pipeline!r}")
         if "1.000s total over 2 batches" not in pipeline:
             errors.append(f"wrong stage total in: {pipeline!r}")
-        if "0.960s" not in pipeline:  # hidden = stage - wait
+        if "0.400s total over 2 batches" not in pipeline:
+            errors.append(f"wrong h2d stage total in: {pipeline!r}")
+        if "wait split: host 0.028s / h2d 0.012s" not in pipeline:
+            errors.append(f"wrong wait split in: {pipeline!r}")
+        if "1.360s" not in pipeline:  # hidden = stage + h2d - wait
             errors.append(f"wrong hidden time in: {pipeline!r}")
         if "data-wait is 2.0% of wall time" not in pipeline:
             errors.append(f"wrong wall fraction in: {pipeline!r}")
@@ -825,14 +855,16 @@ def _selftest_perf() -> list:
         {"name": "trainer.compile", "ts": t, "fn": "train_step",
          "dur_s": 2.0, "total": 1},
         {"name": "trainer.step_phases", "ts": t + 2.0, "step": 1,
-         "wall_s": 2.5, "data_wait_s": 0.25, "compile_s": 2.0,
-         "dispatch_s": 0.05, "device_s": 0.2},
+         "wall_s": 2.5, "data_wait_s": 0.25, "h2d_s": 0.0,
+         "compile_s": 2.0, "dispatch_s": 0.05, "device_s": 0.2},
         {"name": "trainer.step_phases", "ts": t + 3.0, "step": 2,
-         "wall_s": 0.5, "data_wait_s": 0.05, "compile_s": 0.0,
-         "dispatch_s": 0.05, "device_s": 0.4, "mfu": 0.41},
+         "wall_s": 0.5, "data_wait_s": 0.05, "h2d_s": 0.1,
+         "compile_s": 0.0, "dispatch_s": 0.05, "device_s": 0.3,
+         "mfu": 0.41},
         {"name": "trainer.step_phases", "ts": t + 4.0, "step": 3,
-         "wall_s": 1.0, "data_wait_s": 0.2, "compile_s": 0.0,
-         "dispatch_s": 0.1, "device_s": 0.7, "mfu": 0.43},
+         "wall_s": 1.0, "data_wait_s": 0.2, "h2d_s": 0.1,
+         "compile_s": 0.0, "dispatch_s": 0.1, "device_s": 0.6,
+         "mfu": 0.43},
         {"name": "trainer.profile_done", "ts": t + 4.0, "steps": 3,
          "request_id": "r1", "mfu": 0.43},
     ]
@@ -840,8 +872,9 @@ def _selftest_perf() -> list:
     for needle in (
         "3 steps, 4.000s wall",
         "data_wait            0.500",  # 0.25+0.05+0.2
+        "h2d_stage            0.200",  # the split's H2D slice
         "compile              2.000",
-        "device_execute       1.300",
+        "device_execute       1.100",
         "50.0%",   # compile = 2.0 / 4.0 wall
         "mfu: last 0.4300 over 2 samples",
         "compiles: train_step x1 (2.00s)",
