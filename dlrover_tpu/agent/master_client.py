@@ -735,6 +735,98 @@ class MasterClient:
         digest into the diagnostics history)."""
         self._report(msg.ProfileActionRequest(node_id=node_id))
 
+    # -- serving plane ----------------------------------------------------
+
+    def serve_submit(
+        self,
+        prompt: List[int],
+        max_new_tokens: int = 16,
+        temperature: float = 0.0,
+        request_id: str = "",
+    ) -> msg.ServeSubmitResponse:
+        """Submit one generation request to the master's router.
+        ``request_id`` is an idempotence token: RPC retries resubmit
+        the same id and the ledger keeps one entry. When the caller
+        supplies none, a client-side UUID is minted BEFORE the call —
+        a supervisor retry after a lost response must replay the same
+        token, or every network blip would double-queue the
+        request."""
+        import uuid
+
+        return self._get(
+            msg.ServeSubmitRequest(
+                prompt=[int(t) for t in prompt],
+                max_new_tokens=max_new_tokens,
+                temperature=temperature,
+                request_id=request_id or f"req-{uuid.uuid4().hex}",
+            )
+        )
+
+    def serve_result(
+        self, request_id: str, max_wait: Optional[float] = None
+    ) -> msg.ServeResultResponse:
+        return self._get(
+            msg.ServeResultRequest(request_id=request_id),
+            max_wait=max_wait,
+        )
+
+    def serve_pull(
+        self, replica_id: int, max_items: int = 1
+    ) -> List[msg.ServeWorkItem]:
+        """Replica side: pull up to ``max_items`` dispatched
+        requests off the router's queue."""
+        resp = self._get(
+            msg.ServePullRequest(
+                replica_id=replica_id, max_items=max_items
+            )
+        )
+        return list(resp.items)
+
+    def serve_complete(
+        self,
+        replica_id: int,
+        request_id: str,
+        tokens: List[int],
+        ttft_s: float = 0.0,
+        tpot_s: float = 0.0,
+        finish_reason: str = "",
+        error: str = "",
+    ) -> None:
+        self._report(
+            msg.ServeCompletedReport(
+                replica_id=replica_id,
+                request_id=request_id,
+                tokens=[int(t) for t in tokens],
+                ttft_s=ttft_s,
+                tpot_s=tpot_s,
+                finish_reason=finish_reason,
+                error=error,
+            )
+        )
+
+    def serve_stats(self, replica_id: int, stats: dict) -> None:
+        """Best-effort replica telemetry; a lost report is the next
+        interval's problem, never the step loop's."""
+        try:
+            self._report(
+                msg.ServeStatsReport(
+                    replica_id=replica_id, stats=dict(stats)
+                ),
+                what="serve_stats",
+            )
+        except Exception:  # noqa: BLE001 — telemetry must not kill
+            # the replica loop
+            logger.debug("serve stats report failed", exc_info=True)
+
+    def query_serving(
+        self, max_wait: Optional[float] = None
+    ) -> msg.ServeQueryResponse:
+        """The router's serving snapshot (per-replica health/stats,
+        request counters, QPS/p99) — obs_report --serving's feed."""
+        return self._get(
+            msg.ServeQueryRequest(), max_wait=max_wait
+        )
+
     # -- PS-elastic sparse path ------------------------------------------
 
     @retry()

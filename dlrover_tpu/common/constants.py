@@ -24,6 +24,11 @@ class NodeType:
     DATA_WORKER = "data_worker"
     # Parameter-server-style host for the sparse embedding path.
     EMBEDDING = "embedding"
+    # Inference replica in the serving plane (dlrover_tpu/serving/):
+    # hosts a model copy behind a continuous-batching scheduler,
+    # registered in the same node table as training roles but outside
+    # the training rendezvous and speed accounting.
+    REPLICA = "replica"
     EVALUATOR = "evaluator"
 
     ALL = (MASTER, WORKER, DATA_WORKER, EMBEDDING, EVALUATOR)
@@ -62,6 +67,16 @@ DATA_WORKER_NODE_ID_BASE = 3_000_000
 
 def data_worker_node_id(pod_id: int) -> int:
     return DATA_WORKER_NODE_ID_BASE + pod_id
+
+
+# Serving replicas likewise: replica 0 must never merge onto worker
+# 0's node-table entry (the replica worker namespaces its id before
+# register/heartbeat RPCs, serving/replica.py).
+REPLICA_NODE_ID_BASE = 4_000_000
+
+
+def replica_node_id(replica_id: int) -> int:
+    return REPLICA_NODE_ID_BASE + replica_id
 
 
 class NodeStatus:

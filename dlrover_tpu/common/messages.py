@@ -731,6 +731,123 @@ class PsSetPartitionsRequest:
     map_version: int = 0
 
 
+# ---------------------------------------------------------------------------
+# Serving plane (dlrover_tpu/serving/): clients submit generation
+# requests to the master's router; replicas PULL work and REPORT
+# completions/stats, mirroring the task-manager shard protocol so the
+# same requeue-on-death semantics apply to requests.
+# ---------------------------------------------------------------------------
+
+
+@message
+class ServeSubmitRequest:
+    """Client -> master: one generation request. ``request_id`` is an
+    optional caller idempotence token (resubmitting a known id
+    returns it unchanged instead of double-queueing)."""
+
+    prompt: List[int] = dataclasses.field(default_factory=list)
+    max_new_tokens: int = 16
+    temperature: float = 0.0
+    request_id: str = ""
+
+
+@message
+class ServeSubmitResponse:
+    request_id: str = ""
+    accepted: bool = True
+
+
+@message
+class ServeWorkItem:
+    """One dispatched request on the wire (router -> replica)."""
+
+    request_id: str = ""
+    prompt: List[int] = dataclasses.field(default_factory=list)
+    max_new_tokens: int = 16
+    temperature: float = 0.0
+
+
+@message
+class ServePullRequest:
+    """Replica -> master: give me up to ``max_items`` requests. Only
+    READY replicas are fed; the pull counts as liveness progress."""
+
+    replica_id: int = -1
+    max_items: int = 1
+
+
+@message
+class ServePullResponse:
+    items: List[ServeWorkItem] = dataclasses.field(
+        default_factory=list
+    )
+
+
+@message
+class ServeCompletedReport:
+    """Replica -> master: a request finished (or failed when
+    ``error`` is non-empty). First completion wins in the router's
+    ledger; late duplicates after a requeue are dropped."""
+
+    replica_id: int = -1
+    request_id: str = ""
+    tokens: List[int] = dataclasses.field(default_factory=list)
+    ttft_s: float = 0.0
+    tpot_s: float = 0.0
+    finish_reason: str = ""
+    error: str = ""
+
+
+@message
+class ServeResultRequest:
+    request_id: str = ""
+
+
+@message
+class ServeResultResponse:
+    """The router ledger's view of one request. ``state`` is
+    queued | dispatched | done | failed (empty = unknown id)."""
+
+    request_id: str = ""
+    state: str = ""
+    replica_id: int = -1
+    tokens: List[int] = dataclasses.field(default_factory=list)
+    error: str = ""
+    finish_reason: str = ""
+    requeues: int = 0
+    ttft_s: float = 0.0
+    tpot_s: float = 0.0
+    latency_s: float = 0.0
+
+
+@message
+class ServeStatsReport:
+    """Replica -> master: periodic scheduler telemetry (the
+    ``ContinuousBatchingScheduler.stats()`` dict: queue depth, active
+    sequences, KV pool snapshot, TTFT/TPOT percentiles, token
+    counters). The router treats a moving token counter as serving
+    progress for the replica_unhealthy watchdog."""
+
+    replica_id: int = -1
+    stats: Dict[str, Any] = dataclasses.field(default_factory=dict)
+
+
+@message
+class ServeQueryRequest:
+    """Fetch the router's FULL serving snapshot (per-replica
+    health/stats, request counters, QPS/p99) — the obs_report
+    --serving feed. Deliberately fieldless: there is no per-node
+    filter, and a dead field would advertise one."""
+
+    pass
+
+
+@message
+class ServeQueryResponse:
+    enabled: bool = False
+    snapshot: Dict[str, Any] = dataclasses.field(default_factory=dict)
+
+
 # -- brain service wire messages (standalone brain: brain/server.py) --
 
 

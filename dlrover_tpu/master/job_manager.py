@@ -208,7 +208,18 @@ class JobManager:
         with self._lock:
             if node_id is None:
                 node_id = self._next_node_id
-            self._next_node_id = max(self._next_node_id, node_id + 1)
+            # Only worker-range ids advance the sequence: a
+            # namespaced registration (PS 1M+, evaluator 2M+, data
+            # worker 3M+, replica 4M+) must not drag the worker id
+            # sequence into a role namespace — later worker-sequence
+            # launches would mint ids an arriving namespaced agent
+            # believes are ITS OWN and silently merge onto.
+            from dlrover_tpu.common.constants import PS_NODE_ID_BASE
+
+            if node_id < PS_NODE_ID_BASE:
+                self._next_node_id = max(
+                    self._next_node_id, node_id + 1
+                )
             node = self._nodes.get(node_id)
             if node is not None and node.status in NodeStatus.TERMINAL:
                 # A relaunched agent re-registering under its old id: the
@@ -580,16 +591,38 @@ class JobManager:
         return True
 
     def launch_replacement(
-        self, node: Node, reason: str = ""
+        self,
+        node: Node,
+        reason: str = "",
+        node_id: Optional[int] = None,
     ) -> Optional[Node]:
         """Launch a fresh worker (new id/rank, copied resources) to
         stand in for ``node`` via a ScalePlan — the cordon-then-
         replace half-step: the old node is NOT removed here, so a
         failed probation can roll back by retiring the replacement
-        instead. Returns the PENDING replacement node."""
+        instead. Returns the PENDING replacement node.
+
+        ``node_id`` overrides the worker-sequence id for roles whose
+        agents register under NAMESPACED ids (serving replicas,
+        constants.replica_node_id): the arriving process must be able
+        to claim the PENDING node, which it can only do when the
+        launch used the id it will register with."""
         with self._lock:
-            new_id = self._next_node_id
-            self._next_node_id += 1
+            if node_id is not None:
+                new_id = node_id
+                # Namespaced ids must not drag the worker sequence
+                # into their namespace (same rule as register_node).
+                from dlrover_tpu.common.constants import (
+                    PS_NODE_ID_BASE,
+                )
+
+                if new_id < PS_NODE_ID_BASE:
+                    self._next_node_id = max(
+                        self._next_node_id, new_id + 1
+                    )
+            else:
+                new_id = self._next_node_id
+                self._next_node_id += 1
             resource = (
                 NodeResource.from_dict(node.config_resource.to_dict())
                 if node.config_resource is not None
@@ -837,6 +870,7 @@ class JobManager:
         from dlrover_tpu.common.constants import (
             evaluator_node_id,
             ps_node_id,
+            replica_node_id,
         )
 
         # Role-namespaced ids (same scheme the agents use on their
@@ -845,6 +879,7 @@ class JobManager:
         role_id = {
             NodeType.EVALUATOR: evaluator_node_id,
             NodeType.EMBEDDING: ps_node_id,
+            NodeType.REPLICA: replica_node_id,
         }.get(node_type)
 
         plan = ScalePlan()

@@ -58,6 +58,7 @@ class JobMaster:
         health_interval: Optional[float] = None,
         remediation_config: Optional[dict] = None,
         remediation_interval: Optional[float] = None,
+        serving_config: Optional[dict] = None,
     ):
         """``node_num`` is the desired (max) world size; ``min_nodes``
         (default = node_num) is the smallest world the job may proceed
@@ -133,6 +134,21 @@ class JobMaster:
             ps_manager=self.ps_manager,
             fleet=self.fleet,
         )
+        # Serving plane: the traffic router replicas pull work from.
+        # Always constructed (stdlib-only, idle until a replica
+        # registers); ``serving_config`` tunes SLOs/watchdogs, env
+        # DLROVER_TPU_SERVE_* otherwise (docs/SERVING.md).
+        from dlrover_tpu.serving.router import ServingRouter
+
+        self.serving = ServingRouter(
+            job_manager=self.job_manager,
+            config=serving_config,
+            job_name=(
+                job_name
+                or os.getenv("DLROVER_TPU_JOB_NAME", "default")
+            ),
+        )
+        self.servicer.serving = self.serving
         # Brain datastore: where the health plane persists runtime
         # samples, fleet aggregates + goodput ratio, and verdicts —
         # the same channel ROADMAP item 2's policy engine reads. An
@@ -160,6 +176,7 @@ class JobMaster:
             fleet=self.fleet,
             goodput=self.goodput,
             action_sink=self.servicer.push_action,
+            serving=self.serving,
             brain=self.brain,
             job_name=(
                 job_name
@@ -184,6 +201,7 @@ class JobMaster:
             store=self.timeseries,
             speed_monitor=self.speed_monitor,
             rdzv_managers=(self.elastic_rdzv, self.check_rdzv),
+            serving=self.serving,
             brain=self.brain,
             min_nodes=min_nodes if min_nodes > 0 else node_num,
             job_name=(
@@ -265,6 +283,14 @@ class JobMaster:
         from dlrover_tpu.common.constants import NodeEventType
 
         if event_type != NodeEventType.DELETED:
+            return
+        if node.type == NodeType.REPLICA:
+            # A dead serving replica: its in-flight requests requeue
+            # to the survivors (a kill costs latency, not requests).
+            # Replicas never held shards, rendezvous membership, or
+            # step accounting, so the training cleanup below does not
+            # apply — and must not bounce the training fleet.
+            self.serving.replica_gone(node.id)
             return
         self.task_manager.recover_node_tasks(node.id)
         self.speed_monitor.remove_running_node(node.id)
@@ -435,6 +461,9 @@ class JobMaster:
             self.state_journal.start()
         self.health.start()
         self.remediation.start()
+        # Serving autoscale/SLO loop: no-ops until the serving plane
+        # has ever seen a replica or request.
+        self.serving.start()
         if self._metrics_port is not None:
             from dlrover_tpu.obs.exposition import MetricsHTTPServer
 
@@ -507,6 +536,7 @@ class JobMaster:
         if self.ps_auto_scaler is not None:
             self.ps_auto_scaler.stop()
         self.ps_manager.stop_liveness_monitor()
+        self.serving.stop()
         self.remediation.stop()
         self.health.stop()
         self.task_manager.stop()

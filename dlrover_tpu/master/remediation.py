@@ -25,6 +25,15 @@ data_starvation           FIFO (the agent restarts the process, the node
                           retire it without a replacement — the world
                           shrinks at the next rendezvous boundary, never
                           below ``min_nodes``.
+replica_unhealthy         **serving ladder** (docs/SERVING.md): first
+                          **drain_replica** (the router requeues its
+                          in-flight requests — requests are safe within
+                          one decision), then if the replica stays
+                          convicted **restart_training** (its agent
+                          bounces the replica process), then
+                          **cordon_replace** (a fresh replica node via
+                          ScalePlan). Training peers are never bounced
+                          for a replica subject.
 ========================  ==================================================
 
 The *governors* are the point of this module — every action must pass
@@ -75,7 +84,11 @@ from collections import deque
 from typing import Callable, Dict, List, Optional, Sequence, Set, Tuple
 
 from dlrover_tpu import obs
-from dlrover_tpu.common.constants import EventAction, NodeStatus
+from dlrover_tpu.common.constants import (
+    EventAction,
+    NodeStatus,
+    NodeType,
+)
 from dlrover_tpu.common.log import get_logger
 from dlrover_tpu.obs.health import SEVERITY_CRITICAL, HealthVerdict
 
@@ -86,6 +99,7 @@ REMEDIATION_ENV_PREFIX = "DLROVER_TPU_REMEDIATION_"
 ACTION_RESTART_TRAINING = "restart_training"
 ACTION_CORDON_REPLACE = "cordon_replace"
 ACTION_SHRINK = "shrink"
+ACTION_DRAIN_REPLICA = "drain_replica"
 ACTION_ALERT_ONLY = "alert_only"
 
 # Escalation ladder rungs, per subject: the base action, then
@@ -105,7 +119,19 @@ DETECTOR_ACTIONS: Dict[str, str] = {
     "recompile_storm": ACTION_RESTART_TRAINING,
     "rss_growth": ACTION_RESTART_TRAINING,
     "data_starvation": ACTION_RESTART_TRAINING,
+    "replica_unhealthy": ACTION_DRAIN_REPLICA,
 }
+
+# Serving subjects climb their OWN ladder, indexed by the same rung
+# counter: drain (requests requeue) -> restart (the agent bounces the
+# replica process) -> replace (fresh replica node via ScalePlan) ->
+# alert-only.
+SERVING_LADDER = (
+    ACTION_DRAIN_REPLICA,
+    ACTION_RESTART_TRAINING,
+    ACTION_CORDON_REPLACE,
+    ACTION_ALERT_ONLY,
+)
 
 OUTCOME_PENDING = "pending"
 OUTCOME_ACTED = "acted"
@@ -264,6 +290,7 @@ class RemediationEngine:
         speed_monitor=None,
         auto_scaler=None,
         rdzv_managers: Sequence = (),
+        serving=None,
         brain=None,
         min_nodes: int = 1,
         job_name: str = "default",
@@ -280,6 +307,10 @@ class RemediationEngine:
         self.speed_monitor = speed_monitor
         self.auto_scaler = auto_scaler
         self.rdzv_managers = tuple(rdzv_managers)
+        # Serving router: the drain rung of the replica_unhealthy
+        # ladder calls its drain_replica; None on training-only
+        # masters (the detector then never fires either).
+        self.serving = serving
         self.brain = brain
         self.min_nodes = max(int(min_nodes), 1)
         self.job_name = job_name
@@ -453,15 +484,23 @@ class RemediationEngine:
             )
         )
         if action in (ACTION_CORDON_REPLACE, ACTION_SHRINK):
-            alive = len(self._alive_workers())
-            g["min_nodes"] = (
-                GOVERNOR_OK
-                if alive - 1 >= self.min_nodes
-                else (
-                    f"blocked: {alive} alive worker(s) - 1 < "
-                    f"min_nodes {self.min_nodes}"
+            if v.detector == "replica_unhealthy":
+                # Replica subjects never shrink the TRAINING world
+                # (min_nodes guards workers); serving capacity is
+                # refilled by the replacement and requests queue at
+                # the router meanwhile — the router's min_replicas
+                # governs serving floor separately.
+                g["min_nodes"] = GOVERNOR_OK
+            else:
+                alive = len(self._alive_workers())
+                g["min_nodes"] = (
+                    GOVERNOR_OK
+                    if alive - 1 >= self.min_nodes
+                    else (
+                        f"blocked: {alive} alive worker(s) - 1 < "
+                        f"min_nodes {self.min_nodes}"
+                    )
                 )
-            )
         return g
 
     def _cooldown_for(self, key: Tuple[str, str, int]) -> float:
@@ -489,6 +528,11 @@ class RemediationEngine:
         if base is None:
             return None
         rung = self._ladder.get(subject, RUNG_BASE)
+        if v.detector == "replica_unhealthy":
+            action = SERVING_LADDER[
+                min(rung, len(SERVING_LADDER) - 1)
+            ]
+            return None if action == ACTION_ALERT_ONLY else action
         if rung >= RUNG_ALERT_ONLY:
             return None
         if rung >= RUNG_SHRINK:
@@ -632,6 +676,8 @@ class RemediationEngine:
                 return self._exec_cordon_replace(d)
             if d.action == ACTION_SHRINK:
                 return self._exec_shrink(d)
+            if d.action == ACTION_DRAIN_REPLICA:
+                return self._exec_drain_replica(d)
         except Exception:  # noqa: BLE001 — a failed action is an
             # outcome to record, never an engine crash
             logger.warning(
@@ -651,10 +697,27 @@ class RemediationEngine:
         )
         return True
 
+    def _exec_drain_replica(self, d: RemediationDecision) -> bool:
+        """Serving ladder rung 0: the router stops dispatching to the
+        replica and requeues everything it holds — the requests are
+        safe within this one decision, whatever happens to the
+        replica. The node itself is untouched (a recovered replica
+        re-registers ready)."""
+        if self.serving is None:
+            return False
+        self.serving.drain_replica(d.node_id, reason=d.detector)
+        obs.event(
+            "remediation.drain_replica",
+            node_id=d.node_id, detector=d.detector,
+        )
+        return True
+
     def _exec_cordon_replace(self, d: RemediationDecision) -> bool:
         node = self.job_manager.get_node(d.node_id)
         if node is None or not node.is_alive():
             return False
+        if node.type == NodeType.REPLICA:
+            return self._exec_replace_replica(d, node)
         if not self.job_manager.cordon_node(d.node_id, reason=d.detector):
             return False
         # From here on the node IS cordoned: every further step is
@@ -720,6 +783,78 @@ class RemediationEngine:
             replacement_id=d.replacement_id,
         )
         return True
+
+    def _exec_replace_replica(
+        self, d: RemediationDecision, node
+    ) -> bool:
+        """Serving ladder rung 2: cordon the sick replica node (its
+        fresh incarnations stay benched), drain any requests it
+        re-acquired, and launch a replacement replica node through
+        the ScalePlan seam. Deliberately does NOT touch the training
+        world: no rendezvous removal, no peer restarts, no fleet
+        telemetry purge — a sick replica must never bounce the
+        trainers sharing the control plane."""
+        if not self.job_manager.cordon_node(
+            d.node_id, reason=d.detector
+        ):
+            return False
+        if self.serving is not None:
+            try:
+                self.serving.drain_replica(
+                    d.node_id, reason=d.detector
+                )
+            except Exception:  # noqa: BLE001
+                logger.warning(
+                    "drain during replica replace failed",
+                    exc_info=True,
+                )
+        repl = None
+        try:
+            repl = self.job_manager.launch_replacement(
+                node,
+                reason=d.detector,
+                node_id=self._free_replica_node_id(),
+            )
+        except Exception:  # noqa: BLE001 — same contract as the
+            # worker path: a failed launch is governed by probation
+            logger.warning(
+                "replacement launch for replica %d failed",
+                d.node_id, exc_info=True,
+            )
+        d.replacement_id = repl.id if repl is not None else -1
+        with self._lock:
+            self._cordoned[d.node_id] = {
+                "host": d.host,
+                "detector": d.detector,
+                "decision_id": d.decision_id,
+                "replacement_id": d.replacement_id,
+                "since": d.timestamp,
+            }
+        _CORDONED_NODES.set(len(self._cordoned))
+        obs.event(
+            "remediation.cordon",
+            node_id=d.node_id, host=d.host, detector=d.detector,
+            replacement_id=d.replacement_id, replica=True,
+        )
+        return True
+
+    def _free_replica_node_id(self) -> int:
+        """The lowest replica-namespaced node id with no LIVE node —
+        the same lowest-free-index policy ``ensure_role`` uses for
+        this namespace, so cordon-replace and autoscale share one
+        id-allocation scheme. Replica workers register under
+        base+index (constants.replica_node_id); a replacement
+        launched under a plain worker-sequence id could never be
+        claimed by the arriving process and would sit PENDING
+        forever."""
+        from dlrover_tpu.common.constants import replica_node_id
+
+        idx = 0
+        while True:
+            node = self.job_manager.get_node(replica_node_id(idx))
+            if node is None or not node.is_alive():
+                return replica_node_id(idx)
+            idx += 1
 
     def _exec_shrink(self, d: RemediationDecision) -> bool:
         node = self.job_manager.get_node(d.node_id)
@@ -870,7 +1005,29 @@ class RemediationEngine:
         # would re-enter it and re-run the rollback's side effects
         # (spurious trainer bounce) on a live node.
         with self._lock:
-            if d.action == ACTION_RESTART_TRAINING:
+            if d.detector == "replica_unhealthy":
+                # Serving ladder: the failed rung's successor. A
+                # failed replace ends at alert-only (rollback still
+                # un-cordons below for the replace rung).
+                try:
+                    rung = SERVING_LADDER.index(d.action) + 1
+                except ValueError:
+                    rung = RUNG_ALERT_ONLY
+                self._ladder[subject] = min(rung, RUNG_ALERT_ONLY)
+                if d.action == ACTION_CORDON_REPLACE:
+                    d.outcome = OUTCOME_ROLLED_BACK
+                    d.note = (
+                        "replica replace probation failed; rolled "
+                        "back (un-cordoned), alert-only"
+                    )
+                else:
+                    d.outcome = OUTCOME_ESCALATED
+                    d.note = (
+                        f"probation failed after {d.action}; "
+                        "escalating to "
+                        f"{SERVING_LADDER[self._ladder[subject]]}"
+                    )
+            elif d.action == ACTION_RESTART_TRAINING:
                 # The bounce did not help: escalate to cordon-replace
                 # the next time the subject clears hysteresis again.
                 d.outcome = OUTCOME_ESCALATED
@@ -928,13 +1085,17 @@ class RemediationEngine:
                 replacement_kept=True,
             )
             return
-        for rdzv in self.rdzv_managers:
-            rdzv.add_alive_node(d.node_id)
-        if self.speed_monitor is not None:
-            # The host is back in the world: resume its step
-            # accounting (the EWMA restarts clean, so the old slow
-            # window cannot instantly re-convict it).
-            self.speed_monitor.add_running_node(d.node_id)
+        if node.type != NodeType.REPLICA:
+            # Serving replicas were never rendezvous members or step
+            # reporters: un-cordoning one must not inject it into the
+            # TRAINING world.
+            for rdzv in self.rdzv_managers:
+                rdzv.add_alive_node(d.node_id)
+            if self.speed_monitor is not None:
+                # The host is back in the world: resume its step
+                # accounting (the EWMA restarts clean, so the old
+                # slow window cannot instantly re-convict it).
+                self.speed_monitor.add_running_node(d.node_id)
         # Un-park the trainer: restart_training doubles as un-cordon
         # on the agent side (it clears the cordon flag and rejoins at
         # the next rendezvous).

@@ -113,6 +113,9 @@ class MasterServicer:
         # Remediation engine (set by the JobMaster); None on a bare
         # servicer — queries then answer "disabled, no decisions".
         self.remediation = None
+        # Serving router (set by the JobMaster); None on a bare
+        # servicer — serve RPCs then answer "serving disabled".
+        self.serving = None
         # Per-node forensics history (DiagnosticsReport digests),
         # bounded so a crash-looping node cannot grow master memory.
         # Locked: report and query arrive on different RPC worker
@@ -150,6 +153,12 @@ class MasterServicer:
         g(msg.DiagnosticsQueryRequest, self._query_diagnostics)
         g(msg.HealthQueryRequest, self._query_health)
         g(msg.RemediationQueryRequest, self._query_remediation)
+        g(msg.ServeSubmitRequest, self._serve_submit)
+        g(msg.ServeResultRequest, self._serve_result)
+        g(msg.ServePullRequest, self._serve_pull)
+        g(msg.ServeQueryRequest, self._serve_query)
+        r(msg.ServeCompletedReport, self._serve_complete)
+        r(msg.ServeStatsReport, self._serve_stats)
 
         r(msg.KVStoreSetRequest, self._kv_set)
         r(msg.DatasetShardParams, self._create_dataset)
@@ -585,6 +594,79 @@ class MasterServicer:
             node_id=req.node_id, limit=req.limit
         )
 
+    # -- serving plane ------------------------------------------------------
+
+    def _serve_submit(self, req: msg.ServeSubmitRequest):
+        if self.serving is None:
+            return msg.ServeSubmitResponse(
+                request_id="", accepted=False
+            )
+        rid = self.serving.submit(
+            prompt=req.prompt,
+            max_new_tokens=req.max_new_tokens,
+            temperature=req.temperature,
+            request_id=req.request_id,
+        )
+        return msg.ServeSubmitResponse(
+            request_id=rid or "", accepted=rid is not None
+        )
+
+    def _serve_result(self, req: msg.ServeResultRequest):
+        if self.serving is None:
+            return msg.ServeResultResponse(
+                request_id=req.request_id
+            )
+        rec = self.serving.result(req.request_id)
+        if rec is None:
+            return msg.ServeResultResponse(
+                request_id=req.request_id
+            )
+        return msg.ServeResultResponse(**rec)
+
+    def _serve_pull(self, req: msg.ServePullRequest):
+        if self.serving is None:
+            return msg.ServePullResponse()
+        items = self.serving.pull(
+            req.replica_id, max_items=max(req.max_items, 1)
+        )
+        return msg.ServePullResponse(
+            items=[
+                msg.ServeWorkItem(
+                    request_id=r.request_id,
+                    prompt=list(r.prompt),
+                    max_new_tokens=r.max_new_tokens,
+                    temperature=r.temperature,
+                )
+                for r in items
+            ]
+        )
+
+    def _serve_complete(self, req: msg.ServeCompletedReport):
+        if self.serving is None:
+            return None
+        self.serving.complete(
+            replica_id=req.replica_id,
+            request_id=req.request_id,
+            tokens=req.tokens,
+            ttft_s=req.ttft_s,
+            tpot_s=req.tpot_s,
+            finish_reason=req.finish_reason,
+            error=req.error,
+        )
+        return None
+
+    def _serve_stats(self, req: msg.ServeStatsReport):
+        if self.serving is not None:
+            self.serving.report_stats(req.replica_id, req.stats)
+        return None
+
+    def _serve_query(self, req: msg.ServeQueryRequest):
+        if self.serving is None:
+            return msg.ServeQueryResponse(enabled=False)
+        return msg.ServeQueryResponse(
+            enabled=True, snapshot=self.serving.snapshot()
+        )
+
     def diagnose_node(self, node_id: int) -> None:
         """Queue an on-demand stack-and-state snapshot on the node
         (operator trigger or the SpeedMonitor's straggler/hang
@@ -622,6 +704,16 @@ class MasterServicer:
             # out of the rendezvous alive-sets and speed accounting —
             # until the remediation engine un-cordons or retires it.
             self.push_action(node.id, EventAction.CORDON.value)
+            return None
+        if node.type == NodeType.REPLICA:
+            # Serving replicas live in the node table (heartbeats,
+            # watchdog, remediation) but outside the TRAINING world:
+            # no rendezvous membership, no step accounting. Their
+            # registration feeds the router's replica registry.
+            if self.serving is not None:
+                self.serving.register_replica(
+                    node.id, addr=req.node_ip
+                )
             return None
         if node.type not in (
             NodeType.EVALUATOR, NodeType.DATA_WORKER

@@ -258,6 +258,195 @@ def llama_decode_step(params, cache: KVCache, token, pos, cfg,
     return logits, KVCache(k=k_new, v=v_new)
 
 
+# ---------------------------------------------------------------------------
+# Serving path: ragged (per-lane-position) decode + lane-granular
+# prefill over one shared multi-lane cache. This is the model half of
+# the continuous-batching scheduler (dlrover_tpu/serving/scheduler.py):
+# every batch lane hosts a DIFFERENT sequence at a DIFFERENT position,
+# so positions are vectors, cache writes are per-lane scatters, and
+# prompt prefill lands chunk-by-chunk into one lane without touching
+# the others. Llama-family configs only (the serving fleet's family);
+# GPT's absolute position table would slot in the same way.
+# ---------------------------------------------------------------------------
+
+
+def _apply_rope_gathered(x, cos_t, sin_t, pos):
+    """Rotate x [B, 1, H, D] with each lane at its OWN position:
+    ``pos`` [B] int32 gathers per-lane rows from the precomputed
+    tables. Same split-halves convention as llama.apply_rope."""
+    cos = cos_t[pos][:, None, None, :]  # [B, 1, 1, d2]
+    sin = sin_t[pos][:, None, None, :]
+    d2 = cos.shape[-1]
+    x1, x2 = x[..., :d2], x[..., d2:2 * d2]
+    c = cos.astype(x.dtype)
+    s = sin.astype(x.dtype)
+    parts = [x1 * c - x2 * s, x2 * c + x1 * s]
+    if 2 * d2 < x.shape[-1]:
+        parts.append(x[..., 2 * d2:])
+    return jnp.concatenate(parts, axis=-1)
+
+
+def _cached_attention_ragged(q, k_cache, v_cache, pos, window=None):
+    """q [B,1,H,D] against cache [B,T,H_kv,D] with PER-LANE positions
+    ``pos`` [B]: lane b sees keys idx <= pos[b] (band-clamped under a
+    sliding window). Grouped-query handled exactly like
+    :func:`_cached_attention` — no expanded cache copies."""
+    b, t, hkv, d = k_cache.shape
+    h = q.shape[2]
+    g = h // hkv
+    qg = q.reshape(b, 1, hkv, g, d)
+    s = jnp.einsum(
+        "bqhgd,bkhd->bhgqk", qg, k_cache,
+        preferred_element_type=jnp.float32,
+    ) / np.sqrt(d)
+    idx = jnp.arange(t)[None, None, None, None, :]
+    p = pos[:, None, None, None, None]
+    mask = idx <= p
+    if window is not None:
+        mask &= (p - idx) < window
+    s = jnp.where(mask, s, -1e30)
+    att = jax.nn.softmax(s, axis=-1).astype(v_cache.dtype)
+    o = jnp.einsum("bhgqk,bkhd->bqhgd", att, v_cache)
+    return o.reshape(b, 1, h, d)
+
+
+def llama_decode_step_ragged(params, cache: KVCache, token, pos, cfg,
+                             rope=None, active=None):
+    """One continuous-batching decode step: token [B] int32, pos [B]
+    int32 — every lane advances at its own position. Cache updates are
+    one vectorized scatter per layer (``.at[lane, pos[lane]].set``);
+    rope rows gather per lane; attention masks per lane. Returns
+    (logits [B, vocab] f32, new cache).
+
+    ``active`` [B] bool masks the CACHE WRITES: an inactive lane (no
+    sequence, or one still mid-prefill) must not have its own cache
+    touched — without the mask, every decode step would scatter a
+    garbage key at ``pos[b]`` of lane b (the scheduler passes 0 for
+    idle lanes), clobbering position 0 of a lane whose chunked
+    prefill is still in flight. Inactive lanes still COMPUTE garbage
+    logits the scheduler never reads — the price of one static-shape
+    program for any active set; only their writes are suppressed.
+    ``active=None`` means all lanes write (the all-decoding batch)."""
+    B = token.shape[0]
+    x = params["wte"][token][:, None, :].astype(cfg.dtype)  # [B,1,E]
+    cos_t, sin_t = rope if rope is not None else llama_mod.rope_table(
+        cfg, cfg.block_size
+    )
+    lanes = jnp.arange(B)
+    write_mask = (
+        None if active is None else active[:, None, None]
+    )
+
+    def body(x, layer):
+        lp, k_c, v_c = layer
+        h = llama_mod._rms_norm(x, lp["rms1"], cfg.rms_eps)
+        q, k, v = _llama_qkv(h, lp, cfg, B, 1)
+        q = _apply_rope_gathered(q, cos_t, sin_t, pos)
+        k = _apply_rope_gathered(k, cos_t, sin_t, pos)
+        k_w, v_w = k[:, 0], v[:, 0]
+        if write_mask is not None:
+            k_w = jnp.where(write_mask, k_w, k_c[lanes, pos])
+            v_w = jnp.where(write_mask, v_w, v_c[lanes, pos])
+        k_c = k_c.at[lanes, pos].set(k_w)
+        v_c = v_c.at[lanes, pos].set(v_w)
+        att = _cached_attention_ragged(
+            q, k_c, v_c, pos,
+            window=getattr(cfg, "sliding_window", None),
+        ).reshape(B, 1, cfg.n_embd)
+        x = x + att @ lp["wo"]
+        h = llama_mod._rms_norm(x, lp["rms2"], cfg.rms_eps)
+        return _llama_mlp(x, h, lp, cfg), (k_c, v_c)
+
+    x, (k_new, v_new) = jax.lax.scan(
+        body, x, (params["blocks"], cache.k, cache.v)
+    )
+    x = llama_mod._rms_norm(x, params["rmsf"], cfg.rms_eps)
+    logits = llama_mod.head_logits(params, x)[:, 0]
+    return logits, KVCache(k=k_new, v=v_new)
+
+
+def _rect_attention_dense(q, k, v, start, window=None):
+    """Rectangular causal attention for a lane prefill chunk: q
+    [1,C,H,D] at absolute positions start..start+C against the lane's
+    full key range [1,T,H_kv,D]; key j visible to chunk query i iff
+    j <= start + i (band-clamped under a window). Dense masked einsum
+    — the serving chunk is small, so the [C,T] score tile is cheap;
+    the long-context path keeps ops/flash_attention_rect."""
+    b, c, hq, d = q.shape
+    t, hkv = k.shape[1], k.shape[2]
+    g = hq // hkv
+    qg = q.reshape(b, c, hkv, g, d)
+    s = jnp.einsum(
+        "bqhgd,bkhd->bhgqk", qg, k,
+        preferred_element_type=jnp.float32,
+    ) / np.sqrt(d)
+    qi = start + jnp.arange(c)[None, None, None, :, None]
+    ki = jnp.arange(t)[None, None, None, None, :]
+    mask = ki <= qi
+    if window is not None:
+        mask &= (qi - ki) < window
+    s = jnp.where(mask, s, -1e30)
+    att = jax.nn.softmax(s, axis=-1).astype(v.dtype)
+    o = jnp.einsum("bhgqk,bkhd->bqhgd", att, v)
+    return o.reshape(b, c, hq, d)
+
+
+def llama_lane_prefill_chunk(params, cache: KVCache, tokens, lane,
+                             start, cfg, rope=None):
+    """Prefill ``tokens`` [1, C] of ONE sequence into lane ``lane`` of
+    the shared multi-lane cache at positions [start, start+C), leaving
+    every other lane untouched — the bounded prefill admission step of
+    the continuous-batching scheduler (decode latency is protected by
+    capping C, not by pausing the whole batch for a monolithic
+    prompt pass).
+
+    ``lane`` and ``start`` are traced scalars, so one compiled program
+    serves every lane/offset for a given chunk length C; the scheduler
+    pads ragged final chunks up to C (padded positions write garbage
+    that the next chunk or decode step overwrites BEFORE any mask can
+    expose it, and padded queries' outputs are discarded host-side).
+
+    Returns (chunk logits [1, C, vocab] f32, cache) — all chunk
+    positions, so the caller samples the first token from the last
+    REAL position of a padded final chunk."""
+    B, C = tokens.shape
+    if B != 1:
+        raise ValueError(
+            f"lane prefill takes one sequence, got batch {B}"
+        )
+    cos_t, sin_t = rope if rope is not None else llama_mod.rope_table(
+        cfg, cfg.block_size
+    )
+    cos = jax.lax.dynamic_slice_in_dim(cos_t, start, C, 0)
+    sin = jax.lax.dynamic_slice_in_dim(sin_t, start, C, 0)
+    x = params["wte"][tokens].astype(cfg.dtype)  # [1,C,E]
+
+    def body(x, layer):
+        lp, k_c, v_c = layer
+        h = llama_mod._rms_norm(x, lp["rms1"], cfg.rms_eps)
+        q, k, v = _llama_qkv(h, lp, cfg, B, C)
+        q = llama_mod.apply_rope(q, cos, sin)
+        k = llama_mod.apply_rope(k, cos, sin)
+        k_c = jax.lax.dynamic_update_slice(k_c, k, (lane, start, 0, 0))
+        v_c = jax.lax.dynamic_update_slice(v_c, v, (lane, start, 0, 0))
+        k_lane = jax.lax.dynamic_slice_in_dim(k_c, lane, 1, 0)
+        v_lane = jax.lax.dynamic_slice_in_dim(v_c, lane, 1, 0)
+        att = _rect_attention_dense(
+            q, k_lane, v_lane, start,
+            window=getattr(cfg, "sliding_window", None),
+        ).reshape(B, C, cfg.n_embd)
+        x = x + att @ lp["wo"]
+        h = llama_mod._rms_norm(x, lp["rms2"], cfg.rms_eps)
+        return _llama_mlp(x, h, lp, cfg), (k_c, v_c)
+
+    x, (k_new, v_new) = jax.lax.scan(
+        body, x, (params["blocks"], cache.k, cache.v)
+    )
+    x = llama_mod._rms_norm(x, params["rmsf"], cfg.rms_eps)
+    logits = llama_mod.head_logits(params, x)
+    return logits, KVCache(k=k_new, v=v_new)
+
+
 def _fns_for(cfg) -> tuple:
     """(prefill_fn, step_fn) with model-specific constants (rope
     tables) precomputed once, outside any scan."""

@@ -26,6 +26,11 @@ straggler_persistence     the speed monitor has scored the same host a
                           straggler for N consecutive evaluations
 heartbeat_gap             an alive node's last heartbeat is a large
                           fraction of the way to the timeout
+replica_unhealthy         a serving replica holds dispatched requests
+                          without progress past the router's timeout
+                          (or is draining and never came back) — the
+                          verdict the remediation ladder drains,
+                          restarts, then replaces on
 ========================  =====================================================
 
 Each verdict carries a severity (``info``/``warn``/``critical``), the
@@ -124,6 +129,9 @@ DEFAULTS: Dict[str, float] = {
     # heartbeat gap (fraction of the heartbeat timeout)
     "heartbeat_warn_frac": 0.5,
     "heartbeat_crit_frac": 0.8,
+    # replica_unhealthy: staleness as a multiple of the serving
+    # router's progress timeout that escalates warn -> critical
+    "replica_stall_crit_ratio": 2.0,
 }
 
 
@@ -220,6 +228,7 @@ class HealthMonitor:
         fleet=None,
         goodput=None,
         action_sink: Optional[Callable[[int, str], None]] = None,
+        serving=None,
         brain=None,
         job_name: str = "default",
         heartbeat_timeout: float = 180.0,
@@ -234,6 +243,10 @@ class HealthMonitor:
         self.fleet = fleet
         self.goodput = goodput
         self.action_sink = action_sink
+        # Serving router (or any provider of ``unhealthy_replicas()``
+        # facts) — the replica_unhealthy detector's feed; None on
+        # training-only masters.
+        self.serving = serving
         self.brain = brain
         self.job_name = job_name
         self.heartbeat_timeout = heartbeat_timeout
@@ -270,6 +283,7 @@ class HealthMonitor:
             self._detect_rss_growth,
             self._detect_straggler_persistence,
             self._detect_heartbeat_gap,
+            self._detect_replica_unhealthy,
         ]
         _HEALTH_SCORE.set(1.0)
 
@@ -674,6 +688,63 @@ class HealthMonitor:
                     evidence_series="heartbeat_age_s",
                     evidence=[(self.clock(), age)],
                     metrics={"age_s": age, "timeout_frac": frac},
+                    timestamp=self.clock(),
+                )
+            )
+        return out
+
+    def _detect_replica_unhealthy(self) -> List[HealthVerdict]:
+        """A serving replica that is demonstrably not serving: READY
+        with dispatched requests and no progress past the router's
+        ``progress_timeout_s``, or DRAINING and never re-registered.
+        No suggested heartbeat action — the remediation engine owns
+        the response ladder (drain -> restart -> replace), keyed on
+        this detector."""
+        if self.serving is None:
+            return []
+        try:
+            facts = self.serving.unhealthy_replicas()
+        except Exception:  # noqa: BLE001 — a router bug must not
+            # kill the evaluation tick
+            logger.warning(
+                "serving unhealthy_replicas probe failed",
+                exc_info=True,
+            )
+            return []
+        crit_ratio = self._cfg("replica_stall_crit_ratio")
+        out: List[HealthVerdict] = []
+        for f in facts:
+            stale = float(f.get("stale_s", 0.0))
+            timeout = max(float(f.get("timeout_s", 1.0)), 1e-9)
+            severity = (
+                SEVERITY_CRITICAL
+                if stale >= crit_ratio * timeout
+                or f.get("state") == "draining"
+                else SEVERITY_WARN
+            )
+            out.append(
+                HealthVerdict(
+                    detector="replica_unhealthy",
+                    severity=severity,
+                    message=(
+                        f"serving replica {f.get('replica_id')} "
+                        f"({f.get('state')}) holds "
+                        f"{f.get('dispatched', 0)} request(s) with "
+                        f"no progress for {stale:.1f}s "
+                        f"(timeout {timeout:.1f}s)"
+                    ),
+                    node_id=int(f.get("replica_id", -1)),
+                    host=str(f.get("addr", "")),
+                    suggested_action="",
+                    evidence_series="serving.replica_progress_age_s",
+                    evidence=[(self.clock(), stale)],
+                    metrics={
+                        "stale_s": stale,
+                        "timeout_s": timeout,
+                        "dispatched": float(
+                            f.get("dispatched", 0)
+                        ),
+                    },
                     timestamp=self.clock(),
                 )
             )

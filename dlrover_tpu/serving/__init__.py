@@ -1,0 +1,44 @@
+"""Elastic serving plane: continuous-batching decode on the training
+control plane (ROADMAP item 1).
+
+The elasticity stack that keeps *training* alive — master node table,
+heartbeat watchdog, health verdicts, governed remediation, ScalePlans
+— here serves *inference*:
+
+* :mod:`dlrover_tpu.serving.kv_pool` — block-granular KV cache
+  accounting (fixed-size blocks, alloc/free per sequence, utilization
+  gauge): the admission currency of the scheduler, PagedAttention's
+  memory model over the repo's dense multi-lane cache.
+* :mod:`dlrover_tpu.serving.scheduler` — the per-replica
+  continuous-batching scheduler (Orca-style iteration-level
+  scheduling): new sequences join the running decode batch every
+  step, prompts prefill in bounded chunks so decode latency is
+  protected, and pool exhaustion preempts the youngest sequence
+  instead of wedging the batch.
+* :mod:`dlrover_tpu.serving.replica` — the replica worker an agent
+  hosts: registers in the master's node table as ``NodeType.REPLICA``,
+  pulls work from the router, steps its scheduler, reports
+  completions/stats, heartbeats like any other node.
+* :mod:`dlrover_tpu.serving.router` — the master-side traffic router:
+  request ledger (queued → dispatched → done), replica registry fed by
+  the node table, drain + requeue on replica death (a kill costs
+  latency, not requests), progress watchdog feeding the
+  ``replica_unhealthy`` health verdict, and QPS/p99-driven replica
+  scaling through the ScalePlan seam.
+
+The request lifecycle, SLO knobs, and drain semantics are documented
+in docs/SERVING.md; ``tools/serve_drill.py --selftest`` is the
+hermetic acceptance drill (multi-replica traffic through one replica
+kill, zero dropped requests).
+"""
+
+from dlrover_tpu.serving.kv_pool import KVBlockPool  # noqa: F401
+from dlrover_tpu.serving.router import (  # noqa: F401
+    ServingRouter,
+    render_serving,
+)
+from dlrover_tpu.serving.scheduler import (  # noqa: F401
+    CompletedRequest,
+    ContinuousBatchingScheduler,
+    ServeRequest,
+)

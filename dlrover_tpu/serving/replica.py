@@ -1,0 +1,315 @@
+"""Replica worker: one model copy behind a continuous-batching
+scheduler, attached to the master's control plane.
+
+The worker is the serving counterpart of a training agent's trainer
+process: it registers in the master's node table as
+``NodeType.REPLICA`` (namespaced id, constants.replica_node_id),
+heartbeats like any node (so the existing watchdog declares it dead
+and the router requeues its work), PULLS requests off the router
+(mirroring the shard protocol's ``get_task``), steps its scheduler,
+and reports completions and periodic stats.
+
+Heartbeat actions it honors:
+
+* ``restart_training`` — bounce in place: drop local scheduler state
+  and re-register (the router requeues anything the old incarnation
+  held on re-registration, so the requests ride to a healthy replica
+  or back to this fresh one);
+* ``cordon`` — park: stop pulling work (still heartbeating) until a
+  ``restart_training`` un-parks.
+
+Runnable standalone for drills and local serving::
+
+    python -m dlrover_tpu.serving.replica --master 127.0.0.1:PORT \
+        --replica_id 0 --seed 7
+
+(the CLI builds a seed-deterministic tiny Llama so every replica of
+the fleet holds the SAME model — the drill's requeue-equivalence
+assertions depend on it).
+"""
+
+from __future__ import annotations
+
+import argparse
+import threading
+import time
+from typing import Optional
+
+from dlrover_tpu import obs
+from dlrover_tpu.common.constants import (
+    EventAction,
+    NodeType,
+    replica_node_id,
+)
+from dlrover_tpu.common.log import get_logger
+from dlrover_tpu.serving.scheduler import (
+    ContinuousBatchingScheduler,
+    ServeRequest,
+)
+
+logger = get_logger("serving.replica")
+
+
+class ReplicaWorker:
+    def __init__(
+        self,
+        master_addr: str,
+        replica_id: int,
+        params,
+        cfg,
+        lanes: int = 2,
+        max_len: Optional[int] = None,
+        block_size: int = 8,
+        prefill_chunk: int = 16,
+        total_blocks: Optional[int] = None,
+        eos_id: Optional[int] = None,
+        heartbeat_interval: float = 1.0,
+        stats_interval: float = 1.0,
+        pull_batch: int = 4,
+        idle_sleep_s: float = 0.02,
+        name: str = "",
+    ):
+        from dlrover_tpu.agent.master_client import MasterClient
+
+        self.replica_id = replica_id
+        self.node_id = replica_node_id(replica_id)
+        self.name = name or f"replica-{replica_id}"
+        self.client = MasterClient(
+            master_addr, node_id=self.node_id
+        )
+        self._sched_kwargs = dict(
+            lanes=lanes,
+            max_len=max_len,
+            block_size=block_size,
+            prefill_chunk=prefill_chunk,
+            total_blocks=total_blocks,
+            eos_id=eos_id,
+        )
+        self.params = params
+        self.cfg = cfg
+        self.scheduler = ContinuousBatchingScheduler(
+            params, cfg, **self._sched_kwargs
+        )
+        self.heartbeat_interval = heartbeat_interval
+        self.stats_interval = stats_interval
+        self.pull_batch = pull_batch
+        self.idle_sleep_s = idle_sleep_s
+        self._stop = threading.Event()
+        self._parked = False
+        self._last_hb = 0.0
+        self._last_stats = 0.0
+        self._thread: Optional[threading.Thread] = None
+        self.restarts = 0
+
+    # -- lifecycle ----------------------------------------------------------
+
+    def register(self) -> None:
+        self.client.register_node(
+            node_type=NodeType.REPLICA, node_ip=self.name
+        )
+        obs.event(
+            "serve.replica_register",
+            replica_id=self.node_id, replica_name=self.name,
+        )
+
+    def start(self) -> None:
+        if self._thread is None:
+            self._stop.clear()
+            self._thread = threading.Thread(
+                target=self.run_forever,
+                name=f"replica-{self.replica_id}",
+                daemon=True,
+            )
+            self._thread.start()
+
+    def stop(self) -> None:
+        self._stop.set()
+        if self._thread is not None:
+            self._thread.join(timeout=10.0)
+            self._thread = None
+        self.client.close()
+
+    # -- loop ---------------------------------------------------------------
+
+    def _heartbeat_tick(self, now: float) -> None:
+        if now - self._last_hb < self.heartbeat_interval:
+            return
+        self._last_hb = now
+        try:
+            action = self.client.heartbeat()
+        except Exception:  # noqa: BLE001 — the supervisor inside the
+            # client already classified; a heartbeat miss is the
+            # master watchdog's signal, not ours to crash on
+            logger.debug("replica heartbeat failed", exc_info=True)
+            return
+        if action == EventAction.RESTART_TRAINING.value:
+            self.restart_in_place()
+        elif action == EventAction.CORDON.value:
+            if not self._parked:
+                logger.warning(
+                    "replica %d parked by cordon", self.replica_id
+                )
+            self._parked = True
+
+    def restart_in_place(self) -> None:
+        """The restart rung of the serving ladder, executed locally:
+        drop every local sequence (a fresh incarnation), rebuild the
+        scheduler, and re-register — the router requeues whatever the
+        old incarnation still held the moment it sees the
+        re-registration, so no request depends on our dropped
+        state."""
+        dropped = len(self.scheduler.drain())
+        self.scheduler = ContinuousBatchingScheduler(
+            self.params, self.cfg, **self._sched_kwargs
+        )
+        self.restarts += 1
+        self._parked = False
+        obs.event(
+            "serve.replica_restart",
+            replica_id=self.node_id, dropped=dropped,
+        )
+        logger.warning(
+            "replica %d restarted in place (%d request(s) dropped "
+            "for requeue)", self.replica_id, dropped,
+        )
+        try:
+            self.register()
+        except Exception:  # noqa: BLE001
+            logger.warning(
+                "re-register after restart failed", exc_info=True
+            )
+
+    def _stats_tick(self, now: float) -> None:
+        if now - self._last_stats < self.stats_interval:
+            return
+        self._last_stats = now
+        self.client.serve_stats(self.node_id, self.scheduler.stats())
+
+    def run_once(self) -> int:
+        """One loop iteration: heartbeat, pull, step, report.
+        Returns the number of requests completed (drives the idle
+        backoff)."""
+        now = time.monotonic()
+        self._heartbeat_tick(now)
+        self._stats_tick(now)
+        if self._parked:
+            return 0
+        want = min(self.scheduler.capacity_hint(), self.pull_batch)
+        if want > 0:
+            try:
+                items = self.client.serve_pull(
+                    self.node_id, max_items=want
+                )
+            except Exception:  # noqa: BLE001 — a pull miss is
+                # retried next iteration
+                logger.debug("serve pull failed", exc_info=True)
+                items = []
+            for item in items:
+                self.scheduler.submit(
+                    ServeRequest(
+                        request_id=item.request_id,
+                        prompt=list(item.prompt),
+                        max_new_tokens=item.max_new_tokens,
+                        temperature=item.temperature,
+                    )
+                )
+        completed = self.scheduler.step()
+        for c in completed:
+            try:
+                self.client.serve_complete(
+                    self.node_id,
+                    c.request_id,
+                    c.tokens,
+                    ttft_s=c.ttft_s,
+                    tpot_s=c.tpot_s,
+                    finish_reason=c.finish_reason,
+                    error=c.error,
+                )
+            except Exception:  # noqa: BLE001 — the router requeues
+                # on our death; a lost completion costs a recompute,
+                # never the request
+                logger.warning(
+                    "completion report for %s failed", c.request_id,
+                    exc_info=True,
+                )
+        return len(completed)
+
+    def run_forever(self) -> None:
+        self.register()
+        while not self._stop.is_set():
+            busy = self.run_once()
+            # Back off when there is nothing to step: idle, or
+            # parked by a cordon (a parked replica skipping its
+            # scheduler must not busy-spin a core while it waits for
+            # the master's verdict).
+            if not busy and (
+                self._parked
+                or (
+                    self.scheduler.active() == 0
+                    and self.scheduler.queue_depth() == 0
+                )
+            ):
+                self._stop.wait(self.idle_sleep_s)
+
+
+def build_tiny_model(seed: int, block_size: int = 128):
+    """The drill fleet's model: a seed-deterministic tiny Llama —
+    every replica built from the same seed holds bitwise-identical
+    weights, so greedy results are replica-independent."""
+    import dataclasses as _dc
+
+    import jax
+
+    from dlrover_tpu.models import llama
+
+    cfg = _dc.replace(
+        llama.LlamaConfig.tiny(), block_size=block_size
+    )
+    params = llama.init_params(jax.random.PRNGKey(seed), cfg)
+    return params, cfg
+
+
+def main(argv=None) -> int:
+    p = argparse.ArgumentParser("dlrover-tpu-replica")
+    p.add_argument("--master", required=True, help="host:port")
+    p.add_argument("--replica_id", type=int, required=True)
+    p.add_argument(
+        "--seed", type=int, default=0,
+        help="model seed (all fleet replicas must share it)",
+    )
+    p.add_argument("--lanes", type=int, default=2)
+    p.add_argument("--block_size", type=int, default=8)
+    p.add_argument("--prefill_chunk", type=int, default=16)
+    p.add_argument("--max_len", type=int, default=64)
+    p.add_argument("--heartbeat_interval", type=float, default=1.0)
+    p.add_argument("--stats_interval", type=float, default=1.0)
+    p.add_argument("--pull_batch", type=int, default=4)
+    args = p.parse_args(argv)
+    params, cfg = build_tiny_model(
+        args.seed, block_size=max(args.max_len, 64)
+    )
+    worker = ReplicaWorker(
+        args.master,
+        args.replica_id,
+        params,
+        cfg,
+        lanes=args.lanes,
+        max_len=args.max_len,
+        block_size=args.block_size,
+        prefill_chunk=args.prefill_chunk,
+        heartbeat_interval=args.heartbeat_interval,
+        stats_interval=args.stats_interval,
+        pull_batch=args.pull_batch,
+    )
+    print(f"DLROVER_TPU_REPLICA={args.replica_id}", flush=True)
+    try:
+        worker.run_forever()
+    except KeyboardInterrupt:
+        pass
+    return 0
+
+
+if __name__ == "__main__":
+    import sys
+
+    sys.exit(main())

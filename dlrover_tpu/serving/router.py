@@ -1,0 +1,780 @@
+"""Master-side serving router: the traffic half of the control plane.
+
+The master already knows how to keep a *fleet* honest — node table,
+heartbeat watchdog, health verdicts, governed remediation, ScalePlans.
+This router gives the same machinery *requests* to protect:
+
+* a **request ledger** (queued → dispatched → done/failed) mirroring
+  the task manager's shard ledger: replicas PULL work (like
+  ``get_task``) and REPORT completions, so a dead replica simply
+  stops pulling and its dispatched requests are *requeued*, not lost
+  — a replica kill costs latency, never requests;
+* a **replica registry** fed by the existing node table (replicas
+  register as ``NodeType.REPLICA`` through the normal
+  ``NodeAddressRequest`` path, heartbeat like any node; the node
+  watchdog's DELETED event routes here as :meth:`replica_gone`);
+* a **progress watchdog**: a replica holding dispatched work without
+  progress past ``progress_timeout_s`` surfaces through
+  :meth:`unhealthy_replicas` — the feed of the health plane's
+  ``replica_unhealthy`` detector, which in turn drives the
+  remediation ladder drain → restart → replace;
+* **SLO-driven scaling** through the ScalePlan seam:
+  :meth:`maybe_autoscale` grows the replica role when the queue backs
+  up or completion p99 breaches ``p99_slo_s``, and shrinks idle
+  capacity down to ``min_replicas`` — the same
+  ``JobManager.ensure_role`` / ``retire_node`` seams training
+  elasticity uses.
+
+First completion wins: a request requeued off a slow-but-alive
+replica may later be completed twice; the ledger keeps the first
+result and drops the duplicate (same idempotence contract as the
+shard ledger's replayed task results).
+
+Every knob reads ``DLROVER_TPU_SERVE_<KNOB>`` (see DEFAULTS),
+overridable per-instance via ``config=``; the clock is injectable so
+the watchdog and SLO windows are hermetically testable.
+"""
+
+from __future__ import annotations
+
+import itertools
+import os
+import threading
+import time
+from collections import deque
+from typing import Callable, Dict, List, Optional
+
+from dlrover_tpu import obs
+from dlrover_tpu.common.log import get_logger
+from dlrover_tpu.serving.scheduler import ServeRequest
+
+logger = get_logger("serving.router")
+
+SERVE_ENV_PREFIX = "DLROVER_TPU_SERVE_"
+
+REQ_QUEUED = "queued"
+REQ_DISPATCHED = "dispatched"
+REQ_DONE = "done"
+REQ_FAILED = "failed"
+
+REPLICA_READY = "ready"
+REPLICA_DRAINING = "draining"
+
+_REQUESTS_TOTAL = obs.counter(
+    "dlrover_serve_requests_total",
+    "Requests through the serving router, by outcome (submitted / "
+    "completed / failed / requeued / rejected / duplicate)",
+    ("outcome",),
+)
+_ROUTER_QUEUE = obs.gauge(
+    "dlrover_serve_queue_depth",
+    "Requests queued at the router awaiting dispatch to a replica",
+)
+_ROUTER_INFLIGHT = obs.gauge(
+    "dlrover_serve_inflight",
+    "Requests currently dispatched to replicas and not yet completed",
+)
+_REPLICAS_GAUGE = obs.gauge(
+    "dlrover_serve_replicas",
+    "Registered serving replicas, by state (ready / draining)",
+    ("state",),
+)
+_P99_GAUGE = obs.gauge(
+    "dlrover_serve_p99_latency_seconds",
+    "p99 end-to-end request latency over the router's recent window",
+)
+_QPS_GAUGE = obs.gauge(
+    "dlrover_serve_qps",
+    "Completed requests per second over the router's recent window",
+)
+
+DEFAULTS: Dict[str, float] = {
+    # A ready replica holding dispatched work with no progress for
+    # this long is unhealthy (feeds the replica_unhealthy verdict);
+    # a draining one that never came back keeps the verdict alive so
+    # the remediation ladder can escalate drain -> restart -> replace.
+    "progress_timeout_s": 10.0,
+    "max_queue": 4096.0,
+    # scaling SLOs
+    "p99_slo_s": 30.0,
+    "backlog_per_replica": 8.0,
+    "min_replicas": 1.0,
+    "max_replicas": 8.0,
+    "scale_cooldown_s": 60.0,
+    # completed-latency / QPS windows
+    "latency_window": 256.0,
+    "qps_window_s": 60.0,
+    # finished-request ledger retention: done/failed records past
+    # this count are evicted oldest-first (their results become
+    # unknown to late pollers) — the master-side bounded-history
+    # invariant; cumulative done/failed counters survive eviction
+    "ledger_retention": 4096.0,
+    # autoscale evaluation cadence (ServingRouter.start's thread)
+    "autoscale_interval_s": 15.0,
+}
+
+
+class _Replica:
+    __slots__ = (
+        "node_id", "addr", "state", "registered_ts",
+        "last_progress_ts", "stats", "dispatched", "drains",
+    )
+
+    def __init__(self, node_id: int, addr: str, now: float):
+        self.node_id = node_id
+        self.addr = addr
+        self.state = REPLICA_READY
+        self.registered_ts = now
+        self.last_progress_ts = now
+        self.stats: dict = {}
+        self.dispatched: set = set()
+        self.drains = 0
+
+
+class _Request:
+    __slots__ = (
+        "req", "state", "replica_id", "submit_ts", "dispatch_ts",
+        "done_ts", "tokens", "error", "requeues", "ttft_s", "tpot_s",
+        "finish_reason", "order",
+    )
+
+    def __init__(self, req: ServeRequest, now: float):
+        self.req = req
+        self.order = 0  # monotonic submission sequence (the router)
+        self.state = REQ_QUEUED
+        self.replica_id = -1
+        self.submit_ts = now
+        self.dispatch_ts = 0.0
+        self.done_ts = 0.0
+        self.tokens: List[int] = []
+        self.error = ""
+        self.requeues = 0
+        self.ttft_s = 0.0
+        self.tpot_s = 0.0
+        self.finish_reason = ""
+
+
+class ServingRouter:
+    def __init__(
+        self,
+        job_manager=None,
+        clock: Callable[[], float] = time.time,
+        config: Optional[Dict[str, float]] = None,
+        job_name: str = "default",
+    ):
+        self.job_manager = job_manager
+        self.clock = clock
+        self.job_name = job_name
+        self._config = dict(config or {})
+        self._lock = threading.Lock()
+        self._replicas: Dict[int, _Replica] = {}
+        self._requests: Dict[str, _Request] = {}
+        self._queue: deque = deque()  # request ids awaiting dispatch
+        self._seq = itertools.count(1)
+        self._done_latencies: deque = deque(
+            maxlen=int(self._cfg("latency_window"))
+        )
+        self._done_stamps: deque = deque(maxlen=4096)
+        self._requeued_total = 0
+        self._last_scale_ts = 0.0
+        # Bounded finished-record retention (eviction order) +
+        # cumulative outcome counters that survive eviction.
+        self._finished: deque = deque()
+        self._done_total = 0
+        self._failed_total = 0
+        self._stop = threading.Event()
+        self._thread: Optional[threading.Thread] = None
+
+    # -- lifecycle ---------------------------------------------------------
+
+    def start(self) -> None:
+        """Start the background autoscale/SLO loop (the JobMaster
+        wires this into prepare/stop). Idle-cheap: the loop no-ops
+        until the serving plane has ever seen a replica or request."""
+        if self._thread is None:
+            self._stop.clear()
+            self._thread = threading.Thread(
+                target=self._loop, name="serving-router", daemon=True
+            )
+            self._thread.start()
+
+    def stop(self) -> None:
+        self._stop.set()
+        if self._thread is not None:
+            self._thread.join(timeout=5.0)
+            self._thread = None
+
+    def _loop(self) -> None:
+        while not self._stop.wait(self._cfg("autoscale_interval_s")):
+            try:
+                self.maybe_autoscale()
+                self._publish_slo()
+            except Exception:  # noqa: BLE001 — a scaling bug must
+                # not kill the loop (and with it all future scaling)
+                logger.warning(
+                    "serving autoscale tick failed", exc_info=True
+                )
+
+    # -- config -----------------------------------------------------------
+
+    def _cfg(self, knob: str) -> float:
+        if knob in self._config:
+            return float(self._config[knob])
+        env = os.getenv(SERVE_ENV_PREFIX + knob.upper(), "")
+        if env:
+            try:
+                return float(env)
+            except ValueError:
+                logger.warning(
+                    "bad %s%s=%r; using default %s",
+                    SERVE_ENV_PREFIX, knob.upper(), env,
+                    DEFAULTS[knob],
+                )
+        return DEFAULTS[knob]
+
+    # -- replica registry ---------------------------------------------------
+
+    def register_replica(self, node_id: int, addr: str = "") -> None:
+        """A replica announced itself (NodeAddressRequest with
+        node_type=replica routes here from the servicer). Re-register
+        after a restart clears a drain — the fresh process is ready."""
+        now = self.clock()
+        requeued = 0
+        with self._lock:
+            rep = self._replicas.get(node_id)
+            if rep is None:
+                self._replicas[node_id] = _Replica(node_id, addr, now)
+            else:
+                # A re-registration is a NEW incarnation: whatever
+                # the old one still held is gone from its memory, so
+                # requeue it now rather than waiting for the
+                # progress watchdog to notice.
+                requeued = self._requeue_locked(rep)
+                rep.addr = addr or rep.addr
+                rep.state = REPLICA_READY
+                rep.last_progress_ts = now
+        if requeued:
+            self._publish_queue()
+        self._publish_replicas()
+        obs.event(
+            "serve.replica_ready", replica_id=node_id, addr=addr
+        )
+        logger.info("serving replica %d registered (%s)", node_id, addr)
+
+    def drain_replica(self, node_id: int, reason: str = "") -> int:
+        """Stop dispatching to a replica and requeue everything it
+        holds. Returns the number of requests requeued. The replica
+        stays registered (a restart re-registers it ready); the
+        remediation engine's drain rung calls this."""
+        with self._lock:
+            rep = self._replicas.get(node_id)
+            if rep is None:
+                return 0
+            rep.state = REPLICA_DRAINING
+            rep.drains += 1
+            n = self._requeue_locked(rep)
+        self._publish_replicas()
+        self._publish_queue()
+        obs.event(
+            "serve.drain", replica_id=node_id, requeued=n,
+            reason=reason,
+        )
+        logger.warning(
+            "draining serving replica %d (%s): %d request(s) requeued",
+            node_id, reason or "operator", n,
+        )
+        return n
+
+    def replica_gone(self, node_id: int) -> int:
+        """The node table declared the replica dead (heartbeat
+        timeout, pod deleted): forget it and requeue its in-flight
+        requests. Idempotent."""
+        with self._lock:
+            rep = self._replicas.pop(node_id, None)
+            n = self._requeue_locked(rep) if rep is not None else 0
+        if rep is None:
+            return 0
+        self._publish_replicas()
+        self._publish_queue()
+        obs.event(
+            "serve.replica_gone", replica_id=node_id, requeued=n
+        )
+        logger.warning(
+            "serving replica %d gone: %d request(s) requeued",
+            node_id, n,
+        )
+        return n
+
+    def _requeue_locked(self, rep: _Replica) -> int:
+        """Move every request dispatched to ``rep`` back to the FRONT
+        of the queue, oldest submission first (they have waited
+        longest). Caller holds the lock."""
+        n = 0
+        pending = [
+            (self._requests[rid].order, rid)
+            for rid in rep.dispatched
+            if rid in self._requests
+        ]
+        # appendleft in newest-first submission order leaves the
+        # OLDEST at the very front of the queue.
+        for _, rid in sorted(pending, reverse=True):
+            rec = self._requests.get(rid)
+            if rec is None or rec.state != REQ_DISPATCHED:
+                continue
+            rec.state = REQ_QUEUED
+            rec.replica_id = -1
+            rec.requeues += 1
+            self._queue.appendleft(rid)
+            n += 1
+            _REQUESTS_TOTAL.inc(outcome="requeued")
+            obs.event(
+                "serve.requeue", request_id=rid,
+                replica_id=rep.node_id,
+            )
+        rep.dispatched.clear()
+        self._requeued_total += n
+        return n
+
+    # -- request lifecycle --------------------------------------------------
+
+    def submit(
+        self,
+        prompt: List[int],
+        max_new_tokens: int = 16,
+        temperature: float = 0.0,
+        request_id: str = "",
+    ) -> Optional[str]:
+        """Accept a request into the ledger. Returns its id, or None
+        when the queue is full (backpressure). A caller-supplied
+        ``request_id`` is an idempotence token: resubmitting an id the
+        ledger knows returns it unchanged."""
+        with self._lock:
+            if request_id and request_id in self._requests:
+                _REQUESTS_TOTAL.inc(outcome="duplicate")
+                return request_id
+            if len(self._queue) >= int(self._cfg("max_queue")):
+                _REQUESTS_TOTAL.inc(outcome="rejected")
+                return None
+            order = next(self._seq)
+            rid = request_id
+            if not rid:
+                # Auto ids must never collide with a caller-supplied
+                # idempotence token already in the ledger (the
+                # collision would overwrite the other caller's record
+                # and hand them someone else's tokens).
+                rid = f"req-{order}"
+                while rid in self._requests:
+                    order = next(self._seq)
+                    rid = f"req-{order}"
+            rec = _Request(
+                ServeRequest(
+                    request_id=rid,
+                    prompt=list(prompt),
+                    max_new_tokens=max_new_tokens,
+                    temperature=temperature,
+                ),
+                self.clock(),
+            )
+            rec.order = order
+            self._requests[rid] = rec
+            self._queue.append(rid)
+        _REQUESTS_TOTAL.inc(outcome="submitted")
+        self._publish_queue()
+        return rid
+
+    def pull(self, replica_id: int, max_items: int = 1) -> List[ServeRequest]:
+        """A replica asks for work. Only READY replicas are fed; the
+        pull itself counts as progress (the replica is alive and
+        asking)."""
+        now = self.clock()
+        out: List[ServeRequest] = []
+        with self._lock:
+            rep = self._replicas.get(replica_id)
+            if rep is None or rep.state != REPLICA_READY:
+                return []
+            rep.last_progress_ts = now
+            while self._queue and len(out) < max_items:
+                rid = self._queue.popleft()
+                rec = self._requests.get(rid)
+                if rec is None or rec.state != REQ_QUEUED:
+                    continue
+                rec.state = REQ_DISPATCHED
+                rec.replica_id = replica_id
+                rec.dispatch_ts = now
+                rep.dispatched.add(rid)
+                out.append(rec.req)
+        if out:
+            self._publish_queue()
+        return out
+
+    def complete(
+        self,
+        replica_id: int,
+        request_id: str,
+        tokens: List[int],
+        ttft_s: float = 0.0,
+        tpot_s: float = 0.0,
+        finish_reason: str = "",
+        error: str = "",
+    ) -> bool:
+        """A replica finished (or failed) a request. First completion
+        wins; late duplicates from a replica the request was requeued
+        off are dropped. Completions are accepted from ANY replica —
+        after a requeue the original owner may still land the result
+        first, which is a win, not an error."""
+        now = self.clock()
+        with self._lock:
+            rec = self._requests.get(request_id)
+            if rec is None:
+                _REQUESTS_TOTAL.inc(outcome="duplicate")
+                return False
+            rep = self._replicas.get(replica_id)
+            if rep is not None:
+                rep.dispatched.discard(request_id)
+            if rec.state in (REQ_DONE, REQ_FAILED):
+                # A replayed completion is not serving progress: a
+                # drained replica spewing stale results must not
+                # reset the watchdog.
+                _REQUESTS_TOTAL.inc(outcome="duplicate")
+                return False
+            if rep is not None:
+                rep.last_progress_ts = now
+            owner = self._replicas.get(rec.replica_id)
+            if owner is not None and owner is not rep:
+                owner.dispatched.discard(request_id)
+            if rec.state == REQ_QUEUED:
+                # Completed by the original owner after a requeue but
+                # before re-dispatch: take the result and drop the
+                # queued copy at next pull (state check there).
+                try:
+                    self._queue.remove(request_id)
+                except ValueError:
+                    pass
+            rec.state = REQ_FAILED if error else REQ_DONE
+            rec.replica_id = replica_id
+            rec.done_ts = now
+            rec.tokens = list(tokens)
+            rec.error = error
+            rec.ttft_s = ttft_s
+            rec.tpot_s = tpot_s
+            rec.finish_reason = finish_reason
+            if error:
+                self._failed_total += 1
+            else:
+                self._done_total += 1
+                self._done_latencies.append(now - rec.submit_ts)
+                self._done_stamps.append(now)
+            # Bounded ledger: finished records past the retention
+            # evict oldest-first (the result becomes unknown to late
+            # pollers; cumulative counters keep the totals) — the
+            # master must never grow RAM with traffic volume.
+            self._finished.append(request_id)
+            retention = int(self._cfg("ledger_retention"))
+            while len(self._finished) > retention:
+                old = self._finished.popleft()
+                old_rec = self._requests.get(old)
+                if old_rec is not None and old_rec.state in (
+                    REQ_DONE, REQ_FAILED
+                ):
+                    del self._requests[old]
+        _REQUESTS_TOTAL.inc(
+            outcome="failed" if error else "completed"
+        )
+        self._publish_queue()
+        # SLO gauges (p99 sort + QPS window scan) deliberately NOT
+        # recomputed per completion: the router thread refreshes
+        # them every autoscale_interval_s, off the RPC hot path.
+        return True
+
+    def result(self, request_id: str) -> Optional[dict]:
+        """The ledger's view of one request (the ServeResultResponse
+        payload)."""
+        with self._lock:
+            rec = self._requests.get(request_id)
+            if rec is None:
+                return None
+            return {
+                "request_id": request_id,
+                "state": rec.state,
+                "replica_id": rec.replica_id,
+                "tokens": list(rec.tokens),
+                "error": rec.error,
+                "finish_reason": rec.finish_reason,
+                "requeues": rec.requeues,
+                "ttft_s": rec.ttft_s,
+                "tpot_s": rec.tpot_s,
+                "latency_s": (
+                    round(rec.done_ts - rec.submit_ts, 6)
+                    if rec.done_ts
+                    else 0.0
+                ),
+            }
+
+    # -- telemetry ----------------------------------------------------------
+
+    def report_stats(self, replica_id: int, stats: dict) -> None:
+        """Periodic replica telemetry. Progress = the replica's
+        token counter moved (a stats report alone is a heartbeat, not
+        progress: a wedged decode loop still reports stats)."""
+        with self._lock:
+            rep = self._replicas.get(replica_id)
+            if rep is None:
+                return
+            prev = rep.stats.get("tokens_generated", -1)
+            cur = stats.get("tokens_generated", 0)
+            rep.stats = dict(stats)
+            rep.stats["ts"] = self.clock()
+            # READY-and-empty: nothing is owed, stats keep it fresh.
+            # DRAINING must NOT count stats as progress — a drained-
+            # but-alive replica would otherwise look healthy forever
+            # while never being fed, and the ladder's restart rung
+            # (whose re-register is what clears the drain) would
+            # never fire.
+            if cur > prev or (
+                not rep.dispatched and rep.state == REPLICA_READY
+            ):
+                rep.last_progress_ts = self.clock()
+
+    def _publish_queue(self) -> None:
+        # Gauges snapshot under the lock: callers publish AFTER
+        # releasing it, and the replica dict mutates concurrently on
+        # RPC / node-event threads.
+        with self._lock:
+            depth = len(self._queue)
+            inflight = sum(
+                len(r.dispatched) for r in self._replicas.values()
+            )
+        _ROUTER_QUEUE.set(depth)
+        _ROUTER_INFLIGHT.set(inflight)
+
+    def _publish_replicas(self) -> None:
+        with self._lock:
+            total = len(self._replicas)
+            ready = sum(
+                1 for r in self._replicas.values()
+                if r.state == REPLICA_READY
+            )
+        _REPLICAS_GAUGE.set(ready, state="ready")
+        _REPLICAS_GAUGE.set(total - ready, state="draining")
+
+    def _publish_slo(self) -> None:
+        _P99_GAUGE.set(self.p99_latency())
+        _QPS_GAUGE.set(self.qps())
+
+    def p99_latency(self) -> float:
+        from dlrover_tpu.obs.timeseries import _percentile
+
+        with self._lock:
+            lat = sorted(self._done_latencies)
+        return _percentile(lat, 99.0)
+
+    def qps(self) -> float:
+        now = self.clock()
+        window = self._cfg("qps_window_s")
+        with self._lock:
+            n = sum(
+                1 for t in self._done_stamps if now - t <= window
+            )
+        return n / window if window > 0 else 0.0
+
+    # -- health feed --------------------------------------------------------
+
+    def unhealthy_replicas(self) -> List[dict]:
+        """Replicas that are demonstrably not serving: READY with
+        dispatched work and stale progress, or DRAINING and never
+        came back. The health plane's ``replica_unhealthy`` detector
+        consumes this."""
+        now = self.clock()
+        timeout = self._cfg("progress_timeout_s")
+        out: List[dict] = []
+        with self._lock:
+            for rep in self._replicas.values():
+                stale = now - rep.last_progress_ts
+                if stale < timeout:
+                    continue
+                if rep.state == REPLICA_READY and not rep.dispatched:
+                    continue  # idle and empty: nothing owed
+                out.append(
+                    {
+                        "replica_id": rep.node_id,
+                        "addr": rep.addr,
+                        "state": rep.state,
+                        "stale_s": round(stale, 3),
+                        "timeout_s": timeout,
+                        "dispatched": len(rep.dispatched),
+                    }
+                )
+        return out
+
+    # -- SLO-driven scaling -------------------------------------------------
+
+    def maybe_autoscale(self) -> Optional[str]:
+        """One scaling evaluation against the QPS/p99 SLOs, through
+        the same ScalePlan seam training elasticity uses
+        (``JobManager.ensure_role`` launches pending replica nodes;
+        ``retire_node`` removes one). Cooldown-limited; no-op without
+        a job manager. Returns "grow"/"shrink"/None."""
+        if self.job_manager is None:
+            return None
+        with self._lock:
+            idle_master = not self._replicas and not self._requests
+        if idle_master:
+            # A training-only master (serving never used) must not
+            # launch replica nodes toward min_replicas.
+            return None
+        now = self.clock()
+        if now - self._last_scale_ts < self._cfg("scale_cooldown_s"):
+            return None
+        from dlrover_tpu.common.constants import NodeType
+
+        with self._lock:
+            ready = [
+                r for r in self._replicas.values()
+                if r.state == REPLICA_READY
+            ]
+            total = len(self._replicas)
+            queue_depth = len(self._queue)
+        n = len(ready)
+        min_n = int(self._cfg("min_replicas"))
+        max_n = int(self._cfg("max_replicas"))
+        p99 = self.p99_latency()
+        backlogged = queue_depth > self._cfg(
+            "backlog_per_replica"
+        ) * max(n, 1)
+        slo_breach = p99 > self._cfg("p99_slo_s") > 0
+        if (backlogged or slo_breach or n < min_n) and total < max_n:
+            # The SLO pressure is judged on READY replicas, but the
+            # ensure_role target must count EVERY registered replica:
+            # ensure_role counts all alive REPLICA nodes (draining /
+            # cordoned ones included), so a ready-count target would
+            # silently no-op exactly when a drain halved capacity.
+            target = max(total + 1, min_n)
+            self.job_manager.ensure_role(NodeType.REPLICA, target)
+            self._last_scale_ts = now
+            obs.event(
+                "serve.scale", direction="grow", target=target,
+                queue_depth=queue_depth, p99_s=round(p99, 3),
+            )
+            logger.warning(
+                "serving scale-up to %d replicas (queue %d, "
+                "p99 %.2fs)", target, queue_depth, p99,
+            )
+            return "grow"
+        idle = (
+            n > min_n
+            and queue_depth == 0
+            and self.qps() < 0.5 * max(n - 1, 1)
+            and all(not r.dispatched for r in ready)
+        )
+        if idle:
+            victim = max(ready, key=lambda r: r.node_id)
+            self.job_manager.retire_node(victim.node_id)
+            self._last_scale_ts = now
+            obs.event(
+                "serve.scale", direction="shrink",
+                replica_id=victim.node_id, target=n - 1,
+            )
+            logger.info(
+                "serving scale-down: retiring idle replica %d",
+                victim.node_id,
+            )
+            return "shrink"
+        return None
+
+    # -- read surface -------------------------------------------------------
+
+    def counters(self) -> dict:
+        """Request outcome counters. ``done``/``failed`` are
+        CUMULATIVE (they survive ledger eviction); queued/dispatched
+        scan the retained records (bounded by retention + live)."""
+        with self._lock:
+            states = {"queued": 0, "dispatched": 0}
+            for rec in self._requests.values():
+                if rec.state in states:
+                    states[rec.state] += 1
+            return {
+                "requests": len(self._requests),
+                "requeued_total": self._requeued_total,
+                "done": self._done_total,
+                "failed": self._failed_total,
+                **states,
+            }
+
+    def snapshot(self) -> dict:
+        """The ``obs_report --serving`` payload (and the
+        ServeQueryResponse body)."""
+        unhealthy = {
+            u["replica_id"]: u for u in self.unhealthy_replicas()
+        }
+        with self._lock:
+            replicas = [
+                {
+                    "replica_id": rep.node_id,
+                    "addr": rep.addr,
+                    "state": rep.state,
+                    "dispatched": len(rep.dispatched),
+                    "drains": rep.drains,
+                    "last_progress_age_s": round(
+                        self.clock() - rep.last_progress_ts, 3
+                    ),
+                    "unhealthy": rep.node_id in unhealthy,
+                    "stats": dict(rep.stats),
+                }
+                for rep in sorted(
+                    self._replicas.values(),
+                    key=lambda r: r.node_id,
+                )
+            ]
+            queue_depth = len(self._queue)
+        return {
+            "ts": self.clock(),
+            "queue_depth": queue_depth,
+            "p99_latency_s": round(self.p99_latency(), 6),
+            "qps": round(self.qps(), 4),
+            "counters": self.counters(),
+            "replicas": replicas,
+            "unhealthy": sorted(unhealthy),
+        }
+
+
+def render_serving(payload: dict) -> str:
+    """Human rendering of a router snapshot — the body of
+    ``obs_report --serving``."""
+    counters = payload.get("counters", {})
+    replicas = payload.get("replicas", [])
+    unhealthy = payload.get("unhealthy", [])
+    lines = [
+        f"serving: {counters.get('requests', 0)} request(s) "
+        f"({counters.get('done', 0)} done, "
+        f"{counters.get('failed', 0)} failed, "
+        f"{counters.get('queued', 0)} queued, "
+        f"{counters.get('dispatched', 0)} in flight, "
+        f"{counters.get('requeued_total', 0)} requeue(s)), "
+        f"qps {payload.get('qps', 0.0):.2f}, "
+        f"p99 {payload.get('p99_latency_s', 0.0):.3f}s"
+    ]
+    if not replicas:
+        lines.append("  no replicas registered")
+    for rep in replicas:
+        stats = rep.get("stats") or {}
+        kv = stats.get("kv") or {}
+        mark = "UNHEALTHY" if rep.get("unhealthy") else rep.get(
+            "state", "?"
+        )
+        lines.append(
+            f"  replica {rep.get('replica_id')} "
+            f"[{mark:<9}] {rep.get('addr', '') or '-'}: "
+            f"{rep.get('dispatched', 0)} in flight, "
+            f"queue {stats.get('queue_depth', 0)}, "
+            f"active {stats.get('active', 0)}, "
+            f"kv {100.0 * float(kv.get('utilization', 0.0)):.0f}%, "
+            f"ttft p99 {stats.get('ttft_p99_s', 0.0):.3f}s, "
+            f"tpot p50 {stats.get('tpot_p50_s', 0.0):.4f}s, "
+            f"progress {rep.get('last_progress_age_s', 0.0):.1f}s ago"
+        )
+    if unhealthy:
+        lines.append(
+            f"  UNHEALTHY replicas: {unhealthy} — replica_unhealthy "
+            "verdict feeds drain -> restart -> replace"
+        )
+    return "\n".join(lines)

@@ -1,0 +1,616 @@
+"""Per-replica continuous-batching scheduler (Orca-style iteration-
+level scheduling, OSDI '22).
+
+One :meth:`ContinuousBatchingScheduler.step` is one *iteration* of the
+whole replica, not of one request:
+
+1. **retire** — sequences that hit their token budget (or EOS) leave
+   the batch and free their KV blocks *this* step, not at batch end;
+2. **admit** — queued requests claim free lanes + blocks from the
+   :class:`~dlrover_tpu.serving.kv_pool.KVBlockPool` and join
+   immediately (no padding a static batch to completion);
+3. **prefill** — admitted prompts advance in bounded chunks
+   (``prefill_chunk`` tokens per sequence, ``prefill_budget`` tokens
+   per step across sequences), so a long prompt cannot stall the
+   decode latency of sequences already streaming;
+4. **decode** — ONE ragged batched step
+   (models/generate.llama_decode_step_ragged) advances every decoding
+   lane at its own position; sampling (greedy / temperature) happens
+   on-device inside the same jitted program, and the only host
+   transfer in the steady decode loop is the sampled token vector.
+
+KV pressure is honest: growth past a block boundary that the pool
+cannot fund preempts the *youngest* resident sequence back to the
+queue (recompute preemption — greedy decode redoes to the identical
+result), never wedges the batch.
+
+The scheduler is a plain in-process object: the replica worker
+(serving/replica.py) drives it against the master's router; tests and
+benches drive it directly.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import time
+from collections import deque
+from typing import Callable, Dict, List, Optional
+
+import numpy as np
+
+from dlrover_tpu import obs
+from dlrover_tpu.serving.kv_pool import KVBlockPool
+
+_TOKENS_TOTAL = obs.counter(
+    "dlrover_serve_tokens_total",
+    "Tokens processed by this replica's scheduler, by kind "
+    "(prefill / decode)",
+    ("kind",),
+)
+_PREEMPTIONS_TOTAL = obs.counter(
+    "dlrover_serve_preemptions_total",
+    "Sequences preempted back to the queue by KV block-pool "
+    "exhaustion on this replica",
+)
+_REPLICA_QUEUE = obs.gauge(
+    "dlrover_serve_replica_queue_depth",
+    "Requests waiting in this replica's local admission queue",
+)
+_ACTIVE_SEQS = obs.gauge(
+    "dlrover_serve_active_sequences",
+    "Sequences currently resident in this replica's decode batch "
+    "(prefilling or decoding)",
+)
+_TTFT_SECONDS = obs.histogram(
+    "dlrover_serve_ttft_seconds",
+    "Time from request admission on this replica to its first "
+    "generated token",
+    buckets=(0.01, 0.05, 0.1, 0.25, 0.5, 1.0, 2.5, 5.0, 10.0, 30.0),
+)
+_TPOT_SECONDS = obs.histogram(
+    "dlrover_serve_tpot_seconds",
+    "Mean time per generated output token after the first, per "
+    "completed request on this replica",
+    buckets=(0.001, 0.005, 0.01, 0.025, 0.05, 0.1, 0.25, 0.5, 1.0),
+)
+
+PHASE_PREFILL = "prefill"
+PHASE_DECODE = "decode"
+
+FINISH_LENGTH = "length"
+FINISH_EOS = "eos"
+FINISH_ERROR = "error"
+
+# How many recent latency samples the stats surface keeps.
+LATENCY_WINDOW = 256
+
+
+@dataclasses.dataclass
+class ServeRequest:
+    """One generation request as it rides queues and the wire."""
+
+    request_id: str
+    prompt: List[int]
+    max_new_tokens: int = 16
+    temperature: float = 0.0
+
+    def to_dict(self) -> dict:
+        return {
+            "request_id": self.request_id,
+            "prompt": list(self.prompt),
+            "max_new_tokens": self.max_new_tokens,
+            "temperature": self.temperature,
+        }
+
+    @classmethod
+    def from_dict(cls, d: dict) -> "ServeRequest":
+        return cls(
+            request_id=str(d.get("request_id", "")),
+            prompt=[int(t) for t in d.get("prompt", [])],
+            max_new_tokens=int(d.get("max_new_tokens", 16)),
+            temperature=float(d.get("temperature", 0.0)),
+        )
+
+
+@dataclasses.dataclass
+class CompletedRequest:
+    request_id: str
+    tokens: List[int]
+    finish_reason: str = FINISH_LENGTH
+    error: str = ""
+    ttft_s: float = 0.0
+    tpot_s: float = 0.0
+    wall_s: float = 0.0
+
+
+class _Seq:
+    """A resident sequence: one lane of the decode batch."""
+
+    __slots__ = (
+        "req", "lane", "phase", "prefilled", "generated",
+        "admit_ts", "first_token_ts", "last_token_ts", "last_logits",
+    )
+
+    def __init__(self, req: ServeRequest, lane: int, now: float):
+        self.req = req
+        self.lane = lane
+        self.phase = PHASE_PREFILL
+        self.prefilled = 0
+        self.generated: List[int] = []
+        self.admit_ts = now
+        self.first_token_ts = 0.0
+        self.last_token_ts = 0.0
+        # Host copy of the final prefill chunk's logits row, used to
+        # sample the first token at the prefill -> decode handoff.
+        self.last_logits: Optional[np.ndarray] = None
+
+    @property
+    def length(self) -> int:
+        return len(self.req.prompt) + len(self.generated)
+
+
+class ContinuousBatchingScheduler:
+    def __init__(
+        self,
+        params,
+        cfg,
+        lanes: int = 4,
+        max_len: Optional[int] = None,
+        block_size: int = 16,
+        total_blocks: Optional[int] = None,
+        prefill_chunk: int = 16,
+        prefill_budget: Optional[int] = None,
+        max_queue: int = 1024,
+        eos_id: Optional[int] = None,
+        clock: Callable[[], float] = time.monotonic,
+    ):
+        """``prefill_budget`` (default ``2 * prefill_chunk``) caps the
+        total prompt tokens processed per step across all admitting
+        sequences — the decode-latency protection knob. Llama-family
+        configs only (the ragged decode step's contract)."""
+        from dlrover_tpu.models import generate, llama
+
+        if not isinstance(cfg, llama.LlamaConfig):
+            raise TypeError(
+                "the serving scheduler drives the Llama-family ragged "
+                f"decode path; got config {type(cfg).__name__}"
+            )
+        self.params = params
+        self.cfg = cfg
+        self.lanes = lanes
+        self.max_len = min(max_len or cfg.block_size, cfg.block_size)
+        self.prefill_chunk = max(
+            min(int(prefill_chunk), self.max_len), 1
+        )
+        self.prefill_budget = (
+            int(prefill_budget)
+            if prefill_budget is not None
+            else 2 * self.prefill_chunk
+        )
+        self.eos_id = eos_id
+        self.clock = clock
+        self.pool = KVBlockPool(
+            lanes=lanes,
+            max_len=self.max_len,
+            block_size=block_size,
+            total_blocks=total_blocks,
+        )
+        self._queue: deque = deque()
+        self.max_queue = max_queue
+        self._by_lane: Dict[int, _Seq] = {}
+        self._steps = 0
+        self._completed_total = 0
+        self._failed_total = 0
+        self._preempted_total = 0
+        self._tokens_generated = 0
+        self._ttft_recent: deque = deque(maxlen=LATENCY_WINDOW)
+        self._tpot_recent: deque = deque(maxlen=LATENCY_WINDOW)
+        self._build_programs(generate, llama)
+
+    # -- jitted programs ---------------------------------------------------
+
+    def _build_programs(self, generate, llama) -> None:
+        """Compile-once builders. The decode program closes over cfg
+        and the rope tables and takes ONLY device arrays — sampling
+        (greedy vs per-lane temperature) runs inside it, so the steady
+        decode loop's one host transfer is the [lanes] token vector.
+        Prefill is one program too: ragged tails pad up to
+        prefill_chunk, so one shape covers every chunk."""
+        import jax
+        import jax.numpy as jnp
+
+        cfg = self.cfg
+        # The physical cache rounds max_len UP to a prefill-chunk
+        # multiple: a padded final chunk writes [start, start+chunk),
+        # and dynamic_update_slice silently CLAMPS a window that
+        # crosses the buffer end — shifting the whole chunk onto
+        # wrong positions and corrupting already-prefilled entries.
+        # Real data never exceeds max_len (admission guards it); the
+        # slack rows only ever hold pad garbage no causal mask can
+        # expose. The rope tables extend to match so the final
+        # chunk's table slice cannot clamp either.
+        cache_len = (
+            -(-self.max_len // self.prefill_chunk)
+            * self.prefill_chunk
+        )
+        rope = llama.rope_table(cfg, cache_len)
+        self._generate_mod = generate
+        self._cache = generate._cache_for(
+            cfg, self.lanes, cache_len, generate._kv_heads(cfg)
+        )
+
+        def decode(params, cache, token, pos, temps, active, key):
+            logits, cache = generate.llama_decode_step_ragged(
+                params, cache, token, pos, cfg, rope=rope,
+                active=active,
+            )
+            greedy = jnp.argmax(logits, axis=-1).astype(jnp.int32)
+            scaled = logits / jnp.maximum(temps, 1e-6)[:, None]
+            sampled = jax.random.categorical(key, scaled).astype(
+                jnp.int32
+            )
+            tok = jnp.where(temps > 0.0, sampled, greedy)
+            return tok, cache
+
+        self._decode_fn = jax.jit(decode)
+
+        def prefill(params, cache, tokens, lane, start):
+            return generate.llama_lane_prefill_chunk(
+                params, cache, tokens, lane, start, cfg, rope=rope
+            )
+
+        # One jitted program: every chunk pads to prefill_chunk, so
+        # there is exactly one token shape (jit re-caches by shape if
+        # that ever changes).
+        self._prefill_fn = jax.jit(prefill)
+        self._key = jax.random.PRNGKey(0)
+        self._split = jax.jit(jax.random.split)
+
+    # -- submission --------------------------------------------------------
+
+    def submit(self, req: ServeRequest) -> bool:
+        """Queue a request for admission. False = queue full (the
+        caller backs off / the router keeps it).
+
+        Duplicate request_ids are dropped (returning True): a router
+        requeue can race the ORIGINAL copy still resident or queued
+        on this very replica (reconnect re-registration requeues a
+        live replica's in-flight work, and the next pull may hand it
+        straight back) — the resident copy completes and the
+        ledger's first-completion-wins drops any other. Without the
+        dedupe, re-admitting the id would crash the pool's
+        already-resident guard."""
+        rid = req.request_id
+        if self.pool.lane_of(rid) is not None or any(
+            q.request_id == rid for q in self._queue
+        ):
+            return True
+        if len(self._queue) >= self.max_queue:
+            return False
+        self._queue.append(req)
+        _REPLICA_QUEUE.set(len(self._queue))
+        return True
+
+    def queue_depth(self) -> int:
+        return len(self._queue)
+
+    def active(self) -> int:
+        return len(self._by_lane)
+
+    def capacity_hint(self) -> int:
+        """How many more requests this replica can reasonably take on
+        board right now (free lanes minus already-queued) — the pull
+        sizing the replica worker uses against the router."""
+        return max(
+            self.pool.free_lane_count() - len(self._queue), 0
+        )
+
+    # -- the iteration ------------------------------------------------------
+
+    def step(self) -> List[CompletedRequest]:
+        """One scheduler iteration; returns requests completed (or
+        failed) during it."""
+        self._steps += 1
+        now = self.clock()
+        completed: List[CompletedRequest] = []
+        self._admit(now, completed)
+        self._prefill_tick(now)
+        completed.extend(self._decode_tick(now))
+        _REPLICA_QUEUE.set(len(self._queue))
+        _ACTIVE_SEQS.set(len(self._by_lane))
+        return completed
+
+    def _admit(
+        self, now: float, completed: List[CompletedRequest]
+    ) -> None:
+        while self._queue:
+            req = self._queue[0]
+            total = len(req.prompt) + req.max_new_tokens
+            if (
+                not req.prompt
+                or req.max_new_tokens < 1
+                or total > self.max_len
+                or self.pool.blocks_for(total) > self.pool.total_blocks
+            ):
+                self._queue.popleft()
+                completed.append(
+                    CompletedRequest(
+                        request_id=req.request_id,
+                        tokens=[],
+                        finish_reason=FINISH_ERROR,
+                        error=(
+                            "empty prompt"
+                            if not req.prompt
+                            else "max_new_tokens must be >= 1"
+                            if req.max_new_tokens < 1
+                            else f"prompt+new {total} exceeds "
+                            "replica capacity (max_len "
+                            f"{self.max_len}, "
+                            f"{self.pool.total_blocks} blocks)"
+                        ),
+                    )
+                )
+                self._failed_total += 1
+                continue
+            lane = self.pool.allocate(
+                req.request_id, len(req.prompt)
+            )
+            if lane is None:
+                break  # no lane / no blocks: stays queued
+            self._queue.popleft()
+            self._by_lane[lane] = _Seq(req, lane, now)
+
+    def _prefill_tick(self, now: float) -> None:
+        """Advance PREFILL sequences by bounded chunks. Ragged final
+        chunks PAD up to prefill_chunk (one compiled shape): padded
+        positions write garbage the next chunk or decode step
+        overwrites before any causal mask can expose it, and the
+        first token samples from the last REAL position's logits."""
+        import jax.numpy as jnp
+
+        budget = self.prefill_budget
+        for seq in list(self._by_lane.values()):
+            if seq.phase != PHASE_PREFILL or budget <= 0:
+                continue
+            prompt = seq.req.prompt
+            while budget > 0 and seq.prefilled < len(prompt):
+                c = min(
+                    self.prefill_chunk, len(prompt) - seq.prefilled
+                )
+                chunk = np.zeros((1, self.prefill_chunk), np.int32)
+                chunk[0, :c] = prompt[
+                    seq.prefilled:seq.prefilled + c
+                ]
+                logits, self._cache = self._prefill_fn(
+                    self.params,
+                    self._cache,
+                    jnp.asarray(chunk),
+                    seq.lane,
+                    seq.prefilled,
+                )
+                budget -= c
+                seq.prefilled += c
+                _TOKENS_TOTAL.inc(c, kind="prefill")
+                if seq.prefilled >= len(prompt):
+                    # Prefill -> decode handoff: sample the first
+                    # token host-side from the last real position
+                    # (one boundary transfer per request, outside
+                    # the steady decode loop).
+                    row = np.asarray(logits[0, c - 1])
+                    seq.last_logits = row
+                    tok = self._sample_host(seq.req, row)
+                    # Clock at the sample moment, not step start:
+                    # TTFT must include the prefill compute it just
+                    # paid.
+                    self._append_token(seq, int(tok), self.clock())
+                    seq.phase = PHASE_DECODE
+
+    @staticmethod
+    def _sample_host(req: ServeRequest, logits: np.ndarray) -> int:
+        if req.temperature <= 0.0:
+            return int(np.argmax(logits))
+        # Deterministic per request id ACROSS PROCESSES, so a
+        # requeued sampled request redraws the same first token on
+        # any replica — a stable digest, never Python's hash()
+        # (salted per process by PYTHONHASHSEED).
+        import hashlib
+
+        digest = hashlib.sha256(
+            b"serve-first:" + req.request_id.encode()
+        ).digest()
+        seed = int.from_bytes(digest[:4], "big")
+        rng = np.random.default_rng(seed)
+        z = logits.astype(np.float64) / max(req.temperature, 1e-6)
+        z -= z.max()
+        p = np.exp(z)
+        p /= p.sum()
+        return int(rng.choice(len(p), p=p))
+
+    def _append_token(self, seq: _Seq, tok: int, now: float) -> None:
+        seq.generated.append(tok)
+        self._tokens_generated += 1
+        if seq.first_token_ts == 0.0:
+            seq.first_token_ts = now
+            ttft = now - seq.admit_ts
+            self._ttft_recent.append(ttft)
+            _TTFT_SECONDS.observe(ttft)
+        seq.last_token_ts = now
+
+    def _decode_lanes(self) -> List[_Seq]:
+        return [
+            s for s in self._by_lane.values()
+            if s.phase == PHASE_DECODE
+        ]
+
+    def _decode_tick(self, now: float) -> List[CompletedRequest]:
+        import jax.numpy as jnp
+
+        completed: List[CompletedRequest] = []
+        # Retire sequences that already hit their budget (the first
+        # generated token comes from prefill, so a max_new_tokens=1
+        # request finishes without ever entering the ragged step).
+        for seq in self._decode_lanes():
+            if self._finished(seq):
+                completed.append(self._retire(seq, now))
+        active = self._decode_lanes()
+        if not active:
+            return completed
+        # Fund this step's cache writes BEFORE dispatch: the step
+        # writes each lane's slot at position length-1, so the
+        # sequence must own blocks covering ``length`` positions.
+        # Growth the pool cannot fund preempts the youngest resident
+        # sequence back to the queue (recompute preemption) and
+        # retries; a sequence can preempt itself when it IS the
+        # youngest.
+        for seq in active:
+            if self._by_lane.get(seq.lane) is not seq:
+                continue  # already preempted as someone's victim
+            while not self.pool.extend(
+                seq.req.request_id, seq.length
+            ):
+                victim = self._preempt_youngest()
+                if victim is None or victim == seq.req.request_id:
+                    break
+        active = [
+            s for s in active if self._by_lane.get(s.lane) is s
+        ]
+        if not active:
+            return completed
+        token = np.zeros(self.lanes, np.int32)
+        pos = np.zeros(self.lanes, np.int32)
+        temps = np.zeros(self.lanes, np.float32)
+        # Only DECODING lanes may write their cache slot: an idle
+        # lane (or one mid-prefill) rides the batch with pos=0 and
+        # must not clobber its own position 0.
+        mask = np.zeros(self.lanes, np.bool_)
+        for seq in active:
+            token[seq.lane] = seq.generated[-1]
+            # The position this step WRITES: the new token's slot.
+            pos[seq.lane] = seq.length - 1
+            temps[seq.lane] = seq.req.temperature
+            mask[seq.lane] = True
+        keys = self._split(self._key)
+        self._key, sub = keys[0], keys[1]
+        toks_dev, self._cache = self._decode_fn(
+            self.params,
+            self._cache,
+            jnp.asarray(token),
+            jnp.asarray(pos),
+            jnp.asarray(temps),
+            jnp.asarray(mask),
+            sub,
+        )
+        # The steady decode loop's single host transfer.
+        toks = np.asarray(toks_dev)
+        now = self.clock()
+        _TOKENS_TOTAL.inc(len(active), kind="decode")
+        for seq in active:
+            self._append_token(seq, int(toks[seq.lane]), now)
+            if self._finished(seq):
+                completed.append(self._retire(seq, now))
+        return completed
+
+    def _finished(self, seq: _Seq) -> bool:
+        if len(seq.generated) >= seq.req.max_new_tokens:
+            return True
+        return (
+            self.eos_id is not None
+            and bool(seq.generated)
+            and seq.generated[-1] == self.eos_id
+        )
+
+    def _retire(self, seq: _Seq, now: float) -> CompletedRequest:
+        self.pool.release(seq.req.request_id)
+        self._by_lane.pop(seq.lane, None)
+        self._completed_total += 1
+        n = len(seq.generated)
+        tpot = (
+            (seq.last_token_ts - seq.first_token_ts) / (n - 1)
+            if n > 1
+            else 0.0
+        )
+        self._tpot_recent.append(tpot)
+        _TPOT_SECONDS.observe(tpot)
+        reason = (
+            FINISH_EOS
+            if (
+                self.eos_id is not None
+                and seq.generated
+                and seq.generated[-1] == self.eos_id
+            )
+            else FINISH_LENGTH
+        )
+        return CompletedRequest(
+            request_id=seq.req.request_id,
+            tokens=list(seq.generated),
+            finish_reason=reason,
+            ttft_s=round(seq.first_token_ts - seq.admit_ts, 6),
+            tpot_s=round(tpot, 6),
+            wall_s=round(now - seq.admit_ts, 6),
+        )
+
+    def _preempt_youngest(self) -> Optional[str]:
+        victim_id = self.pool.youngest()
+        if victim_id is None:
+            return None
+        lane = self.pool.lane_of(victim_id)
+        seq = self._by_lane.get(lane) if lane is not None else None
+        self.pool.release(victim_id)
+        if seq is not None:
+            self._by_lane.pop(seq.lane, None)
+            # Recompute preemption: back to the FRONT of the queue,
+            # redoing prefill from the prompt (greedy decode redoes
+            # to the identical tokens).
+            self._queue.appendleft(seq.req)
+            self._preempted_total += 1
+            _PREEMPTIONS_TOTAL.inc()
+            obs.event(
+                "serve.preempt",
+                request_id=victim_id,
+                generated=len(seq.generated),
+            )
+        return victim_id
+
+    # -- drain / stats ------------------------------------------------------
+
+    def drain(self) -> List[ServeRequest]:
+        """Stop serving: release every resident sequence and return
+        every unfinished request (queued + resident) for the caller to
+        requeue elsewhere. The scheduler stays usable afterward."""
+        out: List[ServeRequest] = []
+        for seq in list(self._by_lane.values()):
+            self.pool.release(seq.req.request_id)
+            out.append(seq.req)
+        self._by_lane.clear()
+        out.extend(self._queue)
+        self._queue.clear()
+        _REPLICA_QUEUE.set(0)
+        _ACTIVE_SEQS.set(0)
+        return out
+
+    @staticmethod
+    def _pct(samples: deque, q: float) -> float:
+        """Nearest-rank percentile (q in [0, 100]) via the one shared
+        rank formula (obs/timeseries) — serving percentiles must
+        agree with fleet/health percentiles on the same samples."""
+        from dlrover_tpu.obs.timeseries import _percentile
+
+        return _percentile(sorted(samples), q)
+
+    def stats(self) -> dict:
+        """The replica's telemetry snapshot (ServeStatsReport payload
+        + obs_report --serving rows)."""
+        return {
+            "steps": self._steps,
+            "queue_depth": len(self._queue),
+            "active": len(self._by_lane),
+            "completed_total": self._completed_total,
+            "failed_total": self._failed_total,
+            "preempted_total": self._preempted_total,
+            "tokens_generated": self._tokens_generated,
+            "kv": self.pool.snapshot(),
+            "ttft_p50_s": round(self._pct(self._ttft_recent, 50), 6),
+            "ttft_p99_s": round(self._pct(self._ttft_recent, 99), 6),
+            "tpot_p50_s": round(self._pct(self._tpot_recent, 50), 6),
+            "tpot_p99_s": round(self._pct(self._tpot_recent, 99), 6),
+        }

@@ -18,6 +18,18 @@ from dlrover_tpu.brain.service import (
 )
 
 
+@pytest.fixture(autouse=True)
+def _no_leaked_chaos():
+    """A chaos injector left installed by an earlier module would
+    fault THIS module's in-process RPC clients (the injector is
+    process-global); clear it both ways."""
+    from dlrover_tpu.common import chaos
+
+    chaos.install_injector(None)
+    yield
+    chaos.install_injector(None)
+
+
 @pytest.fixture()
 def remote(tmp_path):
     server = BrainRpcServer(
@@ -181,6 +193,20 @@ class TestDurability:
 
 class TestCli:
     def test_entrypoint_serves(self, tmp_path):
+        # The child's environment is scrubbed of every DLROVER_TPU_*
+        # knob: when the FULL suite runs, earlier modules leave
+        # process-global env state (chaos gates, trace files, snapshot
+        # cadences) that a subprocess inherits — the load-flakiness
+        # this test used to show came from exactly that plus a tight
+        # 20s startup deadline on a busy box.
+        import os
+
+        env = {
+            k: v
+            for k, v in os.environ.items()
+            if not k.startswith("DLROVER_TPU_")
+        }
+        env["JAX_PLATFORMS"] = "cpu"
         proc = subprocess.Popen(
             [
                 sys.executable, "-m", "dlrover_tpu.brain.main",
@@ -189,12 +215,16 @@ class TestCli:
             stdout=subprocess.PIPE,
             stderr=subprocess.PIPE,
             text=True,
+            env=env,
         )
         try:
             import select
 
             port = None
-            deadline = time.time() + 20
+            # Generous deadline: under full-suite load a cold python
+            # + grpc import can take far longer than in isolation,
+            # and a slow start is not the failure this test hunts.
+            deadline = time.time() + 60
             while time.time() < deadline and port is None:
                 if proc.poll() is not None:
                     break  # died before printing the port
@@ -208,14 +238,22 @@ class TestCli:
                 line = proc.stdout.readline()
                 if line.startswith("DLROVER_TPU_BRAIN_PORT="):
                     port = int(line.strip().split("=")[1])
+            if port is None and proc.poll() is None:
+                # Still alive but silent past the deadline: collect
+                # its stderr for the failure message instead of
+                # asserting blind.
+                proc.terminate()
+                proc.wait(10)
             assert port, (
                 "brain CLI never printed its port; stderr:\n"
-                + (proc.stderr.read() if proc.poll() is not None
-                   else "")
+                + (proc.stderr.read() or "")
             )
             client = RemoteBrain(f"127.0.0.1:{port}")
-            client.persist_metrics(_metrics())
-            client.close()
+            try:
+                client.persist_metrics(_metrics())
+            finally:
+                client.close()
         finally:
-            proc.terminate()
+            if proc.poll() is None:
+                proc.terminate()
             proc.wait(10)

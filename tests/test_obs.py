@@ -491,6 +491,45 @@ class TestMetricNameHygiene:
         mtype, labels = sites.get("dlrover_job_health_score", (None, 0))
         assert mtype == "gauge" and not labels, (mtype, labels)
 
+    def test_serving_plane_metrics_are_audited(self):
+        """The serving plane's dlrover_serve_* registrations
+        (dlrover_tpu/serving/) must be visible to the walker with the
+        contract names/types/labels — a rename or dynamic
+        registration would drop them from the audit and from every
+        dashboard keyed on them."""
+        sites = {
+            name: (mtype, labels)
+            for _, _, mtype, name, _, labels in self._call_sites()
+        }
+        expected = {
+            "dlrover_serve_requests_total": ("counter", ["outcome"]),
+            "dlrover_serve_tokens_total": ("counter", ["kind"]),
+            "dlrover_serve_kv_alloc_total": ("counter", ["outcome"]),
+            "dlrover_serve_replicas": ("gauge", ["state"]),
+            "dlrover_serve_kv_utilization": ("gauge", None),
+            "dlrover_serve_kv_blocks_in_use": ("gauge", None),
+            "dlrover_serve_queue_depth": ("gauge", None),
+            "dlrover_serve_inflight": ("gauge", None),
+            "dlrover_serve_replica_queue_depth": ("gauge", None),
+            "dlrover_serve_active_sequences": ("gauge", None),
+            "dlrover_serve_p99_latency_seconds": ("gauge", None),
+            "dlrover_serve_qps": ("gauge", None),
+            "dlrover_serve_preemptions_total": ("counter", None),
+            "dlrover_serve_ttft_seconds": ("histogram", None),
+            "dlrover_serve_tpot_seconds": ("histogram", None),
+            "dlrover_serve_replica_restarts_total": (
+                "counter", ["reason"],
+            ),
+        }
+        problems = {}
+        for name, want in expected.items():
+            got = sites.get(name)
+            if got is None or got[0] != want[0] or (
+                want[1] is not None and got[1] != want[1]
+            ):
+                problems[name] = (got, want)
+        assert not problems, problems
+
 
 class TestMasterExposition:
     """Acceptance: the master exposes Prometheus text metrics (node

@@ -203,6 +203,56 @@ def main() -> int:
     )
     json.dump(rec, open(out, "w"), indent=1)
     print(f"[decode] wrote {out}", flush=True)
+
+    # Regression gate: decode throughput rides the same fingerprinted
+    # append-only ledger as training (BENCH_LEDGER.jsonl; record kind
+    # "decode"), so a serving-path regression trips
+    # `bench_ledger compare --metric decode_tokens_per_sec` exactly
+    # like a train-step one. DECODE_LEDGER=0 skips (sweeps that
+    # should not pollute the history).
+    if os.environ.get("DECODE_LEDGER", "1") != "0":
+        from bench_ledger import append_record
+
+        for metric, value, unit, extra in (
+            (
+                "decode_tokens_per_sec",
+                rec["gpt2_decode_tok_s"],
+                "tok/s",
+                {
+                    "prefill_tok_s": rec["gpt2_prefill_tok_s"],
+                    "ms_per_tok": rec["gpt2_decode_ms_per_tok"],
+                    "batch": b,
+                },
+            ),
+            (
+                "decode_windowed_tokens_per_sec",
+                rec["mistral_decode_tok_s"],
+                "tok/s",
+                {
+                    "context": m_prompt,
+                    "window": mcfg.sliding_window,
+                    "chunked_over_mono": rec["chunked_over_mono"],
+                },
+            ),
+        ):
+            stored = append_record(
+                {
+                    "kind": "decode",
+                    "metric": metric,
+                    "value": value,
+                    "unit": unit,
+                    "backend": rec["backend"],
+                    "full_scale": rec["full_scale"],
+                    **extra,
+                },
+                backend=rec["backend"],
+            )
+            print(
+                f"[decode] ledger += {metric} "
+                f"{stored.get('value')} {unit} "
+                f"(rev {str(stored.get('git_rev', ''))[:12]})",
+                flush=True,
+            )
     return 0
 
 

@@ -378,6 +378,163 @@ def health_report(target: str) -> int:
     )
 
 
+def serving_report(target: str) -> int:
+    """Render the serving plane (router request counters, per-replica
+    TTFT/TPOT/queue/KV stats, unhealthy replicas) from a live master
+    (host:port, ``ServeQueryRequest`` RPC) or a JSON snapshot file
+    (``ServingRouter.snapshot()`` shaped). Exits 1 when any replica is
+    currently unhealthy (probe semantics, like ``--health``)."""
+    import json
+    import os
+
+    from dlrover_tpu.serving.router import render_serving
+
+    if os.path.isfile(target):
+        with open(target) as f:
+            payload = json.load(f)
+    elif (
+        target.endswith(".json")
+        or os.sep in target
+        or ":" not in target
+    ):
+        print(
+            f"serving snapshot not found: {target}", file=sys.stderr
+        )
+        return 2
+    else:
+        from dlrover_tpu.agent.master_client import MasterClient
+
+        client = MasterClient(target, node_id=-1)
+        try:
+            resp = client.query_serving(max_wait=15.0)
+        except Exception as exc:  # noqa: BLE001
+            print(
+                f"serving query to {target} failed: {exc}",
+                file=sys.stderr,
+            )
+            return 2
+        finally:
+            client.close()
+        if not resp.enabled:
+            print("serving plane disabled on this master")
+            return 0
+        payload = resp.snapshot
+    print(render_serving(payload))
+    return 1 if payload.get("unhealthy") else 0
+
+
+def _selftest_serving() -> list:
+    """Serving plane hermetically: a fake-clock router over two
+    replicas — one serving, one stalling mid-flight — must requeue
+    the stalled replica's work on drain, flag it unhealthy, and
+    render counters/percentiles via the same path ``--serving``
+    uses."""
+    import json as _json
+    import tempfile
+
+    from dlrover_tpu.serving.router import ServingRouter, render_serving
+
+    errors = []
+    clk = [1000.0]
+    router = ServingRouter(
+        clock=lambda: clk[0],
+        config={"progress_timeout_s": 5.0, "latency_window": 64},
+    )
+    router.register_replica(100, addr="rep-a")
+    router.register_replica(101, addr="rep-b")
+    rids = [
+        router.submit([1, 2, 3], max_new_tokens=4) for _ in range(4)
+    ]
+    if any(r is None for r in rids):
+        errors.append(f"submit rejected: {rids}")
+    a = router.pull(100, max_items=2)
+    b = router.pull(101, max_items=2)
+    if len(a) != 2 or len(b) != 2:
+        errors.append(f"pull sizes wrong: {len(a)}, {len(b)}")
+    clk[0] += 1.0
+    for req in a:
+        router.complete(
+            100, req.request_id, [7, 8, 9, 10],
+            ttft_s=0.2, tpot_s=0.01, finish_reason="length",
+        )
+    # rep-b stalls holding 2 requests past the progress timeout.
+    clk[0] += 6.0
+    unhealthy = router.unhealthy_replicas()
+    if [u["replica_id"] for u in unhealthy] != [101]:
+        errors.append(f"unhealthy detection wrong: {unhealthy}")
+    requeued = router.drain_replica(101, reason="selftest")
+    if requeued != 2:
+        errors.append(f"drain requeued {requeued}, want 2")
+    redispatch = router.pull(100, max_items=4)
+    if len(redispatch) != 2:
+        errors.append(
+            f"survivor re-pulled {len(redispatch)}, want 2"
+        )
+    for req in redispatch:
+        router.complete(
+            100, req.request_id, [1, 1, 1, 1],
+            ttft_s=0.3, tpot_s=0.02, finish_reason="length",
+        )
+    counters = router.counters()
+    if counters["done"] != 4 or counters["requeued_total"] != 2:
+        errors.append(f"counters wrong: {counters}")
+    for rid in rids:
+        rec = router.result(rid)
+        if rec is None or rec["state"] != "done":
+            errors.append(f"request {rid} not done: {rec}")
+    # Late duplicate from the drained replica: dropped, first wins.
+    if router.complete(101, rids[-1], [9, 9, 9, 9]):
+        errors.append("late duplicate completion was accepted")
+    router.report_stats(
+        100,
+        {
+            "queue_depth": 1, "active": 2, "tokens_generated": 16,
+            "ttft_p99_s": 0.25, "tpot_p50_s": 0.015,
+            "kv": {"utilization": 0.5},
+        },
+    )
+    snapshot = router.snapshot()
+    rendered = render_serving(snapshot)
+    for needle in (
+        "4 done",
+        "2 requeue(s)",
+        "replica 100",
+        "replica 101",
+        "[UNHEALTHY]",
+        "kv 50%",
+        "UNHEALTHY replicas: [101]",
+    ):
+        if needle not in rendered:
+            errors.append(
+                f"serving render missing {needle!r}: {rendered!r}"
+            )
+    # The --serving file path end to end: snapshot -> JSON -> report,
+    # rc 1 while the drained replica is still unhealthy.
+    with tempfile.NamedTemporaryFile(
+        "w", suffix=".json", delete=False
+    ) as f:
+        _json.dump(snapshot, f)
+        path = f.name
+    try:
+        if serving_report(path) != 1:
+            errors.append(
+                "serving_report rc != 1 with an unhealthy replica"
+            )
+        # The replica re-registers (fresh process): healthy again.
+        router.register_replica(101, addr="rep-b")
+        with open(path, "w") as f:
+            _json.dump(router.snapshot(), f)
+        if serving_report(path) != 0:
+            errors.append(
+                "serving_report rc != 0 after replica recovery"
+            )
+    finally:
+        import os as _os
+
+        _os.unlink(path)
+    return errors
+
+
 def _selftest_health() -> list:
     """Health plane hermetically: a fake-clock monitor over a ramping
     slow host + a healthy control host must convict exactly the slow
@@ -652,6 +809,7 @@ def selftest() -> int:
     errors.extend(_selftest_perf())
     errors.extend(_selftest_health())
     errors.extend(_selftest_remediation())
+    errors.extend(_selftest_serving())
     if errors:
         print("obs selftest FAILED:")
         for e in errors:
@@ -916,6 +1074,14 @@ def main(argv=None) -> int:
         "exits 1 when a critical verdict is active",
     )
     p.add_argument(
+        "--serving", type=str, default="",
+        metavar="TARGET",
+        help="render the master's serving plane (request counters, "
+        "per-replica TTFT/TPOT/queue/KV stats, unhealthy replicas) "
+        "from a live master (host:port) or a ServingRouter.snapshot()"
+        " JSON file; exits 1 when a replica is unhealthy",
+    )
+    p.add_argument(
         "--postmortem", type=str, default="",
         metavar="DIR",
         help="render a forensics dir (flight-recorder bundles + "
@@ -937,6 +1103,8 @@ def main(argv=None) -> int:
         return selftest()
     if args.health:
         return health_report(args.health)
+    if args.serving:
+        return serving_report(args.serving)
     if args.postmortem:
         from dlrover_tpu.obs.postmortem import render_postmortem
 

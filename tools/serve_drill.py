@@ -1,0 +1,399 @@
+"""Serving-plane chaos drill: replica kill under synthetic traffic.
+
+The serving counterpart of ``tools/chaos_drill.py``: an in-process
+master (router + health plane + remediation engine, deterministically
+ticked) fronts REAL replica subprocesses
+(``python -m dlrover_tpu.serving.replica``, seed-identical tiny
+models). Synthetic greedy traffic streams through the fleet, one
+replica is SIGKILLed mid-flight, and the drill asserts the serving
+survivability contract:
+
+* **zero dropped requests** — every submitted request completes; the
+  killed replica's in-flight work is requeued (``serve.requeue``) and
+  finished by the survivor, so the kill costs latency, not requests;
+* **bounded p99** — end-to-end latency stays under the drill bound
+  through the failover;
+* **the kill is visible in the control plane** — a
+  ``replica_unhealthy`` health verdict convicts the stalled replica,
+  the remediation ladder's drain rung fires
+  (``remediation.drain_replica``), and the node watchdog retires the
+  dead node (``serve.replica_gone``);
+* **failover is correct, not just complete** — requeued requests'
+  greedy tokens equal an in-process reference ``generate.generate``
+  on the same seed model (recompute-on-failover is exact for greedy
+  decode).
+
+Usage::
+
+    python tools/serve_drill.py --selftest        # seeded, <90s (CI)
+    python tools/serve_drill.py --requests 64 --seed 3
+    python tools/serve_drill.py --json out.json
+"""
+
+import _repo_path  # noqa: F401  (sys.path, must precede dlrover_tpu)
+
+import argparse
+import json
+import os
+import subprocess
+import sys
+import time
+
+from dlrover_tpu.common.config import ensure_framework_on_pythonpath
+from dlrover_tpu.common.constants import replica_node_id
+
+
+class DrillError(AssertionError):
+    pass
+
+
+def spawn_replica(
+    master_addr: str,
+    replica_id: int,
+    seed: int,
+    max_len: int = 48,
+) -> subprocess.Popen:
+    env = ensure_framework_on_pythonpath(dict(os.environ))
+    env["JAX_PLATFORMS"] = "cpu"
+    env["DLROVER_TPU_CHAOS"] = "0"
+    return subprocess.Popen(
+        [
+            sys.executable,
+            "-m", "dlrover_tpu.serving.replica",
+            "--master", master_addr,
+            "--replica_id", str(replica_id),
+            "--seed", str(seed),
+            "--lanes", "2",
+            "--block_size", "8",
+            "--prefill_chunk", "8",
+            "--max_len", str(max_len),
+            "--heartbeat_interval", "0.5",
+            "--stats_interval", "0.5",
+            "--pull_batch", "2",
+        ],
+        env=env,
+        stdout=subprocess.DEVNULL,
+        stderr=subprocess.DEVNULL,
+    )
+
+
+def run_serving_drill(
+    seed: int = 7,
+    replicas: int = 2,
+    requests: int = 20,
+    max_new: int = 24,
+    p99_bound_s: float = 45.0,
+    deadline_s: float = 150.0,
+    verify_outputs: int = 4,
+) -> dict:
+    """One replica-kill drill; returns a JSON-able report, raises
+    :class:`DrillError` on any contract violation."""
+    import numpy as np
+
+    import dlrover_tpu.obs as obs
+    from dlrover_tpu.agent.master_client import MasterClient
+    from dlrover_tpu.master.master import JobMaster
+
+    tracer = obs.configure_tracer()  # in-memory ring
+    t0 = time.monotonic()
+    master = JobMaster(
+        port=0,
+        node_num=2,
+        rdzv_timeout=1.0,
+        heartbeat_timeout=6.0,
+        monitor_interval=0.5,
+        collect_interval=999.0,
+        health_interval=9999.0,  # ticked manually, deterministically
+        remediation_config={
+            "interval_s": 9999.0,  # ticked manually
+            "hysteresis_ticks": 2,
+            "cooldown_s": 0.0,
+            "blast_window_s": 600.0,
+            "blast_max_actions": 4.0,
+            "probation_s": 300.0,
+        },
+        serving_config={
+            "progress_timeout_s": 1.5,
+            "scale_cooldown_s": 9999.0,
+        },
+    )
+    # Critical conviction at 1x the progress timeout: the drill's
+    # remediation path must outrun the 6s heartbeat watchdog so BOTH
+    # failover paths (drain + node-gone) are exercised.
+    master.health._config["replica_stall_crit_ratio"] = 1.0
+    master.prepare()
+    procs = {}
+    client = None
+    killed_id = None
+    try:
+        for rid in range(replicas):
+            procs[replica_node_id(rid)] = spawn_replica(
+                master.addr, rid, seed
+            )
+        client = MasterClient(master.addr, node_id=-1)
+
+        def ready_count():
+            snap = master.serving.snapshot()
+            return sum(
+                1 for r in snap["replicas"]
+                if r["state"] == "ready"
+            )
+
+        deadline = time.monotonic() + 60
+        while ready_count() < replicas:
+            if time.monotonic() > deadline:
+                raise DrillError(
+                    f"only {ready_count()}/{replicas} replicas "
+                    "registered within 60s"
+                )
+            for node_id, proc in procs.items():
+                if proc.poll() is not None:
+                    raise DrillError(
+                        f"replica {node_id} exited rc="
+                        f"{proc.returncode} before registering"
+                    )
+            time.sleep(0.2)
+
+        # Seeded traffic: prompts the reference model can replay.
+        rng = np.random.default_rng(seed)
+        from dlrover_tpu.serving.replica import build_tiny_model
+
+        ref_params, ref_cfg = build_tiny_model(seed, block_size=64)
+        prompts = {}
+        rids = []
+        for i in range(requests):
+            plen = int(rng.integers(3, 12))
+            prompt = rng.integers(
+                0, ref_cfg.vocab_size, size=plen
+            ).tolist()
+            resp = client.serve_submit(
+                prompt, max_new_tokens=max_new, temperature=0.0,
+                request_id=f"drill-{i}",
+            )
+            if not resp.accepted:
+                raise DrillError(f"submit {i} rejected")
+            prompts[resp.request_id] = prompt
+            rids.append(resp.request_id)
+
+        def states():
+            out = {}
+            for rid in rids:
+                r = client.serve_result(rid)
+                out[rid] = r
+            return out
+
+        # Let the fleet chew until the kill point: some requests
+        # done, and the victim replica holds in-flight work (so the
+        # kill leaves requests to rescue).
+        kill_deadline = time.monotonic() + 60
+        victim_node = None
+        while victim_node is None:
+            if time.monotonic() > kill_deadline:
+                raise DrillError(
+                    "no replica accumulated in-flight work to kill"
+                )
+            st = states()
+            done_n = sum(
+                1 for r in st.values() if r.state == "done"
+            )
+            in_flight = [
+                r for r in st.values() if r.state == "dispatched"
+            ]
+            if done_n >= max(requests // 10, 1) and in_flight:
+                victim_node = in_flight[0].replica_id
+            else:
+                time.sleep(0.05)
+        t_kill = time.monotonic()
+        procs[victim_node].kill()
+        procs[victim_node].wait()
+        killed_id = victim_node
+        print(
+            f"[drill] killed replica {victim_node} at "
+            f"+{t_kill - t0:.1f}s", flush=True,
+        )
+
+        # Drive the verdict -> remediation pipeline deterministically
+        # while traffic finishes on the survivor.
+        end = time.monotonic() + deadline_s
+        last_tick = 0.0
+        while time.monotonic() < end:
+            now = time.monotonic()
+            if now - last_tick >= 0.4:
+                last_tick = now
+                master.health.evaluate_once()
+                master.remediation.tick_once()
+            st = states()
+            if all(r.state == "done" for r in st.values()):
+                break
+            if any(r.state == "failed" for r in st.values()):
+                bad = {
+                    rid: r.error for rid, r in st.items()
+                    if r.state == "failed"
+                }
+                raise DrillError(f"requests FAILED: {bad}")
+            time.sleep(0.1)
+        st = states()
+        incomplete = {
+            rid: r.state for rid, r in st.items()
+            if r.state != "done"
+        }
+        if incomplete:
+            raise DrillError(
+                f"requests dropped/incomplete after "
+                f"{deadline_s:.0f}s: {incomplete}"
+            )
+
+        # Zero drops + bounded p99 (the same nearest-rank formula
+        # the router's exported gauge uses).
+        from dlrover_tpu.obs.timeseries import _percentile
+
+        latencies = sorted(r.latency_s for r in st.values())
+        p99 = _percentile(latencies, 99.0)
+        if p99 > p99_bound_s:
+            raise DrillError(
+                f"p99 {p99:.1f}s exceeds bound {p99_bound_s}s"
+            )
+        requeued = [rid for rid in rids if st[rid].requeues > 0]
+        if not requeued:
+            raise DrillError(
+                "no request was requeued — the kill victim held "
+                "in-flight work, so the failover path never ran"
+            )
+
+        # Failover correctness: requeued requests' greedy tokens
+        # must equal the reference model's (recompute is exact).
+        import jax.numpy as jnp
+
+        from dlrover_tpu.models import generate
+
+        mismatches = []
+        for rid in requeued[:verify_outputs]:
+            out = generate.generate(
+                ref_params, ref_cfg,
+                jnp.asarray([prompts[rid]], jnp.int32),
+                max_new_tokens=max_new, temperature=0.0,
+            )
+            want = np.asarray(out)[0, len(prompts[rid]):].tolist()
+            if st[rid].tokens != want:
+                mismatches.append((rid, st[rid].tokens, want))
+        if mismatches:
+            raise DrillError(
+                f"requeued outputs diverged from reference: "
+                f"{mismatches}"
+            )
+
+        # The control plane saw the kill: verdict + drain + requeue
+        # (+ the node watchdog retiring the dead node).
+        events, _ = tracer.events_since(0)
+        names = [e.get("name") for e in events]
+        verdicts = [
+            e for e in events
+            if e.get("name") == "health.verdict"
+            and e.get("detector") == "replica_unhealthy"
+        ]
+        if not any(v.get("node_id") == killed_id for v in verdicts):
+            raise DrillError(
+                "no replica_unhealthy verdict for the killed "
+                f"replica in the trace ({len(verdicts)} verdicts)"
+            )
+        for needle in ("serve.requeue", "remediation.drain_replica"):
+            if needle not in names:
+                raise DrillError(
+                    f"event {needle!r} missing from the drill trace"
+                )
+        drains = [
+            e for e in events
+            if e.get("name") == "remediation.drain_replica"
+        ]
+        if not any(d.get("node_id") == killed_id for d in drains):
+            raise DrillError(
+                f"drain decisions {drains} never targeted the "
+                f"killed replica {killed_id}"
+            )
+        counters = master.serving.counters()
+        report = {
+            "seed": seed,
+            "requests": requests,
+            "completed": counters["done"],
+            "failed": counters["failed"],
+            "requeued_requests": len(requeued),
+            "requeued_total": counters["requeued_total"],
+            "killed_replica": killed_id,
+            "p99_s": round(p99, 3),
+            "p50_s": round(_percentile(latencies, 50.0), 3),
+            "verdicts": len(verdicts),
+            "drains": len(drains),
+            "outputs_verified": min(len(requeued), verify_outputs),
+            "wall_s": round(time.monotonic() - t0, 1),
+        }
+        return report
+    finally:
+        if client is not None:
+            client.close()
+        for proc in procs.values():
+            if proc.poll() is None:
+                proc.terminate()
+                try:
+                    proc.wait(timeout=10)
+                except subprocess.TimeoutExpired:
+                    proc.kill()
+        master.stop()
+
+
+def selftest() -> int:
+    """Seeded, hermetic CPU-mesh drill (the tier-1 acceptance:
+    >=2 replicas serve synthetic traffic through one replica kill
+    with zero drops and bounded p99)."""
+    t0 = time.monotonic()
+    report = run_serving_drill(seed=7)
+    print(
+        f"serving drill ok: {report['completed']}/"
+        f"{report['requests']} requests completed through the kill "
+        f"of replica {report['killed_replica']} "
+        f"({report['requeued_requests']} requeued, "
+        f"{report['outputs_verified']} outputs verified, "
+        f"p99 {report['p99_s']}s)"
+    )
+    print(
+        f"serve drill selftest ok "
+        f"({time.monotonic() - t0:.1f}s)"
+    )
+    return 0
+
+
+def main(argv=None) -> int:
+    parser = argparse.ArgumentParser("serve_drill")
+    parser.add_argument("--selftest", action="store_true",
+                        help="seeded quick mode (<90s) for CI")
+    parser.add_argument("--seed", type=int, default=7)
+    parser.add_argument("--replicas", type=int, default=2)
+    parser.add_argument("--requests", type=int, default=18)
+    parser.add_argument("--max_new", type=int, default=5)
+    parser.add_argument("--p99_bound", type=float, default=45.0)
+    parser.add_argument("--json", type=str, default="",
+                        help="write the drill report to this path")
+    args = parser.parse_args(argv)
+    if args.selftest:
+        return selftest()
+    try:
+        report = run_serving_drill(
+            seed=args.seed,
+            replicas=args.replicas,
+            requests=args.requests,
+            max_new=args.max_new,
+            p99_bound_s=args.p99_bound,
+        )
+        report["ok"] = True
+        rc = 0
+    except DrillError as e:
+        report = {"ok": False, "error": str(e)}
+        rc = 1
+    print(json.dumps(report))
+    if args.json:
+        with open(args.json, "w") as f:
+            json.dump(report, f, indent=2)
+    return rc
+
+
+if __name__ == "__main__":
+    sys.exit(main())
