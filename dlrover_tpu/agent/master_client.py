@@ -791,6 +791,7 @@ class MasterClient:
         tpot_s: float = 0.0,
         finish_reason: str = "",
         error: str = "",
+        phases: Optional[Dict[str, float]] = None,
     ) -> None:
         self._report(
             msg.ServeCompletedReport(
@@ -801,6 +802,10 @@ class MasterClient:
                 tpot_s=tpot_s,
                 finish_reason=finish_reason,
                 error=error,
+                phases={
+                    str(k): float(v)
+                    for k, v in (phases or {}).items()
+                },
             )
         )
 
@@ -817,6 +822,25 @@ class MasterClient:
         except Exception:  # noqa: BLE001 — telemetry must not kill
             # the replica loop
             logger.debug("serve stats report failed", exc_info=True)
+
+    def query_traces(
+        self,
+        trace_id: str = "",
+        subject: str = "",
+        limit: int = 0,
+        max_wait: Optional[float] = None,
+    ) -> msg.TraceQueryResponse:
+        """Assembled distributed-trace timelines from the master's
+        trace store. ``trace_id`` fetches one trace; ``subject``
+        filters by membership (a serving request id, or
+        ``node:<id>``); ``limit`` > 0 keeps the newest N. The
+        ``obs_report --trace`` feed."""
+        return self._get(
+            msg.TraceQueryRequest(
+                trace_id=trace_id, subject=subject, limit=limit
+            ),
+            max_wait=max_wait,
+        )
 
     def query_serving(
         self, max_wait: Optional[float] = None

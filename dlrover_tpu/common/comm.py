@@ -21,6 +21,7 @@ import grpc
 from dlrover_tpu.common import messages
 from dlrover_tpu.common.constants import GrpcEnv
 from dlrover_tpu.common.log import get_logger
+from dlrover_tpu.obs import tracer as _trace
 
 logger = get_logger("comm")
 
@@ -53,6 +54,24 @@ def _chaos_server_hook(request) -> None:
     inj = _chaos_injector()
     if inj is not None:
         inj.on_server_request(request)
+
+
+class _TracedPayload:
+    """Client-side carrier pairing a request with its trace-context
+    envelope (``_tc``) for the gRPC serializer — per-call state the
+    stub's fixed ``request_serializer`` could not otherwise see."""
+
+    __slots__ = ("msg", "trace")
+
+    def __init__(self, msg: Any, trace: Dict[str, str]):
+        self.msg = msg
+        self.trace = trace
+
+
+def _serialize_request(obj: Any) -> bytes:
+    if isinstance(obj, _TracedPayload):
+        return messages.serialize(obj.msg, trace=obj.trace)
+    return messages.serialize(obj)
 
 
 def find_free_port(host: str = "127.0.0.1") -> int:
@@ -96,34 +115,47 @@ class _GenericHandler(grpc.GenericRpcHandler):
         if method == _GET:
             return grpc.unary_unary_rpc_method_handler(
                 self._do_get,
-                request_deserializer=messages.deserialize,
+                request_deserializer=messages.deserialize_with_trace,
                 response_serializer=messages.serialize,
             )
         if method == _REPORT:
             return grpc.unary_unary_rpc_method_handler(
                 self._do_report,
-                request_deserializer=messages.deserialize,
+                request_deserializer=messages.deserialize_with_trace,
                 response_serializer=messages.serialize,
             )
         return None
 
-    def _do_get(self, request, context):
+    def _dispatch(self, handle, payload, what: str):
+        request, trace = payload
         _chaos_server_hook(request)
+        # Re-activate the caller's trace context for the handler: the
+        # spans/events the master emits while serving this RPC land in
+        # the caller's causal timeline. Malformed carriers extract to
+        # None and cost nothing.
+        ctx = _trace.extract(trace) if trace else None
         try:
-            result = self._dispatcher.handle_get(request)
+            if ctx is not None:
+                with _trace.activate(ctx):
+                    result = handle(request)
+            else:
+                result = handle(request)
             return messages.BaseResponse(success=True, data=result)
         except Exception as e:  # noqa: BLE001 - must not kill the server
-            logger.exception("get(%s) failed", type(request).__name__)
+            logger.exception(
+                "%s(%s) failed", what, type(request).__name__
+            )
             return messages.BaseResponse(success=False, message=str(e))
 
-    def _do_report(self, request, context):
-        _chaos_server_hook(request)
-        try:
-            result = self._dispatcher.handle_report(request)
-            return messages.BaseResponse(success=True, data=result)
-        except Exception as e:  # noqa: BLE001
-            logger.exception("report(%s) failed", type(request).__name__)
-            return messages.BaseResponse(success=False, message=str(e))
+    def _do_get(self, payload, context):
+        return self._dispatch(
+            self._dispatcher.handle_get, payload, "get"
+        )
+
+    def _do_report(self, payload, context):
+        return self._dispatch(
+            self._dispatcher.handle_report, payload, "report"
+        )
 
 
 class RpcServer:
@@ -194,12 +226,12 @@ class RpcClient:
             )
             self._get = self._channel.unary_unary(
                 _GET,
-                request_serializer=messages.serialize,
+                request_serializer=_serialize_request,
                 response_deserializer=messages.deserialize,
             )
             self._report = self._channel.unary_unary(
                 _REPORT,
-                request_serializer=messages.serialize,
+                request_serializer=_serialize_request,
                 response_deserializer=messages.deserialize,
             )
 
@@ -220,6 +252,15 @@ class RpcClient:
             inj.before_client_call(stub_name, request)
         self._connect()
         stub = self._get if stub_name == "get" else self._report
+        # Propagate the active trace context (if any) as the request
+        # envelope's _tc field. inject() is a dict lookup + None when
+        # no trace is active — the common case stays allocation-free.
+        carrier = _trace.inject()
+        payload = (
+            _TracedPayload(request, carrier)
+            if carrier is not None
+            else request
+        )
         # wait_for_ready=True queues the RPC until the channel
         # (re)connects instead of failing fast from TRANSIENT_FAILURE
         # — without it a channel that ever saw the master down keeps
@@ -228,7 +269,7 @@ class RpcClient:
         # outage. Best-effort telemetry passes False: it must DROP
         # fast during an outage, not block a reporting loop.
         response = stub(
-            request,
+            payload,
             timeout=timeout or self.timeout,
             wait_for_ready=wait_for_ready,
         )

@@ -61,14 +61,34 @@ def decode_from_dict(d: Any) -> Any:
     return d
 
 
-def serialize(msg: Any) -> bytes:
-    return msgpack.packb(encode_to_dict(msg), use_bin_type=True)
+def serialize(msg: Any, trace: Optional[Dict[str, str]] = None) -> bytes:
+    """Encode a message for the wire. ``trace`` (the dict
+    ``obs.tracer.inject()`` produced) rides as a reserved top-level
+    ``_tc`` envelope field — never a message field, so every message
+    type propagates trace context without schema changes, and an old
+    decoder simply drops it (``decode_from_dict`` filters unknown
+    keys)."""
+    d = encode_to_dict(msg)
+    if trace:
+        d["_tc"] = {str(k): str(v) for k, v in trace.items()}
+    return msgpack.packb(d, use_bin_type=True)
 
 
 def deserialize(data: bytes) -> Any:
     return decode_from_dict(
         msgpack.unpackb(data, raw=False, strict_map_key=False)
     )
+
+
+def deserialize_with_trace(data: bytes):
+    """``(message, trace_carrier_or_None)`` — the server-side pair of
+    :func:`serialize`'s ``trace=``. The carrier is the raw ``_tc``
+    dict (feed it to ``obs.tracer.extract``)."""
+    raw = msgpack.unpackb(data, raw=False, strict_map_key=False)
+    trace = None
+    if isinstance(raw, dict):
+        trace = raw.pop("_tc", None)
+    return decode_from_dict(raw), trace
 
 
 # ---------------------------------------------------------------------------
@@ -436,6 +456,10 @@ class RemediationDecisionMsg:
     timestamp: float = 0.0
     probation_deadline: float = 0.0
     note: str = ""
+    # The decision's distributed trace (verdict -> governors ->
+    # action -> probation -> outcome spans), queryable via
+    # TraceQueryRequest.
+    trace_id: str = ""
 
 
 @message
@@ -755,16 +779,24 @@ class ServeSubmitRequest:
 class ServeSubmitResponse:
     request_id: str = ""
     accepted: bool = True
+    # The distributed trace minted (or adopted) for this request at
+    # the router — feed it to query_traces for the causal timeline.
+    trace_id: str = ""
 
 
 @message
 class ServeWorkItem:
-    """One dispatched request on the wire (router -> replica)."""
+    """One dispatched request on the wire (router -> replica).
+    ``trace`` is the request's trace context (an
+    ``obs.tracer.inject()`` carrier): the replica re-attaches it so
+    scheduler events on any hop — including every requeue hop —
+    stay in one causal timeline."""
 
     request_id: str = ""
     prompt: List[int] = dataclasses.field(default_factory=list)
     max_new_tokens: int = 16
     temperature: float = 0.0
+    trace: Dict[str, str] = dataclasses.field(default_factory=dict)
 
 
 @message
@@ -796,6 +828,11 @@ class ServeCompletedReport:
     tpot_s: float = 0.0
     finish_reason: str = ""
     error: str = ""
+    # Replica-side TTFT decomposition, per-phase durations in seconds
+    # (dispatch = scheduler queue wait, prefill, first_decode, decode)
+    # — the master folds these into the request's trace timeline and
+    # the dlrover_serve_ttft_phase_seconds histograms.
+    phases: Dict[str, float] = dataclasses.field(default_factory=dict)
 
 
 @message
@@ -818,6 +855,10 @@ class ServeResultResponse:
     ttft_s: float = 0.0
     tpot_s: float = 0.0
     latency_s: float = 0.0
+    trace_id: str = ""
+    # Master-assembled TTFT decomposition: queue (router) + the
+    # replica-reported phases of the completing hop.
+    phases: Dict[str, float] = dataclasses.field(default_factory=dict)
 
 
 @message
@@ -846,6 +887,30 @@ class ServeQueryRequest:
 class ServeQueryResponse:
     enabled: bool = False
     snapshot: Dict[str, Any] = dataclasses.field(default_factory=dict)
+
+
+@message
+class TraceQueryRequest:
+    """Fetch assembled trace timelines from the master's trace store.
+    ``trace_id`` wins when given; else ``subject`` filters by
+    membership (a serving request id, or ``node:<id>``); else every
+    retained trace. ``limit`` > 0 keeps the newest N."""
+
+    trace_id: str = ""
+    subject: str = ""
+    limit: int = 0
+
+
+@message
+class TraceQueryResponse:
+    """``traces`` are trace-store timelines: ``{trace_id, start_ts,
+    end_ts, subjects, spans: [{name, span_id, parent_span_id,
+    start_ts, dur_s, tags}], dropped_spans}``, newest last."""
+
+    enabled: bool = False
+    traces: List[Dict[str, Any]] = dataclasses.field(
+        default_factory=list
+    )
 
 
 # -- brain service wire messages (standalone brain: brain/server.py) --

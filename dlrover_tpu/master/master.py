@@ -106,13 +106,20 @@ class JobMaster:
         from dlrover_tpu.obs.fleet import FleetAggregator
         from dlrover_tpu.obs.goodput import GoodputAccountant
         from dlrover_tpu.obs.timeseries import TimeSeriesStore
+        from dlrover_tpu.obs.trace_store import TraceStore
 
         self.timeseries = TimeSeriesStore()
         self.goodput = GoodputAccountant(timeseries=self.timeseries)
+        # Distributed-trace assembly (bounded, ring-retained like the
+        # request ledger): in-master planes feed it directly; trace-
+        # tagged events in agent snapshots arrive via the fleet
+        # aggregator. Read via TraceQueryRequest / obs_report --trace.
+        self.traces = TraceStore()
         self.fleet = FleetAggregator(
             speed_monitor=self.speed_monitor,
             goodput=self.goodput,
             timeseries=self.timeseries,
+            trace_store=self.traces,
         )
         self.speed_monitor.timeseries = self.timeseries
         self.elastic_rdzv = ElasticRendezvous()
@@ -147,8 +154,14 @@ class JobMaster:
                 job_name
                 or os.getenv("DLROVER_TPU_JOB_NAME", "default")
             ),
+            trace_sink=self.traces,
         )
         self.servicer.serving = self.serving
+        self.servicer.traces = self.traces
+        # Rendezvous rounds are traces too: each round's start ->
+        # complete interval lands in the store as one rdzv.round span.
+        self.elastic_rdzv.trace_sink = self.traces
+        self.check_rdzv.trace_sink = self.traces
         # Brain datastore: where the health plane persists runtime
         # samples, fleet aggregates + goodput ratio, and verdicts —
         # the same channel ROADMAP item 2's policy engine reads. An
@@ -199,6 +212,7 @@ class JobMaster:
             servicer=self.servicer,
             fleet=self.fleet,
             store=self.timeseries,
+            traces=self.traces,
             speed_monitor=self.speed_monitor,
             rdzv_managers=(self.elastic_rdzv, self.check_rdzv),
             serving=self.serving,

@@ -90,6 +90,7 @@ from dlrover_tpu.common.constants import (
     NodeType,
 )
 from dlrover_tpu.common.log import get_logger
+from dlrover_tpu.obs import tracer as _trace
 from dlrover_tpu.obs.health import SEVERITY_CRITICAL, HealthVerdict
 
 logger = get_logger("remediation")
@@ -163,6 +164,12 @@ _PROBATIONS_ACTIVE = obs.gauge(
     "Remediation actions currently inside their post-action "
     "probation window",
 )
+_RECOVERY_SECONDS = obs.gauge(
+    "dlrover_remediation_recovery_seconds",
+    "Decision-to-recovery duration of the most recently RECOVERED "
+    "remediation (verdict-convicted action through probation "
+    "success)",
+)
 
 # Every governor knob, with its default. Override per knob via
 # DLROVER_TPU_REMEDIATION_<NAME-upper> or the config= dict (config
@@ -215,6 +222,11 @@ class RemediationDecision:
     resolved_at: float = 0.0
     replacement_id: int = -1
     note: str = ""
+    # Distributed trace: one trace per decision (verdict -> governors
+    # -> action -> probation -> outcome spans; a drain's requeues link
+    # in), span_id its root span.
+    trace_id: str = ""
+    span_id: str = ""
 
     def subject(self) -> Tuple[str, int]:
         return (self.host, self.node_id)
@@ -238,6 +250,8 @@ class RemediationDecision:
             "resolved_at": round(self.resolved_at, 3),
             "replacement_id": self.replacement_id,
             "note": self.note,
+            "trace_id": self.trace_id,
+            "span_id": self.span_id,
         }
 
     @classmethod
@@ -263,6 +277,8 @@ class RemediationDecision:
             resolved_at=float(d.get("resolved_at", 0.0)),
             replacement_id=int(d.get("replacement_id", -1)),
             note=str(d.get("note", "")),
+            trace_id=str(d.get("trace_id", "")),
+            span_id=str(d.get("span_id", "")),
         )
 
 
@@ -292,6 +308,7 @@ class RemediationEngine:
         rdzv_managers: Sequence = (),
         serving=None,
         brain=None,
+        traces=None,
         min_nodes: int = 1,
         job_name: str = "default",
         clock: Optional[Callable[[], float]] = None,
@@ -312,6 +329,10 @@ class RemediationEngine:
         # masters (the detector then never fires either).
         self.serving = serving
         self.brain = brain
+        # Trace store: every decision assembles a causal timeline
+        # (verdict -> governors -> action -> probation -> outcome)
+        # queryable by decision trace id or node subject.
+        self.traces = traces
         self.min_nodes = max(int(min_nodes), 1)
         self.job_name = job_name
         self.clock = clock if clock is not None else time.time
@@ -666,6 +687,34 @@ class RemediationEngine:
                 v.metrics.get("baseline_mean_s", 0.0)
             ),
             timestamp=now,
+            trace_id=_trace.new_trace_id(),
+            span_id=_trace.new_span_id(),
+        )
+
+    def _tspan(
+        self,
+        d: RemediationDecision,
+        name: str,
+        start: float,
+        dur: float = 0.0,
+        span_id: str = "",
+        parent: Optional[str] = None,
+        **tags,
+    ) -> None:
+        """One span of the decision's trace (no-op without a store).
+        Default parent is the decision's root span."""
+        if self.traces is None or not d.trace_id:
+            return
+        self.traces.add_span(
+            d.trace_id,
+            name,
+            start,
+            dur_s=max(dur, 0.0),
+            span_id=span_id,
+            parent_span_id=d.span_id if parent is None else parent,
+            node_id=d.node_id,
+            decision_id=d.decision_id,
+            **tags,
         )
 
     def _execute(self, d: RemediationDecision) -> bool:
@@ -705,10 +754,23 @@ class RemediationEngine:
         re-registers ready)."""
         if self.serving is None:
             return False
-        self.serving.drain_replica(d.node_id, reason=d.detector)
+        # The drain's requeues join this decision's trace: the router
+        # records a serve.requeue span per rescued request under the
+        # decision root, so verdict -> drain -> requeue reads as one
+        # causal chain. link= only when a trace store is wired —
+        # duck-typed routers without the kwarg stay supported.
+        if self.traces is not None and d.trace_id:
+            self.serving.drain_replica(
+                d.node_id,
+                reason=d.detector,
+                link=(d.trace_id, d.span_id),
+            )
+        else:
+            self.serving.drain_replica(d.node_id, reason=d.detector)
         obs.event(
             "remediation.drain_replica",
             node_id=d.node_id, detector=d.detector,
+            trace_id=d.trace_id, parent_span_id=d.span_id,
         )
         return True
 
@@ -982,10 +1044,14 @@ class RemediationEngine:
             self.job_manager.retire_node(d.node_id)
             self.job_manager.uncordon_node(d.node_id)
         _CORDONED_NODES.set(len(self._cordoned))
+        # The derived SLO surface: how long this decision took from
+        # conviction to verified recovery.
+        _RECOVERY_SECONDS.set(max(now - d.timestamp, 0.0))
         obs.event(
             "remediation.recovered",
             node_id=d.node_id, host=d.host, detector=d.detector,
             action=d.action, decision_id=d.decision_id,
+            trace_id=d.trace_id, parent_span_id=d.span_id,
         )
         logger.info(
             "remediation recovered: %s on node %d (%s) for %s",
@@ -1122,6 +1188,45 @@ class RemediationEngine:
         if created:
             with self._lock:
                 self._decisions.append(d)
+            # The decision's trace opens: root span, the convicting
+            # verdict, the governor gate results, and the action with
+            # its immediate outcome (acted / blocked / dry_run /
+            # failed).
+            self._tspan(
+                d, "remediation.decision", d.timestamp,
+                span_id=d.span_id, parent="",
+                detector=d.detector, host=d.host,
+                action=d.action, outcome=d.outcome,
+            )
+            self._tspan(
+                d, "remediation.verdict", d.timestamp,
+                detector=d.detector, severity=d.severity,
+                trigger=d.trigger,
+            )
+            self._tspan(
+                d, "remediation.governors", d.timestamp,
+                **{
+                    f"governor_{name}": why
+                    for name, why in d.governors.items()
+                },
+            )
+            if d.action:
+                self._tspan(
+                    d, f"remediation.{d.action}", d.timestamp,
+                    outcome=d.outcome, dry_run=d.dry_run,
+                )
+        else:
+            # Finalization: the probation interval and its outcome.
+            end = d.resolved_at or self.clock()
+            self._tspan(
+                d, "remediation.probation", d.timestamp,
+                dur=end - d.timestamp,
+                outcome=d.outcome,
+            )
+            self._tspan(
+                d, "remediation.outcome", end,
+                outcome=d.outcome, note=d.note,
+            )
         _DECISIONS_TOTAL.inc(
             detector=d.detector, action=d.action, outcome=d.outcome
         )
@@ -1130,6 +1235,7 @@ class RemediationEngine:
             decision_id=d.decision_id, detector=d.detector,
             node_id=d.node_id, host=d.host, action=d.action,
             outcome=d.outcome, dry_run=d.dry_run,
+            trace_id=d.trace_id, parent_span_id=d.span_id,
         )
         self._persist(d)
 
@@ -1239,6 +1345,7 @@ class RemediationEngine:
                     timestamp=d.timestamp,
                     probation_deadline=d.probation_deadline,
                     note=d.note,
+                    trace_id=d.trace_id,
                 )
                 for d in decisions
             ],

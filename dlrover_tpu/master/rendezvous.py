@@ -85,6 +85,13 @@ class RendezvousManagerBase:
         # Fired outside the lock after membership/world changes; the
         # JobMaster points this at the state journal.
         self.on_state_change = None
+        # Distributed tracing: one trace per rendezvous round. The
+        # JobMaster points trace_sink at its TraceStore; the round's
+        # start -> freeze interval lands there as one rdzv.round span
+        # and the round events carry its trace id.
+        self.trace_sink = None
+        self._round_trace = None  # obs.tracer.TraceContext | None
+        self._round_start_wall = 0.0
 
     def _changed(self) -> None:
         cb = self.on_state_change
@@ -132,9 +139,20 @@ class RendezvousManagerBase:
                     self.name,
                     self._rdzv_round,
                 )
+            if self._round_trace is None:
+                # Round boundary, which is NOT always an empty
+                # waiting set: a freeze that leaves surplus waiters
+                # behind seeds the next round non-empty, and that
+                # churn round must be traced too.
+                from dlrover_tpu.obs import tracer as _trace
+
+                self._round_start_wall = time.time()
+                self._round_trace = _trace.new_trace_context()
                 obs.event(
                     "rdzv.start",
                     rdzv=self.name, round=self._rdzv_round,
+                    trace_id=self._round_trace.trace_id,
+                    parent_span_id=self._round_trace.span_id,
                 )
             if node_rank not in self._waiting_nodes:
                 self._waiting_nodes[node_rank] = local_world_size
@@ -195,12 +213,34 @@ class RendezvousManagerBase:
             _RDZV_ROUNDS.inc(name=self.name)
             _RDZV_WORLD.set(len(self._rdzv_nodes), name=self.name)
             _RDZV_SECONDS.observe(elapsed, name=self.name)
+            trace = self._round_trace
             obs.event(
                 "rdzv.complete",
                 rdzv=self.name, round=self._rdzv_round,
                 world_size=len(self._rdzv_nodes),
                 elapsed_s=round(elapsed, 3),
+                **(
+                    {
+                        "trace_id": trace.trace_id,
+                        "parent_span_id": trace.span_id,
+                    }
+                    if trace is not None
+                    else {}
+                ),
             )
+            if trace is not None and self.trace_sink is not None:
+                self.trace_sink.add_span(
+                    trace.trace_id,
+                    "rdzv.round",
+                    self._round_start_wall or time.time() - elapsed,
+                    dur_s=elapsed,
+                    span_id=trace.span_id,
+                    subject=f"rdzv:{self.name}",
+                    rdzv=self.name,
+                    round=self._rdzv_round,
+                    world_size=len(self._rdzv_nodes),
+                )
+            self._round_trace = None
         return completed
 
     # -- warm-restart snapshot ----------------------------------------------

@@ -46,6 +46,10 @@ MAX_STORED_DIGEST = 16384
 # original action was delivered — a replayed RESTART_TRAINING must
 # not double-bounce a trainer.
 MAX_DEDUPE_KEYS = 512
+# Server-side cap on traces returned by one non-id TraceQueryRequest
+# (each trace can carry up to max_spans_per_trace spans — an
+# unbounded listing would approach the gRPC message cap).
+MAX_TRACE_QUERY = 64
 
 
 class MasterServicer:
@@ -116,6 +120,9 @@ class MasterServicer:
         # Serving router (set by the JobMaster); None on a bare
         # servicer — serve RPCs then answer "serving disabled".
         self.serving = None
+        # Trace store (set by the JobMaster); None on a bare servicer
+        # — trace queries then answer "tracing disabled".
+        self.traces = None
         # Per-node forensics history (DiagnosticsReport digests),
         # bounded so a crash-looping node cannot grow master memory.
         # Locked: report and query arrive on different RPC worker
@@ -153,6 +160,7 @@ class MasterServicer:
         g(msg.DiagnosticsQueryRequest, self._query_diagnostics)
         g(msg.HealthQueryRequest, self._query_health)
         g(msg.RemediationQueryRequest, self._query_remediation)
+        g(msg.TraceQueryRequest, self._query_traces)
         g(msg.ServeSubmitRequest, self._serve_submit)
         g(msg.ServeResultRequest, self._serve_result)
         g(msg.ServePullRequest, self._serve_pull)
@@ -594,6 +602,30 @@ class MasterServicer:
             node_id=req.node_id, limit=req.limit
         )
 
+    def _query_traces(self, req: msg.TraceQueryRequest):
+        """The trace store's typed read channel: assembled causal
+        timelines by trace id or subject. Non-id queries are capped
+        server-side (MAX_TRACE_QUERY newest): an unbounded "give me
+        everything" against a full store would serialize ~130k spans
+        into one response and blow the gRPC message cap."""
+        if self.traces is None:
+            return msg.TraceQueryResponse(enabled=False)
+        limit = req.limit
+        if not req.trace_id:
+            limit = (
+                min(limit, MAX_TRACE_QUERY)
+                if limit > 0
+                else MAX_TRACE_QUERY
+            )
+        return msg.TraceQueryResponse(
+            enabled=True,
+            traces=self.traces.query(
+                trace_id=req.trace_id,
+                subject=req.subject,
+                limit=limit,
+            ),
+        )
+
     # -- serving plane ------------------------------------------------------
 
     def _serve_submit(self, req: msg.ServeSubmitRequest):
@@ -608,7 +640,9 @@ class MasterServicer:
             request_id=req.request_id,
         )
         return msg.ServeSubmitResponse(
-            request_id=rid or "", accepted=rid is not None
+            request_id=rid or "",
+            accepted=rid is not None,
+            trace_id=self.serving.trace_of(rid) if rid else "",
         )
 
     def _serve_result(self, req: msg.ServeResultRequest):
@@ -636,6 +670,7 @@ class MasterServicer:
                     prompt=list(r.prompt),
                     max_new_tokens=r.max_new_tokens,
                     temperature=r.temperature,
+                    trace=dict(r.trace),
                 )
                 for r in items
             ]
@@ -652,6 +687,7 @@ class MasterServicer:
             tpot_s=req.tpot_s,
             finish_reason=req.finish_reason,
             error=req.error,
+            phases=req.phases,
         )
         return None
 

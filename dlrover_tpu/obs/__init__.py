@@ -15,6 +15,12 @@ doing through this package, so "what is the job doing right now" and
   lines when ``DLROVER_TPU_TRACE_FILE`` is set. Disabled (the
   default) every hook is a None-check costing well under a
   microsecond, so instrumented hot paths stay hot.
+* :mod:`dlrover_tpu.obs.trace_store` — the master-side distributed-
+  trace assembler: bounded per-trace span timelines (serving request
+  hops with TTFT phase spans, remediation decision chains, rendezvous
+  rounds) fed by the in-master planes and the snapshot event channel,
+  queryable via the ``TraceQueryRequest`` RPC and
+  ``obs_report --trace``.
 * :mod:`dlrover_tpu.obs.timeline` — folds an event stream into the
   canonical recovery breakdown ``failure-detect -> rendezvous ->
   restore -> first-step -> 90%-throughput`` that the chaos drills
@@ -78,12 +84,27 @@ from dlrover_tpu.obs.metrics import (  # noqa: F401
 )
 from dlrover_tpu.obs.tracer import (  # noqa: F401
     EventTracer,
+    IdSource,
+    TraceContext,
+    activate,
     configure_tracer,
+    current_context,
     disable_tracer,
     event,
+    extract,
     get_tracer,
+    inject,
+    new_span_id,
+    new_trace_context,
+    new_trace_id,
+    set_id_source,
     span,
     tracing_enabled,
+)
+from dlrover_tpu.obs.trace_store import (  # noqa: F401
+    TraceStore,
+    render_trace,
+    span_tree,
 )
 from dlrover_tpu.obs.fleet import FleetAggregator  # noqa: F401
 from dlrover_tpu.obs.flight_recorder import (  # noqa: F401

@@ -91,6 +91,7 @@ class FleetAggregator:
         ttl: float = DEFAULT_TTL,
         attach: bool = True,
         timeseries=None,
+        trace_store=None,
     ):
         """``attach=False`` skips hooking :meth:`collect` into the
         registry's render — for owners that cannot guarantee a
@@ -99,11 +100,16 @@ class FleetAggregator:
         :class:`~dlrover_tpu.obs.timeseries.TimeSeriesStore`) turns
         every ingest into history: per-host scalars and fleet
         aggregates are recorded so the health detectors can query
-        windows instead of instants."""
+        windows instead of instants. ``trace_store`` (a
+        :class:`~dlrover_tpu.obs.trace_store.TraceStore`) receives
+        any snapshot event that carries a ``trace_id`` — the channel
+        by which spans emitted on OTHER hosts join the master's
+        assembled trace timelines."""
         self.registry = registry or get_registry()
         self.speed_monitor = speed_monitor
         self.goodput = goodput
         self.timeseries = timeseries
+        self.trace_store = trace_store
         self.ttl = ttl
         self._lock = threading.Lock()
         self._hosts: Dict[str, HostSnapshot] = {}
@@ -146,6 +152,10 @@ class FleetAggregator:
             for t in snap.step_times:
                 self.speed_monitor.observe_host_step_time(node_id, t)
         events = getattr(report, "events", None) or []
+        if self.trace_store is not None and events:
+            # Only trace-tagged events are trace material;
+            # add_events ignores the rest.
+            self.trace_store.add_events(events)
         if self.goodput is not None:
             if events:
                 self.goodput.add_events(events)

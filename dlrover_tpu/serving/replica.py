@@ -211,6 +211,7 @@ class ReplicaWorker:
                         prompt=list(item.prompt),
                         max_new_tokens=item.max_new_tokens,
                         temperature=item.temperature,
+                        trace=dict(item.trace or {}),
                     )
                 )
         completed = self.scheduler.step()
@@ -224,6 +225,7 @@ class ReplicaWorker:
                     tpot_s=c.tpot_s,
                     finish_reason=c.finish_reason,
                     error=c.error,
+                    phases=c.phases,
                 )
             except Exception:  # noqa: BLE001 — the router requeues
                 # on our death; a lost completion costs a recompute,

@@ -46,6 +46,7 @@ from typing import Callable, Dict, List, Optional
 
 from dlrover_tpu import obs
 from dlrover_tpu.common.log import get_logger
+from dlrover_tpu.obs import tracer as _trace
 from dlrover_tpu.serving.scheduler import ServeRequest
 
 logger = get_logger("serving.router")
@@ -86,6 +87,21 @@ _P99_GAUGE = obs.gauge(
 _QPS_GAUGE = obs.gauge(
     "dlrover_serve_qps",
     "Completed requests per second over the router's recent window",
+)
+_TTFT_PHASE_SECONDS = obs.histogram(
+    "dlrover_serve_ttft_phase_seconds",
+    "Router-observed time-to-first-token decomposed by phase: queue "
+    "(router queue incl. requeue waits), dispatch (replica admission "
+    "wait), prefill, first_decode — the phases sum to the request's "
+    "observed TTFT",
+    ("phase",),
+    buckets=(0.005, 0.025, 0.1, 0.25, 0.5, 1.0, 2.5, 5.0, 10.0, 30.0),
+)
+_REQUEUE_HOPS = obs.histogram(
+    "dlrover_serve_requeue_hops",
+    "Requeue hops a request took before completing (0 = finished on "
+    "its first replica), observed per completed request's trace",
+    buckets=(0.0, 1.0, 2.0, 3.0, 5.0, 8.0),
 )
 
 DEFAULTS: Dict[str, float] = {
@@ -135,7 +151,8 @@ class _Request:
     __slots__ = (
         "req", "state", "replica_id", "submit_ts", "dispatch_ts",
         "done_ts", "tokens", "error", "requeues", "ttft_s", "tpot_s",
-        "finish_reason", "order",
+        "finish_reason", "order", "trace_id", "root_span",
+        "root_parent", "hops", "phases",
     )
 
     def __init__(self, req: ServeRequest, now: float):
@@ -152,6 +169,18 @@ class _Request:
         self.ttft_s = 0.0
         self.tpot_s = 0.0
         self.finish_reason = ""
+        # Distributed trace: one trace per request, minted at submit
+        # (or adopted from the caller's RPC context); hops are the
+        # dispatch intervals [{replica_id, dispatch_ts, end_ts, end}]
+        # the trace timeline is assembled from.
+        self.trace_id = ""
+        self.root_span = ""
+        # When the trace is ADOPTED from the caller's RPC context,
+        # the request root parents under the caller's span so the
+        # cross-process causality renders as one tree.
+        self.root_parent = ""
+        self.hops: List[dict] = []
+        self.phases: Dict[str, float] = {}
 
 
 class ServingRouter:
@@ -161,10 +190,17 @@ class ServingRouter:
         clock: Callable[[], float] = time.time,
         config: Optional[Dict[str, float]] = None,
         job_name: str = "default",
+        trace_sink=None,
     ):
+        """``trace_sink`` is the master's
+        :class:`~dlrover_tpu.obs.trace_store.TraceStore` (or None):
+        the router assembles every request's causal timeline into it
+        — queue waits, per-replica hops closed by requeue or
+        completion, and the completing hop's TTFT phase spans."""
         self.job_manager = job_manager
         self.clock = clock
         self.job_name = job_name
+        self.trace_sink = trace_sink
         self._config = dict(config or {})
         self._lock = threading.Lock()
         self._replicas: Dict[int, _Replica] = {}
@@ -182,8 +218,39 @@ class ServingRouter:
         self._finished: deque = deque()
         self._done_total = 0
         self._failed_total = 0
+        # The slowest observed TTFT and its phase breakdown (the
+        # obs_report --serving "worst trace" line): where the p99
+        # lives, not just what it is.
+        self._worst_ttft: Optional[dict] = None
         self._stop = threading.Event()
         self._thread: Optional[threading.Thread] = None
+
+    def _span(
+        self,
+        rec: "_Request",
+        name: str,
+        start: float,
+        dur: float,
+        span_id: str = "",
+        parent: Optional[str] = None,
+        **tags,
+    ) -> None:
+        """Record one span of ``rec``'s trace into the sink (no-op
+        without one). Default parent is the request's root span."""
+        if self.trace_sink is None or not rec.trace_id:
+            return
+        self.trace_sink.add_span(
+            rec.trace_id,
+            name,
+            start,
+            dur_s=max(dur, 0.0),
+            span_id=span_id,
+            parent_span_id=(
+                rec.root_span if parent is None else parent
+            ),
+            request_id=rec.req.request_id,
+            **tags,
+        )
 
     # -- lifecycle ---------------------------------------------------------
 
@@ -261,23 +328,35 @@ class ServingRouter:
         )
         logger.info("serving replica %d registered (%s)", node_id, addr)
 
-    def drain_replica(self, node_id: int, reason: str = "") -> int:
+    def drain_replica(
+        self,
+        node_id: int,
+        reason: str = "",
+        link: Optional[tuple] = None,
+    ) -> int:
         """Stop dispatching to a replica and requeue everything it
         holds. Returns the number of requests requeued. The replica
         stays registered (a restart re-registers it ready); the
-        remediation engine's drain rung calls this."""
+        remediation engine's drain rung calls this, passing its
+        decision trace as ``link`` (``(trace_id, parent_span_id)``)
+        so the requeues it causes join the decision's timeline."""
         with self._lock:
             rep = self._replicas.get(node_id)
             if rep is None:
                 return 0
             rep.state = REPLICA_DRAINING
             rep.drains += 1
-            n = self._requeue_locked(rep)
+            n = self._requeue_locked(rep, link=link)
         self._publish_replicas()
         self._publish_queue()
         obs.event(
             "serve.drain", replica_id=node_id, requeued=n,
             reason=reason,
+            **(
+                {"trace_id": link[0], "parent_span_id": link[1]}
+                if link
+                else {}
+            ),
         )
         logger.warning(
             "draining serving replica %d (%s): %d request(s) requeued",
@@ -305,10 +384,17 @@ class ServingRouter:
         )
         return n
 
-    def _requeue_locked(self, rep: _Replica) -> int:
+    def _requeue_locked(
+        self, rep: _Replica, link: Optional[tuple] = None
+    ) -> int:
         """Move every request dispatched to ``rep`` back to the FRONT
         of the queue, oldest submission first (they have waited
-        longest). Caller holds the lock."""
+        longest). Caller holds the lock. ``link`` is the causing
+        remediation decision's ``(trace_id, parent_span_id)``: each
+        requeue is then ALSO recorded as a span of that decision's
+        trace, so verdict -> drain -> requeue reads as one causal
+        chain."""
+        now = self.clock()
         n = 0
         pending = [
             (self._requests[rid].order, rid)
@@ -327,10 +413,33 @@ class ServingRouter:
             self._queue.appendleft(rid)
             n += 1
             _REQUESTS_TOTAL.inc(outcome="requeued")
+            # Close the lost hop in the request's own trace.
+            hop = rec.hops[-1] if rec.hops else None
+            if hop is not None and not hop["end"]:
+                hop["end_ts"] = now
+                hop["end"] = "requeue"
+                self._span(
+                    rec, "serve.hop", hop["dispatch_ts"],
+                    now - hop["dispatch_ts"],
+                    span_id=hop["span_id"],
+                    replica_id=rep.node_id,
+                    hop=len(rec.hops) - 1,
+                    end="requeue",
+                )
             obs.event(
                 "serve.requeue", request_id=rid,
-                replica_id=rep.node_id,
+                replica_id=rep.node_id, hop=rec.requeues,
+                trace_id=rec.trace_id,
+                parent_span_id=rec.root_span,
             )
+            if link is not None and self.trace_sink is not None:
+                self.trace_sink.add_span(
+                    link[0], "serve.requeue", now,
+                    parent_span_id=link[1],
+                    request_id=rid,
+                    replica_id=rep.node_id,
+                    link_trace_id=rec.trace_id,
+                )
         rep.dispatched.clear()
         self._requeued_total += n
         return n
@@ -376,9 +485,29 @@ class ServingRouter:
                 self.clock(),
             )
             rec.order = order
+            # Mint the request's distributed trace at submit — or
+            # adopt the caller's (the RPC envelope's context is active
+            # on this handler thread). Every hop, requeue, and phase
+            # span of this request's life carries this trace id.
+            ctx = _trace.current_context()
+            rec.trace_id = (
+                ctx.trace_id if ctx is not None else _trace.new_trace_id()
+            )
+            rec.root_span = _trace.new_span_id()
+            rec.root_parent = ctx.span_id if ctx is not None else ""
+            rec.req.trace = {
+                "trace_id": rec.trace_id,
+                "span_id": rec.root_span,
+            }
             self._requests[rid] = rec
             self._queue.append(rid)
         _REQUESTS_TOTAL.inc(outcome="submitted")
+        obs.event(
+            "serve.submit",
+            request_id=rid,
+            trace_id=rec.trace_id,
+            parent_span_id=rec.root_span,
+        )
         self._publish_queue()
         return rid
 
@@ -401,6 +530,29 @@ class ServingRouter:
                 rec.state = REQ_DISPATCHED
                 rec.replica_id = replica_id
                 rec.dispatch_ts = now
+                # Close the queue interval and open this hop in the
+                # trace: queue time since submit (hop 0) or since the
+                # previous hop ended (requeue wait).
+                queued_since = (
+                    rec.hops[-1]["end_ts"]
+                    if rec.hops
+                    else rec.submit_ts
+                )
+                self._span(
+                    rec, "serve.queue", queued_since,
+                    now - queued_since, hop=len(rec.hops),
+                )
+                rec.hops.append(
+                    {
+                        "replica_id": replica_id,
+                        "dispatch_ts": now,
+                        "end_ts": 0.0,
+                        "end": "",
+                        "span_id": _trace.new_span_id()
+                        if rec.trace_id
+                        else "",
+                    }
+                )
                 rep.dispatched.add(rid)
                 out.append(rec.req)
         if out:
@@ -416,6 +568,7 @@ class ServingRouter:
         tpot_s: float = 0.0,
         finish_reason: str = "",
         error: str = "",
+        phases: Optional[Dict[str, float]] = None,
     ) -> bool:
         """A replica finished (or failed) a request. First completion
         wins; late duplicates from a replica the request was requeued
@@ -458,12 +611,16 @@ class ServingRouter:
             rec.ttft_s = ttft_s
             rec.tpot_s = tpot_s
             rec.finish_reason = finish_reason
+            rec.phases = {
+                str(k): float(v) for k, v in (phases or {}).items()
+            }
             if error:
                 self._failed_total += 1
             else:
                 self._done_total += 1
                 self._done_latencies.append(now - rec.submit_ts)
                 self._done_stamps.append(now)
+            self._finish_trace_locked(rec, replica_id, now)
             # Bounded ledger: finished records past the retention
             # evict oldest-first (the result becomes unknown to late
             # pollers; cumulative counters keep the totals) — the
@@ -485,6 +642,97 @@ class ServingRouter:
         # recomputed per completion: the router thread refreshes
         # them every autoscale_interval_s, off the RPC hot path.
         return True
+
+    def _finish_trace_locked(
+        self, rec: _Request, replica_id: int, now: float
+    ) -> None:
+        """Fold the finished request into its trace timeline and the
+        TTFT phase surface. Caller holds the lock."""
+        hop = rec.hops[-1] if rec.hops else None
+        if hop is not None and not hop["end"]:
+            hop["end_ts"] = now
+            hop["end"] = rec.state
+            self._span(
+                rec, "serve.hop", hop["dispatch_ts"],
+                now - hop["dispatch_ts"],
+                span_id=hop["span_id"],
+                replica_id=replica_id,
+                hop=len(rec.hops) - 1,
+                end=rec.state,
+            )
+        # Total time spent QUEUED at the router (initial wait plus
+        # every requeue wait) — the "queue" slice of TTFT.
+        queue_s, prev = 0.0, rec.submit_ts
+        for h in rec.hops:
+            queue_s += max(h["dispatch_ts"] - prev, 0.0)
+            prev = h["end_ts"] or now
+        ph = dict(rec.phases)
+        if not rec.error and ph:
+            decomposed = {
+                "queue": round(queue_s, 6),
+                "dispatch": round(float(ph.get("dispatch", 0.0)), 6),
+                "prefill": round(float(ph.get("prefill", 0.0)), 6),
+                "first_decode": round(
+                    float(ph.get("first_decode", 0.0)), 6
+                ),
+            }
+            for phase, dur in decomposed.items():
+                _TTFT_PHASE_SECONDS.observe(dur, phase=phase)
+            ttft_total = round(sum(decomposed.values()), 6)
+            rec.phases = {
+                **decomposed,
+                "decode": round(float(ph.get("decode", 0.0)), 6),
+                "ttft_total": ttft_total,
+            }
+            worst = self._worst_ttft
+            if worst is None or ttft_total > worst["ttft_total_s"]:
+                self._worst_ttft = {
+                    "request_id": rec.req.request_id,
+                    "trace_id": rec.trace_id,
+                    "replica_id": replica_id,
+                    "requeues": rec.requeues,
+                    "ttft_total_s": ttft_total,
+                    "phases": decomposed,
+                }
+        _REQUEUE_HOPS.observe(float(rec.requeues))
+        # The completing hop's interior phase spans, laid sequentially
+        # backward from the completion instant (the durations are the
+        # replica's own monotonic measurements; only the wall anchor
+        # is approximated) — monotonic and non-overlapping by
+        # construction.
+        if self.trace_sink is not None and hop is not None and ph:
+            names = (
+                ("dispatch", "serve.dispatch"),
+                ("prefill", "serve.prefill"),
+                ("first_decode", "serve.first_token"),
+                ("decode", "serve.decode"),
+            )
+            total = sum(
+                max(float(ph.get(k, 0.0)), 0.0) for k, _ in names
+            )
+            t = now - total
+            for key, span_name in names:
+                dur = max(float(ph.get(key, 0.0)), 0.0)
+                self._span(
+                    rec, span_name, t, dur,
+                    parent=hop["span_id"] or rec.root_span,
+                    replica_id=replica_id,
+                )
+                t += dur
+        self._span(
+            rec, "serve.request", rec.submit_ts,
+            now - rec.submit_ts,
+            span_id=rec.root_span, parent=rec.root_parent,
+            requeues=rec.requeues, outcome=rec.state,
+            replica_id=replica_id,
+        )
+
+    def trace_of(self, request_id: str) -> str:
+        """The trace id minted for a ledger-known request ("" when
+        unknown/evicted)."""
+        with self._lock:
+            rec = self._requests.get(request_id)
+            return rec.trace_id if rec is not None else ""
 
     def result(self, request_id: str) -> Optional[dict]:
         """The ledger's view of one request (the ServeResultResponse
@@ -508,6 +756,8 @@ class ServingRouter:
                     if rec.done_ts
                     else 0.0
                 ),
+                "trace_id": rec.trace_id,
+                "phases": dict(rec.phases),
             }
 
     # -- telemetry ----------------------------------------------------------
@@ -726,6 +976,9 @@ class ServingRouter:
                 )
             ]
             queue_depth = len(self._queue)
+            worst = (
+                dict(self._worst_ttft) if self._worst_ttft else None
+            )
         return {
             "ts": self.clock(),
             "queue_depth": queue_depth,
@@ -734,6 +987,7 @@ class ServingRouter:
             "counters": self.counters(),
             "replicas": replicas,
             "unhealthy": sorted(unhealthy),
+            "worst_ttft": worst,
         }
 
 
@@ -771,6 +1025,19 @@ def render_serving(payload: dict) -> str:
             f"ttft p99 {stats.get('ttft_p99_s', 0.0):.3f}s, "
             f"tpot p50 {stats.get('tpot_p50_s', 0.0):.4f}s, "
             f"progress {rep.get('last_progress_age_s', 0.0):.1f}s ago"
+        )
+    worst = payload.get("worst_ttft")
+    if worst:
+        ph = worst.get("phases") or {}
+        lines.append(
+            f"  worst TTFT {worst.get('ttft_total_s', 0.0):.3f}s = "
+            f"queue {ph.get('queue', 0.0):.3f}s + "
+            f"dispatch {ph.get('dispatch', 0.0):.3f}s + "
+            f"prefill {ph.get('prefill', 0.0):.3f}s + "
+            f"first_decode {ph.get('first_decode', 0.0):.3f}s "
+            f"({worst.get('request_id', '?')}, "
+            f"{worst.get('requeues', 0)} requeue(s), "
+            f"trace {str(worst.get('trace_id', ''))[:16]})"
         )
     if unhealthy:
         lines.append(

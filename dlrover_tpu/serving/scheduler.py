@@ -87,12 +87,16 @@ LATENCY_WINDOW = 256
 
 @dataclasses.dataclass
 class ServeRequest:
-    """One generation request as it rides queues and the wire."""
+    """One generation request as it rides queues and the wire.
+    ``trace`` is the router-minted trace-context carrier
+    (``{"trace_id", "span_id"}``) re-attached on every hop so
+    scheduler events stay in the request's causal timeline."""
 
     request_id: str
     prompt: List[int]
     max_new_tokens: int = 16
     temperature: float = 0.0
+    trace: Dict[str, str] = dataclasses.field(default_factory=dict)
 
     def to_dict(self) -> dict:
         return {
@@ -100,6 +104,7 @@ class ServeRequest:
             "prompt": list(self.prompt),
             "max_new_tokens": self.max_new_tokens,
             "temperature": self.temperature,
+            "trace": dict(self.trace),
         }
 
     @classmethod
@@ -109,6 +114,10 @@ class ServeRequest:
             prompt=[int(t) for t in d.get("prompt", [])],
             max_new_tokens=int(d.get("max_new_tokens", 16)),
             temperature=float(d.get("temperature", 0.0)),
+            trace={
+                str(k): str(v)
+                for k, v in (d.get("trace") or {}).items()
+            },
         )
 
 
@@ -121,6 +130,13 @@ class CompletedRequest:
     ttft_s: float = 0.0
     tpot_s: float = 0.0
     wall_s: float = 0.0
+    # Replica-side TTFT decomposition (per-phase durations, seconds):
+    # dispatch (local queue wait: scheduler submit -> lane admission),
+    # prefill (admission -> last prompt chunk), first_decode (prefill
+    # done -> first token), decode (first -> last token). dispatch +
+    # prefill + first_decode == ttft_s + dispatch by construction;
+    # the router folds these into the request's trace timeline.
+    phases: Dict[str, float] = dataclasses.field(default_factory=dict)
 
 
 class _Seq:
@@ -129,6 +145,7 @@ class _Seq:
     __slots__ = (
         "req", "lane", "phase", "prefilled", "generated",
         "admit_ts", "first_token_ts", "last_token_ts", "last_logits",
+        "dispatch_wait_s", "prefill_done_ts",
     )
 
     def __init__(self, req: ServeRequest, lane: int, now: float):
@@ -140,6 +157,11 @@ class _Seq:
         self.admit_ts = now
         self.first_token_ts = 0.0
         self.last_token_ts = 0.0
+        # TTFT phase boundaries: how long the request waited in this
+        # replica's local queue before claiming a lane, and when its
+        # prompt finished prefilling.
+        self.dispatch_wait_s = 0.0
+        self.prefill_done_ts = 0.0
         # Host copy of the final prefill chunk's logits row, used to
         # sample the first token at the prefill -> decode handoff.
         self.last_logits: Optional[np.ndarray] = None
@@ -197,6 +219,12 @@ class ContinuousBatchingScheduler:
         )
         self._queue: deque = deque()
         self.max_queue = max_queue
+        # request_id -> local-queue entry stamp (the "dispatch" TTFT
+        # phase: scheduler submit -> lane admission). Entries leave at
+        # admission/rejection; a preemption re-stamps (its re-
+        # admission wait is a fresh dispatch phase, matching the
+        # recomputed TTFT).
+        self._enqueue_ts: Dict[str, float] = {}
         self._by_lane: Dict[int, _Seq] = {}
         self._steps = 0
         self._completed_total = 0
@@ -288,6 +316,7 @@ class ContinuousBatchingScheduler:
         if len(self._queue) >= self.max_queue:
             return False
         self._queue.append(req)
+        self._enqueue_ts[rid] = self.clock()
         _REPLICA_QUEUE.set(len(self._queue))
         return True
 
@@ -333,6 +362,7 @@ class ContinuousBatchingScheduler:
                 or self.pool.blocks_for(total) > self.pool.total_blocks
             ):
                 self._queue.popleft()
+                self._enqueue_ts.pop(req.request_id, None)
                 completed.append(
                     CompletedRequest(
                         request_id=req.request_id,
@@ -358,7 +388,11 @@ class ContinuousBatchingScheduler:
             if lane is None:
                 break  # no lane / no blocks: stays queued
             self._queue.popleft()
-            self._by_lane[lane] = _Seq(req, lane, now)
+            seq = _Seq(req, lane, now)
+            seq.dispatch_wait_s = max(
+                now - self._enqueue_ts.pop(req.request_id, now), 0.0
+            )
+            self._by_lane[lane] = seq
 
     def _prefill_tick(self, now: float) -> None:
         """Advance PREFILL sequences by bounded chunks. Ragged final
@@ -395,7 +429,10 @@ class ContinuousBatchingScheduler:
                     # Prefill -> decode handoff: sample the first
                     # token host-side from the last real position
                     # (one boundary transfer per request, outside
-                    # the steady decode loop).
+                    # the steady decode loop). The prefill phase ends
+                    # here; the logits materialization + sample is
+                    # the first_decode slice of TTFT.
+                    seq.prefill_done_ts = self.clock()
                     row = np.asarray(logits[0, c - 1])
                     seq.last_logits = row
                     tok = self._sample_host(seq.req, row)
@@ -540,6 +577,17 @@ class ContinuousBatchingScheduler:
             )
             else FINISH_LENGTH
         )
+        prefill_done = seq.prefill_done_ts or seq.first_token_ts
+        phases = {
+            "dispatch": round(seq.dispatch_wait_s, 6),
+            "prefill": round(prefill_done - seq.admit_ts, 6),
+            "first_decode": round(
+                seq.first_token_ts - prefill_done, 6
+            ),
+            "decode": round(
+                seq.last_token_ts - seq.first_token_ts, 6
+            ),
+        }
         return CompletedRequest(
             request_id=seq.req.request_id,
             tokens=list(seq.generated),
@@ -547,6 +595,7 @@ class ContinuousBatchingScheduler:
             ttft_s=round(seq.first_token_ts - seq.admit_ts, 6),
             tpot_s=round(tpot, 6),
             wall_s=round(now - seq.admit_ts, 6),
+            phases=phases,
         )
 
     def _preempt_youngest(self) -> Optional[str]:
@@ -560,14 +609,19 @@ class ContinuousBatchingScheduler:
             self._by_lane.pop(seq.lane, None)
             # Recompute preemption: back to the FRONT of the queue,
             # redoing prefill from the prompt (greedy decode redoes
-            # to the identical tokens).
+            # to the identical tokens). Re-stamp the local-queue
+            # entry: the re-admission wait is a fresh dispatch phase,
+            # matching the recomputed TTFT.
             self._queue.appendleft(seq.req)
+            self._enqueue_ts[victim_id] = self.clock()
             self._preempted_total += 1
             _PREEMPTIONS_TOTAL.inc()
+            trace_id = seq.req.trace.get("trace_id", "")
             obs.event(
                 "serve.preempt",
                 request_id=victim_id,
                 generated=len(seq.generated),
+                **({"trace_id": trace_id} if trace_id else {}),
             )
         return victim_id
 
@@ -584,6 +638,7 @@ class ContinuousBatchingScheduler:
         self._by_lane.clear()
         out.extend(self._queue)
         self._queue.clear()
+        self._enqueue_ts.clear()
         _REPLICA_QUEUE.set(0)
         _ACTIVE_SEQS.set(0)
         return out

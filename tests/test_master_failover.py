@@ -741,6 +741,12 @@ class TestClockSourceAudit:
         ("dlrover_tpu/master/speed_monitor.py", "collect_node_step"),
         ("dlrover_tpu/master/speed_monitor.py", "remove_running_node"),
         ("dlrover_tpu/master/state_store.py", "save"),
+        # Rendezvous-round trace spans anchor on wall time (the
+        # trace store's timelines are cross-process artifacts; the
+        # round's TIMER math stays monotonic — see
+        # _start_rdzv_time).
+        ("dlrover_tpu/master/rendezvous.py", "join"),
+        ("dlrover_tpu/master/rendezvous.py", "_try_complete"),
         ("dlrover_tpu/agent/monitor.py", "write_metrics"),
         ("dlrover_tpu/agent/monitor.py", "mark_phase"),
         ("dlrover_tpu/agent/master_client.py", "heartbeat"),

@@ -354,6 +354,10 @@ class TestMetricNameHygiene:
     METRIC_NAME_RE = r"^dlrover_[a-z0-9]+(_[a-z0-9]+)*$"
     LABEL_NAME_RE = r"^[a-z][a-z0-9_]*$"
     RESERVED_LABELS = ("le", "quantile")
+    # Unbounded-cardinality identifiers: request/trace ids live in
+    # SPANS (the trace store), never in a metric label — one label
+    # value per request would grow every scrape forever.
+    UNBOUNDED_LABELS = ("request_id", "trace_id", "span_id")
 
     def _call_sites(self):
         import ast
@@ -463,6 +467,12 @@ class TestMetricNameHygiene:
                             f"{where}: {name!r} label {label!r} is "
                             "reserved by Prometheus"
                         )
+                    if label in self.UNBOUNDED_LABELS:
+                        problems.append(
+                            f"{where}: {name!r} label {label!r} has "
+                            "unbounded cardinality — ids belong in "
+                            "trace spans, not metric labels"
+                        )
         # The walker must actually see labeled registrations (e.g.
         # dlrover_forensics_bundles_total{node,kind}); zero means the
         # label extraction broke, not that the code is clean.
@@ -529,6 +539,109 @@ class TestMetricNameHygiene:
             ):
                 problems[name] = (got, want)
         assert not problems, problems
+
+
+class TestSpanNameHygiene:
+    """Audit every literal ``obs.span(...)`` / ``obs.event(...)``
+    name in the framework and tools: dotted lowercase namespaces
+    (``serve.requeue``, ``remediation.decision``, ``rdzv.start`` —
+    never camelCase, never a bare un-namespaced word), so the trace
+    store's plane attribution and obs_report's renderers can key on a
+    stable naming contract."""
+
+    SPAN_NAME_RE = r"^[a-z][a-z0-9_]*(\.[a-z0-9_]+)+$"
+    # The plane each subsystem's spans/events must namespace under.
+    PLANE_PREFIXES = {
+        os.path.join("dlrover_tpu", "serving"): ("serve.",),
+        os.path.join("dlrover_tpu", "master", "remediation.py"): (
+            "remediation.",
+        ),
+        os.path.join("dlrover_tpu", "master", "rendezvous.py"): (
+            "rdzv.",
+        ),
+    }
+
+    def _call_sites(self):
+        import ast
+
+        sites = []
+        for root in ("dlrover_tpu", "tools"):
+            for dirpath, _, files in os.walk(os.path.join(REPO, root)):
+                if "__pycache__" in dirpath:
+                    continue
+                for fname in files:
+                    if not fname.endswith(".py"):
+                        continue
+                    fpath = os.path.join(dirpath, fname)
+                    with open(fpath, encoding="utf-8") as f:
+                        tree = ast.parse(f.read(), filename=fpath)
+                    for node in ast.walk(tree):
+                        if not (
+                            isinstance(node, ast.Call)
+                            and isinstance(node.func, ast.Attribute)
+                            and node.func.attr in ("span", "event")
+                            and isinstance(node.func.value, ast.Name)
+                            and node.func.value.id in ("obs", "tracer")
+                        ):
+                            continue
+                        if not (
+                            node.args
+                            and isinstance(node.args[0], ast.Constant)
+                            and isinstance(node.args[0].value, str)
+                        ):
+                            continue  # dynamic name: not auditable
+                        sites.append(
+                            (
+                                os.path.relpath(fpath, REPO),
+                                node.lineno,
+                                node.args[0].value,
+                            )
+                        )
+        return sites
+
+    def test_span_names_are_dotted_lowercase_namespaces(self):
+        import re
+
+        sites = self._call_sites()
+        # The framework emits plenty of spans/events; an empty audit
+        # means the walker broke, not that the code is clean.
+        assert len(sites) >= 30, sites
+        problems = []
+        for rel, line, name in sites:
+            where = f"{rel}:{line}"
+            if not re.match(self.SPAN_NAME_RE, name):
+                problems.append(
+                    f"{where}: span/event name {name!r} is not a "
+                    "dotted lowercase namespace"
+                )
+        assert not problems, "\n".join(problems)
+
+    def test_planes_use_their_namespace(self):
+        sites = self._call_sites()
+        problems = []
+        for rel, line, name in sites:
+            for subpath, prefixes in self.PLANE_PREFIXES.items():
+                if not rel.startswith(subpath):
+                    continue
+                if not name.startswith(prefixes):
+                    problems.append(
+                        f"{rel}:{line}: {name!r} outside the "
+                        f"{prefixes} namespace(s) of its plane"
+                    )
+        assert not problems, "\n".join(problems)
+
+    def test_serving_and_remediation_planes_are_audited(self):
+        """The walker must actually SEE the cross-plane span names
+        the trace store and drill assertions key on — a rename or a
+        move to dynamic names would silently drop them from the
+        audit."""
+        names = {name for _, _, name in self._call_sites()}
+        for required in (
+            "serve.submit", "serve.requeue", "serve.drain",
+            "remediation.decision", "remediation.drain_replica",
+            "rdzv.start", "rdzv.complete",
+        ):
+            assert required in names, (required, sorted(names))
 
 
 class TestMasterExposition:

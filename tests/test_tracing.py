@@ -1,0 +1,592 @@
+"""Cross-plane distributed tracing (ISSUE 13): trace-context
+propagation, the master-side trace store, the serving router's trace
+assembly with TTFT phase attribution, and the hot-loop overhead
+tripwires.
+
+The serving/remediation end-to-end half of this PR's acceptance lives
+in ``tools/serve_drill.py`` (run by tests/test_serving.py): the
+SIGKILL drill asserts a requeued request's trace shows >=2 replica
+hops with monotonic non-overlapping phase spans, the phase histograms
+sum to observed TTFT, and the drain decision's trace links
+verdict -> drain -> requeue — all fetched via ``query_traces``.
+"""
+
+import gc
+import random
+import threading
+import time
+
+import pytest
+
+from dlrover_tpu import obs
+from dlrover_tpu.obs import tracer
+from dlrover_tpu.obs.trace_store import TraceStore, span_tree
+
+
+@pytest.fixture()
+def seeded_ids():
+    """Deterministic trace/span ids (the injectable RNG seam — no
+    wall-clock analogue anywhere in id minting)."""
+    prev = tracer.set_id_source(tracer.IdSource(random.Random(7)))
+    yield
+    tracer.set_id_source(prev)
+
+
+@pytest.fixture()
+def live_tracer():
+    tr = obs.configure_tracer()
+    yield tr
+    obs.disable_tracer()
+
+
+class TestTraceContext:
+    def test_ids_are_deterministic_hex(self, seeded_ids):
+        a = tracer.new_trace_context()
+        assert len(a.trace_id) == 32 and len(a.span_id) == 16
+        int(a.trace_id, 16), int(a.span_id, 16)  # valid hex
+        tracer.set_id_source(tracer.IdSource(random.Random(7)))
+        b = tracer.new_trace_context()
+        assert (a.trace_id, a.span_id) == (b.trace_id, b.span_id)
+
+    def test_inject_extract_roundtrip(self, seeded_ids):
+        ctx = tracer.new_trace_context().child()
+        with tracer.activate(ctx):
+            carrier = tracer.inject()
+        back = tracer.extract(carrier)
+        assert back.trace_id == ctx.trace_id
+        assert back.span_id == ctx.span_id
+        assert back.parent_span_id == ctx.parent_span_id
+
+    def test_extract_tolerates_garbage(self):
+        for bad in (None, {}, [], "x", {"trace_id": ""},
+                    {"span_id": "only"}, {"trace_id": "t"}):
+            assert tracer.extract(bad) is None
+
+    def test_activation_is_scoped_and_nested(self, seeded_ids):
+        assert tracer.current_context() is None
+        outer = tracer.new_trace_context()
+        with tracer.activate(outer):
+            assert tracer.current_context() is outer
+            inner = outer.child()
+            with tracer.activate(inner):
+                assert tracer.current_context() is inner
+                assert inner.parent_span_id == outer.span_id
+            assert tracer.current_context() is outer
+        assert tracer.current_context() is None
+        assert tracer.inject() is None
+
+    def test_spans_chain_span_ids(self, seeded_ids, live_tracer):
+        root = tracer.new_trace_context()
+        with tracer.activate(root):
+            with obs.span("serve.hop"):
+                with obs.span("serve.prefill"):
+                    pass
+        events = {e["name"]: e for e in live_tracer.events()}
+        hop, prefill = events["serve.hop"], events["serve.prefill"]
+        assert hop["trace_id"] == root.trace_id
+        assert hop["parent_span_id"] == root.span_id
+        assert prefill["parent_span_id"] == hop["span_id"]
+
+    def test_point_events_tag_current_span(
+        self, seeded_ids, live_tracer
+    ):
+        root = tracer.new_trace_context()
+        with tracer.activate(root):
+            obs.event("serve.requeue", request_id="r1")
+        ev = live_tracer.events()[-1]
+        assert ev["trace_id"] == root.trace_id
+        assert ev["parent_span_id"] == root.span_id
+
+    def test_no_context_no_trace_tags(self, live_tracer):
+        with obs.span("trainer.step_phases"):
+            obs.event("trainer.compile")
+        for ev in live_tracer.events():
+            assert "trace_id" not in ev
+
+
+class TestThreadStateBounded:
+    """The PR's tracer fix: per-thread stacks must not accumulate
+    for dead threads — a churny replica/supervisor thread pool ran
+    spans on thousands of short-lived threads and the old
+    threading.local could strand state until interpreter exit."""
+
+    def test_span_stacks_pruned_after_thread_churn(self, live_tracer):
+        def work(i):
+            with obs.span("serve.hop", i=i):
+                pass
+
+        for batch in range(8):
+            threads = [
+                threading.Thread(target=work, args=(batch * 32 + j,))
+                for j in range(32)
+            ]
+            for t in threads:
+                t.start()
+            for t in threads:
+                t.join()
+        # Balanced span usage deletes entries eagerly: nothing may
+        # remain for the 256 dead threads.
+        assert len(live_tracer._stacks) == 0, live_tracer._stacks
+        assert len(tracer._ctx_stacks) == 0, tracer._ctx_stacks
+
+    @staticmethod
+    def _dead_threads(n):
+        ts = [threading.Thread(target=lambda: None) for _ in range(n)]
+        for t in ts:
+            t.start()
+        for t in ts:
+            t.join()
+        return ts
+
+    def test_stacks_orphaned_mid_span_are_swept(self, live_tracer):
+        """A thread dying INSIDE a span leaks its stack entry; the
+        high-water-mark sweep must reclaim it."""
+        from dlrover_tpu.obs.tracer import _STACKS_SWEEP_AT
+
+        dead = self._dead_threads(_STACKS_SWEEP_AT)
+        with live_tracer._stacks_lock:
+            for t in dead:
+                live_tracer._stacks[t] = ["serve.hop"]
+        assert len(live_tracer._stacks) >= _STACKS_SWEEP_AT
+
+        def spin_one_span():
+            with obs.span("serve.queue"):
+                pass
+
+        # A fresh thread's first span creates a new stack entry,
+        # which triggers the sweep past the high-water mark.
+        t = threading.Thread(target=spin_one_span)
+        t.start()
+        t.join()
+        assert not any(
+            d in live_tracer._stacks for d in dead
+        ), live_tracer._stacks
+        assert len(live_tracer._stacks) <= 1
+
+    def test_activation_stacks_orphans_swept_too(self):
+        from dlrover_tpu.obs.tracer import _STACKS_SWEEP_AT
+
+        ctx = tracer.new_trace_context()
+        dead = self._dead_threads(_STACKS_SWEEP_AT)
+        with tracer._ctx_lock:
+            for t in dead:
+                tracer._ctx_stacks[t] = [ctx]
+
+        def activate_once():
+            with tracer.activate(tracer.new_trace_context()):
+                pass
+
+        t = threading.Thread(target=activate_once)
+        t.start()
+        t.join()
+        assert not any(d in tracer._ctx_stacks for d in dead)
+        assert len(tracer._ctx_stacks) == 0
+
+    def test_recycled_ident_cannot_inherit_context(self):
+        """Thread-OBJECT keys close the ident-recycling hazard: a new
+        thread must never see a dead thread's leftover context, even
+        when the OS hands it the same ident (simulated directly —
+        real ident reuse is nondeterministic)."""
+        orphan = threading.Thread(target=lambda: None)
+        orphan.start()
+        orphan.join()
+        with tracer._ctx_lock:
+            tracer._ctx_stacks[orphan] = [tracer.new_trace_context()]
+        try:
+            seen = []
+
+            def probe():
+                seen.append(tracer.current_context())
+
+            t = threading.Thread(target=probe)
+            t.start()
+            t.join()
+            assert seen == [None]
+        finally:
+            with tracer._ctx_lock:
+                tracer._ctx_stacks.pop(orphan, None)
+
+
+class TestOverheadTripwire:
+    """With tracing OFF (the production serving hot loop default),
+    inject()/extract()/span()/event() must stay allocation-light:
+    shared no-op objects and None returns, nothing minted per call."""
+
+    def test_disabled_fast_paths_return_shared_objects(self):
+        obs.disable_tracer()
+        assert obs.span("serve.hop") is obs.span("serve.decode")
+        assert obs.event("serve.submit") is None
+        assert tracer.inject() is None
+        assert tracer.extract(None) is None
+
+    def test_disabled_hot_loop_is_allocation_light(self):
+        import tracemalloc
+
+        obs.disable_tracer()
+        # Warm every lazy path first.
+        for _ in range(100):
+            tracer.inject()
+            with obs.span("serve.decode"):
+                pass
+            obs.event("serve.tick")
+        gc.collect()
+        tracemalloc.start()
+        for _ in range(5000):
+            tracer.inject()
+            with obs.span("serve.decode"):
+                pass
+            obs.event("serve.tick")
+        _, peak = tracemalloc.get_traced_memory()
+        tracemalloc.stop()
+        # 5000 iterations of the full disabled surface must not
+        # allocate per-call state: peak transient footprint stays
+        # far under one object per iteration (64 KiB is ~13 bytes
+        # per iteration of slack, vs >100 B per minted context).
+        assert peak < 65536, f"disabled tracing allocated {peak}B"
+
+
+class TestTraceStore:
+    def test_bounded_retention_evicts_oldest(self):
+        store = TraceStore(max_traces=4)
+        for i in range(10):
+            store.add_span(f"t{i}", "serve.request", float(i), 1.0)
+        assert len(store) == 4
+        assert store.get("t5") is None
+        assert store.get("t9") is not None
+
+    def test_span_cap_counts_drops(self):
+        store = TraceStore(max_spans_per_trace=3)
+        for i in range(5):
+            ok = store.add_span("t", "serve.hop", float(i), 0.1)
+            assert ok == (i < 3)
+        tl = store.get("t")
+        assert len(tl["spans"]) == 3 and tl["dropped_spans"] == 2
+
+    def test_subject_index_and_query(self):
+        store = TraceStore()
+        store.add_span(
+            "ta", "serve.request", 1.0, 2.0, request_id="req-9"
+        )
+        store.add_span(
+            "tb", "remediation.decision", 3.0, node_id=4000001
+        )
+        assert [t["trace_id"] for t in store.query(subject="req-9")] \
+            == ["ta"]
+        assert [
+            t["trace_id"]
+            for t in store.query(subject="node:4000001")
+        ] == ["tb"]
+        assert store.query(trace_id="tb")[0]["subjects"] == [
+            "node:4000001"
+        ]
+        assert store.query(subject="nope") == []
+        assert len(store.query(limit=1)) == 1
+
+    def test_add_event_tracer_shape(self):
+        """The snapshot channel's payload: tracer event dicts with
+        trace ids become spans; untagged events are ignored; process
+        tags (pid/role/rank) are stripped from span tags."""
+        store = TraceStore()
+        assert not store.add_event({"name": "trainer.step", "ts": 1})
+        n = store.add_events(
+            [
+                {
+                    "name": "ckpt.save", "ts": 5.0, "dur_s": 0.5,
+                    "trace_id": "tc", "span_id": "s1",
+                    "pid": 1, "role": "worker", "rank": 0,
+                    "step": 12,
+                },
+                {"name": "no.trace", "ts": 6.0},
+            ]
+        )
+        assert n == 1
+        span = store.get("tc")["spans"][0]
+        assert span["tags"] == {"step": 12}
+        assert span["dur_s"] == 0.5
+
+    def test_span_tree_depths_and_orphans(self):
+        store = TraceStore()
+        store.add_span("t", "serve.request", 0.0, 5.0, span_id="A")
+        store.add_span(
+            "t", "serve.hop", 1.0, 2.0, span_id="B",
+            parent_span_id="A",
+        )
+        store.add_span(
+            "t", "serve.prefill", 1.2, 0.5, parent_span_id="B"
+        )
+        store.add_span(
+            "t", "serve.orphan", 0.5, 0.1, parent_span_id="GONE"
+        )
+        tree = span_tree(store.get("t"))
+        depth = {s["name"]: s["depth"] for s in tree}
+        assert depth == {
+            "serve.request": 0, "serve.hop": 1,
+            "serve.prefill": 2, "serve.orphan": 0,
+        }
+
+
+class TestRpcPropagation:
+    def test_context_rides_the_envelope(self, seeded_ids):
+        """An active client-side context is re-activated on the
+        server's handler thread — the cross-process half of every
+        propagation path (MasterClient -> servicer, replica ->
+        router)."""
+        from dlrover_tpu.common import messages as msg
+        from dlrover_tpu.common.comm import (
+            RpcClient,
+            RpcDispatcher,
+            RpcServer,
+        )
+
+        seen = []
+        dispatcher = RpcDispatcher()
+
+        def handler(req):
+            ctx = tracer.current_context()
+            seen.append(
+                (ctx.trace_id, ctx.span_id) if ctx else None
+            )
+            return msg.KVStoreGetResponse(found=True, value=b"v")
+
+        dispatcher.register_get(msg.KVStoreGetRequest, handler)
+        server = RpcServer(dispatcher, port=0)
+        server.start()
+        client = RpcClient(server.addr)
+        try:
+            client.get(msg.KVStoreGetRequest(key="k"))
+            assert seen[-1] is None  # no active trace -> no context
+            ctx = tracer.new_trace_context()
+            with tracer.activate(ctx):
+                client.get(msg.KVStoreGetRequest(key="k"))
+            assert seen[-1] == (ctx.trace_id, ctx.span_id)
+        finally:
+            client.close()
+            server.stop(0)
+
+    def test_old_decoder_drops_the_envelope_field(self):
+        """Forward compatibility: a decoder without trace support
+        (messages.deserialize) reads a trace-carrying payload as the
+        plain message."""
+        from dlrover_tpu.common import messages as msg
+
+        data = msg.serialize(
+            msg.KVStoreGetRequest(key="k"),
+            trace={"trace_id": "t" * 32, "span_id": "s" * 16},
+        )
+        decoded = msg.deserialize(data)
+        assert isinstance(decoded, msg.KVStoreGetRequest)
+        assert decoded.key == "k"
+        again, trace = msg.deserialize_with_trace(data)
+        assert again.key == "k"
+        assert trace["trace_id"] == "t" * 32
+
+
+class TestRouterTraceAssembly:
+    """The router's server-side assembly with a fake clock: hops,
+    queue intervals, phase spans, and the TTFT phase decomposition
+    that feeds dlrover_serve_ttft_phase_seconds."""
+
+    def _router(self, store):
+        from dlrover_tpu.serving.router import ServingRouter
+
+        clk = [100.0]
+        router = ServingRouter(
+            clock=lambda: clk[0],
+            config={"progress_timeout_s": 5.0},
+            trace_sink=store,
+        )
+        return router, clk
+
+    def test_requeued_request_timeline(self, seeded_ids):
+        store = TraceStore()
+        router, clk = self._router(store)
+        router.register_replica(4000000)
+        router.register_replica(4000001)
+        rid = router.submit([1, 2, 3], max_new_tokens=4)
+        trace_id = router.trace_of(rid)
+        assert trace_id
+        clk[0] += 1.0  # 1s queued
+        assert router.pull(4000000, max_items=1)
+        clk[0] += 2.0  # 2s on the doomed replica
+        assert router.drain_replica(4000000, reason="test") == 1
+        clk[0] += 0.5  # 0.5s requeue wait
+        assert router.pull(4000001, max_items=1)
+        clk[0] += 3.0
+        router.complete(
+            4000001, rid, [7, 8, 9, 10],
+            ttft_s=0.35, tpot_s=0.01, finish_reason="length",
+            phases={
+                "dispatch": 0.05, "prefill": 0.3,
+                "first_decode": 0.05, "decode": 1.0,
+            },
+        )
+        res = router.result(rid)
+        assert res["state"] == "done" and res["requeues"] == 1
+        # queue = 1.0 (initial) + 0.5 (requeue wait)
+        ph = res["phases"]
+        assert ph["queue"] == pytest.approx(1.5)
+        assert ph["ttft_total"] == pytest.approx(
+            1.5 + 0.05 + 0.3 + 0.05
+        )
+        tl = store.get(trace_id)
+        names = [s["name"] for s in tl["spans"]]
+        assert names.count("serve.hop") == 2
+        assert names.count("serve.queue") == 2
+        hops = [s for s in tl["spans"] if s["name"] == "serve.hop"]
+        assert {h["tags"]["replica_id"] for h in hops} == {
+            4000000, 4000001,
+        }
+        assert {h["tags"]["end"] for h in hops} == {
+            "requeue", "done",
+        }
+        # Phase spans are sequential and non-overlapping.
+        phases = sorted(
+            (
+                s for s in tl["spans"]
+                if s["name"] in (
+                    "serve.dispatch", "serve.prefill",
+                    "serve.first_token", "serve.decode",
+                )
+            ),
+            key=lambda s: s["start_ts"],
+        )
+        assert [s["name"] for s in phases] == [
+            "serve.dispatch", "serve.prefill",
+            "serve.first_token", "serve.decode",
+        ]
+        for prev, cur in zip(phases, phases[1:]):
+            assert cur["start_ts"] == pytest.approx(
+                prev["start_ts"] + prev["dur_s"]
+            )
+        # Root covers submit -> done; request id is a query subject.
+        root = next(
+            s for s in tl["spans"] if s["name"] == "serve.request"
+        )
+        assert root["dur_s"] == pytest.approx(6.5)
+        assert store.query(subject=rid)[0]["trace_id"] == trace_id
+        # Worst-trace surface for obs_report --serving.
+        worst = router.snapshot()["worst_ttft"]
+        assert worst["request_id"] == rid
+        assert worst["ttft_total_s"] == pytest.approx(1.9)
+
+    def test_drain_link_records_requeues_in_decision_trace(
+        self, seeded_ids
+    ):
+        store = TraceStore()
+        router, clk = self._router(store)
+        router.register_replica(4000000)
+        rid = router.submit([1, 2], max_new_tokens=2)
+        assert router.pull(4000000, max_items=1)
+        link = (tracer.new_trace_id(), tracer.new_span_id())
+        router.drain_replica(4000000, reason="verdict", link=link)
+        tl = store.get(link[0])
+        assert tl is not None
+        requeue = next(
+            s for s in tl["spans"] if s["name"] == "serve.requeue"
+        )
+        assert requeue["tags"]["request_id"] == rid
+        assert requeue["tags"]["link_trace_id"] == router.trace_of(
+            rid
+        )
+        assert requeue["parent_span_id"] == link[1]
+
+    def test_adopted_caller_context_wins(self, seeded_ids):
+        """A submit arriving inside an RPC-propagated context joins
+        the CALLER's trace instead of minting a new one."""
+        store = TraceStore()
+        router, _ = self._router(store)
+        caller = tracer.new_trace_context()
+        with tracer.activate(caller):
+            rid = router.submit([1], max_new_tokens=1)
+        assert router.trace_of(rid) == caller.trace_id
+
+
+class TestRendezvousRoundTrace:
+    def test_every_round_traced_even_with_leftover_waiters(
+        self, seeded_ids
+    ):
+        """A freeze that leaves surplus waiters seeds the next round
+        with a NON-empty waiting set; that churn round must still
+        mint its own trace (the original mint condition — empty
+        waiting set — silently skipped it)."""
+        from dlrover_tpu.master.rendezvous import ElasticRendezvous
+
+        store = TraceStore()
+        rdzv = ElasticRendezvous()
+        rdzv.trace_sink = store
+        rdzv.update_params(
+            min_nodes=2, max_nodes=2, waiting_timeout=30.0
+        )
+        for rank in (0, 1, 2):  # one surplus waiter
+            rdzv.join(rank, 1)
+        _, _, world = rdzv.get_comm_world(0)
+        assert sorted(world) == [0, 1]
+        # Round 1 starts with rank 2 ALREADY waiting when member 0
+        # rejoins (restart invalidates the frozen world) — the churn
+        # case whose trace the empty-set mint condition missed.
+        rdzv.join(0, 1)
+        _, _, world2 = rdzv.get_comm_world(0)
+        assert sorted(world2) == [0, 2]
+        rounds = store.query(subject="rdzv:elastic-training")
+        assert len(rounds) == 2
+        assert len({t["trace_id"] for t in rounds}) == 2
+        got = [
+            t["spans"][0]["tags"]["round"] for t in rounds
+        ]
+        assert got == [0, 1]
+
+
+class TestSchedulerPhases:
+    """Replica-side TTFT decomposition on the real jitted scheduler:
+    the reported phases must reconstruct ttft_s exactly (dispatch +
+    prefill + first_decode spans admit -> first token)."""
+
+    @pytest.fixture(scope="class")
+    def model(self):
+        from dlrover_tpu.serving.replica import build_tiny_model
+
+        return build_tiny_model(0, block_size=64)
+
+    def test_phases_sum_to_ttft(self, model):
+        from dlrover_tpu.serving.scheduler import (
+            ContinuousBatchingScheduler,
+            ServeRequest,
+        )
+
+        params, cfg = model
+        sched = ContinuousBatchingScheduler(
+            params, cfg, lanes=2, max_len=32, block_size=8,
+            prefill_chunk=8,
+        )
+        sched.submit(
+            ServeRequest(
+                request_id="r1", prompt=[3, 1, 4, 1, 5],
+                max_new_tokens=4,
+                trace={"trace_id": "t" * 32, "span_id": "s" * 16},
+            )
+        )
+        done = []
+        for _ in range(64):
+            done.extend(sched.step())
+            if done:
+                break
+        assert [c.request_id for c in done] == ["r1"]
+        c = done[0]
+        assert set(c.phases) == {
+            "dispatch", "prefill", "first_decode", "decode",
+        }
+        for v in c.phases.values():
+            assert v >= 0.0
+        assert c.phases["prefill"] + c.phases["first_decode"] == \
+            pytest.approx(c.ttft_s, abs=2e-6)
+        assert c.phases["decode"] <= c.wall_s
+
+    def test_wire_roundtrip_keeps_trace(self):
+        from dlrover_tpu.serving.scheduler import ServeRequest
+
+        req = ServeRequest(
+            request_id="r", prompt=[1],
+            trace={"trace_id": "t" * 32, "span_id": "s" * 16},
+        )
+        back = ServeRequest.from_dict(req.to_dict())
+        assert back.trace == req.trace

@@ -144,6 +144,22 @@ def record_value(rec: dict) -> Optional[float]:
     return None
 
 
+def lower_is_better(metric: Optional[str]) -> bool:
+    """Latency metrics gate in the opposite direction from
+    throughput: a HIGHER ttft/tpot/latency-seconds value is the
+    regression. Keyed on the metric naming convention (duration
+    metrics end in _s/_seconds/_ms or name the latency quantity)."""
+    if not metric:
+        return False
+    m = metric.lower()
+    return (
+        m.endswith(("_s", "_seconds", "_ms"))
+        or "latency" in m
+        or "ttft" in m
+        or "tpot" in m
+    )
+
+
 def _matches(rec: dict, selector: str) -> bool:
     if selector in ("", "last"):
         return True
@@ -186,8 +202,9 @@ def compare(
     path: Optional[str] = None,
 ) -> Tuple[int, str]:
     """(exit code, human report). Regression = head more than
-    ``threshold`` (fractional) below baseline on the higher-is-better
-    metric value."""
+    ``threshold`` (fractional) WORSE than baseline: below it for
+    higher-is-better metrics (throughput), above it for
+    lower-is-better ones (latency — see :func:`lower_is_better`)."""
     records = load_records(path)
     if not records:
         return 2, f"no ledger records at {ledger_path(path)}"
@@ -207,7 +224,8 @@ def compare(
     head_v = record_value(head_rec)
     base_v = record_value(base_rec)
     delta = (head_v - base_v) / base_v
-    regressed = delta < -threshold
+    inverted = lower_is_better(metric)
+    regressed = delta > threshold if inverted else delta < -threshold
 
     def _describe(tag, rec, v):
         meta = rec.get("meta", {}) or {}
@@ -217,8 +235,9 @@ def compare(
             if stats
             else ""
         )
+        v_str = f"{v:.1f}" if v >= 10 else f"{v:g}"
         return (
-            f"  {tag}: {v:.1f} {rec.get('unit', '')}{extra}\n"
+            f"  {tag}: {v_str} {rec.get('unit', '')}{extra}\n"
             f"    rev={str(rec.get('git_rev', ''))[:12]} "
             f"stage={rec.get('stage')} config={rec.get('config_hash')} "
             f"backend={meta.get('backend')} host={meta.get('host')} "
@@ -226,7 +245,9 @@ def compare(
         )
 
     lines = [
-        f"bench ledger compare [{metric}], threshold {threshold:.1%}:",
+        f"bench ledger compare [{metric}], threshold {threshold:.1%}"
+        + (" (lower is better)" if inverted else "")
+        + ":",
         _describe("head    ", head_rec, head_v),
         _describe("baseline", base_rec, base_v),
         f"  delta: {delta:+.2%} -> "

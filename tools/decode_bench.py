@@ -33,17 +33,25 @@ sys.path.insert(0, REPO)
 def timed(fn, *args, repeats=3, **kw):
     """Best-of wall clock with block_until_ready, after one warmup
     (compile) call."""
+    best, _, out = timed_samples(fn, *args, repeats=repeats, **kw)
+    return best, out
+
+
+def timed_samples(fn, *args, repeats=3, **kw):
+    """``(best, samples, out)`` — every repeat's wall clock, for the
+    latency percentiles (p50/p99 need the distribution, not just the
+    floor)."""
     import jax
 
     out = fn(*args, **kw)
     jax.block_until_ready(out)
-    best = float("inf")
+    samples = []
     for _ in range(repeats):
         t0 = time.perf_counter()
         out = fn(*args, **kw)
         jax.block_until_ready(out)
-        best = min(best, time.perf_counter() - t0)
-    return best, out
+        samples.append(time.perf_counter() - t0)
+    return min(samples), samples, out
 
 
 def main() -> int:
@@ -116,18 +124,22 @@ def main() -> int:
     # prefill and fixed overheads cancel exactly (subtracting a
     # separately-jitted prefill underflows when the two programs
     # optimize differently).
-    def gen_at(n_new):
+    def gen_at(n_new, samples=False):
         fn = jax.jit(
             lambda p, pr, k: generate.generate(
                 p, cfg, pr, max_new_tokens=n_new, temperature=0.0,
                 key=k,
             )
         )
-        d, _ = timed(fn, params, prompt, jax.random.PRNGKey(2))
-        return d
+        d, walls, _ = timed_samples(
+            fn, params, prompt, jax.random.PRNGKey(2), repeats=5
+        )
+        return (d, walls) if samples else d
 
     half = max(new // 2, 1)
-    dt_full, dt_half = gen_at(new), gen_at(new - half)
+    (dt_full, full_walls), dt_half = (
+        gen_at(new, samples=True), gen_at(new - half)
+    )
     decode_s = max(dt_full - dt_half, 1e-9)
     rec["gpt2_generate_ms"] = round(dt_full * 1e3, 2)
     rec["gpt2_decode_tok_s"] = round(b * half / decode_s, 1)
@@ -135,6 +147,27 @@ def main() -> int:
     print(f"[decode] gpt2-shape decode: {rec['gpt2_decode_tok_s']} "
           f"tok/s ({rec['gpt2_decode_ms_per_tok']} ms/tok, "
           f"batch {b})", flush=True)
+    ckpt()
+
+    # Latency distributions (the serving SLO pair): TTFT = a 1-token
+    # generate (prefill + first token), sampled per repeat; TPOT =
+    # per-repeat (full_wall - best_half_wall) / half. p50/p99 use the
+    # one shared nearest-rank formula so the bench's gates measure
+    # the same quantity as the router's exported gauges.
+    from dlrover_tpu.obs.timeseries import _percentile
+
+    _, ttft_walls = gen_at(1, samples=True)
+    tpot_samples = sorted(
+        max(w - dt_half, 1e-9) / half for w in full_walls
+    )
+    ttft_samples = sorted(ttft_walls)
+    rec["ttft_p50_s"] = round(_percentile(ttft_samples, 50.0), 4)
+    rec["ttft_p99_s"] = round(_percentile(ttft_samples, 99.0), 4)
+    rec["tpot_p50_s"] = round(_percentile(tpot_samples, 50.0), 5)
+    rec["tpot_p99_s"] = round(_percentile(tpot_samples, 99.0), 5)
+    print(f"[decode] gpt2-shape latency: ttft p50/p99 "
+          f"{rec['ttft_p50_s']}/{rec['ttft_p99_s']}s, tpot p50/p99 "
+          f"{rec['tpot_p50_s']}/{rec['tpot_p99_s']}s", flush=True)
     ckpt()
 
     # --- windowed Mistral-tiny: chunked vs monolithic prefill --------
@@ -233,6 +266,21 @@ def main() -> int:
                     "window": mcfg.sliding_window,
                     "chunked_over_mono": rec["chunked_over_mono"],
                 },
+            ),
+            # Latency gates: `bench_ledger compare --metric
+            # decode_ttft_p99_s` (or decode_tpot_p99_s) trips on a
+            # latency regression, not just a throughput one.
+            (
+                "decode_ttft_p99_s",
+                rec["ttft_p99_s"],
+                "s",
+                {"p50": rec["ttft_p50_s"], "batch": b},
+            ),
+            (
+                "decode_tpot_p99_s",
+                rec["tpot_p99_s"],
+                "s",
+                {"p50": rec["tpot_p50_s"], "batch": b},
             ),
         ):
             stored = append_record(

@@ -423,6 +423,214 @@ def serving_report(target: str) -> int:
     return 1 if payload.get("unhealthy") else 0
 
 
+def trace_report(key: str, target: str) -> int:
+    """Render causal trace timelines for ``key`` — a trace id, a
+    serving request id, or a node subject (``node:<id>`` or a bare
+    node id) — from a live master (host:port, ``TraceQueryRequest``
+    RPC) or a JSON file of trace-store timelines. The span tree is
+    indented by causality with per-span durations; requeue hops and
+    remediation rungs are summarized per trace."""
+    import json
+    import os
+
+    from dlrover_tpu.obs.trace_store import render_trace
+
+    def _matches(tl: dict) -> bool:
+        subjects = set(tl.get("subjects", ()))
+        return (
+            tl.get("trace_id") == key
+            or key in subjects
+            or f"node:{key}" in subjects
+        )
+
+    if os.path.isfile(target):
+        with open(target) as f:
+            doc = json.load(f)
+        timelines = doc.get("traces", doc) if isinstance(
+            doc, dict
+        ) else doc
+        timelines = [tl for tl in timelines if _matches(tl)]
+    elif (
+        target.endswith(".json")
+        or os.sep in target
+        or ":" not in target
+    ):
+        print(f"trace snapshot not found: {target}", file=sys.stderr)
+        return 2
+    else:
+        from dlrover_tpu.agent.master_client import MasterClient
+
+        client = MasterClient(target, node_id=-1)
+        try:
+            resp = client.query_traces(trace_id=key, max_wait=15.0)
+            if resp.enabled and not resp.traces:
+                # Not a trace id: try it as a subject (request id /
+                # node) — bare node ids get the node: prefix form too.
+                resp = client.query_traces(subject=key, max_wait=15.0)
+                if not resp.traces and key.isdigit():
+                    resp = client.query_traces(
+                        subject=f"node:{key}", max_wait=15.0
+                    )
+        except Exception as exc:  # noqa: BLE001
+            print(
+                f"trace query to {target} failed: {exc}",
+                file=sys.stderr,
+            )
+            return 2
+        finally:
+            client.close()
+        if not resp.enabled:
+            print("trace store disabled on this master")
+            return 0
+        timelines = list(resp.traces)
+    if not timelines:
+        print(f"no trace found for {key!r}")
+        return 1
+    for tl in timelines:
+        print(render_trace(tl))
+        names = [s.get("name", "") for s in tl.get("spans", ())]
+        hops = sum(1 for n in names if n == "serve.hop")
+        requeues = sum(1 for n in names if n == "serve.requeue")
+        rungs = sorted(
+            {
+                n.split(".", 1)[1]
+                for n in names
+                if n.startswith("remediation.")
+                and n not in (
+                    "remediation.decision", "remediation.verdict",
+                    "remediation.governors",
+                )
+            }
+        )
+        summary = []
+        if hops:
+            summary.append(
+                f"{hops} replica hop(s), {requeues} requeue(s)"
+            )
+        if rungs:
+            summary.append(f"remediation: {' -> '.join(rungs)}")
+        if summary:
+            print("  -- " + "; ".join(summary))
+    return 0
+
+
+def _selftest_trace() -> list:
+    """Trace assembly hermetically: a synthetic serving-request
+    timeline (two hops, phase spans) plus a remediation decision
+    trace must render as an indented causal tree through the same
+    path ``--trace`` uses."""
+    import json as _json
+    import tempfile
+
+    from dlrover_tpu.obs.trace_store import (
+        TraceStore,
+        render_trace,
+        span_tree,
+    )
+
+    errors = []
+    store = TraceStore()
+    t = 2000.0
+    tid, root = "t" * 32, "r" * 16
+    store.add_span(
+        tid, "serve.request", t, 3.0, span_id=root,
+        request_id="req-1", requeues=1, outcome="done",
+    )
+    store.add_span(
+        tid, "serve.queue", t, 0.1, parent_span_id=root,
+        request_id="req-1", hop=0,
+    )
+    store.add_span(
+        tid, "serve.hop", t + 0.1, 1.0, span_id="h" * 16,
+        parent_span_id=root, request_id="req-1",
+        replica_id=4000000, end="requeue",
+    )
+    store.add_span(
+        tid, "serve.hop", t + 1.3, 1.7, span_id="g" * 16,
+        parent_span_id=root, request_id="req-1",
+        replica_id=4000001, end="done",
+    )
+    for i, (name, dur) in enumerate(
+        (
+            ("serve.dispatch", 0.1),
+            ("serve.prefill", 0.5),
+            ("serve.first_token", 0.05),
+            ("serve.decode", 1.0),
+        )
+    ):
+        store.add_span(
+            tid, name, t + 1.35 + 0.4 * i, dur,
+            parent_span_id="g" * 16, request_id="req-1",
+        )
+    dec_tid = "d" * 32
+    store.add_span(
+        dec_tid, "remediation.decision", t + 1.0,
+        span_id="q" * 16, node_id=4000000, decision_id=1,
+    )
+    store.add_span(
+        dec_tid, "remediation.verdict", t + 1.0,
+        parent_span_id="q" * 16, node_id=4000000,
+        detector="replica_unhealthy",
+    )
+    store.add_span(
+        dec_tid, "remediation.drain_replica", t + 1.1,
+        parent_span_id="q" * 16, node_id=4000000,
+    )
+    store.add_span(
+        dec_tid, "serve.requeue", t + 1.1,
+        parent_span_id="q" * 16, request_id="req-1",
+        link_trace_id=tid,
+    )
+    tl = store.get(tid)
+    if tl is None:
+        return ["trace store lost the request trace"]
+    tree = span_tree(tl)
+    if tree[0]["name"] != "serve.request" or tree[0]["depth"] != 0:
+        errors.append(f"tree root wrong: {tree[0]}")
+    depths = {s["name"]: s["depth"] for s in tree}
+    if depths.get("serve.hop") != 1 or depths.get(
+        "serve.prefill"
+    ) != 2:
+        errors.append(f"tree depths wrong: {depths}")
+    rendered = render_trace(tl)
+    for needle in ("serve.request", "serve.hop", "req-1"):
+        if needle not in rendered:
+            errors.append(
+                f"trace render missing {needle!r}: {rendered!r}"
+            )
+    # query surfaces: by subject (request id and node form)
+    if not store.query(subject="req-1"):
+        errors.append("subject query by request id found nothing")
+    if [
+        x["trace_id"] for x in store.query(subject="node:4000000")
+    ] != [tid, dec_tid]:
+        errors.append("subject query by node wrong")
+    # file path end to end (the --trace target contract)
+    with tempfile.NamedTemporaryFile(
+        "w", suffix=".json", delete=False
+    ) as f:
+        _json.dump({"traces": store.query()}, f)
+        path = f.name
+    try:
+        if trace_report(tid, path) != 0:
+            errors.append("trace_report rc != 0 on the request trace")
+        if trace_report("node:4000000", path) != 0:
+            errors.append("trace_report rc != 0 on the node subject")
+        if trace_report("missing", path) != 1:
+            errors.append("trace_report rc != 1 on an unknown key")
+    finally:
+        import os as _os
+
+        _os.unlink(path)
+    # bounded retention: the store must evict oldest-first
+    small = TraceStore(max_traces=3)
+    for i in range(10):
+        small.add_span(f"trace-{i}", "serve.request", float(i), 1.0)
+    if len(small) != 3 or small.get("trace-0") is not None:
+        errors.append("trace retention not bounded")
+    return errors
+
+
 def _selftest_serving() -> list:
     """Serving plane hermetically: a fake-clock router over two
     replicas — one serving, one stalling mid-flight — must requeue
@@ -474,6 +682,10 @@ def _selftest_serving() -> list:
         router.complete(
             100, req.request_id, [1, 1, 1, 1],
             ttft_s=0.3, tpot_s=0.02, finish_reason="length",
+            phases={
+                "dispatch": 0.05, "prefill": 0.25,
+                "first_decode": 0.05, "decode": 0.4,
+            },
         )
     counters = router.counters()
     if counters["done"] != 4 or counters["requeued_total"] != 2:
@@ -503,6 +715,12 @@ def _selftest_serving() -> list:
         "[UNHEALTHY]",
         "kv 50%",
         "UNHEALTHY replicas: [101]",
+        # The worst-trace TTFT breakdown: queue covers the 7s the
+        # requeued requests waited across the stall + drain.
+        "worst TTFT",
+        "dispatch 0.050s",
+        "prefill 0.250s",
+        "1 requeue(s)",
     ):
         if needle not in rendered:
             errors.append(
@@ -810,6 +1028,7 @@ def selftest() -> int:
     errors.extend(_selftest_health())
     errors.extend(_selftest_remediation())
     errors.extend(_selftest_serving())
+    errors.extend(_selftest_trace())
     if errors:
         print("obs selftest FAILED:")
         for e in errors:
@@ -1082,6 +1301,15 @@ def main(argv=None) -> int:
         " JSON file; exits 1 when a replica is unhealthy",
     )
     p.add_argument(
+        "--trace", type=str, default="",
+        metavar="KEY",
+        help="render the causal trace timeline(s) for KEY — a trace "
+        "id, a serving request id, or a node (node:<id> or bare id) "
+        "— from the target given as the positional argument: a live "
+        "master (host:port, TraceQueryRequest RPC) or a JSON file "
+        "of trace-store timelines",
+    )
+    p.add_argument(
         "--postmortem", type=str, default="",
         metavar="DIR",
         help="render a forensics dir (flight-recorder bundles + "
@@ -1105,6 +1333,13 @@ def main(argv=None) -> int:
         return health_report(args.health)
     if args.serving:
         return serving_report(args.serving)
+    if args.trace:
+        if not args.event_file:
+            p.error(
+                "--trace needs a target: obs_report --trace KEY "
+                "HOST:PORT|traces.json"
+            )
+        return trace_report(args.trace, args.event_file)
     if args.postmortem:
         from dlrover_tpu.obs.postmortem import render_postmortem
 

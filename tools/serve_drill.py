@@ -310,7 +310,169 @@ def run_serving_drill(
                 f"drain decisions {drains} never targeted the "
                 f"killed replica {killed_id}"
             )
+
+        # -- distributed-trace acceptance (ISSUE 13): the surviving
+        # trace of a requeued request, assembled SERVER-side and
+        # fetched via query_traces, shows >=2 replica hops with
+        # monotonic non-overlapping phase spans; TTFT phase
+        # histograms sum to the observed TTFT; and the remediation
+        # decision trace links verdict -> drain -> requeue.
+        probe = requeued[0]
+        trace_id = st[probe].trace_id
+        if not trace_id:
+            raise DrillError(f"request {probe} carries no trace_id")
+        tq = client.query_traces(trace_id=trace_id)
+        if not tq.enabled or not tq.traces:
+            raise DrillError(
+                f"query_traces({trace_id}) returned nothing "
+                f"(enabled={tq.enabled})"
+            )
+        spans = tq.traces[0]["spans"]
+        hops = [s for s in spans if s["name"] == "serve.hop"]
+        hop_replicas = {
+            s["tags"].get("replica_id") for s in hops
+        }
+        if len(hops) < 2 or len(hop_replicas) < 2:
+            raise DrillError(
+                f"requeued request {probe}: expected >=2 hops on "
+                f">=2 replicas, got {len(hops)} hop(s) on "
+                f"{sorted(hop_replicas)}"
+            )
+
+        def check_monotonic(what, group):
+            ordered = sorted(group, key=lambda s: s["start_ts"])
+            prev_end, prev_name = None, ""
+            for s in ordered:
+                if (
+                    prev_end is not None
+                    and s["start_ts"] < prev_end - 1e-6
+                ):
+                    raise DrillError(
+                        f"{what} spans overlap: {s['name']} starts "
+                        f"{prev_end - s['start_ts']:.6f}s before "
+                        f"{prev_name} ends"
+                    )
+                prev_end = s["start_ts"] + s["dur_s"]
+                prev_name = s["name"]
+
+        check_monotonic(f"{probe} hop", hops)
+        phase_span_names = (
+            "serve.dispatch", "serve.prefill", "serve.first_token",
+            "serve.decode",
+        )
+        phase_spans = [
+            s for s in spans if s["name"] in phase_span_names
+        ]
+        if len(phase_spans) != 4:
+            raise DrillError(
+                f"expected the 4 TTFT phase spans on {probe}'s "
+                f"completing hop, got "
+                f"{sorted(s['name'] for s in phase_spans)}"
+            )
+        check_monotonic(f"{probe} phase", phase_spans)
+        ph = st[probe].phases
+        ttft_total = ph.get("ttft_total", 0.0)
+        phase_sum = sum(
+            ph.get(k, 0.0)
+            for k in ("queue", "dispatch", "prefill", "first_decode")
+        )
+        if abs(phase_sum - ttft_total) > 1e-3:
+            raise DrillError(
+                f"TTFT phases {ph} sum to {phase_sum:.6f}s != "
+                f"observed total {ttft_total:.6f}s"
+            )
+        # The replica-reported TTFT must agree with its own phase
+        # split (prefill + first_decode span admit -> first token).
+        replica_sum = ph.get("prefill", 0.0) + ph.get(
+            "first_decode", 0.0
+        )
+        if abs(replica_sum - st[probe].ttft_s) > 0.05:
+            raise DrillError(
+                f"replica phases sum {replica_sum:.4f}s diverges "
+                f"from reported ttft_s {st[probe].ttft_s:.4f}s"
+            )
+        # Histogram cross-check: per-phase sums across ALL completed
+        # requests equal the observed totals within tolerance.
+        hist = obs.get_registry().get(
+            "dlrover_serve_ttft_phase_seconds"
+        )
+        hist_total = sum(
+            hist.sum(phase=p)
+            for p in ("queue", "dispatch", "prefill", "first_decode")
+        )
+        observed_total = sum(
+            r.phases.get("ttft_total", 0.0) for r in st.values()
+        )
+        if abs(hist_total - observed_total) > 1e-3 * max(
+            len(st), 1
+        ) + 1e-6:
+            raise DrillError(
+                f"TTFT phase histogram sums {hist_total:.6f}s != "
+                f"observed TTFT total {observed_total:.6f}s"
+            )
+
+        # The remediation decision trace for the killed replica:
+        # verdict -> drain -> requeue linked by ONE trace id.
+        rq = client.query_remediation()
+        drain_decisions = [
+            dec for dec in rq.decisions
+            if dec.action == "drain_replica"
+            and dec.node_id == killed_id
+        ]
+        if not drain_decisions or not drain_decisions[0].trace_id:
+            raise DrillError(
+                "no traced drain_replica decision for the killed "
+                f"replica in {len(rq.decisions)} decision(s)"
+            )
+        dec_trace = client.query_traces(
+            trace_id=drain_decisions[0].trace_id
+        ).traces
+        if not dec_trace:
+            raise DrillError(
+                "decision trace "
+                f"{drain_decisions[0].trace_id} not in the store"
+            )
+        dec_names = {s["name"] for s in dec_trace[0]["spans"]}
+        for needle in (
+            "remediation.verdict", "remediation.drain_replica",
+            "serve.requeue",
+        ):
+            if needle not in dec_names:
+                raise DrillError(
+                    f"decision trace missing {needle!r}: has "
+                    f"{sorted(dec_names)}"
+                )
+        requeue_rids = {
+            s["tags"].get("request_id")
+            for s in dec_trace[0]["spans"]
+            if s["name"] == "serve.requeue"
+        }
+        if not requeue_rids & set(requeued):
+            raise DrillError(
+                f"decision-trace requeues {sorted(requeue_rids)} "
+                f"name none of the requeued requests {requeued}"
+            )
+        # And the killed node is a queryable SUBJECT of it.
+        by_subject = client.query_traces(
+            subject=f"node:{killed_id}"
+        ).traces
+        if drain_decisions[0].trace_id not in {
+            t["trace_id"] for t in by_subject
+        }:
+            raise DrillError(
+                f"subject query node:{killed_id} does not surface "
+                "the drain decision trace"
+            )
+
         counters = master.serving.counters()
+        # Latency SLO surface for the bench ledger: end-to-end TTFT
+        # (router-observed, requeue waits included) and TPOT over
+        # every completed request.
+        ttfts = sorted(
+            r.phases.get("ttft_total", r.ttft_s)
+            for r in st.values()
+        )
+        tpots = sorted(r.tpot_s for r in st.values())
         report = {
             "seed": seed,
             "requests": requests,
@@ -321,6 +483,10 @@ def run_serving_drill(
             "killed_replica": killed_id,
             "p99_s": round(p99, 3),
             "p50_s": round(_percentile(latencies, 50.0), 3),
+            "ttft_p50_s": round(_percentile(ttfts, 50.0), 4),
+            "ttft_p99_s": round(_percentile(ttfts, 99.0), 4),
+            "tpot_p50_s": round(_percentile(tpots, 50.0), 5),
+            "tpot_p99_s": round(_percentile(tpots, 99.0), 5),
             "verdicts": len(verdicts),
             "drains": len(drains),
             "outputs_verified": min(len(requeued), verify_outputs),
@@ -388,6 +554,45 @@ def main(argv=None) -> int:
     except DrillError as e:
         report = {"ok": False, "error": str(e)}
         rc = 1
+    if rc == 0 and os.environ.get("DECODE_LEDGER", "1") != "0":
+        # Latency joins the regression gate: TTFT/TPOT p50/p99 ride
+        # the same kind-"decode" fingerprinted ledger as throughput,
+        # so `bench_ledger compare --metric serve_ttft_p99_s` trips
+        # on a latency regression exactly like a tok/s one.
+        # (--selftest never writes: CI must not pollute the history.)
+        sys.path.insert(
+            0, os.path.join(os.path.dirname(__file__))
+        )
+        from bench_ledger import append_record
+
+        for metric, value, extra in (
+            (
+                "serve_ttft_p99_s",
+                report["ttft_p99_s"],
+                {"p50": report["ttft_p50_s"]},
+            ),
+            (
+                "serve_tpot_p99_s",
+                report["tpot_p99_s"],
+                {"p50": report["tpot_p50_s"]},
+            ),
+        ):
+            stored = append_record(
+                {
+                    "kind": "decode",
+                    "metric": metric,
+                    "value": value,
+                    "unit": "s",
+                    "requests": report["requests"],
+                    "replicas": args.replicas,
+                    **extra,
+                },
+            )
+            print(
+                f"[drill] ledger += {metric} "
+                f"{stored.get('value')} s",
+                flush=True,
+            )
     print(json.dumps(report))
     if args.json:
         with open(args.json, "w") as f:
