@@ -258,12 +258,23 @@ class MasterClient:
 
     _singleton: Optional["MasterClient"] = None
 
-    def __init__(self, addr: str, node_id: int = 0, node_rank: int = -1):
+    def __init__(
+        self,
+        addr: str,
+        node_id: int = 0,
+        node_rank: int = -1,
+        job_id: str = "",
+    ):
         # wait_for_ready: during a master outage the channel sits in
         # TRANSIENT_FAILURE; queued-until-connected calls recover the
         # instant the replacement master serves, instead of failing
         # fast until gRPC's backoff deigns to redial.
-        self._client = RpcClient(addr, wait_for_ready=True)
+        # ``job_id`` (or DLROVER_TPU_POOL_JOB_ID via singleton())
+        # rides every request's envelope so a multi-job pool master
+        # routes this process's RPCs to ITS job's servicer; ""
+        # preserves single-job behavior exactly.
+        self.job_id = job_id
+        self._client = RpcClient(addr, wait_for_ready=True, job_id=job_id)
         self.node_id = node_id
         self.node_rank = node_rank if node_rank >= 0 else node_id
         # Rides out master outages (reschedule, partition) on every
@@ -348,7 +359,10 @@ class MasterClient:
                 )
             node_id = int(os.getenv(NodeEnv.NODE_ID, "0"))
             node_rank = int(os.getenv(NodeEnv.NODE_RANK, "-1"))
-            cls._singleton = cls(addr, node_id, node_rank)
+            job_id = os.getenv(NodeEnv.POOL_JOB_ID, "")
+            cls._singleton = cls(
+                addr, node_id, node_rank, job_id=job_id
+            )
         return cls._singleton
 
     @classmethod
@@ -850,6 +864,58 @@ class MasterClient:
         return self._get(
             msg.ServeQueryRequest(), max_wait=max_wait
         )
+
+    # -- multi-job pool plane ---------------------------------------------
+
+    def pool_submit(
+        self,
+        job_id: str,
+        tenant: str = "default",
+        priority: int = 0,
+        n_slices: int = 1,
+        min_slices: int = 0,
+        queue: str = "default",
+    ) -> msg.PoolSubmitResponse:
+        """Submit a job to the pool master's gang scheduler.
+        Idempotent on ``job_id`` (a resubmission returns the job's
+        current state instead of double-queueing)."""
+        return self._get(
+            msg.PoolSubmitRequest(
+                job_id=job_id,
+                tenant=tenant,
+                priority=priority,
+                n_slices=n_slices,
+                min_slices=min_slices,
+                queue=queue,
+            )
+        )
+
+    def pool_job_status(
+        self, job_id: str, max_wait: Optional[float] = None
+    ) -> msg.PoolJobStatusResponse:
+        return self._get(
+            msg.PoolJobStatusRequest(job_id=job_id),
+            max_wait=max_wait,
+        )
+
+    def query_pool(
+        self, max_wait: Optional[float] = None
+    ) -> msg.PoolQueryResponse:
+        """The pool scheduler's snapshot (queue depth per band,
+        per-tenant quota usage, slice utilization, preemptions,
+        wait percentiles) — obs_report --pool's feed."""
+        return self._get(msg.PoolQueryRequest(), max_wait=max_wait)
+
+    def query_metrics(
+        self, max_wait: Optional[float] = None
+    ) -> str:
+        """The master's Prometheus text exposition over the control
+        plane (same payload as GET /metrics)."""
+        resp = self._get(
+            msg.MetricsRequest(node_id=self.node_id),
+            max_wait=max_wait,
+        )
+        return resp.text
 
     # -- PS-elastic sparse path ------------------------------------------
 

@@ -57,20 +57,29 @@ def _chaos_server_hook(request) -> None:
 
 
 class _TracedPayload:
-    """Client-side carrier pairing a request with its trace-context
-    envelope (``_tc``) for the gRPC serializer — per-call state the
-    stub's fixed ``request_serializer`` could not otherwise see."""
+    """Client-side carrier pairing a request with its envelope fields
+    (``_tc`` trace context, ``_job`` routing id) for the gRPC
+    serializer — per-call state the stub's fixed
+    ``request_serializer`` could not otherwise see."""
 
-    __slots__ = ("msg", "trace")
+    __slots__ = ("msg", "trace", "job_id")
 
-    def __init__(self, msg: Any, trace: Dict[str, str]):
+    def __init__(
+        self,
+        msg: Any,
+        trace: Optional[Dict[str, str]],
+        job_id: str = "",
+    ):
         self.msg = msg
         self.trace = trace
+        self.job_id = job_id
 
 
 def _serialize_request(obj: Any) -> bytes:
     if isinstance(obj, _TracedPayload):
-        return messages.serialize(obj.msg, trace=obj.trace)
+        return messages.serialize(
+            obj.msg, trace=obj.trace, job_id=obj.job_id
+        )
     return messages.serialize(obj)
 
 
@@ -81,7 +90,13 @@ def find_free_port(host: str = "127.0.0.1") -> int:
 
 
 class RpcDispatcher:
-    """Routes decoded request messages to per-type handler callables."""
+    """Routes decoded request messages to per-type handler callables.
+
+    ``job_id`` (the envelope's ``_job`` field) is accepted — and
+    ignored — by the base dispatcher: a single-job master serves
+    every caller identically, so a job-tagged client talking to one
+    keeps working. :class:`JobRoutingDispatcher` overrides the
+    handle methods to route on it."""
 
     def __init__(self):
         self._get_handlers: Dict[type, Callable[[Any], Any]] = {}
@@ -93,17 +108,81 @@ class RpcDispatcher:
     def register_report(self, msg_cls: type, fn: Callable[[Any], Any]) -> None:
         self._report_handlers[msg_cls] = fn
 
-    def handle_get(self, request: Any) -> Any:
+    def has_get(self, msg_cls: type) -> bool:
+        return msg_cls in self._get_handlers
+
+    def has_report(self, msg_cls: type) -> bool:
+        return msg_cls in self._report_handlers
+
+    def handle_get(self, request: Any, job_id: str = "") -> Any:
         fn = self._get_handlers.get(type(request))
         if fn is None:
             raise KeyError(f"no get handler for {type(request).__name__}")
         return fn(request)
 
-    def handle_report(self, request: Any) -> Any:
+    def handle_report(self, request: Any, job_id: str = "") -> Any:
         fn = self._report_handlers.get(type(request))
         if fn is None:
             raise KeyError(f"no report handler for {type(request).__name__}")
         return fn(request)
+
+
+class JobRoutingDispatcher(RpcDispatcher):
+    """Multi-job dispatcher: the pool master's one RPC server hosting
+    many per-job servicers.
+
+    Requests whose envelope carries a ``_job`` id route to that job's
+    registered :class:`RpcDispatcher` (its own node table, rendezvous,
+    shard ledger, kv store); pool-level messages — and any message
+    type a job's servicer does not handle, e.g. TraceQueryRequest
+    served by the shared trace store — fall through to the handlers
+    registered directly on this dispatcher. An unknown job id raises,
+    so a worker of a retired job fails loudly instead of silently
+    mutating another job's state."""
+
+    def __init__(self):
+        super().__init__()
+        self._lock = threading.Lock()
+        self._jobs: Dict[str, RpcDispatcher] = {}
+
+    def register_job(
+        self, job_id: str, dispatcher: RpcDispatcher
+    ) -> None:
+        if not job_id:
+            raise ValueError("job_id must be non-empty")
+        with self._lock:
+            self._jobs[job_id] = dispatcher
+
+    def remove_job(self, job_id: str) -> None:
+        with self._lock:
+            self._jobs.pop(job_id, None)
+
+    def job_ids(self) -> list:
+        with self._lock:
+            return sorted(self._jobs)
+
+    def _job_dispatcher(self, job_id: str) -> RpcDispatcher:
+        with self._lock:
+            d = self._jobs.get(job_id)
+        if d is None:
+            raise KeyError(
+                f"unknown job {job_id!r} (known: {self.job_ids()})"
+            )
+        return d
+
+    def handle_get(self, request: Any, job_id: str = "") -> Any:
+        if job_id:
+            d = self._job_dispatcher(job_id)
+            if d.has_get(type(request)):
+                return d.handle_get(request)
+        return super().handle_get(request)
+
+    def handle_report(self, request: Any, job_id: str = "") -> Any:
+        if job_id:
+            d = self._job_dispatcher(job_id)
+            if d.has_report(type(request)):
+                return d.handle_report(request)
+        return super().handle_report(request)
 
 
 class _GenericHandler(grpc.GenericRpcHandler):
@@ -115,19 +194,19 @@ class _GenericHandler(grpc.GenericRpcHandler):
         if method == _GET:
             return grpc.unary_unary_rpc_method_handler(
                 self._do_get,
-                request_deserializer=messages.deserialize_with_trace,
+                request_deserializer=messages.deserialize_envelope,
                 response_serializer=messages.serialize,
             )
         if method == _REPORT:
             return grpc.unary_unary_rpc_method_handler(
                 self._do_report,
-                request_deserializer=messages.deserialize_with_trace,
+                request_deserializer=messages.deserialize_envelope,
                 response_serializer=messages.serialize,
             )
         return None
 
     def _dispatch(self, handle, payload, what: str):
-        request, trace = payload
+        request, trace, job_id = payload
         _chaos_server_hook(request)
         # Re-activate the caller's trace context for the handler: the
         # spans/events the master emits while serving this RPC land in
@@ -137,9 +216,9 @@ class _GenericHandler(grpc.GenericRpcHandler):
         try:
             if ctx is not None:
                 with _trace.activate(ctx):
-                    result = handle(request)
+                    result = handle(request, job_id)
             else:
-                result = handle(request)
+                result = handle(request, job_id)
             return messages.BaseResponse(success=True, data=result)
         except Exception as e:  # noqa: BLE001 - must not kill the server
             logger.exception(
@@ -208,10 +287,15 @@ class RpcClient:
         addr: str,
         timeout: float = 30.0,
         wait_for_ready: bool = False,
+        job_id: str = "",
     ):
         self.addr = addr
         self.timeout = timeout
         self.wait_for_ready = wait_for_ready
+        # Stamped on every request's envelope (the ``_job`` field) so
+        # a pool master routes this client's calls to its job's
+        # servicer. "" = single-job client (envelope field omitted).
+        self.job_id = job_id
         self._lock = threading.Lock()
         self._channel: Optional[grpc.Channel] = None
         self._get: Optional[grpc.UnaryUnaryMultiCallable] = None
@@ -253,12 +337,13 @@ class RpcClient:
         self._connect()
         stub = self._get if stub_name == "get" else self._report
         # Propagate the active trace context (if any) as the request
-        # envelope's _tc field. inject() is a dict lookup + None when
-        # no trace is active — the common case stays allocation-free.
+        # envelope's _tc field, and the client's job id as _job.
+        # inject() is a dict lookup + None when no trace is active —
+        # the single-job, no-trace common case stays allocation-free.
         carrier = _trace.inject()
         payload = (
-            _TracedPayload(request, carrier)
-            if carrier is not None
+            _TracedPayload(request, carrier, self.job_id)
+            if carrier is not None or self.job_id
             else request
         )
         # wait_for_ready=True queues the RPC until the channel

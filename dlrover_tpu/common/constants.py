@@ -197,6 +197,11 @@ class NodeEnv:
     """Environment variables understood by agents and training processes."""
 
     JOB_NAME = "DLROVER_TPU_JOB_NAME"
+    # Multi-job pool routing id: when set, every master RPC this
+    # process makes carries it on the envelope's _job field so the
+    # pool master routes to this job's servicer. Unset/empty =
+    # single-job mode (unchanged behavior).
+    POOL_JOB_ID = "DLROVER_TPU_POOL_JOB_ID"
     MASTER_ADDR = "DLROVER_TPU_MASTER_ADDR"
     NODE_ID = "DLROVER_TPU_NODE_ID"
     NODE_RANK = "DLROVER_TPU_NODE_RANK"

@@ -61,16 +61,25 @@ def decode_from_dict(d: Any) -> Any:
     return d
 
 
-def serialize(msg: Any, trace: Optional[Dict[str, str]] = None) -> bytes:
+def serialize(
+    msg: Any,
+    trace: Optional[Dict[str, str]] = None,
+    job_id: Optional[str] = None,
+) -> bytes:
     """Encode a message for the wire. ``trace`` (the dict
     ``obs.tracer.inject()`` produced) rides as a reserved top-level
     ``_tc`` envelope field — never a message field, so every message
     type propagates trace context without schema changes, and an old
     decoder simply drops it (``decode_from_dict`` filters unknown
-    keys)."""
+    keys). ``job_id`` rides the same way as ``_job``: the multi-job
+    pool master routes every message type to that job's servicer
+    without any per-message schema change, and a single-job master
+    (no routing dispatcher) ignores it."""
     d = encode_to_dict(msg)
     if trace:
         d["_tc"] = {str(k): str(v) for k, v in trace.items()}
+    if job_id:
+        d["_job"] = str(job_id)
     return msgpack.packb(d, use_bin_type=True)
 
 
@@ -84,11 +93,22 @@ def deserialize_with_trace(data: bytes):
     """``(message, trace_carrier_or_None)`` — the server-side pair of
     :func:`serialize`'s ``trace=``. The carrier is the raw ``_tc``
     dict (feed it to ``obs.tracer.extract``)."""
+    msg_, trace, _ = deserialize_envelope(data)
+    return msg_, trace
+
+
+def deserialize_envelope(data: bytes):
+    """``(message, trace_carrier_or_None, job_id)`` — the full
+    server-side envelope: typed message, raw ``_tc`` trace carrier,
+    and the ``_job`` routing id ("" when absent, i.e. a single-job
+    client)."""
     raw = msgpack.unpackb(data, raw=False, strict_map_key=False)
     trace = None
+    job_id = ""
     if isinstance(raw, dict):
         trace = raw.pop("_tc", None)
-    return decode_from_dict(raw), trace
+        job_id = str(raw.pop("_job", "") or "")
+    return decode_from_dict(raw), trace, job_id
 
 
 # ---------------------------------------------------------------------------
@@ -911,6 +931,78 @@ class TraceQueryResponse:
     traces: List[Dict[str, Any]] = dataclasses.field(
         default_factory=list
     )
+
+
+# ---------------------------------------------------------------------------
+# Multi-job pool control plane (dlrover_tpu/pool/): clients submit
+# jobs to the pool master's gang scheduler; every per-job RPC above
+# rides the same envelope with its ``_job`` id, so these messages are
+# only the POOL-level surface (submit/status/snapshot).
+# ---------------------------------------------------------------------------
+
+
+@message
+class PoolSubmitRequest:
+    """Client/operator -> pool master: queue one job. ``priority`` is
+    an integer band (higher wins; bounded 0..9 by the scheduler);
+    ``n_slices`` the gang size (placed whole or not at all);
+    ``min_slices`` > 0 the elastic floor a PREEMPTED job may resume
+    with when full capacity has not returned yet. Resubmitting a
+    known ``job_id`` is idempotent (returns its current state)."""
+
+    job_id: str = ""
+    tenant: str = "default"
+    priority: int = 0
+    n_slices: int = 1
+    min_slices: int = 0
+    queue: str = "default"
+
+
+@message
+class PoolSubmitResponse:
+    job_id: str = ""
+    accepted: bool = True
+    state: str = ""  # a PoolJobState value
+    reason: str = ""  # e.g. "quota: tenant over cap" when queued
+    # The job's pool-lifecycle distributed trace (submit -> queue ->
+    # place -> [preempt -> resume]* -> complete) — feed query_traces.
+    trace_id: str = ""
+
+
+@message
+class PoolJobStatusRequest:
+    job_id: str = ""
+
+
+@message
+class PoolJobStatusResponse:
+    job_id: str = ""
+    known: bool = False
+    state: str = ""
+    tenant: str = ""
+    priority: int = 0
+    n_slices: int = 0
+    slices: List[int] = dataclasses.field(default_factory=list)
+    preemptions: int = 0
+    trace_id: str = ""
+    message: str = ""
+
+
+@message
+class PoolQueryRequest:
+    """Fetch the pool scheduler's FULL snapshot (queue depth per
+    priority band, per-tenant quota usage, slice utilization,
+    preemption counts, wait-time percentiles) — the
+    ``obs_report --pool`` feed. Deliberately fieldless, like
+    ServeQueryRequest."""
+
+    pass
+
+
+@message
+class PoolQueryResponse:
+    enabled: bool = False
+    snapshot: Dict[str, Any] = dataclasses.field(default_factory=dict)
 
 
 # -- brain service wire messages (standalone brain: brain/server.py) --

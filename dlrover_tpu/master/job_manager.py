@@ -169,6 +169,15 @@ class JobManager:
         self._critical_workers = parse_critical_workers(critical_workers)
         # Set when a critical node is lost for good: (reason, detail).
         self._job_failure: Optional[tuple] = None
+        # Multi-job pool grant: when this job runs under a pool
+        # master, the pool caps its ALIVE node count here (None =
+        # single-job, unlimited). ensure_role respects it (the
+        # serving plane's autoscale seam), and the remediation
+        # engine's pool_grant governor consults grant_headroom()
+        # before launching replacements — per-job planes become
+        # consumers of pool grants instead of assuming an infinite
+        # cluster.
+        self.pool_grant: Optional[int] = None
 
     @property
     def scaler(self) -> Scaler:
@@ -844,6 +853,22 @@ class JobManager:
 
     # -- role-aware queries and scheduling ----------------------------------
 
+    def _grant_headroom_locked(self) -> Optional[int]:
+        if self.pool_grant is None:
+            return None
+        alive = sum(
+            1 for n in self._nodes.values() if n.is_alive()
+        )
+        return max(self.pool_grant - alive, 0)
+
+    def grant_headroom(self) -> Optional[int]:
+        """Alive-node headroom left inside this job's pool grant
+        (None = no pool, unlimited). Cordoned nodes still count:
+        they hold their host until retired, so a replacement needs
+        real headroom, not a benched slot."""
+        with self._lock:
+            return self._grant_headroom_locked()
+
     def is_chief_running(self) -> bool:
         """Whether any chief node is RUNNING (PS-strategy trainers wait
         for the chief to initialize shared state before stepping)."""
@@ -884,7 +909,9 @@ class JobManager:
 
         plan = ScalePlan()
         launched: List[Node] = []
+        capped = False
         with self._lock:
+            headroom = self._grant_headroom_locked()
             alive = sum(
                 1
                 for n in self._nodes.values()
@@ -892,6 +919,13 @@ class JobManager:
             )
             for index in range(count):
                 if alive + len(launched) >= count:
+                    break
+                if headroom is not None and len(launched) >= headroom:
+                    # Pool grant exhausted: scale intents beyond the
+                    # grant are dropped, not queued — the caller
+                    # (serving autoscaler, evaluator schedule) will
+                    # re-ask when the pool grows the grant.
+                    capped = True
                     break
                 if role_id is not None:
                     node_id = role_id(index)
@@ -915,6 +949,16 @@ class JobManager:
                 self._nodes[node.id] = node
                 plan.launch_nodes.append(node)
                 launched.append(node)
+        if capped:
+            obs.event(
+                "pool.grant_capped",
+                role=node_type, want=count, grant=self.pool_grant,
+            )
+            logger.warning(
+                "ensure_role(%s, %d) capped by pool grant %s "
+                "(launched %d)",
+                node_type, count, self.pool_grant, len(launched),
+            )
         if not plan.empty():
             self._scaler.scale(plan)
         for node in launched:

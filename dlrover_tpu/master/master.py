@@ -59,6 +59,10 @@ class JobMaster:
         remediation_config: Optional[dict] = None,
         remediation_interval: Optional[float] = None,
         serving_config: Optional[dict] = None,
+        job_id: str = "",
+        dispatcher=None,
+        trace_store=None,
+        pool_grant: Optional[int] = None,
     ):
         """``node_num`` is the desired (max) world size; ``min_nodes``
         (default = node_num) is the smallest world the job may proceed
@@ -83,8 +87,21 @@ class JobMaster:
         that acts on critical verdicts (docs/FAULT_TOLERANCE.md
         "Verdict-driven remediation"; DLROVER_TPU_REMEDIATION_* env
         knobs, DLROVER_TPU_REMEDIATION_DRY_RUN=1 to observe without
-        acting)."""
+        acting).
+
+        **Multi-job pool embedding** (docs/MULTI_JOB.md): ``job_id``
+        names this master's job inside a pool; ``dispatcher`` (a
+        per-job RpcDispatcher the pool's JobRoutingDispatcher routes
+        ``_job``-tagged envelopes to) makes this master SHARE the
+        pool's RPC server instead of owning one — its node table,
+        rendezvous, shard ledger, and kv store stay per-job objects
+        behind that routing key. ``trace_store`` shares the pool's
+        TraceStore so every job's spans are queryable at the pool
+        level; ``pool_grant`` caps this job's scalable node count at
+        its pool grant (``JobManager.pool_grant``). All four default
+        to the unchanged single-job behavior."""
         self.node_num = node_num
+        self.job_id = job_id
         self.evaluator_count = evaluator_count
         self.job_manager = JobManager(
             scaler=scaler,
@@ -92,6 +109,9 @@ class JobMaster:
             heartbeat_timeout=heartbeat_timeout,
             monitor_interval=monitor_interval,
         )
+        self.job_manager.pool_grant = pool_grant
+        # Inside a pool, the job id is the natural job name default.
+        job_name = job_name or job_id
         self.task_manager = TaskManager()
         self.speed_monitor = SpeedMonitor()
         self.kv_store = KVStoreService()
@@ -114,7 +134,12 @@ class JobMaster:
         # request ledger): in-master planes feed it directly; trace-
         # tagged events in agent snapshots arrive via the fleet
         # aggregator. Read via TraceQueryRequest / obs_report --trace.
-        self.traces = TraceStore()
+        # A pool-embedded master shares the pool's store, so pool
+        # lifecycle spans and this job's rendezvous/serving spans
+        # assemble into the same queryable timelines.
+        self.traces = (
+            trace_store if trace_store is not None else TraceStore()
+        )
         self.fleet = FleetAggregator(
             speed_monitor=self.speed_monitor,
             goodput=self.goodput,
@@ -251,9 +276,16 @@ class JobMaster:
             metrics_port = int(port_s) if port_s else None
         self._metrics_port = metrics_port
         self.metrics_server = None
-        dispatcher = RpcDispatcher()
-        self.servicer.register(dispatcher)
-        self._server = RpcServer(dispatcher, port=port)
+        if dispatcher is None:
+            dispatcher = RpcDispatcher()
+            self.servicer.register(dispatcher)
+            self._server = RpcServer(dispatcher, port=port)
+        else:
+            # Pool embedding: register into the provided per-job
+            # dispatcher; the pool's shared RpcServer owns the port
+            # and routes `_job`-tagged envelopes here.
+            self.servicer.register(dispatcher)
+            self._server = None
         self._stopped = threading.Event()
         self._warm_restarted = False
         # Warm-restart journal: recoverable master state -> versioned
@@ -451,10 +483,20 @@ class JobMaster:
 
     @property
     def port(self) -> int:
+        if self._server is None:
+            raise RuntimeError(
+                "pool-embedded master has no own server; use the "
+                "pool master's port"
+            )
         return self._server.port
 
     @property
     def addr(self) -> str:
+        if self._server is None:
+            raise RuntimeError(
+                "pool-embedded master has no own server; use the "
+                "pool master's addr"
+            )
         return self._server.addr
 
     def _on_straggler(self, node_id: int) -> None:
@@ -467,7 +509,8 @@ class JobMaster:
         # Restore BEFORE the server accepts its first RPC: agents
         # must never observe a half-restored ledger.
         self._warm_restarted = self._maybe_warm_restart()
-        self._server.start()
+        if self._server is not None:
+            self._server.start()
         self.job_manager.start()
         self.task_manager.start()
         self.metric_collector.start()
@@ -564,7 +607,8 @@ class JobMaster:
         # Unhook the fleet collector from the (process-global)
         # registry so a stopped master stops contributing lines.
         self.fleet.close()
-        self._server.stop(0)
+        if self._server is not None:
+            self._server.stop(0)
 
 
 def run_master(

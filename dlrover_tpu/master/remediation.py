@@ -522,6 +522,26 @@ class RemediationEngine:
                         f"min_nodes {self.min_nodes}"
                     )
                 )
+        if action == ACTION_CORDON_REPLACE:
+            # Under a pool master this job's replacement must fit its
+            # GRANT: cordon-then-replace briefly runs old + new side
+            # by side, and the pool will not hand out a slice the
+            # scheduler did not grant. Single-job masters (no grant)
+            # pass unconditionally. getattr: embedded test doubles
+            # predate the pool seam.
+            headroom_fn = getattr(
+                self.job_manager, "grant_headroom", None
+            )
+            headroom = headroom_fn() if headroom_fn else None
+            g["pool_grant"] = (
+                GOVERNOR_OK
+                if headroom is None or headroom >= 1
+                else (
+                    "blocked: pool grant "
+                    f"{self.job_manager.pool_grant} has no headroom "
+                    "for a replacement"
+                )
+            )
         return g
 
     def _cooldown_for(self, key: Tuple[str, str, int]) -> float:

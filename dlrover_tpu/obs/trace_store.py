@@ -63,7 +63,11 @@ def _env_int(name: str, default: int) -> int:
 
 def _plane_of(name: str) -> str:
     head = name.split(".", 1)[0]
-    return head if head in ("serve", "remediation", "rdzv") else "other"
+    return (
+        head
+        if head in ("serve", "remediation", "rdzv", "pool")
+        else "other"
+    )
 
 
 def _safe_tag(v):

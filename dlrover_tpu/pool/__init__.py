@@ -1,0 +1,25 @@
+"""Multi-job pool control plane: one TPU pool, many tenants.
+
+See docs/MULTI_JOB.md. The pool master owns a fixed slice inventory
+and gang-schedules many jobs onto it with priority bands, FIFO within
+a band, backfill, per-tenant quotas, and checkpoint-backed graceful
+preemption; each placed job runs a full per-job JobMaster (node
+table, rendezvous, shard ledger, kv store) behind one shared RPC
+server, keyed by the ``_job`` envelope id.
+"""
+
+from dlrover_tpu.pool.master import (  # noqa: F401
+    PoolJobContext,
+    TPUPoolMaster,
+    tracker_ckpt_probe,
+)
+from dlrover_tpu.pool.scheduler import (  # noqa: F401
+    JobRuntime,
+    PoolJobSpec,
+    PoolJobState,
+    PoolScheduler,
+)
+from dlrover_tpu.pool.slice_pool import (  # noqa: F401
+    SlicePool,
+    SliceSpec,
+)

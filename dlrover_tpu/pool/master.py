@@ -1,0 +1,628 @@
+"""Pool master: one RPC endpoint, many tenant jobs.
+
+``TPUPoolMaster`` lifts the one-master-one-job control plane to a
+shared TPU pool: it owns the slice inventory
+(:class:`~dlrover_tpu.pool.slice_pool.SlicePool`), the gang scheduler
+with checkpoint-backed preemption
+(:class:`~dlrover_tpu.pool.scheduler.PoolScheduler`), one
+:class:`~dlrover_tpu.common.comm.RpcServer` fronted by a
+:class:`~dlrover_tpu.common.comm.JobRoutingDispatcher`, and one
+shared :class:`~dlrover_tpu.obs.trace_store.TraceStore`.
+
+Each *placed* job gets a full embedded
+:class:`~dlrover_tpu.master.master.JobMaster` — its own node table,
+rendezvous pair, shard ledger, kv store, health plane — registered
+under its ``job_id`` on the routing dispatcher. Workers reach their
+job's master through the pool's single address by stamping the job id
+on the RPC envelope (``MasterClient(job_id=...)`` or the
+``DLROVER_TPU_POOL_JOB_ID`` env); the single-job wire protocol is
+otherwise unchanged.
+
+**Preemption choreography** (the graceful path the scheduler drives
+through :meth:`PoolJobContext.park`): the victim's workers each get a
+``save_checkpoint`` then a ``stop_training`` action through their
+job's normal heartbeat FIFO — the worker contract is *finish the
+in-flight shard, report it, flash-checkpoint durably, exit* — and the
+context confirms the park only once every worker reached a terminal
+state AND the job's checkpoint probe reports the checkpoint durably
+staged. Slices return to the pool strictly after that confirmation.
+The job's ``JobMaster`` (and with it the shard ledger) stays alive
+across the preemption, which is what makes the resume exactly-once:
+completed shards stay completed, in-flight shards were reported
+before the park, and the fresh workers of the resumed incarnation
+simply continue the same ledger.
+"""
+
+from __future__ import annotations
+
+import os
+import threading
+import time
+from typing import Callable, Dict, List, Optional
+
+from dlrover_tpu import obs
+from dlrover_tpu.common import messages as msg
+from dlrover_tpu.common.comm import JobRoutingDispatcher, RpcDispatcher, RpcServer
+from dlrover_tpu.common.constants import (
+    CheckpointConstant,
+    EventAction,
+)
+from dlrover_tpu.common.log import get_logger
+from dlrover_tpu.obs.trace_store import TraceStore
+from dlrover_tpu.pool.scheduler import (
+    JobRuntime,
+    PoolJobSpec,
+    PoolScheduler,
+)
+from dlrover_tpu.pool.slice_pool import SlicePool
+
+logger = get_logger("pool_master")
+
+# Env knobs (docs/MULTI_JOB.md knob table). Constructor arguments
+# win; the env fills unset ones so a pool deployment is tunable
+# without code.
+PARK_TIMEOUT_ENV = "DLROVER_TPU_POOL_PARK_TIMEOUT_S"
+QUOTAS_ENV = "DLROVER_TPU_POOL_QUOTAS"
+DEFAULT_QUOTA_ENV = "DLROVER_TPU_POOL_DEFAULT_QUOTA"
+WATCH_INTERVAL_ENV = "DLROVER_TPU_POOL_WATCH_INTERVAL_S"
+
+
+def parse_quota_spec(spec: str) -> Dict[str, int]:
+    """``"research=3,prod=8"`` -> {"research": 3, "prod": 8} (the
+    DLROVER_TPU_POOL_QUOTAS format)."""
+    out: Dict[str, int] = {}
+    for part in (spec or "").split(","):
+        part = part.strip()
+        if not part:
+            continue
+        tenant, _, n = part.partition("=")
+        try:
+            out[tenant.strip()] = int(n)
+        except ValueError:
+            raise ValueError(
+                f"bad quota entry {part!r}: expected tenant=N"
+            ) from None
+    return out
+
+
+def tracker_ckpt_probe(ckpt_dir: str) -> Callable[[], dict]:
+    """Checkpoint-staging probe over the flash-checkpoint tracker
+    file contract: the checkpoint is durably staged when
+    ``<ckpt_dir>/latest_checkpointed_iteration.txt`` names a step.
+    ``mtime`` rides along so the park choreography can distinguish a
+    FRESH park-time write from a stale tracker left by an earlier
+    park/periodic save — any tracker would otherwise vacuously
+    satisfy "staged before release" forever after the job's first
+    checkpoint."""
+
+    def probe() -> dict:
+        path = os.path.join(
+            ckpt_dir, CheckpointConstant.TRACKER_FILE
+        )
+        try:
+            with open(path) as f:
+                step = int(f.read().strip() or -1)
+            mtime = os.stat(path).st_mtime
+        except (OSError, ValueError):
+            return {
+                "staged": False, "path": path, "step": -1,
+                "mtime": 0.0,
+            }
+        return {
+            "staged": step >= 0, "path": path, "step": step,
+            "mtime": mtime,
+        }
+
+    return probe
+
+
+class PoolJobContext(JobRuntime):
+    """One admitted job inside the pool master process."""
+
+    def __init__(
+        self,
+        pool_master: "TPUPoolMaster",
+        spec: PoolJobSpec,
+        worker_launcher: Optional[Callable] = None,
+        ckpt_probe: Optional[Callable[[], dict]] = None,
+        job_master_kwargs: Optional[dict] = None,
+    ):
+        self._pool = pool_master
+        self.spec = spec
+        self.worker_launcher = worker_launcher
+        # None = the job declared no durable training state: safe to
+        # park without a checkpoint, confirmed as staged-stateless.
+        self.ckpt_probe = ckpt_probe
+        self.job_master_kwargs = dict(job_master_kwargs or {})
+        self.master = None  # JobMaster, built at first placement
+        self.dispatcher: Optional[RpcDispatcher] = None
+        self.slices: List[int] = []
+        self._parking = threading.Event()
+        self._closed = False
+        # Completion guard: all_workers_done() is vacuously true right
+        # after a (re)placement until the fresh incarnation's workers
+        # register — completing then would retire a job that never
+        # restarted. Set once a RUNNING worker is seen post-placement.
+        self._workers_seen = False
+
+    # -- JobRuntime ---------------------------------------------------------
+
+    def _grant_hosts(self, slices: List[int]) -> int:
+        return sum(
+            self._pool.pool.spec(sid).hosts for sid in slices
+        )
+
+    def place(self, slices: List[int], resume: bool) -> None:
+        self.slices = list(slices)
+        # Order matters: the completion watcher may tick between
+        # these lines — reset the workers-seen guard while _parking
+        # still suppresses check_complete, or a stale True plus the
+        # parked incarnation's all-terminal node table would complete
+        # the job before its resume workers ever start.
+        self._workers_seen = False
+        self._parking.clear()
+        hosts = self._grant_hosts(slices)
+        if self.master is None:
+            from dlrover_tpu.common.constants import (
+                NodeEventType,
+                NodeType,
+            )
+            from dlrover_tpu.master.master import JobMaster
+
+            self.dispatcher = RpcDispatcher()
+            kwargs = dict(self.job_master_kwargs)
+            kwargs.setdefault("node_num", hosts)
+            kwargs.setdefault(
+                "min_nodes",
+                max(
+                    self._grant_min_hosts(), 1
+                ) if self.spec.min_slices > 0 else 0,
+            )
+            self.master = JobMaster(
+                job_id=self.spec.job_id,
+                dispatcher=self.dispatcher,
+                trace_store=self._pool.traces,
+                pool_grant=hosts,
+                **kwargs,
+            )
+
+            # Event-driven, not polled: a short job's workers can
+            # register AND finish entirely between two watcher
+            # ticks — a poll for "alive workers" would miss the
+            # incarnation and strand the job PLACED forever.
+            def _on_node_event(node, event_type):
+                if (
+                    event_type == NodeEventType.CREATED
+                    and node.type
+                    in (NodeType.WORKER, NodeType.CHIEF)
+                ):
+                    self._workers_seen = True
+
+            self.master.job_manager.add_listener(_on_node_event)
+            self._pool.router.register_job(
+                self.spec.job_id, self.dispatcher
+            )
+            self.master.prepare()
+        else:
+            # Elastic re-admission: same master, same ledger, a
+            # possibly smaller grant.
+            self.master.job_manager.pool_grant = hosts
+        if self.worker_launcher is not None:
+            self.worker_launcher(
+                self.spec.job_id, self._pool.addr, list(slices), resume
+            )
+
+    def _grant_min_hosts(self) -> int:
+        """The SMALLEST host count any min_slices-sized grant could
+        carry: on a heterogeneous pool, an elastic resume may land on
+        the small slices — a min_nodes derived from the big ones
+        would strand the resumed incarnation below its own
+        rendezvous floor forever."""
+        specs = sorted(
+            self._pool.pool.specs(), key=lambda s: s.hosts
+        )
+        return sum(
+            s.hosts for s in specs[: self.spec.min_slices]
+        )
+
+    def park(self, on_parked: Callable[[dict], None]) -> None:
+        """Graceful eviction, asynchronously: deliver
+        save_checkpoint + stop_training through each worker's
+        heartbeat FIFO, wait for every worker to reach a terminal
+        state, then wait for the checkpoint probe to confirm durable
+        staging — only then confirm the park."""
+        if self.master is None:
+            on_parked({"staged": True, "note": "never placed"})
+            return
+        self._parking.set()
+        # Baseline BEFORE any park action is delivered: the park's
+        # checkpoint must be newer than whatever the tracker already
+        # named, or a stale checkpoint from an earlier park/periodic
+        # save would vacuously confirm the staging.
+        base = self.ckpt_probe() if self.ckpt_probe else None
+        servicer = self.master.servicer
+        workers = self.master.job_manager.alive_workers(
+            include_chief=True
+        )
+        for node in workers:
+            servicer.push_action(
+                node.id, EventAction.SAVE_CHECKPOINT.value
+            )
+            servicer.push_action(
+                node.id, EventAction.STOP_TRAINING.value
+            )
+        obs.event(
+            "pool.park_begin", job_id=self.spec.job_id,
+            workers=len(workers),
+        )
+
+        deadline = time.monotonic() + max(
+            self._pool.scheduler.park_timeout_s - 2.0, 1.0
+        )
+
+        def wait_and_confirm() -> None:
+            jm = self.master.job_manager
+            while time.monotonic() < deadline:
+                if not jm.alive_workers(include_chief=True):
+                    break
+                time.sleep(0.05)
+            info: dict
+            if self.ckpt_probe is None:
+                info = {"staged": True, "note": "stateless"}
+            else:
+
+                def fresh(p: dict) -> bool:
+                    if not p.get("staged"):
+                        return False
+                    if base is None or not base.get("staged"):
+                        return True  # no prior checkpoint to confuse
+                    return (
+                        p.get("step", -1) > base.get("step", -1)
+                        or p.get("mtime", 0.0)
+                        > base.get("mtime", 0.0)
+                    )
+
+                info = {"staged": False}
+                while time.monotonic() < deadline:
+                    info = self.ckpt_probe()
+                    if fresh(info):
+                        break
+                    time.sleep(0.05)
+                if not fresh(info):
+                    info = dict(info)
+                    info["staged"] = False
+                    info.setdefault(
+                        "error",
+                        "tracker never advanced past the pre-park "
+                        "checkpoint",
+                    )
+            still_alive = len(
+                jm.alive_workers(include_chief=True)
+            )
+            if still_alive:
+                # Workers ignored the park entirely: this is a
+                # FORCED reclaim, not an unstaged-but-clean one —
+                # the scheduler must hard-stop the incarnation
+                # before its slices are reused.
+                info = dict(info)
+                info["staged"] = False
+                info["forced"] = True
+                info["error"] = (
+                    f"{still_alive} worker(s) never parked"
+                )
+            on_parked(info)
+
+        t = threading.Thread(
+            target=wait_and_confirm,
+            name=f"park-{self.spec.job_id}",
+            daemon=True,
+        )
+        t.start()
+
+    def stop(self) -> None:
+        """Hard stop (forced reclaim / cancellation): push the stop
+        action for polite workers, then retire the nodes through the
+        ScalePlan seam — a runtime being force-stopped may be
+        ignoring actions entirely (that is usually WHY it is being
+        forced), and only pod deletion actually frees the hardware."""
+        if self.master is None:
+            return
+        jm = self.master.job_manager
+        workers = jm.alive_workers(include_chief=True)
+        for node in workers:
+            self.master.servicer.push_action(
+                node.id, EventAction.STOP_TRAINING.value
+            )
+        for node in workers:
+            jm.retire_node(node.id)
+
+    # -- completion watching ------------------------------------------------
+
+    def check_complete(self) -> bool:
+        """True when the placed job's training fleet has finished
+        (used by the pool's watcher; guarded against the vacuous
+        window before the incarnation's workers register)."""
+        if self.master is None or self._parking.is_set():
+            return False
+        if not self._workers_seen:
+            return False
+        return self.master.job_manager.all_workers_done()
+
+    def close(self) -> None:
+        """Tear down the embedded master (idempotent): called when
+        the job reaches a terminal state and again defensively at
+        pool shutdown. Without this, every finished job would leak
+        its master's threads, its dispatcher registration, and its
+        fleet hook into the process-global metrics registry."""
+        if self._closed:
+            return
+        self._closed = True
+        if self.master is not None:
+            try:
+                self.master.stop()
+            except Exception:  # noqa: BLE001
+                logger.exception(
+                    "stopping job master %s failed", self.spec.job_id
+                )
+            self._pool.router.remove_job(self.spec.job_id)
+
+
+class TPUPoolMaster:
+    """The pool-level control plane (see module docstring)."""
+
+    def __init__(
+        self,
+        slices,
+        port: int = 0,
+        tenant_quotas: Optional[Dict[str, int]] = None,
+        default_quota: Optional[int] = None,
+        park_timeout_s: Optional[float] = None,
+        watch_interval: Optional[float] = None,
+        worker_launcher: Optional[Callable] = None,
+        job_master_defaults: Optional[dict] = None,
+        metrics_port: Optional[int] = None,
+    ):
+        if tenant_quotas is None and os.getenv(QUOTAS_ENV, ""):
+            tenant_quotas = parse_quota_spec(os.environ[QUOTAS_ENV])
+        if default_quota is None and os.getenv(
+            DEFAULT_QUOTA_ENV, ""
+        ):
+            default_quota = int(os.environ[DEFAULT_QUOTA_ENV])
+        if park_timeout_s is None:
+            park_timeout_s = float(
+                os.getenv(PARK_TIMEOUT_ENV, "") or 120.0
+            )
+        if watch_interval is None:
+            watch_interval = float(
+                os.getenv(WATCH_INTERVAL_ENV, "") or 1.0
+            )
+        self.pool = SlicePool(
+            slices,
+            tenant_quotas=tenant_quotas,
+            default_quota=default_quota,
+        )
+        self.traces = TraceStore()
+        self.scheduler = PoolScheduler(
+            self.pool,
+            trace_sink=self.traces,
+            park_timeout_s=park_timeout_s,
+        )
+        self.router = JobRoutingDispatcher()
+        self._server = RpcServer(self.router, port=port)
+        self._contexts: Dict[str, PoolJobContext] = {}
+        self._ctx_lock = threading.Lock()
+        # RPC-path defaults (submissions arriving over the wire have
+        # no way to pass callables).
+        self._default_launcher = worker_launcher
+        self._job_master_defaults = dict(job_master_defaults or {})
+        self._watch_interval = watch_interval
+        self._stop = threading.Event()
+        self._watcher: Optional[threading.Thread] = None
+        # Prometheus /metrics (the dlrover_pool_* series land in the
+        # same process-global registry the job planes use); None =
+        # RPC-only exposition via MetricsRequest.
+        self._metrics_port = metrics_port
+        self.metrics_server = None
+        # Ring-evicted terminal jobs drop their contexts here.
+        self.scheduler.on_job_evicted = self._on_job_evicted
+        self._register_rpc()
+
+    def _on_job_evicted(self, job_id: str) -> None:
+        with self._ctx_lock:
+            ctx = self._contexts.pop(job_id, None)
+        if ctx is not None:
+            ctx.close()
+
+    # -- lifecycle ----------------------------------------------------------
+
+    @property
+    def port(self) -> int:
+        return self._server.port
+
+    @property
+    def addr(self) -> str:
+        return self._server.addr
+
+    def prepare(self) -> None:
+        self._server.start()
+        self._watcher = threading.Thread(
+            target=self._watch_loop, name="pool-watcher", daemon=True
+        )
+        self._watcher.start()
+        if self._metrics_port is not None:
+            from dlrover_tpu.obs.exposition import MetricsHTTPServer
+
+            self.metrics_server = MetricsHTTPServer(
+                port=self._metrics_port
+            )
+            self.metrics_server.start()
+        logger.info(
+            "pool master serving %d slices on %s",
+            self.pool.n_slices, self.addr,
+        )
+
+    def stop(self) -> None:
+        self._stop.set()
+        with self._ctx_lock:
+            contexts = list(self._contexts.values())
+        for ctx in contexts:
+            ctx.close()
+        if self.metrics_server is not None:
+            self.metrics_server.stop()
+            self.metrics_server = None
+        self._server.stop(0)
+
+    # -- submissions --------------------------------------------------------
+
+    def submit(
+        self,
+        spec: PoolJobSpec,
+        worker_launcher: Optional[Callable] = None,
+        ckpt_probe: Optional[Callable[[], dict]] = None,
+        job_master_kwargs: Optional[dict] = None,
+    ) -> dict:
+        """Admit one job (in-process API; the PoolSubmitRequest RPC
+        routes here with the constructor defaults). Idempotent on
+        job_id."""
+        with self._ctx_lock:
+            if spec.job_id in self._contexts:
+                info = self.scheduler.job_info(spec.job_id) or {}
+                return {
+                    "state": info.get("state", ""),
+                    "reason": "already submitted",
+                    "trace_id": info.get("trace_id", ""),
+                }
+            ctx = PoolJobContext(
+                self,
+                spec,
+                worker_launcher=(
+                    worker_launcher or self._default_launcher
+                ),
+                ckpt_probe=ckpt_probe,
+                job_master_kwargs={
+                    **self._job_master_defaults,
+                    **(job_master_kwargs or {}),
+                },
+            )
+            self._contexts[spec.job_id] = ctx
+        result = self.scheduler.submit(spec, ctx)
+        if not result.get("state"):
+            # Rejected outright (bad spec): drop the context again.
+            with self._ctx_lock:
+                self._contexts.pop(spec.job_id, None)
+        return result
+
+    def context(self, job_id: str) -> Optional[PoolJobContext]:
+        with self._ctx_lock:
+            return self._contexts.get(job_id)
+
+    # -- completion watcher -------------------------------------------------
+
+    def tick_once(self) -> None:
+        """One completion-watch pass (drills tick deterministically;
+        the background watcher calls this on ``watch_interval``)."""
+        from dlrover_tpu.pool.scheduler import PoolJobState
+
+        with self._ctx_lock:
+            contexts = list(self._contexts.values())
+        for ctx in contexts:
+            info = self.scheduler.job_info(ctx.spec.job_id)
+            if info is None:
+                continue
+            if info["state"] in PoolJobState.TERMINAL:
+                # Reclaim the embedded master of a finished/cancelled
+                # job (threads, dispatcher slot, registry hook); the
+                # scheduler record stays for status/trace queries.
+                ctx.close()
+                continue
+            if info["state"] != PoolJobState.PLACED:
+                continue
+            if ctx.check_complete():
+                logger.info(
+                    "job %s finished; returning %s to the pool",
+                    ctx.spec.job_id, info["slices"],
+                )
+                self.scheduler.complete(ctx.spec.job_id)
+
+    def _watch_loop(self) -> None:
+        while not self._stop.wait(self._watch_interval):
+            try:
+                self.tick_once()
+            except Exception:  # noqa: BLE001
+                logger.exception("pool watcher pass failed")
+
+    # -- pool-level RPC surface ---------------------------------------------
+
+    def _register_rpc(self) -> None:
+        g = self.router.register_get
+        g(msg.PoolSubmitRequest, self._rpc_submit)
+        g(msg.PoolJobStatusRequest, self._rpc_status)
+        g(msg.PoolQueryRequest, self._rpc_query)
+        g(msg.TraceQueryRequest, self._rpc_traces)
+        g(msg.MetricsRequest, self._rpc_metrics)
+
+    def _rpc_submit(self, req: msg.PoolSubmitRequest):
+        spec = PoolJobSpec(
+            job_id=req.job_id,
+            tenant=req.tenant or "default",
+            priority=req.priority,
+            n_slices=req.n_slices,
+            min_slices=req.min_slices,
+            queue=req.queue or "default",
+        )
+        result = self.submit(spec)
+        return msg.PoolSubmitResponse(
+            job_id=req.job_id,
+            accepted=bool(result.get("state")),
+            state=result.get("state", ""),
+            reason=result.get("reason", ""),
+            trace_id=result.get("trace_id", ""),
+        )
+
+    def _rpc_status(self, req: msg.PoolJobStatusRequest):
+        info = self.scheduler.job_info(req.job_id)
+        if info is None:
+            return msg.PoolJobStatusResponse(
+                job_id=req.job_id, known=False
+            )
+        return msg.PoolJobStatusResponse(
+            job_id=req.job_id,
+            known=True,
+            state=info["state"],
+            tenant=info["tenant"],
+            priority=info["priority"],
+            n_slices=info["n_slices"],
+            slices=info["slices"],
+            preemptions=info["preemptions"],
+            trace_id=info["trace_id"],
+            message=info["reason"],
+        )
+
+    def _rpc_query(self, req: msg.PoolQueryRequest):
+        return msg.PoolQueryResponse(
+            enabled=True, snapshot=self.scheduler.snapshot()
+        )
+
+    def _rpc_traces(self, req: msg.TraceQueryRequest):
+        from dlrover_tpu.master.servicer import MAX_TRACE_QUERY
+
+        limit = req.limit
+        if not req.trace_id:
+            limit = (
+                min(limit, MAX_TRACE_QUERY)
+                if limit > 0
+                else MAX_TRACE_QUERY
+            )
+        return msg.TraceQueryResponse(
+            enabled=True,
+            traces=self.traces.query(
+                trace_id=req.trace_id,
+                subject=req.subject,
+                limit=limit,
+            ),
+        )
+
+    def _rpc_metrics(self, req: msg.MetricsRequest):
+        return msg.MetricsResponse(
+            text=obs.get_registry().render()
+        )

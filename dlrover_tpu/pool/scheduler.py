@@ -1,0 +1,1005 @@
+"""Gang scheduler + preemption engine for the multi-job pool.
+
+One :class:`PoolScheduler` owns the queue of every job submitted to a
+:class:`~dlrover_tpu.pool.slice_pool.SlicePool` and decides, on every
+``schedule_once`` pass, which jobs run where:
+
+* **Gang placement** — a job is placed only when its *whole* slice
+  gang can be allocated atomically (``SlicePool.allocate`` is
+  all-or-nothing), so two half-placed gangs can never deadlock each
+  other holding partial grants.
+* **Priority bands, FIFO within a band** — the queue orders by
+  (priority desc, admission seq asc). Priorities are integer bands
+  0..9 (higher wins), matching the ``priority`` field of the
+  ElasticJob CRD.
+* **Backfill** — when the head of the queue cannot be placed, a
+  strictly LOWER-priority job further down that fits entirely in the
+  current free slices is placed into the hole. Lower-priority only:
+  the head can preempt it back the moment its gang becomes feasible,
+  so backfill can delay the head by at most one graceful checkpoint —
+  and a same-band job jumping the queue would break FIFO fairness.
+* **Checkpoint-backed preemption** — when the head outranks running
+  jobs, the engine evicts the cheapest victims (lowest priority
+  first, youngest first within a band) through the *graceful* path:
+  the victim's runtime parks its workers (CORDON-style: finish the
+  in-flight shard, flash-checkpoint durably), and ONLY after the
+  runtime confirms the checkpoint is staged are the victim's slices
+  released. A parked job re-enters the queue at its original
+  admission seq (it does not lose its FIFO place) and is re-admitted
+  **elastically**: when capacity returns partially, it may resume
+  with fewer slices (>= ``min_slices``), growing back later through
+  its own master's elasticity.
+
+The scheduler never talks to workers itself — it drives
+:class:`JobRuntime` objects (the pool master's per-job contexts, or
+test fakes) through three calls: ``place(slices, resume)``,
+``park(on_parked)``, ``stop()``.
+
+Every job's pool lifecycle is one distributed trace in the shared
+:class:`~dlrover_tpu.obs.trace_store.TraceStore`; preemption spans
+(park -> checkpoint staged -> release) are recorded in the
+*demanding* job's trace — tagged with the victim's id as a subject —
+so the whole queue -> preempt -> place -> resume story of one
+capacity incident reads as a single timeline via ``query_traces``.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import threading
+import time
+import uuid
+from collections import deque
+from typing import Callable, Dict, List, Optional
+
+from dlrover_tpu import obs
+from dlrover_tpu.common.log import get_logger
+
+logger = get_logger("pool_scheduler")
+
+MAX_PRIORITY = 9
+# Wait-time samples retained per band for the snapshot percentiles.
+WAIT_SAMPLES_PER_BAND = 256
+# Terminal (done/failed/cancelled) job records retained for status/
+# snapshot queries — ring-bounded like every other retention surface
+# in this repo (trace store, request ledger): a long-lived pool
+# serving thousands of short jobs must not grow without bound.
+MAX_TERMINAL_JOBS = 512
+
+_QUEUE_DEPTH = obs.gauge(
+    "dlrover_pool_queue_depth",
+    "Jobs waiting for placement (queued + preempted), by priority "
+    "band",
+    ("band",),
+)
+_JOBS = obs.gauge(
+    "dlrover_pool_jobs",
+    "Pool jobs by lifecycle state",
+    ("state",),
+)
+_PLACEMENT_SECONDS = obs.histogram(
+    "dlrover_pool_placement_seconds",
+    "Wall time from submission to first placement",
+)
+_WAIT_SECONDS = obs.histogram(
+    "dlrover_pool_wait_seconds",
+    "Wall time spent waiting before each placement (first placement "
+    "and every elastic re-admission), by priority band",
+    ("band",),
+)
+_PREEMPTIONS = obs.counter(
+    "dlrover_pool_preemptions_total",
+    "Jobs preempted by the pool scheduler, by reason (priority = "
+    "clean graceful eviction for a higher band; unstaged = workers "
+    "parked but the checkpoint never confirmed staging; forced = "
+    "the graceful park timed out or failed and the slices were "
+    "reclaimed with a hard stop)",
+    ("reason",),
+)
+_QUOTA_DENIED = obs.counter(
+    "dlrover_pool_quota_denied_total",
+    "Placement attempts skipped because the tenant was at quota",
+    ("tenant",),
+)
+_BACKFILLS = obs.counter(
+    "dlrover_pool_backfills_total",
+    "Lower-priority jobs placed into holes ahead of a blocked "
+    "queue head",
+)
+
+
+class PoolJobState:
+    QUEUED = "queued"
+    PLACED = "placed"
+    PREEMPTING = "preempting"  # graceful park in flight
+    PREEMPTED = "preempted"  # parked; waiting for re-admission
+    DONE = "done"
+    FAILED = "failed"
+    CANCELLED = "cancelled"
+
+    WAITING = (QUEUED, PREEMPTED)
+    RUNNING = (PLACED, PREEMPTING)
+    TERMINAL = (DONE, FAILED, CANCELLED)
+
+
+@dataclasses.dataclass(frozen=True)
+class PoolJobSpec:
+    job_id: str
+    tenant: str = "default"
+    priority: int = 0
+    n_slices: int = 1
+    # Elastic floor for RE-admission after a preemption: 0 = not
+    # elastic, the full gang is required to resume too.
+    min_slices: int = 0
+    queue: str = "default"
+
+
+class JobRuntime:
+    """What the scheduler needs from a job's execution side. The pool
+    master's per-job context implements this over an embedded
+    JobMaster; tests use in-memory fakes."""
+
+    def place(self, slices: List[int], resume: bool) -> None:
+        """Start (or elastically resume, ``resume=True``) the job on
+        these slices."""
+        raise NotImplementedError
+
+    def park(self, on_parked: Callable[[dict], None]) -> None:
+        """Gracefully stop the job: finish in-flight shards, flash-
+        checkpoint durably, then call ``on_parked({"staged": bool,
+        "path": ..., "step": ...})``. The scheduler releases the
+        job's slices only after this callback — checkpoint staging
+        strictly precedes slice release."""
+        raise NotImplementedError
+
+    def stop(self) -> None:
+        """Hard stop (cancellation); no checkpoint contract."""
+        raise NotImplementedError
+
+
+class _Job:
+    __slots__ = (
+        "spec", "runtime", "state", "seq", "trace_id",
+        "submit_wall", "submit_mono", "wait_since_mono",
+        "wait_since_wall", "placed_mono", "first_placed",
+        "slices", "preemptions", "preempt_trace", "park_started_wall",
+        "reason", "quota_logged", "done_wall",
+    )
+
+    def __init__(self, spec: PoolJobSpec, runtime: JobRuntime,
+                 seq: int, trace_id: str):
+        self.spec = spec
+        self.runtime = runtime
+        self.state = PoolJobState.QUEUED
+        self.seq = seq
+        self.trace_id = trace_id
+        self.submit_wall = time.time()
+        self.submit_mono = time.monotonic()
+        self.wait_since_mono = self.submit_mono
+        self.wait_since_wall = self.submit_wall
+        self.placed_mono: Optional[float] = None
+        self.first_placed = False
+        self.slices: List[int] = []
+        self.preemptions = 0
+        # The demanding job's trace id while this job is being
+        # preempted / awaiting resume — the cross-link that keeps one
+        # capacity incident in one timeline.
+        self.preempt_trace: str = ""
+        self.park_started_wall: float = 0.0
+        self.reason = ""
+        self.quota_logged = False
+        self.done_wall: float = 0.0
+
+    @property
+    def band(self) -> str:
+        return str(self.spec.priority)
+
+
+class PoolScheduler:
+    def __init__(
+        self,
+        pool,
+        trace_sink=None,
+        park_timeout_s: float = 120.0,
+    ):
+        self.pool = pool
+        self.traces = trace_sink
+        self.park_timeout_s = park_timeout_s
+        self._lock = threading.RLock()
+        self._jobs: Dict[str, _Job] = {}
+        self._seq = 0
+        self._scheduling = False
+        self._dirty = False
+        self._park_timers: Dict[str, threading.Timer] = {}
+        self._terminal_fifo: deque = deque()
+        # Fired (outside the lock) with each evicted terminal job id;
+        # the pool master drops its PoolJobContext here.
+        self.on_job_evicted: Optional[Callable[[str], None]] = None
+        self._wait_samples: Dict[str, deque] = {}
+        self._counters = {
+            "submitted": 0,
+            "placements": 0,
+            "backfills": 0,
+            "completions": 0,
+            "preemptions": {},  # reason -> n
+            "quota_denied": {},  # tenant -> n
+        }
+
+    # -- trace plumbing -----------------------------------------------------
+
+    def _span(
+        self, trace_id: str, name: str, start: float,
+        dur: float = 0.0, **tags,
+    ) -> None:
+        if self.traces is not None and trace_id:
+            self.traces.add_span(
+                trace_id, name, start, dur_s=dur, **tags
+            )
+
+    @staticmethod
+    def _subject(job_id: str) -> str:
+        return f"pooljob:{job_id}"
+
+    # -- submission ---------------------------------------------------------
+
+    def submit(
+        self, spec: PoolJobSpec, runtime: JobRuntime
+    ) -> Dict[str, str]:
+        """Queue a job. Idempotent on job_id. Returns
+        {"state": ..., "reason": ..., "trace_id": ...}."""
+        if not spec.job_id:
+            return {"state": "", "reason": "job_id required",
+                    "trace_id": ""}
+        if not 0 <= spec.priority <= MAX_PRIORITY:
+            return {
+                "state": "",
+                "reason": f"priority must be 0..{MAX_PRIORITY}",
+                "trace_id": "",
+            }
+        if spec.n_slices < 1 or spec.n_slices > self.pool.n_slices:
+            return {
+                "state": "",
+                "reason": (
+                    f"n_slices {spec.n_slices} outside pool capacity "
+                    f"1..{self.pool.n_slices}"
+                ),
+                "trace_id": "",
+            }
+        with self._lock:
+            existing = self._jobs.get(spec.job_id)
+            if existing is not None:
+                return {
+                    "state": existing.state,
+                    "reason": "already submitted",
+                    "trace_id": existing.trace_id,
+                }
+            trace_id = f"pool-{spec.job_id}-{uuid.uuid4().hex[:8]}"
+            job = _Job(spec, runtime, self._seq, trace_id)
+            self._seq += 1
+            self._jobs[spec.job_id] = job
+            self._counters["submitted"] += 1
+        self._span(
+            trace_id, "pool.submit", job.submit_wall,
+            subject=self._subject(spec.job_id), job_id=spec.job_id,
+            tenant=spec.tenant, priority=spec.priority,
+            n_slices=spec.n_slices,
+        )
+        obs.event(
+            "pool.submit", job_id=spec.job_id, tenant=spec.tenant,
+            priority=spec.priority, n_slices=spec.n_slices,
+            trace_id=trace_id,
+        )
+        self.schedule_once()
+        with self._lock:
+            return {
+                "state": job.state,
+                "reason": job.reason,
+                "trace_id": trace_id,
+            }
+
+    # -- lifecycle from runtimes --------------------------------------------
+
+    def _note_terminal_locked(self, job_id: str) -> List[str]:
+        """Ring-bound the terminal-record history; returns evicted
+        job ids (callback fired by the caller outside the lock)."""
+        self._terminal_fifo.append(job_id)
+        evicted: List[str] = []
+        while len(self._terminal_fifo) > MAX_TERMINAL_JOBS:
+            old = self._terminal_fifo.popleft()
+            job = self._jobs.get(old)
+            if job is not None and job.state in PoolJobState.TERMINAL:
+                self._jobs.pop(old, None)
+                evicted.append(old)
+        return evicted
+
+    def _fire_evictions(self, evicted: List[str]) -> None:
+        cb = self.on_job_evicted
+        for job_id in evicted:
+            if cb is not None:
+                try:
+                    cb(job_id)
+                except Exception:  # noqa: BLE001
+                    logger.exception(
+                        "job eviction callback failed for %s", job_id
+                    )
+
+    def complete(self, job_id: str, success: bool = True) -> None:
+        """The job's runtime reports it finished; frees its slices
+        and re-schedules (parked jobs resume here)."""
+        with self._lock:
+            job = self._jobs.get(job_id)
+            if job is None or job.state in PoolJobState.TERMINAL:
+                return
+            job.state = (
+                PoolJobState.DONE if success else PoolJobState.FAILED
+            )
+            job.done_wall = time.time()
+            released = self.pool.release(job_id)
+            job.slices = []
+            self._counters["completions"] += 1
+            evicted = self._note_terminal_locked(job_id)
+            self._update_gauges_locked()
+        self._fire_evictions(evicted)
+        self._span(
+            job.trace_id, "pool.complete", job.done_wall,
+            subject=self._subject(job_id), job_id=job_id,
+            success=success, released=",".join(map(str, released)),
+        )
+        obs.event(
+            "pool.complete", job_id=job_id, success=success,
+            released=len(released),
+        )
+        self.schedule_once()
+
+    def cancel(self, job_id: str) -> bool:
+        with self._lock:
+            job = self._jobs.get(job_id)
+            if job is None or job.state in PoolJobState.TERMINAL:
+                return False
+            was_running = job.state in PoolJobState.RUNNING
+            job.state = PoolJobState.CANCELLED
+            self.pool.release(job_id)
+            job.slices = []
+            evicted = self._note_terminal_locked(job_id)
+            self._update_gauges_locked()
+        self._fire_evictions(evicted)
+        if was_running:
+            try:
+                job.runtime.stop()
+            except Exception:  # noqa: BLE001
+                logger.exception("stop() failed for %s", job_id)
+        obs.event("pool.cancel", job_id=job_id)
+        self.schedule_once()
+        return True
+
+    # -- scheduling pass ----------------------------------------------------
+
+    def schedule_once(self) -> None:
+        """One full scheduling pass. Reentrancy-safe: a pass already
+        in flight absorbs nested calls (from synchronous runtime
+        callbacks) as a re-run request instead of recursing."""
+        with self._lock:
+            if self._scheduling:
+                self._dirty = True
+                return
+            self._scheduling = True
+        try:
+            for _ in range(64):  # progress-bounded, not time-bounded
+                with self._lock:
+                    self._dirty = False
+                    actions = self._plan_locked()
+                for fn in actions:
+                    try:
+                        fn()
+                    except Exception:  # noqa: BLE001 — one broken
+                        # runtime must not wedge the whole pool
+                        logger.exception("pool runtime action failed")
+                with self._lock:
+                    if not actions and not self._dirty:
+                        break
+        finally:
+            with self._lock:
+                self._scheduling = False
+                self._update_gauges_locked()
+
+    def _waiting_locked(self) -> List[_Job]:
+        return sorted(
+            (
+                j for j in self._jobs.values()
+                if j.state in PoolJobState.WAITING
+            ),
+            key=lambda j: (-j.spec.priority, j.seq),
+        )
+
+    def _grant_size(self, job: _Job, free: int) -> int:
+        """How many slices this placement attempt needs/takes. A
+        fresh job demands its whole gang; a preempted elastic job may
+        resume smaller (>= min_slices) and grow back later."""
+        if free >= job.spec.n_slices:
+            return job.spec.n_slices
+        if (
+            job.state == PoolJobState.PREEMPTED
+            and job.spec.min_slices > 0
+            and free >= job.spec.min_slices
+        ):
+            return free
+        return 0
+
+    def _plan_locked(self) -> List[Callable[[], None]]:
+        """Compute the next batch of runtime actions under the lock;
+        the caller executes them outside it."""
+        actions: List[Callable[[], None]] = []
+        waiting = self._waiting_locked()
+        if not waiting:
+            return actions
+        free = self.pool.n_free()
+        head_blocked: Optional[_Job] = None
+        # Free slices earmarked for a blocked head whose gang will
+        # become feasible through in-flight preemptions: backfill
+        # must not re-occupy capacity the engine is actively freeing,
+        # or the victim it just parked bounces straight back onto the
+        # head's slices (placement churn, head never fits).
+        reserved_free = 0
+        for job in waiting:
+            if head_blocked is not None:
+                # Backfill: strictly lower-priority, whole gang in
+                # the UNRESERVED holes, within quota. (Same band
+                # would break FIFO; higher can't be behind the head.)
+                if job.spec.priority >= head_blocked.spec.priority:
+                    continue
+                grant = self._grant_size(
+                    job, max(free - reserved_free, 0)
+                )
+                # Whole gang in the holes, or an elastic resume
+                # (_grant_size only returns a partial grant for
+                # PREEMPTED jobs with a min_slices floor).
+                if grant <= 0:
+                    continue
+            else:
+                grant = self._grant_size(job, free)
+            if grant <= 0:
+                if head_blocked is None:
+                    # Quota before head-blocking: an over-quota job
+                    # is waiting on its OWN tenant's usage, not on
+                    # pool capacity — letting it become the blocked
+                    # head would starve same-band jobs of other
+                    # tenants behind a gang that may never be
+                    # quota-feasible.
+                    if not self.pool.within_quota(
+                        job.spec.tenant, job.spec.n_slices
+                    ):
+                        self._note_quota_denied_locked(job)
+                        continue
+                    head_blocked = job
+                    feasible = self._maybe_preempt_for_locked(
+                        job, actions
+                    )
+                    if feasible:
+                        # Every currently-free slice is part of the
+                        # head's incoming gang.
+                        reserved_free = free
+                continue
+            if not self.pool.within_quota(job.spec.tenant, grant):
+                self._note_quota_denied_locked(job)
+                # Over-quota jobs are skipped over — they keep their
+                # queue place but never block other tenants. They do
+                # not become the blocked head either: nothing about
+                # pool capacity blocks them, only their own quota.
+                continue
+            granted = self.pool.allocate(
+                job.spec.job_id, job.spec.tenant, grant
+            )
+            if granted is None:
+                if head_blocked is None:
+                    head_blocked = job
+                    self._maybe_preempt_for_locked(job, actions)
+                continue
+            actions.append(self._make_place_locked(job, granted,
+                                                   head_blocked))
+            free = self.pool.n_free()
+        return actions
+
+    def _note_quota_denied_locked(self, job: _Job) -> None:
+        job.reason = (
+            f"quota: tenant {job.spec.tenant!r} at cap "
+            f"{self.pool.quota_of(job.spec.tenant)}"
+        )
+        if not job.quota_logged:
+            job.quota_logged = True
+            tenant = job.spec.tenant
+            qd = self._counters["quota_denied"]
+            qd[tenant] = qd.get(tenant, 0) + 1
+            _QUOTA_DENIED.inc(tenant=tenant)
+            obs.event(
+                "pool.quota_denied", job_id=job.spec.job_id,
+                tenant=tenant,
+            )
+            logger.info(
+                "job %s queued over quota (%s)",
+                job.spec.job_id, job.reason,
+            )
+
+    def _make_place_locked(
+        self, job: _Job, granted: List[int],
+        head_blocked: Optional[_Job],
+    ) -> Callable[[], None]:
+        """Transition to PLACED under the lock; return the runtime
+        call for outside-lock execution."""
+        now_wall = time.time()
+        now_mono = time.monotonic()
+        resume = job.state == PoolJobState.PREEMPTED
+        backfilled = head_blocked is not None
+        job.state = PoolJobState.PLACED
+        job.slices = list(granted)
+        job.placed_mono = now_mono
+        job.reason = ""
+        job.quota_logged = False
+        wait_s = max(now_mono - job.wait_since_mono, 0.0)
+        band = job.band
+        self._wait_samples.setdefault(
+            band, deque(maxlen=WAIT_SAMPLES_PER_BAND)
+        ).append(wait_s)
+        _WAIT_SECONDS.observe(wait_s, band=band)
+        if not job.first_placed:
+            job.first_placed = True
+            _PLACEMENT_SECONDS.observe(wait_s)
+        self._counters["placements"] += 1
+        if backfilled:
+            self._counters["backfills"] += 1
+            _BACKFILLS.inc()
+        # Queue-wait span covers this wait interval; then the
+        # placement point span. On a resume, the span lands in the
+        # demanding job's incident trace too.
+        span_name = "pool.resume" if resume else "pool.place"
+        self._span(
+            job.trace_id, "pool.queue_wait", job.wait_since_wall,
+            dur=wait_s, subject=self._subject(job.spec.job_id),
+            job_id=job.spec.job_id, band=band,
+        )
+        self._span(
+            job.trace_id, span_name, now_wall,
+            subject=self._subject(job.spec.job_id),
+            job_id=job.spec.job_id,
+            slices=",".join(map(str, granted)),
+            elastic=resume and len(granted) < job.spec.n_slices,
+            backfill=backfilled,
+        )
+        if resume and job.preempt_trace:
+            self._span(
+                job.preempt_trace, "pool.resume", now_wall,
+                subject=self._subject(job.spec.job_id),
+                job_id=job.spec.job_id,
+                slices=",".join(map(str, granted)),
+                elastic=len(granted) < job.spec.n_slices,
+            )
+            job.preempt_trace = ""
+        obs.event(
+            "pool.place", job_id=job.spec.job_id,
+            slices=",".join(map(str, granted)), resume=resume,
+            backfill=backfilled, wait_s=round(wait_s, 3),
+        )
+        logger.info(
+            "%s job %s on slices %s (waited %.2fs%s)",
+            "resuming" if resume else "placing",
+            job.spec.job_id, granted, wait_s,
+            ", backfill" if backfilled else "",
+        )
+        runtime, slices = job.runtime, list(granted)
+        return lambda: runtime.place(slices, resume)
+
+    # -- preemption ---------------------------------------------------------
+
+    def _maybe_preempt_for_locked(
+        self, head: _Job, actions: List[Callable[[], None]]
+    ) -> bool:
+        """Evict the cheapest lower-priority victims so ``head``'s
+        gang becomes feasible. Returns True when the gang WILL fit
+        once in-flight/initiated parks confirm (the planner then
+        reserves the free holes for it); False when even evicting
+        every lower-priority job would not fit it — waiting on
+        completions is then the only option, and backfill into the
+        holes stays allowed (the head can preempt the backfilled job
+        once its gang turns feasible)."""
+        if not self.pool.within_quota(
+            head.spec.tenant, head.spec.n_slices
+        ):
+            self._note_quota_denied_locked(head)
+            return False
+        pending = sum(
+            len(j.slices)
+            for j in self._jobs.values()
+            if j.state == PoolJobState.PREEMPTING
+        )
+        shortfall = (
+            head.spec.n_slices - self.pool.n_free() - pending
+        )
+        if shortfall <= 0:
+            return True  # enough capacity already in flight
+        victims = sorted(
+            (
+                j for j in self._jobs.values()
+                if j.state == PoolJobState.PLACED
+                and j.spec.priority < head.spec.priority
+            ),
+            key=lambda j: (
+                j.spec.priority,
+                -(j.placed_mono or 0.0),  # youngest first
+            ),
+        )
+        chosen: List[_Job] = []
+        gain = 0
+        for v in victims:
+            if gain >= shortfall:
+                break
+            chosen.append(v)
+            gain += len(v.slices)
+        if gain < shortfall:
+            head.reason = (
+                f"waiting: needs {head.spec.n_slices}, "
+                f"{self.pool.n_free()} free, only {gain} "
+                "preemptible"
+            )
+            return False
+        head.reason = (
+            f"preempting {[v.spec.job_id for v in chosen]}"
+        )
+        for v in chosen:
+            self._start_park_locked(v, head, actions)
+        return True
+
+    def _start_park_locked(
+        self, victim: _Job, head: _Job,
+        actions: List[Callable[[], None]],
+    ) -> None:
+        victim.state = PoolJobState.PREEMPTING
+        victim.park_started_wall = time.time()
+        victim.preempt_trace = head.trace_id
+        obs.event(
+            "pool.preempt", job_id=victim.spec.job_id,
+            for_job=head.spec.job_id,
+            victim_priority=victim.spec.priority,
+            head_priority=head.spec.priority,
+            trace_id=head.trace_id,
+        )
+        logger.warning(
+            "preempting job %s (band %d) for job %s (band %d): "
+            "graceful park -> checkpoint -> release",
+            victim.spec.job_id, victim.spec.priority,
+            head.spec.job_id, head.spec.priority,
+        )
+        job_id = victim.spec.job_id
+        runtime = victim.runtime
+        deadline = time.monotonic() + self.park_timeout_s
+
+        def on_parked(info: Optional[dict] = None) -> None:
+            self._finish_park(job_id, info or {})
+
+        def park_action() -> None:
+            try:
+                runtime.park(on_parked)
+            except Exception:  # noqa: BLE001
+                logger.exception(
+                    "park() failed for %s; forcing release", job_id
+                )
+                # forced: park() raising means the workers never got
+                # their park actions — they are still running and
+                # need the hard stop the forced path orders.
+                self._finish_park(
+                    job_id,
+                    {"staged": False, "error": "park failed"},
+                    forced=True,
+                )
+                return
+            # Watchdog: a runtime that never confirms parks the whole
+            # queue — reclaim forcibly after the timeout.
+            timer = threading.Timer(
+                max(deadline - time.monotonic(), 0.0),
+                lambda: self._finish_park(
+                    job_id, {"staged": False, "error": "park timeout"},
+                    forced=True,
+                ),
+            )
+            timer.daemon = True
+            self._watch_park(job_id, timer)
+            timer.start()
+
+        actions.append(park_action)
+
+    def _watch_park(self, job_id: str, timer) -> None:
+        """Track the park watchdog so a prompt confirmation cancels
+        it (a synchronous on_parked already flipped the state)."""
+        with self._lock:
+            job = self._jobs.get(job_id)
+            if job is not None and job.state == PoolJobState.PREEMPTING:
+                job.reason = "parking"
+                self._park_timers[job_id] = timer
+            else:
+                timer.cancel()
+
+    def _finish_park(
+        self, job_id: str, info: dict, forced: bool = False
+    ) -> None:
+        """The victim's runtime confirmed the graceful park (or the
+        watchdog fired). Checkpoint staging is verified BEFORE the
+        slices go back to the pool — the ordering the drill asserts."""
+        with self._lock:
+            # Drop the watchdog entry FIRST: a confirmation arriving
+            # for a job that left PREEMPTING some other way (completed
+            # or cancelled mid-park) must still clean up its timer
+            # slot, or the dict grows one dead entry per such race.
+            timer = self._park_timers.pop(job_id, None)
+            if timer is not None:
+                timer.cancel()
+            job = self._jobs.get(job_id)
+            if job is None or job.state != PoolJobState.PREEMPTING:
+                return  # duplicate confirmation / already reclaimed
+            staged = bool(info.get("staged"))
+            # priority = clean graceful park; forced = the watchdog
+            # reclaimed, park() itself failed, or the runtime reports
+            # workers never parked (info["forced"]) — workers may
+            # still be running; unstaged = workers parked cleanly but
+            # the checkpoint never confirmed staging.
+            forced = forced or bool(info.get("forced"))
+            if forced:
+                reason = "forced"
+            elif staged:
+                reason = "priority"
+            else:
+                reason = "unstaged"
+            now_wall = time.time()
+            # Park span: covers park start -> checkpoint staged.
+            self._span(
+                job.preempt_trace, "pool.park",
+                job.park_started_wall,
+                dur=max(now_wall - job.park_started_wall, 0.0),
+                subject=self._subject(job_id), job_id=job_id,
+                staged=staged,
+                ckpt_path=str(info.get("path", "")),
+                ckpt_step=info.get("step", -1),
+            )
+            self._span(
+                job.trace_id, "pool.preempted", now_wall,
+                subject=self._subject(job_id), job_id=job_id,
+                staged=staged, reason=reason,
+                for_trace=job.preempt_trace,
+            )
+            released = self.pool.release(job_id)
+            job.slices = []
+            job.state = PoolJobState.PREEMPTED
+            job.preemptions += 1
+            job.wait_since_mono = time.monotonic()
+            job.wait_since_wall = now_wall
+            job.reason = "preempted; awaiting capacity"
+            self._span(
+                job.preempt_trace, "pool.release", now_wall,
+                subject=self._subject(job_id), job_id=job_id,
+                slices=",".join(map(str, released)),
+            )
+            pre = self._counters["preemptions"]
+            pre[reason] = pre.get(reason, 0) + 1
+            _PREEMPTIONS.inc(reason=reason)
+            self._update_gauges_locked()
+            runtime = job.runtime
+        obs.event(
+            "pool.parked", job_id=job_id, staged=staged,
+            forced=forced, released=len(released),
+        )
+        if reason != "priority":
+            # Anything but a clean graceful park: order a hard stop
+            # before the slices are reused. After a FORCED reclaim
+            # the victim's workers may still be running — they must
+            # not double-occupy the hardware or double-report into
+            # the ledger next to their own resume incarnation; after
+            # an UNSTAGED park the stop is a no-op (workers already
+            # exited) but costs nothing.
+            logger.error(
+                "job %s released %s (%s) — its resume will replay "
+                "from the shard ledger%s",
+                job_id, reason,
+                info.get("error", "no staging confirmation"),
+                "; ordering runtime stop before slice reuse"
+                if reason == "forced" else "",
+            )
+            try:
+                runtime.stop()
+            except Exception:  # noqa: BLE001 — the reclaim must
+                # proceed even when the wedged runtime can't be told
+                logger.exception("stop() failed for %s", job_id)
+        self.schedule_once()
+
+    # -- observability ------------------------------------------------------
+
+    def _update_gauges_locked(self) -> None:
+        by_band: Dict[str, int] = {}
+        by_state: Dict[str, int] = {}
+        for j in self._jobs.values():
+            by_state[j.state] = by_state.get(j.state, 0) + 1
+            if j.state in PoolJobState.WAITING:
+                by_band[j.band] = by_band.get(j.band, 0) + 1
+        for band in set(by_band) | set(self._wait_samples):
+            _QUEUE_DEPTH.set(by_band.get(band, 0), band=band)
+        for state in (
+            PoolJobState.QUEUED, PoolJobState.PLACED,
+            PoolJobState.PREEMPTING, PoolJobState.PREEMPTED,
+            PoolJobState.DONE, PoolJobState.FAILED,
+        ):
+            _JOBS.set(by_state.get(state, 0), state=state)
+
+    def job_info(self, job_id: str) -> Optional[dict]:
+        with self._lock:
+            job = self._jobs.get(job_id)
+            if job is None:
+                return None
+            return self._job_info_locked(job)
+
+    def _job_info_locked(self, job: _Job) -> dict:
+        return {
+            "job_id": job.spec.job_id,
+            "tenant": job.spec.tenant,
+            "priority": job.spec.priority,
+            "queue": job.spec.queue,
+            "n_slices": job.spec.n_slices,
+            "min_slices": job.spec.min_slices,
+            "state": job.state,
+            "slices": list(job.slices),
+            "preemptions": job.preemptions,
+            "trace_id": job.trace_id,
+            "reason": job.reason,
+            "submitted_ts": job.submit_wall,
+            "waiting_s": (
+                round(time.monotonic() - job.wait_since_mono, 3)
+                if job.state in PoolJobState.WAITING
+                else 0.0
+            ),
+        }
+
+    def snapshot(self) -> dict:
+        """The obs_report --pool feed: queue depth per band, tenant
+        quota usage, slice utilization, preemption counters, and
+        wait-time percentiles per band."""
+        from dlrover_tpu.obs.timeseries import _percentile
+
+        with self._lock:
+            jobs = {
+                jid: self._job_info_locked(j)
+                for jid, j in self._jobs.items()
+            }
+            queue_depth: Dict[str, int] = {}
+            queue_order = [
+                j.spec.job_id for j in self._waiting_locked()
+            ]
+            for j in self._jobs.values():
+                if j.state in PoolJobState.WAITING:
+                    queue_depth[j.band] = (
+                        queue_depth.get(j.band, 0) + 1
+                    )
+            waits = {
+                band: sorted(samples)
+                for band, samples in self._wait_samples.items()
+                if samples
+            }
+            counters = {
+                "submitted": self._counters["submitted"],
+                "placements": self._counters["placements"],
+                "backfills": self._counters["backfills"],
+                "completions": self._counters["completions"],
+                "preemptions": dict(self._counters["preemptions"]),
+                "quota_denied": dict(self._counters["quota_denied"]),
+            }
+        pool_snap = self.pool.snapshot()
+        return {
+            "slices": pool_snap,
+            "utilization": (
+                1.0
+                - len(pool_snap["free_slices"])
+                / max(pool_snap["total_slices"], 1)
+            ),
+            "jobs": jobs,
+            "queue_depth": queue_depth,
+            "queue_order": queue_order,
+            "counters": counters,
+            "wait_seconds": {
+                band: {
+                    "count": len(s),
+                    "p50": round(_percentile(s, 50.0), 4),
+                    "p90": round(_percentile(s, 90.0), 4),
+                    "p99": round(_percentile(s, 99.0), 4),
+                }
+                for band, s in waits.items()
+            },
+        }
+
+
+def render_pool(snapshot: dict) -> str:
+    """Human rendering of a PoolScheduler snapshot — the body of
+    ``obs_report --pool``."""
+    lines = []
+    slices = snapshot.get("slices", {})
+    total = slices.get("total_slices", 0)
+    free = len(slices.get("free_slices", []))
+    util = snapshot.get("utilization", 0.0)
+    lines.append(
+        f"pool: {total} slice(s), {free} free "
+        f"(utilization {util * 100:.0f}%)"
+    )
+    depth = snapshot.get("queue_depth", {})
+    if depth:
+        by_band = "  ".join(
+            f"band {b}: {n}"
+            for b, n in sorted(
+                depth.items(), key=lambda kv: -int(kv[0])
+            )
+        )
+        order = snapshot.get("queue_order", [])
+        lines.append(
+            f"queue depth: {sum(depth.values())} ({by_band})"
+            + (f"; order: {', '.join(order)}" if order else "")
+        )
+    else:
+        lines.append("queue depth: 0")
+    tenants = slices.get("tenants", {})
+    if tenants:
+        lines.append("tenants:")
+        for tenant in sorted(tenants):
+            t = tenants[tenant]
+            quota = t.get("quota")
+            lines.append(
+                f"  {tenant}: {t.get('used', 0)}/"
+                f"{quota if quota is not None else 'unlimited'} "
+                "slice(s)"
+            )
+    jobs = snapshot.get("jobs", {})
+    if jobs:
+        lines.append("jobs:")
+        for jid in sorted(
+            jobs, key=lambda j: (-jobs[j]["priority"], j)
+        ):
+            j = jobs[jid]
+            extra = []
+            if j.get("slices"):
+                extra.append(
+                    "slices "
+                    + ",".join(map(str, j["slices"]))
+                )
+            if j.get("preemptions"):
+                extra.append(f"preempted x{j['preemptions']}")
+            if j.get("reason"):
+                extra.append(j["reason"])
+            lines.append(
+                f"  {jid}  tenant={j['tenant']}  "
+                f"band={j['priority']}  {j['state']}"
+                + ("  " + "; ".join(extra) if extra else "")
+            )
+    c = snapshot.get("counters", {})
+    lines.append(
+        f"counters: submitted {c.get('submitted', 0)}, placements "
+        f"{c.get('placements', 0)}, backfills "
+        f"{c.get('backfills', 0)}, completions "
+        f"{c.get('completions', 0)}"
+    )
+    pre = c.get("preemptions", {})
+    lines.append(
+        "preemptions: "
+        + (
+            ", ".join(
+                f"{r}={n}" for r, n in sorted(pre.items())
+            )
+            if pre
+            else "none"
+        )
+    )
+    qd = c.get("quota_denied", {})
+    if qd:
+        lines.append(
+            "quota-denied: "
+            + ", ".join(f"{t}={n}" for t, n in sorted(qd.items()))
+        )
+    waits = snapshot.get("wait_seconds", {})
+    for band in sorted(waits, key=int, reverse=True):
+        w = waits[band]
+        lines.append(
+            f"wait band {band}: p50 {w['p50']:.3f}s  "
+            f"p90 {w['p90']:.3f}s  p99 {w['p99']:.3f}s  "
+            f"(n={w['count']})"
+        )
+    return "\n".join(lines)

@@ -1,0 +1,232 @@
+"""Slice inventory + per-tenant quota accounting for the pool master.
+
+The pool's unit of scheduling is a TPU *slice* (a rectangular ICI
+domain: its hosts train together or not at all — the same invariant
+``node_unit`` enforces inside one job's rendezvous, lifted to the
+cluster level). A :class:`SlicePool` owns a fixed inventory of
+slices, hands them to jobs **atomically** (a gang allocation either
+gets every requested slice or nothing — no partial holds that could
+deadlock two half-placed gangs against each other), and enforces
+per-tenant quotas at allocation time.
+
+Quota semantics: a tenant's quota caps its *placed* slices, never its
+queue — an over-quota submission waits in the scheduler's queue (and
+is skipped over, so it cannot starve other tenants) until the
+tenant's own usage drops.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import threading
+from typing import Dict, List, Optional, Sequence, Union
+
+from dlrover_tpu import obs
+from dlrover_tpu.common.log import get_logger
+
+logger = get_logger("slice_pool")
+
+_SLICES = obs.gauge(
+    "dlrover_pool_slices",
+    "Slices in the pool by state (free / allocated)",
+    ("state",),
+)
+_TENANT_SLICES = obs.gauge(
+    "dlrover_pool_tenant_slices",
+    "Slices currently allocated to each tenant's placed jobs",
+    ("tenant",),
+)
+
+
+@dataclasses.dataclass(frozen=True)
+class SliceSpec:
+    """One schedulable TPU slice of the pool's inventory."""
+
+    slice_id: int
+    accelerator: str = "tpu"
+    hosts: int = 1
+    chips_per_host: int = 4
+
+    @property
+    def chips(self) -> int:
+        return self.hosts * self.chips_per_host
+
+    def to_dict(self) -> dict:
+        return dataclasses.asdict(self)
+
+
+class SlicePool:
+    """Thread-safe slice allocator with per-tenant quotas.
+
+    ``slices`` is either an explicit inventory of :class:`SliceSpec`
+    or an int (that many identical single-host slices — the hermetic
+    drill/test shape). ``tenant_quotas`` maps tenant -> max placed
+    slices; tenants absent from the map get ``default_quota``
+    (None = unlimited).
+    """
+
+    def __init__(
+        self,
+        slices: Union[int, Sequence[SliceSpec]],
+        tenant_quotas: Optional[Dict[str, int]] = None,
+        default_quota: Optional[int] = None,
+    ):
+        if isinstance(slices, int):
+            slices = [SliceSpec(slice_id=i) for i in range(slices)]
+        self._slices: Dict[int, SliceSpec] = {
+            s.slice_id: s for s in slices
+        }
+        if len(self._slices) != len(list(slices)):
+            raise ValueError("duplicate slice_id in pool inventory")
+        self._quotas = dict(tenant_quotas or {})
+        self._default_quota = default_quota
+        self._lock = threading.Lock()
+        self._free: List[int] = sorted(self._slices)
+        self._owner: Dict[int, str] = {}  # slice_id -> job_id
+        self._job_slices: Dict[str, List[int]] = {}
+        self._job_tenant: Dict[str, str] = {}
+        # Every tenant that ever held a slice: a tenant whose usage
+        # drops to zero must have its gauge SET to 0, not silently
+        # stop being written (a stale series would report phantom
+        # usage forever).
+        self._gauge_tenants: set = set()
+        self._update_gauges_locked()
+
+    # -- inventory ----------------------------------------------------------
+
+    @property
+    def n_slices(self) -> int:
+        return len(self._slices)
+
+    def n_free(self) -> int:
+        with self._lock:
+            return len(self._free)
+
+    def spec(self, slice_id: int) -> SliceSpec:
+        return self._slices[slice_id]
+
+    def specs(self) -> List[SliceSpec]:
+        """The whole inventory, slice_id-ordered."""
+        return [self._slices[sid] for sid in sorted(self._slices)]
+
+    def slices_of(self, job_id: str) -> List[int]:
+        with self._lock:
+            return list(self._job_slices.get(job_id, ()))
+
+    # -- quota --------------------------------------------------------------
+
+    def quota_of(self, tenant: str) -> Optional[int]:
+        return self._quotas.get(tenant, self._default_quota)
+
+    def tenant_usage(self) -> Dict[str, int]:
+        with self._lock:
+            return self._tenant_usage_locked()
+
+    def _tenant_usage_locked(self) -> Dict[str, int]:
+        usage: Dict[str, int] = {}
+        for job_id, sl in self._job_slices.items():
+            tenant = self._job_tenant.get(job_id, "default")
+            usage[tenant] = usage.get(tenant, 0) + len(sl)
+        return usage
+
+    def within_quota(self, tenant: str, n: int) -> bool:
+        """Would placing ``n`` more slices keep ``tenant`` within its
+        quota?"""
+        quota = self.quota_of(tenant)
+        if quota is None:
+            return True
+        with self._lock:
+            used = self._tenant_usage_locked().get(tenant, 0)
+        return used + n <= quota
+
+    # -- allocation ---------------------------------------------------------
+
+    def allocate(
+        self, job_id: str, tenant: str, n: int
+    ) -> Optional[List[int]]:
+        """Atomically allocate ``n`` slices to ``job_id``. Returns
+        the slice ids, or None when the pool cannot satisfy the whole
+        gang (insufficient free slices, over quota, or the job
+        already holds an allocation) — never a partial grant."""
+        if n <= 0:
+            return None
+        quota = self.quota_of(tenant)
+        with self._lock:
+            if job_id in self._job_slices:
+                logger.warning(
+                    "job %s already holds %s; refusing re-allocation",
+                    job_id, self._job_slices[job_id],
+                )
+                return None
+            if len(self._free) < n:
+                return None
+            if quota is not None:
+                used = self._tenant_usage_locked().get(tenant, 0)
+                if used + n > quota:
+                    return None
+            granted = self._free[:n]
+            self._free = self._free[n:]
+            for sid in granted:
+                self._owner[sid] = job_id
+            self._job_slices[job_id] = granted
+            self._job_tenant[job_id] = tenant
+            self._update_gauges_locked()
+        obs.event(
+            "pool.allocate", job_id=job_id, tenant=tenant,
+            slices=",".join(map(str, granted)),
+        )
+        return list(granted)
+
+    def release(self, job_id: str) -> List[int]:
+        """Return every slice ``job_id`` holds to the free set.
+        Idempotent (an unknown/already-released job releases [])."""
+        with self._lock:
+            granted = self._job_slices.pop(job_id, [])
+            self._job_tenant.pop(job_id, None)
+            for sid in granted:
+                self._owner.pop(sid, None)
+            self._free = sorted(self._free + list(granted))
+            self._update_gauges_locked()
+        if granted:
+            obs.event(
+                "pool.release", job_id=job_id,
+                slices=",".join(map(str, granted)),
+            )
+        return list(granted)
+
+    # -- observability ------------------------------------------------------
+
+    def _update_gauges_locked(self) -> None:
+        _SLICES.set(len(self._free), state="free")
+        _SLICES.set(len(self._owner), state="allocated")
+        usage = self._tenant_usage_locked()
+        self._gauge_tenants |= set(usage)
+        for tenant in self._gauge_tenants:
+            _TENANT_SLICES.set(usage.get(tenant, 0), tenant=tenant)
+
+    def snapshot(self) -> dict:
+        with self._lock:
+            usage = self._tenant_usage_locked()
+            return {
+                "total_slices": len(self._slices),
+                "free_slices": list(self._free),
+                "allocated": {
+                    job: list(sl)
+                    for job, sl in self._job_slices.items()
+                },
+                "tenants": {
+                    tenant: {
+                        "used": usage.get(tenant, 0),
+                        "quota": self.quota_of(tenant),
+                    }
+                    for tenant in sorted(
+                        set(usage)
+                        | set(self._quotas)
+                        | set(self._job_tenant.values())
+                    )
+                },
+                "slices": {
+                    str(sid): self._slices[sid].to_dict()
+                    for sid in sorted(self._slices)
+                },
+            }

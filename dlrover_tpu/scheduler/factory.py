@@ -339,6 +339,40 @@ def _pod_manifest(spec: dict, namespace: str) -> dict:
     }
 
 
+def slice_inventory(
+    platform_name: str = "local",
+    n_slices: int = 4,
+    hosts_per_slice: int = 1,
+    chips_per_host: int = 4,
+    accelerator: str = "tpu",
+):
+    """Slice inventory for a :class:`~dlrover_tpu.pool.SlicePool`.
+
+    ``local`` synthesizes ``n_slices`` identical slices (tests,
+    drills, single-host pools). Cluster platforms cannot be probed
+    from this environment (no k8s/ray SDK): pass an explicit
+    ``SliceSpec`` list to the pool instead, built from your node-pool
+    labels (``dlrover-tpu/slice`` — the same label the scaler pins
+    replacements with)."""
+    from dlrover_tpu.pool.slice_pool import SliceSpec
+
+    if platform_name != "local":
+        raise RuntimeError(
+            f"platform {platform_name!r} slice discovery needs the "
+            "cluster SDK; build the SliceSpec inventory explicitly "
+            "from your node pools and pass it to SlicePool"
+        )
+    return [
+        SliceSpec(
+            slice_id=i,
+            accelerator=accelerator,
+            hosts=hosts_per_slice,
+            chips_per_host=chips_per_host,
+        )
+        for i in range(n_slices)
+    ]
+
+
 def elasticjob_manifest(
     job_name: str,
     namespace: str = "default",
@@ -350,16 +384,27 @@ def elasticjob_manifest(
     enable_elastic_scheduling: bool = True,
     enable_dynamic_sharding: bool = True,
     envs: Optional[dict] = None,
+    priority: Optional[int] = None,
+    tenant: str = "",
+    queue: str = "",
 ) -> dict:
     """ElasticJob CRD manifest — field-for-field the reference's
     ElasticJobSpec (go/operator/api/v1alpha1/elasticjob_types.go:29-67:
     distributionStrategy, resourceLimits, optimizeMode, brainService,
     enableElasticScheduling, enableDynamicSharding, replicaSpecs,
-    envs)."""
+    envs) plus the pool-scheduler fields (``priority`` band 0-9,
+    ``tenant`` quota account, ``queue``) mapped onto
+    PoolSubmitRequest by the operator (deploy/README.md)."""
     spec: dict = {
         "distributionStrategy": distribution_strategy,
         "replicaSpecs": replica_specs or {},
     }
+    if priority is not None:
+        spec["priority"] = int(priority)
+    if tenant:
+        spec["tenant"] = tenant
+    if queue:
+        spec["queue"] = queue
     if resource_limits:
         spec["resourceLimits"] = {
             k: str(v) for k, v in resource_limits.items()

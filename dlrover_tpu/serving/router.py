@@ -213,6 +213,11 @@ class ServingRouter:
         self._done_stamps: deque = deque(maxlen=4096)
         self._requeued_total = 0
         self._last_scale_ts = 0.0
+        # Once-per-blocked-transition damper for grant-withheld
+        # scale-ups (the blocked branch deliberately does not burn
+        # the scale cooldown, so without this every evaluation tick
+        # under sustained pressure would re-log and re-emit).
+        self._grant_block_logged = False
         # Bounded finished-record retention (eviction order) +
         # cumulative outcome counters that survive eviction.
         self._finished: deque = deque()
@@ -899,6 +904,32 @@ class ServingRouter:
             # cordoned ones included), so a ready-count target would
             # silently no-op exactly when a drain halved capacity.
             target = max(total + 1, min_n)
+            # Under a pool master the serving plane is a per-job
+            # consumer of its pool GRANT: with no headroom the scale
+            # intent is withheld (no cooldown burned) so the next
+            # evaluation retries the moment the grant grows, instead
+            # of burning the cooldown on a capped no-op.
+            # getattr: embedded test doubles predate the pool seam.
+            headroom_fn = getattr(
+                self.job_manager, "grant_headroom", None
+            )
+            headroom = headroom_fn() if headroom_fn else None
+            if headroom is not None and headroom <= 0:
+                if not self._grant_block_logged:
+                    self._grant_block_logged = True
+                    obs.event(
+                        "serve.scale_blocked_by_grant",
+                        target=target,
+                        grant=self.job_manager.pool_grant,
+                        queue_depth=queue_depth,
+                    )
+                    logger.warning(
+                        "serving scale-up to %d withheld: pool "
+                        "grant %s has no headroom", target,
+                        self.job_manager.pool_grant,
+                    )
+                return None
+            self._grant_block_logged = False
             self.job_manager.ensure_role(NodeType.REPLICA, target)
             self._last_scale_ts = now
             obs.event(

@@ -540,6 +540,39 @@ class TestMetricNameHygiene:
                 problems[name] = (got, want)
         assert not problems, problems
 
+    def test_pool_plane_metrics_are_audited(self):
+        """The multi-job pool plane's dlrover_pool_* registrations
+        (dlrover_tpu/pool/) must be visible to the walker with the
+        contract names/types/labels — the obs_report --pool and
+        docs/MULTI_JOB.md dashboard surface keys on them."""
+        sites = {
+            name: (mtype, labels)
+            for _, _, mtype, name, _, labels in self._call_sites()
+        }
+        expected = {
+            "dlrover_pool_slices": ("gauge", ["state"]),
+            "dlrover_pool_tenant_slices": ("gauge", ["tenant"]),
+            "dlrover_pool_queue_depth": ("gauge", ["band"]),
+            "dlrover_pool_jobs": ("gauge", ["state"]),
+            "dlrover_pool_placement_seconds": ("histogram", None),
+            "dlrover_pool_wait_seconds": ("histogram", ["band"]),
+            "dlrover_pool_preemptions_total": (
+                "counter", ["reason"],
+            ),
+            "dlrover_pool_quota_denied_total": (
+                "counter", ["tenant"],
+            ),
+            "dlrover_pool_backfills_total": ("counter", None),
+        }
+        problems = {}
+        for name, want in expected.items():
+            got = sites.get(name)
+            if got is None or got[0] != want[0] or (
+                want[1] is not None and got[1] != want[1]
+            ):
+                problems[name] = (got, want)
+        assert not problems, problems
+
 
 class TestSpanNameHygiene:
     """Audit every literal ``obs.span(...)`` / ``obs.event(...)``
@@ -559,6 +592,7 @@ class TestSpanNameHygiene:
         os.path.join("dlrover_tpu", "master", "rendezvous.py"): (
             "rdzv.",
         ),
+        os.path.join("dlrover_tpu", "pool"): ("pool.",),
     }
 
     def _call_sites(self):

@@ -1,0 +1,623 @@
+"""Multi-job pool control plane: slice allocator, gang scheduler,
+preemption engine, job-routed RPC envelope, and the hermetic drill.
+
+The drill (tools/pool_drill.py --selftest) is the acceptance test:
+a 4-slice fake pool runs a low-priority job, a high-priority gang
+that doesn't fit preempts it through the graceful checkpoint path,
+and the preempted job resumes elastically with exactly-once shard
+accounting. The unit tests here pin the scheduler invariants the
+drill only samples one path through.
+"""
+
+import os
+import subprocess
+import sys
+import threading
+import time
+
+import pytest
+
+from dlrover_tpu.pool import (
+    JobRuntime,
+    PoolJobSpec,
+    PoolJobState,
+    PoolScheduler,
+    SlicePool,
+    SliceSpec,
+)
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+class FakeRT(JobRuntime):
+    """Synchronous runtime: parks confirm immediately (staged unless
+    told otherwise)."""
+
+    def __init__(self, staged: bool = True, defer_park: bool = False):
+        self.placements = []
+        self.parks = 0
+        self.stops = 0
+        self.staged = staged
+        self.defer_park = defer_park
+        self._pending_park = None
+
+    def place(self, slices, resume):
+        self.placements.append((list(slices), resume))
+
+    def park(self, on_parked):
+        self.parks += 1
+        if self.defer_park:
+            self._pending_park = on_parked
+        else:
+            on_parked({"staged": self.staged, "path": "/ck",
+                       "step": 1})
+
+    def confirm_park(self):
+        cb, self._pending_park = self._pending_park, None
+        cb({"staged": self.staged, "path": "/ck", "step": 1})
+
+    def stop(self):
+        self.stops += 1
+
+
+class TestSlicePool:
+    def test_gang_allocation_is_atomic(self):
+        pool = SlicePool(4)
+        assert pool.allocate("a", "t", 3) == [0, 1, 2]
+        # 2 > 1 free: nothing granted, free set untouched.
+        assert pool.allocate("b", "t", 2) is None
+        assert pool.n_free() == 1
+        assert pool.release("a") == [0, 1, 2]
+        assert pool.n_free() == 4
+        # Idempotent release.
+        assert pool.release("a") == []
+
+    def test_double_allocation_refused(self):
+        pool = SlicePool(4)
+        assert pool.allocate("a", "t", 1) == [0]
+        assert pool.allocate("a", "t", 1) is None
+
+    def test_quota_enforced_at_allocation(self):
+        pool = SlicePool(4, tenant_quotas={"research": 2})
+        assert pool.allocate("a", "research", 2) is not None
+        assert pool.allocate("b", "research", 1) is None
+        assert pool.allocate("c", "prod", 2) is not None
+        pool.release("a")
+        assert pool.allocate("b", "research", 1) is not None
+
+    def test_inventory_specs(self):
+        pool = SlicePool(
+            [SliceSpec(slice_id=7, hosts=2, chips_per_host=4)]
+        )
+        assert pool.spec(7).chips == 8
+        with pytest.raises(ValueError):
+            SlicePool([SliceSpec(0), SliceSpec(0)])
+
+    def test_snapshot_shape(self):
+        pool = SlicePool(2, tenant_quotas={"t": 1})
+        pool.allocate("a", "t", 1)
+        snap = pool.snapshot()
+        assert snap["total_slices"] == 2
+        assert snap["free_slices"] == [1]
+        assert snap["tenants"]["t"] == {"used": 1, "quota": 1}
+
+
+class TestGangScheduler:
+    def _sched(self, n=4, quotas=None):
+        return PoolScheduler(
+            SlicePool(n, tenant_quotas=quotas), park_timeout_s=5.0
+        )
+
+    def test_fifo_within_band(self):
+        sched = self._sched(4)
+        a, b = FakeRT(), FakeRT()
+        sched.submit(PoolJobSpec(job_id="a", priority=2,
+                                 n_slices=3), a)
+        sched.submit(PoolJobSpec(job_id="b", priority=2,
+                                 n_slices=3), b)
+        # c would fit in the free slice RIGHT NOW, but it must not
+        # jump the same-band head b (FIFO within a band).
+        c = FakeRT()
+        sched.submit(PoolJobSpec(job_id="c", priority=2,
+                                 n_slices=1), c)
+        assert sched.job_info("a")["state"] == PoolJobState.PLACED
+        assert sched.job_info("b")["state"] == PoolJobState.QUEUED
+        assert sched.job_info("c")["state"] == PoolJobState.QUEUED
+        sched.complete("a")
+        # Head first; c then takes the remaining capacity in order.
+        assert sched.job_info("b")["state"] == PoolJobState.PLACED
+        assert sched.job_info("c")["state"] == PoolJobState.PLACED
+
+    def test_backfill_lower_priority_into_holes(self):
+        sched = self._sched(4)
+        big, small = FakeRT(), FakeRT()
+        sched.submit(PoolJobSpec(job_id="running", priority=3,
+                                 n_slices=2), FakeRT())
+        sched.submit(PoolJobSpec(job_id="big", priority=3,
+                                 n_slices=4), big)
+        # big blocked (head, cannot preempt same band); a STRICTLY
+        # lower-priority small job takes the hole.
+        sched.submit(PoolJobSpec(job_id="small", priority=1,
+                                 n_slices=2), small)
+        assert sched.job_info("big")["state"] == PoolJobState.QUEUED
+        assert (
+            sched.job_info("small")["state"] == PoolJobState.PLACED
+        )
+        assert sched.snapshot()["counters"]["backfills"] == 1
+
+    def test_preemption_youngest_lowest_band_first(self):
+        sched = self._sched(4)
+        old, young, mid = FakeRT(), FakeRT(), FakeRT()
+        sched.submit(PoolJobSpec(job_id="old", priority=1,
+                                 n_slices=1), old)
+        time.sleep(0.01)
+        sched.submit(PoolJobSpec(job_id="young", priority=1,
+                                 n_slices=1), young)
+        sched.submit(PoolJobSpec(job_id="mid", priority=3,
+                                 n_slices=2), mid)
+        # Needs 2, 0 free: evict from band 1 only, youngest first.
+        sched.submit(
+            PoolJobSpec(job_id="hi", priority=5, n_slices=2),
+            FakeRT(),
+        )
+        assert sched.job_info("hi")["state"] == PoolJobState.PLACED
+        assert (
+            sched.job_info("young")["state"]
+            == PoolJobState.PREEMPTED
+        )
+        assert (
+            sched.job_info("old")["state"] == PoolJobState.PREEMPTED
+        )
+        # Band 3 was never touched: lower bands covered the need.
+        assert sched.job_info("mid")["state"] == PoolJobState.PLACED
+        assert mid.parks == 0
+
+    def test_no_partial_hold_while_preempting(self):
+        """The demanding gang holds ZERO slices until the whole gang
+        fits — freed capacity stays in the pool, not half-granted."""
+        sched = self._sched(4)
+        v1, v2 = FakeRT(defer_park=True), FakeRT(defer_park=True)
+        sched.submit(PoolJobSpec(job_id="v1", priority=1,
+                                 n_slices=2), v1)
+        sched.submit(PoolJobSpec(job_id="v2", priority=1,
+                                 n_slices=2), v2)
+        hi = FakeRT()
+        sched.submit(PoolJobSpec(job_id="hi", priority=5,
+                                 n_slices=4), hi)
+        # Both victims parking; one confirms — hi must STILL hold
+        # nothing (2 free < 4).
+        v1.confirm_park()
+        assert sched.job_info("hi")["state"] == PoolJobState.QUEUED
+        assert sched.job_info("hi")["slices"] == []
+        assert sched.pool.n_free() == 2
+        v2.confirm_park()
+        assert sched.job_info("hi")["state"] == PoolJobState.PLACED
+        assert len(sched.job_info("hi")["slices"]) == 4
+
+    def test_checkpoint_staged_before_release(self):
+        """Release strictly follows the park confirmation: while the
+        victim's checkpoint is in flight its slices stay owned."""
+        sched = self._sched(2)
+        victim = FakeRT(defer_park=True)
+        sched.submit(PoolJobSpec(job_id="victim", priority=1,
+                                 n_slices=2), victim)
+        sched.submit(
+            PoolJobSpec(job_id="hi", priority=5, n_slices=2),
+            FakeRT(),
+        )
+        assert victim.parks == 1
+        assert sched.pool.slices_of("victim") == [0, 1]
+        assert sched.pool.n_free() == 0
+        victim.confirm_park()
+        assert sched.pool.slices_of("victim") == []
+        assert sched.job_info("hi")["state"] == PoolJobState.PLACED
+        snap = sched.snapshot()
+        assert snap["counters"]["preemptions"] == {"priority": 1}
+
+    def test_unstaged_release_counts_separately_and_stops(self):
+        """Workers parked cleanly but the checkpoint never confirmed
+        staging: distinct reason, and the runtime gets a (no-op on a
+        parked job) stop so a half-parked one can't linger."""
+        sched = self._sched(2)
+        victim = FakeRT(staged=False)
+        sched.submit(PoolJobSpec(job_id="victim", priority=1,
+                                 n_slices=2), victim)
+        sched.submit(
+            PoolJobSpec(job_id="hi", priority=5, n_slices=2),
+            FakeRT(),
+        )
+        snap = sched.snapshot()
+        assert snap["counters"]["preemptions"] == {"unstaged": 1}
+        assert victim.stops == 1
+
+    def test_park_timeout_watchdog_reclaims(self):
+        sched = PoolScheduler(SlicePool(2), park_timeout_s=0.2)
+
+        class NeverParks(JobRuntime):
+            def place(self, slices, resume):
+                pass
+
+            def park(self, on_parked):
+                pass  # never confirms
+
+            def stop(self):
+                pass
+
+        sched.submit(PoolJobSpec(job_id="wedge", priority=1,
+                                 n_slices=2), NeverParks())
+        sched.submit(
+            PoolJobSpec(job_id="hi", priority=5, n_slices=2),
+            FakeRT(),
+        )
+        deadline = time.monotonic() + 5.0
+        while time.monotonic() < deadline:
+            if sched.job_info("hi")["state"] == PoolJobState.PLACED:
+                break
+            time.sleep(0.05)
+        assert sched.job_info("hi")["state"] == PoolJobState.PLACED
+        assert (
+            sched.snapshot()["counters"]["preemptions"]
+            == {"forced": 1}
+        )
+
+    def test_forced_reclaim_orders_runtime_stop(self):
+        """A park-timeout reclaim must hard-stop the wedged victim
+        before its slices are reused — no double occupancy."""
+        sched = PoolScheduler(SlicePool(2), park_timeout_s=0.2)
+        stops = []
+
+        class Wedged(JobRuntime):
+            def place(self, slices, resume):
+                pass
+
+            def park(self, on_parked):
+                pass  # never confirms
+
+            def stop(self):
+                stops.append(1)
+
+        sched.submit(PoolJobSpec(job_id="wedge", priority=1,
+                                 n_slices=2), Wedged())
+        sched.submit(
+            PoolJobSpec(job_id="hi", priority=5, n_slices=2),
+            FakeRT(),
+        )
+        deadline = time.monotonic() + 5.0
+        while time.monotonic() < deadline and not stops:
+            time.sleep(0.05)
+        assert stops, "forced reclaim never stopped the runtime"
+
+    def test_over_quota_over_capacity_head_does_not_starve(self):
+        """An over-quota job whose gang ALSO exceeds free capacity
+        must not become the blocked head: same-band jobs of other
+        tenants keep placing (the quota non-starvation invariant)."""
+        sched = self._sched(4, quotas={"research": 2})
+        sched.submit(
+            PoolJobSpec(job_id="p0", tenant="prod", priority=1,
+                        n_slices=2),
+            FakeRT(),
+        )
+        # Over quota (3 > 2) AND over the 2 free slices, higher band.
+        sched.submit(
+            PoolJobSpec(job_id="rx", tenant="research", priority=3,
+                        n_slices=3),
+            FakeRT(),
+        )
+        p1 = FakeRT()
+        sched.submit(
+            PoolJobSpec(job_id="p1", tenant="prod", priority=3,
+                        n_slices=2),
+            p1,
+        )
+        assert sched.job_info("p1")["state"] == PoolJobState.PLACED
+        assert sched.job_info("rx")["state"] == PoolJobState.QUEUED
+        assert "quota" in sched.job_info("rx")["reason"]
+
+    def test_terminal_records_ring_bounded(self):
+        from dlrover_tpu.pool import scheduler as sched_mod
+
+        sched = self._sched(4)
+        evicted = []
+        sched.on_job_evicted = evicted.append
+        old_cap = sched_mod.MAX_TERMINAL_JOBS
+        sched_mod.MAX_TERMINAL_JOBS = 3
+        try:
+            for i in range(6):
+                jid = f"j{i}"
+                sched.submit(
+                    PoolJobSpec(job_id=jid, n_slices=1), FakeRT()
+                )
+                sched.complete(jid)
+            assert evicted == ["j0", "j1", "j2"]
+            assert sched.job_info("j0") is None
+            assert sched.job_info("j5") is not None
+        finally:
+            sched_mod.MAX_TERMINAL_JOBS = old_cap
+
+    def test_elastic_resume_with_fewer_slices(self):
+        sched = self._sched(4)
+        low = FakeRT()
+        sched.submit(
+            PoolJobSpec(job_id="low", priority=1, n_slices=3,
+                        min_slices=1),
+            low,
+        )
+        sched.submit(
+            PoolJobSpec(job_id="hi", priority=5, n_slices=4),
+            FakeRT(),
+        )
+        assert (
+            sched.job_info("low")["state"] == PoolJobState.PREEMPTED
+        )
+        # Capacity returns only partially: a band-3 job takes 2.
+        sched.complete("hi")
+        # low resumed (elastically or fully depending on ordering);
+        # with 4 free it gets its full gang back first...
+        assert sched.job_info("low")["state"] == PoolJobState.PLACED
+        assert len(sched.job_info("low")["slices"]) == 3
+        # ...but after a second preemption with only 2 free, the
+        # resume is elastic.
+        sched.submit(
+            PoolJobSpec(job_id="hi2", priority=5, n_slices=4),
+            FakeRT(),
+        )
+        sched.submit(
+            PoolJobSpec(job_id="mid", priority=3, n_slices=2),
+            FakeRT(),
+        )
+        sched.complete("hi2")
+        info = sched.job_info("low")
+        assert info["state"] == PoolJobState.PLACED
+        assert len(info["slices"]) == 2  # < gang of 3, >= min 1
+        assert low.placements[-1][1] is True  # resume flag
+
+    def test_quota_denied_head_never_starves_others(self):
+        sched = self._sched(4, quotas={"research": 2})
+        sched.submit(
+            PoolJobSpec(job_id="r1", tenant="research",
+                        priority=3, n_slices=2),
+            FakeRT(),
+        )
+        # Same tenant over quota, HIGHER priority than everything
+        # else waiting — still must not block other tenants.
+        sched.submit(
+            PoolJobSpec(job_id="r2", tenant="research",
+                        priority=5, n_slices=2),
+            FakeRT(),
+        )
+        other = FakeRT()
+        sched.submit(
+            PoolJobSpec(job_id="p1", tenant="prod", priority=1,
+                        n_slices=2),
+            other,
+        )
+        assert (
+            sched.job_info("r2")["state"] == PoolJobState.QUEUED
+        )
+        assert "quota" in sched.job_info("r2")["reason"]
+        assert sched.job_info("p1")["state"] == PoolJobState.PLACED
+        snap = sched.snapshot()
+        assert snap["counters"]["quota_denied"] == {"research": 1}
+        # Quota frees -> the queued job places without resubmission.
+        sched.complete("r1")
+        sched.complete("p1")
+        assert sched.job_info("r2")["state"] == PoolJobState.PLACED
+
+    def test_submit_idempotent_and_validated(self):
+        sched = self._sched(2)
+        r1 = sched.submit(
+            PoolJobSpec(job_id="a", n_slices=1), FakeRT()
+        )
+        r2 = sched.submit(
+            PoolJobSpec(job_id="a", n_slices=1), FakeRT()
+        )
+        assert r2["reason"] == "already submitted"
+        assert r2["trace_id"] == r1["trace_id"]
+        bad = sched.submit(
+            PoolJobSpec(job_id="b", n_slices=99), FakeRT()
+        )
+        assert bad["state"] == ""
+        assert "capacity" in bad["reason"]
+        bad = sched.submit(
+            PoolJobSpec(job_id="c", priority=42, n_slices=1),
+            FakeRT(),
+        )
+        assert bad["state"] == ""
+
+
+class TestJobRoutedEnvelope:
+    """The per-job refactor: one server, many masters, state
+    isolation keyed by the `_job` envelope id."""
+
+    def test_envelope_roundtrip(self):
+        from dlrover_tpu.common import messages as msg
+
+        data = msg.serialize(
+            msg.KVStoreSetRequest(key="k", value=b"v"),
+            trace={"t": "1"},
+            job_id="job-a",
+        )
+        m, trace, job = msg.deserialize_envelope(data)
+        assert isinstance(m, msg.KVStoreSetRequest)
+        assert trace == {"t": "1"}
+        assert job == "job-a"
+        # Old-style decode drops the envelope fields cleanly.
+        m2, trace2 = msg.deserialize_with_trace(data)
+        assert m2.key == "k" and trace2 == {"t": "1"}
+
+    def test_routing_dispatcher_isolates_and_falls_through(self):
+        from dlrover_tpu.common import messages as msg
+        from dlrover_tpu.common.comm import (
+            JobRoutingDispatcher,
+            RpcDispatcher,
+        )
+
+        router = JobRoutingDispatcher()
+        seen = []
+        router.register_get(
+            msg.PoolQueryRequest,
+            lambda req: seen.append("pool") or "pool-level",
+        )
+        d_a = RpcDispatcher()
+        d_a.register_get(
+            msg.KVStoreGetRequest, lambda req: f"a:{req.key}"
+        )
+        router.register_job("a", d_a)
+        assert (
+            router.handle_get(
+                msg.KVStoreGetRequest(key="x"), job_id="a"
+            )
+            == "a:x"
+        )
+        # Unhandled type on the job dispatcher falls through to the
+        # pool level (e.g. TraceQueryRequest on the shared store).
+        assert (
+            router.handle_get(
+                msg.PoolQueryRequest(), job_id="a"
+            )
+            == "pool-level"
+        )
+        with pytest.raises(KeyError, match="unknown job"):
+            router.handle_get(
+                msg.KVStoreGetRequest(key="x"), job_id="ghost"
+            )
+
+    def test_two_jobs_state_isolated_over_real_rpc(self):
+        """Two embedded JobMasters behind one pool server: kv store,
+        shard ledger, and node tables never bleed across job ids."""
+        from dlrover_tpu.agent.master_client import MasterClient
+        from dlrover_tpu.pool import PoolJobSpec, TPUPoolMaster
+
+        master = TPUPoolMaster(slices=4, watch_interval=9999.0)
+        master.prepare()
+        clients = []
+        try:
+            for jid in ("job-a", "job-b"):
+                r = master.submit(
+                    PoolJobSpec(job_id=jid, n_slices=2)
+                )
+                assert r["state"] == PoolJobState.PLACED, r
+            ca = MasterClient(
+                master.addr, node_id=0, job_id="job-a"
+            )
+            cb = MasterClient(
+                master.addr, node_id=0, job_id="job-b"
+            )
+            clients += [ca, cb]
+            ca.register_node("worker")
+            cb.register_node("worker")
+            ca.kv_set("shared-key", b"from-a")
+            assert cb.kv_get("shared-key") is None
+            assert ca.kv_get("shared-key") == b"from-a"
+            ca.create_dataset(
+                "ds", dataset_size=4, batch_size=1,
+                num_minibatches_per_shard=1,
+            )
+            task = ca.get_task("ds")
+            assert task.task_id >= 0
+            # job-b has no such dataset: wait task, not job-a's.
+            tb = cb.get_task("ds")
+            assert tb.task_id < 0
+            ctx_a = master.context("job-a")
+            ctx_b = master.context("job-b")
+            assert ctx_a.master.task_manager.has_dataset("ds")
+            assert not ctx_b.master.task_manager.has_dataset("ds")
+            assert len(ctx_a.master.job_manager.list_nodes()) == 1
+            assert len(ctx_b.master.job_manager.list_nodes()) == 1
+        finally:
+            for c in clients:
+                c.close()
+            master.stop()
+
+
+class TestPoolGrantConsumers:
+    """Per-job planes consume pool grants instead of assuming an
+    infinite cluster."""
+
+    def test_ensure_role_capped_by_grant(self):
+        from dlrover_tpu.master.job_manager import JobManager
+
+        jm = JobManager()
+        jm.pool_grant = 2
+        launched = jm.ensure_role("worker", 5)
+        assert len(launched) == 2
+        assert jm.grant_headroom() == 0
+        # Without a grant: unconstrained (single-job behavior).
+        jm2 = JobManager()
+        assert jm2.grant_headroom() is None
+        assert len(jm2.ensure_role("worker", 5)) == 5
+
+    def test_remediation_pool_grant_governor(self):
+        from dlrover_tpu.master.job_manager import JobManager
+        from dlrover_tpu.master.remediation import (
+            ACTION_CORDON_REPLACE,
+            GOVERNOR_OK,
+            RemediationEngine,
+        )
+        from dlrover_tpu.obs.health import HealthMonitor
+        from dlrover_tpu.obs.timeseries import TimeSeriesStore
+
+        jm = JobManager()
+        for _ in range(2):
+            jm.register_node("worker")
+        store = TimeSeriesStore()
+        health = HealthMonitor(
+            store=store, job_manager=jm, interval=9999.0
+        )
+        eng = RemediationEngine(
+            health=health,
+            job_manager=jm,
+            servicer=None,
+            config={"hysteresis_ticks": 1, "cooldown_s": 0.0},
+            interval=9999.0,
+            min_nodes=1,
+        )
+        from dlrover_tpu.obs.health import HealthVerdict
+
+        v = HealthVerdict(
+            detector="node_stalled", severity="critical",
+            message="m", node_id=0, host="h",
+        )
+        eng._sick[v.key()] = 99
+        g_free = eng._check_governors(
+            v, ACTION_CORDON_REPLACE, time.time()
+        )
+        assert g_free["pool_grant"] == GOVERNOR_OK  # no grant
+        jm.pool_grant = 2  # both slots alive -> zero headroom
+        g_full = eng._check_governors(
+            v, ACTION_CORDON_REPLACE, time.time()
+        )
+        assert g_full["pool_grant"].startswith("blocked")
+        jm.pool_grant = 3
+        g_room = eng._check_governors(
+            v, ACTION_CORDON_REPLACE, time.time()
+        )
+        assert g_room["pool_grant"] == GOVERNOR_OK
+
+
+class TestPoolDrill:
+    def test_pool_drill_selftest(self):
+        """The hermetic acceptance drill: gang placement, graceful
+        checkpoint-backed preemption (staged before release),
+        whole-gang placement, elastic resume with exactly-once shard
+        accounting, quota non-starvation, and the one-trace incident
+        story."""
+        env = dict(os.environ)
+        env["JAX_PLATFORMS"] = "cpu"
+        env.pop("DLROVER_TPU_CHAOS", None)
+        proc = subprocess.run(
+            [
+                sys.executable,
+                os.path.join(REPO, "tools", "pool_drill.py"),
+                "--selftest",
+            ],
+            env=env,
+            capture_output=True,
+            text=True,
+            timeout=180,
+        )
+        assert proc.returncode == 0, (
+            f"pool drill failed\nstdout:\n{proc.stdout}\n"
+            f"stderr:\n{proc.stderr}"
+        )
+        assert "pool drill selftest ok" in proc.stdout

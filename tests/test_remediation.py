@@ -998,6 +998,7 @@ class TestAcceptanceDrill:
         assert d.governors == {
             "hysteresis": "ok", "cooldown": "ok",
             "blast_radius": "ok", "min_nodes": "ok",
+            "pool_grant": "ok",  # single-job master: no grant cap
         }
         assert d.trigger  # the convicting verdict's message rides it
         repl_id = d.replacement_id

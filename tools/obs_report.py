@@ -423,6 +423,51 @@ def serving_report(target: str) -> int:
     return 1 if payload.get("unhealthy") else 0
 
 
+def pool_report(target: str) -> int:
+    """Render the multi-job pool plane (queue depth per priority
+    band, per-tenant quota usage, slice utilization, preemption
+    counts, wait-time percentiles) from a live pool master
+    (host:port, ``PoolQueryRequest`` RPC) or a JSON snapshot file
+    (``PoolScheduler.snapshot()`` shaped)."""
+    import json
+    import os
+
+    from dlrover_tpu.pool.scheduler import render_pool
+
+    if os.path.isfile(target):
+        with open(target) as f:
+            payload = json.load(f)
+    elif (
+        target.endswith(".json")
+        or os.sep in target
+        or ":" not in target
+    ):
+        print(
+            f"pool snapshot not found: {target}", file=sys.stderr
+        )
+        return 2
+    else:
+        from dlrover_tpu.agent.master_client import MasterClient
+
+        client = MasterClient(target, node_id=-1)
+        try:
+            resp = client.query_pool(max_wait=15.0)
+        except Exception as exc:  # noqa: BLE001
+            print(
+                f"pool query to {target} failed: {exc}",
+                file=sys.stderr,
+            )
+            return 2
+        finally:
+            client.close()
+        if not resp.enabled:
+            print("pool plane disabled on this master")
+            return 0
+        payload = resp.snapshot
+    print(render_pool(payload))
+    return 0
+
+
 def trace_report(key: str, target: str) -> int:
     """Render causal trace timelines for ``key`` — a trace id, a
     serving request id, or a node subject (``node:<id>`` or a bare
@@ -1029,6 +1074,7 @@ def selftest() -> int:
     errors.extend(_selftest_remediation())
     errors.extend(_selftest_serving())
     errors.extend(_selftest_trace())
+    errors.extend(_selftest_pool())
     if errors:
         print("obs selftest FAILED:")
         for e in errors:
@@ -1036,6 +1082,96 @@ def selftest() -> int:
         return 1
     print("obs selftest ok")
     return 0
+
+
+def _selftest_pool() -> list:
+    """The --pool path end to end: a real PoolScheduler plays a
+    priority-preemption + quota story with fake runtimes, its
+    snapshot round-trips through JSON, and the renderer surfaces
+    queue depth per band, tenant quotas, slice utilization,
+    preemptions, and wait percentiles."""
+    import json
+    import os
+    import tempfile
+
+    from dlrover_tpu.pool import (
+        JobRuntime,
+        PoolJobSpec,
+        PoolScheduler,
+        SlicePool,
+    )
+    from dlrover_tpu.pool.scheduler import render_pool
+
+    errors = []
+
+    class FakeRT(JobRuntime):
+        def place(self, slices, resume):
+            pass
+
+        def park(self, on_parked):
+            on_parked({"staged": True, "path": "/ck", "step": 3})
+
+        def stop(self):
+            pass
+
+    pool = SlicePool(4, tenant_quotas={"research": 2})
+    sched = PoolScheduler(pool, park_timeout_s=5.0)
+    sched.submit(
+        PoolJobSpec(job_id="low", tenant="research", priority=1,
+                    n_slices=2, min_slices=1),
+        FakeRT(),
+    )
+    sched.submit(
+        PoolJobSpec(job_id="high", tenant="prod", priority=5,
+                    n_slices=4),
+        FakeRT(),
+    )
+    # low was preempted for high; a second research job is now
+    # quota-feasible but capacity-queued.
+    sched.submit(
+        PoolJobSpec(job_id="more", tenant="research", priority=1,
+                    n_slices=1),
+        FakeRT(),
+    )
+    info = sched.job_info("low")
+    if info["state"] != "preempted" or info["preemptions"] != 1:
+        errors.append(f"pool selftest: low not preempted: {info}")
+    if sched.job_info("high")["slices"] != [0, 1, 2, 3]:
+        errors.append("pool selftest: high gang not whole")
+    snap = sched.snapshot()
+    if snap["counters"]["preemptions"].get("priority") != 1:
+        errors.append(
+            f"pool selftest: counters {snap['counters']}"
+        )
+    if snap["queue_depth"].get("1") != 2:
+        errors.append(
+            f"pool selftest: band-1 depth {snap['queue_depth']}"
+        )
+    rendered = render_pool(snap)
+    for needle in (
+        "utilization 100%",
+        "queue depth: 2",
+        "research:",
+        "priority=1",
+        "wait band",
+    ):
+        if needle not in rendered:
+            errors.append(
+                f"pool selftest: {needle!r} missing from:\n"
+                f"{rendered}"
+            )
+    # File-target path end to end (the --pool target contract).
+    with tempfile.NamedTemporaryFile(
+        "w", suffix="_pool.json", delete=False
+    ) as f:
+        json.dump(snap, f)
+        path = f.name
+    try:
+        if pool_report(path) != 0:
+            errors.append("pool selftest: pool_report(file) != 0")
+    finally:
+        os.unlink(path)
+    return errors
 
 
 def _selftest_goodput(events) -> list:
@@ -1301,6 +1437,14 @@ def main(argv=None) -> int:
         " JSON file; exits 1 when a replica is unhealthy",
     )
     p.add_argument(
+        "--pool", type=str, default="",
+        metavar="TARGET",
+        help="render the multi-job pool plane (queue depth per "
+        "priority band, per-tenant quota usage, slice utilization, "
+        "preemption counts, wait-time percentiles) from a live pool "
+        "master (host:port) or a PoolScheduler.snapshot() JSON file",
+    )
+    p.add_argument(
         "--trace", type=str, default="",
         metavar="KEY",
         help="render the causal trace timeline(s) for KEY — a trace "
@@ -1333,6 +1477,8 @@ def main(argv=None) -> int:
         return health_report(args.health)
     if args.serving:
         return serving_report(args.serving)
+    if args.pool:
+        return pool_report(args.pool)
     if args.trace:
         if not args.event_file:
             p.error(
