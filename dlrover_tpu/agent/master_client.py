@@ -287,7 +287,9 @@ class MasterClient:
         # after a reconnect (the master may be a warm-restarted
         # replacement that needs this node announced again; the
         # job-manager register path is re-register-safe).
-        self._registration: Optional[Tuple[str, str]] = None
+        self._registration: Optional[
+            Tuple[str, str, Dict[str, str]]
+        ] = None
         # User hooks fired after re-registration on every reconnect
         # (e.g. resend a sharding snapshot / metrics snapshot).
         self._reconnect_callbacks: List[Callable[[], None]] = []
@@ -304,13 +306,14 @@ class MasterClient:
         snapshots. Uses the RAW client — the supervisor is mid-call,
         and a failure here will be healed by the next outage cycle."""
         if self._registration is not None:
-            node_type, node_ip = self._registration
+            node_type, node_ip, reg_labels = self._registration
             try:
                 self._client.report(
                     msg.NodeAddressRequest(
                         node_id=self.node_id,
                         node_type=node_type,
                         node_ip=node_ip,
+                        labels=dict(reg_labels),
                     )
                 )
                 logger.info(
@@ -372,13 +375,21 @@ class MasterClient:
     # -- node lifecycle -----------------------------------------------------
 
     @retry()
-    def register_node(self, node_type: str = "worker", node_ip: str = ""):
+    def register_node(
+        self,
+        node_type: str = "worker",
+        node_ip: str = "",
+        labels: Optional[Dict[str, str]] = None,
+    ):
         # Remember the facts FIRST: even if this attempt dies mid-
         # outage, the supervisor's reconnect path can re-announce.
-        self._registration = (node_type, node_ip)
+        self._registration = (node_type, node_ip, dict(labels or {}))
         self._report(
             msg.NodeAddressRequest(
-                node_id=self.node_id, node_type=node_type, node_ip=node_ip
+                node_id=self.node_id,
+                node_type=node_type,
+                node_ip=node_ip,
+                labels=dict(labels or {}),
             )
         )
 
@@ -806,7 +817,11 @@ class MasterClient:
         finish_reason: str = "",
         error: str = "",
         phases: Optional[Dict[str, float]] = None,
+        handoff: Optional[dict] = None,
     ) -> None:
+        """``handoff`` (a packed HandoffPayload wire dict) turns the
+        report into a prefill->decode stage transition: the KV rides
+        this same RPC seam up to the master's staging queue."""
         self._report(
             msg.ServeCompletedReport(
                 replica_id=replica_id,
@@ -820,6 +835,7 @@ class MasterClient:
                     str(k): float(v)
                     for k, v in (phases or {}).items()
                 },
+                handoff=dict(handoff or {}),
             )
         )
 

@@ -51,10 +51,15 @@ class ReplicaSupervisor:
         extra_args: Optional[List[str]] = None,
         env: Optional[dict] = None,
         poll_interval: float = 0.2,
+        role: str = "mixed",
     ):
         self.master_addr = master_addr
         self.replica_id = replica_id
         self.seed = seed
+        # Disaggregation role the spawned replica registers with
+        # (prefill / decode / mixed) — a supervisor relaunch must
+        # bring the SAME role back, or the fleet changes shape.
+        self.role = role
         self.max_restarts = max_restarts
         self.restart_backoff_s = restart_backoff_s
         self.extra_args = list(extra_args or [])
@@ -73,6 +78,7 @@ class ReplicaSupervisor:
             "--master", self.master_addr,
             "--replica_id", str(self.replica_id),
             "--seed", str(self.seed),
+            "--role", self.role,
             *self.extra_args,
         ]
 

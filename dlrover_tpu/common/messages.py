@@ -525,6 +525,12 @@ class NodeAddressRequest:
     node_id: int = -1
     node_type: str = ""
     node_ip: str = ""
+    # Role labels for the node-table entry (e.g. a serving replica's
+    # {"serving_role": "prefill"|"decode"|"mixed"}): the labeled
+    # ensure_role seam counts targets per label set, so per-role
+    # autoscaling can launch/count each role independently. An old
+    # decoder simply drops the field.
+    labels: Dict[str, str] = dataclasses.field(default_factory=dict)
 
 
 @message
@@ -817,6 +823,11 @@ class ServeWorkItem:
     max_new_tokens: int = 16
     temperature: float = 0.0
     trace: Dict[str, str] = dataclasses.field(default_factory=dict)
+    # Prefill/decode disaggregation: a packed HandoffPayload wire
+    # dict (serving/handoff.py — raw KV bytes + dtype/shape, msgpack-
+    # safe) when this item is a completed prefill bound for a
+    # decode-role replica; empty for raw prompts.
+    handoff: Dict[str, Any] = dataclasses.field(default_factory=dict)
 
 
 @message
@@ -849,10 +860,17 @@ class ServeCompletedReport:
     finish_reason: str = ""
     error: str = ""
     # Replica-side TTFT decomposition, per-phase durations in seconds
-    # (dispatch = scheduler queue wait, prefill, first_decode, decode)
-    # — the master folds these into the request's trace timeline and
-    # the dlrover_serve_ttft_phase_seconds histograms.
+    # (dispatch = scheduler queue wait, prefill, first_decode, decode,
+    # and "handoff" — the decode replica's import wait — on
+    # disaggregated completions) — the master folds these into the
+    # request's trace timeline and the
+    # dlrover_serve_ttft_phase_seconds histograms.
     phases: Dict[str, float] = dataclasses.field(default_factory=dict)
+    # Prefill/decode disaggregation: a prefill-role replica reports a
+    # finished PROMPT here — the packed KV HandoffPayload rides this
+    # field and the report is a stage transition (queued for a decode
+    # replica), not a completion.
+    handoff: Dict[str, Any] = dataclasses.field(default_factory=dict)
 
 
 @message

@@ -10,7 +10,7 @@ from __future__ import annotations
 
 import dataclasses
 import time
-from typing import Optional
+from typing import Dict, Optional
 
 from dlrover_tpu.common.constants import (
     NodeExitReason,
@@ -121,6 +121,12 @@ class Node:
     # target (its replacement is); retired once probation confirms
     # recovery, un-cordoned on rollback.
     cordoned: bool = False
+    # Role labels (e.g. a serving replica's serving_role): set at
+    # registration or by a labeled ensure_role launch; the labeled
+    # ensure_role seam counts alive nodes per label set so each role
+    # scales independently. Rides node-table snapshots like any
+    # field.
+    labels: Dict[str, str] = dataclasses.field(default_factory=dict)
 
     def __post_init__(self):
         if self.config_resource is None:

@@ -212,6 +212,7 @@ class JobManager:
         rank: int = -1,
         addr: str = "",
         resource: Optional[NodeResource] = None,
+        labels: Optional[Dict[str, str]] = None,
     ) -> Node:
         """Called when an agent announces itself (or a pod is created)."""
         with self._lock:
@@ -249,6 +250,7 @@ class JobManager:
                     # past the heartbeat timeout rejoin the world on
                     # re-register, next to its replacement.
                     cordoned=node.cordoned,
+                    labels=dict(node.labels),
                 )
                 self._nodes[node_id] = fresh
                 node = fresh
@@ -263,6 +265,11 @@ class JobManager:
                 )
                 self._nodes[node_id] = node
             node.host_addr = addr or node.host_addr
+            if labels:
+                # The registering process's declared labels win over
+                # a PENDING launch's (they describe what actually
+                # arrived).
+                node.labels.update(labels)
             self._apply_role_policy(node)
             node.update_status(NodeStatus.RUNNING)
             node.update_heartbeat()
@@ -645,6 +652,11 @@ class JobManager:
                 config_resource=resource,
                 max_relaunch_count=self._max_relaunch,
                 relaunch_reason=reason,
+                # The stand-in inherits the replaced node's role
+                # labels: a replaced prefill replica must come back
+                # a prefill replica, or the role fleet silently
+                # changes shape under remediation.
+                labels=dict(node.labels),
             )
             self._apply_role_policy(repl)
             # The stand-in inherits the replaced worker's criticality:
@@ -884,6 +896,7 @@ class JobManager:
         node_type: str,
         count: int,
         resource: Optional[NodeResource] = None,
+        labels: Optional[Dict[str, str]] = None,
     ) -> List[Node]:
         """Schedule nodes so ``count`` of ``node_type`` are alive.
 
@@ -891,6 +904,13 @@ class JobManager:
         spec wants but no agent has registered yet — e.g. a standalone
         evaluator the trainer's evaluate loop will attach to. Returns
         the newly launched (PENDING) nodes; no-op if enough are alive.
+
+        ``labels`` scopes the target to the matching label set (the
+        serving plane's per-role autoscaling: prefill and decode
+        replica counts are independent targets within one node type).
+        Launched nodes carry the labels; alive nodes of the type with
+        DIFFERENT labels neither count toward the target nor have
+        their ids reused.
         """
         from dlrover_tpu.common.constants import (
             evaluator_node_id,
@@ -912,12 +932,32 @@ class JobManager:
         capped = False
         with self._lock:
             headroom = self._grant_headroom_locked()
+
+            def _matches(n: Node) -> bool:
+                if n.type != node_type or not n.is_alive():
+                    return False
+                if labels:
+                    return all(
+                        n.labels.get(k) == v
+                        for k, v in labels.items()
+                    )
+                return True
+
             alive = sum(
+                1 for n in self._nodes.values() if _matches(n)
+            )
+            # The id scan must reach past indices occupied by alive
+            # same-type nodes of OTHER label sets (a labeled call
+            # skips them without counting them toward its target).
+            same_type_alive = sum(
                 1
                 for n in self._nodes.values()
                 if n.type == node_type and n.is_alive()
             )
-            for index in range(count):
+            scan = count + (
+                same_type_alive if role_id is not None else 0
+            )
+            for index in range(scan):
                 if alive + len(launched) >= count:
                     break
                 if headroom is not None and len(launched) >= headroom:
@@ -944,6 +984,7 @@ class JobManager:
                     status=NodeStatus.PENDING,
                     config_resource=resource or NodeResource(),
                     max_relaunch_count=self._max_relaunch,
+                    labels=dict(labels or {}),
                 )
                 self._apply_role_policy(node)
                 self._nodes[node.id] = node

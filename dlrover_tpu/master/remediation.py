@@ -913,10 +913,19 @@ class RemediationEngine:
                 "since": d.timestamp,
             }
         _CORDONED_NODES.set(len(self._cordoned))
+        # The replacement inherits the sick node's role labels
+        # (launch_replacement copies node.labels), so a replaced
+        # prefill replica comes back a prefill replica; record the
+        # role on the decision for the audit trail.
+        role = getattr(node, "labels", {}).get("serving_role", "")
+        if not role and self.serving is not None:
+            role_of = getattr(self.serving, "role_of", None)
+            role = role_of(d.node_id) if role_of else ""
         obs.event(
             "remediation.cordon",
             node_id=d.node_id, host=d.host, detector=d.detector,
             replacement_id=d.replacement_id, replica=True,
+            **({"role": role} if role else {}),
         )
         return True
 

@@ -671,6 +671,7 @@ class MasterServicer:
                     max_new_tokens=r.max_new_tokens,
                     temperature=r.temperature,
                     trace=dict(r.trace),
+                    handoff=dict(r.handoff or {}),
                 )
                 for r in items
             ]
@@ -688,6 +689,7 @@ class MasterServicer:
             finish_reason=req.finish_reason,
             error=req.error,
             phases=req.phases,
+            handoff=dict(req.handoff) if req.handoff else None,
         )
         return None
 
@@ -726,6 +728,7 @@ class MasterServicer:
             node_type=req.node_type or "worker",
             node_id=req.node_id if req.node_id >= 0 else None,
             addr=req.node_ip,
+            labels=dict(req.labels or {}),
         )
         # Evaluators and data workers live outside the training
         # world: they must not enter the rendezvous alive-sets (their
@@ -745,10 +748,16 @@ class MasterServicer:
             # Serving replicas live in the node table (heartbeats,
             # watchdog, remediation) but outside the TRAINING world:
             # no rendezvous membership, no step accounting. Their
-            # registration feeds the router's replica registry.
+            # registration feeds the router's replica registry —
+            # role-typed (prefill/decode/mixed) for the two-stage
+            # dispatch; a PENDING launch's label stands in when the
+            # process itself declared none.
             if self.serving is not None:
+                role = (req.labels or {}).get(
+                    "serving_role"
+                ) or node.labels.get("serving_role") or "mixed"
                 self.serving.register_replica(
-                    node.id, addr=req.node_ip
+                    node.id, addr=req.node_ip, role=role
                 )
             return None
         if node.type not in (

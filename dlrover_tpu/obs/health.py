@@ -722,13 +722,14 @@ class HealthMonitor:
                 or f.get("state") == "draining"
                 else SEVERITY_WARN
             )
+            role = str(f.get("role", "mixed"))
             out.append(
                 HealthVerdict(
                     detector="replica_unhealthy",
                     severity=severity,
                     message=(
                         f"serving replica {f.get('replica_id')} "
-                        f"({f.get('state')}) holds "
+                        f"({role}, {f.get('state')}) holds "
                         f"{f.get('dispatched', 0)} request(s) with "
                         f"no progress for {stale:.1f}s "
                         f"(timeout {timeout:.1f}s)"

@@ -20,11 +20,17 @@ heartbeat watchdog, health verdicts, governed remediation, ScalePlans
   pulls work from the router, steps its scheduler, reports
   completions/stats, heartbeats like any other node.
 * :mod:`dlrover_tpu.serving.router` — the master-side traffic router:
-  request ledger (queued → dispatched → done), replica registry fed by
-  the node table, drain + requeue on replica death (a kill costs
+  request ledger (queued → dispatched → done; disaggregated stages
+  ``prefilling → handoff → decoding``), replica registry fed by the
+  node table, drain + requeue on replica death (a kill costs
   latency, not requests), progress watchdog feeding the
   ``replica_unhealthy`` health verdict, and QPS/p99-driven replica
-  scaling through the ScalePlan seam.
+  scaling through the ScalePlan seam (per-role targets once the
+  fleet disaggregates).
+* :mod:`dlrover_tpu.serving.handoff` — prefill/decode
+  disaggregation's transfer seam: block-granular KV payloads
+  exported off a prefill replica's cache, msgpack-safe wire form,
+  and the jitted install into a decode replica's pool.
 
 The request lifecycle, SLO knobs, and drain semantics are documented
 in docs/SERVING.md; ``tools/serve_drill.py --selftest`` is the
@@ -32,6 +38,10 @@ hermetic acceptance drill (multi-replica traffic through one replica
 kill, zero dropped requests).
 """
 
+from dlrover_tpu.serving.handoff import (  # noqa: F401
+    HandoffPayload,
+    export_handoff,
+)
 from dlrover_tpu.serving.kv_pool import KVBlockPool  # noqa: F401
 from dlrover_tpu.serving.router import (  # noqa: F401
     ServingRouter,

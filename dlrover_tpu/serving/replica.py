@@ -33,6 +33,7 @@ from __future__ import annotations
 import argparse
 import threading
 import time
+from collections import deque
 from typing import Optional
 
 from dlrover_tpu import obs
@@ -42,7 +43,10 @@ from dlrover_tpu.common.constants import (
     replica_node_id,
 )
 from dlrover_tpu.common.log import get_logger
+from dlrover_tpu.serving import handoff as handoff_mod
 from dlrover_tpu.serving.scheduler import (
+    FINISH_HANDOFF,
+    ROLE_MIXED,
     ContinuousBatchingScheduler,
     ServeRequest,
 )
@@ -61,19 +65,23 @@ class ReplicaWorker:
         max_len: Optional[int] = None,
         block_size: int = 8,
         prefill_chunk: int = 16,
+        prefill_budget: Optional[int] = None,
         total_blocks: Optional[int] = None,
         eos_id: Optional[int] = None,
         heartbeat_interval: float = 1.0,
         stats_interval: float = 1.0,
         pull_batch: int = 4,
+        pull_interval_s: float = 0.05,
         idle_sleep_s: float = 0.02,
         name: str = "",
+        role: str = ROLE_MIXED,
     ):
         from dlrover_tpu.agent.master_client import MasterClient
 
         self.replica_id = replica_id
         self.node_id = replica_node_id(replica_id)
         self.name = name or f"replica-{replica_id}"
+        self.role = role
         self.client = MasterClient(
             master_addr, node_id=self.node_id
         )
@@ -82,8 +90,10 @@ class ReplicaWorker:
             max_len=max_len,
             block_size=block_size,
             prefill_chunk=prefill_chunk,
+            prefill_budget=prefill_budget,
             total_blocks=total_blocks,
             eos_id=eos_id,
+            role=role,
         )
         self.params = params
         self.cfg = cfg
@@ -93,7 +103,24 @@ class ReplicaWorker:
         self.heartbeat_interval = heartbeat_interval
         self.stats_interval = stats_interval
         self.pull_batch = pull_batch
+        # Busy-loop pull throttle: while sequences are resident, the
+        # pull RPC fires at most every pull_interval_s — otherwise a
+        # replica with a free lane pays a master roundtrip between
+        # EVERY decode tick, and that roundtrip (not the model)
+        # dominates TPOT at small batch. An EMPTY scheduler still
+        # pulls every iteration (nothing to delay).
+        self.pull_interval_s = pull_interval_s
+        self._last_pull = 0.0
         self.idle_sleep_s = idle_sleep_s
+        # Async completion reporter: the decode loop must never
+        # block on a completion RPC (each one is a master roundtrip
+        # — under a handoff-heavy storm those stalls, not the model,
+        # would dominate TPOT). run_forever drains the queue on a
+        # daemon thread; without the thread (tests driving run_once
+        # directly) reports go inline.
+        self._report_queue: deque = deque()
+        self._report_cond = threading.Condition()
+        self._reporter: Optional[threading.Thread] = None
         self._stop = threading.Event()
         self._parked = False
         self._last_hb = 0.0
@@ -105,11 +132,14 @@ class ReplicaWorker:
 
     def register(self) -> None:
         self.client.register_node(
-            node_type=NodeType.REPLICA, node_ip=self.name
+            node_type=NodeType.REPLICA,
+            node_ip=self.name,
+            labels={"serving_role": self.role},
         )
         obs.event(
             "serve.replica_register",
             replica_id=self.node_id, replica_name=self.name,
+            role=self.role,
         )
 
     def start(self) -> None:
@@ -124,9 +154,15 @@ class ReplicaWorker:
 
     def stop(self) -> None:
         self._stop.set()
+        with self._report_cond:
+            self._report_cond.notify_all()
         if self._thread is not None:
             self._thread.join(timeout=10.0)
             self._thread = None
+        if self._reporter is not None:
+            self._reporter.join(timeout=5.0)
+            self._reporter = None
+        self._drain_reports()
         self.client.close()
 
     # -- loop ---------------------------------------------------------------
@@ -195,7 +231,11 @@ class ReplicaWorker:
         if self._parked:
             return 0
         want = min(self.scheduler.capacity_hint(), self.pull_batch)
-        if want > 0:
+        if want > 0 and (
+            self.scheduler.active() == 0
+            or now - self._last_pull >= self.pull_interval_s
+        ):
+            self._last_pull = now
             try:
                 items = self.client.serve_pull(
                     self.node_id, max_items=want
@@ -205,6 +245,14 @@ class ReplicaWorker:
                 logger.debug("serve pull failed", exc_info=True)
                 items = []
             for item in items:
+                if item.handoff:
+                    # A completed prefill bound for this decode/
+                    # mixed replica: import its KV instead of
+                    # re-prefilling the prompt.
+                    self.scheduler.submit_handoff(
+                        handoff_mod.unpack(item.handoff)
+                    )
+                    continue
                 self.scheduler.submit(
                     ServeRequest(
                         request_id=item.request_id,
@@ -216,28 +264,73 @@ class ReplicaWorker:
                 )
         completed = self.scheduler.step()
         for c in completed:
-            try:
-                self.client.serve_complete(
-                    self.node_id,
-                    c.request_id,
-                    c.tokens,
-                    ttft_s=c.ttft_s,
-                    tpot_s=c.tpot_s,
-                    finish_reason=c.finish_reason,
-                    error=c.error,
-                    phases=c.phases,
-                )
-            except Exception:  # noqa: BLE001 — the router requeues
-                # on our death; a lost completion costs a recompute,
-                # never the request
-                logger.warning(
-                    "completion report for %s failed", c.request_id,
-                    exc_info=True,
-                )
+            report = dict(
+                request_id=c.request_id,
+                tokens=c.tokens,
+                ttft_s=c.ttft_s,
+                tpot_s=c.tpot_s,
+                finish_reason=c.finish_reason,
+                error=c.error,
+                phases=c.phases,
+                # A prefill-role export: the KV payload rides the
+                # same completion RPC up to the master's staging
+                # queue (a stage transition, not a completion).
+                handoff=(
+                    handoff_mod.pack(c.handoff)
+                    if c.finish_reason == FINISH_HANDOFF
+                    and c.handoff is not None
+                    else None
+                ),
+            )
+            if self._reporter is not None:
+                with self._report_cond:
+                    self._report_queue.append(report)
+                    self._report_cond.notify()
+            else:
+                self._send_report(report)
         return len(completed)
+
+    def _send_report(self, report: dict) -> None:
+        try:
+            self.client.serve_complete(self.node_id, **report)
+        except Exception:  # noqa: BLE001 — the router requeues on
+            # our death; a lost completion costs a recompute, never
+            # the request
+            logger.warning(
+                "completion report for %s failed",
+                report.get("request_id"), exc_info=True,
+            )
+
+    def _reporter_loop(self) -> None:
+        while True:
+            with self._report_cond:
+                while not self._report_queue:
+                    if self._stop.is_set():
+                        return
+                    self._report_cond.wait(timeout=0.2)
+                report = self._report_queue.popleft()
+            self._send_report(report)
+
+    def _drain_reports(self, timeout_s: float = 5.0) -> None:
+        """Flush queued completion reports at shutdown (best-effort:
+        anything lost is requeued by the router's watchdog)."""
+        deadline = time.monotonic() + timeout_s
+        while time.monotonic() < deadline:
+            with self._report_cond:
+                if not self._report_queue:
+                    return
+                report = self._report_queue.popleft()
+            self._send_report(report)
 
     def run_forever(self) -> None:
         self.register()
+        if self._reporter is None:
+            self._reporter = threading.Thread(
+                target=self._reporter_loop,
+                name=f"replica-reporter-{self.replica_id}",
+                daemon=True,
+            )
+            self._reporter.start()
         while not self._stop.is_set():
             busy = self.run_once()
             # Back off when there is nothing to step: idle, or
@@ -282,10 +375,23 @@ def main(argv=None) -> int:
     p.add_argument("--lanes", type=int, default=2)
     p.add_argument("--block_size", type=int, default=8)
     p.add_argument("--prefill_chunk", type=int, default=16)
+    p.add_argument(
+        "--prefill_budget", type=int, default=0,
+        help="prompt tokens prefilled per scheduler step across "
+        "sequences (0 = the scheduler default, 2x prefill_chunk)",
+    )
+    p.add_argument("--pull_interval_s", type=float, default=0.05)
     p.add_argument("--max_len", type=int, default=64)
     p.add_argument("--heartbeat_interval", type=float, default=1.0)
     p.add_argument("--stats_interval", type=float, default=1.0)
     p.add_argument("--pull_batch", type=int, default=4)
+    p.add_argument(
+        "--role", type=str, default="mixed",
+        choices=["mixed", "prefill", "decode"],
+        help="disaggregation role: prefill replicas only prefill "
+        "and export KV handoffs, decode replicas only decode "
+        "handoff imports, mixed does both (colocated default)",
+    )
     args = p.parse_args(argv)
     params, cfg = build_tiny_model(
         args.seed, block_size=max(args.max_len, 64)
@@ -299,9 +405,12 @@ def main(argv=None) -> int:
         max_len=args.max_len,
         block_size=args.block_size,
         prefill_chunk=args.prefill_chunk,
+        prefill_budget=args.prefill_budget or None,
         heartbeat_interval=args.heartbeat_interval,
         stats_interval=args.stats_interval,
         pull_batch=args.pull_batch,
+        pull_interval_s=args.pull_interval_s,
+        role=args.role,
     )
     print(f"DLROVER_TPU_REPLICA={args.replica_id}", flush=True)
     try:

@@ -47,16 +47,32 @@ from typing import Callable, Dict, List, Optional
 from dlrover_tpu import obs
 from dlrover_tpu.common.log import get_logger
 from dlrover_tpu.obs import tracer as _trace
-from dlrover_tpu.serving.scheduler import ServeRequest
+from dlrover_tpu.serving import handoff as handoff_mod
+from dlrover_tpu.serving.scheduler import (
+    ROLE_DECODE,
+    ROLE_MIXED,
+    ROLE_PREFILL,
+    ROLES,
+    ServeRequest,
+)
 
 logger = get_logger("serving.router")
 
 SERVE_ENV_PREFIX = "DLROVER_TPU_SERVE_"
 
 REQ_QUEUED = "queued"
-REQ_DISPATCHED = "dispatched"
+REQ_DISPATCHED = "dispatched"  # on a MIXED replica (colocated)
+# Disaggregated stages (docs/SERVING.md "Prefill/decode
+# disaggregation"): prompt on a PREFILL replica -> KV payload staged
+# at the master -> streaming on a DECODE replica.
+REQ_PREFILLING = "prefilling"
+REQ_HANDOFF = "handoff"
+REQ_DECODING = "decoding"
 REQ_DONE = "done"
 REQ_FAILED = "failed"
+
+# States owned by a live replica (requeue targets on drain/death).
+DISPATCHED_STATES = (REQ_DISPATCHED, REQ_PREFILLING, REQ_DECODING)
 
 REPLICA_READY = "ready"
 REPLICA_DRAINING = "draining"
@@ -79,6 +95,12 @@ _REPLICAS_GAUGE = obs.gauge(
     "dlrover_serve_replicas",
     "Registered serving replicas, by state (ready / draining)",
     ("state",),
+)
+_ROLE_REPLICAS_GAUGE = obs.gauge(
+    "dlrover_serve_role_replicas",
+    "Registered serving replicas by disaggregation role (mixed / "
+    "prefill / decode)",
+    ("role",),
 )
 _P99_GAUGE = obs.gauge(
     "dlrover_serve_p99_latency_seconds",
@@ -127,19 +149,46 @@ DEFAULTS: Dict[str, float] = {
     "ledger_retention": 4096.0,
     # autoscale evaluation cadence (ServingRouter.start's thread)
     "autoscale_interval_s": 15.0,
+    # -- prefill/decode disaggregation --------------------------------
+    # Staged-handoff byte budget: a completed prefill whose KV would
+    # push the master past this falls back to recompute (requeued to
+    # the prompt stage — bounded master RAM, zero drops).
+    "handoff_max_bytes": 64.0 * 1024 * 1024,
+    # Per-role SLO autoscaling (active once any prefill/decode-role
+    # replica registers). Prefill count scales on the raw-prompt
+    # backlog and the queue+prefill TTFT phases; decode count on the
+    # TPOT p99 SLO, staged-handoff backlog, and decode-pool KV
+    # utilization.
+    "min_prefill": 1.0,
+    "max_prefill": 8.0,
+    "min_decode": 1.0,
+    "max_decode": 8.0,
+    "tpot_slo_s": 0.0,  # 0 = disabled
+    "ttft_slo_s": 0.0,  # 0 = disabled (queue+prefill phase p99)
+    "kv_util_high": 0.9,
+    "handoff_backlog_per_decode": 8.0,
+    # recent-phase sample window for the per-phase SLO judgments
+    "phase_window": 256.0,
 }
 
 
 class _Replica:
     __slots__ = (
-        "node_id", "addr", "state", "registered_ts",
+        "node_id", "addr", "state", "role", "registered_ts",
         "last_progress_ts", "stats", "dispatched", "drains",
     )
 
-    def __init__(self, node_id: int, addr: str, now: float):
+    def __init__(
+        self,
+        node_id: int,
+        addr: str,
+        now: float,
+        role: str = ROLE_MIXED,
+    ):
         self.node_id = node_id
         self.addr = addr
         self.state = REPLICA_READY
+        self.role = role
         self.registered_ts = now
         self.last_progress_ts = now
         self.stats: dict = {}
@@ -206,6 +255,24 @@ class ServingRouter:
         self._replicas: Dict[int, _Replica] = {}
         self._requests: Dict[str, _Request] = {}
         self._queue: deque = deque()  # request ids awaiting dispatch
+        # Disaggregation: completed prefills staged for a decode
+        # replica's pull. _handoffs maps rid -> {"payload": wire
+        # dict, "staged_ts", "from_replica", "bytes"}; the payload
+        # leaves the master at dispatch (a decode-replica death
+        # re-prefills — the master never retains KV for in-flight
+        # decodes, so its RAM stays bounded by handoff_max_bytes).
+        self._handoff_queue: deque = deque()
+        self._handoffs: Dict[str, dict] = {}
+        self._handoff_bytes = 0
+        # Recent per-phase TTFT samples + TPOT samples (the per-role
+        # SLO autoscaler's evidence; same bounded-window discipline
+        # as _done_latencies).
+        window = int(self._cfg("phase_window"))
+        self._phase_recent: Dict[str, deque] = {
+            phase: deque(maxlen=window)
+            for phase in ("queue", "prefill")
+        }
+        self._tpot_recent: deque = deque(maxlen=window)
         self._seq = itertools.count(1)
         self._done_latencies: deque = deque(
             maxlen=int(self._cfg("latency_window"))
@@ -306,16 +373,29 @@ class ServingRouter:
 
     # -- replica registry ---------------------------------------------------
 
-    def register_replica(self, node_id: int, addr: str = "") -> None:
+    def register_replica(
+        self, node_id: int, addr: str = "", role: str = ROLE_MIXED
+    ) -> None:
         """A replica announced itself (NodeAddressRequest with
         node_type=replica routes here from the servicer). Re-register
-        after a restart clears a drain — the fresh process is ready."""
+        after a restart clears a drain — the fresh process is ready.
+        ``role`` types the replica for two-stage dispatch: prefill
+        replicas are fed raw prompts, decode replicas staged
+        handoffs, mixed both."""
+        if role not in ROLES:
+            logger.warning(
+                "replica %d registered with unknown role %r; "
+                "treating as mixed", node_id, role,
+            )
+            role = ROLE_MIXED
         now = self.clock()
         requeued = 0
         with self._lock:
             rep = self._replicas.get(node_id)
             if rep is None:
-                self._replicas[node_id] = _Replica(node_id, addr, now)
+                self._replicas[node_id] = _Replica(
+                    node_id, addr, now, role=role
+                )
             else:
                 # A re-registration is a NEW incarnation: whatever
                 # the old one still held is gone from its memory, so
@@ -324,14 +404,27 @@ class ServingRouter:
                 requeued = self._requeue_locked(rep)
                 rep.addr = addr or rep.addr
                 rep.state = REPLICA_READY
+                rep.role = role
                 rep.last_progress_ts = now
         if requeued:
             self._publish_queue()
         self._publish_replicas()
         obs.event(
-            "serve.replica_ready", replica_id=node_id, addr=addr
+            "serve.replica_ready", replica_id=node_id, addr=addr,
+            role=role,
         )
-        logger.info("serving replica %d registered (%s)", node_id, addr)
+        logger.info(
+            "serving replica %d registered (%s, role=%s)",
+            node_id, addr, role,
+        )
+
+    def role_of(self, node_id: int) -> str:
+        """The registered role of a replica (mixed when unknown) —
+        the remediation engine labels replacements with it so a
+        replaced prefill replica comes back a prefill replica."""
+        with self._lock:
+            rep = self._replicas.get(node_id)
+            return rep.role if rep is not None else ROLE_MIXED
 
     def drain_replica(
         self,
@@ -410,8 +503,19 @@ class ServingRouter:
         # OLDEST at the very front of the queue.
         for _, rid in sorted(pending, reverse=True):
             rec = self._requests.get(rid)
-            if rec is None or rec.state != REQ_DISPATCHED:
+            if rec is None or rec.state not in DISPATCHED_STATES:
                 continue
+            if rec.state == REQ_DECODING:
+                # The decode replica held the only copy of this
+                # sequence's KV (the master dropped its staged
+                # payload at dispatch): back to the PROMPT stage —
+                # a decode-replica kill re-prefills, exact for
+                # greedy, zero drops.
+                handoff_mod.note_outcome("reprefill")
+            # Drop any dispatched payload still referenced off the
+            # ledger record: retaining KV bytes past the replica
+            # handoff would break the handoff_max_bytes RAM bound.
+            rec.req.handoff = None
             rec.state = REQ_QUEUED
             rec.replica_id = -1
             rec.requeues += 1
@@ -519,40 +623,94 @@ class ServingRouter:
     def pull(self, replica_id: int, max_items: int = 1) -> List[ServeRequest]:
         """A replica asks for work. Only READY replicas are fed; the
         pull itself counts as progress (the replica is alive and
-        asking)."""
+        asking). Dispatch is role-typed: PREFILL replicas take raw
+        prompts, DECODE replicas take staged handoffs (the KV payload
+        rides out attached to the work item and leaves the master),
+        MIXED drain raw prompts first and then handoffs — a mixed
+        fleet keeps every stage moving even when one role's fleet is
+        momentarily empty."""
         now = self.clock()
         out: List[ServeRequest] = []
+        staged_waits: List[float] = []
         with self._lock:
             rep = self._replicas.get(replica_id)
             if rep is None or rep.state != REPLICA_READY:
                 return []
             rep.last_progress_ts = now
-            while self._queue and len(out) < max_items:
-                rid = self._queue.popleft()
+            while len(out) < max_items:
+                from_handoff = False
+                rid = None
+                if rep.role == ROLE_DECODE:
+                    if self._handoff_queue:
+                        rid = self._handoff_queue.popleft()
+                        from_handoff = True
+                elif self._queue:
+                    rid = self._queue.popleft()
+                elif rep.role == ROLE_MIXED and self._handoff_queue:
+                    rid = self._handoff_queue.popleft()
+                    from_handoff = True
+                if rid is None:
+                    break
                 rec = self._requests.get(rid)
-                if rec is None or rec.state != REQ_QUEUED:
-                    continue
-                rec.state = REQ_DISPATCHED
+                if from_handoff:
+                    staged = self._handoffs.pop(rid, None)
+                    if rec is None or rec.state != REQ_HANDOFF:
+                        if staged is not None:
+                            self._handoff_bytes -= staged["bytes"]
+                        continue
+                    if staged is None:
+                        # Payload lost (should not happen): back to
+                        # the prompt stage — recompute, never drop.
+                        rec.state = REQ_QUEUED
+                        self._queue.appendleft(rid)
+                        handoff_mod.note_outcome("reprefill")
+                        continue
+                    self._handoff_bytes -= staged["bytes"]
+                    rec.state = REQ_DECODING
+                    rec.req.handoff = staged["payload"]
+                    wait = now - staged["staged_ts"]
+                    staged_waits.append(wait)
+                    handoff_mod.note_outcome("dispatched")
+                    # The staged interval is the request's
+                    # serve.handoff hop: prefill replica -> master
+                    # -> decode replica, joining the causal chain
+                    # between the two serve.hop spans.
+                    self._span(
+                        rec, "serve.handoff", staged["staged_ts"],
+                        wait, hop=len(rec.hops),
+                        from_replica=staged["from_replica"],
+                        to_replica=replica_id,
+                    )
+                else:
+                    if rec is None or rec.state != REQ_QUEUED:
+                        continue
+                    rec.state = (
+                        REQ_PREFILLING
+                        if rep.role == ROLE_PREFILL
+                        else REQ_DISPATCHED
+                    )
+                    rec.req.handoff = None
+                    # Close the queue interval and open this hop in
+                    # the trace: queue time since submit (hop 0) or
+                    # since the previous hop ended (requeue wait).
+                    queued_since = (
+                        rec.hops[-1]["end_ts"]
+                        if rec.hops
+                        else rec.submit_ts
+                    )
+                    self._span(
+                        rec, "serve.queue", queued_since,
+                        now - queued_since, hop=len(rec.hops),
+                    )
                 rec.replica_id = replica_id
                 rec.dispatch_ts = now
-                # Close the queue interval and open this hop in the
-                # trace: queue time since submit (hop 0) or since the
-                # previous hop ended (requeue wait).
-                queued_since = (
-                    rec.hops[-1]["end_ts"]
-                    if rec.hops
-                    else rec.submit_ts
-                )
-                self._span(
-                    rec, "serve.queue", queued_since,
-                    now - queued_since, hop=len(rec.hops),
-                )
                 rec.hops.append(
                     {
                         "replica_id": replica_id,
                         "dispatch_ts": now,
                         "end_ts": 0.0,
                         "end": "",
+                        "stage": rec.state,
                         "span_id": _trace.new_span_id()
                         if rec.trace_id
                         else "",
@@ -560,6 +718,8 @@ class ServingRouter:
                 )
                 rep.dispatched.add(rid)
                 out.append(rec.req)
+        for wait in staged_waits:
+            handoff_mod.observe_staged_wait(wait)
         if out:
             self._publish_queue()
         return out
@@ -574,13 +734,23 @@ class ServingRouter:
         finish_reason: str = "",
         error: str = "",
         phases: Optional[Dict[str, float]] = None,
+        handoff: Optional[dict] = None,
     ) -> bool:
         """A replica finished (or failed) a request. First completion
         wins; late duplicates from a replica the request was requeued
         off are dropped. Completions are accepted from ANY replica —
         after a requeue the original owner may still land the result
-        first, which is a win, not an error."""
+        first, which is a win, not an error.
+
+        ``handoff`` (a packed HandoffPayload wire dict) turns the
+        report into a STAGE TRANSITION instead of a completion: the
+        prefill replica finished the prompt, and the request moves to
+        the handoff stage awaiting a decode replica's pull."""
         now = self.clock()
+        if handoff and not error:
+            return self._stage_handoff(
+                replica_id, request_id, handoff, now
+            )
         with self._lock:
             rec = self._requests.get(request_id)
             if rec is None:
@@ -600,6 +770,12 @@ class ServingRouter:
             owner = self._replicas.get(rec.replica_id)
             if owner is not None and owner is not rep:
                 owner.dispatched.discard(request_id)
+            if rec.state == REQ_HANDOFF:
+                # Completed while staged (only an error report can
+                # land here — e.g. the prefill replica double-
+                # reported): drop the staged payload with the
+                # completion.
+                self._drop_staged_locked(request_id)
             if rec.state == REQ_QUEUED:
                 # Completed by the original owner after a requeue but
                 # before re-dispatch: take the result and drop the
@@ -609,6 +785,10 @@ class ServingRouter:
                 except ValueError:
                     pass
             rec.state = REQ_FAILED if error else REQ_DONE
+            # The finished record lives in the ledger until
+            # retention evicts it: it must not pin a dispatched KV
+            # payload's bytes for that whole window.
+            rec.req.handoff = None
             rec.replica_id = replica_id
             rec.done_ts = now
             rec.tokens = list(tokens)
@@ -626,19 +806,7 @@ class ServingRouter:
                 self._done_latencies.append(now - rec.submit_ts)
                 self._done_stamps.append(now)
             self._finish_trace_locked(rec, replica_id, now)
-            # Bounded ledger: finished records past the retention
-            # evict oldest-first (the result becomes unknown to late
-            # pollers; cumulative counters keep the totals) — the
-            # master must never grow RAM with traffic volume.
-            self._finished.append(request_id)
-            retention = int(self._cfg("ledger_retention"))
-            while len(self._finished) > retention:
-                old = self._finished.popleft()
-                old_rec = self._requests.get(old)
-                if old_rec is not None and old_rec.state in (
-                    REQ_DONE, REQ_FAILED
-                ):
-                    del self._requests[old]
+            self._note_finished_locked(request_id)
         _REQUESTS_TOTAL.inc(
             outcome="failed" if error else "completed"
         )
@@ -646,6 +814,154 @@ class ServingRouter:
         # SLO gauges (p99 sort + QPS window scan) deliberately NOT
         # recomputed per completion: the router thread refreshes
         # them every autoscale_interval_s, off the RPC hot path.
+        return True
+
+    def _note_finished_locked(self, request_id: str) -> None:
+        """Bounded ledger: finished records past the retention evict
+        oldest-first (the result becomes unknown to late pollers;
+        cumulative counters keep the totals) — the master must never
+        grow RAM with traffic volume. Caller holds the lock."""
+        self._finished.append(request_id)
+        retention = int(self._cfg("ledger_retention"))
+        while len(self._finished) > retention:
+            old = self._finished.popleft()
+            old_rec = self._requests.get(old)
+            if old_rec is not None and old_rec.state in (
+                REQ_DONE, REQ_FAILED
+            ):
+                del self._requests[old]
+
+    def _drop_staged_locked(self, rid: str) -> None:
+        staged = self._handoffs.pop(rid, None)
+        if staged is not None:
+            self._handoff_bytes -= staged["bytes"]
+            try:
+                self._handoff_queue.remove(rid)
+            except ValueError:
+                pass
+
+    def _stage_handoff(
+        self, replica_id: int, request_id: str, wire: dict, now: float
+    ) -> bool:
+        """A prefill replica reports a completed prompt with its KV
+        payload: move the request to the handoff stage (awaiting a
+        decode replica's pull). First report wins, like completions.
+        Budget semantics: a payload that would push the STAGED total
+        past ``handoff_max_bytes`` (but fits it alone) falls back to
+        the prompt queue — the staging store is draining, so the
+        recompute will land once a decode replica frees room. A
+        payload that exceeds the budget BY ITSELF can never be
+        staged: re-prefilling it would loop forever in a pure
+        prefill+decode fleet, so the request fails terminally with
+        the reason surfaced to the caller."""
+        overflow = False
+        oversize = False
+        with self._lock:
+            rec = self._requests.get(request_id)
+            if rec is None:
+                _REQUESTS_TOTAL.inc(outcome="duplicate")
+                return False
+            rep = self._replicas.get(replica_id)
+            if rep is not None:
+                rep.dispatched.discard(request_id)
+            if rec.state in (
+                REQ_DONE, REQ_FAILED, REQ_HANDOFF, REQ_DECODING
+            ):
+                # Already past the prefill stage (a late duplicate
+                # from a replica the request was requeued off).
+                _REQUESTS_TOTAL.inc(outcome="duplicate")
+                return False
+            if rep is not None:
+                rep.last_progress_ts = now
+            owner = self._replicas.get(rec.replica_id)
+            if owner is not None and owner is not rep:
+                owner.dispatched.discard(request_id)
+            if rec.state == REQ_QUEUED:
+                # Requeued off the reporting replica before its
+                # handoff landed: the prefill IS done — take the
+                # request out of the prompt queue and use it.
+                try:
+                    self._queue.remove(request_id)
+                except ValueError:
+                    pass
+            nbytes = handoff_mod.payload_nbytes(wire)
+            budget = int(self._cfg("handoff_max_bytes"))
+            oversize = nbytes > budget
+            overflow = (
+                not oversize
+                and self._handoff_bytes + nbytes > budget
+            )
+            hop = rec.hops[-1] if rec.hops else None
+            if hop is not None and not hop["end"]:
+                hop["end_ts"] = now
+                hop["end"] = "failed" if oversize else "handoff"
+                self._span(
+                    rec, "serve.hop", hop["dispatch_ts"],
+                    now - hop["dispatch_ts"],
+                    span_id=hop["span_id"],
+                    replica_id=replica_id,
+                    hop=len(rec.hops) - 1,
+                    end=hop["end"],
+                )
+            if oversize:
+                rec.state = REQ_FAILED
+                rec.replica_id = replica_id
+                rec.done_ts = now
+                rec.error = (
+                    f"handoff payload {nbytes} B exceeds "
+                    f"handoff_max_bytes {budget} B"
+                )
+                self._failed_total += 1
+                handoff_mod.note_outcome("oversize")
+                _REQUESTS_TOTAL.inc(outcome="failed")
+                self._finish_trace_locked(rec, replica_id, now)
+                self._note_finished_locked(request_id)
+            elif overflow:
+                rec.state = REQ_QUEUED
+                rec.replica_id = -1
+                rec.requeues += 1
+                self._queue.appendleft(request_id)
+                handoff_mod.note_outcome("overflow")
+                _REQUESTS_TOTAL.inc(outcome="requeued")
+            else:
+                rec.state = REQ_HANDOFF
+                self._handoffs[request_id] = {
+                    "payload": wire,
+                    "staged_ts": now,
+                    "from_replica": replica_id,
+                    "bytes": nbytes,
+                }
+                self._handoff_queue.append(request_id)
+                self._handoff_bytes += nbytes
+                handoff_mod.note_outcome("staged")
+            trace_id = rec.trace_id
+            root = rec.root_span
+        obs.event(
+            "serve.handoff_oversize"
+            if oversize
+            else "serve.handoff_overflow"
+            if overflow
+            else "serve.handoff_staged",
+            request_id=request_id,
+            replica_id=replica_id,
+            bytes=nbytes,
+            trace_id=trace_id,
+            parent_span_id=root,
+        )
+        if oversize:
+            logger.warning(
+                "handoff for %s (%d B) exceeds handoff_max_bytes "
+                "(%d B) by itself; request FAILED (re-prefilling "
+                "would loop forever)",
+                request_id, nbytes,
+                int(self._cfg("handoff_max_bytes")),
+            )
+        elif overflow:
+            logger.warning(
+                "handoff for %s (%d B) exceeds the staging budget; "
+                "falling back to recompute", request_id, nbytes,
+            )
+        self._publish_queue()
         return True
 
     def _finish_trace_locked(
@@ -666,10 +982,18 @@ class ServingRouter:
                 end=rec.state,
             )
         # Total time spent QUEUED at the router (initial wait plus
-        # every requeue wait) — the "queue" slice of TTFT.
-        queue_s, prev = 0.0, rec.submit_ts
+        # every requeue wait) — the "queue" slice of TTFT. A gap
+        # preceding a DECODE-stage hop is the staged-handoff wait,
+        # not queue time: the first token already existed when the
+        # prefill replica exported, so handoff transit is outside
+        # TTFT (it has its own phase and histogram).
+        queue_s, handoff_master_s, prev = 0.0, 0.0, rec.submit_ts
         for h in rec.hops:
-            queue_s += max(h["dispatch_ts"] - prev, 0.0)
+            gap = max(h["dispatch_ts"] - prev, 0.0)
+            if h.get("stage") == REQ_DECODING:
+                handoff_master_s += gap
+            else:
+                queue_s += gap
             prev = h["end_ts"] or now
         ph = dict(rec.phases)
         if not rec.error and ph:
@@ -683,9 +1007,23 @@ class ServingRouter:
             }
             for phase, dur in decomposed.items():
                 _TTFT_PHASE_SECONDS.observe(dur, phase=phase)
+            # Per-phase SLO evidence for the role autoscaler.
+            self._phase_recent["queue"].append(decomposed["queue"])
+            self._phase_recent["prefill"].append(
+                decomposed["prefill"]
+            )
+            self._tpot_recent.append(float(rec.tpot_s))
             ttft_total = round(sum(decomposed.values()), 6)
+            handoff_s = handoff_master_s + float(
+                ph.get("handoff", 0.0)
+            )
             rec.phases = {
                 **decomposed,
+                **(
+                    {"handoff": round(handoff_s, 6)}
+                    if handoff_s > 0 or "handoff" in ph
+                    else {}
+                ),
                 "decode": round(float(ph.get("decode", 0.0)), 6),
                 "ttft_total": ttft_total,
             }
@@ -710,6 +1048,15 @@ class ServingRouter:
                 ("dispatch", "serve.dispatch"),
                 ("prefill", "serve.prefill"),
                 ("first_decode", "serve.first_token"),
+                # Disaggregated completions: the decode replica's
+                # local import wait sits between the first token and
+                # the decode stream (the master-side staged wait is
+                # the serve.handoff span emitted at dispatch).
+                *(
+                    (("handoff", "serve.handoff_import"),)
+                    if "handoff" in ph
+                    else ()
+                ),
                 ("decode", "serve.decode"),
             )
             total = sum(
@@ -796,11 +1143,14 @@ class ServingRouter:
         # RPC / node-event threads.
         with self._lock:
             depth = len(self._queue)
+            handoff_depth = len(self._handoff_queue)
+            handoff_bytes = self._handoff_bytes
             inflight = sum(
                 len(r.dispatched) for r in self._replicas.values()
             )
         _ROUTER_QUEUE.set(depth)
         _ROUTER_INFLIGHT.set(inflight)
+        handoff_mod.publish_staging(handoff_depth, handoff_bytes)
 
     def _publish_replicas(self) -> None:
         with self._lock:
@@ -809,8 +1159,13 @@ class ServingRouter:
                 1 for r in self._replicas.values()
                 if r.state == REPLICA_READY
             )
+            by_role = {role: 0 for role in ROLES}
+            for r in self._replicas.values():
+                by_role[r.role] = by_role.get(r.role, 0) + 1
         _REPLICAS_GAUGE.set(ready, state="ready")
         _REPLICAS_GAUGE.set(total - ready, state="draining")
+        for role, n in by_role.items():
+            _ROLE_REPLICAS_GAUGE.set(n, role=role)
 
     def _publish_slo(self) -> None:
         _P99_GAUGE.set(self.p99_latency())
@@ -854,6 +1209,7 @@ class ServingRouter:
                         "replica_id": rep.node_id,
                         "addr": rep.addr,
                         "state": rep.state,
+                        "role": rep.role,
                         "stale_s": round(stale, 3),
                         "timeout_s": timeout,
                         "dispatched": len(rep.dispatched),
@@ -882,6 +1238,13 @@ class ServingRouter:
             return None
         from dlrover_tpu.common.constants import NodeType
 
+        with self._lock:
+            disagg = any(
+                r.role != ROLE_MIXED
+                for r in self._replicas.values()
+            )
+        if disagg:
+            return self._autoscale_disagg(now)
         with self._lock:
             ready = [
                 r for r in self._replicas.values()
@@ -962,6 +1325,171 @@ class ServingRouter:
             return "shrink"
         return None
 
+    def phase_p99(self, phase: str) -> float:
+        """p99 of a recent TTFT phase window ("queue"/"prefill") or
+        of TPOT ("tpot") — the per-phase SLO autoscaler's evidence,
+        via the one shared nearest-rank formula."""
+        from dlrover_tpu.obs.timeseries import _percentile
+
+        with self._lock:
+            if phase == "tpot":
+                samples = sorted(self._tpot_recent)
+            else:
+                samples = sorted(self._phase_recent.get(phase, ()))
+        return _percentile(samples, 99.0)
+
+    def _grant_blocked(self, target: int, queue_depth: int) -> bool:
+        """Pool-grant headroom gate shared by both scaling paths
+        (see maybe_autoscale's grow branch for the semantics)."""
+        headroom_fn = getattr(
+            self.job_manager, "grant_headroom", None
+        )
+        headroom = headroom_fn() if headroom_fn else None
+        if headroom is None or headroom > 0:
+            self._grant_block_logged = False
+            return False
+        if not self._grant_block_logged:
+            self._grant_block_logged = True
+            obs.event(
+                "serve.scale_blocked_by_grant",
+                target=target,
+                grant=self.job_manager.pool_grant,
+                queue_depth=queue_depth,
+            )
+            logger.warning(
+                "serving scale-up to %d withheld: pool grant %s "
+                "has no headroom", target,
+                self.job_manager.pool_grant,
+            )
+        return True
+
+    def _autoscale_disagg(self, now: float) -> Optional[str]:
+        """Per-role scaling for a disaggregated fleet. PREFILL count
+        scales on the raw-prompt backlog and the queue/prefill TTFT
+        phase p99s (the phases a starved prefill fleet inflates);
+        DECODE count on the TPOT p99 SLO, the staged-handoff backlog,
+        and decode-pool KV utilization (the signals of a starved
+        decode fleet). Both route through the same
+        ``ensure_role``/ScalePlan seam, labeled with the serving role
+        so each role's target counts only its own nodes."""
+        from dlrover_tpu.common.constants import NodeType
+
+        with self._lock:
+            by_role: Dict[str, List[_Replica]] = {}
+            for r in self._replicas.values():
+                by_role.setdefault(r.role, []).append(r)
+            raw_depth = len(self._queue)
+            handoff_depth = len(self._handoff_queue)
+            kv_utils = [
+                float(
+                    (r.stats.get("kv") or {}).get("utilization", 0.0)
+                )
+                for r in by_role.get(ROLE_DECODE, [])
+                if r.stats
+            ]
+        prefills = by_role.get(ROLE_PREFILL, [])
+        decodes = by_role.get(ROLE_DECODE, [])
+        n_pre, n_dec = len(prefills), len(decodes)
+        min_pre = int(self._cfg("min_prefill"))
+        max_pre = int(self._cfg("max_prefill"))
+        min_dec = int(self._cfg("min_decode"))
+        max_dec = int(self._cfg("max_decode"))
+        ttft_slo = self._cfg("ttft_slo_s")
+        tpot_slo = self._cfg("tpot_slo_s")
+        queue_p99 = self.phase_p99("queue")
+        prefill_p99 = self.phase_p99("prefill")
+        tpot_p99 = self.phase_p99("tpot")
+        kv_mean = (
+            sum(kv_utils) / len(kv_utils) if kv_utils else 0.0
+        )
+        grew = None
+        grow_prefill = (
+            raw_depth
+            > self._cfg("backlog_per_replica") * max(n_pre, 1)
+            or (ttft_slo > 0 and queue_p99 + prefill_p99 > ttft_slo)
+            or n_pre < min_pre
+        )
+        if grow_prefill and n_pre < max_pre:
+            target = max(n_pre + 1, min_pre)
+            if not self._grant_blocked(target, raw_depth):
+                self.job_manager.ensure_role(
+                    NodeType.REPLICA, target,
+                    labels={"serving_role": ROLE_PREFILL},
+                )
+                self._last_scale_ts = now
+                grew = "grow"
+                obs.event(
+                    "serve.scale", direction="grow",
+                    role=ROLE_PREFILL, target=target,
+                    queue_depth=raw_depth,
+                    queue_p99_s=round(queue_p99, 3),
+                    prefill_p99_s=round(prefill_p99, 3),
+                )
+                logger.warning(
+                    "serving scale-up: prefill -> %d (queue %d, "
+                    "queue+prefill p99 %.2fs)",
+                    target, raw_depth, queue_p99 + prefill_p99,
+                )
+        grow_decode = (
+            handoff_depth
+            > self._cfg("handoff_backlog_per_decode") * max(n_dec, 1)
+            or (tpot_slo > 0 and tpot_p99 > tpot_slo)
+            or kv_mean > self._cfg("kv_util_high")
+            or n_dec < min_dec
+        )
+        if grow_decode and n_dec < max_dec:
+            target = max(n_dec + 1, min_dec)
+            if not self._grant_blocked(target, handoff_depth):
+                self.job_manager.ensure_role(
+                    NodeType.REPLICA, target,
+                    labels={"serving_role": ROLE_DECODE},
+                )
+                self._last_scale_ts = now
+                grew = "grow"
+                obs.event(
+                    "serve.scale", direction="grow",
+                    role=ROLE_DECODE, target=target,
+                    handoff_depth=handoff_depth,
+                    tpot_p99_s=round(tpot_p99, 5),
+                    kv_util=round(kv_mean, 3),
+                )
+                logger.warning(
+                    "serving scale-up: decode -> %d (handoff "
+                    "backlog %d, tpot p99 %.4fs, kv %.0f%%)",
+                    target, handoff_depth, tpot_p99,
+                    100.0 * kv_mean,
+                )
+        if grew:
+            return grew
+        # Shrink one idle role per evaluation (never below its min):
+        # prefill idles when no raw prompts wait anywhere; decode
+        # when no handoffs wait and nothing is decoding.
+        for role, reps, n, floor, depth in (
+            (ROLE_PREFILL, prefills, n_pre, min_pre, raw_depth),
+            (ROLE_DECODE, decodes, n_dec, min_dec, handoff_depth),
+        ):
+            ready = [r for r in reps if r.state == REPLICA_READY]
+            idle = (
+                len(ready) > floor
+                and n > floor
+                and depth == 0
+                and all(not r.dispatched for r in ready)
+            )
+            if idle:
+                victim = max(ready, key=lambda r: r.node_id)
+                self.job_manager.retire_node(victim.node_id)
+                self._last_scale_ts = now
+                obs.event(
+                    "serve.scale", direction="shrink", role=role,
+                    replica_id=victim.node_id, target=n - 1,
+                )
+                logger.info(
+                    "serving scale-down: retiring idle %s replica "
+                    "%d", role, victim.node_id,
+                )
+                return "shrink"
+        return None
+
     # -- read surface -------------------------------------------------------
 
     def counters(self) -> dict:
@@ -969,7 +1497,13 @@ class ServingRouter:
         CUMULATIVE (they survive ledger eviction); queued/dispatched
         scan the retained records (bounded by retention + live)."""
         with self._lock:
-            states = {"queued": 0, "dispatched": 0}
+            states = {
+                REQ_QUEUED: 0,
+                REQ_DISPATCHED: 0,
+                REQ_PREFILLING: 0,
+                REQ_HANDOFF: 0,
+                REQ_DECODING: 0,
+            }
             for rec in self._requests.values():
                 if rec.state in states:
                     states[rec.state] += 1
@@ -978,6 +1512,7 @@ class ServingRouter:
                 "requeued_total": self._requeued_total,
                 "done": self._done_total,
                 "failed": self._failed_total,
+                "handoff_bytes": self._handoff_bytes,
                 **states,
             }
 
@@ -993,6 +1528,7 @@ class ServingRouter:
                     "replica_id": rep.node_id,
                     "addr": rep.addr,
                     "state": rep.state,
+                    "role": rep.role,
                     "dispatched": len(rep.dispatched),
                     "drains": rep.drains,
                     "last_progress_age_s": round(
@@ -1007,16 +1543,42 @@ class ServingRouter:
                 )
             ]
             queue_depth = len(self._queue)
+            handoff_depth = len(self._handoff_queue)
+            handoff_bytes = self._handoff_bytes
             worst = (
                 dict(self._worst_ttft) if self._worst_ttft else None
             )
+        # Per-role rollup (obs_report --serving's disaggregation
+        # rows): replica counts and mean KV utilization by role.
+        roles: Dict[str, dict] = {}
+        for rep in replicas:
+            row = roles.setdefault(
+                rep["role"],
+                {"replicas": 0, "ready": 0, "kv_utils": []},
+            )
+            row["replicas"] += 1
+            if rep["state"] == REPLICA_READY:
+                row["ready"] += 1
+            kv = (rep["stats"] or {}).get("kv") or {}
+            if kv:
+                row["kv_utils"].append(
+                    float(kv.get("utilization", 0.0))
+                )
+        for row in roles.values():
+            utils = row.pop("kv_utils")
+            row["kv_utilization"] = round(
+                sum(utils) / len(utils), 4
+            ) if utils else 0.0
         return {
             "ts": self.clock(),
             "queue_depth": queue_depth,
+            "handoff_queue_depth": handoff_depth,
+            "handoff_bytes": handoff_bytes,
             "p99_latency_s": round(self.p99_latency(), 6),
             "qps": round(self.qps(), 4),
             "counters": self.counters(),
             "replicas": replicas,
+            "roles": roles,
             "unhealthy": sorted(unhealthy),
             "worst_ttft": worst,
         }
@@ -1038,6 +1600,24 @@ def render_serving(payload: dict) -> str:
         f"qps {payload.get('qps', 0.0):.2f}, "
         f"p99 {payload.get('p99_latency_s', 0.0):.3f}s"
     ]
+    roles = payload.get("roles") or {}
+    disagg = any(r != "mixed" for r in roles)
+    if disagg:
+        # Per-role rollup: the disaggregation dashboard line — role
+        # replica counts, the staged-handoff backlog, per-role KV.
+        for role in ("prefill", "decode", "mixed"):
+            row = roles.get(role)
+            if not row:
+                continue
+            lines.append(
+                f"  role {role:<8} {row.get('ready', 0)}/"
+                f"{row.get('replicas', 0)} ready, "
+                f"kv {100.0 * float(row.get('kv_utilization', 0.0)):.0f}%"
+            )
+        lines.append(
+            f"  handoff queue {payload.get('handoff_queue_depth', 0)}"
+            f" staged ({payload.get('handoff_bytes', 0)} B)"
+        )
     if not replicas:
         lines.append("  no replicas registered")
     for rep in replicas:
@@ -1048,7 +1628,9 @@ def render_serving(payload: dict) -> str:
         )
         lines.append(
             f"  replica {rep.get('replica_id')} "
-            f"[{mark:<9}] {rep.get('addr', '') or '-'}: "
+            f"[{mark:<9}] "
+            f"{rep.get('role', 'mixed'):<7} "
+            f"{rep.get('addr', '') or '-'}: "
             f"{rep.get('dispatched', 0)} in flight, "
             f"queue {stats.get('queue_depth', 0)}, "
             f"active {stats.get('active', 0)}, "

@@ -77,9 +77,23 @@ _TPOT_SECONDS = obs.histogram(
 PHASE_PREFILL = "prefill"
 PHASE_DECODE = "decode"
 
+# Replica roles (prefill/decode disaggregation, docs/SERVING.md):
+# MIXED is the colocated default (prefill + decode in one loop);
+# PREFILL runs only lane-chunk prefill and EXPORTS finished
+# sequences' KV as handoffs; DECODE runs only the ragged decode step,
+# admitting from handoff IMPORTS — its ticks are never preempted by a
+# prompt storm.
+ROLE_MIXED = "mixed"
+ROLE_PREFILL = "prefill"
+ROLE_DECODE = "decode"
+ROLES = (ROLE_MIXED, ROLE_PREFILL, ROLE_DECODE)
+
 FINISH_LENGTH = "length"
 FINISH_EOS = "eos"
 FINISH_ERROR = "error"
+# A prefill-role "completion" that is really a stage transition: the
+# CompletedRequest carries the KV handoff payload instead of tokens.
+FINISH_HANDOFF = "handoff"
 
 # How many recent latency samples the stats surface keeps.
 LATENCY_WINDOW = 256
@@ -97,6 +111,10 @@ class ServeRequest:
     max_new_tokens: int = 16
     temperature: float = 0.0
     trace: Dict[str, str] = dataclasses.field(default_factory=dict)
+    # Router-attached packed HandoffPayload wire dict when this item
+    # is a completed prefill bound for a decode replica. NOT part of
+    # to_dict(): on the wire it rides ServeWorkItem.handoff.
+    handoff: Optional[dict] = None
 
     def to_dict(self) -> dict:
         return {
@@ -135,8 +153,15 @@ class CompletedRequest:
     # prefill (admission -> last prompt chunk), first_decode (prefill
     # done -> first token), decode (first -> last token). dispatch +
     # prefill + first_decode == ttft_s + dispatch by construction;
-    # the router folds these into the request's trace timeline.
+    # the router folds these into the request's trace timeline. A
+    # handoff-imported completion additionally carries "handoff" (the
+    # decode replica's local import wait — the master adds its own
+    # staged wait when assembling the trace).
     phases: Dict[str, float] = dataclasses.field(default_factory=dict)
+    # Prefill-role export: set when finish_reason == FINISH_HANDOFF
+    # (a HandoffPayload — the request's KV blocks + sampling state,
+    # bound for a decode replica via the complete/pull seam).
+    handoff: Optional[object] = None
 
 
 class _Seq:
@@ -145,7 +170,8 @@ class _Seq:
     __slots__ = (
         "req", "lane", "phase", "prefilled", "generated",
         "admit_ts", "first_token_ts", "last_token_ts", "last_logits",
-        "dispatch_wait_s", "prefill_done_ts",
+        "dispatch_wait_s", "prefill_done_ts", "imported_phases",
+        "imported_ttft_s", "import_wait_s",
     )
 
     def __init__(self, req: ServeRequest, lane: int, now: float):
@@ -165,6 +191,13 @@ class _Seq:
         # Host copy of the final prefill chunk's logits row, used to
         # sample the first token at the prefill -> decode handoff.
         self.last_logits: Optional[np.ndarray] = None
+        # Handoff import (decode role): the PREFILL replica's phase
+        # decomposition and TTFT, carried so the completing replica
+        # reports the request's true end-to-end phases; None when the
+        # sequence prefilled locally.
+        self.imported_phases: Optional[Dict[str, float]] = None
+        self.imported_ttft_s = 0.0
+        self.import_wait_s = 0.0
 
     @property
     def length(self) -> int:
@@ -185,11 +218,19 @@ class ContinuousBatchingScheduler:
         max_queue: int = 1024,
         eos_id: Optional[int] = None,
         clock: Callable[[], float] = time.monotonic,
+        role: str = ROLE_MIXED,
     ):
         """``prefill_budget`` (default ``2 * prefill_chunk``) caps the
         total prompt tokens processed per step across all admitting
         sequences — the decode-latency protection knob. Llama-family
-        configs only (the ragged decode step's contract)."""
+        configs only (the ragged decode step's contract).
+
+        ``role`` selects the disaggregation mode: ``mixed`` (default,
+        colocated), ``prefill`` (prefill-only — finished prompts
+        EXPORT as KV handoffs instead of entering decode), ``decode``
+        (decode-only — admission comes from :meth:`submit_handoff`
+        imports; a raw prompt submitted here fails loudly at
+        admission, it can never prefill)."""
         from dlrover_tpu.models import generate, llama
 
         if not isinstance(cfg, llama.LlamaConfig):
@@ -197,6 +238,12 @@ class ContinuousBatchingScheduler:
                 "the serving scheduler drives the Llama-family ragged "
                 f"decode path; got config {type(cfg).__name__}"
             )
+        if role not in ROLES:
+            raise ValueError(
+                f"unknown scheduler role {role!r}; expected one of "
+                f"{ROLES}"
+            )
+        self.role = role
         self.params = params
         self.cfg = cfg
         self.lanes = lanes
@@ -218,6 +265,9 @@ class ContinuousBatchingScheduler:
             total_blocks=total_blocks,
         )
         self._queue: deque = deque()
+        # Completed-prefill imports awaiting lane admission (decode /
+        # mixed roles; HandoffPayload entries).
+        self._handoff_queue: deque = deque()
         self.max_queue = max_queue
         # request_id -> local-queue entry stamp (the "dispatch" TTFT
         # phase: scheduler submit -> lane admission). Entries leave at
@@ -230,6 +280,8 @@ class ContinuousBatchingScheduler:
         self._completed_total = 0
         self._failed_total = 0
         self._preempted_total = 0
+        self._handoffs_exported = 0
+        self._handoffs_imported = 0
         self._tokens_generated = 0
         self._ttft_recent: deque = deque(maxlen=LATENCY_WINDOW)
         self._tpot_recent: deque = deque(maxlen=LATENCY_WINDOW)
@@ -256,10 +308,17 @@ class ContinuousBatchingScheduler:
         # Real data never exceeds max_len (admission guards it); the
         # slack rows only ever hold pad garbage no causal mask can
         # expose. The rope tables extend to match so the final
-        # chunk's table slice cannot clamp either.
+        # chunk's table slice cannot clamp either. It then rounds to
+        # a KV-BLOCK multiple too: handoff installs are block-padded
+        # (handoff.py), and their write window must never cross the
+        # buffer end for the same clamping reason.
         cache_len = (
             -(-self.max_len // self.prefill_chunk)
             * self.prefill_chunk
+        )
+        cache_len = (
+            -(-cache_len // self.pool.block_size)
+            * self.pool.block_size
         )
         rope = llama.rope_table(cfg, cache_len)
         self._generate_mod = generate
@@ -291,6 +350,12 @@ class ContinuousBatchingScheduler:
         # there is exactly one token shape (jit re-caches by shape if
         # that ever changes).
         self._prefill_fn = jax.jit(prefill)
+        # Handoff install (decode/mixed roles): payloads are block-
+        # padded, so jit re-caches once per block-count bucket.
+        from dlrover_tpu.serving import handoff as handoff_mod
+
+        self._handoff_mod = handoff_mod
+        self._install_fn = handoff_mod.make_install_fn()
         self._key = jax.random.PRNGKey(0)
         self._split = jax.jit(jax.random.split)
 
@@ -309,19 +374,46 @@ class ContinuousBatchingScheduler:
         dedupe, re-admitting the id would crash the pool's
         already-resident guard."""
         rid = req.request_id
-        if self.pool.lane_of(rid) is not None or any(
-            q.request_id == rid for q in self._queue
-        ):
+        if self._known_locally(rid):
             return True
         if len(self._queue) >= self.max_queue:
             return False
         self._queue.append(req)
         self._enqueue_ts[rid] = self.clock()
-        _REPLICA_QUEUE.set(len(self._queue))
+        _REPLICA_QUEUE.set(self.queue_depth())
+        return True
+
+    def _known_locally(self, rid: str) -> bool:
+        return (
+            self.pool.lane_of(rid) is not None
+            or any(q.request_id == rid for q in self._queue)
+            or any(
+                h.request_id == rid for h in self._handoff_queue
+            )
+        )
+
+    def submit_handoff(self, payload) -> bool:
+        """Queue a completed-prefill import (decode / mixed roles)
+        for lane admission. Same dedupe contract as :meth:`submit`
+        (a requeue can race the resident copy); False = queue full.
+        Prefill-role replicas never import — they could not decode
+        the sequence."""
+        if self.role == ROLE_PREFILL:
+            raise ValueError(
+                "a prefill-role scheduler cannot import handoffs"
+            )
+        rid = payload.request_id
+        if self._known_locally(rid):
+            return True
+        if self.queue_depth() >= self.max_queue:
+            return False
+        self._handoff_queue.append(payload)
+        self._enqueue_ts[rid] = self.clock()
+        _REPLICA_QUEUE.set(self.queue_depth())
         return True
 
     def queue_depth(self) -> int:
-        return len(self._queue)
+        return len(self._queue) + len(self._handoff_queue)
 
     def active(self) -> int:
         return len(self._by_lane)
@@ -331,21 +423,31 @@ class ContinuousBatchingScheduler:
         board right now (free lanes minus already-queued) — the pull
         sizing the replica worker uses against the router."""
         return max(
-            self.pool.free_lane_count() - len(self._queue), 0
+            self.pool.free_lane_count() - self.queue_depth(), 0
         )
 
     # -- the iteration ------------------------------------------------------
 
     def step(self) -> List[CompletedRequest]:
         """One scheduler iteration; returns requests completed (or
-        failed) during it."""
+        failed) during it. Role-typed: a PREFILL scheduler never runs
+        the decode tick (finished prompts export as handoffs), a
+        DECODE scheduler never prefills (handoff imports arrive with
+        their KV already computed), MIXED does both — today's
+        colocated behavior, bit for bit."""
         self._steps += 1
         now = self.clock()
         completed: List[CompletedRequest] = []
+        if self.role != ROLE_PREFILL:
+            self._admit_handoffs(now, completed)
         self._admit(now, completed)
-        self._prefill_tick(now)
-        completed.extend(self._decode_tick(now))
-        _REPLICA_QUEUE.set(len(self._queue))
+        if self.role != ROLE_DECODE:
+            self._prefill_tick(now)
+        if self.role == ROLE_PREFILL:
+            completed.extend(self._export_tick(now))
+        else:
+            completed.extend(self._decode_tick(now))
+        _REPLICA_QUEUE.set(self.queue_depth())
         _ACTIVE_SEQS.set(len(self._by_lane))
         return completed
 
@@ -356,7 +458,8 @@ class ContinuousBatchingScheduler:
             req = self._queue[0]
             total = len(req.prompt) + req.max_new_tokens
             if (
-                not req.prompt
+                self.role == ROLE_DECODE
+                or not req.prompt
                 or req.max_new_tokens < 1
                 or total > self.max_len
                 or self.pool.blocks_for(total) > self.pool.total_blocks
@@ -369,7 +472,14 @@ class ContinuousBatchingScheduler:
                         tokens=[],
                         finish_reason=FINISH_ERROR,
                         error=(
-                            "empty prompt"
+                            # A raw prompt on a decode-only replica
+                            # is a routing bug: fail it loudly at
+                            # admission — this replica can never
+                            # prefill it.
+                            "decode-role replica cannot prefill "
+                            "prompts"
+                            if self.role == ROLE_DECODE
+                            else "empty prompt"
                             if not req.prompt
                             else "max_new_tokens must be >= 1"
                             if req.max_new_tokens < 1
@@ -393,6 +503,148 @@ class ContinuousBatchingScheduler:
                 now - self._enqueue_ts.pop(req.request_id, now), 0.0
             )
             self._by_lane[lane] = seq
+
+    def _admit_handoffs(
+        self, now: float, completed: List[CompletedRequest]
+    ) -> None:
+        """Admit completed-prefill imports: claim a lane + blocks
+        (the SAME budget accounting raw admission pays — a handoff
+        cannot smuggle KV past the pool), install the payload's
+        block-padded KV into the lane via the jitted install program,
+        and enter the batch directly in the DECODE phase with the
+        prefill replica's first token as ``generated[0]`` — exactly
+        the state a colocated scheduler is in after its own prefill,
+        so greedy continuation is bitwise identical."""
+        import jax.numpy as jnp
+
+        while self._handoff_queue:
+            h = self._handoff_queue[0]
+            plen = h.prompt_len
+            total = plen + h.max_new_tokens
+            if (
+                plen < 1
+                or h.max_new_tokens < 1
+                or total > self.max_len
+                or h.k.shape[1] > self._cache.k.shape[2]
+                or self.pool.blocks_for(total) > self.pool.total_blocks
+            ):
+                self._handoff_queue.popleft()
+                self._enqueue_ts.pop(h.request_id, None)
+                completed.append(
+                    CompletedRequest(
+                        request_id=h.request_id,
+                        tokens=[],
+                        finish_reason=FINISH_ERROR,
+                        error=(
+                            f"handoff of {plen}+{h.max_new_tokens} "
+                            "tokens exceeds replica capacity "
+                            f"(max_len {self.max_len}, cache "
+                            f"{self._cache.k.shape[2]}, "
+                            f"{self.pool.total_blocks} blocks)"
+                        ),
+                    )
+                )
+                self._failed_total += 1
+                continue
+            lane = self.pool.allocate(h.request_id, plen)
+            if lane is None:
+                break  # no lane / no blocks: stays queued
+            self._handoff_queue.popleft()
+            self._cache = self._install_fn(
+                self._cache,
+                jnp.asarray(h.k, self._cache.k.dtype),
+                jnp.asarray(h.v, self._cache.v.dtype),
+                lane,
+            )
+            req = ServeRequest(
+                request_id=h.request_id,
+                prompt=list(h.prompt),
+                max_new_tokens=h.max_new_tokens,
+                temperature=h.temperature,
+                trace=dict(h.trace or {}),
+            )
+            seq = _Seq(req, lane, now)
+            seq.prefilled = plen
+            seq.phase = PHASE_DECODE
+            seq.generated = [int(h.first_token)]
+            # The first token already exists (sampled on the prefill
+            # replica): TPOT intervals start at import, and the
+            # prefill-side TTFT decomposition rides through to the
+            # completion report.
+            seq.first_token_ts = now
+            seq.last_token_ts = now
+            seq.prefill_done_ts = now
+            seq.imported_phases = dict(h.phases or {})
+            seq.imported_ttft_s = h.ttft_s
+            seq.import_wait_s = max(
+                now - self._enqueue_ts.pop(h.request_id, now), 0.0
+            )
+            self._by_lane[lane] = seq
+            self._handoffs_imported += 1
+            self._tokens_generated += 1
+            self._handoff_mod.note_outcome("imported")
+            trace_id = req.trace.get("trace_id", "")
+            obs.event(
+                "serve.handoff_import",
+                request_id=h.request_id,
+                lane=lane,
+                prompt_len=plen,
+                **({"trace_id": trace_id} if trace_id else {}),
+            )
+
+    def _export_tick(self, now: float) -> List[CompletedRequest]:
+        """Prefill-role counterpart of the decode tick: sequences
+        whose prompt just finished (phase flipped to DECODE at the
+        first-token sample) leave the batch as either a finished
+        request (max_new_tokens == 1, or the first token was EOS) or
+        a KV handoff bound for a decode replica."""
+        completed: List[CompletedRequest] = []
+        for seq in list(self._by_lane.values()):
+            if seq.phase != PHASE_DECODE:
+                continue
+            if self._finished(seq):
+                completed.append(self._retire(seq, now))
+                continue
+            payload = self._handoff_mod.export_handoff(
+                self._cache,
+                seq.lane,
+                len(seq.req.prompt),
+                self.pool.block_size,
+                seq.req,
+                seq.generated[0],
+                ttft_s=round(seq.first_token_ts - seq.admit_ts, 6),
+                phases={
+                    "dispatch": round(seq.dispatch_wait_s, 6),
+                    "prefill": round(
+                        seq.prefill_done_ts - seq.admit_ts, 6
+                    ),
+                    "first_decode": round(
+                        seq.first_token_ts - seq.prefill_done_ts, 6
+                    ),
+                },
+            )
+            self.pool.release(seq.req.request_id)
+            self._by_lane.pop(seq.lane, None)
+            self._handoffs_exported += 1
+            completed.append(
+                CompletedRequest(
+                    request_id=seq.req.request_id,
+                    tokens=[],
+                    finish_reason=FINISH_HANDOFF,
+                    ttft_s=payload.ttft_s,
+                    wall_s=round(now - seq.admit_ts, 6),
+                    phases=dict(payload.phases),
+                    handoff=payload,
+                )
+            )
+            trace_id = seq.req.trace.get("trace_id", "")
+            obs.event(
+                "serve.handoff_export",
+                request_id=seq.req.request_id,
+                prompt_len=len(seq.req.prompt),
+                **({"trace_id": trace_id} if trace_id else {}),
+            )
+        return completed
 
     def _prefill_tick(self, now: float) -> None:
         """Advance PREFILL sequences by bounded chunks. Ragged final
@@ -578,21 +830,37 @@ class ContinuousBatchingScheduler:
             else FINISH_LENGTH
         )
         prefill_done = seq.prefill_done_ts or seq.first_token_ts
-        phases = {
-            "dispatch": round(seq.dispatch_wait_s, 6),
-            "prefill": round(prefill_done - seq.admit_ts, 6),
-            "first_decode": round(
-                seq.first_token_ts - prefill_done, 6
-            ),
-            "decode": round(
-                seq.last_token_ts - seq.first_token_ts, 6
-            ),
-        }
+        if seq.imported_phases is not None:
+            # Handoff-imported: the prefill replica's decomposition
+            # (dispatch/prefill/first_decode) rides through; this
+            # replica contributes its local import wait ("handoff")
+            # and the decode span. TTFT is the prefill replica's —
+            # the first token existed before the handoff.
+            phases = {
+                **seq.imported_phases,
+                "handoff": round(seq.import_wait_s, 6),
+                "decode": round(
+                    seq.last_token_ts - seq.first_token_ts, 6
+                ),
+            }
+            ttft = seq.imported_ttft_s
+        else:
+            phases = {
+                "dispatch": round(seq.dispatch_wait_s, 6),
+                "prefill": round(prefill_done - seq.admit_ts, 6),
+                "first_decode": round(
+                    seq.first_token_ts - prefill_done, 6
+                ),
+                "decode": round(
+                    seq.last_token_ts - seq.first_token_ts, 6
+                ),
+            }
+            ttft = seq.first_token_ts - seq.admit_ts
         return CompletedRequest(
             request_id=seq.req.request_id,
             tokens=list(seq.generated),
             finish_reason=reason,
-            ttft_s=round(seq.first_token_ts - seq.admit_ts, 6),
+            ttft_s=round(ttft, 6),
             tpot_s=round(tpot, 6),
             wall_s=round(now - seq.admit_ts, 6),
             phases=phases,
@@ -638,6 +906,20 @@ class ContinuousBatchingScheduler:
         self._by_lane.clear()
         out.extend(self._queue)
         self._queue.clear()
+        # Queued handoff imports requeue as their raw requests (the
+        # KV stays behind with this incarnation; the router's
+        # re-prefill path recomputes it — exact for greedy).
+        for h in self._handoff_queue:
+            out.append(
+                ServeRequest(
+                    request_id=h.request_id,
+                    prompt=list(h.prompt),
+                    max_new_tokens=h.max_new_tokens,
+                    temperature=h.temperature,
+                    trace=dict(h.trace or {}),
+                )
+            )
+        self._handoff_queue.clear()
         self._enqueue_ts.clear()
         _REPLICA_QUEUE.set(0)
         _ACTIVE_SEQS.set(0)
@@ -656,12 +938,16 @@ class ContinuousBatchingScheduler:
         """The replica's telemetry snapshot (ServeStatsReport payload
         + obs_report --serving rows)."""
         return {
+            "role": self.role,
             "steps": self._steps,
             "queue_depth": len(self._queue),
+            "handoff_queue_depth": len(self._handoff_queue),
             "active": len(self._by_lane),
             "completed_total": self._completed_total,
             "failed_total": self._failed_total,
             "preempted_total": self._preempted_total,
+            "handoffs_exported": self._handoffs_exported,
+            "handoffs_imported": self._handoffs_imported,
             "tokens_generated": self._tokens_generated,
             "kv": self.pool.snapshot(),
             "ttft_p50_s": round(self._pct(self._ttft_recent, 50), 6),

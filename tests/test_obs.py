@@ -530,6 +530,13 @@ class TestMetricNameHygiene:
             "dlrover_serve_replica_restarts_total": (
                 "counter", ["reason"],
             ),
+            # Prefill/decode disaggregation (serving/handoff.py +
+            # router role surface).
+            "dlrover_serve_handoff_total": ("counter", ["outcome"]),
+            "dlrover_serve_handoff_bytes": ("gauge", None),
+            "dlrover_serve_handoff_queue_depth": ("gauge", None),
+            "dlrover_serve_handoff_seconds": ("histogram", None),
+            "dlrover_serve_role_replicas": ("gauge", ["role"]),
         }
         problems = {}
         for name, want in expected.items():

@@ -609,6 +609,565 @@ class TestRouter:
         assert decoded.items[0].temperature == 0.5
 
 
+class TestHandoffPayload:
+    def _payload(self, rid="h", plen=3):
+        import numpy as np
+
+        from dlrover_tpu.serving.handoff import HandoffPayload
+
+        zeros = np.arange(
+            2 * 8 * 2 * 4, dtype=np.float32
+        ).reshape(2, 8, 2, 4)
+        return HandoffPayload(
+            request_id=rid,
+            prompt=list(range(1, plen + 1)),
+            max_new_tokens=4,
+            temperature=0.0,
+            first_token=7,
+            k=zeros,
+            v=zeros + 1.0,
+            ttft_s=0.25,
+            phases={"dispatch": 0.01, "prefill": 0.2,
+                    "first_decode": 0.04},
+            trace={"trace_id": "t", "span_id": "s"},
+        )
+
+    def test_pack_unpack_roundtrip_bitwise(self):
+        import numpy as np
+
+        from dlrover_tpu.serving import handoff as hmod
+
+        p = self._payload()
+        got = hmod.unpack(hmod.pack(p))
+        assert got.request_id == p.request_id
+        assert got.prompt == p.prompt
+        assert got.first_token == 7
+        assert got.phases == p.phases
+        np.testing.assert_array_equal(got.k, p.k)
+        np.testing.assert_array_equal(got.v, p.v)
+        assert hmod.payload_nbytes(hmod.pack(p)) == p.nbytes()
+
+    def test_handoff_rides_the_completion_wire(self):
+        """The packed payload survives the msgpack RPC envelope
+        (ServeCompletedReport up, ServeWorkItem down) with its KV
+        bytes bitwise intact — no pickle anywhere."""
+        import numpy as np
+
+        from dlrover_tpu.common import messages as msg
+        from dlrover_tpu.serving import handoff as hmod
+
+        wire = hmod.pack(self._payload())
+        up = msg.deserialize(
+            msg.serialize(
+                msg.ServeCompletedReport(
+                    replica_id=1, request_id="h",
+                    finish_reason="handoff", handoff=wire,
+                )
+            )
+        )
+        down = msg.deserialize(
+            msg.serialize(
+                msg.ServeWorkItem(
+                    request_id="h", handoff=up.handoff
+                )
+            )
+        )
+        got = hmod.unpack(down.handoff)
+        np.testing.assert_array_equal(got.k, self._payload().k)
+        assert got.first_token == 7
+
+
+class TestSchedulerRoles:
+    def test_unknown_role_rejected(self, tiny_model):
+        params, cfg = tiny_model
+        with pytest.raises(ValueError, match="role"):
+            ContinuousBatchingScheduler(
+                params, cfg, lanes=1, role="turbo"
+            )
+
+    def test_decode_role_fails_raw_prompts_loudly(self, tiny_model):
+        params, cfg = tiny_model
+        sched = ContinuousBatchingScheduler(
+            params, cfg, lanes=1, block_size=8, max_len=32,
+            role="decode",
+        )
+        sched.submit(
+            ServeRequest(request_id="raw", prompt=[1, 2],
+                         max_new_tokens=4)
+        )
+        failed = {c.request_id: c for c in sched.step()}
+        assert "cannot prefill" in failed["raw"].error
+        with pytest.raises(ValueError, match="prefill-role"):
+            ContinuousBatchingScheduler(
+                params, cfg, lanes=1, block_size=8, max_len=32,
+                role="prefill",
+            ).submit_handoff(object())
+
+    @pytest.mark.slow
+    def test_disagg_pipeline_bitwise_matches_generate(
+        self, tiny_model
+    ):
+        """(slow: ~25s of compiles; tier-1 gets the same bitwise
+        guarantee end-to-end over RPC from
+        test_disagg_interference_drill.)
+
+        The tentpole correctness claim at scheduler level: a
+        prefill-role scheduler exports KV handoffs, a decode-role
+        scheduler (with a DIFFERENT block size — payloads are
+        self-describing) imports them, and every greedy continuation
+        is bitwise the colocated ``generate.generate`` tokens.
+        Covers multi-chunk prompts, max_new_tokens=1 finishing on
+        the prefill replica outright, and the import-wait 'handoff'
+        phase on completions."""
+        params, cfg = tiny_model
+        pre = ContinuousBatchingScheduler(
+            params, cfg, lanes=2, block_size=4, prefill_chunk=4,
+            max_len=32, role="prefill",
+        )
+        dec = ContinuousBatchingScheduler(
+            params, cfg, lanes=3, block_size=8, prefill_chunk=8,
+            max_len=32, role="decode",
+        )
+        rng = np.random.default_rng(5)
+        reqs = []
+        for i in range(4):
+            plen = int(rng.integers(3, 12))
+            prompt = rng.integers(
+                0, cfg.vocab_size, size=plen
+            ).tolist()
+            reqs.append(
+                ServeRequest(
+                    request_id=f"r{i}", prompt=prompt,
+                    max_new_tokens=6,
+                )
+            )
+            assert pre.submit(reqs[-1])
+        one = ServeRequest(
+            request_id="one", prompt=[3, 1, 4], max_new_tokens=1
+        )
+        assert pre.submit(one)
+        done = {}
+        for _ in range(400):
+            for c in pre.step():
+                if c.finish_reason == "handoff":
+                    assert dec.submit_handoff(c.handoff)
+                else:
+                    done[c.request_id] = c
+            for c in dec.step():
+                done[c.request_id] = c
+            if len(done) == len(reqs) + 1:
+                break
+        assert len(done) == len(reqs) + 1
+        # max_new_tokens=1 finished ON the prefill scheduler (its
+        # only token comes from prefill; nothing to hand off).
+        assert done["one"].finish_reason == "length"
+        assert done["one"].tokens == _greedy_reference(
+            params, cfg, one.prompt, 1
+        )
+        assert "handoff" not in done["one"].phases
+        for r in reqs:
+            want = _greedy_reference(
+                params, cfg, r.prompt, r.max_new_tokens
+            )
+            assert done[r.request_id].tokens == want, r.request_id
+            assert "handoff" in done[r.request_id].phases
+        assert pre.stats()["handoffs_exported"] == len(reqs)
+        assert dec.stats()["handoffs_imported"] == len(reqs)
+        assert pre.stats()["role"] == "prefill"
+        # Both pools fully drained.
+        assert pre.pool.blocks_in_use() == 0
+        assert dec.pool.blocks_in_use() == 0
+
+    def test_handoff_import_gates_on_block_budget(self, tiny_model):
+        """A handoff import pays the SAME pool accounting as raw
+        admission: with no block budget it stays queued (and a
+        too-long one fails cleanly)."""
+        import numpy as np
+
+        from dlrover_tpu.serving.handoff import HandoffPayload
+
+        params, cfg = tiny_model
+        dec = ContinuousBatchingScheduler(
+            params, cfg, lanes=2, block_size=8, max_len=32,
+            total_blocks=3, role="decode",
+        )
+        L = cfg.n_layer
+        kv = np.zeros((L, 16, cfg.n_kv_head, cfg.head_dim),
+                      np.float32)
+
+        def payload(rid, plen, max_new=4):
+            return HandoffPayload(
+                request_id=rid, prompt=list(range(plen)),
+                max_new_tokens=max_new, temperature=0.0,
+                first_token=1, k=kv[:, :16], v=kv[:, :16],
+            )
+
+        assert dec.submit_handoff(payload("a", 14))  # 2 blocks
+        assert dec.submit_handoff(payload("b", 14))  # budget-blocked
+        dec.step()
+        assert dec.active() == 1
+        assert dec.queue_depth() == 1  # b waits for blocks
+        too_long = payload("c", 14, max_new=32)  # 14+32 > max_len
+        dec2 = ContinuousBatchingScheduler(
+            params, cfg, lanes=2, block_size=8, max_len=32,
+            role="decode",
+        )
+        assert dec2.submit_handoff(too_long)
+        failed = {c.request_id: c for c in dec2.step()}
+        assert "exceeds replica capacity" in failed["c"].error
+
+    def test_drain_requeues_queued_handoffs_as_prompts(
+        self, tiny_model
+    ):
+        import numpy as np
+
+        from dlrover_tpu.serving.handoff import HandoffPayload
+
+        params, cfg = tiny_model
+        dec = ContinuousBatchingScheduler(
+            params, cfg, lanes=1, block_size=8, max_len=32,
+            role="decode",
+        )
+        kv = np.zeros(
+            (cfg.n_layer, 8, cfg.n_kv_head, cfg.head_dim),
+            np.float32,
+        )
+        dec.submit_handoff(
+            HandoffPayload(
+                request_id="q", prompt=[1, 2, 3],
+                max_new_tokens=4, temperature=0.5,
+                first_token=1, k=kv, v=kv,
+            )
+        )
+        drained = dec.drain()
+        assert [r.request_id for r in drained] == ["q"]
+        assert drained[0].prompt == [1, 2, 3]
+        assert drained[0].temperature == 0.5
+
+
+class FakeLabeledJobManager(FakeJobManager):
+    def ensure_role(self, node_type, count, resource=None,
+                    labels=None):
+        self.ensured.append((node_type, count, labels))
+        return []
+
+
+class TestRouterDisagg:
+    def _router(self, **config):
+        clk = [1000.0]
+        cfg = {"progress_timeout_s": 5.0, "scale_cooldown_s": 0.0}
+        cfg.update(config)
+        router = ServingRouter(
+            job_manager=FakeLabeledJobManager(),
+            clock=lambda: clk[0],
+            config=cfg,
+        )
+        return router, clk
+
+    def _wire(self, rid, plen=3, nbytes_scale=1):
+        import numpy as np
+
+        from dlrover_tpu.serving import handoff as hmod
+
+        kv = np.zeros((2, 8 * nbytes_scale, 2, 4), np.float32)
+        return hmod.pack(
+            hmod.HandoffPayload(
+                request_id=rid, prompt=list(range(plen)),
+                max_new_tokens=4, temperature=0.0,
+                first_token=1, k=kv, v=kv,
+                ttft_s=0.1,
+                phases={"dispatch": 0.0, "prefill": 0.08,
+                        "first_decode": 0.02},
+            )
+        )
+
+    def test_two_stage_lifecycle(self):
+        router, clk = self._router()
+        router.register_replica(1, "pre", role="prefill")
+        router.register_replica(2, "dec", role="decode")
+        rid = router.submit([1, 2, 3], request_id="x")
+        assert router.pull(2, max_items=2) == []  # raw never to dec
+        assert router.pull(1, max_items=2)
+        assert router.result("x")["state"] == "prefilling"
+        assert router.complete(1, "x", [], handoff=self._wire("x"))
+        assert router.result("x")["state"] == "handoff"
+        assert router.snapshot()["handoff_queue_depth"] == 1
+        assert router.pull(1, max_items=1) == []  # handoff never to pre
+        out = router.pull(2, max_items=1)
+        assert out and out[0].handoff
+        assert router.result("x")["state"] == "decoding"
+        # payload left the master at dispatch (bounded RAM)
+        assert router.counters()["handoff_bytes"] == 0
+        clk[0] += 1.0
+        assert router.complete(
+            2, "x", [1, 2, 3, 4], ttft_s=0.1, tpot_s=0.01,
+            finish_reason="length",
+            phases={"dispatch": 0.0, "prefill": 0.08,
+                    "first_decode": 0.02, "handoff": 0.01,
+                    "decode": 0.05},
+        )
+        rec = router.result("x")
+        assert rec["state"] == "done"
+        assert "handoff" in rec["phases"]
+        total = sum(
+            rec["phases"][k]
+            for k in ("queue", "dispatch", "prefill", "first_decode")
+        )
+        assert rec["phases"]["ttft_total"] == pytest.approx(
+            total, abs=1e-6
+        )
+        # A late duplicate handoff from a stale replica is dropped.
+        assert not router.complete(
+            1, "x", [], handoff=self._wire("x")
+        )
+
+    def test_requeue_semantics_per_role(self):
+        """A prefill-replica death recomputes the prompt; a
+        decode-replica death re-prefills; a STAGED handoff (owned by
+        the master) survives either death."""
+        router, clk = self._router()
+        router.register_replica(1, "pre", role="prefill")
+        router.register_replica(2, "dec", role="decode")
+        for rid in ("a", "b", "c"):
+            router.submit([1, 2], request_id=rid)
+        assert len(router.pull(1, max_items=3)) == 3
+        # a stays prefilling on 1; b reaches handoff; c reaches dec.
+        router.complete(1, "b", [], handoff=self._wire("b"))
+        router.complete(1, "c", [], handoff=self._wire("c"))
+        assert [r.request_id for r in router.pull(2, max_items=1)] \
+            == ["b"]
+        assert router.result("a")["state"] == "prefilling"
+        assert router.result("b")["state"] == "decoding"
+        assert router.result("c")["state"] == "handoff"
+        # Decode replica dies: b re-prefills (KV lost with it).
+        assert router.replica_gone(2) == 1
+        assert router.result("b")["state"] == "queued"
+        # Prefill replica dies: a requeues; the STAGED c survives.
+        assert router.replica_gone(1) == 1
+        assert router.result("a")["state"] == "queued"
+        assert router.result("c")["state"] == "handoff"
+        # A mixed replica can serve both stages: raw first, then
+        # staged handoffs.
+        router.register_replica(3, "mix", role="mixed")
+        first = router.pull(3, max_items=2)
+        assert sorted(r.request_id for r in first) == ["a", "b"]
+        assert all(r.handoff is None for r in first)
+        nxt = router.pull(3, max_items=1)
+        assert nxt[0].request_id == "c" and nxt[0].handoff
+        assert router.result("c")["state"] == "decoding"
+
+    def test_dispatched_payload_not_pinned_by_ledger(self):
+        """Regression (review): the KV payload attached to the work
+        item at decode dispatch must not stay referenced off the
+        finished (or requeued) ledger record — a retained reference
+        would pin up to ledger_retention payloads of dead KV bytes
+        in master RAM, silently breaking the handoff_max_bytes
+        bound."""
+        router, clk = self._router()
+        router.register_replica(1, "pre", role="prefill")
+        router.register_replica(2, "dec", role="decode")
+        router.submit([1, 2], request_id="a")
+        router.submit([3, 4], request_id="b")
+        router.pull(1, max_items=2)
+        router.complete(1, "a", [], handoff=self._wire("a"))
+        router.complete(1, "b", [], handoff=self._wire("b"))
+        out = router.pull(2, max_items=2)
+        assert all(r.handoff for r in out)
+        router.complete(2, "a", [1, 2], finish_reason="length")
+        assert router._requests["a"].req.handoff is None
+        # ...and on the re-prefill requeue path too.
+        router.replica_gone(2)
+        assert router._requests["b"].req.handoff is None
+
+    def test_oversize_payload_fails_terminally(self):
+        """Regression (review): a payload bigger than the WHOLE
+        handoff_max_bytes budget can never be staged; requeueing it
+        would re-prefill -> overflow forever in a pure
+        prefill+decode fleet, so it must fail with the reason
+        surfaced to the caller."""
+        router, clk = self._router(handoff_max_bytes=100.0)
+        router.register_replica(1, "pre", role="prefill")
+        router.submit([1, 2], request_id="huge")
+        router.pull(1, max_items=1)
+        assert router.complete(
+            1, "huge", [], handoff=self._wire("huge", nbytes_scale=4)
+        )
+        rec = router.result("huge")
+        assert rec["state"] == "failed"
+        assert "handoff_max_bytes" in rec["error"]
+        assert router.snapshot()["handoff_queue_depth"] == 0
+        assert router.counters()["failed"] == 1
+
+    def test_handoff_overflow_falls_back_to_recompute(self):
+        # Base payload is 1024 B: one fits the 1500 B budget alone,
+        # two do not — the second OVERFLOWS (requeued to the prompt
+        # stage for recompute once staging drains, never dropped).
+        router, clk = self._router(handoff_max_bytes=1500.0)
+        router.register_replica(1, "pre", role="prefill")
+        router.register_replica(2, "dec", role="decode")
+        router.submit([1, 2], request_id="a")
+        router.submit([3, 4], request_id="big")
+        router.pull(1, max_items=2)
+        assert router.complete(1, "a", [], handoff=self._wire("a"))
+        assert router.complete(
+            1, "big", [], handoff=self._wire("big")
+        )
+        rec = router.result("big")
+        assert rec["state"] == "queued"
+        assert rec["requeues"] == 1
+        assert router.snapshot()["handoff_queue_depth"] == 1
+        # Once a decode pull drains the store, the recompute's next
+        # handoff stages cleanly.
+        router.pull(2, max_items=1)
+        router.pull(1, max_items=1)
+        assert router.complete(
+            1, "big", [], handoff=self._wire("big")
+        )
+        assert router.result("big")["state"] == "handoff"
+
+    def test_handoff_accepted_after_requeue_race(self):
+        """A requeue (re-registration) can beat the original prefill
+        replica's handoff report; the prefill IS done, so the late
+        handoff wins over the queued copy."""
+        router, clk = self._router()
+        router.register_replica(1, "pre", role="prefill")
+        router.submit([1, 2], request_id="r")
+        router.pull(1, max_items=1)
+        router.register_replica(1, "pre", role="prefill")  # requeues r
+        assert router.result("r")["state"] == "queued"
+        assert router.complete(1, "r", [], handoff=self._wire("r"))
+        assert router.result("r")["state"] == "handoff"
+        # The stale queued copy cannot be double-dispatched.
+        assert router.pull(1, max_items=2) == []
+
+    def test_per_role_autoscale_grow_and_shrink(self):
+        from dlrover_tpu.common.constants import NodeType
+
+        router, clk = self._router(
+            backlog_per_replica=2.0,
+            handoff_backlog_per_decode=2.0,
+            min_prefill=1, max_prefill=4,
+            min_decode=1, max_decode=4,
+        )
+        router.register_replica(1, "pre", role="prefill")
+        router.register_replica(2, "dec", role="decode")
+        # Raw backlog grows the PREFILL role (labeled target).
+        for i in range(5):
+            router.submit([i], request_id=f"q{i}")
+        assert router.maybe_autoscale() == "grow"
+        assert (
+            NodeType.REPLICA, 2, {"serving_role": "prefill"}
+        ) in router.job_manager.ensured
+        # Staged-handoff backlog grows the DECODE role.
+        router.job_manager.ensured.clear()
+        pulled = router.pull(1, max_items=5)
+        for r in pulled:
+            router.complete(1, r.request_id,
+                            [], handoff=self._wire(r.request_id))
+        assert router.snapshot()["handoff_queue_depth"] == 5
+        assert router.maybe_autoscale() == "grow"
+        assert (
+            NodeType.REPLICA, 2, {"serving_role": "decode"}
+        ) in router.job_manager.ensured
+        # KV pressure on decode replicas also grows decode.
+        router.job_manager.ensured.clear()
+        for r in router.pull(2, max_items=5):
+            router.complete(
+                2, r.request_id, [1, 2], finish_reason="length"
+            )
+        router.report_stats(
+            2, {"tokens_generated": 10, "kv": {"utilization": 0.97}}
+        )
+        assert router.maybe_autoscale() == "grow"
+        assert (
+            NodeType.REPLICA, 2, {"serving_role": "decode"}
+        ) in router.job_manager.ensured
+        # Idle roles shrink toward their floors, one per tick.
+        router.report_stats(
+            2, {"tokens_generated": 10, "kv": {"utilization": 0.1}}
+        )
+        router.register_replica(3, "pre2", role="prefill")
+        clk[0] += 120.0
+        assert router.maybe_autoscale() == "shrink"
+        assert router.job_manager.retired == [3]
+
+    def test_unhealthy_facts_carry_role(self):
+        router, clk = self._router()
+        router.register_replica(1, "pre", role="prefill")
+        router.submit([1], request_id="r")
+        router.pull(1, max_items=1)
+        clk[0] += 6.0
+        facts = router.unhealthy_replicas()
+        assert facts and facts[0]["role"] == "prefill"
+
+
+class TestEnsureRoleLabels:
+    def test_labeled_targets_are_independent(self):
+        from dlrover_tpu.common.constants import (
+            NodeType,
+            replica_node_id,
+        )
+        from dlrover_tpu.master.job_manager import (
+            JobManager,
+            Scaler,
+        )
+
+        jm = JobManager(scaler=Scaler())
+        pre = jm.ensure_role(
+            NodeType.REPLICA, 2,
+            labels={"serving_role": "prefill"},
+        )
+        assert len(pre) == 2
+        assert [n.id for n in pre] == [
+            replica_node_id(0), replica_node_id(1)
+        ]
+        for n in pre:
+            n.update_status("running")
+        # A decode target of 2 counts ZERO of the prefill nodes and
+        # claims the next free namespaced ids.
+        dec = jm.ensure_role(
+            NodeType.REPLICA, 2,
+            labels={"serving_role": "decode"},
+        )
+        assert len(dec) == 2
+        assert [n.id for n in dec] == [
+            replica_node_id(2), replica_node_id(3)
+        ]
+        assert all(
+            n.labels == {"serving_role": "decode"} for n in dec
+        )
+        # Re-asking for 2 prefill is a no-op; unlabeled count sees
+        # all four alive... once the decode pair runs.
+        for n in dec:
+            n.update_status("running")
+        assert jm.ensure_role(
+            NodeType.REPLICA, 2,
+            labels={"serving_role": "prefill"},
+        ) == []
+        assert jm.ensure_role(NodeType.REPLICA, 4) == []
+
+    def test_replacement_inherits_role_labels(self):
+        from dlrover_tpu.common.constants import (
+            NodeType,
+            replica_node_id,
+        )
+        from dlrover_tpu.master.job_manager import (
+            JobManager,
+            Scaler,
+        )
+
+        jm = JobManager(scaler=Scaler())
+        node = jm.register_node(
+            node_type=NodeType.REPLICA,
+            node_id=replica_node_id(0),
+            labels={"serving_role": "prefill"},
+        )
+        repl = jm.launch_replacement(
+            node, reason="test", node_id=replica_node_id(1)
+        )
+        assert repl.labels == {"serving_role": "prefill"}
+
+
 class TestReplicaUnhealthyDetector:
     def _monitor(self, serving):
         from dlrover_tpu.obs.health import HealthMonitor
@@ -937,6 +1496,8 @@ class TestDecodeLoopHostSyncAudit:
                         f".{f.attr}() in the serving decode path"
                     )
 
+        from dlrover_tpu.serving import handoff as handoff_mod
+
         for fn, where in (
             (generate.llama_decode_step_ragged,
              "llama_decode_step_ragged"),
@@ -950,6 +1511,13 @@ class TestDecodeLoopHostSyncAudit:
              "_apply_rope_gathered"),
             (ContinuousBatchingScheduler._build_programs,
              "ContinuousBatchingScheduler._build_programs"),
+            # Disaggregation: the decode replica's jitted KV-install
+            # program builder must be as host-sync-free as the
+            # decode step it feeds (the EXPORT path's np.asarray is
+            # the prefill replica's deliberate product and lives in
+            # export_handoff, outside this audit by design).
+            (handoff_mod.make_install_fn,
+             "handoff.make_install_fn"),
         ):
             audit(textwrap.dedent(inspect.getsource(fn)), where)
 
@@ -980,6 +1548,36 @@ class TestServeDrill:
             f"stderr:\n{proc.stderr}"
         )
         assert "serve drill selftest ok" in proc.stdout
+
+    def test_disagg_interference_drill(self):
+        """The ISSUE-15 acceptance drill: (1) with a long-prompt
+        storm running, disaggregated p99 stream TPOT beats colocated
+        on the same workload (virtual per-replica clocks over real
+        measured step costs — per-lane TPOT histogram values); (2) a
+        real 2-prefill + 1-decode subprocess fleet completes every
+        request through a SIGKILL of one prefill replica (zero
+        drops), outputs bitwise equal to ``generate.generate``
+        through the handoff, and the request trace shows the
+        prefill -> handoff -> decode hop chain."""
+        env = dict(os.environ)
+        env["JAX_PLATFORMS"] = "cpu"
+        env.pop("DLROVER_TPU_CHAOS", None)
+        proc = subprocess.run(
+            [
+                sys.executable,
+                os.path.join(REPO, "tools", "serve_drill.py"),
+                "--disagg",
+            ],
+            env=env,
+            capture_output=True,
+            text=True,
+            timeout=420,
+        )
+        assert proc.returncode == 0, (
+            f"disagg drill failed\nstdout:\n{proc.stdout}\n"
+            f"stderr:\n{proc.stderr}"
+        )
+        assert "disagg drill ok" in proc.stdout
 
 
 def test_scheduler_rejects_non_llama_config():

@@ -795,6 +795,92 @@ def _selftest_serving() -> list:
         import os as _os
 
         _os.unlink(path)
+    errors.extend(_selftest_serving_disagg())
+    return errors
+
+
+def _selftest_serving_disagg() -> list:
+    """Disaggregated snapshot hermetically: a prefill + decode fleet
+    moves one request through prefilling -> handoff -> decoding ->
+    done, and the ``--serving`` renderer shows the per-role rows,
+    the staged-handoff queue, and per-role KV utilization."""
+    import numpy as np
+
+    from dlrover_tpu.serving import handoff as hmod
+    from dlrover_tpu.serving.router import (
+        ServingRouter,
+        render_serving,
+    )
+
+    errors = []
+    clk = [2000.0]
+    router = ServingRouter(
+        clock=lambda: clk[0],
+        config={"progress_timeout_s": 5.0},
+    )
+    router.register_replica(200, addr="pre-a", role="prefill")
+    router.register_replica(201, addr="dec-a", role="decode")
+    rid = router.submit([1, 2, 3], max_new_tokens=4)
+    if router.pull(201, max_items=1) != []:
+        errors.append("decode replica was fed a raw prompt")
+    items = router.pull(200, max_items=1)
+    if not items or router.result(rid)["state"] != "prefilling":
+        errors.append(
+            f"prefill dispatch wrong: {router.result(rid)}"
+        )
+    zeros = np.zeros((2, 8, 2, 4), np.float32)
+    wire = hmod.pack(
+        hmod.HandoffPayload(
+            rid, [1, 2, 3], 4, 0.0, 9, zeros, zeros,
+            ttft_s=0.1,
+            phases={
+                "dispatch": 0.0, "prefill": 0.08,
+                "first_decode": 0.02,
+            },
+        )
+    )
+    router.complete(200, rid, [], handoff=wire)
+    if router.result(rid)["state"] != "handoff":
+        errors.append(
+            f"handoff staging wrong: {router.result(rid)}"
+        )
+    router.report_stats(
+        201,
+        {
+            "role": "decode", "queue_depth": 0, "active": 1,
+            "tokens_generated": 1, "kv": {"utilization": 0.25},
+        },
+    )
+    snapshot = router.snapshot()
+    rendered = render_serving(snapshot)
+    for needle in (
+        "role prefill",
+        "role decode",
+        "handoff queue 1 staged",
+        "kv 25%",
+    ):
+        if needle not in rendered:
+            errors.append(
+                f"disagg serving render missing {needle!r}: "
+                f"{rendered!r}"
+            )
+    out = router.pull(201, max_items=1)
+    if not out or not out[0].handoff:
+        errors.append("decode pull did not carry the KV payload")
+    clk[0] += 0.5
+    router.complete(
+        201, rid, [9, 8, 7, 6], ttft_s=0.1, tpot_s=0.01,
+        finish_reason="length",
+        phases={
+            "dispatch": 0.0, "prefill": 0.08, "first_decode": 0.02,
+            "handoff": 0.01, "decode": 0.03,
+        },
+    )
+    rec = router.result(rid)
+    if rec["state"] != "done" or "handoff" not in rec["phases"]:
+        errors.append(f"disagg completion wrong: {rec}")
+    if router.snapshot()["handoff_queue_depth"] != 0:
+        errors.append("handoff queue not drained after dispatch")
     return errors
 
 

@@ -52,6 +52,11 @@ def spawn_replica(
     replica_id: int,
     seed: int,
     max_len: int = 48,
+    role: str = "mixed",
+    lanes: int = 2,
+    prefill_chunk: int = 8,
+    pull_batch: int = 2,
+    prefill_budget: int = 0,
 ) -> subprocess.Popen:
     env = ensure_framework_on_pythonpath(dict(os.environ))
     env["JAX_PLATFORMS"] = "cpu"
@@ -63,13 +68,15 @@ def spawn_replica(
             "--master", master_addr,
             "--replica_id", str(replica_id),
             "--seed", str(seed),
-            "--lanes", "2",
+            "--lanes", str(lanes),
             "--block_size", "8",
-            "--prefill_chunk", "8",
+            "--prefill_chunk", str(prefill_chunk),
+            "--prefill_budget", str(prefill_budget),
             "--max_len", str(max_len),
             "--heartbeat_interval", "0.5",
             "--stats_interval", "0.5",
-            "--pull_batch", "2",
+            "--pull_batch", str(pull_batch),
+            "--role", role,
         ],
         env=env,
         stdout=subprocess.DEVNULL,
@@ -506,6 +513,463 @@ def run_serving_drill(
         master.stop()
 
 
+def _run_disagg_leg(
+    seed: int,
+    fleet,
+    workload: dict,
+    kill_prefill: bool = False,
+    max_len: int = 64,
+    deadline_s: float = 150.0,
+) -> dict:
+    """One fleet leg of the interference drill: warm up the whole
+    pipeline, start the streaming decodes, unleash the long-prompt
+    storm beside them, optionally SIGKILL a prefill replica holding
+    prefilling work, and require every request to complete with
+    greedy tokens bitwise equal to the reference model.
+
+    ``fleet`` is ``[(replica_id, role, lanes), ...]``; ``workload``
+    carries the prompts (identical across legs — the comparison is
+    the same traffic through two fleet shapes). Returns the leg
+    report: per-stream TPOTs, requeue counts, the killed node."""
+    import numpy as np
+
+    import dlrover_tpu.obs as obs
+    from dlrover_tpu.agent.master_client import MasterClient
+    from dlrover_tpu.master.master import JobMaster
+
+    tracer = obs.configure_tracer()
+    t0 = time.monotonic()
+    master = JobMaster(
+        port=0,
+        node_num=2,
+        rdzv_timeout=1.0,
+        heartbeat_timeout=6.0,
+        monitor_interval=0.5,
+        collect_interval=999.0,
+        health_interval=9999.0,  # ticked manually
+        remediation_config={
+            "interval_s": 9999.0,
+            "hysteresis_ticks": 2,
+            "cooldown_s": 0.0,
+            "blast_window_s": 600.0,
+            "blast_max_actions": 4.0,
+            "probation_s": 300.0,
+        },
+        serving_config={
+            "progress_timeout_s": 1.5,
+            "scale_cooldown_s": 9999.0,
+        },
+    )
+    master.health._config["replica_stall_crit_ratio"] = 1.0
+    master.prepare()
+    procs = {}
+    client = None
+    killed_id = None
+    try:
+        from dlrover_tpu.common.constants import replica_node_id
+
+        for rid, role, lanes in fleet:
+            procs[replica_node_id(rid)] = spawn_replica(
+                master.addr, rid, seed,
+                max_len=max_len, role=role, lanes=lanes,
+                prefill_chunk=16, pull_batch=4,
+                # The SAME per-step prefill budget in both legs:
+                # the comparison isolates fleet SHAPE (where the
+                # prefill compute lands), not scheduler tuning.
+                prefill_budget=64,
+            )
+        client = MasterClient(master.addr, node_id=-1)
+
+        def ready_count():
+            snap = master.serving.snapshot()
+            return sum(
+                1 for r in snap["replicas"]
+                if r["state"] == "ready"
+            )
+
+        deadline = time.monotonic() + 90
+        while ready_count() < len(fleet):
+            if time.monotonic() > deadline:
+                raise DrillError(
+                    f"only {ready_count()}/{len(fleet)} replicas "
+                    "registered within 90s"
+                )
+            for node_id, proc in procs.items():
+                if proc.poll() is not None:
+                    raise DrillError(
+                        f"replica {node_id} exited rc="
+                        f"{proc.returncode} before registering"
+                    )
+            time.sleep(0.2)
+
+        def submit(tag, prompt, max_new):
+            resp = client.serve_submit(
+                prompt, max_new_tokens=max_new, temperature=0.0,
+                request_id=tag,
+            )
+            if not resp.accepted:
+                raise DrillError(f"submit {tag} rejected")
+            return resp.request_id
+
+        def states(rids):
+            return {rid: client.serve_result(rid) for rid in rids}
+
+        def tick():
+            master.health.evaluate_once()
+            master.remediation.tick_once()
+
+        def wait_done(rids, budget, what):
+            end = time.monotonic() + budget
+            last_tick = 0.0
+            while time.monotonic() < end:
+                now = time.monotonic()
+                if now - last_tick >= 0.4:
+                    last_tick = now
+                    tick()
+                st = states(rids)
+                failed = {
+                    rid: r.error for rid, r in st.items()
+                    if r.state == "failed"
+                }
+                if failed:
+                    raise DrillError(
+                        f"{what} requests FAILED: {failed}"
+                    )
+                if all(r.state == "done" for r in st.values()):
+                    return st
+                time.sleep(0.05)
+            st = states(rids)
+            incomplete = {
+                rid: r.state for rid, r in st.items()
+                if r.state != "done"
+            }
+            raise DrillError(
+                f"{what} incomplete after {budget:.0f}s: "
+                f"{incomplete}"
+            )
+
+        # Warm up every compiled program in the pipeline (prefill
+        # chunks, ragged decode, both handoff install buckets) so
+        # compile time never lands inside a measured TPOT interval.
+        warm = [
+            submit("warm-s", workload["warm_stream"], 3),
+            submit("warm-l", workload["warm_storm"], 2),
+        ]
+        wait_done(warm, 90.0, "warmup")
+
+        # Streaming decodes: short prompts, long outputs — the
+        # latency-sensitive traffic disaggregation protects.
+        stream_rids = [
+            submit(f"stream-{i}", p, workload["stream_max_new"])
+            for i, p in enumerate(workload["streams"])
+        ]
+        # Wait until every stream is ON a replica decoding (mixed:
+        # dispatched; disagg: decoding after its prefill handoff).
+        end = time.monotonic() + 60
+        while True:
+            st = states(stream_rids)
+            if all(
+                r.state in ("dispatched", "decoding", "done")
+                for r in st.values()
+            ):
+                break
+            if time.monotonic() > end:
+                raise DrillError(
+                    "streams never reached the decode stage: "
+                    f"{ {k: v.state for k, v in st.items()} }"
+                )
+            time.sleep(0.05)
+
+        # The long-prompt storm, landing beside the live decodes.
+        storm_rids = [
+            submit(f"storm-{i}", p, workload["storm_max_new"])
+            for i, p in enumerate(workload["storms"])
+        ]
+
+        if kill_prefill:
+            # SIGKILL one prefill replica while it holds requests
+            # mid-prefill (the handoff pipeline's upstream stage).
+            end = time.monotonic() + 60
+            victim = None
+            while victim is None:
+                st = states(storm_rids)
+                prefilling = [
+                    r for r in st.values()
+                    if r.state == "prefilling"
+                ]
+                if prefilling:
+                    victim = prefilling[0].replica_id
+                elif time.monotonic() > end:
+                    raise DrillError(
+                        "no storm request ever showed state "
+                        "'prefilling' to aim the kill at"
+                    )
+                else:
+                    time.sleep(0.02)
+            procs[victim].kill()
+            procs[victim].wait()
+            killed_id = victim
+            print(
+                f"[disagg] killed prefill replica {victim} at "
+                f"+{time.monotonic() - t0:.1f}s", flush=True,
+            )
+
+        st = wait_done(
+            stream_rids + storm_rids, deadline_s, "drill"
+        )
+
+        # Bitwise correctness of EVERY output (streams + storm,
+        # through handoffs and any kill-requeues) vs the reference.
+        import jax.numpy as jnp
+
+        from dlrover_tpu.models import generate
+        from dlrover_tpu.serving.replica import build_tiny_model
+
+        ref_params, ref_cfg = build_tiny_model(
+            seed, block_size=max(max_len, 64)
+        )
+        prompts = {}
+        for i, p in enumerate(workload["streams"]):
+            prompts[f"stream-{i}"] = (
+                p, workload["stream_max_new"]
+            )
+        for i, p in enumerate(workload["storms"]):
+            prompts[f"storm-{i}"] = (p, workload["storm_max_new"])
+        mismatches = []
+        for rid, (prompt, max_new) in prompts.items():
+            out = generate.generate(
+                ref_params, ref_cfg,
+                jnp.asarray([prompt], jnp.int32),
+                max_new_tokens=max_new, temperature=0.0,
+            )
+            want = np.asarray(out)[0, len(prompt):].tolist()
+            if st[rid].tokens != want:
+                mismatches.append((rid, st[rid].tokens, want))
+        if mismatches:
+            raise DrillError(
+                "outputs diverged from the reference through the "
+                f"handoff: {mismatches[:3]}"
+            )
+
+        requeued = [
+            rid for rid in stream_rids + storm_rids
+            if st[rid].requeues > 0
+        ]
+        if kill_prefill:
+            if not requeued:
+                raise DrillError(
+                    "the prefill kill left nothing to requeue — "
+                    "the failover path never ran"
+                )
+            events, _ = tracer.events_since(0)
+            names = [e.get("name") for e in events]
+            for needle in (
+                "serve.requeue", "remediation.drain_replica",
+            ):
+                if needle not in names:
+                    raise DrillError(
+                        f"event {needle!r} missing from the "
+                        "disagg drill trace"
+                    )
+
+        report = {
+            "stream_tpots_s": [
+                round(st[rid].tpot_s, 6) for rid in stream_rids
+            ],
+            "stream_ttfts_s": [
+                round(
+                    st[rid].phases.get("ttft_total", st[rid].ttft_s),
+                    6,
+                )
+                for rid in stream_rids
+            ],
+            "requeued": len(requeued),
+            "killed_replica": killed_id,
+            "completed": len(st),
+            "wall_s": round(time.monotonic() - t0, 1),
+        }
+        disagg = any(role != "mixed" for _, role, _ in fleet)
+        if disagg:
+            # The request trace must show the prefill -> handoff ->
+            # decode hop chain, server-assembled.
+            probe = next(
+                (
+                    rid for rid in storm_rids
+                    if st[rid].requeues == 0
+                    and "handoff" in st[rid].phases
+                ),
+                None,
+            )
+            if probe is None:
+                raise DrillError(
+                    "no storm request completed via a clean "
+                    "handoff to probe the trace with"
+                )
+            tq = client.query_traces(trace_id=st[probe].trace_id)
+            if not tq.enabled or not tq.traces:
+                raise DrillError(
+                    f"query_traces({st[probe].trace_id}) empty"
+                )
+            spans = tq.traces[0]["spans"]
+            hops = [s for s in spans if s["name"] == "serve.hop"]
+            ends = [s["tags"].get("end") for s in hops]
+            handoffs = [
+                s for s in spans if s["name"] == "serve.handoff"
+            ]
+            hop_replicas = {
+                s["tags"].get("replica_id") for s in hops
+            }
+            if (
+                "handoff" not in ends
+                or not handoffs
+                or len(hops) < 2
+                or len(hop_replicas) < 2
+            ):
+                raise DrillError(
+                    f"request {probe}'s trace lacks the prefill ->"
+                    " handoff -> decode chain: hops end "
+                    f"{ends}, {len(handoffs)} serve.handoff "
+                    f"span(s), replicas {sorted(hop_replicas)}"
+                )
+            report["handoff_chain_probe"] = probe
+            snap = master.serving.snapshot()
+            report["roles"] = snap.get("roles", {})
+        return report
+    finally:
+        if client is not None:
+            client.close()
+        for proc in procs.values():
+            if proc.poll() is None:
+                proc.terminate()
+                try:
+                    proc.wait(timeout=10)
+                except subprocess.TimeoutExpired:
+                    proc.kill()
+        master.stop()
+
+
+def run_disagg_drill(
+    seed: int = 11,
+    streams: int = 4,
+    storms: int = 16,
+    stream_max_new: int = 48,
+    storm_prompt_len: int = 56,
+    kill: bool = True,
+) -> dict:
+    """The prefill/decode disaggregation acceptance drill (ISSUE 15),
+    two halves:
+
+    1. **Interference A/B** (in-process, virtual per-replica clocks —
+       ``tools/decode_bench.run_disagg_ab``): with the long-prompt
+       storm running, disaggregated p99 stream TPOT must BEAT
+       colocated on the same workload, with every output bitwise
+       equal to ``generate.generate``. Per-replica virtual clocks
+       charge each loop only its own measured step costs — the
+       single-core-honest model of dedicated role hardware.
+    2. **Failover leg** (real master + replica subprocesses): a
+       2-prefill + 1-decode fleet serves the storm beside the
+       streams, one prefill replica is SIGKILLed while holding
+       prefilling requests, and the drill asserts zero dropped
+       requests, bitwise outputs through handoff AND kill-requeue,
+       and that a handed-off request's server-assembled trace shows
+       the prefill -> handoff -> decode hop chain.
+    """
+    import numpy as np
+
+    from dlrover_tpu.serving.replica import build_tiny_model
+
+    sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
+    from decode_bench import run_disagg_ab
+
+    t0 = time.monotonic()
+    print(
+        "[disagg] interference A/B (virtual-clock, in-process)...",
+        flush=True,
+    )
+    ab = run_disagg_ab(seed=seed)
+    if not ab["disagg_p99_tpot_s"] < ab["coloc_p99_tpot_s"]:
+        raise DrillError(
+            f"disaggregated p99 stream TPOT "
+            f"{ab['disagg_p99_tpot_s']}s did NOT beat colocated "
+            f"{ab['coloc_p99_tpot_s']}s under the same prompt storm"
+        )
+    print(
+        f"[disagg] A/B: colocated p99 TPOT "
+        f"{ab['coloc_p99_tpot_s']}s vs disaggregated "
+        f"{ab['disagg_p99_tpot_s']}s (x{ab['tpot_p99_ratio']}, "
+        f"{ab['outputs_verified']} outputs bitwise-verified)",
+        flush=True,
+    )
+
+    _, cfg = build_tiny_model(seed, block_size=64)
+    rng = np.random.default_rng(seed)
+    lanes = streams + 2
+    workload = {
+        "warm_stream": rng.integers(
+            0, cfg.vocab_size, size=6
+        ).tolist(),
+        "warm_storm": rng.integers(
+            0, cfg.vocab_size, size=storm_prompt_len
+        ).tolist(),
+        "streams": [
+            rng.integers(0, cfg.vocab_size, size=6).tolist()
+            for _ in range(streams)
+        ],
+        "storms": [
+            rng.integers(
+                0, cfg.vocab_size, size=storm_prompt_len
+            ).tolist()
+            for _ in range(storms)
+        ],
+        "stream_max_new": stream_max_new,
+        "storm_max_new": 8,
+    }
+    print(
+        "[disagg] failover leg (2 prefill + 1 decode"
+        + (", SIGKILL one prefill)..." if kill else ")..."),
+        flush=True,
+    )
+    disagg = _run_disagg_leg(
+        seed,
+        [(0, "prefill", 4), (1, "prefill", 4), (2, "decode", lanes)],
+        workload,
+        kill_prefill=kill,
+        max_len=128,
+    )
+    print(
+        f"[disagg] failover leg done in {disagg['wall_s']}s "
+        f"({disagg['completed']} completed, "
+        f"{disagg['requeued']} requeued)", flush=True,
+    )
+    report = {
+        "seed": seed,
+        "streams": streams,
+        "storms": storms,
+        "stream_max_new": stream_max_new,
+        "storm_prompt_len": storm_prompt_len,
+        "coloc_tpot_p99_s": ab["coloc_p99_tpot_s"],
+        "disagg_tpot_p99_s": ab["disagg_p99_tpot_s"],
+        "coloc_tpot_p50_s": ab["coloc_p50_tpot_s"],
+        "disagg_tpot_p50_s": ab["disagg_p50_tpot_s"],
+        "tpot_p99_ratio": ab["tpot_p99_ratio"],
+        "ab_handoffs": ab["handoffs"],
+        "killed_replica": disagg.get("killed_replica"),
+        "requeued": disagg.get("requeued", 0),
+        "completed": disagg.get("completed", 0),
+        "handoff_chain_probe": disagg.get("handoff_chain_probe"),
+        "roles": disagg.get("roles", {}),
+        # Wall-clock stream TPOTs from the subprocess leg, for the
+        # record (NOT gated: this container serializes every replica
+        # onto one core, so subprocess wall time measures OS
+        # scheduling, not fleet shape).
+        "failover_leg_stream_tpots_s": disagg.get(
+            "stream_tpots_s", []
+        ),
+        "wall_s": round(time.monotonic() - t0, 1),
+    }
+    return report
+
+
 def selftest() -> int:
     """Seeded, hermetic CPU-mesh drill (the tier-1 acceptance:
     >=2 replicas serve synthetic traffic through one replica kill
@@ -531,6 +995,15 @@ def main(argv=None) -> int:
     parser = argparse.ArgumentParser("serve_drill")
     parser.add_argument("--selftest", action="store_true",
                         help="seeded quick mode (<90s) for CI")
+    parser.add_argument(
+        "--disagg", action="store_true",
+        help="prefill/decode disaggregation drill: long-prompt "
+        "storm beside streaming decodes through a colocated and a "
+        "disaggregated fleet (SIGKILL one prefill replica "
+        "mid-handoff), asserting zero drops, bitwise outputs, the "
+        "prefill->handoff->decode trace chain, and disagg p99 TPOT "
+        "beating colocated",
+    )
     parser.add_argument("--seed", type=int, default=7)
     parser.add_argument("--replicas", type=int, default=2)
     parser.add_argument("--requests", type=int, default=18)
@@ -539,6 +1012,27 @@ def main(argv=None) -> int:
     parser.add_argument("--json", type=str, default="",
                         help="write the drill report to this path")
     args = parser.parse_args(argv)
+    if args.disagg:
+        t0 = time.monotonic()
+        try:
+            report = run_disagg_drill(seed=args.seed or 11)
+        except DrillError as e:
+            print(f"disagg drill FAILED: {e}", file=sys.stderr)
+            return 1
+        print(json.dumps(report))
+        if args.json:
+            with open(args.json, "w") as f:
+                json.dump(report, f, indent=2)
+        print(
+            f"disagg drill ok: stream TPOT p99 "
+            f"{report['disagg_tpot_p99_s']}s disaggregated vs "
+            f"{report['coloc_tpot_p99_s']}s colocated "
+            f"(x{report['tpot_p99_ratio']}), "
+            f"{report['requeued']} requeued through the prefill "
+            f"kill of replica {report['killed_replica']} "
+            f"({time.monotonic() - t0:.1f}s)"
+        )
+        return 0
     if args.selftest:
         return selftest()
     try:
