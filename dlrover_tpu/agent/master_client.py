@@ -922,6 +922,16 @@ class MasterClient:
         wait percentiles) — obs_report --pool's feed."""
         return self._get(msg.PoolQueryRequest(), max_wait=max_wait)
 
+    def query_capacity(
+        self, max_wait: Optional[float] = None
+    ) -> msg.CapacityQueryResponse:
+        """The pool master's capacity accounting rollup (per-tenant
+        chip-seconds by state, goodput-per-chip, SLO budget standing)
+        — obs_report --capacity's feed."""
+        return self._get(
+            msg.CapacityQueryRequest(), max_wait=max_wait
+        )
+
     def query_metrics(
         self, max_wait: Optional[float] = None
     ) -> str:

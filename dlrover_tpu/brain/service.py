@@ -139,6 +139,24 @@ class BrainService:
                 timestamp REAL
             )"""
         )
+        # Capacity plane (obs/capacity.py): closed slice state
+        # intervals and per-tenant goodput rollups — the offline
+        # history the capacity brain (ROADMAP item 5) warm-starts
+        # goodput-per-chip planning from.
+        self._db.execute(
+            """CREATE TABLE IF NOT EXISTS capacity_intervals (
+                job_name TEXT, slice_id INT, state TEXT,
+                tenant TEXT, job_id TEXT, start_ts REAL,
+                end_ts REAL, chip_seconds REAL
+            )"""
+        )
+        self._db.execute(
+            """CREATE TABLE IF NOT EXISTS tenant_goodput (
+                job_name TEXT, tenant TEXT, chips INT,
+                held_chip_seconds REAL, productive_chip_seconds REAL,
+                goodput_per_chip REAL, timestamp REAL
+            )"""
+        )
 
     def persist_metrics(self, rec: JobMetricsRecord) -> None:
         with self._lock:
@@ -286,6 +304,123 @@ class BrainService:
                 }
             )
         return out
+
+    def persist_capacity_interval(
+        self,
+        job_name: str,
+        slice_id: int,
+        state: str,
+        tenant: str = "",
+        job_id: str = "",
+        start_ts: float = 0.0,
+        end_ts: float = 0.0,
+        chip_seconds: float = 0.0,
+    ) -> None:
+        """One closed slice state interval from the capacity ledger
+        (``end_ts`` doubles as the retention-order timestamp)."""
+        with self._lock:
+            self._db.execute(
+                "INSERT INTO capacity_intervals VALUES "
+                "(?,?,?,?,?,?,?,?)",
+                (
+                    job_name, int(slice_id), state, tenant, job_id,
+                    float(start_ts), float(end_ts),
+                    float(chip_seconds),
+                ),
+            )
+            self._db.execute(
+                "DELETE FROM capacity_intervals WHERE rowid IN ("
+                "  SELECT rowid FROM capacity_intervals"
+                "  WHERE job_name = ?"
+                "  ORDER BY end_ts DESC"
+                "  LIMIT -1 OFFSET ?)",
+                (job_name, self.SAMPLE_RETENTION),
+            )
+            self._db.commit()
+
+    def recent_capacity_intervals(
+        self, job_name: str, limit: int = 100
+    ) -> List[Dict]:
+        """Newest-first closed capacity intervals."""
+        with self._lock:
+            cur = self._db.execute(
+                "SELECT slice_id, state, tenant, job_id, start_ts, "
+                "end_ts, chip_seconds FROM capacity_intervals "
+                "WHERE job_name = ? ORDER BY end_ts DESC LIMIT ?",
+                (job_name, limit),
+            )
+            rows = cur.fetchall()
+        return [
+            {
+                "slice_id": slice_id,
+                "state": state,
+                "tenant": tenant,
+                "job_id": job_id,
+                "start_ts": start_ts,
+                "end_ts": end_ts,
+                "chip_seconds": chip_seconds,
+            }
+            for slice_id, state, tenant, job_id, start_ts, end_ts,
+            chip_seconds in rows
+        ]
+
+    def persist_tenant_goodput(
+        self,
+        job_name: str,
+        tenant: str,
+        chips: int = 0,
+        held_chip_seconds: float = 0.0,
+        productive_chip_seconds: float = 0.0,
+        goodput_per_chip: float = 0.0,
+        timestamp: float = 0.0,
+    ) -> None:
+        """One per-tenant chip-second rollup (held vs productive,
+        goodput-per-chip) on the goodput-observation cadence."""
+        with self._lock:
+            self._db.execute(
+                "INSERT INTO tenant_goodput VALUES (?,?,?,?,?,?,?)",
+                (
+                    job_name, tenant, int(chips),
+                    float(held_chip_seconds),
+                    float(productive_chip_seconds),
+                    float(goodput_per_chip),
+                    timestamp or time.time(),
+                ),
+            )
+            self._db.execute(
+                "DELETE FROM tenant_goodput WHERE rowid IN ("
+                "  SELECT rowid FROM tenant_goodput"
+                "  WHERE job_name = ?"
+                "  ORDER BY timestamp DESC"
+                "  LIMIT -1 OFFSET ?)",
+                (job_name, self.SAMPLE_RETENTION),
+            )
+            self._db.commit()
+
+    def recent_tenant_goodput(
+        self, job_name: str, limit: int = 100
+    ) -> List[Dict]:
+        """Newest-first tenant goodput rollups."""
+        with self._lock:
+            cur = self._db.execute(
+                "SELECT tenant, chips, held_chip_seconds, "
+                "productive_chip_seconds, goodput_per_chip, "
+                "timestamp FROM tenant_goodput "
+                "WHERE job_name = ? ORDER BY timestamp DESC LIMIT ?",
+                (job_name, limit),
+            )
+            rows = cur.fetchall()
+        return [
+            {
+                "tenant": tenant,
+                "chips": chips,
+                "held_chip_seconds": held,
+                "productive_chip_seconds": productive,
+                "goodput_per_chip": gpc,
+                "timestamp": ts,
+            }
+            for tenant, chips, held, productive, gpc, ts in rows
+        ]
 
     def persist_health_verdict(
         self,

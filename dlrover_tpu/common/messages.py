@@ -1023,6 +1023,25 @@ class PoolQueryResponse:
     snapshot: Dict[str, Any] = dataclasses.field(default_factory=dict)
 
 
+@message
+class CapacityQueryRequest:
+    """Fetch the pool master's capacity accounting rollup: per-tenant
+    chip-second totals by slice state, goodput-per-chip, and the SLO
+    error-budget standing (budget remaining + active burn alerts) —
+    the ``obs_report --capacity`` feed. Fieldless, like
+    PoolQueryRequest."""
+
+    pass
+
+
+@message
+class CapacityQueryResponse:
+    enabled: bool = False
+    # CapacityLedger.snapshot() with an "slo" block
+    # ({"budgets": HealthMonitor.slo_snapshot()}) attached.
+    snapshot: Dict[str, Any] = dataclasses.field(default_factory=dict)
+
+
 # -- brain service wire messages (standalone brain: brain/server.py) --
 
 

@@ -58,7 +58,13 @@ doing through this package, so "what is the job doing right now" and
   recompile-storm / RSS-growth / straggler-persistence /
   heartbeat-gap verdicts with evidence windows, the composite
   ``dlrover_job_health_score``, auto-queued PROFILE/DIAGNOSE actions,
-  and brain persistence.
+  and brain persistence — plus the per-tenant SLO error-budget engine
+  with multi-window burn-rate alerting.
+* :mod:`dlrover_tpu.obs.capacity` — the pool capacity accounting
+  plane: a per-slice state-interval ledger (idle / allocated /
+  preempting / draining / restoring) producing per-tenant chip-second
+  totals, productive chip-seconds from goodput joins, and
+  goodput-per-chip — the substrate for capacity-aware autoscaling.
 
 The functions re-exported here are the instrumentation surface the
 rest of the codebase uses::
@@ -131,11 +137,19 @@ from dlrover_tpu.obs.timeseries import (  # noqa: F401
     WindowStats,
 )
 
-# Imported last: health.py instruments through `dlrover_tpu.obs`
-# itself (obs.counter/obs.gauge are bound above by the time this
-# executes), mirroring how the master modules import the package.
+# Imported last: health.py and capacity.py instrument through
+# `dlrover_tpu.obs` itself (obs.counter/obs.gauge are bound above by
+# the time this executes), mirroring how the master modules import
+# the package.
 from dlrover_tpu.obs.health import (  # noqa: E402,F401
     HealthMonitor,
     HealthVerdict,
+    SLOSpec,
     render_health,
+    slos_from_env,
+)
+from dlrover_tpu.obs.capacity import (  # noqa: E402,F401
+    CapacityLedger,
+    SliceInterval,
+    render_capacity,
 )

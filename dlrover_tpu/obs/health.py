@@ -31,6 +31,9 @@ replica_unhealthy         a serving replica holds dispatched requests
                           (or is draining and never came back) — the
                           verdict the remediation ladder drains,
                           restarts, then replaces on
+slo_burn                  a tenant SLO's error budget burns past the
+                          fast (5m AND 1h, critical) or slow (6h AND
+                          3d, warn) multi-window burn-rate threshold
 ========================  =====================================================
 
 Each verdict carries a severity (``info``/``warn``/``critical``), the
@@ -45,6 +48,18 @@ composite ``dlrover_job_health_score`` gauge, and are persisted to
 the brain datastore so the policy engine (ROADMAP item 2) consumes
 the same channel.
 
+On top of the detector suite sits the **SLO budget engine**
+(ROADMAP item 5's accountability half): declarative per-tenant
+:class:`SLOSpec` objectives (training goodput >= X, serving
+TTFT/TPOT p99 <= Y) tracked as error budgets over the time-series
+store, with Google-SRE-style multi-window burn-rate detection — the
+fast pair (5m AND 1h) at >= 14.4x budget burn fires a ``critical``
+``slo_burn`` verdict (page), the slow pair (6h AND 3d) at >= 1x
+fires ``warn`` (ticket). Budget remaining over each SLO's period is
+exported as ``dlrover_slo_budget_remaining{tenant,slo}`` every
+evaluation, and :meth:`HealthMonitor.slo_snapshot` feeds the
+``CapacityQueryRequest`` RPC / ``obs_report --capacity``.
+
 Every threshold reads ``DLROVER_TPU_HEALTH_<KNOB>`` (see DEFAULTS),
 overridable per-instance via the ``config`` dict; the clock is
 injectable so detector tests drive simulated hours hermetically.
@@ -53,6 +68,7 @@ injectable so detector tests drive simulated hours hermetically.
 from __future__ import annotations
 
 import dataclasses
+import json
 import os
 import threading
 import time
@@ -95,6 +111,12 @@ _HEALTH_SCORE = obs.gauge(
     "Composite job health in [0, 1]: 1 minus severity-weighted "
     "penalties of the currently-active health verdicts",
 )
+_SLO_BUDGET_REMAINING = obs.gauge(
+    "dlrover_slo_budget_remaining",
+    "Fraction of each tenant SLO's error budget left over its "
+    "period (1 = untouched, 0 = exhausted)",
+    ("tenant", "slo"),
+)
 
 # Every knob a detector reads, with its default. Override per knob via
 # DLROVER_TPU_HEALTH_<NAME-upper> or the HealthMonitor(config=) dict
@@ -132,7 +154,86 @@ DEFAULTS: Dict[str, float] = {
     # replica_unhealthy: staleness as a multiple of the serving
     # router's progress timeout that escalates warn -> critical
     "replica_stall_crit_ratio": 2.0,
+    # SLO burn-rate windows + thresholds (Google SRE multi-window
+    # multi-burn-rate): the fast pair pages, the slow pair tickets.
+    # 14.4x on a 30d budget spends ~2% of it in one hour.
+    "slo_fast_burn": 14.4,
+    "slo_slow_burn": 1.0,
+    "slo_fast_short_s": 300.0,       # 5m
+    "slo_fast_long_s": 3600.0,       # 1h
+    "slo_slow_short_s": 21600.0,     # 6h
+    "slo_slow_long_s": 259200.0,     # 3d
 }
+
+
+@dataclasses.dataclass
+class SLOSpec:
+    """One declarative per-tenant service-level objective.
+
+    ``direction`` says which side of ``objective`` is good:
+    ``"min"`` — the series must stay AT OR ABOVE the objective
+    (training goodput >= 0.8); ``"max"`` — it must stay at or below
+    (serving TTFT p99 <= 0.5s). ``budget`` is the allowed bad-sample
+    fraction over ``period_s``; burn rate is bad_fraction / budget.
+    ``labels`` scope the series query (e.g. ``{"tenant": "a"}``).
+    """
+
+    tenant: str
+    slo: str
+    series: str
+    objective: float
+    direction: str = "min"
+    budget: float = 0.05
+    period_s: float = 3.0 * 86400.0
+    labels: Dict[str, str] = dataclasses.field(default_factory=dict)
+
+    def key(self) -> str:
+        return f"{self.tenant}/{self.slo}"
+
+    def to_dict(self) -> dict:
+        return {
+            "tenant": self.tenant,
+            "slo": self.slo,
+            "series": self.series,
+            "objective": self.objective,
+            "direction": self.direction,
+            "budget": self.budget,
+            "period_s": self.period_s,
+            "labels": dict(self.labels),
+        }
+
+    @classmethod
+    def from_dict(cls, d: dict) -> "SLOSpec":
+        return cls(
+            tenant=str(d.get("tenant", "default")),
+            slo=str(d.get("slo", "slo")),
+            series=str(d.get("series", "")),
+            objective=float(d.get("objective", 0.0)),
+            direction=str(d.get("direction", "min")),
+            budget=float(d.get("budget", 0.05)),
+            period_s=float(d.get("period_s", 3.0 * 86400.0)),
+            labels={
+                str(k): str(v)
+                for k, v in (d.get("labels") or {}).items()
+            },
+        )
+
+
+def slos_from_env() -> List["SLOSpec"]:
+    """Parse ``DLROVER_TPU_HEALTH_SLOS`` (a JSON list of SLOSpec
+    dicts) — the deploy-time way to declare objectives without code.
+    Bad JSON degrades to no SLOs, never to a crash."""
+    raw = os.getenv(HEALTH_ENV_PREFIX + "SLOS", "")
+    if not raw:
+        return []
+    try:
+        data = json.loads(raw)
+        return [SLOSpec.from_dict(d) for d in data]
+    except Exception:  # noqa: BLE001
+        logger.warning(
+            "bad %sSLOS JSON %r; ignoring", HEALTH_ENV_PREFIX, raw
+        )
+        return []
 
 
 @dataclasses.dataclass
@@ -236,6 +337,7 @@ class HealthMonitor:
         clock: Callable[[], float] = time.time,
         config: Optional[Dict[str, float]] = None,
         interval: Optional[float] = None,
+        slos: Optional[List[SLOSpec]] = None,
     ):
         self.store = store
         self.speed_monitor = speed_monitor
@@ -252,6 +354,12 @@ class HealthMonitor:
         self.heartbeat_timeout = heartbeat_timeout
         self._heartbeat_ages = heartbeat_ages
         self.clock = clock
+        self.slos: List[SLOSpec] = (
+            list(slos) if slos is not None else slos_from_env()
+        )
+        # spec.key() -> last computed budget/burn numbers, refreshed
+        # every evaluation tick (read by slo_snapshot()).
+        self._slo_last: Dict[str, dict] = {}
         self._config = dict(config or {})
         self.interval = (
             interval
@@ -284,6 +392,7 @@ class HealthMonitor:
             self._detect_straggler_persistence,
             self._detect_heartbeat_gap,
             self._detect_replica_unhealthy,
+            self._detect_slo_burn,
         ]
         _HEALTH_SCORE.set(1.0)
 
@@ -749,6 +858,141 @@ class HealthMonitor:
                     timestamp=self.clock(),
                 )
             )
+        return out
+
+    def _slo_bad_frac(
+        self, spec: SLOSpec, window_s: float
+    ) -> Tuple[float, int]:
+        """(bad-sample fraction, sample count) of the SLO's series
+        over the trailing window. No samples = no burn — an idle
+        tenant must not page."""
+        pts = self.store.points(
+            spec.series, window_s, **spec.labels
+        )
+        if not pts:
+            return 0.0, 0
+        if spec.direction == "max":
+            bad = sum(1 for _, v in pts if v > spec.objective)
+        else:
+            bad = sum(1 for _, v in pts if v < spec.objective)
+        return bad / len(pts), len(pts)
+
+    def _detect_slo_burn(self) -> List[HealthVerdict]:
+        """Multi-window multi-burn-rate error-budget detector (the
+        Google SRE workbook shape): a pair fires only when BOTH its
+        short and long windows burn past the threshold — the short
+        window for fast resolution, the long one so a blip cannot
+        page. The fast pair (5m/1h, 14.4x) is critical, the slow
+        pair (6h/3d, 1x) is warn; each pair is its own verdict
+        subject so a drill can watch fast fire critical while slow
+        stays warn."""
+        if not self.slos:
+            return []
+        pairs = (
+            (
+                "fast",
+                self._cfg("slo_fast_short_s"),
+                self._cfg("slo_fast_long_s"),
+                self._cfg("slo_fast_burn"),
+                SEVERITY_CRITICAL,
+            ),
+            (
+                "slow",
+                self._cfg("slo_slow_short_s"),
+                self._cfg("slo_slow_long_s"),
+                self._cfg("slo_slow_burn"),
+                SEVERITY_WARN,
+            ),
+        )
+        now = self.clock()
+        out: List[HealthVerdict] = []
+        for spec in self.slos:
+            budget = max(spec.budget, 1e-9)
+            period_bad, period_n = self._slo_bad_frac(
+                spec, spec.period_s
+            )
+            remaining = max(0.0, 1.0 - period_bad / budget)
+            _SLO_BUDGET_REMAINING.set(
+                remaining, tenant=spec.tenant, slo=spec.slo
+            )
+            burns: Dict[str, float] = {}
+            for name, short_s, long_s, threshold, severity in pairs:
+                short_bad, short_n = self._slo_bad_frac(
+                    spec, short_s
+                )
+                long_bad, long_n = self._slo_bad_frac(spec, long_s)
+                # Both windows must burn: min() of the two rates.
+                burn = min(short_bad, long_bad) / budget
+                burns[name] = burn
+                if not short_n or not long_n or burn < threshold:
+                    continue
+                out.append(
+                    HealthVerdict(
+                        detector="slo_burn",
+                        severity=severity,
+                        message=(
+                            f"tenant {spec.tenant} {spec.slo} "
+                            f"burning its error budget at "
+                            f"{burn:.1f}x ({name} windows "
+                            f"{short_s:.0f}s/{long_s:.0f}s, budget "
+                            f"{budget:.3f}, "
+                            f"{100.0 * remaining:.0f}% remaining)"
+                        ),
+                        host=f"{spec.key()}/{name}",
+                        suggested_action="",
+                        evidence_series=spec.series,
+                        evidence=self._evidence(
+                            spec.series, short_s, **spec.labels
+                        ),
+                        metrics={
+                            "burn": burn,
+                            "threshold": threshold,
+                            "budget_remaining": remaining,
+                            "short_bad_frac": short_bad,
+                            "long_bad_frac": long_bad,
+                        },
+                        timestamp=now,
+                    )
+                )
+            self._slo_last[spec.key()] = {
+                **spec.to_dict(),
+                "budget_remaining": remaining,
+                "period_bad_frac": period_bad,
+                "period_samples": period_n,
+                "burn": dict(burns),
+                "ts": now,
+            }
+        return out
+
+    def slo_snapshot(self) -> List[dict]:
+        """Per-SLO budget standing for the capacity RPC: the spec,
+        budget remaining, last burn rates, and whether a burn verdict
+        is currently active (and at what severity)."""
+        with self._lock:
+            active = dict(self._active)
+        out = []
+        for spec in self.slos:
+            entry = dict(
+                self._slo_last.get(
+                    spec.key(),
+                    {**spec.to_dict(), "budget_remaining": 1.0,
+                     "burn": {}},
+                )
+            )
+            severity = ""
+            for name in ("fast", "slow"):
+                v = active.get(
+                    ("slo_burn", f"{spec.key()}/{name}", -1)
+                )
+                if v is not None and (
+                    not severity
+                    or SEVERITIES.index(v.severity)
+                    > SEVERITIES.index(severity)
+                ):
+                    severity = v.severity
+            entry["severity"] = severity
+            entry["burning"] = bool(severity)
+            out.append(entry)
         return out
 
     # -- verdict lifecycle -------------------------------------------------

@@ -48,6 +48,9 @@ from dlrover_tpu.common.constants import (
     EventAction,
 )
 from dlrover_tpu.common.log import get_logger
+from dlrover_tpu.obs.capacity import CapacityLedger
+from dlrover_tpu.obs.health import HealthMonitor
+from dlrover_tpu.obs.timeseries import TimeSeriesStore
 from dlrover_tpu.obs.trace_store import TraceStore
 from dlrover_tpu.pool.scheduler import (
     JobRuntime,
@@ -197,6 +200,15 @@ class PoolJobContext(JobRuntime):
                     in (NodeType.WORKER, NodeType.CHIEF)
                 ):
                     self._workers_seen = True
+                    # Capacity: a resumed incarnation's slices leave
+                    # `restoring` once its workers re-register (a
+                    # fresh placement is already `allocated`: no-op).
+                    try:
+                        self._pool.capacity.job_ready(
+                            self.spec.job_id
+                        )
+                    except Exception:  # noqa: BLE001
+                        pass
 
             self.master.job_manager.add_listener(_on_node_event)
             self._pool.router.register_job(
@@ -381,6 +393,8 @@ class TPUPoolMaster:
         worker_launcher: Optional[Callable] = None,
         job_master_defaults: Optional[dict] = None,
         metrics_port: Optional[int] = None,
+        slos=None,
+        brain=None,
     ):
         if tenant_quotas is None and os.getenv(QUOTAS_ENV, ""):
             tenant_quotas = parse_quota_spec(os.environ[QUOTAS_ENV])
@@ -406,6 +420,32 @@ class TPUPoolMaster:
             self.pool,
             trace_sink=self.traces,
             park_timeout_s=park_timeout_s,
+        )
+        # Capacity accounting plane: the interval ledger observes the
+        # pool's allocation lifecycle (via pool/scheduler hooks) and
+        # the watcher tick joins in each placed job's goodput ratio
+        # and serving latency percentiles; the SLO budget engine over
+        # the same store turns per-tenant objectives into error
+        # budgets with burn-rate alerting. ``slos`` is a list of
+        # obs.SLOSpec (None = DLROVER_TPU_HEALTH_SLOS env, if set);
+        # ``brain`` is any BrainService-shaped datastore.
+        self.brain = brain
+        self.timeseries = TimeSeriesStore()
+        self.capacity = CapacityLedger(
+            self.pool.specs(),
+            timeseries=self.timeseries,
+            brain=brain,
+            job_name="pool",
+        )
+        self.pool.ledger = self.capacity
+        # No monitor thread: SLO evaluation rides the watcher tick so
+        # drills stay deterministic (tick_once -> evaluate_once).
+        self.health = HealthMonitor(
+            store=self.timeseries,
+            brain=brain,
+            job_name="pool",
+            slos=slos,
+            interval=watch_interval,
         )
         self.router = JobRoutingDispatcher()
         self._server = RpcServer(self.router, port=port)
@@ -543,6 +583,68 @@ class TPUPoolMaster:
                     ctx.spec.job_id, info["slices"],
                 )
                 self.scheduler.complete(ctx.spec.job_id)
+        self.observe_capacity()
+
+    def observe_capacity(self) -> None:
+        """Join each placed job's telemetry into the capacity plane:
+        the embedded JobMaster's goodput ratio (-> productive
+        chip-seconds + ``tenant.goodput`` series) and its serving
+        router's TTFT/TPOT p99s (-> ``tenant.ttft_p99_s`` /
+        ``tenant.tpot_p99_s``), then one SLO budget evaluation.
+        Rides the watcher tick; drills call it directly."""
+        with self._ctx_lock:
+            contexts = list(self._contexts.values())
+        for ctx in contexts:
+            jm = ctx.master
+            if jm is None or not ctx.slices:
+                continue
+            tenant = ctx.spec.tenant
+            job_id = ctx.spec.job_id
+            goodput = getattr(jm, "goodput", None)
+            if goodput is not None:
+                try:
+                    report = goodput.account()
+                except Exception:  # noqa: BLE001
+                    report = None
+                if report is not None:
+                    self.capacity.observe_goodput(
+                        job_id, report.goodput_ratio
+                    )
+            serving = getattr(jm, "serving", None)
+            if serving is not None:
+                try:
+                    ttft = serving.phase_p99(
+                        "queue"
+                    ) + serving.phase_p99("prefill")
+                    tpot = serving.phase_p99("tpot")
+                except Exception:  # noqa: BLE001
+                    ttft = tpot = 0.0
+                # Idle routers report 0 — recording that would count
+                # as an SLO-compliant sample without any traffic.
+                # Each signal lands twice: the per-job series (purged
+                # when the job retires) and the tenant-level series
+                # the SLO budget engine queries (the store matches on
+                # the exact label set).
+                if ttft > 0:
+                    self.timeseries.record(
+                        "tenant.ttft_p99_s", ttft,
+                        tenant=tenant, job=job_id,
+                    )
+                    self.timeseries.record(
+                        "tenant.ttft_p99_s", ttft, tenant=tenant
+                    )
+                if tpot > 0:
+                    self.timeseries.record(
+                        "tenant.tpot_p99_s", tpot,
+                        tenant=tenant, job=job_id,
+                    )
+                    self.timeseries.record(
+                        "tenant.tpot_p99_s", tpot, tenant=tenant
+                    )
+        try:
+            self.health.evaluate_once()
+        except Exception:  # noqa: BLE001
+            logger.exception("pool SLO evaluation failed")
 
     def _watch_loop(self) -> None:
         while not self._stop.wait(self._watch_interval):
@@ -558,6 +660,7 @@ class TPUPoolMaster:
         g(msg.PoolSubmitRequest, self._rpc_submit)
         g(msg.PoolJobStatusRequest, self._rpc_status)
         g(msg.PoolQueryRequest, self._rpc_query)
+        g(msg.CapacityQueryRequest, self._rpc_capacity)
         g(msg.TraceQueryRequest, self._rpc_traces)
         g(msg.MetricsRequest, self._rpc_metrics)
 
@@ -601,6 +704,13 @@ class TPUPoolMaster:
     def _rpc_query(self, req: msg.PoolQueryRequest):
         return msg.PoolQueryResponse(
             enabled=True, snapshot=self.scheduler.snapshot()
+        )
+
+    def _rpc_capacity(self, req: msg.CapacityQueryRequest):
+        snapshot = self.capacity.snapshot()
+        snapshot["slo"] = {"budgets": self.health.slo_snapshot()}
+        return msg.CapacityQueryResponse(
+            enabled=True, snapshot=snapshot
         )
 
     def _rpc_traces(self, req: msg.TraceQueryRequest):

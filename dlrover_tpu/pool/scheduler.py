@@ -312,6 +312,32 @@ class PoolScheduler:
                 evicted.append(old)
         return evicted
 
+    def _ledger(self, hook: str, *args) -> None:
+        """Best-effort capacity-ledger notification (the pool owns
+        the ledger reference; accounting must never fail or deadlock
+        a scheduling decision — the ledger lock is leaf-level)."""
+        ledger = getattr(self.pool, "ledger", None)
+        if ledger is None:
+            return
+        try:
+            getattr(ledger, hook)(*args)
+        except Exception:  # noqa: BLE001
+            logger.warning(
+                "capacity ledger %s hook failed", hook, exc_info=True
+            )
+
+    def _tenant_retired_locked(self, job: _Job) -> bool:
+        """True when ``job`` was its tenant's last live pool job —
+        the signal to purge the tenant's time series (the dead-tenant
+        half of the departed-host purge discipline)."""
+        tenant = job.spec.tenant
+        return not any(
+            j.spec.tenant == tenant
+            and j.spec.job_id != job.spec.job_id
+            and j.state not in PoolJobState.TERMINAL
+            for j in self._jobs.values()
+        )
+
     def _fire_evictions(self, evicted: List[str]) -> None:
         cb = self.on_job_evicted
         for job_id in evicted:
@@ -339,6 +365,8 @@ class PoolScheduler:
             self._counters["completions"] += 1
             evicted = self._note_terminal_locked(job_id)
             self._update_gauges_locked()
+            tenant_retired = self._tenant_retired_locked(job)
+        self._ledger("retire_job", job_id, tenant_retired)
         self._fire_evictions(evicted)
         self._span(
             job.trace_id, "pool.complete", job.done_wall,
@@ -358,10 +386,16 @@ class PoolScheduler:
                 return False
             was_running = job.state in PoolJobState.RUNNING
             job.state = PoolJobState.CANCELLED
+            # Capacity: the interval between the cancel decision and
+            # the slices returning to idle is drain, not production.
+            if job.slices:
+                self._ledger("mark_draining", job_id)
             self.pool.release(job_id)
             job.slices = []
             evicted = self._note_terminal_locked(job_id)
             self._update_gauges_locked()
+            tenant_retired = self._tenant_retired_locked(job)
+        self._ledger("retire_job", job_id, tenant_retired)
         self._fire_evictions(evicted)
         if was_running:
             try:
@@ -578,6 +612,11 @@ class PoolScheduler:
             slices=",".join(map(str, granted)), resume=resume,
             backfill=backfilled, wait_s=round(wait_s, 3),
         )
+        if resume:
+            # Capacity: a resumed gang restores from checkpoint
+            # before it produces; CapacityLedger.job_ready (workers
+            # re-registered) flips it back to allocated.
+            self._ledger("mark_restoring", job.spec.job_id)
         logger.info(
             "%s job %s on slices %s (waited %.2fs%s)",
             "resuming" if resume else "placing",
@@ -654,6 +693,9 @@ class PoolScheduler:
         victim.state = PoolJobState.PREEMPTING
         victim.park_started_wall = time.time()
         victim.preempt_trace = head.trace_id
+        # Capacity: park -> checkpoint -> release is preemption
+        # overhead, not production, from this decision onward.
+        self._ledger("mark_preempting", victim.spec.job_id)
         obs.event(
             "pool.preempt", job_id=victim.spec.job_id,
             for_job=head.spec.job_id,

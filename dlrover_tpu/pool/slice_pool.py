@@ -90,6 +90,10 @@ class SlicePool:
         # stop being written (a stale series would report phantom
         # usage forever).
         self._gauge_tenants: set = set()
+        # Optional CapacityLedger observing allocation lifecycles.
+        # Notified OUTSIDE the pool lock (the ledger takes its own),
+        # and best-effort: accounting must never fail an allocation.
+        self.ledger = None
         self._update_gauges_locked()
 
     # -- inventory ----------------------------------------------------------
@@ -175,6 +179,7 @@ class SlicePool:
             "pool.allocate", job_id=job_id, tenant=tenant,
             slices=",".join(map(str, granted)),
         )
+        self._notify_ledger("on_allocate", job_id, tenant, granted)
         return list(granted)
 
     def release(self, job_id: str) -> List[int]:
@@ -192,9 +197,22 @@ class SlicePool:
                 "pool.release", job_id=job_id,
                 slices=",".join(map(str, granted)),
             )
+            self._notify_ledger("on_release", job_id, granted)
         return list(granted)
 
     # -- observability ------------------------------------------------------
+
+    def _notify_ledger(self, hook: str, *args) -> None:
+        ledger = self.ledger
+        if ledger is None:
+            return
+        try:
+            getattr(ledger, hook)(*args)
+        except Exception:  # noqa: BLE001 — capacity accounting must
+            # never fail an allocation or release
+            logger.warning(
+                "capacity ledger %s hook failed", hook, exc_info=True
+            )
 
     def _update_gauges_locked(self) -> None:
         _SLICES.set(len(self._free), state="free")

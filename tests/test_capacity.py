@@ -1,0 +1,705 @@
+"""Pool capacity accounting & SLO error budgets (obs/capacity.py +
+the slo_burn detector in obs/health.py).
+
+The acceptance drill (TestCapacityAcceptance) plays a hermetic,
+fake-clocked two-tenant pool through a ~10-minute backdated timeline
+— tenant ``a`` training through one preemption + restore, tenant
+``b`` serving through an injected TTFT regression — against a LIVE
+``TPUPoolMaster`` and asserts the four contract points:
+
+(a) per-{tenant,state} chip-seconds partition ``total_chips x
+    elapsed`` exactly;
+(b) tenant a's goodput-per-chip dip is attributed to the
+    ``preempting`` / ``restoring`` intervals;
+(c) the fast-window burn verdict fires critical while the slow
+    window holds warn, and both resolve after recovery;
+(d) ``obs_report --capacity`` against the live master renders the
+    table with rc=1 during the burn and rc=0 after — with the new
+    metrics in the registry and the brain tables readable back.
+
+The unit tests pin the ledger/budget invariants the drill only
+samples one path through.
+"""
+
+import json
+import os
+import sys
+import time
+
+import pytest
+
+from dlrover_tpu import obs
+from dlrover_tpu.brain.service import BrainService
+from dlrover_tpu.obs.capacity import (
+    IDLE_TENANT,
+    STATE_ALLOCATED,
+    STATE_IDLE,
+    STATE_PREEMPTING,
+    STATE_RESTORING,
+    CapacityLedger,
+    render_capacity,
+)
+from dlrover_tpu.obs.health import (
+    SEVERITY_CRITICAL,
+    SEVERITY_WARN,
+    HealthMonitor,
+    SLOSpec,
+    slos_from_env,
+)
+from dlrover_tpu.obs.timeseries import TimeSeriesStore
+from dlrover_tpu.pool import SliceSpec
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+TOOLS = os.path.join(REPO, "tools")
+if TOOLS not in sys.path:
+    sys.path.insert(0, TOOLS)
+
+
+class FakeClock:
+    def __init__(self, t=1000.0):
+        self.t = t
+
+    def __call__(self):
+        return self.t
+
+
+def two_slices():
+    return [SliceSpec(slice_id=0), SliceSpec(slice_id=1)]  # 4 chips
+
+
+# ---------------------------------------------------------------------------
+# ledger invariants
+# ---------------------------------------------------------------------------
+
+
+class TestCapacityLedger:
+    def test_partition_invariant_is_exact(self):
+        """Closed cells + open accruals must sum to total_chips x
+        elapsed EXACTLY at every instant — through allocation,
+        preemption, idle gaps, and a restore."""
+        clk = FakeClock(1000.0)
+        led = CapacityLedger(two_slices(), clock=clk)
+
+        def check(ts):
+            snap = led.snapshot(ts=ts)
+            assert snap["partition_ok"], snap["chip_seconds"]
+            want = led.total_chips * (ts - 1000.0)
+            assert snap["chip_seconds"]["accounted"] == pytest.approx(
+                want, abs=1e-6
+            )
+            assert snap["chip_seconds"]["capacity"] == pytest.approx(
+                want, abs=1e-6
+            )
+
+        led.on_allocate("ja", "a", [0, 1], ts=1010.0)
+        check(1015.0)
+        led.mark_preempting("ja", ts=1030.0)
+        check(1035.0)
+        led.on_release("ja", [0, 1], ts=1040.0)
+        check(1045.0)
+        led.on_allocate("ja", "a", [1], ts=1050.0)
+        led.mark_restoring("ja", ts=1050.0)
+        led.job_ready("ja", ts=1070.0)
+        check(1100.0)
+
+    def test_state_attribution_by_tenant(self):
+        clk = FakeClock(1000.0)
+        led = CapacityLedger(two_slices(), clock=clk)
+        led.on_allocate("ja", "a", [0, 1], ts=1010.0)
+        led.mark_preempting("ja", ts=1030.0)
+        led.on_release("ja", [0, 1], ts=1040.0)
+        snap = led.snapshot(ts=1050.0)
+        a = snap["tenants"]["a"]
+        assert a["states"][STATE_ALLOCATED] == pytest.approx(160.0)
+        assert a["states"][STATE_PREEMPTING] == pytest.approx(80.0)
+        assert a["overhead_chip_seconds"] == pytest.approx(80.0)
+        by_state = snap["chip_seconds"]["by_state"]
+        # idle: 2 slices x 10s before + 2 x 10s after, x 4 chips.
+        assert by_state[STATE_IDLE] == pytest.approx(160.0)
+        assert IDLE_TENANT not in snap["tenants"]
+
+    def test_goodput_ratio_applies_forward_and_clamps(self):
+        """A ratio observation settles the PREVIOUS ratio up to its
+        stamp and applies forward; out-of-range ratios clamp."""
+        clk = FakeClock(0.0)
+        led = CapacityLedger(two_slices(), clock=clk)
+        led.on_allocate("ja", "a", [0, 1], ts=0.0)
+        led.observe_goodput("ja", 0.5, ts=10.0)  # 0..10 at ratio 0
+        led.observe_goodput("ja", 1.5, ts=20.0)  # clamps to 1.0
+        led.observe_goodput("ja", -3.0, ts=30.0)  # clamps to 0.0
+        snap = led.snapshot(ts=40.0)
+        a = snap["tenants"]["a"]
+        # 10s x 8 chips x 0.5 + 10s x 8 x 1.0 + 10s x 8 x 0.0
+        assert a["productive_chip_seconds"] == pytest.approx(120.0)
+        assert a["goodput_per_chip"] == pytest.approx(120.0 / 320.0)
+
+    def test_overhead_intervals_accrue_no_productive(self):
+        """Acceptance (b): the goodput-per-chip dip during a
+        preemption + restore is attributed to the overhead states —
+        held keeps growing while productive is frozen."""
+        clk = FakeClock(0.0)
+        led = CapacityLedger(two_slices(), clock=clk)
+        led.on_allocate("ja", "a", [0, 1], ts=0.0)
+        led.observe_goodput("ja", 1.0, ts=0.0)
+        before = led.snapshot(ts=100.0)["tenants"]["a"]
+        assert before["goodput_per_chip"] == pytest.approx(1.0)
+        led.mark_preempting("ja", ts=100.0)
+        led.on_release("ja", [0, 1], ts=120.0)
+        led.on_allocate("ja", "a", [0, 1], ts=140.0)
+        led.mark_restoring("ja", ts=140.0)
+        during = led.snapshot(ts=160.0)["tenants"]["a"]
+        # productive frozen at 800 while held grew by the overhead.
+        assert during["productive_chip_seconds"] == pytest.approx(
+            before["productive_chip_seconds"]
+        )
+        assert during["goodput_per_chip"] < before["goodput_per_chip"]
+        assert during["states"][STATE_PREEMPTING] == pytest.approx(
+            160.0
+        )
+        assert during["states"][STATE_RESTORING] == pytest.approx(
+            160.0
+        )
+        led.job_ready("ja", ts=160.0)
+        after = led.snapshot(ts=260.0)["tenants"]["a"]
+        assert (
+            after["productive_chip_seconds"]
+            > during["productive_chip_seconds"]
+        )
+
+    def test_retire_job_purges_series(self):
+        """Satellite: retired jobs drop their per-job series; the
+        tenant-level series survives until its LAST job retires
+        (the PR-8 departed-host purge applied to tenants)."""
+        clk = FakeClock(0.0)
+        store = TimeSeriesStore(clock=clk)
+        led = CapacityLedger(
+            two_slices(), timeseries=store, clock=clk
+        )
+        led.on_allocate("j1", "a", [0], ts=0.0)
+        led.on_allocate("j2", "a", [1], ts=0.0)
+        led.observe_goodput("j1", 0.5, ts=10.0)
+        led.observe_goodput("j2", 0.5, ts=10.0)
+        labels = store.series_labels("tenant.goodput")
+        assert {"tenant": "a", "job": "j1"} in labels
+        assert {"tenant": "a"} in labels
+        led.on_release("j1", [0], ts=20.0)
+        led.retire_job("j1", retire_tenant=False, ts=20.0)
+        labels = store.series_labels("tenant.goodput")
+        assert {"tenant": "a", "job": "j1"} not in labels
+        assert {"tenant": "a", "job": "j2"} in labels
+        assert {"tenant": "a"} in labels
+        led.on_release("j2", [1], ts=30.0)
+        led.retire_job("j2", retire_tenant=True, ts=30.0)
+        assert store.series_labels("tenant.goodput") == []
+        # Retired productive history still counts in the rollup:
+        # j1 accrued 10s x 4 chips x 0.5, j2 accrued 20s x 4 x 0.5.
+        snap = led.snapshot(ts=40.0)
+        assert snap["tenants"]["a"][
+            "productive_chip_seconds"
+        ] == pytest.approx(60.0)
+
+    def test_brain_tables_roundtrip(self):
+        brain = BrainService(":memory:")
+        clk = FakeClock(0.0)
+        led = CapacityLedger(
+            two_slices(), brain=brain, job_name="pool", clock=clk
+        )
+        led.on_allocate("ja", "a", [0, 1], ts=10.0)
+        led.observe_goodput("ja", 0.8, ts=20.0)
+        led.mark_preempting("ja", ts=30.0)
+        led.on_release("ja", [0, 1], ts=40.0)
+        ivs = brain.recent_capacity_intervals("pool")
+        assert ivs, "no capacity intervals persisted"
+        states = {(iv["state"], iv["tenant"]) for iv in ivs}
+        assert (STATE_ALLOCATED, "a") in states
+        assert (STATE_PREEMPTING, "a") in states
+        assert (STATE_IDLE, IDLE_TENANT) in states
+        total = sum(iv["chip_seconds"] for iv in ivs)
+        assert total == pytest.approx(40.0 * 8, abs=1e-6)
+        gp = brain.recent_tenant_goodput("pool")
+        assert gp and gp[0]["tenant"] == "a"
+        assert gp[0]["held_chip_seconds"] > 0
+
+    def test_render_capacity_partition_warning(self):
+        payload = {
+            "pool_slices": 1,
+            "total_chips": 4,
+            "elapsed_s": 10.0,
+            "utilization": 0.5,
+            "partition_ok": False,
+            "chip_seconds": {
+                "capacity": 40.0,
+                "accounted": 30.0,
+                "by_state": {"idle": 30.0},
+            },
+            "tenants": {},
+        }
+        out = render_capacity(payload)
+        assert "WARNING" in out and "missed transition hook" in out
+        assert "no tenants" in out
+
+
+# ---------------------------------------------------------------------------
+# scheduler/pool hook integration (no pool master)
+# ---------------------------------------------------------------------------
+
+
+class TestSchedulerLedgerHooks:
+    def test_preempt_resume_complete_drive_ledger(self):
+        """The PoolScheduler + SlicePool hooks drive the ledger
+        through the real preemption path: allocated -> preempting ->
+        idle -> restoring on the elastic resume, and complete()
+        retires the job (tenant purge only when its last job
+        leaves)."""
+        from dlrover_tpu.pool import (
+            PoolJobSpec,
+            PoolScheduler,
+            SlicePool,
+        )
+        from tests.test_pool import FakeRT
+
+        clk = FakeClock(1000.0)
+        store = TimeSeriesStore(clock=clk)
+        pool = SlicePool(4)
+        pool.ledger = CapacityLedger(
+            pool.specs(), timeseries=store, clock=clk
+        )
+        led = pool.ledger
+        sched = PoolScheduler(pool, park_timeout_s=5.0)
+        sched.submit(
+            PoolJobSpec(job_id="low", tenant="research", priority=1,
+                        n_slices=4, min_slices=2),
+            FakeRT(defer_park=True),
+        )
+        led.observe_goodput("low", 0.9, ts=1000.0)
+        clk.t = 1100.0
+        rt_low = sched._jobs["low"].runtime
+        sched.submit(
+            PoolJobSpec(job_id="high", tenant="prod", priority=5,
+                        n_slices=2),
+            FakeRT(),
+        )
+        clk.t = 1110.0
+        rt_low.confirm_park()  # release at 1110: preempting 10s
+        # low resumed elastically on the 2 remaining slices.
+        assert sched.job_info("low")["state"] == "placed"
+        snap = led.snapshot(ts=1150.0)
+        research = snap["tenants"]["research"]
+        assert research["states"][STATE_PREEMPTING] == pytest.approx(
+            10.0 * 16
+        )
+        assert STATE_RESTORING in research["states"]
+        assert snap["partition_ok"], snap["chip_seconds"]
+        # Complete the high job: prod had no sibling -> tenant purge.
+        led.observe_goodput("high", 0.5, ts=1150.0)
+        assert {"tenant": "prod"} in store.series_labels(
+            "tenant.goodput"
+        )
+        sched.complete("high")
+        assert {"tenant": "prod"} not in store.series_labels(
+            "tenant.goodput"
+        )
+        assert {"tenant": "research"} in store.series_labels(
+            "tenant.goodput"
+        )
+
+
+# ---------------------------------------------------------------------------
+# SLO error budgets & burn-rate detection
+# ---------------------------------------------------------------------------
+
+
+def _ttft_spec(**over):
+    kw = dict(
+        tenant="b", slo="ttft", series="tenant.ttft_p99_s",
+        objective=0.5, direction="max", budget=0.05,
+        labels={"tenant": "b"},
+    )
+    kw.update(over)
+    return SLOSpec(**kw)
+
+
+class TestSLOBudgets:
+    def _monitor(self, clk, specs):
+        store = TimeSeriesStore(clock=clk)
+        mon = HealthMonitor(
+            store=store, clock=clk, interval=9999.0, slos=specs
+        )
+        return store, mon
+
+    def test_fast_critical_slow_warn_then_resolve(self):
+        """Acceptance (c): the injected TTFT regression fires the
+        fast pair critical while the slow pair holds warn; both
+        resolve once the bad samples age out of their windows."""
+        clk = FakeClock(200000.0)
+        spec = _ttft_spec()
+        store, mon = self._monitor(clk, [spec])
+        now = clk.t
+        for i in range(15):  # compliant traffic, 5m..1h old
+            store.record(
+                "tenant.ttft_p99_s", 0.2,
+                ts=now - 3500 + i * 8, tenant="b",
+            )
+        for i in range(50):  # regression inside the 5m window
+            store.record(
+                "tenant.ttft_p99_s", 2.0,
+                ts=now - 290 + i * 5.5, tenant="b",
+            )
+        active = mon.evaluate_once()
+        sev = {v.host: v.severity for v in active
+               if v.detector == "slo_burn"}
+        assert sev.get("b/ttft/fast") == SEVERITY_CRITICAL, sev
+        assert sev.get("b/ttft/slow") == SEVERITY_WARN, sev
+        budgets = mon.slo_snapshot()
+        assert len(budgets) == 1
+        b = budgets[0]
+        assert b["burning"] and b["severity"] == SEVERITY_CRITICAL
+        assert b["burn"]["fast"] >= 14.4
+        assert b["burn"]["slow"] >= 1.0
+        assert b["budget_remaining"] == 0.0
+        # Recovery: 8h later the bad samples are outside even the 6h
+        # slow window; fresh traffic is compliant.
+        clk.t = now + 8 * 3600.0
+        for i in range(30):
+            store.record(
+                "tenant.ttft_p99_s", 0.2,
+                ts=clk.t - 240 + i * 7, tenant="b",
+            )
+        active = mon.evaluate_once()
+        assert not [v for v in active if v.detector == "slo_burn"]
+        b = mon.slo_snapshot()[0]
+        assert not b["burning"] and b["severity"] == ""
+        # The resolution is in the history with resolved=True.
+        resolved = [
+            v for v in mon.history()
+            if v.detector == "slo_burn" and v.resolved
+        ]
+        assert resolved
+
+    def test_idle_tenant_never_pages(self):
+        """No samples = no burn: an idle tenant keeps a full budget
+        and no verdict."""
+        clk = FakeClock(200000.0)
+        _, mon = self._monitor(clk, [_ttft_spec()])
+        active = mon.evaluate_once()
+        assert not [v for v in active if v.detector == "slo_burn"]
+        b = mon.slo_snapshot()[0]
+        assert b["budget_remaining"] == 1.0 and not b["burning"]
+
+    def test_blip_does_not_page(self):
+        """A short spike burns the 5m window but not the 1h window —
+        the min() of the pair keeps fast quiet."""
+        clk = FakeClock(200000.0)
+        store, mon = self._monitor(clk, [_ttft_spec()])
+        now = clk.t
+        for i in range(200):  # an hour of compliant traffic
+            store.record(
+                "tenant.ttft_p99_s", 0.2,
+                ts=now - 3590 + i * 17, tenant="b",
+            )
+        for i in range(5):  # 5-sample blip in the last minute
+            store.record(
+                "tenant.ttft_p99_s", 2.0,
+                ts=now - 50 + i * 9, tenant="b",
+            )
+        active = mon.evaluate_once()
+        sev = {v.host: v.severity for v in active
+               if v.detector == "slo_burn"}
+        assert "b/ttft/fast" not in sev, sev
+
+    def test_min_direction_objective(self):
+        """Training-goodput SLOs gate in the other direction: bad
+        when the ratio drops BELOW the objective."""
+        clk = FakeClock(200000.0)
+        spec = _ttft_spec(
+            tenant="a", slo="goodput", series="tenant.goodput",
+            objective=0.8, direction="min",
+            labels={"tenant": "a"},
+        )
+        store, mon = self._monitor(clk, [spec])
+        now = clk.t
+        for i in range(60):
+            store.record(
+                "tenant.goodput", 0.3,
+                ts=now - 290 + i * 4.5, tenant="a",
+            )
+        for i in range(10):
+            store.record(
+                "tenant.goodput", 0.95,
+                ts=now - 3500 + i * 10, tenant="a",
+            )
+        active = mon.evaluate_once()
+        sev = {v.host: v.severity for v in active
+               if v.detector == "slo_burn"}
+        assert sev.get("a/goodput/fast") == SEVERITY_CRITICAL, sev
+
+    def test_slos_from_env(self, monkeypatch):
+        monkeypatch.setenv(
+            "DLROVER_TPU_HEALTH_SLOS",
+            json.dumps([
+                {"tenant": "a", "slo": "goodput",
+                 "series": "tenant.goodput", "objective": 0.8,
+                 "direction": "min", "budget": 0.1,
+                 "labels": {"tenant": "a"}},
+            ]),
+        )
+        specs = slos_from_env()
+        assert len(specs) == 1
+        assert specs[0].key() == "a/goodput"
+        assert specs[0].budget == 0.1
+        monkeypatch.setenv("DLROVER_TPU_HEALTH_SLOS", "not json")
+        assert slos_from_env() == []
+
+
+# ---------------------------------------------------------------------------
+# exit-code contract (satellite: one table, enforced)
+# ---------------------------------------------------------------------------
+
+
+class TestObsReportExitCodes:
+    """Every obs_report probe section follows the documented rc
+    contract: 0 ok / 1 probe-failed / 2 target-unreachable."""
+
+    def test_unreachable_target_is_rc2_everywhere(self, capsys):
+        import obs_report
+
+        missing = os.path.join("no", "such", "snapshot.json")
+        assert obs_report.health_report(missing) == 2
+        assert obs_report.serving_report(missing) == 2
+        assert obs_report.pool_report(missing) == 2
+        assert obs_report.capacity_report(missing) == 2
+        assert obs_report.trace_report("tr-1", missing) == 2
+        capsys.readouterr()
+
+    def test_probe_failed_is_rc1(self, tmp_path, capsys):
+        import obs_report
+
+        # --pool: a failed job in the snapshot gates rc=1.
+        snap = {
+            "slices": {"total": 1, "used": 0},
+            "jobs": {
+                "j1": {"state": "failed", "tenant": "t",
+                       "priority": 1, "n_slices": 1, "slices": [],
+                       "preemptions": 0, "reason": "boom",
+                       "trace_id": ""},
+            },
+            "queue_depth": {},
+            "counters": {"preemptions": {}},
+            "tenants": {},
+            "wait_percentiles": {},
+        }
+        p = tmp_path / "pool.json"
+        p.write_text(json.dumps(snap))
+        assert obs_report.pool_report(str(p)) == 1
+        snap["jobs"]["j1"]["state"] = "done"
+        p.write_text(json.dumps(snap))
+        assert obs_report.pool_report(str(p)) == 0
+        # --capacity: a burning budget gates rc=1.
+        cap = {
+            "pool_slices": 1, "total_chips": 4, "elapsed_s": 1.0,
+            "utilization": 0.0, "partition_ok": True,
+            "chip_seconds": {"capacity": 4.0, "accounted": 4.0,
+                             "by_state": {"idle": 4.0}},
+            "tenants": {},
+            "slo": {"budgets": [{"tenant": "b", "slo": "ttft",
+                                 "burning": True,
+                                 "severity": "critical",
+                                 "budget_remaining": 0.0,
+                                 "burn": {"fast": 20.0,
+                                          "slow": 2.0}}]},
+        }
+        c = tmp_path / "cap.json"
+        c.write_text(json.dumps(cap))
+        assert obs_report.capacity_report(str(c)) == 1
+        cap["slo"]["budgets"][0]["burning"] = False
+        c.write_text(json.dumps(cap))
+        assert obs_report.capacity_report(str(c)) == 0
+        # --trace: reachable target, key not found -> rc=1.
+        t = tmp_path / "traces.json"
+        t.write_text(json.dumps({"traces": []}))
+        assert obs_report.trace_report("ghost", str(t)) == 1
+        capsys.readouterr()
+
+    def test_exit_code_table_documented(self):
+        import obs_report
+
+        doc = obs_report.__doc__
+        assert "Exit codes" in doc
+        for needle in ("probe passed", "probe FAILED",
+                       "target unreachable", "--capacity"):
+            assert needle in doc
+
+
+# ---------------------------------------------------------------------------
+# the acceptance drill: live pool master, backdated two-tenant story
+# ---------------------------------------------------------------------------
+
+
+class TestCapacityAcceptance:
+    def test_two_tenant_pool_drill(self):
+        from dlrover_tpu.agent.master_client import MasterClient
+        from dlrover_tpu.pool import PoolJobSpec, TPUPoolMaster
+
+        import obs_report
+
+        brain = BrainService(":memory:")
+        spec = _ttft_spec()
+        master = TPUPoolMaster(
+            slices=4, watch_interval=9999.0, slos=[spec],
+            brain=brain,
+        )
+        # Backdate the whole plane onto one fake clock, anchored at
+        # the ledger's birth instant so the partition stays exact.
+        clk = FakeClock(master.capacity.start_ts)
+        t0 = clk.t
+        master.capacity.clock = clk
+        master.timeseries.clock = clk
+        master.health.clock = clk
+        master.prepare()
+        clients = []
+        staged = {"ok": False}
+
+        def probe():
+            return {"staged": staged["ok"], "step": 7, "mtime": clk.t}
+
+        try:
+            clk.t = t0 + 30
+            r = master.submit(
+                PoolJobSpec(job_id="job-a", tenant="a", priority=1,
+                            n_slices=2, min_slices=2),
+                ckpt_probe=probe,
+            )
+            assert r["state"] == "placed", r
+            clk.t = t0 + 40
+            r = master.submit(
+                PoolJobSpec(job_id="job-b", tenant="b", priority=5,
+                            n_slices=1)
+            )
+            assert r["state"] == "placed", r
+            # Training telemetry: tenant a runs at 0.9 goodput.
+            master.capacity.observe_goodput("job-a", 0.9, ts=t0 + 60)
+            # Preemption: a priority-9 gang that needs a's slices.
+            clk.t = t0 + 240
+            r = master.submit(
+                PoolJobSpec(job_id="job-h", tenant="prod",
+                            priority=9, n_slices=2)
+            )
+            clk.t = t0 + 300
+            staged["ok"] = True  # checkpoint staged: park confirms
+            deadline = time.time() + 15.0
+            while time.time() < deadline:
+                info = master.scheduler.job_info("job-a")
+                if info["state"] == "preempted":
+                    break
+                time.sleep(0.05)
+            assert master.scheduler.job_info("job-a")[
+                "state"
+            ] == "preempted"
+            assert master.scheduler.job_info("job-h")[
+                "state"
+            ] == "placed"
+            # The high job finishes; a resumes (restore path). The
+            # resume placement may ride a scheduling pass still in
+            # flight on the park thread, so poll (clk pinned at
+            # t+360 keeps the restoring stamp deterministic).
+            clk.t = t0 + 360
+            master.scheduler.complete("job-h")
+            deadline = time.time() + 15.0
+            while time.time() < deadline:
+                if master.scheduler.job_info("job-a")[
+                    "state"
+                ] == "placed":
+                    break
+                time.sleep(0.05)
+            info = master.scheduler.job_info("job-a")
+            assert info["state"] == "placed", info
+            # Its workers re-register at t+420: restoring ends.
+            clk.t = t0 + 420
+            ca = MasterClient(
+                master.addr, node_id=0, job_id="job-a"
+            )
+            clients.append(ca)
+            ca.register_node("worker")
+            # Serving telemetry: tenant b's TTFT regresses hard in
+            # the last five minutes of the timeline.
+            for i in range(15):
+                master.timeseries.record(
+                    "tenant.ttft_p99_s", 0.2,
+                    ts=t0 + 180 + i * 8, tenant="b",
+                )
+                master.timeseries.record(
+                    "tenant.ttft_p99_s", 0.2,
+                    ts=t0 + 180 + i * 8, tenant="b", job="job-b",
+                )
+            for i in range(50):
+                master.timeseries.record(
+                    "tenant.ttft_p99_s", 2.0,
+                    ts=t0 + 310 + i * 5.5, tenant="b",
+                )
+            clk.t = t0 + 600
+            master.observe_capacity()  # join + one SLO evaluation
+
+            # (a) the partition invariant, against the live ledger.
+            snap = master.capacity.snapshot(ts=t0 + 600)
+            assert snap["partition_ok"], snap["chip_seconds"]
+            want = master.capacity.total_chips * 600.0
+            assert snap["chip_seconds"]["accounted"] == pytest.approx(
+                want, abs=1e-6
+            )
+
+            # (b) tenant a's dip is attributed to the overhead
+            # states: preempting 60s x 8 chips, restoring 60s x 8.
+            a = snap["tenants"]["a"]
+            assert a["states"][STATE_PREEMPTING] == pytest.approx(
+                60.0 * 8, abs=1e-6
+            )
+            assert a["states"][STATE_RESTORING] == pytest.approx(
+                60.0 * 8, abs=1e-6
+            )
+            assert a["overhead_chip_seconds"] == pytest.approx(
+                120.0 * 8, abs=1e-6
+            )
+            assert a["productive_chip_seconds"] > 0
+            assert a["goodput_per_chip"] < 0.9
+
+            # (c) fast fires critical while slow holds warn.
+            sev = {
+                v.severity
+                for k, v in master.health._active.items()
+                if k[0] == "slo_burn"
+            }
+            assert sev == {SEVERITY_CRITICAL, SEVERITY_WARN}, sev
+            budgets = master.health.slo_snapshot()
+            assert budgets[0]["burning"]
+            assert budgets[0]["severity"] == SEVERITY_CRITICAL
+
+            # (d) obs_report --capacity against the live master:
+            # rc=1 during the burn...
+            assert obs_report.capacity_report(master.addr) == 1
+            # ...and rc=0 after recovery (bad samples age out).
+            clk.t = t0 + 600 + 8 * 3600.0
+            for i in range(30):
+                master.timeseries.record(
+                    "tenant.ttft_p99_s", 0.2,
+                    ts=clk.t - 240 + i * 7, tenant="b",
+                )
+            master.health.evaluate_once()
+            assert not master.health.slo_snapshot()[0]["burning"]
+            assert obs_report.capacity_report(master.addr) == 0
+
+            # New metrics exposed in the registry text.
+            text = obs.get_registry().render()
+            for name in (
+                "dlrover_pool_chip_seconds_total",
+                "dlrover_tenant_goodput_per_chip",
+                "dlrover_slo_budget_remaining",
+            ):
+                assert name in text, name
+
+            # Brain tables readable back.
+            assert brain.recent_capacity_intervals("pool")
+            assert brain.recent_tenant_goodput("pool")
+        finally:
+            for c in clients:
+                c.close()
+            master.stop()

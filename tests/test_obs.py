@@ -580,6 +580,36 @@ class TestMetricNameHygiene:
                 problems[name] = (got, want)
         assert not problems, problems
 
+    def test_capacity_plane_metrics_are_audited(self):
+        """The capacity accounting plane's registrations
+        (obs/capacity.py chip-second ledger + obs/health.py SLO
+        budget engine) must be visible to the walker with the
+        contract names/types/labels — obs_report --capacity, the
+        docs/OBSERVABILITY.md dashboard rows, and the burn-rate
+        alerts all key on them. Labels stay bounded: tenant/state/
+        slo only, never job_id."""
+        sites = {
+            name: (mtype, labels)
+            for _, _, mtype, name, _, labels in self._call_sites()
+        }
+        expected = {
+            "dlrover_pool_chip_seconds_total": (
+                "counter", ["tenant", "state"],
+            ),
+            "dlrover_tenant_goodput_per_chip": (
+                "gauge", ["tenant"],
+            ),
+            "dlrover_slo_budget_remaining": (
+                "gauge", ["tenant", "slo"],
+            ),
+        }
+        problems = {}
+        for name, want in expected.items():
+            got = sites.get(name)
+            if got != want:
+                problems[name] = (got, want)
+        assert not problems, problems
+
 
 class TestSpanNameHygiene:
     """Audit every literal ``obs.span(...)`` / ``obs.event(...)``

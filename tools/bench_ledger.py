@@ -29,13 +29,18 @@ Usage::
 
     python tools/bench_ledger.py append --json '{"metric": ..., ...}'
     python tools/bench_ledger.py compare --baseline <rev-prefix|last>
-        [--threshold 0.03] [--metric NAME] [--head <rev-prefix>]
+        [--threshold 0.03] [--metric NAME[,NAME...]]
+        [--head <rev-prefix>]
     python tools/bench_ledger.py show [-n 10]
 
 ``compare`` exit codes: 0 = head within threshold of baseline (or
 better), 1 = regression past the threshold, 2 = can't compare
 (missing records / bad arguments) — distinct so CI can tell "slower"
-from "blind".
+from "blind". ``--metric`` accepts a comma-separated list: each
+metric is gated independently (its own report + verdict line) and
+the aggregated exit code takes the worst outcome, with "slower"
+outranking "blind" — any regression → 1, else any not-comparable
+→ 2, else 0.
 """
 
 from __future__ import annotations
@@ -320,7 +325,13 @@ def main(argv=None) -> int:
         "(newest measurable record older than head)",
     )
     cp.add_argument("--head", default="", help="head selector (default: newest)")
-    cp.add_argument("--metric", default=None)
+    cp.add_argument(
+        "--metric", default=None,
+        help="metric name, or a comma-separated list — each metric "
+        "gates independently and the worst outcome wins the exit "
+        "code (any regression -> 1, else any not-comparable -> 2, "
+        "else 0)",
+    )
     cp.add_argument("--threshold", type=float, default=DEFAULT_THRESHOLD)
 
     sp = sub.add_parser("show", help="print recent records")
@@ -343,15 +354,35 @@ def main(argv=None) -> int:
         print(json.dumps(stored, sort_keys=True))
         return 0
     if args.cmd == "compare":
-        rc, report = compare(
-            args.baseline,
-            head=args.head,
-            metric=args.metric,
-            threshold=args.threshold,
-            path=path,
+        metrics = (
+            [m.strip() for m in args.metric.split(",") if m.strip()]
+            if args.metric
+            else [None]
         )
-        print(report)
-        return rc
+        results = []
+        for metric in metrics:
+            rc, report = compare(
+                args.baseline,
+                head=args.head,
+                metric=metric,
+                threshold=args.threshold,
+                path=path,
+            )
+            results.append((metric, rc))
+            print(report)
+        if len(results) > 1:
+            # Per-metric verdicts, then one aggregated exit code:
+            # any regression beats any not-comparable beats ok.
+            verdict = {0: "ok", 1: "REGRESSED", 2: "not comparable"}
+            print("per-metric verdicts:")
+            for metric, rc in results:
+                print(f"  {metric}: {verdict.get(rc, rc)}")
+        codes = [rc for _, rc in results]
+        if 1 in codes:
+            return 1
+        if 2 in codes:
+            return 2
+        return 0
     print(show(args.n, path=path))
     return 0
 

@@ -23,6 +23,15 @@ obs/health.py) from either a live master (``host:port``, via the
 verdict is active, so scripts can gate on it like the /healthz
 probe.
 
+``--capacity TARGET`` renders the pool's capacity accounting plane
+(per-tenant chip-second ledger, goodput-per-chip, preemption /
+restore overhead, SLO error budgets with burn-rate alerts —
+obs/capacity.py + the slo_burn detector in obs/health.py) from a
+live pool master (``host:port``, via the ``CapacityQueryRequest``
+RPC) or a JSON snapshot file (``CapacityLedger.snapshot()`` shaped,
+optionally with an attached ``slo`` block). Exits 1 while any
+tenant's error budget is burning.
+
 ``--postmortem DIR`` instead renders a forensics dir (the flight
 recorder's ``bundle_*.json`` black-box bundles + ``stacks_*.txt``
 faulthandler dumps + any ``*.jsonl`` traces, obs/postmortem.py) into
@@ -31,11 +40,31 @@ windowed event tail, recovery timeline + goodput over the window,
 then every bundle's per-thread Python stacks and each stacks file's
 final dump.
 
+Exit codes — every probe section follows the same contract so
+scripts and cron gates can treat any section uniformly:
+
+    rc  meaning                 examples
+    --  ----------------------  ------------------------------------
+    0   probe passed            healthy fleet; no unhealthy replica;
+                                no failed pool job; budgets intact
+    1   probe FAILED            --health: critical / failing-
+                                probation / unknown-remediation
+                                verdict active; --serving: unhealthy
+                                replica; --pool: failed job;
+                                --capacity: SLO budget burning;
+                                --trace: key not found
+    2   target unreachable      snapshot file missing, RPC refused /
+                                timed out — the probe itself could
+                                not run (distinct from "ran and
+                                failed" so alerting can separate
+                                outage-of-signal from bad signal)
+
 Usage:
     python tools/obs_report.py TRACE.jsonl [--failure-ts T] [--top N]
     python tools/obs_report.py TRACE.jsonl --goodput
     python tools/obs_report.py --health 127.0.0.1:8001
     python tools/obs_report.py --health health_snapshot.json
+    python tools/obs_report.py --capacity 127.0.0.1:8001
     python tools/obs_report.py --postmortem /tmp/dlrover_tpu_forensics_job
     python tools/obs_report.py --selftest
 
@@ -465,7 +494,59 @@ def pool_report(target: str) -> int:
             return 0
         payload = resp.snapshot
     print(render_pool(payload))
-    return 0
+    failed = [
+        jid
+        for jid, j in (payload.get("jobs") or {}).items()
+        if j.get("state") == "failed"
+    ]
+    return 1 if failed else 0
+
+
+def capacity_report(target: str) -> int:
+    """Render the pool capacity plane (per-tenant chip-second
+    ledger, goodput-per-chip, overhead, SLO error budgets) from a
+    live pool master (host:port, ``CapacityQueryRequest`` RPC) or a
+    JSON snapshot file (``CapacityLedger.snapshot()`` shaped).
+    Exits 1 while any tenant's error budget is burning."""
+    import json
+    import os
+
+    from dlrover_tpu.obs.capacity import render_capacity
+
+    if os.path.isfile(target):
+        with open(target) as f:
+            payload = json.load(f)
+    elif (
+        target.endswith(".json")
+        or os.sep in target
+        or ":" not in target
+    ):
+        print(
+            f"capacity snapshot not found: {target}", file=sys.stderr
+        )
+        return 2
+    else:
+        from dlrover_tpu.agent.master_client import MasterClient
+
+        client = MasterClient(target, node_id=-1)
+        try:
+            resp = client.query_capacity(max_wait=15.0)
+        except Exception as exc:  # noqa: BLE001
+            print(
+                f"capacity query to {target} failed: {exc}",
+                file=sys.stderr,
+            )
+            return 2
+        finally:
+            client.close()
+        if not resp.enabled:
+            print("capacity plane disabled on this master")
+            return 0
+        payload = resp.snapshot
+    print(render_capacity(payload))
+    budgets = (payload.get("slo") or {}).get("budgets") or []
+    burning = [b for b in budgets if b.get("burning")]
+    return 1 if burning else 0
 
 
 def trace_report(key: str, target: str) -> int:
@@ -1161,6 +1242,7 @@ def selftest() -> int:
     errors.extend(_selftest_serving())
     errors.extend(_selftest_trace())
     errors.extend(_selftest_pool())
+    errors.extend(_selftest_capacity())
     if errors:
         print("obs selftest FAILED:")
         for e in errors:
@@ -1257,6 +1339,124 @@ def _selftest_pool() -> list:
             errors.append("pool selftest: pool_report(file) != 0")
     finally:
         os.unlink(path)
+    return errors
+
+
+def _selftest_capacity() -> list:
+    """The --capacity path end to end: a real CapacityLedger plays a
+    two-tenant pool story under a fake clock — idle gap, allocation,
+    goodput accrual, a preemption + restore — then the snapshot must
+    hold the partition invariant exactly, the renderer must surface
+    the per-tenant table and SLO budget alerts, and the file-target
+    rc contract must distinguish burning (1) / healthy (0) /
+    missing (2)."""
+    import json
+    import os
+    import tempfile
+
+    from dlrover_tpu.obs.capacity import (
+        CapacityLedger,
+        render_capacity,
+    )
+    from dlrover_tpu.pool.slice_pool import SliceSpec
+
+    errors = []
+    t0 = 1000.0
+    specs = [SliceSpec(slice_id=0), SliceSpec(slice_id=1)]
+    led = CapacityLedger(specs, clock=lambda: t0)  # 2 x 4 chips
+    # tenant a trains on both slices after a 10s idle gap, gets
+    # preempted at t+40, resumes on slice 1 at t+70.
+    led.on_allocate("ja", "a", [0, 1], ts=t0 + 10)
+    led.observe_goodput("ja", 0.9, ts=t0 + 40)
+    led.mark_preempting("ja", ts=t0 + 40)
+    led.on_release("ja", [0, 1], ts=t0 + 50)
+    # tenant b serves on slice 0 from t+60.
+    led.on_allocate("jb", "b", [0], ts=t0 + 60)
+    led.on_allocate("ja", "a", [1], ts=t0 + 70)
+    led.mark_restoring("ja", ts=t0 + 70)
+    led.job_ready("ja", ts=t0 + 80)
+    snap = led.snapshot(ts=t0 + 100)
+    if not snap["partition_ok"]:
+        errors.append(
+            f"capacity selftest: partition broken: "
+            f"{snap['chip_seconds']}"
+        )
+    if abs(snap["chip_seconds"]["capacity"] - 800.0) > 1e-6:
+        errors.append(
+            f"capacity selftest: capacity "
+            f"{snap['chip_seconds']['capacity']} != 800"
+        )
+    by_state = snap["chip_seconds"]["by_state"]
+    if abs(by_state.get("idle", 0.0) - 200.0) > 1e-6:
+        errors.append(
+            f"capacity selftest: idle cs {by_state.get('idle')}"
+            " != 200"
+        )
+    a = snap["tenants"].get("a", {})
+    if abs(a.get("overhead_chip_seconds", 0.0) - 120.0) > 1e-6:
+        errors.append(
+            f"capacity selftest: tenant-a overhead {a} != 120"
+        )
+    # A ratio observation applies forward: 0.9 lands at t+40 just
+    # as the preemption stops accrual, so only the 20s x 4 chips
+    # after the restore completes counts — overhead accrues none.
+    if abs(a.get("productive_chip_seconds", 0.0) - 72.0) > 1e-6:
+        errors.append(
+            f"capacity selftest: tenant-a productive {a} != 72"
+        )
+    if abs(snap.get("utilization", 0.0) - 0.75) > 1e-6:
+        errors.append(
+            f"capacity selftest: utilization {snap['utilization']}"
+        )
+    snap["slo"] = {
+        "budgets": [
+            {
+                "tenant": "b", "slo": "ttft",
+                "series": "tenant.ttft_p99_s",
+                "objective": 0.5, "direction": "max",
+                "budget_remaining": 0.2, "burning": True,
+                "severity": "critical",
+                "burn": {"fast": 15.4, "slow": 2.0},
+            },
+        ]
+    }
+    rendered = render_capacity(snap)
+    for needle in (
+        "2 slice(s) / 8 chip(s)",
+        "utilization 75%",
+        "preempting 80.0",
+        "restoring 40.0",
+        "b/ttft: budget remaining 20%",
+        "BURNING [critical] fast 15.4x slow 2.0x",
+    ):
+        if needle not in rendered:
+            errors.append(
+                f"capacity selftest: {needle!r} missing from:\n"
+                f"{rendered}"
+            )
+    # File-target rc contract: burning -> 1, healthy -> 0,
+    # missing -> 2.
+    with tempfile.NamedTemporaryFile(
+        "w", suffix="_capacity.json", delete=False
+    ) as f:
+        json.dump(snap, f)
+        path = f.name
+    try:
+        if capacity_report(path) != 1:
+            errors.append(
+                "capacity selftest: burning snapshot rc != 1"
+            )
+        snap["slo"]["budgets"][0]["burning"] = False
+        with open(path, "w") as f:
+            json.dump(snap, f)
+        if capacity_report(path) != 0:
+            errors.append(
+                "capacity selftest: healthy snapshot rc != 0"
+            )
+    finally:
+        os.unlink(path)
+    if capacity_report(path) != 2:
+        errors.append("capacity selftest: missing target rc != 2")
     return errors
 
 
@@ -1531,6 +1731,15 @@ def main(argv=None) -> int:
         "master (host:port) or a PoolScheduler.snapshot() JSON file",
     )
     p.add_argument(
+        "--capacity", type=str, default="",
+        metavar="TARGET",
+        help="render the pool capacity plane (per-tenant chip-second "
+        "accounting, goodput-per-chip, preemption/restore overhead, "
+        "SLO error budgets with burn-rate alerts) from a live pool "
+        "master (host:port) or a CapacityLedger.snapshot() JSON "
+        "file; exits 1 while any tenant's error budget is burning",
+    )
+    p.add_argument(
         "--trace", type=str, default="",
         metavar="KEY",
         help="render the causal trace timeline(s) for KEY — a trace "
@@ -1565,6 +1774,8 @@ def main(argv=None) -> int:
         return serving_report(args.serving)
     if args.pool:
         return pool_report(args.pool)
+    if args.capacity:
+        return capacity_report(args.capacity)
     if args.trace:
         if not args.event_file:
             p.error(
