@@ -90,6 +90,14 @@ def measure() -> int:
         shard_batch,
     )
 
+    # Progress beacon: the parent points DLROVER_TPU_BEACON_FILE at a
+    # run-scoped path; we stamp step/phase boundaries so a wedged
+    # backend (the tunnel hangs rather than raises) leaves a readable
+    # last-known-position for the parent's kind-"hang" ledger record.
+    from dlrover_tpu.obs.beacon import default_beacon
+
+    beacon = default_beacon()
+
     n_chips = len(jax.devices())
     mesh = build_mesh(MeshConfig(data=n_chips))
     smoke = os.getenv("BENCH_SMOKE", "0") == "1"
@@ -384,6 +392,8 @@ def measure() -> int:
 
     # Fetch-then-dispatch: every fetched batch is trained on, and the
     # loop never pays a trailing fetch for a batch it will discard.
+    if beacon is not None:
+        beacon.stamp(phase="compile")
     for _ in range(warmup):
         if pf is not None:
             tokens, targets = next(pf)
@@ -397,13 +407,17 @@ def measure() -> int:
     if pf is not None:
         pf.wait_s_total = 0.0  # count data-wait for measured steps only
     start = time.time()
-    for _ in range(steps):
+    for i in range(steps):
+        if beacon is not None:
+            beacon.stamp(step=i + 1, phase="dispatch")
         if pf is not None:
             tokens, targets = next(pf)
         params, opt_state, metrics = step(
             params, opt_state, tokens, targets
         )
     float(metrics["loss"])
+    if beacon is not None:
+        beacon.stamp(step=steps, phase="device_execute")
     elapsed = time.time() - start
     data_wait_s = pf.wait_s_total if pf is not None else 0.0
     if pf is not None:
@@ -623,6 +637,30 @@ def _stamp_and_ledger(line: str) -> str:
         return line
 
 
+def _read_final_beacon() -> dict:
+    """The measurement child's last progress stamp (step / phase /
+    staleness), read from the beacon file AFTER the child is dead —
+    the whole point of the mmap'd beacon is that it outlives a wedged
+    writer. Empty dict when the child never stamped."""
+    try:
+        from dlrover_tpu.obs import beacon as _beacon
+
+        stamp = _beacon.read_beacon()
+        if not stamp:
+            return {}
+        out = {
+            k: stamp.get(k)
+            for k in ("pid", "step", "microbatch", "phase", "seq")
+        }
+        age = _beacon.stamp_age(stamp)
+        if age is not None:
+            out["age_s"] = round(age, 1)
+        return out
+    except Exception:  # noqa: BLE001 — forensics never outrank the
+        # failure record
+        return {}
+
+
 def _emit_failure(error_class: str, detail: str, attempts: int) -> None:
     rec = {
         "metric": "nanogpt_tokens_per_sec_per_chip",
@@ -633,6 +671,29 @@ def _emit_failure(error_class: str, detail: str, attempts: int) -> None:
         "detail": detail[:300],
         "attempts": attempts,
     }
+    if error_class == "tpu_hang":
+        # A timeout is a hang, and the beacon says WHERE: the record
+        # kind + last stamp turn "rc=124" into "wedged at step K's
+        # dispatch" (ROADMAP item 1's blind-retry seam).
+        rec["kind"] = "hang"
+        stamp = _read_final_beacon()
+        if stamp:
+            rec["beacon"] = stamp
+            rec["hang_digest"] = (
+                f"child last stamped step {stamp.get('step')} "
+                f"{stamp.get('phase')}"
+                + (
+                    f" microbatch {stamp.get('microbatch')}"
+                    if (stamp.get("microbatch") or -1) >= 0
+                    else ""
+                )
+                + (
+                    f", {stamp['age_s']:.0f}s before the kill"
+                    if isinstance(stamp.get("age_s"), (int, float))
+                    else ""
+                )
+            )
+            print(f"# {rec['hang_digest']}", file=sys.stderr)
     try:
         from dlrover_tpu.common.runmeta import run_metadata
 
@@ -666,6 +727,16 @@ def _emit_failure(error_class: str, detail: str, attempts: int) -> None:
 
 
 def main() -> int:
+    # Run-scoped beacon file, inherited by the measurement child: the
+    # child stamps progress into it, and on a timeout the parent reads
+    # the dead child's last position for the kind-"hang" record.
+    os.environ.setdefault(
+        "DLROVER_TPU_BEACON_FILE",
+        os.path.join(
+            os.getenv("TMPDIR", "/tmp"),
+            f"dlrover_tpu_beacon_bench_{os.getpid()}.json",
+        ),
+    )
     max_wait = float(os.getenv("BENCH_MAX_WAIT_S", "1200"))
     probe_timeout = float(os.getenv("BENCH_PROBE_TIMEOUT", "120"))
     run_timeout = float(os.getenv("BENCH_RUN_TIMEOUT", "900"))

@@ -403,6 +403,25 @@ class ElasticAgent:
             "diagnose", bundle_path=bundle_path, digest=digest
         )
 
+    def _on_stale_beacon(self, stamp: dict) -> None:
+        """ResourceMonitor found the trainer's progress beacon wedged
+        (no stamp for DLROVER_TPU_BEACON_STALL_S): capture forensics
+        while the wedge is live — the SIGUSR1 stack snapshot shows
+        exactly which collective the trainer is parked in — and ship
+        them as a kind-``stall`` DiagnosticsReport. The master-side
+        correlator does the cross-host localization; this capture is
+        the host-local half of the evidence."""
+        digest, bundle_path = self._collect_forensics(
+            "stall",
+            beacon_step=stamp.get("step"),
+            beacon_microbatch=stamp.get("microbatch"),
+            beacon_phase=stamp.get("phase"),
+            beacon_age_s=stamp.get("age_s"),
+        )
+        self.client.report_diagnostics(
+            "stall", bundle_path=bundle_path, digest=digest
+        )
+
     def _run_profile(self) -> None:
         """Master-pushed `profile` action: ask the co-hosted trainer
         for an N-step step-phase/MFU capture and ship the digest back
@@ -580,7 +599,9 @@ class ElasticAgent:
         )
         from dlrover_tpu.agent.paral_config_tuner import ParalConfigTuner
 
-        res_mon = ResourceMonitor(self.client)
+        res_mon = ResourceMonitor(
+            self.client, on_stale_beacon=self._on_stale_beacon
+        )
         train_mon = TrainingMonitor(self.client)
         tuner = ParalConfigTuner(self.client)
         # After a master reconnect (possibly to a warm-restarted

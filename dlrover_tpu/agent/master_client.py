@@ -666,6 +666,7 @@ class MasterClient:
         step_times: Optional[list] = None,
         events: Optional[list] = None,
         timestamp: Optional[float] = None,
+        beacon: Optional[dict] = None,
     ):
         """Ship this host's telemetry snapshot to the master's
         FleetAggregator (ResourceMonitor cadence). Best-effort like
@@ -682,6 +683,7 @@ class MasterClient:
                     resource=resource or {},
                     step_times=list(step_times or []),
                     events=list(events or []),
+                    beacon=dict(beacon or {}),
                 ),
                 wait_for_ready=False,
             )
@@ -930,6 +932,17 @@ class MasterClient:
         — obs_report --capacity's feed."""
         return self._get(
             msg.CapacityQueryRequest(), max_wait=max_wait
+        )
+
+    def query_stall(
+        self, max_wait: Optional[float] = None
+    ) -> msg.StallQueryResponse:
+        """The master's stall-localization snapshot (per-host beacon
+        progress table, open/recent collective_stall incidents with
+        culprit + trace id + capture bundles) — obs_report --stall's
+        feed."""
+        return self._get(
+            msg.StallQueryRequest(), max_wait=max_wait
         )
 
     def query_metrics(

@@ -23,6 +23,7 @@ from typing import Dict, Optional
 
 from dlrover_tpu import obs
 from dlrover_tpu.common.log import get_logger
+from dlrover_tpu.obs import beacon as beacon_mod
 
 logger = get_logger("agent_monitor")
 
@@ -32,6 +33,13 @@ RECENT_STEP_TIMES = 32
 
 METRICS_FILE_ENV = "DLROVER_TPU_METRICS_FILE"
 PHASES_FILE_ENV = "DLROVER_TPU_PHASES_FILE"
+
+# Local staleness threshold before the agent treats the co-hosted
+# trainer's beacon as wedged and fires its forensics hook. Sits above
+# any sane step time but well under the master's heartbeat timeout,
+# so the host-local SIGUSR1 capture lands while the wedge is live.
+BEACON_STALL_ENV = "DLROVER_TPU_BEACON_STALL_S"
+DEFAULT_BEACON_STALL_S = 120.0
 
 
 def default_metrics_file() -> str:
@@ -86,12 +94,29 @@ class ResourceMonitor:
         client,
         interval: float = 30.0,
         metrics_file: Optional[str] = None,
+        beacon_path: Optional[str] = None,
+        on_stale_beacon=None,
     ):
         self.client = client
         self.interval = interval
         self.metrics_file = metrics_file or os.getenv(
             METRICS_FILE_ENV, default_metrics_file()
         )
+        # Stall beacon: each snapshot ships the trainer's last
+        # progress stamp + locally-computed staleness; a stamp older
+        # than the stall threshold fires on_stale_beacon(stamp) once
+        # per distinct wedge (the agent wires its SIGUSR1 forensics
+        # capture here).
+        self.beacon_path = beacon_path or beacon_mod.beacon_file()
+        self.on_stale_beacon = on_stale_beacon
+        try:
+            self.beacon_stall_s = float(
+                os.getenv(BEACON_STALL_ENV, "")
+                or DEFAULT_BEACON_STALL_S
+            )
+        except ValueError:
+            self.beacon_stall_s = DEFAULT_BEACON_STALL_S
+        self._stall_fired_key: Optional[tuple] = None
         self.host = (
             os.getenv("DLROVER_TPU_HOST_IP", "")
             or socket.gethostname()
@@ -258,7 +283,40 @@ class ResourceMonitor:
             "resource": resource,
             "step_times": self._new_step_times(data),
             "events": self._new_events(),
+            "beacon": self.beacon_payload(),
         }
+
+    def beacon_payload(self) -> dict:
+        """The trainer's last progress stamp plus its staleness age
+        on this host's monotonic clock (the writer may be wedged —
+        only the file is consulted). Empty when no beacon exists."""
+        stamp = beacon_mod.read_beacon(self.beacon_path)
+        if not stamp:
+            return {}
+        age = beacon_mod.stamp_age(stamp)
+        out = dict(stamp)
+        out["age_s"] = round(age, 3) if age is not None else -1.0
+        return out
+
+    def check_beacon_stall(self, stamp: dict) -> bool:
+        """Fire the forensics hook when the local beacon is wedged;
+        re-arms as soon as the stamp advances. Returns True when the
+        hook fired this call."""
+        if self.on_stale_beacon is None or not stamp:
+            return False
+        age = stamp.get("age_s")
+        if not isinstance(age, (int, float)) or age < self.beacon_stall_s:
+            self._stall_fired_key = None
+            return False
+        key = (stamp.get("pid"), stamp.get("seq"))
+        if key == self._stall_fired_key:
+            return False
+        self._stall_fired_key = key
+        try:
+            self.on_stale_beacon(dict(stamp))
+        except Exception:  # noqa: BLE001 — capture is best-effort
+            logger.warning("stale-beacon hook failed", exc_info=True)
+        return True
 
     def report_once(self) -> dict:
         stats = current_resource_stats()
@@ -266,11 +324,13 @@ class ResourceMonitor:
             self.client.report_resource(**stats)
         except Exception:  # noqa: BLE001
             logger.debug("resource report failed", exc_info=True)
+        snap = self.build_snapshot(stats)
         try:
-            self.client.report_metrics_snapshot(**self.build_snapshot(stats))
+            self.client.report_metrics_snapshot(**snap)
         except Exception:  # noqa: BLE001 — fleet telemetry is
             # best-effort (and test fakes may lack the method)
             logger.debug("metrics snapshot failed", exc_info=True)
+        self.check_beacon_stall(snap.get("beacon") or {})
         return stats
 
     def _loop(self) -> None:

@@ -335,6 +335,10 @@ class MetricsSnapshotReport:
     ``events`` are tracer events new since the previous snapshot (how
     trainer-side spans reach the master's goodput accountant). The
     master's FleetAggregator merges these into host-labeled series.
+    ``beacon`` is the trainer's last progress stamp (obs/beacon.py
+    record plus the agent-computed ``age_s`` staleness) — the
+    StallCorrelator's per-host progress vector; empty when the host
+    runs no beacon.
     """
 
     node_id: int = -1
@@ -346,6 +350,7 @@ class MetricsSnapshotReport:
     events: List[Dict[str, Any]] = dataclasses.field(
         default_factory=list
     )
+    beacon: Dict[str, Any] = dataclasses.field(default_factory=dict)
 
 
 @message
@@ -1039,6 +1044,25 @@ class CapacityQueryResponse:
     enabled: bool = False
     # CapacityLedger.snapshot() with an "slo" block
     # ({"budgets": HealthMonitor.slo_snapshot()}) attached.
+    snapshot: Dict[str, Any] = dataclasses.field(default_factory=dict)
+
+
+@message
+class StallQueryRequest:
+    """Fetch the master's stall-localization snapshot: the per-host
+    progress table (last beacon step/phase/age), any open or recent
+    ``collective_stall`` incident with its localized culprit, trace
+    id, and coordinated-capture bundle paths — the
+    ``obs_report --stall`` feed. Fieldless, like CapacityQueryRequest."""
+
+    pass
+
+
+@message
+class StallQueryResponse:
+    enabled: bool = False
+    # StallCorrelator.snapshot(): {"hosts": {host: {...progress...}},
+    # "incident": {...} | {}, "incidents": [...], "config": {...}}.
     snapshot: Dict[str, Any] = dataclasses.field(default_factory=dict)
 
 

@@ -224,6 +224,21 @@ class JobMaster:
             interval=health_interval,
         )
         self.servicer.health = self.health
+        # Stall-localization plane: correlates the fleet's shipped
+        # progress beacons on the health tick, localizes collective
+        # stalls to one host, mints stall.incident traces, and queues
+        # the coordinated all-host DIAGNOSE+PROFILE capture through
+        # the same per-node action FIFO.
+        from dlrover_tpu.obs.stall import StallCorrelator
+
+        self.stall = StallCorrelator(
+            fleet=self.fleet,
+            traces=self.traces,
+            capture=self.servicer.push_action,
+            diagnostics=self.servicer.recent_diagnostics,
+        )
+        self.health.attach_stall(self.stall)
+        self.servicer.stall = self.stall
         # Remediation engine: acts on the health plane's critical
         # verdicts through the master's own seams (cordon-then-replace
         # via ScalePlan, restart_training via the heartbeat FIFO,

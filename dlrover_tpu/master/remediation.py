@@ -112,11 +112,15 @@ RUNG_SHRINK = 2
 RUNG_ALERT_ONLY = 3
 
 # Which critical detector convicts into which base action. Detectors
-# absent here (goodput_slo = job-wide, heartbeat_gap = a silent node
-# cannot be handed an action) stay alert-only by design.
+# absent here (goodput_slo = job-wide, fleet_stall = nobody to
+# convict, heartbeat_gap = a silent node cannot be handed an action)
+# stay alert-only by design.
 DETECTOR_ACTIONS: Dict[str, str] = {
     "throughput_degradation": ACTION_CORDON_REPLACE,
     "straggler_persistence": ACTION_CORDON_REPLACE,
+    # The stall correlator's localized culprit: replace the one wedged
+    # host, never blind-restart the fleet it parked.
+    "collective_stall": ACTION_CORDON_REPLACE,
     "recompile_storm": ACTION_RESTART_TRAINING,
     "rss_growth": ACTION_RESTART_TRAINING,
     "data_starvation": ACTION_RESTART_TRAINING,

@@ -123,6 +123,9 @@ class MasterServicer:
         # Trace store (set by the JobMaster); None on a bare servicer
         # — trace queries then answer "tracing disabled".
         self.traces = None
+        # Stall correlator (set by the JobMaster); None on a bare
+        # servicer — stall queries then answer "plane disabled".
+        self.stall = None
         # Per-node forensics history (DiagnosticsReport digests),
         # bounded so a crash-looping node cannot grow master memory.
         # Locked: report and query arrive on different RPC worker
@@ -159,6 +162,7 @@ class MasterServicer:
         g(msg.MetricsRequest, self._get_metrics)
         g(msg.DiagnosticsQueryRequest, self._query_diagnostics)
         g(msg.HealthQueryRequest, self._query_health)
+        g(msg.StallQueryRequest, self._query_stall)
         g(msg.RemediationQueryRequest, self._query_remediation)
         g(msg.TraceQueryRequest, self._query_traces)
         g(msg.ServeSubmitRequest, self._serve_submit)
@@ -601,6 +605,23 @@ class MasterServicer:
         return self.remediation.query_response(
             node_id=req.node_id, limit=req.limit
         )
+
+    def _query_stall(self, req: msg.StallQueryRequest):
+        """The stall-localization plane's typed read channel: the
+        correlator's per-host progress table and incident state —
+        ``obs_report --stall``'s feed."""
+        if self.stall is None:
+            return msg.StallQueryResponse(enabled=False)
+        return msg.StallQueryResponse(
+            enabled=True, snapshot=self.stall.snapshot()
+        )
+
+    def recent_diagnostics(self, node_id: int) -> list:
+        """One node's forensics history (DiagnosticsReport records,
+        newest last) — the stall correlator cross-links coordinated
+        capture bundles into its incident snapshot through this."""
+        with self._diagnostics_lock:
+            return list(self._diagnostics.get(node_id, ()))
 
     def _query_traces(self, req: msg.TraceQueryRequest):
         """The trace store's typed read channel: assembled causal

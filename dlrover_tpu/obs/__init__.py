@@ -49,6 +49,16 @@ doing through this package, so "what is the job doing right now" and
   a live MFU gauge from XLA cost analysis, and the on-demand PROFILE
   capture protocol (master action -> agent request file -> trainer
   digest -> diagnostics history).
+* :mod:`dlrover_tpu.obs.beacon` — the collective-stall progress
+  beacon: a fixed-size mmap'd progress stamp (step / microbatch /
+  phase / monotonic ts) the trainer rewrites at every phase boundary,
+  readable by other processes even when the trainer is wedged inside
+  a C-level collective.
+* :mod:`dlrover_tpu.obs.stall` — the master-side
+  :class:`StallCorrelator` over the fleet's shipped beacons: splits
+  fleet-wide stalls from single-host laggards, emits the localized
+  ``collective_stall`` verdict, mints ``stall.incident`` traces, and
+  queues the coordinated all-host DIAGNOSE+PROFILE capture.
 * :mod:`dlrover_tpu.obs.timeseries` — the bounded in-memory
   time-series store (labeled series, ring retention with coarse
   downsampling, windowed mean/percentile/rate/robust-slope queries)
@@ -112,6 +122,13 @@ from dlrover_tpu.obs.trace_store import (  # noqa: F401
     render_trace,
     span_tree,
 )
+from dlrover_tpu.obs.beacon import (  # noqa: F401
+    ProgressBeacon,
+    beacon_file,
+    progress_key,
+    read_beacon,
+    stamp_age,
+)
 from dlrover_tpu.obs.fleet import FleetAggregator  # noqa: F401
 from dlrover_tpu.obs.flight_recorder import (  # noqa: F401
     FlightRecorder,
@@ -152,4 +169,8 @@ from dlrover_tpu.obs.capacity import (  # noqa: E402,F401
     CapacityLedger,
     SliceInterval,
     render_capacity,
+)
+from dlrover_tpu.obs.stall import (  # noqa: E402,F401
+    StallCorrelator,
+    render_stall,
 )

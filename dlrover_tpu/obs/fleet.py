@@ -78,6 +78,9 @@ class HostSnapshot:
     registry: Dict[str, dict]
     resource: Dict[str, float]
     step_times: List[float]
+    # The trainer's last progress stamp (obs/beacon.py record plus the
+    # agent-computed ``age_s``); empty when the host runs no beacon.
+    beacon: Dict = dataclasses.field(default_factory=dict)
 
 
 class FleetAggregator:
@@ -143,6 +146,7 @@ class FleetAggregator:
                 float(t)
                 for t in (getattr(report, "step_times", None) or [])
             ],
+            beacon=dict(getattr(report, "beacon", None) or {}),
         )
         with self._lock:
             self._hosts[host] = snap
@@ -229,6 +233,22 @@ class FleetAggregator:
             store.record(
                 "host.compiles", compiles, ts=ts, host=snap.host
             )
+        if snap.beacon:
+            # Progress-vector history for the stall correlator: step
+            # is a counter-shaped series (monotone while healthy),
+            # age the agent-observed staleness at snapshot time.
+            step = snap.beacon.get("step")
+            if isinstance(step, (int, float)):
+                store.record(
+                    "host.beacon_step", float(step), ts=ts,
+                    host=snap.host,
+                )
+            age = snap.beacon.get("age_s")
+            if isinstance(age, (int, float)) and age >= 0:
+                store.record(
+                    "host.beacon_age_s", float(age), ts=ts,
+                    host=snap.host,
+                )
         # Fleet aggregates walk every live snapshot; recording them
         # on every per-host ingest is O(hosts^2) per collect interval
         # and floods the window with near-identical duplicates, so

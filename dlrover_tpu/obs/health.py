@@ -383,6 +383,10 @@ class HealthMonitor:
         # active set + cooldown stamps — a warm restart must not
         # re-fire a sticky verdict's action.
         self.on_state_change = None
+        # Stall correlator (obs/stall.py), attached via attach_stall:
+        # runs as a detector on this tick and feeds the
+        # heartbeat_gap DIAGNOSE upgrade its silent-suspect set.
+        self.stall = None
         self.detectors: List[Callable[[], List[HealthVerdict]]] = [
             self._detect_throughput_degradation,
             self._detect_goodput_slo,
@@ -395,6 +399,30 @@ class HealthMonitor:
             self._detect_slo_burn,
         ]
         _HEALTH_SCORE.set(1.0)
+
+    def attach_stall(self, correlator) -> None:
+        """Plug a stall correlator (obs/stall.py) into the tick: its
+        evaluate() joins the detector list — so collective_stall /
+        fleet_stall verdicts get the engine's full transition
+        lifecycle, action cooldowns, and persistence — and it gains
+        the silent-node probe (heartbeat ages already past the
+        critical fraction) that backs fleet-stall attribution."""
+        self.stall = correlator
+
+        def _silent_nodes():
+            crit = (
+                self._cfg("heartbeat_crit_frac")
+                * max(self.heartbeat_timeout, 1e-9)
+            )
+            return {
+                node_id: age
+                for node_id, age in self.heartbeat_ages().items()
+                if age >= crit
+            }
+
+        if getattr(correlator, "silent_probe", None) is None:
+            correlator.silent_probe = _silent_nodes
+        self.detectors.append(correlator.evaluate)
 
     # -- config -----------------------------------------------------------
 
@@ -769,12 +797,22 @@ class HealthMonitor:
 
     def _detect_heartbeat_gap(self) -> List[HealthVerdict]:
         """An alive node most of the way to its heartbeat timeout:
-        the early warning BEFORE the watchdog declares it dead. No
-        suggested action — a node that is not heartbeating cannot be
-        handed one."""
+        the early warning BEFORE the watchdog declares it dead.
+        Normally no suggested action — a node that is not
+        heartbeating cannot be handed one — EXCEPT when the stall
+        correlator attributes a live fleet-wide stall to this silent
+        node: then the critical verdict carries DIAGNOSE, parked in
+        the node's FIFO so the capture fires the moment the agent
+        reconnects (cooldown-shared with every other action on this
+        subject via the engine's stamps)."""
         warn_f = self._cfg("heartbeat_warn_frac")
         crit_f = self._cfg("heartbeat_crit_frac")
         timeout = max(self.heartbeat_timeout, 1e-9)
+        suspects = (
+            getattr(self.stall, "silent_suspects", None) or ()
+            if self.stall is not None
+            else ()
+        )
         out: List[HealthVerdict] = []
         for node_id, age in sorted(self.heartbeat_ages().items()):
             frac = age / timeout
@@ -783,17 +821,24 @@ class HealthMonitor:
             severity = (
                 SEVERITY_CRITICAL if frac >= crit_f else SEVERITY_WARN
             )
+            message = (
+                f"node {node_id} last heartbeat {age:.0f}s "
+                f"ago ({100.0 * frac:.0f}% of the "
+                f"{timeout:.0f}s timeout)"
+            )
+            suggested = ""
+            if severity == SEVERITY_CRITICAL and node_id in suspects:
+                suggested = EventAction.DIAGNOSE.value
+                message += (
+                    "; fleet stall attributed to this silent node"
+                )
             out.append(
                 HealthVerdict(
                     detector="heartbeat_gap",
                     severity=severity,
-                    message=(
-                        f"node {node_id} last heartbeat {age:.0f}s "
-                        f"ago ({100.0 * frac:.0f}% of the "
-                        f"{timeout:.0f}s timeout)"
-                    ),
+                    message=message,
                     node_id=node_id,
-                    suggested_action="",
+                    suggested_action=suggested,
                     evidence_series="heartbeat_age_s",
                     evidence=[(self.clock(), age)],
                     metrics={"age_s": age, "timeout_frac": frac},

@@ -41,6 +41,9 @@ FAILURE_EVENT_NAMES = (
     "node.gone",
     "node.heartbeat_timeout",
     "agent.hang_detected",
+    # The stall correlator's coordinated-capture moment: for a hang
+    # that never crashed, the incident IS the failure instant.
+    "stall.incident",
 )
 
 _STACKS_TAIL_CAP = 16384
@@ -268,6 +271,34 @@ def render_postmortem(dir_: str, window: float = 60.0) -> str:
             lines.append(
                 f"  {e['ts'] - t_fail:+8.3f}s {e['name']}{extra_s}"
             )
+    stall_marks = [
+        e
+        for e in windowed
+        if e.get("name") in ("stall.incident", "stall.resolved")
+    ]
+    if stall_marks:
+        lines.append("")
+        lines.append("stall incidents in window:")
+        for e in stall_marks:
+            if e["name"] == "stall.incident":
+                who = (
+                    f"culprit {e['culprit']}"
+                    if e.get("culprit")
+                    else "no localized culprit"
+                )
+                lines.append(
+                    f"  {e.get('incident', '?')} opened at "
+                    f"{float(e['ts']):.3f}: {e.get('kind', '?')}, "
+                    f"{who}, {e.get('hosts', '?')} host(s) parked "
+                    f"(trace id = incident id; obs_report --trace "
+                    f"{e.get('incident', '?')})"
+                )
+            else:
+                lines.append(
+                    f"  {e.get('incident', '?')} resolved at "
+                    f"{float(e['ts']):.3f} after "
+                    f"{float(e.get('open_s', 0.0)):.0f}s"
+                )
     if windowed:
         tl = reconstruct_recovery_timeline(windowed)
         if tl is not None:

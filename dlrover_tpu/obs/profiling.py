@@ -42,6 +42,7 @@ import time
 from typing import Callable, Dict, List, Optional
 
 from dlrover_tpu.common.log import get_logger
+from dlrover_tpu.obs.beacon import ProgressBeacon, default_beacon
 from dlrover_tpu.obs.metrics import counter, gauge
 from dlrover_tpu.obs.tracer import event as obs_event
 
@@ -336,6 +337,7 @@ class StepPhaseProfiler:
         request_file: Optional[str] = None,
         digest_file: Optional[str] = None,
         poll_requests: bool = True,
+        beacon: object = "auto",
     ):
         self.fn_name = fn_name
         self._clock = clock
@@ -344,6 +346,14 @@ class StepPhaseProfiler:
         self._request_file = request_file or profile_request_file()
         self._digest_file = digest_file or profile_digest_file()
         self._poll_requests = poll_requests
+        # Stall-localization beacon: the profiler stamps every phase
+        # boundary the loop already reports, so cross-host progress
+        # comparison costs the hot path one mmap memcpy per note.
+        # "auto" = job-scoped beacon unless DLROVER_TPU_BEACON=0;
+        # pass None/False to run beacon-less, or inject an instance.
+        if beacon == "auto":
+            beacon = default_beacon()
+        self.beacon: Optional[ProgressBeacon] = beacon or None
         self._step_start: Optional[float] = None
         self._noted: Dict[str, float] = dict.fromkeys(PHASES, 0.0)
         self.steps = 0
@@ -369,12 +379,16 @@ class StepPhaseProfiler:
             self._step_start = self._clock() - (host + h2d)
         self._noted["data_wait"] += host
         self._noted["h2d_stage"] += h2d
+        if self.beacon is not None:
+            self.beacon.stamp(step=self.steps + 1, phase="data_wait")
 
     def note_dispatch(self, seconds: float, compiled: bool = False) -> None:
         if self._step_start is None:
             self._step_start = self._clock() - max(seconds, 0.0)
         phase = "compile" if compiled else "dispatch"
         self._noted[phase] += max(seconds, 0.0)
+        if self.beacon is not None:
+            self.beacon.stamp(step=self.steps + 1, phase=phase)
 
     def end_step(self) -> Dict[str, float]:
         """Close the step: attribute its wall time and return the
@@ -400,6 +414,8 @@ class StepPhaseProfiler:
         self._noted = dict.fromkeys(PHASES, 0.0)
         self._step_start = now
         breakdown["wall_s"] = wall
+        if self.beacon is not None:
+            self.beacon.stamp(step=self.steps, phase="device_execute")
         mfu = None
         if self.mfu is not None:
             # Compile-tainted steps stay OUT of the MFU window (same

@@ -65,7 +65,7 @@ def _plane_of(name: str) -> str:
     head = name.split(".", 1)[0]
     return (
         head
-        if head in ("serve", "remediation", "rdzv", "pool")
+        if head in ("serve", "remediation", "rdzv", "pool", "stall")
         else "other"
     )
 

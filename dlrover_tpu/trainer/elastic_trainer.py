@@ -690,6 +690,11 @@ class ElasticTrainer:
         :meth:`shard_microbatches`'s ``(accum, -1)`` reshape exactly:
         microbatch k is rows ``[k*mb, (k+1)*mb)`` of the (per-process)
         host batch, so the two staging paths feed identical data."""
+        if self.profiler is not None and self.profiler.beacon is not None:
+            # Stall beacon: microbatch granularity localizes a wedge
+            # *within* a step (host h parked at microbatch k while
+            # peers reached k+1). Host-side mmap write, no sync.
+            self.profiler.beacon.stamp(microbatch=k)
         sharding = self._microbatch_sharding
         n_proc = jax.process_count()
         if n_proc <= 1:

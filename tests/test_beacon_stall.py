@@ -1,0 +1,652 @@
+"""Cross-host stall localization: beacons, correlator, fleet drill.
+
+Covers the acceptance criteria of the stall-localization PR:
+
+* the trainer-side progress beacon round-trips through its mmap'd
+  file, tolerates torn reads, and stays readable after the writer
+  closes (or wedges) — the agent reads the FILE, not the process;
+* ``StepPhaseProfiler`` stamps the beacon at boundaries trainers
+  already cross, and a profiler-less train step writes nothing (the
+  host-sync audits' regime);
+* the correlator's decision table, hermetically with a fake clock:
+  fleet-wide vs single-laggard split, no verdict on partial
+  staleness, a flapping beacon never convicts, departed hosts purge
+  their conviction state;
+* the wedged-fleet drill: three REAL subprocesses stamp beacons, one
+  wedges mid-step; a real in-process JobMaster convicts exactly that
+  host (zero false convictions), pushes the coordinated
+  DIAGNOSE+PROFILE capture to every host's FIFO in one window, mints
+  the ``stall.incident`` trace, serves it over ``query_stall``, and
+  ``obs_report --stall`` against the live socket exits 1 during the
+  incident and 0 after resolution;
+* the bench capture path: a timed-out measurement leaves a
+  kind-``hang`` ledger record carrying the last beacon stamp.
+"""
+
+import json
+import os
+import subprocess
+import sys
+import time
+import types
+
+import pytest
+
+from dlrover_tpu.common import messages as msg
+from dlrover_tpu.common.comm import RpcClient
+from dlrover_tpu.common.constants import EventAction
+from dlrover_tpu.master.master import JobMaster
+from dlrover_tpu.obs.beacon import (
+    ProgressBeacon,
+    progress_key,
+    read_beacon,
+    stamp_age,
+)
+from dlrover_tpu.obs.health import (
+    SEVERITY_CRITICAL,
+    HealthMonitor,
+)
+from dlrover_tpu.obs.stall import StallCorrelator, render_stall
+from dlrover_tpu.obs.timeseries import TimeSeriesStore
+from dlrover_tpu.obs.trace_store import TraceStore
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+class FakeClock:
+    def __init__(self, t=1000.0):
+        self.t = t
+
+    def __call__(self):
+        return self.t
+
+
+class TestProgressBeacon:
+    def test_roundtrip_and_cross_process_read(self, tmp_path):
+        path = str(tmp_path / "beacon.json")
+        b = ProgressBeacon(path=path)
+        b.stamp(step=3, phase="dispatch", microbatch=2)
+        # Another reader (the agent) opens the file fresh.
+        stamp = read_beacon(path)
+        assert stamp["step"] == 3
+        assert stamp["phase"] == "dispatch"
+        assert stamp["microbatch"] == 2
+        assert stamp["pid"] == os.getpid()
+        age = stamp_age(stamp)
+        assert age is not None and 0.0 <= age < 5.0
+        # Omitted fields keep their last value; seq advances.
+        seq = stamp["seq"]
+        b.stamp(phase="device_execute")
+        stamp2 = read_beacon(path)
+        assert stamp2["step"] == 3 and stamp2["seq"] > seq
+        # The file survives the writer closing — a wedged (or dead)
+        # trainer leaves its last position readable.
+        b.close()
+        assert read_beacon(path)["phase"] == "device_execute"
+
+    def test_torn_read_is_no_stamp(self, tmp_path):
+        path = str(tmp_path / "beacon.json")
+        with open(path, "w") as f:
+            f.write('{"step": 3, "pha')  # mid-write torn record
+        assert read_beacon(path) is None
+        assert read_beacon(str(tmp_path / "missing.json")) is None
+
+    def test_progress_key_total_order(self):
+        # Step outranks phase outranks microbatch; None sorts first.
+        k = progress_key
+        assert k({"step": 2, "phase": "init"}) > k(
+            {"step": 1, "phase": "device_execute", "microbatch": 9}
+        )
+        assert k({"step": 1, "phase": "dispatch"}) > k(
+            {"step": 1, "phase": "h2d_stage", "microbatch": 5}
+        )
+        assert k(
+            {"step": 1, "phase": "h2d_stage", "microbatch": 2}
+        ) > k({"step": 1, "phase": "h2d_stage", "microbatch": 1})
+        assert k(None) < k({"step": 0, "phase": "init"})
+
+    def test_profiler_stamps_boundaries(self, tmp_path):
+        from dlrover_tpu.obs.profiling import StepPhaseProfiler
+
+        path = str(tmp_path / "beacon.json")
+        prof = StepPhaseProfiler(
+            beacon=ProgressBeacon(path=path), poll_requests=False
+        )
+        prof.note_data_wait(0.01)
+        assert read_beacon(path)["phase"] == "data_wait"
+        prof.note_dispatch(0.01, compiled=False)
+        s = read_beacon(path)
+        assert (s["step"], s["phase"]) == (1, "dispatch")
+        prof.end_step()
+        s = read_beacon(path)
+        assert (s["step"], s["phase"]) == (1, "device_execute")
+
+    def test_disabled_beacon_writes_nothing(self, tmp_path, monkeypatch):
+        from dlrover_tpu.obs.beacon import default_beacon
+
+        path = str(tmp_path / "beacon.json")
+        monkeypatch.setenv("DLROVER_TPU_BEACON_FILE", path)
+        monkeypatch.setenv("DLROVER_TPU_BEACON", "0")
+        assert default_beacon() is None
+        assert not os.path.exists(path)
+
+
+class FakeFleet:
+    """``live_snapshots()`` provider shaped like FleetAggregator."""
+
+    def __init__(self, clock):
+        self.clock = clock
+        self.snaps = {}
+
+    def set(self, host, node_id, step, phase, mb, age_s):
+        self.snaps[host] = types.SimpleNamespace(
+            host=host, node_id=node_id, wall_ts=self.clock(),
+            beacon={"step": step, "phase": phase,
+                    "microbatch": mb, "age_s": age_s},
+        )
+
+    def drop(self, host):
+        self.snaps.pop(host, None)
+
+    def age(self, dt):
+        for snap in self.snaps.values():
+            snap.wall_ts = self.clock()
+            snap.beacon["age_s"] += dt
+
+    def live_snapshots(self):
+        return list(self.snaps.values())
+
+
+def make_correlator(clk, fleet, **kw):
+    config = {
+        "stall_after_s": 60.0,
+        "stall_ticks": 2.0,
+        "capture_cooldown_s": 0.0,
+    }
+    config.update(kw.pop("config", {}))
+    return StallCorrelator(
+        fleet=fleet, clock=clk, config=config, **kw
+    )
+
+
+class TestStallCorrelator:
+    def setup_method(self):
+        self.clk = FakeClock(5000.0)
+        self.fleet = FakeFleet(self.clk)
+        for host, nid in (("h0", 0), ("h1", 1), ("h2", 2)):
+            self.fleet.set(host, nid, 10, "dispatch", -1, 1.0)
+
+    def park(self, ticks, corr, dt=90.0):
+        out = []
+        for _ in range(ticks):
+            self.clk.t += dt
+            self.fleet.age(dt)
+            out = corr.evaluate()
+        return out
+
+    def test_laggard_convicted_with_coordinated_capture(self):
+        pushes = []
+        traces = TraceStore(clock=self.clk)
+        corr = make_correlator(
+            self.clk, self.fleet, traces=traces,
+            capture=lambda n, a, dedupe_key=None: (
+                pushes.append((n, a, dedupe_key)) or True
+            ),
+        )
+        assert corr.evaluate() == []
+        # h2 wedges a full phase behind its peers.
+        self.fleet.set("h2", 2, 10, "h2d_stage", 1, 1.0)
+        verdicts = self.park(2, corr)
+        assert [
+            (v.detector, v.host, v.node_id, v.severity)
+            for v in verdicts
+        ] == [("collective_stall", "h2", 2, SEVERITY_CRITICAL)]
+        assert verdicts[0].suggested_action == (
+            EventAction.DIAGNOSE.value
+        )
+        inc = corr.open_incident()
+        assert inc["kind"] == "laggard" and inc["culprit"] == "h2"
+        # One coordinated round: DIAGNOSE+PROFILE to every node.
+        assert sorted({n for n, _, _ in pushes}) == [0, 1, 2]
+        assert len(pushes) == 6
+        assert all(
+            k == f"stall:{inc['id']}:{a}:{n}" for n, a, k in pushes
+        )
+        # The incident trace: root + per-host progress + captures.
+        tl = traces.get(inc["trace_id"])
+        names = [s["name"] for s in tl["spans"]]
+        assert names.count("stall.incident") == 1
+        assert names.count("stall.progress") == 3
+        assert names.count("stall.capture") == 3
+        root_id = f"{inc['trace_id']}:root"
+        assert all(
+            s["parent_span_id"] == root_id
+            for s in tl["spans"]
+            if s["name"] != "stall.incident"
+        )
+        culprit_span = next(
+            s for s in tl["spans"]
+            if s["name"] == "stall.progress"
+            and s["tags"]["host"] == "h2"
+        )
+        assert culprit_span["tags"]["culprit"] is True
+        # Recovery resolves the incident into history.
+        self.clk.t += 30.0
+        for host, nid in (("h0", 0), ("h1", 1), ("h2", 2)):
+            self.fleet.set(host, nid, 12, "dispatch", -1, 1.0)
+        assert corr.evaluate() == []
+        assert corr.open_incident() is None
+        snap = corr.snapshot()
+        assert snap["incidents"][-1]["resolved_ts"] == self.clk.t
+        assert "stall.resolved" in [
+            s["name"] for s in traces.get(inc["trace_id"])["spans"]
+        ]
+
+    def test_fleet_wide_stall_not_localized(self):
+        corr = make_correlator(
+            self.clk, self.fleet,
+            silent_probe=lambda: {1: 150.0},
+        )
+        verdicts = self.park(2, corr)
+        assert [
+            (v.detector, v.node_id, v.suggested_action)
+            for v in verdicts
+        ] == [("fleet_stall", -1, "")]
+        assert "attributed to silent node 1" in verdicts[0].message
+        assert corr.silent_suspects == {1}
+        assert corr.open_incident()["kind"] == "fleet_wide"
+
+    def test_partial_staleness_never_convicts(self):
+        corr = make_correlator(self.clk, self.fleet)
+        # Only h2 goes stale; its peers keep stamping.
+        for step in (11, 12, 13):
+            self.clk.t += 90.0
+            self.fleet.set("h0", 0, step, "dispatch", -1, 1.0)
+            self.fleet.set("h1", 1, step, "dispatch", -1, 1.0)
+            self.fleet.snaps["h2"].beacon["age_s"] += 90.0
+            assert corr.evaluate() == []
+        assert corr.open_incident() is None
+
+    def test_flapping_beacon_never_convicts(self):
+        corr = make_correlator(self.clk, self.fleet)
+        # Every host reads stale (slow agent cadence) but the keys
+        # keep advancing: progress resets the streak each tick.
+        for step in range(11, 17):
+            self.clk.t += 120.0
+            for host, nid in (("h0", 0), ("h1", 1), ("h2", 2)):
+                self.fleet.set(host, nid, step, "dispatch", -1, 200.0)
+            assert corr.evaluate() == []
+        assert corr.open_incident() is None
+
+    def test_departed_host_purges_conviction_state(self):
+        corr = make_correlator(self.clk, self.fleet)
+        self.park(1, corr)  # every host at 1 stalled tick
+        assert corr._stalled_ticks["h2"] == 1
+        self.fleet.drop("h2")
+        self.clk.t += 90.0
+        self.fleet.age(90.0)
+        corr.evaluate()
+        assert "h2" not in corr._stalled_ticks
+        assert "h2" not in corr._progress
+
+    def test_two_tied_behind_is_fleet_wide(self):
+        corr = make_correlator(self.clk, self.fleet)
+        self.fleet.set("h1", 1, 10, "h2d_stage", 1, 1.0)
+        self.fleet.set("h2", 2, 10, "h2d_stage", 1, 1.0)
+        verdicts = self.park(2, corr)
+        assert verdicts[0].detector == "fleet_stall"
+
+    def test_render_and_snapshot_roundtrip(self):
+        corr = make_correlator(self.clk, self.fleet)
+        self.fleet.set("h2", 2, 9, "data_wait", -1, 1.0)
+        self.park(2, corr)
+        snap = json.loads(json.dumps(corr.snapshot()))
+        rendered = render_stall(snap)
+        assert "OPEN" in rendered
+        assert "<- culprit" in rendered
+        assert "STALLED" in rendered
+
+
+class TestMonitorIntegration:
+    def test_heartbeat_gap_upgraded_for_silent_suspect(self):
+        clk = FakeClock(5000.0)
+        store = TimeSeriesStore(clock=clk)
+        fleet = FakeFleet(clk)
+        for host, nid in (("h0", 0), ("h1", 1)):
+            fleet.set(host, nid, 10, "dispatch", -1, 200.0)
+        ages = {0: 10.0, 1: 160.0}  # node 1 heartbeat-silent
+        mon = HealthMonitor(
+            store,
+            heartbeat_timeout=180.0,
+            heartbeat_ages=lambda: dict(ages),
+            clock=clk,
+            config={"goodput_grace_s": 0.0},
+        )
+        corr = make_correlator(clk, fleet, config={"stall_ticks": 1.0})
+        mon.attach_stall(corr)
+        assert mon.stall is corr
+        assert corr.silent_probe is not None
+        # Tick 1: the correlator (last detector) records the suspect;
+        # tick 2: heartbeat_gap reads it and upgrades to DIAGNOSE.
+        clk.t += 90.0
+        fleet.age(90.0)
+        mon.evaluate_once()
+        assert corr.silent_suspects == {1}
+        clk.t += 90.0
+        fleet.age(90.0)
+        verdicts = mon.evaluate_once()
+        hb = next(
+            v for v in verdicts if v.detector == "heartbeat_gap"
+        )
+        assert hb.node_id == 1
+        assert hb.severity == SEVERITY_CRITICAL
+        assert hb.suggested_action == EventAction.DIAGNOSE.value
+        assert "attributed to this silent node" in hb.message
+        fs = next(v for v in verdicts if v.detector == "fleet_stall")
+        assert fs.suggested_action == ""
+
+    def test_collective_stall_maps_to_cordon_replace(self):
+        from dlrover_tpu.master.remediation import (
+            ACTION_CORDON_REPLACE,
+            DETECTOR_ACTIONS,
+        )
+
+        assert DETECTOR_ACTIONS["collective_stall"] == (
+            ACTION_CORDON_REPLACE
+        )
+        # Fleet-wide stalls are deliberately alert-only: remediation
+        # must never act on a verdict that convicts nobody.
+        assert "fleet_stall" not in DETECTOR_ACTIONS
+
+    def test_resource_monitor_ships_beacon_and_latches(self, tmp_path):
+        from dlrover_tpu.agent.monitor import ResourceMonitor
+
+        path = str(tmp_path / "beacon.json")
+        b = ProgressBeacon(path=path)
+        b.stamp(step=4, phase="dispatch")
+        fired = []
+        mon = ResourceMonitor(
+            client=None,
+            beacon_path=path,
+            on_stale_beacon=fired.append,
+        )
+        payload = mon.beacon_payload()
+        assert payload["step"] == 4 and payload["age_s"] >= 0.0
+        # Below the threshold: latch armed, nothing fires.
+        assert mon.check_beacon_stall(dict(payload)) is False
+        stale = dict(payload)
+        stale["age_s"] = mon.beacon_stall_s + 1.0
+        assert mon.check_beacon_stall(stale) is True
+        assert fired and fired[0]["step"] == 4
+        # Same (pid, seq): fires once, not on every cadence tick.
+        assert mon.check_beacon_stall(stale) is False
+        # Fresh progress re-arms the latch.
+        b.stamp(step=5, phase="dispatch")
+        fresh = mon.beacon_payload()
+        assert mon.check_beacon_stall(fresh) is False
+        stale2 = dict(fresh)
+        stale2["age_s"] = mon.beacon_stall_s + 1.0
+        assert mon.check_beacon_stall(stale2) is True
+        assert len(fired) == 2
+
+
+WEDGE_SRC = """
+import sys, time
+from dlrover_tpu.obs.beacon import ProgressBeacon
+path, step, phase = sys.argv[1], int(sys.argv[2]), sys.argv[3]
+b = ProgressBeacon(path=path)
+for s in range(1, step + 1):
+    b.stamp(step=s, phase="dispatch")
+b.stamp(step=step, phase=phase)
+print("STAMPED", flush=True)
+time.sleep(120)  # wedged in a "collective"
+"""
+
+
+class TestWedgedFleetDrill:
+    """Three real subprocesses stamp beacons; one wedges a phase
+    behind. A real in-process JobMaster localizes it, captures the
+    whole fleet, serves the incident, and obs_report --stall holds
+    the rc contract against the live socket."""
+
+    @pytest.fixture()
+    def master(self, monkeypatch):
+        monkeypatch.setenv("DLROVER_TPU_STALL_STALL_AFTER_S", "0.4")
+        monkeypatch.setenv("DLROVER_TPU_STALL_STALL_TICKS", "2")
+        monkeypatch.setenv("DLROVER_TPU_STALL_CAPTURE_COOLDOWN_S", "0")
+        m = JobMaster(
+            port=0, node_num=3, rdzv_timeout=1.0, metrics_port=0,
+            collect_interval=999.0, health_interval=9999.0,
+        )
+        m.prepare()
+        yield m
+        m.stop()
+
+    def spawn_fleet(self, tmp_path):
+        """(host -> beacon path, procs): h0/h1 park at step 5's
+        dispatch; h2 wedged at step 4's h2d_stage."""
+        paths, procs = {}, []
+        spec = {"h0": (5, "dispatch"), "h1": (5, "dispatch"),
+                "h2": (4, "h2d_stage")}
+        for host, (step, phase) in spec.items():
+            path = str(tmp_path / f"beacon_{host}.json")
+            paths[host] = path
+            procs.append(
+                subprocess.Popen(
+                    [sys.executable, "-c", WEDGE_SRC,
+                     path, str(step), phase],
+                    stdout=subprocess.PIPE, text=True, cwd=REPO,
+                )
+            )
+        for p in procs:
+            assert p.stdout.readline().strip() == "STAMPED"
+        return paths, procs
+
+    def report_round(self, master, paths):
+        client = RpcClient(master.addr)
+        for node_id, host in ((0, "h0"), (1, "h1"), (2, "h2")):
+            stamp = read_beacon(paths[host])
+            beacon = dict(stamp)
+            beacon["age_s"] = round(stamp_age(stamp), 3)
+            client.report(
+                msg.MetricsSnapshotReport(
+                    node_id=node_id, host=host,
+                    timestamp=time.time(), beacon=beacon,
+                )
+            )
+        return client
+
+    def test_drill(self, master, tmp_path):
+        paths, procs = self.spawn_fleet(tmp_path)
+        try:
+            client = RpcClient(master.addr)
+            for node_id, host in ((0, "h0"), (1, "h1"), (2, "h2")):
+                client.report(
+                    msg.NodeAddressRequest(
+                        node_id=node_id, node_ip=host
+                    )
+                )
+            # Round 1, beacons fresh: no verdicts, no convictions.
+            self.report_round(master, paths)
+            assert not [
+                v for v in master.health.evaluate_once()
+                if v.detector in ("collective_stall", "fleet_stall")
+            ]
+            # The children wedge (stop stamping); two stale rounds.
+            verdicts = []
+            for _ in range(2):
+                time.sleep(0.55)
+                self.report_round(master, paths)
+                verdicts = master.health.evaluate_once()
+            stall = [
+                v for v in verdicts
+                if v.detector == "collective_stall"
+            ]
+            assert len(stall) == 1, verdicts
+            # EXACTLY the wedged host — zero false convictions.
+            assert (stall[0].host, stall[0].node_id) == ("h2", 2)
+            assert not any(
+                v.host in ("h0", "h1")
+                for v in verdicts
+                if v.detector == "collective_stall"
+            )
+            inc = master.stall.open_incident()
+            assert inc["kind"] == "laggard"
+            assert inc["culprit"] == "h2"
+
+            # Coordinated capture: every node's FIFO drains both
+            # actions — a simultaneous fleet snapshot, not a
+            # culprit-only poke.
+            for node_id in (0, 1, 2):
+                drained = set()
+                while True:
+                    action = client.report(
+                        msg.HeartbeatRequest(node_id=node_id)
+                    ).action
+                    if action == "none":
+                        break
+                    drained.add(action)
+                assert {
+                    EventAction.DIAGNOSE.value,
+                    EventAction.PROFILE.value,
+                } <= drained, (node_id, drained)
+
+            # All bundles hang off ONE stall.incident trace.
+            tl = master.traces.get(inc["trace_id"])
+            names = [s["name"] for s in tl["spans"]]
+            assert names.count("stall.incident") == 1
+            assert names.count("stall.progress") == 3
+            assert names.count("stall.capture") == 3
+
+            # Diagnostics reports cross-link into the served snapshot.
+            client.report(
+                msg.DiagnosticsReport(
+                    node_id=2, kind="stall",
+                    bundle_path="/tmp/bundle_h2.json",
+                    timestamp=time.time(),
+                )
+            )
+            from dlrover_tpu.agent.master_client import MasterClient
+
+            mc = MasterClient(master.addr, node_id=0)
+            resp = mc.query_stall()
+            assert resp.enabled
+            snap = resp.snapshot
+            assert snap["incident"]["culprit"] == "h2"
+            assert snap["hosts"]["h2"]["stalled"] is True
+            assert snap["incident"]["bundles"]["h2"][0][
+                "bundle_path"
+            ] == "/tmp/bundle_h2.json"
+
+            # obs_report --stall, live socket: rc=1 while open.
+            proc = subprocess.run(
+                [
+                    sys.executable,
+                    os.path.join(REPO, "tools", "obs_report.py"),
+                    "--stall", master.addr,
+                ],
+                capture_output=True, text=True, timeout=120, cwd=REPO,
+            )
+            assert proc.returncode == 1, proc.stdout + proc.stderr
+            assert "h2" in proc.stdout
+            assert "culprit" in proc.stdout
+            assert inc["trace_id"] in proc.stdout
+            assert "bundle" in proc.stdout
+
+            # Recovery: the fleet advances, the incident resolves,
+            # and the live rc contract drops to 0.
+            for host in paths:
+                b = ProgressBeacon(path=paths[host])
+                b.stamp(step=9, phase="dispatch")
+                b.close()
+            self.report_round(master, paths)
+            verdicts = master.health.evaluate_once()
+            assert not any(
+                v.detector in ("collective_stall", "fleet_stall")
+                for v in verdicts
+            )
+            assert master.stall.open_incident() is None
+            proc = subprocess.run(
+                [
+                    sys.executable,
+                    os.path.join(REPO, "tools", "obs_report.py"),
+                    "--stall", master.addr,
+                ],
+                capture_output=True, text=True, timeout=120, cwd=REPO,
+            )
+            assert proc.returncode == 0, proc.stdout + proc.stderr
+            assert "resolved after" in proc.stdout
+        finally:
+            for p in procs:
+                p.kill()
+                p.wait()
+
+
+class TestBenchHangLedger:
+    """The capture path's blind-retry seam: a timed-out measurement
+    child leaves a kind-'hang' ledger record with its last stamp."""
+
+    def _wedged_child(self, tmp_path, monkeypatch):
+        path = str(tmp_path / "beacon.json")
+        ledger = str(tmp_path / "ledger.jsonl")
+        monkeypatch.setenv("DLROVER_TPU_BEACON_FILE", path)
+        monkeypatch.setenv("DLROVER_TPU_BENCH_LEDGER", ledger)
+        # A real child process stamps then wedges; the parent kills
+        # it on timeout and reads the file it left behind.
+        proc = subprocess.Popen(
+            [sys.executable, "-c", WEDGE_SRC, path, "7", "dispatch"],
+            stdout=subprocess.PIPE, text=True, cwd=REPO,
+        )
+        assert proc.stdout.readline().strip() == "STAMPED"
+        proc.kill()
+        proc.wait()
+        return ledger
+
+    def test_bench_emit_failure_records_hang(
+        self, tmp_path, monkeypatch, capsys
+    ):
+        import importlib.util
+
+        ledger = self._wedged_child(tmp_path, monkeypatch)
+        spec = importlib.util.spec_from_file_location(
+            "bench_for_test", os.path.join(REPO, "bench.py")
+        )
+        bench = importlib.util.module_from_spec(spec)
+        spec.loader.exec_module(bench)
+        bench._emit_failure("tpu_hang", "no response within 900s", 2)
+        out = capsys.readouterr().out.strip().splitlines()[-1]
+        rec = json.loads(out)
+        assert rec["kind"] == "hang"
+        assert rec["error"] == "tpu_hang"
+        assert rec["beacon"]["step"] == 7
+        assert rec["beacon"]["phase"] == "dispatch"
+        assert "step 7 dispatch" in rec["hang_digest"]
+        # The same record landed in the ledger, and it can never be
+        # picked as a comparison endpoint.
+        sys.path.insert(0, os.path.join(REPO, "tools"))
+        try:
+            import bench_ledger
+
+            recs = bench_ledger.load_records(ledger)
+            assert recs[-1]["kind"] == "hang"
+            assert recs[-1]["beacon"]["step"] == 7
+            assert bench_ledger.record_value(recs[-1]) is None
+        finally:
+            sys.path.remove(os.path.join(REPO, "tools"))
+
+    def test_capture_perf_hang_record(self, tmp_path, monkeypatch):
+        ledger = self._wedged_child(tmp_path, monkeypatch)
+        sys.path.insert(0, os.path.join(REPO, "tools"))
+        try:
+            import capture_perf
+
+            line = capture_perf.hang_record(600.0, "baseline")
+        finally:
+            sys.path.remove(os.path.join(REPO, "tools"))
+        assert "hang ledger record" in line
+        assert "step 7 dispatch" in line
+        with open(ledger) as f:
+            recs = [json.loads(x) for x in f if x.strip()]
+        assert recs[-1]["kind"] == "hang"
+        assert recs[-1]["stage"] == "baseline"
+        assert recs[-1]["beacon"]["step"] == 7

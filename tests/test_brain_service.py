@@ -241,9 +241,16 @@ class TestCli:
             if port is None and proc.poll() is None:
                 # Still alive but silent past the deadline: collect
                 # its stderr for the failure message instead of
-                # asserting blind.
+                # asserting blind. A child wedged enough to also
+                # ignore SIGTERM gets SIGKILL — the failure we want
+                # reported is the assert below, not TimeoutExpired
+                # from this cleanup.
                 proc.terminate()
-                proc.wait(10)
+                try:
+                    proc.wait(10)
+                except subprocess.TimeoutExpired:
+                    proc.kill()
+                    proc.wait()
             assert port, (
                 "brain CLI never printed its port; stderr:\n"
                 + (proc.stderr.read() or "")
@@ -256,4 +263,8 @@ class TestCli:
         finally:
             if proc.poll() is None:
                 proc.terminate()
-            proc.wait(10)
+            try:
+                proc.wait(10)
+            except subprocess.TimeoutExpired:
+                proc.kill()
+                proc.wait()

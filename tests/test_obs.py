@@ -610,6 +610,31 @@ class TestMetricNameHygiene:
                 problems[name] = (got, want)
         assert not problems, problems
 
+    def test_stall_plane_metrics_are_audited(self):
+        """The stall-localization plane's registrations
+        (obs/stall.py) must be visible to the walker with the
+        contract names/types/labels — the OBSERVABILITY.md alert
+        rows ("open_incident > 0 for 5m", capture-rate) and the
+        drill assertions key on them. Labels stay bounded: kind is
+        {laggard, fleet_wide}, action is {diagnose, profile} —
+        never host or incident id."""
+        sites = {
+            name: (mtype, labels)
+            for _, _, mtype, name, _, labels in self._call_sites()
+        }
+        expected = {
+            "dlrover_stall_incidents_total": ("counter", ["kind"]),
+            "dlrover_stall_open_incident": ("gauge", None),
+            "dlrover_stall_beacon_hosts": ("gauge", None),
+            "dlrover_stall_captures_total": ("counter", ["action"]),
+        }
+        problems = {}
+        for name, want in expected.items():
+            got = sites.get(name)
+            if got != want:
+                problems[name] = (got, want)
+        assert not problems, problems
+
 
 class TestSpanNameHygiene:
     """Audit every literal ``obs.span(...)`` / ``obs.event(...)``
@@ -630,6 +655,7 @@ class TestSpanNameHygiene:
             "rdzv.",
         ),
         os.path.join("dlrover_tpu", "pool"): ("pool.",),
+        os.path.join("dlrover_tpu", "obs", "stall.py"): ("stall.",),
     }
 
     def _call_sites(self):
@@ -711,8 +737,49 @@ class TestSpanNameHygiene:
             "serve.submit", "serve.requeue", "serve.drain",
             "remediation.decision", "remediation.drain_replica",
             "rdzv.start", "rdzv.complete",
+            "stall.incident", "stall.resolved",
         ):
             assert required in names, (required, sorted(names))
+
+    def test_stall_trace_spans_keep_the_namespace(self):
+        """The correlator mints its incident timeline via
+        ``traces.add_span`` (the walker above only sees
+        ``obs.span``/``obs.event``), so audit those literal names
+        directly: every span in obs/stall.py must live under the
+        ``stall.`` namespace the trace store's plane attribution
+        routes on."""
+        import ast
+        import re
+
+        fpath = os.path.join(REPO, "dlrover_tpu", "obs", "stall.py")
+        with open(fpath, encoding="utf-8") as f:
+            tree = ast.parse(f.read(), filename=fpath)
+        names = []
+        for node in ast.walk(tree):
+            if not (
+                isinstance(node, ast.Call)
+                and isinstance(node.func, ast.Attribute)
+                and node.func.attr == "add_span"
+            ):
+                continue
+            # add_span(trace_id, name, ...) — name is the second
+            # positional argument.
+            if len(node.args) > 1 and isinstance(
+                node.args[1], ast.Constant
+            ):
+                names.append((node.lineno, node.args[1].value))
+        # Root + progress + capture + resolved at minimum; an empty
+        # audit means the walker broke, not that the code is clean.
+        assert len(names) >= 4, names
+        for line, name in names:
+            assert re.match(self.SPAN_NAME_RE, name), (line, name)
+            assert name.startswith("stall."), (line, name)
+        # And the trace store must actually route that namespace to
+        # a plane — otherwise stall.incident timelines render as
+        # "unknown" in obs_report --trace.
+        from dlrover_tpu.obs.trace_store import _plane_of
+
+        assert _plane_of("stall.incident") == "stall"
 
 
 class TestMasterExposition:

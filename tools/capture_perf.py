@@ -97,6 +97,72 @@ def append_perf(rec: dict) -> None:
         )
 
 
+def final_beacon_stamp() -> dict:
+    """The dead bench child's last progress stamp, read from the
+    capture-scoped beacon file (main() pins DLROVER_TPU_BEACON_FILE so
+    parent and children agree on the path). Empty when the child died
+    before its first stamp."""
+    try:
+        import _repo_path  # noqa: F401
+        from dlrover_tpu.obs import beacon as _beacon
+
+        raw = _beacon.read_beacon()
+        if not raw:
+            return {}
+        stamp = {
+            k: raw.get(k)
+            for k in ("pid", "step", "microbatch", "phase", "seq")
+        }
+        age = _beacon.stamp_age(raw)
+        if age is not None:
+            stamp["age_s"] = round(age, 1)
+        return stamp
+    except Exception as exc:  # noqa: BLE001 — forensics never kill
+        # the capture chain
+        log(f"beacon read failed: {exc!r}")
+        return {}
+
+
+def hang_record(timeout_s: float, stage: str) -> str:
+    """A bench.py that WE had to kill never got to write its own
+    failure record — append the kind-"hang" ledger record here, with
+    the final beacon stamp, and return the one-line digest for the
+    log. The blind seam this closes (ROADMAP item 1): a timed-out
+    stage used to leave nothing but rc=124."""
+    stamp = final_beacon_stamp()
+    where = (
+        f"last beacon stamp: step {stamp.get('step')} "
+        f"{stamp.get('phase')} (age {stamp.get('age_s', '?')}s)"
+        if stamp
+        else "no beacon stamp (child died before its first stamp)"
+    )
+    rec = {
+        "metric": "nanogpt_tokens_per_sec_per_chip",
+        "value": 0.0,
+        "unit": "tokens/s/chip",
+        "vs_baseline": 0.0,
+        "error": "tpu_hang",
+        "kind": "hang",
+        "detail": f"capture_perf killed bench.py at {timeout_s:.0f}s",
+        "stage": stage or "adhoc",
+    }
+    if stamp:
+        rec["beacon"] = stamp
+    if os.getenv("BENCH_NO_LEDGER", "0") == "1":
+        return f"{where}; ledger disabled (BENCH_NO_LEDGER=1)"
+    try:
+        import bench_ledger
+
+        stored = bench_ledger.append_record(rec)
+        ref = (
+            f"{stored.get('ts')}@"
+            f"{str(stored.get('git_rev', ''))[:12]}"
+        )
+        return f"hang ledger record {ref}; {where}"
+    except Exception as exc:  # noqa: BLE001
+        return f"hang ledger append failed: {exc!r}; {where}"
+
+
 def run_bench(extra_env: dict, timeout_s: float) -> dict | None:
     """One bench.py run; returns the parsed JSON record or None.
 
@@ -119,6 +185,11 @@ def run_bench(extra_env: dict, timeout_s: float) -> dict | None:
         log(
             f"bench.py timed out after {timeout_s:.0f}s"
             + (f"; tail: {tail}" if tail else " (no output captured)")
+        )
+        log(
+            hang_record(
+                timeout_s, extra_env.get("BENCH_LEDGER_STAGE", "")
+            )
         )
         return None
     for line in p.stdout.splitlines():
@@ -362,6 +433,17 @@ def parse_autotune(out: str) -> tuple | None:
 
 
 def main() -> int:
+    # Capture-scoped beacon file, inherited by every bench child (its
+    # own setdefault defers to ours): when a child has to be killed,
+    # final_beacon_stamp() knows where its last position landed.
+    os.environ.setdefault(
+        "DLROVER_TPU_BEACON_FILE",
+        os.path.join(
+            os.getenv("TMPDIR", "/tmp"),
+            f"dlrover_tpu_beacon_capture_{os.getpid()}.json",
+        ),
+    )
+
     # CAPTURE_STAGE gates which stages run so the unattended chain can
     # land the cheap baseline record first and defer the long autotune:
     #   baseline — stage 1 only;  tune — stages 2-3 only;  all (default).

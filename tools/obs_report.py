@@ -549,6 +549,53 @@ def capacity_report(target: str) -> int:
     return 1 if burning else 0
 
 
+def stall_report(target: str) -> int:
+    """Render the stall-localization plane (per-host progress-beacon
+    table, open/recent ``collective_stall`` incidents with culprit,
+    trace id, and coordinated-capture bundle paths) from a live
+    master (host:port, ``StallQueryRequest`` RPC) or a JSON snapshot
+    file (``StallCorrelator.snapshot()`` shaped). Exits 1 while an
+    incident is open — a paging surface, like --capacity's burning
+    budgets."""
+    import json
+    import os
+
+    from dlrover_tpu.obs.stall import render_stall
+
+    if os.path.isfile(target):
+        with open(target) as f:
+            payload = json.load(f)
+    elif (
+        target.endswith(".json")
+        or os.sep in target
+        or ":" not in target
+    ):
+        print(
+            f"stall snapshot not found: {target}", file=sys.stderr
+        )
+        return 2
+    else:
+        from dlrover_tpu.agent.master_client import MasterClient
+
+        client = MasterClient(target, node_id=-1)
+        try:
+            resp = client.query_stall(max_wait=15.0)
+        except Exception as exc:  # noqa: BLE001
+            print(
+                f"stall query to {target} failed: {exc}",
+                file=sys.stderr,
+            )
+            return 2
+        finally:
+            client.close()
+        if not resp.enabled:
+            print("stall plane disabled on this master")
+            return 0
+        payload = resp.snapshot
+    print(render_stall(payload))
+    return 1 if payload.get("incident") else 0
+
+
 def trace_report(key: str, target: str) -> int:
     """Render causal trace timelines for ``key`` — a trace id, a
     serving request id, or a node subject (``node:<id>`` or a bare
@@ -1243,6 +1290,7 @@ def selftest() -> int:
     errors.extend(_selftest_trace())
     errors.extend(_selftest_pool())
     errors.extend(_selftest_capacity())
+    errors.extend(_selftest_stall())
     if errors:
         print("obs selftest FAILED:")
         for e in errors:
@@ -1588,6 +1636,9 @@ def _selftest_postmortem() -> list:
                  "pid": 222},
                 {"name": "trainer.step", "ts": t + 1.0, "step": 41,
                  "pid": 222},
+                {"name": "stall.incident", "ts": t + 60.0,
+                 "pid": 111, "incident": "stall-2060-1",
+                 "kind": "laggard", "culprit": "host-c", "hosts": 3},
                 {"name": "agent.hang_detected", "ts": t + 62.0,
                  "pid": 111},
             ],
@@ -1612,8 +1663,8 @@ def _selftest_postmortem() -> list:
         if len(bundles) != 1:
             errors.append(f"expected 1 bundle, loaded {len(bundles)}")
         events = collect_events(dir_, bundles)
-        if len(events) != 3:
-            errors.append(f"expected 3 events, got {len(events)}")
+        if len(events) != 4:
+            errors.append(f"expected 4 events, got {len(events)}")
         t_fail, source = failure_instant(events, bundles)
         if t_fail != t + 62.0 or source != "agent.hang_detected":
             errors.append(
@@ -1635,6 +1686,9 @@ def _selftest_postmortem() -> list:
             "stuck_collective",
             "goodput",  # attribution over the window
             "agent.hang_detected",
+            "stall incidents in window:",
+            "stall-2060-1 opened at 2060.000: laggard, "
+            "culprit host-c, 3 host(s) parked",
         ):
             if needle not in report:
                 errors.append(f"postmortem missing {needle!r}")
@@ -1689,6 +1743,148 @@ def _selftest_perf() -> list:
     return errors
 
 
+def _selftest_stall() -> list:
+    """The --stall path end to end: a real StallCorrelator over a
+    fake three-host fleet with an injected clock. One host stops
+    stamping -> after the tick streak exactly that host is convicted
+    (collective_stall), the coordinated capture reaches all three
+    nodes, the incident trace carries per-host progress spans, the
+    snapshot round-trips through JSON into stall_report's rc=1 /
+    rc=0 contract, and a flapping beacon never convicts."""
+    import json
+    import os
+    import tempfile
+    import types
+
+    from dlrover_tpu.obs.stall import StallCorrelator, render_stall
+
+    errors = []
+    t = [5000.0]
+
+    class FakeFleet:
+        def __init__(self):
+            self.snaps = {}
+
+        def set(self, host, node_id, step, phase, mb, age_s):
+            self.snaps[host] = types.SimpleNamespace(
+                host=host, node_id=node_id, wall_ts=t[0],
+                beacon={"step": step, "phase": phase,
+                        "microbatch": mb, "age_s": age_s},
+            )
+
+        def live_snapshots(self):
+            return list(self.snaps.values())
+
+    fleet = FakeFleet()
+    pushes = []
+
+    def capture(node_id, action, dedupe_key=None):
+        pushes.append((node_id, action, dedupe_key))
+        return True
+
+    from dlrover_tpu.obs.trace_store import TraceStore
+
+    traces = TraceStore(clock=lambda: t[0])
+    corr = StallCorrelator(
+        fleet=fleet, traces=traces, capture=capture,
+        clock=lambda: t[0],
+        config={"stall_after_s": 60.0, "stall_ticks": 2.0,
+                "capture_cooldown_s": 0.0},
+    )
+    # Healthy fleet: everyone stamping, no verdicts.
+    for h, n in (("host-a", 0), ("host-b", 1), ("host-c", 2)):
+        fleet.set(h, n, step=10, phase="dispatch", mb=3, age_s=1.0)
+    if corr.evaluate():
+        errors.append("stall selftest: verdict on a healthy fleet")
+    # host-c wedges a step behind; peers park at step 11's dispatch.
+    t[0] += 90.0
+    fleet.set("host-a", 0, 11, "dispatch", -1, 90.0)
+    fleet.set("host-b", 1, 11, "dispatch", -1, 90.0)
+    fleet.set("host-c", 2, 10, "h2d_stage", 1, 95.0)
+    if corr.evaluate():
+        errors.append("stall selftest: conviction on a single tick")
+    # Peers advanced a step before parking, so their streaks only
+    # start counting now — two stale ticks convict.
+    for _ in range(2):
+        t[0] += 30.0
+        for snap in fleet.snaps.values():
+            snap.wall_ts = t[0]
+            snap.beacon["age_s"] += 30.0
+        verdicts = corr.evaluate()
+    if (
+        len(verdicts) != 1
+        or verdicts[0].detector != "collective_stall"
+        or verdicts[0].host != "host-c"
+        or verdicts[0].node_id != 2
+    ):
+        errors.append(f"stall selftest: bad verdicts {verdicts}")
+    inc = corr.open_incident()
+    if not inc or inc["kind"] != "laggard":
+        errors.append(f"stall selftest: bad incident {inc}")
+    # Coordinated capture: DIAGNOSE+PROFILE to every node, once.
+    if sorted({n for n, _, _ in pushes}) != [0, 1, 2]:
+        errors.append(f"stall selftest: capture missed hosts {pushes}")
+    if len(pushes) != 6:
+        errors.append(f"stall selftest: capture count {len(pushes)}")
+    # The incident trace: one root, a progress span per host.
+    tl = traces.get(inc["trace_id"]) if inc else None
+    names = [s["name"] for s in (tl or {}).get("spans", ())]
+    if names.count("stall.incident") != 1:
+        errors.append(f"stall selftest: trace roots in {names}")
+    if names.count("stall.progress") != 3:
+        errors.append(f"stall selftest: progress spans in {names}")
+    # rc contract via the snapshot file path: 1 open, 0 resolved.
+    snap = corr.snapshot()
+    rendered = render_stall(snap)
+    for needle in ("incident", "OPEN", "host-c", "<- culprit",
+                   "STALLED"):
+        if needle not in rendered:
+            errors.append(
+                f"stall render missing {needle!r}: {rendered!r}"
+            )
+    with tempfile.NamedTemporaryFile(
+        "w", suffix=".json", delete=False
+    ) as f:
+        json.dump(snap, f)
+        path = f.name
+    try:
+        if stall_report(path) != 1:
+            errors.append("stall_report rc != 1 with open incident")
+        # host-c recovers: incident resolves, rc drops to 0.
+        t[0] += 30.0
+        fleet.set("host-a", 0, 12, "dispatch", -1, 1.0)
+        fleet.set("host-b", 1, 12, "dispatch", -1, 1.0)
+        fleet.set("host-c", 2, 12, "dispatch", -1, 1.0)
+        if corr.evaluate():
+            errors.append("stall selftest: verdict after recovery")
+        if corr.open_incident() is not None:
+            errors.append("stall selftest: incident not resolved")
+        snap = corr.snapshot()
+        if not snap["incidents"]:
+            errors.append("stall selftest: resolved incident lost")
+        with open(path, "w") as f:
+            json.dump(snap, f)
+        if stall_report(path) != 0:
+            errors.append("stall_report rc != 0 after resolution")
+    finally:
+        os.unlink(path)
+    # A flapping beacon (stale but advancing) must never convict.
+    flap = StallCorrelator(
+        fleet=fleet, clock=lambda: t[0],
+        config={"stall_after_s": 60.0, "stall_ticks": 2.0},
+    )
+    step = 12
+    for _ in range(5):
+        t[0] += 120.0
+        step += 1
+        for i, h in enumerate(("host-a", "host-b", "host-c")):
+            fleet.set(h, i, step, "dispatch", -1, 200.0)
+        if flap.evaluate():
+            errors.append("stall selftest: flapping beacon convicted")
+            break
+    return errors
+
+
 def main(argv=None) -> int:
     p = argparse.ArgumentParser("obs_report")
     p.add_argument("event_file", nargs="?", default="")
@@ -1740,6 +1936,16 @@ def main(argv=None) -> int:
         "file; exits 1 while any tenant's error budget is burning",
     )
     p.add_argument(
+        "--stall", type=str, default="",
+        metavar="TARGET",
+        help="render the stall-localization plane (per-host progress "
+        "beacons, open/recent collective_stall incidents with the "
+        "localized culprit, trace id, and coordinated-capture bundle "
+        "paths) from a live master (host:port) or a "
+        "StallCorrelator.snapshot() JSON file; exits 1 while an "
+        "incident is open",
+    )
+    p.add_argument(
         "--trace", type=str, default="",
         metavar="KEY",
         help="render the causal trace timeline(s) for KEY — a trace "
@@ -1776,6 +1982,8 @@ def main(argv=None) -> int:
         return pool_report(args.pool)
     if args.capacity:
         return capacity_report(args.capacity)
+    if args.stall:
+        return stall_report(args.stall)
     if args.trace:
         if not args.event_file:
             p.error(

@@ -54,6 +54,51 @@ case "${DEADLINE_EPOCH:-}" in
     ;;
 esac
 
+# Chain-scoped progress beacon: bench children stamp step/phase into
+# this file (their own setdefault defers to the export), so a
+# deadline-killed stage leaves a readable last-known position for
+# log_hang below instead of only rc=124.
+export DLROVER_TPU_BEACON_FILE="${DLROVER_TPU_BEACON_FILE:-/tmp/dlrover_tpu_beacon_chain_$$.json}"
+
+# log_hang STAGE_DESC: after a budget/deadline kill, read the dead
+# child's final beacon stamp and append a kind-"hang" record to the
+# bench ledger (tools/bench_ledger.py) so the timed-out stage is
+# localizable in the history — prints the record id + last stamp.
+log_hang() {
+  python - "$1" <<'PY' 2>/dev/null || echo "[$(date +%T)] hang forensics unavailable"
+import sys
+sys.path.insert(0, "tools")
+import _repo_path  # noqa: F401
+from dlrover_tpu.obs import beacon as b
+stamp = b.read_beacon() or {}
+age = b.stamp_age(stamp) if stamp else None
+where = (
+    "last beacon stamp: step {} {}".format(
+        stamp.get("step"), stamp.get("phase"))
+    + (" (age {:.0f}s)".format(age) if age is not None else "")
+    if stamp else "no beacon stamp (stage never stamped)"
+)
+rec = {
+    "metric": "nanogpt_tokens_per_sec_per_chip",
+    "value": 0.0, "unit": "tokens/s/chip", "vs_baseline": 0.0,
+    "error": "tpu_hang", "kind": "hang",
+    "detail": "tpu_jobs_when_up.sh killed stage: " + sys.argv[1][:200],
+    "stage": "chain",
+}
+if stamp:
+    rec["beacon"] = {
+        k: stamp.get(k)
+        for k in ("pid", "step", "microbatch", "phase", "seq")
+    }
+    if age is not None:
+        rec["beacon"]["age_s"] = round(age, 1)
+import bench_ledger
+stored = bench_ledger.append_record(rec)
+print("hang ledger record {}@{}; {}".format(
+    stored.get("ts"), str(stored.get("git_rev", ""))[:12], where))
+PY
+}
+
 # run_stage BUDGET_S CMD...: run one chain stage in its own session,
 # clamped to min(budget, time to the deadline). On expiry: SIGTERM to
 # the process GROUP, 30s grace, SIGKILL to the group. Returns the
@@ -85,6 +130,7 @@ run_stage() {
         kill -KILL -- "-$pid" 2>/dev/null
       fi
       wait "$pid" 2>/dev/null
+      echo "[$(date +%T)] $(log_hang "$*")"
       return 124
     fi
     sleep 2
