@@ -569,6 +569,7 @@ class MasterClient:
         num_minibatches_per_shard: int = 2,
         storage_type: str = "table",
         task_type: str = "training",
+        num_stream_partitions: int = 1,
     ):
         self._report(
             msg.DatasetShardParams(
@@ -580,8 +581,28 @@ class MasterClient:
                 dataset_name=dataset_name,
                 task_type=task_type,
                 storage_type=storage_type,
+                num_stream_partitions=num_stream_partitions,
             )
         )
+
+    @retry()
+    def stream_barrier(
+        self, dataset_name: str, epoch: int, step: int
+    ) -> msg.StreamBarrierResponse:
+        """Commit a stream barrier: coordinated PS flush stamped with
+        the shard ledger's HWM, then a durable journal record. The
+        caller must have quiesced its sparse applies first."""
+        return self._get(msg.StreamBarrierRequest(
+            dataset_name=dataset_name, epoch=epoch, step=step
+        ))
+
+    @retry()
+    def query_stream_barrier(
+        self, dataset_name: str
+    ) -> msg.StreamBarrierResponse:
+        return self._get(msg.StreamBarrierQueryRequest(
+            dataset_name=dataset_name
+        ))
 
     def get_task(self, dataset_name: str) -> msg.Task:
         return self._get(

@@ -42,6 +42,7 @@ class ShardingClient:
         shuffle: bool = False,
         num_minibatches_per_shard: int = 2,
         storage_type: str = "table",
+        num_stream_partitions: int = 1,
     ) -> None:
         self._client.create_dataset(
             dataset_name=self.dataset_name,
@@ -51,7 +52,18 @@ class ShardingClient:
             shuffle=shuffle,
             num_minibatches_per_shard=num_minibatches_per_shard,
             storage_type=storage_type,
+            num_stream_partitions=num_stream_partitions,
         )
+
+    def stream_barrier(self, epoch: int, step: int):
+        """Commit a stream barrier for this dataset (caller quiesces
+        its sparse applies first)."""
+        return self._client.stream_barrier(
+            self.dataset_name, epoch=epoch, step=step
+        )
+
+    def query_stream_barrier(self):
+        return self._client.query_stream_barrier(self.dataset_name)
 
     def get_task(
         self,

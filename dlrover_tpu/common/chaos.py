@@ -50,6 +50,12 @@ PARTITION_NODES_ENV = "DLROVER_TPU_CHAOS_PARTITION_NODES"
 # Server-side: "MessageTypeName:N" — _exit the process when the Nth
 # request of that type is dispatched (N counts from 1).
 KILL_AT_ENV = "DLROVER_TPU_CHAOS_KILL_AT"
+# Which RPC plane client-side faults apply to: "all" (default),
+# "master" (agent<->master control plane only) or "ps" (trainer<->PS
+# data plane only — Ps* request types). Out-of-scope calls still draw
+# from the RNG so the fault schedule of in-scope calls is unchanged
+# by scoping (same seed => same decisions at the same call indices).
+SCOPE_ENV = "DLROVER_TPU_CHAOS_SCOPE"
 
 # Exit code for a chaos-scheduled master kill: distinguishable from
 # OOM (137) and ordinary failures in drill logs.
@@ -87,6 +93,7 @@ class ChaosInjector:
         partition_nodes: Sequence[int] = (),
         kill_at: Optional[Tuple[str, int]] = None,
         node_id: Optional[int] = None,
+        scope: str = "all",
     ):
         self.seed = seed
         self.drop_rate = drop_rate
@@ -94,6 +101,11 @@ class ChaosInjector:
         self.latency_ms = latency_ms
         self.partition_nodes = frozenset(int(n) for n in partition_nodes)
         self.kill_at = kill_at
+        if scope not in ("all", "master", "ps"):
+            raise ValueError(
+                f"chaos scope must be all|master|ps, got {scope!r}"
+            )
+        self.scope = scope
         self._node_id = node_id
         self._lock = threading.Lock()
         self._rng = random.Random(seed)
@@ -124,6 +136,7 @@ class ChaosInjector:
             latency_ms=float(environ.get(LATENCY_MS_ENV, "0") or 0),
             partition_nodes=nodes,
             kill_at=kill_at,
+            scope=environ.get(SCOPE_ENV, "all") or "all",
         )
 
     def _local_node_id(self) -> Optional[int]:
@@ -165,9 +178,23 @@ class ChaosInjector:
 
     # -- client side ------------------------------------------------------
 
+    def _in_scope(self, request) -> bool:
+        """Does the configured scope cover this request's plane? The
+        PS data plane is identified by its message types (Ps*) — the
+        same RpcClient carries both planes, so the stub name alone
+        cannot distinguish them."""
+        if self.scope == "all":
+            return True
+        is_ps = type(request).__name__.startswith("Ps")
+        return is_ps if self.scope == "ps" else not is_ps
+
     def before_client_call(self, method: str, request) -> None:
         """Raise/delay per the schedule. Called by RpcClient._call."""
         decision, latency_s = self.decide(method)
+        if not self._in_scope(request):
+            # The draw already happened (schedule stability); the
+            # fault just doesn't apply to this plane.
+            return
         if decision == "partition":
             raise ChaosPartitionError(
                 f"chaos: node {self._local_node_id()} is partitioned "
@@ -227,13 +254,15 @@ def get_injector() -> Optional[ChaosInjector]:
                 _injector = ChaosInjector.from_env()
                 logger.warning(
                     "chaos injection ENABLED (seed=%d drop=%.3f "
-                    "error=%.3f latency=%.0fms partition=%s kill_at=%s)",
+                    "error=%.3f latency=%.0fms partition=%s kill_at=%s "
+                    "scope=%s)",
                     _injector.seed,
                     _injector.drop_rate,
                     _injector.error_rate,
                     _injector.latency_ms,
                     sorted(_injector.partition_nodes),
                     _injector.kill_at,
+                    _injector.scope,
                 )
             _init_done = True
     return _injector

@@ -250,6 +250,9 @@ class DatasetShardParams:
     dataset_name: str = ""
     task_type: str = ""
     storage_type: str = "table"
+    # Streaming datasets: number of stream partitions the splitter
+    # fabricates shards from (each carries its own offset/watermark).
+    num_stream_partitions: int = 1
 
 
 @message
@@ -258,6 +261,10 @@ class Shard:
     start: int = 0
     end: int = 0
     record_indices: List[int] = dataclasses.field(default_factory=list)
+    # Stream partition this shard was fabricated from (streaming
+    # datasets only; 0 for table/text shards). start/end index the
+    # partition's own record space.
+    partition: int = 0
 
 
 @message
@@ -666,6 +673,16 @@ class PsApplyRequest:
     lr: float = 1e-3
     hyperparams: Dict[str, float] = dataclasses.field(default_factory=dict)
     map_version: int = -1
+    # Replay fence (exactly-once streaming): barrier epoch the client
+    # is applying under, its stable client id, and a per-client
+    # monotonically increasing apply sequence. A post-restore PS
+    # rejects epochs older than its fence and dedups replayed
+    # (client_id, apply_seq) pairs per partition, so a trainer
+    # replaying its in-flight shard after a kill is idempotent.
+    # All three default to -1 = unfenced (legacy at-least-once path).
+    epoch: int = -1
+    client_id: int = -1
+    apply_seq: int = -1
 
 
 @message
@@ -689,6 +706,14 @@ class PsTableDump:
     # slot name -> (keys, values) for optimizer state
     slot_keys: Dict[str, Tensor] = dataclasses.field(default_factory=dict)
     slot_values: Dict[str, Tensor] = dataclasses.field(default_factory=dict)
+    # Replay-fence state for the dumped partitions: partition ->
+    # {client_id: last applied seq}, plus the source's fence epoch.
+    # Rides PS-to-PS moves so a rebalanced partition keeps its dedup
+    # history (without it a live move would reopen the replay window).
+    part_seqs: Dict[int, Dict[int, int]] = dataclasses.field(
+        default_factory=dict
+    )
+    fence_epoch: int = -1
 
 
 @message
@@ -730,14 +755,27 @@ class PsStatsResponse:
 
 @message
 class PsFlushRequest:
-    """Checkpoint: delta-flush owned partitions to storage."""
+    """Checkpoint: delta-flush owned partitions to storage.
+
+    A barrier flush (``epoch >= 0``) additionally persists the replay
+    fence (per-partition applied-seq high water marks) stamped with
+    the shard ledger's high-water marks, and advances the PS fence
+    epoch — the PS half of a barrier-consistent checkpoint cut.
+    """
 
     step: int = 0
+    epoch: int = -1
+    # Shard-ledger high-water marks at the cut: dataset -> watermark
+    # record offset (forensics stamp carried into the fence files).
+    hwm: Dict[str, int] = dataclasses.field(default_factory=dict)
 
 
 @message
 class PsFlushResponse:
     flushed_rows: int = 0
+    # Fence epoch in force on the PS after this flush (-1 = no
+    # barrier flush has ever run there).
+    epoch: int = -1
 
 
 @message
@@ -784,6 +822,47 @@ class PsSetPartitionsRequest:
 
     partitions: List[int] = dataclasses.field(default_factory=list)
     map_version: int = 0
+
+
+@message
+class StreamBarrierRequest:
+    """Trainer -> master: cut a barrier-consistent checkpoint of the
+    streaming sparse path (Chandy-Lamport style: the trainer has
+    quiesced its in-flight applies before sending this). The master
+    flushes every PS partition stamped with the shard ledger's
+    high-water marks, then durably journals (epoch, offsets,
+    watermarks, flush generation) as one atomic snapshot before
+    acking. ``epoch`` < 0 asks the master to assign the next epoch."""
+
+    dataset_name: str = ""
+    epoch: int = -1
+    step: int = 0
+
+
+@message
+class StreamBarrierResponse:
+    """The durable barrier record (also the answer to a
+    StreamBarrierQueryRequest; ``epoch`` < 0 = no barrier yet)."""
+
+    dataset_name: str = ""
+    epoch: int = -1
+    step: int = 0
+    # Per-stream-partition fabrication offsets at the cut.
+    offsets: Dict[int, int] = dataclasses.field(default_factory=dict)
+    # Per-stream-partition completed-record watermarks at the cut.
+    watermarks: Dict[int, int] = dataclasses.field(default_factory=dict)
+    # Master state-store generation the record became durable in.
+    flush_gen: int = 0
+    flushed_rows: int = 0
+    durable: bool = False
+
+
+@message
+class StreamBarrierQueryRequest:
+    """Trainer -> master: the last durable barrier for a dataset
+    (resume point after a trainer restart)."""
+
+    dataset_name: str = ""
 
 
 # ---------------------------------------------------------------------------

@@ -333,6 +333,13 @@ class JobMaster:
             # in-flight cordons/probations.
             self.health.on_state_change = mark
             self.remediation.on_state_change = mark
+            # The PS partition map survives a master bounce: the PS
+            # fleet keeps serving it, so the restored master must
+            # adopt rather than re-derive it (ps_manager snapshot).
+            self.ps_manager.on_state_change = mark
+            # Stream barriers flush the journal synchronously and
+            # report the generation back to the trainer.
+            self.servicer.state_journal = self.state_journal
         # Nodes can die without their agent ever reporting (pod
         # deleted, preemption, heartbeat timeout). The servicer's
         # failure-report path does this cleanup inline; DELETED events
@@ -411,6 +418,7 @@ class JobMaster:
             "speed_monitor": self.speed_monitor.to_snapshot(),
             "health": self.health.to_snapshot(),
             "remediation": self.remediation.to_snapshot(),
+            "ps_manager": self.ps_manager.to_snapshot(),
         }
 
     def _maybe_warm_restart(self) -> bool:
@@ -444,6 +452,9 @@ class JobMaster:
             self.remediation.restore_snapshot(
                 state.get("remediation", {})
             )
+            self.ps_manager.restore_snapshot(
+                state.get("ps_manager", {})
+            )
         except Exception:  # noqa: BLE001 — a corrupt-but-parseable
             # snapshot must degrade to a cold start, not a crash loop
             logger.exception(
@@ -463,6 +474,7 @@ class JobMaster:
             self.speed_monitor.restore_snapshot({})
             self.health.restore_snapshot({})
             self.remediation.restore_snapshot({})
+            self.ps_manager.restore_snapshot({})
             return False
         age_s = max(time.time() - float(doc.get("saved_at", 0.0)), 0.0)
         alive = len(self.job_manager.alive_nodes())
@@ -548,8 +560,17 @@ class JobMaster:
         # probing must not depend on --ps_autoscale. A dead PS is
         # failed over in ~10 s — well inside the sparse client's
         # stale-map retry budget — vs the 180 s node-heartbeat timeout.
-        # No-op while no PS is registered.
-        self.ps_manager.start_liveness_monitor()
+        # No-op while no PS is registered. Drills shrink detection
+        # latency via the env knobs (stream_soak runs whole kill
+        # cycles in seconds).
+        self.ps_manager.start_liveness_monitor(
+            interval=float(
+                os.getenv("DLROVER_TPU_PS_LIVENESS_INTERVAL", "2.0")
+            ),
+            ping_timeout=float(
+                os.getenv("DLROVER_TPU_PS_LIVENESS_TIMEOUT", "3.0")
+            ),
+        )
         if self.evaluator_count > 0:
             self.job_manager.ensure_role(
                 NodeType.EVALUATOR, self.evaluator_count

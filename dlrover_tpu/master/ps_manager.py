@@ -24,7 +24,7 @@ from __future__ import annotations
 
 import threading
 import time
-from typing import Dict, List, Optional
+from typing import Callable, Dict, List, Optional
 
 from dlrover_tpu.common import messages as msg
 from dlrover_tpu.common.comm import RpcClient
@@ -52,6 +52,18 @@ class PsManager:
         # Set by check_liveness after an automatic failover: ps_id,
         # t_detected, t_map_published, map_version (drill telemetry).
         self.last_failover: Optional[Dict] = None
+        # Fired after every membership/map mutation; the JobMaster
+        # points this at the state journal's mark_dirty so the map
+        # survives a master bounce (to_snapshot/restore_snapshot).
+        self.on_state_change: Optional[Callable[..., None]] = None
+
+    def _changed(self, urgent: bool = False) -> None:
+        cb = self.on_state_change
+        if cb is not None:
+            try:
+                cb(urgent=urgent)
+            except Exception:  # noqa: BLE001
+                pass
 
     # -- accessors -------------------------------------------------------
 
@@ -63,6 +75,46 @@ class PsManager:
                 assignment=list(self._map.assignment),
                 ps_addrs=dict(self._map.ps_addrs),
             )
+
+    # -- warm-restart snapshot -------------------------------------------
+
+    def to_snapshot(self) -> dict:
+        """The partition map is recoverable master state: PS nodes
+        outlive a master bounce, and a replacement master that forgot
+        the map would re-rebalance healthy nodes from scratch (and
+        break every fenced client mid-stream)."""
+        with self._lock:
+            return {
+                "version": self._map.version,
+                "assignment": list(self._map.assignment),
+                "ps_addrs": {
+                    str(ps): addr
+                    for ps, addr in self._map.ps_addrs.items()
+                },
+            }
+
+    def restore_snapshot(self, state: dict) -> None:
+        """Adopt a journaled map without republishing: the PS fleet
+        still holds these exact partitions at this exact version, so
+        the restored master just resumes serving the map."""
+        with self._lock:
+            if not state:
+                self._map = PartitionMap(
+                    version=0, assignment=[], ps_addrs={}
+                )
+                return
+            self._map = PartitionMap(
+                version=int(state.get("version", 0)),
+                assignment=[
+                    int(a) for a in state.get("assignment", [])
+                ],
+                ps_addrs={
+                    int(ps): addr
+                    for ps, addr in state.get("ps_addrs", {}).items()
+                },
+            )
+            self._clients = {}
+            self._ping_failures = {}
 
     def to_msg(self) -> msg.PartitionMapMsg:
         m = self.partition_map
@@ -113,6 +165,7 @@ class PsManager:
                         other, parts,
                         restore=parts if other == ps_id else None,
                     )
+        self._changed(urgent=True)
 
     def remove_ps(self, ps_id: int) -> None:
         """A PS died or is being scaled in. Survivors take over its
@@ -130,10 +183,12 @@ class PsManager:
                 logger.error("last PS node %d removed", ps_id)
                 self._map.assignment = []
                 self._map.version += 1
-                return
-            self._rebalance(
-                reason=f"remove ps {ps_id}", restore_parts=dead_parts
-            )
+            else:
+                self._rebalance(
+                    reason=f"remove ps {ps_id}",
+                    restore_parts=dead_parts,
+                )
+        self._changed(urgent=True)
 
     def drain_ps(self, ps_id: int) -> None:
         """Gracefully retire a still-alive PS (hot-PS migration, scale
@@ -157,6 +212,7 @@ class PsManager:
                 if c is not None:
                     c.close()
                 self._stats.pop(ps_id, None)
+                self._changed(urgent=True)
                 return
         # Last PS: nothing to move to — plain removal (checkpoint
         # restore is the only recovery once a new PS appears).
@@ -239,16 +295,21 @@ class PsManager:
 
     # -- checkpoint ------------------------------------------------------
 
-    def flush_all(self, step: int) -> int:
+    def flush_all(self, step: int, epoch: int = -1,
+                  hwm: Optional[Dict[str, int]] = None) -> int:
         """Direct every PS to delta-flush (called on the trainer's
-        checkpoint cadence). Returns total rows flushed."""
+        checkpoint cadence). Returns total rows flushed.
+
+        A stream barrier passes ``epoch`` and the shard ledger's
+        high-water mark ``hwm``; both land in every partition's fence
+        file, tying the PS cut to the ledger cut."""
         total = 0
         with self._lock:
             ps_ids = sorted(self._map.ps_addrs)
         for ps_id in ps_ids:
             try:
-                resp = self._client(ps_id).get(
-                    msg.PsFlushRequest(step=step))
+                resp = self._client(ps_id).get(msg.PsFlushRequest(
+                    step=step, epoch=epoch, hwm=dict(hwm or {})))
                 total += resp.flushed_rows
             except Exception:  # noqa: BLE001
                 logger.warning("PS %d flush failed", ps_id,

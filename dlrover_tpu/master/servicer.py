@@ -34,6 +34,24 @@ _FORENSICS_TOTAL = obs.counter(
     ("node", "kind"),
 )
 
+_STREAM_BARRIERS = obs.counter(
+    "dlrover_stream_barriers_total",
+    "Stream barriers committed (coordinated PS flush + durable "
+    "ledger record), by dataset",
+    ("dataset",),
+)
+_STREAM_BARRIER_SECONDS = obs.histogram(
+    "dlrover_stream_barrier_seconds",
+    "Wall time of one stream barrier: PS fleet flush + urgent "
+    "journal write",
+)
+_STREAM_WATERMARK = obs.gauge(
+    "dlrover_stream_watermark_records",
+    "Contiguously-applied record count at the last stream barrier, "
+    "by dataset",
+    ("dataset",),
+)
+
 # Bounded per-node queues: how many pushed-but-undelivered actions a
 # node may accumulate, and how many diagnostics digests the master
 # retains per node.
@@ -123,6 +141,10 @@ class MasterServicer:
         # Trace store (set by the JobMaster); None on a bare servicer
         # — trace queries then answer "tracing disabled".
         self.traces = None
+        # Warm-restart journal (set by the JobMaster when state_dir is
+        # configured); None on a bare servicer — stream barriers then
+        # still flush the PS fleet but answer durable=False.
+        self.state_journal = None
         # Stall correlator (set by the JobMaster); None on a bare
         # servicer — stall queries then answer "plane disabled".
         self.stall = None
@@ -190,6 +212,9 @@ class MasterServicer:
         g(msg.PartitionMapRequest, self._get_partition_map)
         r(msg.PsRegisterRequest, self._register_ps)
         r(msg.PsStatsReport, self._report_ps_stats)
+
+        g(msg.StreamBarrierRequest, self._stream_barrier)
+        g(msg.StreamBarrierQueryRequest, self._query_stream_barrier)
 
     def _noop(self, req):
         return None
@@ -282,6 +307,7 @@ class MasterServicer:
             shuffle=req.shuffle,
             storage_type=req.storage_type or "table",
             task_type=req.task_type or "training",
+            num_stream_partitions=max(req.num_stream_partitions, 1),
         )
         return None
 
@@ -294,9 +320,111 @@ class MasterServicer:
                 start=task.shard.start,
                 end=task.shard.end,
                 record_indices=task.shard.record_indices or [],
+                partition=task.shard.partition,
             )
         return msg.Task(
             task_id=task.task_id, task_type=task.task_type, shard=shard
+        )
+
+    # -- stream barriers ----------------------------------------------------
+
+    def _stream_barrier(self, req: msg.StreamBarrierRequest):
+        """One barrier = one atomic cut across all three planes: the
+        trainer has quiesced its applies before calling; here the
+        ledger frontier is read, the PS fleet delta-flushes stamped
+        with (epoch, HWM), and the barrier record lands in the warm-
+        restart journal with an urgent synchronous flush. Only after
+        the journal write returns is the barrier acknowledged durable
+        — a master or PS death at any point either replays to the
+        previous cut or to this one, never between."""
+        t0 = time.monotonic()
+        with obs.span(
+            "stream.barrier",
+            dataset=req.dataset_name,
+            epoch=req.epoch,
+            step=req.step,
+        ):
+            frontier = self.task_manager.ledger_watermarks(
+                req.dataset_name
+            )
+            hwm = {
+                str(p): int(w)
+                for p, w in frontier["watermarks"].items()
+            }
+            flushed = self.ps_manager.flush_all(
+                req.step, epoch=req.epoch, hwm=hwm
+            )
+            flush_gen = 0
+            durable = False
+            if self.state_journal is not None:
+                record = self.task_manager.record_barrier(
+                    req.dataset_name, req.epoch, req.step,
+                    flushed_rows=flushed,
+                )
+                path = self.state_journal.flush()
+                if path:
+                    durable = True
+                    # master_state-<seq>.json: seq is the generation.
+                    try:
+                        flush_gen = int(
+                            path.rsplit("-", 1)[1].split(".")[0]
+                        )
+                    except (IndexError, ValueError):
+                        flush_gen = 0
+                    record["flush_gen"] = flush_gen
+                    self.task_manager.record_barrier(
+                        req.dataset_name, req.epoch, req.step,
+                        flush_gen=flush_gen, flushed_rows=flushed,
+                    )
+            else:
+                self.task_manager.record_barrier(
+                    req.dataset_name, req.epoch, req.step,
+                    flushed_rows=flushed,
+                )
+        _STREAM_BARRIERS.inc(dataset=req.dataset_name)
+        _STREAM_BARRIER_SECONDS.observe(time.monotonic() - t0)
+        _STREAM_WATERMARK.set(
+            frontier["records"], dataset=req.dataset_name
+        )
+        return msg.StreamBarrierResponse(
+            dataset_name=req.dataset_name,
+            epoch=req.epoch,
+            step=req.step,
+            offsets={
+                int(p): int(o) for p, o in frontier["offsets"].items()
+            },
+            watermarks={
+                int(p): int(w)
+                for p, w in frontier["watermarks"].items()
+            },
+            flush_gen=flush_gen,
+            flushed_rows=flushed,
+            durable=durable,
+        )
+
+    def _query_stream_barrier(self, req: msg.StreamBarrierQueryRequest):
+        """Last durable barrier cut (what a restarted trainer resumes
+        from)."""
+        rec = self.task_manager.last_barrier(req.dataset_name)
+        if rec is None:
+            return msg.StreamBarrierResponse(
+                dataset_name=req.dataset_name
+            )
+        return msg.StreamBarrierResponse(
+            dataset_name=req.dataset_name,
+            epoch=int(rec.get("epoch", -1)),
+            step=int(rec.get("step", 0)),
+            offsets={
+                int(p): int(o)
+                for p, o in rec.get("offsets", {}).items()
+            },
+            watermarks={
+                int(p): int(w)
+                for p, w in rec.get("watermarks", {}).items()
+            },
+            flush_gen=int(rec.get("flush_gen", 0)),
+            flushed_rows=int(rec.get("flushed_rows", 0)),
+            durable=bool(rec.get("flush_gen", 0)),
         )
 
     def _report_task_result(self, req: msg.TaskResultRequest):

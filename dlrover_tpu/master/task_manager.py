@@ -69,6 +69,7 @@ def _task_to_dict(task: Task) -> dict:
         "start": task.shard.start if task.shard else 0,
         "end": task.shard.end if task.shard else 0,
         "indices": task.shard.record_indices if task.shard else None,
+        "partition": task.shard.partition if task.shard else 0,
     }
 
 
@@ -147,6 +148,14 @@ class DatasetManager:
         if not success:
             self.todo.insert(0, doing.task)
             return doing.task
+        shard = doing.task.shard
+        if shard is not None and hasattr(self.splitter, "mark_done"):
+            # Streaming ledgers advance the per-partition watermark —
+            # the completion frontier a stream barrier stamps into PS
+            # flushes.
+            self.splitter.mark_done(
+                shard.partition, shard.start, shard.end
+            )
         return None
 
     def recover_node_tasks(self, node_id: int) -> int:
@@ -214,6 +223,7 @@ class DatasetManager:
                 start=t["start"],
                 end=t["end"],
                 record_indices=t.get("indices"),
+                partition=int(t.get("partition", 0)),
             )
 
         def _task(t: dict) -> Task:
@@ -244,6 +254,9 @@ class TaskManager:
         self._lock = threading.Lock()
         self._datasets: Dict[str, DatasetManager] = {}
         self._completed_notified: set = set()
+        # dataset -> last stream-barrier record (epoch, offsets,
+        # watermarks, flush_gen); journaled with the snapshot.
+        self._barriers: Dict[str, dict] = {}
         self.shard_timeout = shard_timeout
         self._stop = threading.Event()
         self._thread: Optional[threading.Thread] = None
@@ -277,6 +290,7 @@ class TaskManager:
         shuffle: bool = False,
         storage_type: str = "table",
         task_type: str = TaskType.TRAINING,
+        num_stream_partitions: int = 1,
     ) -> None:
         params = {
             "dataset_name": dataset_name,
@@ -286,6 +300,7 @@ class TaskManager:
             "shuffle": shuffle,
             "storage_type": storage_type,
             "task_type": task_type,
+            "num_stream_partitions": num_stream_partitions,
         }
         with self._lock:
             if dataset_name in self._datasets:
@@ -297,6 +312,7 @@ class TaskManager:
                 shard_size,
                 num_epochs,
                 shuffle,
+                num_stream_partitions=num_stream_partitions,
             )
             self._datasets[dataset_name] = DatasetManager(
                 splitter, task_type, params=params
@@ -354,6 +370,57 @@ class TaskManager:
                 ds.recover_node_tasks(node_id)
         self._changed()
 
+    # -- stream barriers ----------------------------------------------------
+
+    def ledger_watermarks(self, dataset_name: str) -> dict:
+        """Streaming ledger frontier: per-partition fabrication
+        offsets, per-partition completion watermarks, and the total
+        contiguously-applied record count (the barrier's HWM)."""
+        with self._lock:
+            ds = self._datasets.get(dataset_name)
+            sp = ds.splitter if ds is not None else None
+            if sp is None or not hasattr(sp, "watermarks"):
+                return {"offsets": {}, "watermarks": {}, "records": 0}
+            return {
+                "offsets": dict(sp.part_offsets),
+                "watermarks": dict(sp.watermarks),
+                "records": sp.watermark_records(),
+            }
+
+    def record_barrier(
+        self,
+        dataset_name: str,
+        epoch: int,
+        step: int,
+        flush_gen: int = 0,
+        flushed_rows: int = 0,
+    ) -> dict:
+        """Pin the current streaming cut as the last barrier: (epoch,
+        per-partition offsets + watermarks, PS flush generation) as one
+        unit. Lives inside the warm-restart snapshot, so the journal
+        write that makes it durable is the same one that makes the
+        shard ledger durable — the atomicity the barrier contract
+        needs."""
+        frontier = self.ledger_watermarks(dataset_name)
+        with self._lock:
+            record = {
+                "epoch": epoch,
+                "step": step,
+                "offsets": frontier["offsets"],
+                "watermarks": frontier["watermarks"],
+                "records": frontier["records"],
+                "flush_gen": flush_gen,
+                "flushed_rows": flushed_rows,
+            }
+            self._barriers[dataset_name] = record
+        self._changed(urgent=True)
+        return dict(record)
+
+    def last_barrier(self, dataset_name: str) -> Optional[dict]:
+        with self._lock:
+            rec = self._barriers.get(dataset_name)
+            return dict(rec) if rec else None
+
     def finished(self) -> bool:
         with self._lock:
             if not self._datasets:
@@ -398,6 +465,10 @@ class TaskManager:
                     for name, ds in self._datasets.items()
                 },
                 "completed_notified": sorted(self._completed_notified),
+                "barriers": {
+                    name: dict(rec)
+                    for name, rec in self._barriers.items()
+                },
             }
 
     def reset(self) -> None:
@@ -406,6 +477,7 @@ class TaskManager:
         with self._lock:
             self._datasets = {}
             self._completed_notified = set()
+            self._barriers = {}
 
     def restore_snapshot(self, state: dict) -> None:
         for name, entry in state.get("datasets", {}).items():
@@ -420,6 +492,9 @@ class TaskManager:
                 or "table",
                 task_type=params.get("task_type", TaskType.TRAINING)
                 or TaskType.TRAINING,
+                num_stream_partitions=int(
+                    params.get("num_stream_partitions", 1)
+                ),
             )
             with self._lock:
                 ds = self._datasets[name]
@@ -432,6 +507,18 @@ class TaskManager:
             self._completed_notified = set(
                 state.get("completed_notified", [])
             )
+            # The JSON round-trip stringifies the per-partition dict
+            # keys; the query path (StreamBarrierResponse) and the
+            # live record_barrier path both speak int partitions.
+            self._barriers = {}
+            for name, rec in state.get("barriers", {}).items():
+                rec = dict(rec)
+                for field in ("offsets", "watermarks"):
+                    rec[field] = {
+                        int(p): int(v)
+                        for p, v in rec.get(field, {}).items()
+                    }
+                self._barriers[name] = rec
         self._changed()
 
     # -- watchdog -----------------------------------------------------------

@@ -301,6 +301,12 @@ class KvVariable:
         self._num_shards = num_shards
         self._disk_tier_path = disk_tier_path
         self._max_ram_rows = max_ram_rows
+        # Single-host replay fence: client_id -> highest apply_seq
+        # absorbed (the in-process analogue of PsServer._part_seqs —
+        # one mark per client, since there is no partition movement
+        # on this path). Fenced applies at or below the mark are
+        # replayed duplicates and no-op.
+        self._fence_seqs: Dict[int, int] = {}
         if disk_tier_path and max_ram_rows > 0:
             self.enable_disk_tier(disk_tier_path, max_ram_rows)
 
@@ -465,10 +471,20 @@ class KvVariable:
         grads: np.ndarray,
         step: int,
         lr: float = 1e-3,
+        client_id: int = -1,
+        apply_seq: int = -1,
         **kw,
     ) -> None:
         """Fused sparse apply. Duplicate keys are combined first (sum)
-        — the reference's kernels expect deduplicated ids too."""
+        — the reference's kernels expect deduplicated ids too.
+
+        ``(client_id, apply_seq)`` with both >= 0 engages the replay
+        fence: a seq at or below this client's mark is a replayed
+        duplicate and becomes a no-op instead of a double-apply."""
+        if client_id >= 0 and apply_seq >= 0:
+            if apply_seq <= self._fence_seqs.get(client_id, -1):
+                return
+            self._fence_seqs[client_id] = apply_seq
         keys = np.ascontiguousarray(keys, np.int64).ravel()
         grads = np.ascontiguousarray(grads, np.float32).reshape(
             keys.size, self.embedding_dim

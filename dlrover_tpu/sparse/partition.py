@@ -34,6 +34,21 @@ def key_partition(keys: np.ndarray, num_partitions: int = NUM_PARTITIONS
     return (k % np.uint64(num_partitions)).astype(np.int32)
 
 
+def group_by_partition(
+    keys: np.ndarray, num_partitions: int = NUM_PARTITIONS
+) -> Dict[int, np.ndarray]:
+    """partition id -> indices (into ``keys``) that hash to it. The
+    shared grouping for per-partition work: delta-flush file layout
+    and the replay fence's per-partition dedup both key on it, so a
+    partition restored on a new owner sees exactly the key set the
+    old owner's fence covered."""
+    parts = key_partition(keys, num_partitions)
+    out: Dict[int, np.ndarray] = {}
+    for p in np.unique(parts):
+        out[int(p)] = np.nonzero(parts == p)[0]
+    return out
+
+
 @dataclasses.dataclass
 class PartitionMap:
     """Versioned assignment of virtual partitions to PS node ids.

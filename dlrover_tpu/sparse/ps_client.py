@@ -41,6 +41,7 @@ class DistributedKvClient:
         embedding_dims: Dict[str, int],
         max_retries: int = 12,
         retry_interval: float = 0.5,
+        client_id: int = -1,
     ):
         # The default retry budget (backoff sleeps totalling ~39 s)
         # must comfortably exceed the PsManager liveness monitor's
@@ -51,7 +52,18 @@ class DistributedKvClient:
         self.embedding_dims = dict(embedding_dims)
         self.max_retries = max_retries
         self.retry_interval = retry_interval
+        # Replay fence identity: with client_id >= 0 every apply is
+        # stamped (epoch, client_id, apply_seq) so a post-failover
+        # replay is deduped server-side instead of double-applied.
+        # epoch is advanced by the trainer at each stream barrier.
+        self.client_id = client_id
+        self.epoch = -1
+        self._apply_seq = -1
         self._map: Optional[PartitionMap] = None
+        # Bumps whenever a refreshed map carries a new version — the
+        # trainer watches it to know a rebalance/failover happened and
+        # its post-barrier window must be replayed through the fence.
+        self.map_changes = 0
         self._clients: Dict[str, RpcClient] = {}
         self._lock = threading.Lock()
         self._pool = ThreadPoolExecutor(max_workers=16)
@@ -61,7 +73,10 @@ class DistributedKvClient:
     def _refresh_map(self, force: bool = False) -> PartitionMap:
         with self._lock:
             if self._map is None or force:
+                old = self._map.version if self._map else -1
                 self._map = self._map_source()
+                if self._map.version != old and old >= 0:
+                    self.map_changes += 1
             return self._map
 
     def _client_for(self, addr: str) -> RpcClient:
@@ -159,11 +174,17 @@ class DistributedKvClient:
         optimizer: str = "adam",
         lr: float = 1e-3,
         hessian=None,
+        apply_seq: Optional[int] = None,
         **hyperparams,
-    ) -> None:
+    ) -> int:
         """``hessian``: per-key auxiliary rows in the same layout as
         ``grads`` (adahessian's Hutchinson diagonal estimates); sliced
-        per shard alongside the gradients."""
+        per shard alongside the gradients.
+
+        Returns the fence sequence number this apply was stamped with
+        (-1 when unfenced). Pass ``apply_seq`` explicitly only when
+        replaying a buffered apply after a failover — the original seq
+        makes the replay idempotent against the PS fence."""
         keys = np.ascontiguousarray(keys, np.int64).ravel()
         dim = self.embedding_dims[table]
         grads = np.ascontiguousarray(grads, np.float32).reshape(
@@ -173,6 +194,15 @@ class DistributedKvClient:
             hessian = np.ascontiguousarray(
                 hessian, np.float32
             ).reshape(keys.size, dim)
+        if apply_seq is None:
+            if self.client_id >= 0:
+                self._apply_seq += 1
+                apply_seq = self._apply_seq
+            else:
+                apply_seq = -1
+        elif self.client_id >= 0:
+            # Replays must never run ahead of fresh applies.
+            self._apply_seq = max(self._apply_seq, apply_seq)
 
         def call(addr, version, sub_keys, idx):
             self._client_for(addr).get(msg.PsApplyRequest(
@@ -189,9 +219,13 @@ class DistributedKvClient:
                 lr=lr,
                 hyperparams=dict(hyperparams),
                 map_version=version,
+                epoch=self.epoch,
+                client_id=self.client_id,
+                apply_seq=apply_seq,
             ))
 
         self._fan_out(keys, call)
+        return apply_seq
 
     def table_size(self, table: str) -> int:
         """Total rows across reachable shards (stats fan-out; test/ops

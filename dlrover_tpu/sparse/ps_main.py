@@ -1,0 +1,124 @@
+"""Standalone parameter-server node process.
+
+``python -m dlrover_tpu.sparse.ps_main --master host:port ...`` runs
+one PsServer as its own OS process: it registers with the master's
+PsManager (which assigns partitions, directs restores, and publishes
+the map) and then heartbeats via periodic ``PsStatsReport``s — the
+same report the hot-PS optimizer and the PS liveness monitor consume.
+
+This is the process boundary the kill drills need: ``examples/ctr``
+runs its PS nodes in-process (one SIGKILL would take the whole drill
+down), while ``tools/stream_soak.py`` SIGKILLs individual PS
+processes and lets the master's liveness monitor fail them over.
+"""
+
+from __future__ import annotations
+
+import argparse
+import signal
+import sys
+import threading
+from typing import Dict
+
+from dlrover_tpu.common import messages as msg
+from dlrover_tpu.common.comm import RpcClient
+from dlrover_tpu.common.log import get_logger
+from dlrover_tpu.sparse.partition import NUM_PARTITIONS
+from dlrover_tpu.sparse.ps_server import PsServer
+
+logger = get_logger("ps_main")
+
+
+def parse_tables(spec: str) -> Dict[str, int]:
+    """"name:dim[,name:dim...]" -> {name: dim}."""
+    tables: Dict[str, int] = {}
+    for part in spec.split(","):
+        part = part.strip()
+        if not part:
+            continue
+        name, _, dim = part.partition(":")
+        tables[name.strip()] = int(dim)
+    if not tables:
+        raise ValueError(f"no tables in spec {spec!r}")
+    return tables
+
+
+def run_ps(
+    node_id: int,
+    master_addr: str,
+    checkpoint_dir: str,
+    tables: Dict[str, int],
+    port: int = 0,
+    num_partitions: int = NUM_PARTITIONS,
+    seed: int = 0,
+    stats_interval: float = 1.0,
+    stop_event: threading.Event = None,
+) -> None:
+    server = PsServer(
+        node_id,
+        checkpoint_dir,
+        tables,
+        num_partitions=num_partitions,
+        port=port,
+        seed=seed,
+    )
+    server.start()
+    client = RpcClient(master_addr)
+    client.report(msg.PsRegisterRequest(node_id=node_id,
+                                        addr=server.addr))
+    logger.info("PS %d registered with master %s", node_id, master_addr)
+    stop = stop_event or threading.Event()
+
+    def _stop(*_):
+        stop.set()
+
+    try:
+        signal.signal(signal.SIGTERM, _stop)
+        signal.signal(signal.SIGINT, _stop)
+    except ValueError:
+        pass  # not the main thread (embedded use in tests)
+    # Stats reports double as the liveness heartbeat; a missed report
+    # is fine (the monitor pings PS directly), a dead process is not.
+    while not stop.wait(stats_interval):
+        try:
+            with server._lock:
+                total = sum(len(t) for t in server._tables.values())
+            client.report(msg.PsStatsReport(
+                node_id=node_id, total_rows=total,
+            ))
+        except Exception:  # noqa: BLE001 — master may be mid-restart
+            logger.warning("PS %d stats report failed", node_id)
+    server.stop()
+    client.close()
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(description=__doc__)
+    ap.add_argument("--node-id", type=int, required=True)
+    ap.add_argument("--master", required=True,
+                    help="master RPC address host:port")
+    ap.add_argument("--checkpoint-dir", required=True,
+                    help="shared delta-flush directory")
+    ap.add_argument("--tables", default="emb:8",
+                    help='embedding tables, "name:dim[,name:dim...]"')
+    ap.add_argument("--port", type=int, default=0)
+    ap.add_argument("--num-partitions", type=int,
+                    default=NUM_PARTITIONS)
+    ap.add_argument("--seed", type=int, default=0)
+    ap.add_argument("--stats-interval", type=float, default=1.0)
+    args = ap.parse_args(argv)
+    run_ps(
+        node_id=args.node_id,
+        master_addr=args.master,
+        checkpoint_dir=args.checkpoint_dir,
+        tables=parse_tables(args.tables),
+        port=args.port,
+        num_partitions=args.num_partitions,
+        seed=args.seed,
+        stats_interval=args.stats_interval,
+    )
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -31,19 +31,43 @@ from typing import Dict, List, Optional
 
 import numpy as np
 
+from dlrover_tpu import obs
 from dlrover_tpu.common import messages as msg
 from dlrover_tpu.common.comm import RpcClient, RpcDispatcher, RpcServer
 from dlrover_tpu.common.log import get_logger
 from dlrover_tpu.common.storage import get_storage
 from dlrover_tpu.sparse.kv_variable import KvVariable
-from dlrover_tpu.sparse.partition import NUM_PARTITIONS, key_partition
+from dlrover_tpu.sparse.partition import (
+    NUM_PARTITIONS,
+    group_by_partition,
+    key_partition,
+)
 
 logger = get_logger("ps_server")
+
+_FENCED_APPLIES = obs.counter(
+    "dlrover_stream_fenced_applies_total",
+    "Replayed (client, seq) apply rows deduplicated by the per-"
+    "partition replay fence (each one would have been a double-apply)",
+    ("table",),
+)
+_STALE_EPOCH_REJECTS = obs.counter(
+    "dlrover_stream_stale_epoch_rejects_total",
+    "Apply requests rejected because their barrier epoch predates "
+    "the PS fence epoch (a zombie writer from before a restore)",
+    ("table",),
+)
 
 
 class StaleMapError(RuntimeError):
     """Client used an outdated PartitionMap (or hit a frozen/foreign
     partition); it must refetch the map and retry."""
+
+
+class StaleEpochError(RuntimeError):
+    """Apply carried a barrier epoch older than this PS's fence: the
+    writer predates the last restore cut and must re-sync (unlike a
+    stale map this is not retryable with the same request)."""
 
 
 class PsServer:
@@ -97,6 +121,20 @@ class PsServer:
         # KvVariable's version counter is the training step passed to
         # apply_gradients/assign)
         self._flushed_version: Dict[str, int] = {}
+        # Replay fence: partition -> {client_id: highest applied seq}.
+        # Applies are synchronous per client, so seqs arrive non-
+        # decreasing; a repeat at or below the mark is a replay (the
+        # commit succeeded but the response was lost, or the trainer
+        # is replaying its post-barrier window after a failover) and
+        # must be a no-op. Granularity is the partition because that
+        # is the unit of flush/restore/rebalance: a restored partition
+        # rewinds to its fence-at-flush while surviving partitions
+        # keep their live marks — together they make trainer replay
+        # exactly-once.
+        self._part_seqs: Dict[int, Dict[int, int]] = {}
+        # Highest barrier epoch flushed/restored on this PS; applies
+        # stamped with an older epoch are rejected.
+        self.fence_epoch = -1
         self._qps_count = 0
         self._qps_t0 = time.time()
 
@@ -171,6 +209,30 @@ class PsServer:
             vals = self._tables[req.table].gather(keys, train=req.train)
         return msg.PsLookupResponse(values=msg.Tensor.from_numpy(vals))
 
+    def _fence_mask(self, req: msg.PsApplyRequest,
+                    keys: np.ndarray) -> Optional[np.ndarray]:
+        """Boolean keep-mask for a fenced apply (None = unfenced).
+        Must hold the lock. Advances the per-partition fence for the
+        partitions it admits."""
+        if req.apply_seq < 0 or req.client_id < 0:
+            return None
+        if 0 <= req.epoch < self.fence_epoch:
+            _STALE_EPOCH_REJECTS.inc(table=req.table)
+            raise StaleEpochError(
+                f"apply epoch {req.epoch} predates PS fence epoch "
+                f"{self.fence_epoch} (post-restore zombie writer)"
+            )
+        keep = np.ones(keys.size, bool)
+        for p, idx in group_by_partition(
+            keys, self.num_partitions
+        ).items():
+            fence = self._part_seqs.setdefault(p, {})
+            if req.apply_seq <= fence.get(req.client_id, -1):
+                keep[idx] = False  # replayed duplicate for this cut
+            else:
+                fence[req.client_id] = req.apply_seq
+        return keep
+
     def _apply(self, req: msg.PsApplyRequest) -> None:
         self._count()
         keys = req.keys.to_numpy()
@@ -180,6 +242,16 @@ class PsServer:
             extra["hessian"] = req.aux.to_numpy()
         with self._lock:
             self._check_version(req.map_version, keys)
+            keep = self._fence_mask(req, keys)
+            if keep is not None and not keep.all():
+                _FENCED_APPLIES.inc(
+                    int((~keep).sum()), table=req.table
+                )
+                if not keep.any():
+                    return
+                keys, grads = keys[keep], grads[keep]
+                if "hessian" in extra:
+                    extra["hessian"] = extra["hessian"][keep]
             self._tables[req.table].apply_gradients(
                 req.optimizer, keys, grads, req.step, lr=req.lr,
                 **extra, **req.hyperparams,
@@ -206,6 +278,15 @@ class PsServer:
             values=msg.Tensor.from_numpy(values),
             freqs=msg.Tensor.from_numpy(freqs),
             versions=msg.Tensor.from_numpy(versions),
+            # Live moves must carry the replay fence with the rows:
+            # without it the new owner would re-apply any replayed
+            # (client, seq) the old owner had already absorbed.
+            part_seqs={
+                p: dict(self._part_seqs.get(p, {}))
+                for p in (partitions if partitions is not None
+                          else self.partitions)
+            },
+            fence_epoch=self.fence_epoch,
         )
         if include_slots:
             state = table.state_dict()
@@ -240,6 +321,12 @@ class PsServer:
             sv = dump.slot_values[slot].to_numpy()
             sk = sk.to_numpy()
             table.import_slot(slot, sk, sv)
+        for p, seqs in dump.part_seqs.items():
+            fence = self._part_seqs.setdefault(int(p), {})
+            for c, s in seqs.items():
+                c = int(c)
+                fence[c] = max(fence.get(c, -1), int(s))
+        self.fence_epoch = max(self.fence_epoch, dump.fence_epoch)
         return keys.size
 
     def _import(self, req: msg.PsImportRequest) -> None:
@@ -300,6 +387,33 @@ class PsServer:
     def _part_dir(self, table: str, partition: int) -> str:
         return f"{self.checkpoint_dir}/{table}/p{partition:04d}"
 
+    def _fence_path(self, partition: int) -> str:
+        return f"{self.checkpoint_dir}/_fence/p{partition:04d}.json"
+
+    def _write_fences(self, step: int, epoch: int, hwm: Dict[str, int]
+                      ) -> None:
+        """Persist the replay fence of every owned partition alongside
+        the delta files. Written on EVERY flush (not only barrier
+        flushes): restore imports deltas up to the latest flush, so the
+        fence must describe that same cut or replayed seqs between the
+        last barrier and the last flush would double-apply."""
+        import json
+
+        for p in self.partitions:
+            payload = {
+                "epoch": epoch,
+                "step": step,
+                "hwm": dict(hwm or {}),
+                # JSON object keys are strings; un-stringed on restore.
+                "seqs": {
+                    str(c): s
+                    for c, s in self._part_seqs.get(p, {}).items()
+                },
+            }
+            self.storage.write_bytes(
+                json.dumps(payload).encode(), self._fence_path(p)
+            )
+
     def _flush(self, req: msg.PsFlushRequest) -> msg.PsFlushResponse:
         """Delta-flush each owned partition to its own directory so any
         future owner can restore it (files are per-partition — that is
@@ -339,7 +453,12 @@ class PsServer:
                     )
                     flushed += int(mask.sum())
                 self._flushed_version[name] = req.step + 1
-        return msg.PsFlushResponse(flushed_rows=flushed)
+            if req.epoch >= 0:
+                self.fence_epoch = max(self.fence_epoch, req.epoch)
+            self._write_fences(req.step, self.fence_epoch, req.hwm)
+        return msg.PsFlushResponse(
+            flushed_rows=flushed, epoch=self.fence_epoch
+        )
 
     def _restore(self, req: msg.PsRestoreRequest) -> None:
         """Import all delta files of the given partitions, oldest first
@@ -375,3 +494,25 @@ class PsServer:
                         "PS %d restored partition %d of %s",
                         self.node_id, p, name,
                     )
+            for p in req.partitions:
+                self._restore_fence(p)
+
+    def _restore_fence(self, partition: int) -> None:
+        """Rewind the partition's replay fence to its fence-at-flush.
+        Merging with max keeps the invariant that a seq the store has
+        absorbed is never re-applied, while seqs lost with the dead
+        node's RAM drop below the mark and are accepted on replay."""
+        import json
+
+        try:
+            raw = self.storage.read_bytes(self._fence_path(partition))
+        except (FileNotFoundError, OSError):
+            return
+        payload = json.loads(raw.decode())
+        fence = self._part_seqs.setdefault(partition, {})
+        for c, s in payload.get("seqs", {}).items():
+            c = int(c)
+            fence[c] = max(fence.get(c, -1), int(s))
+        self.fence_epoch = max(
+            self.fence_epoch, int(payload.get("epoch", -1))
+        )

@@ -12,23 +12,37 @@ PsManager liveness monitor rebalances a dead PS, then the step
 resumes — drilled end to end by ``examples/ctr/train.py --drill
 abrupt`` (RECOVERY_PS_r03.json).
 
-Periodic delta flushes (``flush_every``) bound the updates an abrupt
-PS death can lose; ``state_dict``/``load_state_dict`` carry the dense
-side for flash checkpoints while the PS side restores from its own
-per-partition files.
+With stream barriers (``barrier_every`` + a fenced client) the sparse
+path is exactly-once across abrupt PS and master kills: the trainer
+keeps a replay buffer of post-barrier applies and re-sends it (same
+fence seqs) when the partition map changes, the PS replay fence dedups
+the rows survivors already absorbed, and restored partitions rewind to
+the barrier cut — so an abrupt kill loses nothing and double-applies
+nothing. Periodic delta flushes (``flush_every``) then only bound the
+replay length, not the loss. ``state_dict``/``load_state_dict`` carry
+the dense side for flash checkpoints while the PS side restores from
+its own per-partition files.
 """
 
 from __future__ import annotations
 
 import time
-from typing import Callable, Dict, Optional, Tuple
+from typing import Callable, Dict, List, Optional, Tuple
 
 import jax
 import numpy as np
 
+from dlrover_tpu import obs
 from dlrover_tpu.common.log import get_logger
 
 logger = get_logger("sparse_trainer")
+
+_REPLAYED_APPLIES = obs.counter(
+    "dlrover_stream_replayed_applies_total",
+    "Post-barrier applies re-sent through the replay fence after a "
+    "partition-map change (PS failover or rebalance)",
+    ("table",),
+)
 
 
 class SparseTrainer:
@@ -48,6 +62,10 @@ class SparseTrainer:
         (sparse/kv_variable.py rules, e.g. "group_adam", l21=...).
     flush_manager: optional PsManager — enables the periodic
         delta-flush cadence (``flush_every`` steps).
+    barrier_client: optional ShardingClient (anything with
+        ``stream_barrier(epoch, step)``) — enables the stream-barrier
+        cadence (``barrier_every`` steps) and, with a fenced client
+        (``client.client_id >= 0``), the exactly-once replay buffer.
     """
 
     def __init__(
@@ -63,6 +81,8 @@ class SparseTrainer:
         sparse_hparams: Optional[Dict] = None,
         flush_manager=None,
         flush_every: int = 100,
+        barrier_client=None,
+        barrier_every: int = 0,
     ):
         self.client = client
         self.loss_and_grads = loss_and_grads
@@ -76,10 +96,23 @@ class SparseTrainer:
         self.sparse_hparams = dict(sparse_hparams or {})
         self.flush_manager = flush_manager
         self.flush_every = flush_every
+        self.barrier_client = barrier_client
+        self.barrier_every = barrier_every
         self.step_num = 0
-        # Rows persisted by the most recent periodic flush (drill /
-        # ops telemetry: bounds what an abrupt PS death can lose).
+        # Rows persisted by the most recent periodic flush (with the
+        # replay fence this bounds replay length, not loss).
         self.last_flush_rows = 0
+        # Stream-barrier state: the epoch stamps every fenced apply;
+        # the replay buffer holds post-barrier applies so a partition-
+        # map change (PS failover/rebalance) can replay them through
+        # the fence — survivors dedup, restored partitions re-absorb.
+        self.stream_epoch = 0
+        self.last_barrier = None
+        self._replay_buf: List[Tuple[int, np.ndarray, np.ndarray, int]]
+        self._replay_buf = []
+        self._seen_map_changes = getattr(client, "map_changes", 0)
+        if getattr(client, "client_id", -1) >= 0:
+            client.epoch = self.stream_epoch
 
     def train_step(self, keys: np.ndarray, *batch) -> float:
         """One update: lookup -> dense+embedding grads -> dense optax
@@ -104,15 +137,21 @@ class SparseTrainer:
             dgrad, self.opt_state, self.dense
         )
         self.dense = optax.apply_updates(self.dense, updates)
-        self.client.apply_gradients(
+        self.maybe_replay()
+        egrad_np = np.asarray(egrad).reshape(-1, self.embedding_dim)
+        seq = self.client.apply_gradients(
             self.table,
             flat,
-            np.asarray(egrad).reshape(-1, self.embedding_dim),
+            egrad_np,
             step=self.step_num,
             optimizer=self.sparse_optimizer,
             lr=self.sparse_lr,
             **self.sparse_hparams,
         )
+        if isinstance(seq, int) and seq >= 0:
+            self._replay_buf.append(
+                (seq, flat, egrad_np, self.step_num)
+            )
         if (
             self.flush_manager is not None
             and self.flush_every
@@ -127,7 +166,73 @@ class SparseTrainer:
                 self.step_num, self.last_flush_rows,
                 time.time() - t0,
             )
+        if (
+            self.barrier_client is not None
+            and self.barrier_every
+            and self.step_num % self.barrier_every == 0
+        ):
+            self.commit_barrier()
         return float(loss)
+
+    # -- stream barriers ------------------------------------------------
+
+    def maybe_replay(self) -> int:
+        """Replay the post-barrier apply window if the partition map
+        changed since we last looked (a PS died or partitions moved).
+        Replays carry their original fence seqs: partitions that
+        survived dedup them, partitions restored from the barrier cut
+        re-absorb them — together, exactly-once."""
+        mc = getattr(self.client, "map_changes", None)
+        if mc is None or mc == self._seen_map_changes:
+            return 0
+        self._seen_map_changes = mc
+        if not self._replay_buf:
+            return 0
+        logger.info(
+            "partition map changed: replaying %d post-barrier applies "
+            "through the fence", len(self._replay_buf),
+        )
+        for seq, keys, grads, step in list(self._replay_buf):
+            self.client.apply_gradients(
+                self.table,
+                keys,
+                grads,
+                step=step,
+                optimizer=self.sparse_optimizer,
+                lr=self.sparse_lr,
+                apply_seq=seq,
+                **self.sparse_hparams,
+            )
+        _REPLAYED_APPLIES.inc(len(self._replay_buf), table=self.table)
+        # The replay itself may have raced another map bump; catch up
+        # so the next step does not re-replay what we just sent (the
+        # fence would dedup it, but the RPCs are not free).
+        self._seen_map_changes = getattr(
+            self.client, "map_changes", mc
+        )
+        return len(self._replay_buf)
+
+    def commit_barrier(self):
+        """Commit a stream barrier. Applies are synchronous, so
+        between steps the stream is quiesced — the barrier cut is
+        exact. On success the epoch advances (new applies outrank any
+        pre-barrier zombie) and the replay buffer resets to the new
+        cut."""
+        resp = self.barrier_client.stream_barrier(
+            epoch=self.stream_epoch + 1, step=self.step_num
+        )
+        self.stream_epoch = resp.epoch
+        if getattr(self.client, "client_id", -1) >= 0:
+            self.client.epoch = self.stream_epoch
+        self._replay_buf.clear()
+        self.last_barrier = resp
+        logger.info(
+            "stream barrier epoch %d at step %d: %d rows flushed, "
+            "gen %d, durable=%s",
+            resp.epoch, resp.step, resp.flushed_rows, resp.flush_gen,
+            resp.durable,
+        )
+        return resp
 
     # -- dense-side checkpoint state ------------------------------------
 

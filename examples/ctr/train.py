@@ -23,8 +23,14 @@ with NO flush and NO master notification. The training loop's next
 sparse op blocks in the client's stale-map retry; the PsManager
 liveness monitor detects the dead PS, rebalances its partitions onto
 the survivors (restored from the last periodic delta flush), bumps the
-map version, and the blocked client resumes — updates lost are bounded
-by --flush-every. --drill-json writes the recovery stats artifact.
+map version, and the blocked client resumes. This example runs
+UNFENCED (no stream barriers): an abrupt death loses the updates since
+the last flush, so --flush-every bounds the loss window. With the
+stream-barrier path (SparseTrainer barrier_every + a fenced client,
+drilled by tools/stream_soak.py) the same kill loses ZERO updates —
+the trainer replays its post-barrier window through the PS replay
+fence, so flush cadence only bounds replay length, not loss.
+--drill-json writes the recovery stats artifact.
 """
 
 from __future__ import annotations

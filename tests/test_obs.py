@@ -635,6 +635,42 @@ class TestMetricNameHygiene:
                 problems[name] = (got, want)
         assert not problems, problems
 
+    def test_stream_plane_metrics_are_audited(self):
+        """The streaming exactly-once plane's registrations
+        (ps_server fence, servicer barrier, trainer replay) must be
+        visible to the walker with the contract names/types/labels —
+        the FAULT_TOLERANCE.md failure matrix and stream_soak audit
+        key on them. Labels stay bounded: table and dataset names,
+        never client ids or sequence numbers."""
+        sites = {
+            name: (mtype, labels)
+            for _, _, mtype, name, _, labels in self._call_sites()
+        }
+        expected = {
+            "dlrover_stream_fenced_applies_total": (
+                "counter", ["table"],
+            ),
+            "dlrover_stream_stale_epoch_rejects_total": (
+                "counter", ["table"],
+            ),
+            "dlrover_stream_barriers_total": (
+                "counter", ["dataset"],
+            ),
+            "dlrover_stream_barrier_seconds": ("histogram", None),
+            "dlrover_stream_watermark_records": (
+                "gauge", ["dataset"],
+            ),
+            "dlrover_stream_replayed_applies_total": (
+                "counter", ["table"],
+            ),
+        }
+        problems = {}
+        for name, want in expected.items():
+            got = sites.get(name)
+            if got != want:
+                problems[name] = (got, want)
+        assert not problems, problems
+
 
 class TestSpanNameHygiene:
     """Audit every literal ``obs.span(...)`` / ``obs.event(...)``

@@ -1,0 +1,364 @@
+"""Streaming exactly-once: replay fence, barrier-consistent state,
+and the chaos PS-plane scope.
+
+Strategy mirrors test_ps_elastic.py (real in-process PS RPC servers +
+a real PsManager) and test_master_failover.py (real JobMaster round
+trips through a MasterStateStore): the fence/ledger contracts are
+asserted against the real wire path, not mocks, and the acceptance
+soak (``tools/stream_soak.py``) rides as a subprocess smoke.
+"""
+
+import os
+import subprocess
+import sys
+
+import numpy as np
+import pytest
+
+from dlrover_tpu.common import chaos
+from dlrover_tpu.common import messages as msg
+from dlrover_tpu.common.comm import RpcClient
+from dlrover_tpu.master.master import JobMaster
+from dlrover_tpu.master.ps_manager import PsManager
+from dlrover_tpu.sparse.ps_client import DistributedKvClient
+from dlrover_tpu.sparse.ps_server import PsServer
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+TOOLS = os.path.join(REPO, "tools")
+
+DIM = 4
+DIMS = {"emb": DIM}
+
+
+def _start_ps(node_id, tmp_path, num_partitions=16):
+    ps = PsServer(
+        node_id=node_id,
+        checkpoint_dir=str(tmp_path / "sparse_ckpt"),
+        embedding_dims=DIMS,
+        num_partitions=num_partitions,
+        seed=node_id * 100,
+    )
+    ps.start()
+    return ps
+
+
+def _row_counts(server, partitions):
+    """key -> apply count under all-ones SGD at lr=1.0 (init noise is
+    ±0.05, far below the 0.5 rounding boundary)."""
+    dump = server._dump_table("emb", list(partitions), 0, False)
+    if dump.keys is None:
+        return {}
+    keys = dump.keys.to_numpy()
+    values = dump.values.to_numpy().reshape(keys.size, DIM)
+    return {
+        int(k): int(round(-float(row.mean())))
+        for k, row in zip(keys, values)
+    }
+
+
+class TestReplayFenceRestore:
+    """A replayed apply window must be absorbed exactly once by a
+    fleet where some partitions survived (fence dedup) and some were
+    restored from the barrier flush (re-absorb)."""
+
+    def test_replay_after_ps_kill_is_exactly_once(self, tmp_path):
+        mgr = PsManager(num_partitions=16)
+        servers = {}
+        try:
+            for i in (0, 1):
+                servers[i] = _start_ps(i, tmp_path)
+                mgr.register_ps(i, servers[i].addr)
+            client = DistributedKvClient(
+                lambda: mgr.partition_map, DIMS,
+                retry_interval=0.05, client_id=0,
+            )
+            client.epoch = 1
+            # Six disjoint batches of 8 keys, one fence seq each.
+            batches = [
+                np.arange(i * 8, (i + 1) * 8, dtype=np.int64)
+                for i in range(6)
+            ]
+            ones = np.ones((8, DIM), np.float32)
+            replay_log = []
+            for step, keys in enumerate(batches[:4], start=1):
+                seq = client.apply_gradients(
+                    "emb", keys, ones, step=step,
+                    optimizer="sgd", lr=1.0,
+                )
+                replay_log.append((seq, keys, step))
+            # Barrier cut: flush stamped with epoch + ledger HWM;
+            # every partition's fence file records the cut.
+            mgr.flush_all(step=4, epoch=1, hwm={"0": 32})
+            for step, keys in enumerate(batches[4:], start=5):
+                seq = client.apply_gradients(
+                    "emb", keys, ones, step=step,
+                    optimizer="sgd", lr=1.0,
+                )
+                replay_log.append((seq, keys, step))
+
+            # SIGKILL-equivalent: PS 0 dies with its post-barrier
+            # applies unflushed; the survivor restores its partitions
+            # from the barrier-cut delta files.
+            servers[0].stop()
+            mgr.remove_ps(0)
+
+            # The trainer's failover replay: the whole post-barrier
+            # window, original fence seqs. Survivor partitions dedup,
+            # restored partitions re-absorb.
+            for seq, keys, step in replay_log:
+                client.apply_gradients(
+                    "emb", keys, ones, step=step,
+                    optimizer="sgd", lr=1.0, apply_seq=seq,
+                )
+            counts = _row_counts(servers[1], range(16))
+            expected = {int(k): 1 for b in batches for k in b}
+            assert counts == expected
+            client.close()
+        finally:
+            for ps in servers.values():
+                ps.stop()
+
+    def test_stale_epoch_apply_is_rejected(self, tmp_path):
+        mgr = PsManager(num_partitions=16)
+        server = _start_ps(0, tmp_path)
+        try:
+            mgr.register_ps(0, server.addr)
+            mgr.flush_all(step=1, epoch=3, hwm={})
+            assert server.fence_epoch == 3
+            rpc = RpcClient(server.addr)
+            try:
+                with pytest.raises(Exception, match="fence epoch"):
+                    rpc.get(msg.PsApplyRequest(
+                        table="emb",
+                        optimizer="sgd",
+                        keys=msg.Tensor.from_numpy(
+                            np.arange(4, dtype=np.int64)
+                        ),
+                        grads=msg.Tensor.from_numpy(
+                            np.ones((4, DIM), np.float32)
+                        ),
+                        step=9,
+                        lr=1.0,
+                        map_version=mgr.partition_map.version,
+                        epoch=2,  # pre-restore zombie writer
+                        client_id=0,
+                        apply_seq=99,
+                    ))
+            finally:
+                rpc.close()
+            # Unfenced applies (client_id < 0) stay untouched by the
+            # epoch fence — the non-streaming sparse path must not
+            # start failing once a stream barrier has ever run.
+            rpc = RpcClient(server.addr)
+            try:
+                rpc.get(msg.PsApplyRequest(
+                    table="emb",
+                    optimizer="sgd",
+                    keys=msg.Tensor.from_numpy(
+                        np.arange(4, dtype=np.int64)
+                    ),
+                    grads=msg.Tensor.from_numpy(
+                        np.ones((4, DIM), np.float32)
+                    ),
+                    step=9,
+                    lr=1.0,
+                    map_version=mgr.partition_map.version,
+                ))
+            finally:
+                rpc.close()
+        finally:
+            server.stop()
+
+    def test_fence_rides_partition_moves(self, tmp_path):
+        """A live PS-to-PS rebalance must carry the fence state with
+        the rows: after partitions move, a replayed seq is still a
+        duplicate on the new owner."""
+        mgr = PsManager(num_partitions=16)
+        servers = {0: _start_ps(0, tmp_path)}
+        try:
+            mgr.register_ps(0, servers[0].addr)
+            client = DistributedKvClient(
+                lambda: mgr.partition_map, DIMS,
+                retry_interval=0.05, client_id=0,
+            )
+            client.epoch = 1
+            keys = np.arange(32, dtype=np.int64)
+            seq = client.apply_gradients(
+                "emb", keys, np.ones((32, DIM), np.float32),
+                step=1, optimizer="sgd", lr=1.0,
+            )
+            # Scale up: half the partitions move PS-to-PS (freeze ->
+            # pull -> publish), dumps carrying part_seqs/fence_epoch.
+            servers[1] = _start_ps(1, tmp_path)
+            mgr.register_ps(1, servers[1].addr)
+            client.apply_gradients(
+                "emb", keys, np.ones((32, DIM), np.float32),
+                step=1, optimizer="sgd", lr=1.0, apply_seq=seq,
+            )
+            counts = {}
+            for ps_id, server in servers.items():
+                counts.update(_row_counts(
+                    server, mgr.partition_map.partitions_of(ps_id)
+                ))
+            assert counts == {int(k): 1 for k in keys}
+            client.close()
+        finally:
+            for ps in servers.values():
+                ps.stop()
+
+
+class TestStreamingLedgerWarmRestart:
+    """The streaming shard ledger — per-partition offsets, completion
+    watermarks, barrier records, and the PS partition map — survives a
+    real JobMaster bounce through the MasterStateStore journal."""
+
+    def _master(self, state_dir):
+        m = JobMaster(
+            port=0, node_num=2, rdzv_timeout=1.0,
+            state_dir=str(state_dir),
+        )
+        m.prepare()
+        return m
+
+    def test_round_trip_preserves_stream_state(self, tmp_path):
+        m1 = self._master(tmp_path)
+        try:
+            m1.task_manager.create_dataset(
+                "stream", dataset_size=24, shard_size=4,
+                storage_type="streaming", num_stream_partitions=2,
+            )
+            # The PS partition map is recoverable state too: a master
+            # bounce must not forget which PS owns which partitions.
+            m1.ps_manager.register_ps(0, "127.0.0.1:1")
+            map_version = m1.ps_manager.partition_map.version
+            dispatched = []
+            for _ in range(3):
+                t = m1.task_manager.get_task(0, "stream")
+                dispatched.append(t)
+            # Complete out of order: t3 parks beyond the t2 gap, so
+            # one partition's watermark must NOT advance past t2.
+            m1.task_manager.report_task_result(
+                "stream", dispatched[0].task_id, True, node_id=0
+            )
+            m1.task_manager.report_task_result(
+                "stream", dispatched[2].task_id, True, node_id=0
+            )
+            barrier = m1.task_manager.record_barrier(
+                "stream", epoch=1, step=3,
+                flush_gen=7, flushed_rows=42,
+            )
+            frontier = m1.task_manager.ledger_watermarks("stream")
+        finally:
+            m1.stop()  # final journal flush
+
+        m2 = self._master(tmp_path)
+        try:
+            assert m2.warm_restarted
+            # Barrier record restored atomically with the ledger.
+            rec = m2.task_manager.last_barrier("stream")
+            assert rec is not None
+            assert rec["epoch"] == 1
+            assert rec["flush_gen"] == 7
+            assert rec["flushed_rows"] == 42
+            assert rec["watermarks"] == barrier["watermarks"]
+            # Frontier (offsets + parked watermark gap) restored.
+            assert (
+                m2.task_manager.ledger_watermarks("stream") == frontier
+            )
+            # PS partition map adopted, not re-derived.
+            pmap = m2.ps_manager.partition_map
+            assert pmap.version == map_version
+            assert pmap.ps_addrs == {0: "127.0.0.1:1"}
+
+            # The in-flight shard is still owned by node 0; draining
+            # the stream covers every record exactly once.
+            seen = []
+            for t in dispatched:
+                seen.extend(t.shard.record_indices)
+            m2.task_manager.report_task_result(
+                "stream", dispatched[1].task_id, True, node_id=0
+            )
+            while True:
+                t = m2.task_manager.get_task(0, "stream")
+                if t.shard is None:
+                    break
+                seen.extend(t.shard.record_indices)
+                m2.task_manager.report_task_result(
+                    "stream", t.task_id, True, node_id=0
+                )
+            assert sorted(seen) == list(range(24))
+            assert (
+                m2.task_manager.ledger_watermarks("stream")["records"]
+                == 24
+            )
+        finally:
+            m2.stop()
+
+
+class TestChaosScope:
+    """DLROVER_TPU_CHAOS_SCOPE narrows client-side faults to one RPC
+    plane without disturbing the seeded schedule."""
+
+    def test_ps_scope_spares_the_control_plane(self):
+        inj = chaos.ChaosInjector(
+            seed=3, drop_rate=1.0, node_id=0, scope="ps"
+        )
+        # Master-plane request: the draw happens, the fault does not.
+        inj.before_client_call("get", msg.TaskRequest())
+        with pytest.raises(chaos.ChaosDropError):
+            inj.before_client_call("get", msg.PsStatsRequest())
+
+    def test_master_scope_spares_the_ps_plane(self):
+        inj = chaos.ChaosInjector(
+            seed=3, drop_rate=1.0, node_id=0, scope="master"
+        )
+        inj.before_client_call("get", msg.PsStatsRequest())
+        with pytest.raises(chaos.ChaosDropError):
+            inj.before_client_call("get", msg.TaskRequest())
+
+    def test_scoping_does_not_shift_the_schedule(self):
+        """Same seed => identical per-index decisions whether or not
+        a scope filters some of them out: the decision log (the
+        drills' replay key) must not depend on the scope."""
+        def decisions(scope):
+            inj = chaos.ChaosInjector(
+                seed=42, drop_rate=0.3, node_id=0, scope=scope
+            )
+            reqs = [msg.TaskRequest(), msg.PsStatsRequest()] * 50
+            for req in reqs:
+                try:
+                    inj.before_client_call("get", req)
+                except chaos.ChaosDropError:
+                    pass
+            return list(inj.decisions)
+
+        assert decisions("all") == decisions("ps")
+
+    def test_from_env_and_validation(self):
+        inj = chaos.ChaosInjector.from_env(
+            {"DLROVER_TPU_CHAOS_SCOPE": "ps"}
+        )
+        assert inj.scope == "ps"
+        assert chaos.ChaosInjector.from_env({}).scope == "all"
+        with pytest.raises(ValueError):
+            chaos.ChaosInjector(scope="workers")
+
+
+class TestStreamSoakSelftest:
+    def test_stream_soak_selftest_smoke(self):
+        """The acceptance drill the tier-1 set runs: real master + PS
+        subprocesses, PS SIGKILL + master SIGKILL + rebalance, every
+        record id applied exactly once."""
+        proc = subprocess.run(
+            [
+                sys.executable,
+                os.path.join(TOOLS, "stream_soak.py"),
+                "--selftest",
+            ],
+            capture_output=True,
+            text=True,
+            timeout=180,
+            env={**os.environ, "JAX_PLATFORMS": "cpu"},
+        )
+        assert proc.returncode == 0, proc.stdout + proc.stderr
+        assert "stream soak selftest ok" in proc.stdout
